@@ -1,0 +1,21 @@
+"""firedancer_tpu — a TPU-native high-performance Solana validator framework.
+
+A from-scratch re-design of the capabilities of Jump Crypto's Firedancer
+(/root/reference) for TPU hardware: the compute-heavy protocol math (ed25519
+batch sigverify, SHA-2, erasure coding, merkle trees) runs as batched JAX/XLA
+and Pallas programs on TPU, while the streaming runtime around it (rings,
+stages, dedup, pack, PoH) is host-side, mirroring the reference's
+tile-pipeline shape (SURVEY.md §3.3):
+
+    ingress -> verify (TPU) -> dedup -> pack -> poh -> shred (TPU RS/merkle)
+
+Layout:
+    ops/       JAX/Pallas device math (field arith, curve, sha, sigverify)
+    models/    assembled pipelines ("flagship" = leader TPU pipeline)
+    parallel/  mesh construction, shardings, stage framework
+    tango/     host message rings, flow control, dedup caches
+    runtime/   host stage implementations (verify driver, dedup, pack, gen)
+    utils/     logging, config, metrics
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,77 @@
+"""Sanity tests for the pure-python ed25519 ground truth (RFC 8032 vectors)."""
+
+import hashlib
+
+from firedancer_tpu.ops.ref import ed25519_ref as ref
+
+# RFC 8032 §7.1 test vectors (public inputs only).
+RFC_VECTORS = [
+    # (secret_hex, public_hex, msg_hex, sig_hex)
+    (
+        "9d61b19deffd5a60ba844af492ec2cc44449c5697b326919703bac031cae7f60",
+        "d75a980182b10ab7d54bfed3c964073a0ee172f3daa62325af021a68f707511a",
+        "",
+        "e5564300c360ac729086e2cc806e828a84877f1eb8e5d974d873e06522490155"
+        "5fb8821590a33bacc61e39701cf9b46bd25bf5f0595bbe24655141438e7a100b",
+    ),
+    (
+        "4ccd089b28ff96da9db6c346ec114e0f5b8a319f35aba624da8cf6ed4fb8a6fb",
+        "3d4017c3e843895a92b70aa74d1b7ebc9c982ccf2ec4968cc0cd55f12af4660c",
+        "72",
+        "92a009a9f0d4cab8720e820b5f642540a2b27b5416503f8fb3762223ebdb69da"
+        "085ac1e43e15996e458f3613d0f11d8c387b2eaeb4302aeeb00d291612bb0c00",
+    ),
+    (
+        "c5aa8df43f9f837bedb7442f31dcb7b166d38535076f094b85ce3a2e0b4458f7",
+        "fc51cd8e6218a1a38da47ed00230f0580816ed13ba3303ac5deb911548908025",
+        "af82",
+        "6291d657deec24024827e69c3abe01a30ce548a284743a445e3680d7db5ac3ac"
+        "18ff9b538d16f290ae67f760984dc6594a7c15e9716ed28dc027beceea1ec40a",
+    ),
+]
+
+
+def test_rfc8032_vectors():
+    for secret, pub, msg, sig in RFC_VECTORS:
+        secret, pub, msg, sig = (
+            bytes.fromhex(secret),
+            bytes.fromhex(pub),
+            bytes.fromhex(msg),
+            bytes.fromhex(sig),
+        )
+        assert ref.public_key(secret) == pub
+        assert ref.sign(secret, msg) == sig
+        assert ref.verify(msg, sig, pub)
+
+
+def test_reject_corruption():
+    secret = hashlib.sha256(b"key").digest()
+    pub = ref.public_key(secret)
+    msg = b"hello solana"
+    sig = ref.sign(secret, msg)
+    assert ref.verify(msg, sig, pub)
+    assert not ref.verify(msg + b"x", sig, pub)
+    bad = bytearray(sig)
+    bad[1] ^= 1
+    assert not ref.verify(msg, bytes(bad), pub)
+
+
+def test_reject_high_s():
+    secret = hashlib.sha256(b"key2").digest()
+    pub = ref.public_key(secret)
+    msg = b"m"
+    sig = ref.sign(secret, msg)
+    s = int.from_bytes(sig[32:], "little")
+    # s + L is an equivalent scalar — classic malleability; must be rejected.
+    forged = sig[:32] + int.to_bytes(s + ref.L, 32, "little")
+    assert not ref.verify(msg, forged, pub)
+
+
+def test_reject_small_order():
+    # identity point encoding (y=1) is small order
+    ident = int.to_bytes(1, 32, "little")
+    secret = hashlib.sha256(b"key3").digest()
+    pub = ref.public_key(secret)
+    sig = ref.sign(secret, b"m")
+    assert not ref.verify(b"m", sig[:32] + sig[32:], ident)  # small-order A
+    assert not ref.verify(b"m", ident + sig[32:], pub)  # small-order R
