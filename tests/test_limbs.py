@@ -1,0 +1,122 @@
+"""Differential tests: JAX limb field arithmetic vs python big-int ground truth.
+
+Everything goes through jax.jit: eager dispatch is prohibitively slow in this
+environment and the production path is always jitted anyway.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from firedancer_tpu.ops import limbs as fl
+
+P = fl.P
+
+j_add = jax.jit(fl.fe_add)
+j_sub = jax.jit(fl.fe_sub)
+j_neg = jax.jit(fl.fe_neg)
+j_mul = jax.jit(fl.fe_mul)
+j_sqr = jax.jit(fl.fe_sqr)
+j_invert = jax.jit(fl.fe_invert)
+j_pow2523 = jax.jit(fl.fe_pow2523)
+j_freeze = jax.jit(fl.fe_freeze)
+j_parity = jax.jit(fl.fe_parity)
+j_eq = jax.jit(fl.fe_eq)
+j_tobytes = jax.jit(fl.fe_tobytes)
+j_frombytes = jax.jit(fl.fe_frombytes)
+j_frombytes_raw = jax.jit(lambda b: fl.fe_frombytes(b, mask_msb=False))
+
+
+def rand_ints(rng, n):
+    """Random field values covering edge regions."""
+    vals = [int.from_bytes(rng.bytes(32), "little") % P for _ in range(n - 6)]
+    vals += [0, 1, P - 1, P - 19, 2**255 - 20, (1 << 255) - 1]  # non-canonical too
+    return vals[:n]
+
+
+def to_fe(vals):
+    return jnp.asarray(
+        np.stack([fl.int_to_limbs(v) for v in vals], axis=-1), dtype=jnp.int32
+    )
+
+
+def from_fe(fe):
+    arr = np.asarray(fe)
+    return [fl.limbs_to_int(arr[:, i]) for i in range(arr.shape[1])]
+
+
+def test_roundtrip(rng):
+    vals = rand_ints(rng, 32)
+    assert from_fe(to_fe(vals)) == [v % P for v in vals]
+
+
+def test_add_sub_neg_mul_sqr(rng):
+    a, b = rand_ints(rng, 16), rand_ints(rng, 16)
+    fa, fb = to_fe(a), to_fe(b)
+    assert from_fe(j_add(fa, fb)) == [(x + y) % P for x, y in zip(a, b)]
+    assert from_fe(j_sub(fa, fb)) == [(x - y) % P for x, y in zip(a, b)]
+    assert from_fe(j_neg(fa)) == [(-x) % P for x in a]
+    assert from_fe(j_mul(fa, fb)) == [(x * y) % P for x, y in zip(a, b)]
+    assert from_fe(j_sqr(fa)) == [(x * x) % P for x in a]
+
+
+@jax.jit
+def _chain_step(fa):
+    fa = fl.fe_mul(fl.fe_add(fa, fa), fa)
+    return fl.fe_sub(fa, fl.fe_one((1,)))
+
+
+def test_mul_stays_loose_after_chains(rng):
+    # Long op chains must not overflow int32: deep chain, compare, check bounds.
+    vals = rand_ints(rng, 8)
+    fa = to_fe(vals)
+    ref = [v % P for v in vals]
+    for _ in range(20):
+        fa = _chain_step(fa)
+        ref = [(2 * r * r - 1) % P for r in ref]
+    assert from_fe(fa) == ref
+    arr = np.asarray(fa)
+    assert arr.min() >= 0 and arr.max() < 1 << 15
+
+
+def test_invert_pow2523(rng):
+    vals = [v for v in rand_ints(rng, 10) if v % P != 0]
+    fa = to_fe(vals)
+    assert from_fe(j_invert(fa)) == [pow(v, P - 2, P) for v in vals]
+    assert from_fe(j_pow2523(fa)) == [pow(v, (P - 5) // 8, P) for v in vals]
+
+
+def test_freeze_eq_parity(rng):
+    vals = rand_ints(rng, 16)
+    fa = to_fe(vals)
+    frozen = np.asarray(j_freeze(fa))
+    assert frozen.max() <= fl.MASK
+    assert from_fe(jnp.asarray(frozen)) == [v % P for v in vals]
+    assert list(np.asarray(j_parity(fa))) == [(v % P) & 1 for v in vals]
+    # eq across the p boundary: v and v + p are the same element
+    small = [1, 5, 19]
+    shifted = to_fe([v + P for v in small])
+    assert np.asarray(j_eq(to_fe(small), shifted)).all()
+
+
+def test_bytes_roundtrip(rng):
+    vals = rand_ints(rng, 16)
+    raw = np.stack(
+        [np.frombuffer(int.to_bytes(v, 32, "little"), dtype=np.uint8) for v in vals],
+        axis=-1,
+    ).astype(np.int32)
+    fe = j_frombytes_raw(jnp.asarray(raw))
+    assert from_fe(fe) == [v % P for v in vals]
+    # tobytes emits the canonical little-endian encoding
+    out = np.asarray(j_tobytes(fe))
+    expect = np.stack(
+        [
+            np.frombuffer(int.to_bytes(v % P, 32, "little"), dtype=np.uint8)
+            for v in vals
+        ],
+        axis=-1,
+    )
+    assert (out == expect).all()
+    # msb masking drops bit 255
+    fe2 = j_frombytes(jnp.asarray(raw))
+    assert from_fe(fe2) == [(v & ((1 << 255) - 1)) % P for v in vals]
