@@ -1,0 +1,146 @@
+"""Batched scalar arithmetic mod L = 2^252 + 27742...493 (the ed25519 group
+order) in JAX int32 limbs.
+
+Needed by verify: (a) validate s < L (the malleability rule the validator
+enforces, fd_curve25519_scalar_validate), (b) reduce the 512-bit SHA-512
+output k mod L (fd_curve25519_scalar_reduce).  Radix 2^12 is used here —
+252 = 21*12 exactly, so the fold boundary at 2^252 is limb-aligned:
+    2^252 == -C (mod L),  C = L - 2^252  (125 bits, 11 limbs).
+Folds run in *signed* int32 limbs (carries use arithmetic shifts), then the
+result is shifted positive by +L and conditionally reduced.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+RADIX = 12
+MASK = (1 << RADIX) - 1
+NLIMB = 22  # holds 264 bits: any 32-byte value
+L = 2**252 + 27742317777372353535851937790883648493
+C = L - 2**252  # 125 bits
+
+
+def _to_limbs(x: int, n: int) -> np.ndarray:
+    out = np.zeros(n, dtype=np.int32)
+    for i in range(n):
+        out[i] = x & MASK
+        x >>= RADIX
+    assert x == 0
+    return out
+
+
+_L_LIMBS = _to_limbs(L, NLIMB)
+_C_LIMBS = _to_limbs(C, 11)
+
+
+def limbs_to_int(limbs) -> int:
+    return sum(int(v) << (RADIX * i) for i, v in enumerate(np.asarray(limbs)))
+
+
+def sc_frombytes(b: jnp.ndarray) -> jnp.ndarray:
+    """(32, B) little-endian bytes -> (22, B) int32 limbs (raw, unreduced)."""
+    b = b.astype(jnp.int32)
+    rows = []
+    for i in range(NLIMB):
+        bit_lo = RADIX * i
+        byte0, sh = bit_lo >> 3, bit_lo & 7
+        v = b[byte0] >> sh
+        if byte0 + 1 < 32:
+            v = v | (b[byte0 + 1] << (8 - sh))
+        if sh > 4 and byte0 + 2 < 32:  # 16 - sh < 12: need a third byte
+            v = v | (b[byte0 + 2] << (16 - sh))
+        rows.append(v & MASK)
+    return jnp.stack(rows)
+
+
+def sc_validate(b: jnp.ndarray) -> jnp.ndarray:
+    """(32, B) bytes -> (B,) bool: value < L (rejects malleable s)."""
+    s = sc_frombytes(b)
+    l_l = jnp.asarray(_L_LIMBS).reshape((NLIMB,) + (1,) * (s.ndim - 1))
+    t = s - l_l
+    borrow = jnp.zeros_like(t[0])
+    for k in range(NLIMB):
+        v = t[k] - borrow
+        borrow = (v < 0).astype(jnp.int32)
+    return borrow == 1  # s - L borrowed out => s < L
+
+
+def _carry_seq(x: jnp.ndarray) -> jnp.ndarray:
+    """Sequential signed carry chain: exact for mixed-sign limbs (borrows
+    propagate fully, unlike parallel passes).  Top limb keeps any sign."""
+    n = x.shape[0]
+    for k in range(n - 1):
+        hi = x[k] >> RADIX  # arithmetic shift: floor division
+        x = x.at[k].set(x[k] & MASK)
+        x = x.at[k + 1].add(hi)
+    return x
+
+
+def sc_reduce512(b: jnp.ndarray) -> jnp.ndarray:
+    """(64, B) little-endian bytes (SHA-512 output) -> (22, B) limbs in [0, L).
+
+    Iterated fold x = lo + hi*2^252 == lo - hi*C (mod L); four folds bring
+    512 bits down to ~252, then +L and two conditional subtracts normalise.
+    """
+    b = b.astype(jnp.int32)
+    n64 = 44  # 528 bits >= 512
+    rows = []
+    for i in range(n64):
+        bit_lo = RADIX * i
+        byte0, sh = bit_lo >> 3, bit_lo & 7
+        if byte0 >= 64:
+            rows.append(jnp.zeros_like(b[0]))
+            continue
+        v = b[byte0] >> sh
+        if byte0 + 1 < 64:
+            v = v | (b[byte0 + 1] << (8 - sh))
+        if sh > 4 and byte0 + 2 < 64:
+            v = v | (b[byte0 + 2] << (16 - sh))
+        rows.append(v & MASK)
+    x = jnp.stack(rows)  # (44, B), limbs in [0, 2^12)
+
+    c_l = [int(v) for v in _C_LIMBS]
+    pad_cfg = [(0, 0)] * (x.ndim - 1)
+    l_pad = np.zeros(n64, dtype=np.int32)
+    l_pad[:NLIMB] = _L_LIMBS
+    for it in range(4):
+        lo = x[:21]
+        hi = x[21:]  # signed limbs; exact value of x >> 252
+        # conv: hi (23 limbs) * C (11 limbs) -> 33 limbs; |terms| < 11*2^28
+        acc = None
+        for j, cj in enumerate(c_l):
+            if cj == 0:
+                continue
+            t = jnp.pad(cj * hi, [(j, len(c_l) - 1 - j)] + pad_cfg)
+            acc = t if acc is None else acc + t
+        prod = jnp.pad(acc, [(0, n64 - (hi.shape[0] + len(c_l) - 1))] + pad_cfg)
+        x = jnp.pad(lo, [(0, n64 - 21)] + pad_cfg) - prod
+        if it == 3:
+            # Final fold: value is in (-2^131, 2^252); shift by +L before the
+            # carry chain so the result is positive and fits 22 limbs.
+            x = x + jnp.asarray(l_pad).reshape((n64,) + (1,) * (x.ndim - 1))
+        x = _carry_seq(x)
+
+    x = x[:NLIMB]
+    l_l = jnp.asarray(_L_LIMBS).reshape((NLIMB,) + (1,) * (x.ndim - 1))
+    # Now 0 <= x < 3L: two conditional subtracts.
+    for _ in range(2):
+        t = x - l_l
+        borrow = jnp.zeros_like(t[0])
+        outs = []
+        for k in range(NLIMB):
+            v = t[k] - borrow
+            borrow = (v < 0).astype(jnp.int32)
+            outs.append(v + (borrow << RADIX))
+        t = jnp.stack(outs)
+        x = jnp.where((borrow == 0)[None], t, x)
+    return x
+
+
+def sc_bits(s: jnp.ndarray, nbits: int = 253) -> jnp.ndarray:
+    """(22, B) limbs -> (nbits, B) int32 bits, little-endian."""
+    rows = [(s[i // RADIX] >> (i % RADIX)) & 1 for i in range(nbits)]
+    return jnp.stack(rows)

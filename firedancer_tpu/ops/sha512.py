@@ -1,0 +1,216 @@
+"""Batched SHA-512 in JAX for TPU.
+
+TPU has no native u64, so words are (hi, lo) uint32 pairs — the same 2x32
+decomposition the reference's AVX2 assembly path uses on pre-AVX512 x86
+(/root/reference/src/ballet/sha512/fd_sha512_core_avx2.S); here the vector
+lane dimension is the batch instead of the block.
+
+Variable message lengths in one batch are handled by processing the maximum
+number of blocks for every element and *capturing* each element's digest at
+its own final block — so one jit-compiled program serves any mix of message
+sizes up to the static maximum (SURVEY.md §7.3: static shapes, masking).
+
+Layout: byte/word rows lead, batch trails: messages are (nbytes, B) int32
+rows; digests are (64, B) int32 rows (values 0..255).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_K = [
+    0x428A2F98D728AE22, 0x7137449123EF65CD, 0xB5C0FBCFEC4D3B2F, 0xE9B5DBA58189DBBC,
+    0x3956C25BF348B538, 0x59F111F1B605D019, 0x923F82A4AF194F9B, 0xAB1C5ED5DA6D8118,
+    0xD807AA98A3030242, 0x12835B0145706FBE, 0x243185BE4EE4B28C, 0x550C7DC3D5FFB4E2,
+    0x72BE5D74F27B896F, 0x80DEB1FE3B1696B1, 0x9BDC06A725C71235, 0xC19BF174CF692694,
+    0xE49B69C19EF14AD2, 0xEFBE4786384F25E3, 0x0FC19DC68B8CD5B5, 0x240CA1CC77AC9C65,
+    0x2DE92C6F592B0275, 0x4A7484AA6EA6E483, 0x5CB0A9DCBD41FBD4, 0x76F988DA831153B5,
+    0x983E5152EE66DFAB, 0xA831C66D2DB43210, 0xB00327C898FB213F, 0xBF597FC7BEEF0EE4,
+    0xC6E00BF33DA88FC2, 0xD5A79147930AA725, 0x06CA6351E003826F, 0x142929670A0E6E70,
+    0x27B70A8546D22FFC, 0x2E1B21385C26C926, 0x4D2C6DFC5AC42AED, 0x53380D139D95B3DF,
+    0x650A73548BAF63DE, 0x766A0ABB3C77B2A8, 0x81C2C92E47EDAEE6, 0x92722C851482353B,
+    0xA2BFE8A14CF10364, 0xA81A664BBC423001, 0xC24B8B70D0F89791, 0xC76C51A30654BE30,
+    0xD192E819D6EF5218, 0xD69906245565A910, 0xF40E35855771202A, 0x106AA07032BBD1B8,
+    0x19A4C116B8D2D0C8, 0x1E376C085141AB53, 0x2748774CDF8EEB99, 0x34B0BCB5E19B48A8,
+    0x391C0CB3C5C95A63, 0x4ED8AA4AE3418ACB, 0x5B9CCA4F7763E373, 0x682E6FF3D6B2B8A3,
+    0x748F82EE5DEFB2FC, 0x78A5636F43172F60, 0x84C87814A1F0AB72, 0x8CC702081A6439EC,
+    0x90BEFFFA23631E28, 0xA4506CEBDE82BDE9, 0xBEF9A3F7B2C67915, 0xC67178F2E372532B,
+    0xCA273ECEEA26619C, 0xD186B8C721C0C207, 0xEADA7DD6CDE0EB1E, 0xF57D4F7FEE6ED178,
+    0x06F067AA72176FBA, 0x0A637DC5A2C898A6, 0x113F9804BEF90DAE, 0x1B710B35131C471B,
+    0x28DB77F523047D84, 0x32CAAB7B40C72493, 0x3C9EBE0A15C9BEBC, 0x431D67C49C100D4C,
+    0x4CC5D4BECB3E42B6, 0x597F299CFC657E2A, 0x5FCB6FAB3AD6FAEC, 0x6C44198C4A475817,
+]
+_K_HI = np.asarray([k >> 32 for k in _K], dtype=np.uint32)
+_K_LO = np.asarray([k & 0xFFFFFFFF for k in _K], dtype=np.uint32)
+
+_IV = [
+    0x6A09E667F3BCC908, 0xBB67AE8584CAA73B, 0x3C6EF372FE94F82B, 0xA54FF53A5F1D36F1,
+    0x510E527FADE682D1, 0x9B05688C2B3E6C1F, 0x1F83D9ABFB41BD6B, 0x5BE0CD19137E2179,
+]
+
+
+def _add2(ah, al, bh, bl):
+    lo = al + bl
+    carry = (lo < al).astype(jnp.uint32)
+    return ah + bh + carry, lo
+
+
+def _rotr(h, l, n):
+    if n == 32:
+        return l, h
+    if n < 32:
+        return (h >> n) | (l << (32 - n)), (l >> n) | (h << (32 - n))
+    m = n - 32
+    return (l >> m) | (h << (32 - m)), (h >> m) | (l << (32 - m))
+
+
+def _shr(h, l, n):
+    if n < 32:
+        return h >> n, (l >> n) | (h << (32 - n))
+    return jnp.zeros_like(h), h >> (n - 32)
+
+
+def _xor3(a, b, c):
+    return (a[0] ^ b[0] ^ c[0], a[1] ^ b[1] ^ c[1])
+
+
+def _big_sigma0(h, l):
+    return _xor3(_rotr(h, l, 28), _rotr(h, l, 34), _rotr(h, l, 39))
+
+
+def _big_sigma1(h, l):
+    return _xor3(_rotr(h, l, 14), _rotr(h, l, 18), _rotr(h, l, 41))
+
+
+def _small_sigma0(h, l):
+    return _xor3(_rotr(h, l, 1), _rotr(h, l, 8), _shr(h, l, 7))
+
+
+def _small_sigma1(h, l):
+    return _xor3(_rotr(h, l, 19), _rotr(h, l, 61), _shr(h, l, 6))
+
+
+def _compress_block(state, whi, wlo):
+    """One SHA-512 compression: state (8,2) rows of (B,), W as (80, B) pairs."""
+    khi = jnp.asarray(_K_HI)
+    klo = jnp.asarray(_K_LO)
+
+    def round_body(t, s):
+        a, b, c, d, e, f, g, h = [(s[i], s[i + 8]) for i in range(8)]
+        wh = jax.lax.dynamic_index_in_dim(whi, t, keepdims=False)
+        wl = jax.lax.dynamic_index_in_dim(wlo, t, keepdims=False)
+        kh = jax.lax.dynamic_index_in_dim(khi, t, keepdims=False)
+        kl = jax.lax.dynamic_index_in_dim(klo, t, keepdims=False)
+        ch = (
+            (e[0] & f[0]) ^ (~e[0] & g[0]),
+            (e[1] & f[1]) ^ (~e[1] & g[1]),
+        )
+        maj = (
+            (a[0] & b[0]) ^ (a[0] & c[0]) ^ (b[0] & c[0]),
+            (a[1] & b[1]) ^ (a[1] & c[1]) ^ (b[1] & c[1]),
+        )
+        t1 = _add2(*_add2(*_add2(*_add2(*h, *_big_sigma1(*e)), *ch), kh, kl), wh, wl)
+        t2 = _add2(*_big_sigma0(*a), *maj)
+        e2 = _add2(*d, *t1)
+        a2 = _add2(*t1, *t2)
+        ns = (a2, a, b, c, e2, e, f, g)
+        return jnp.stack([p[0] for p in ns] + [p[1] for p in ns])
+
+    s0 = jnp.stack([p[0] for p in state] + [p[1] for p in state])
+    s = jax.lax.fori_loop(0, 80, round_body, s0)
+    out = []
+    for i in range(8):
+        out.append(_add2(state[i][0], state[i][1], s[i], s[i + 8]))
+    return tuple(out)
+
+
+def _schedule(block_hi, block_lo):
+    """Extend 16 message words to 80: (16, B) -> (80, B) hi/lo."""
+    nfill = 80 - 16
+    pad = [(0, nfill)] + [(0, 0)] * (block_hi.ndim - 1)
+    whi = jnp.pad(block_hi, pad)
+    wlo = jnp.pad(block_lo, pad)
+
+    def body(t, w):
+        whi, wlo = w
+        g = lambda arr, off: jax.lax.dynamic_index_in_dim(arr, t - off, keepdims=False)
+        s1 = _small_sigma1(g(whi, 2), g(wlo, 2))
+        s0 = _small_sigma0(g(whi, 15), g(wlo, 15))
+        v = _add2(*_add2(*_add2(*s1, g(whi, 7), g(wlo, 7)), *s0), g(whi, 16), g(wlo, 16))
+        whi = jax.lax.dynamic_update_index_in_dim(whi, v[0], t, 0)
+        wlo = jax.lax.dynamic_update_index_in_dim(wlo, v[1], t, 0)
+        return whi, wlo
+
+    return jax.lax.fori_loop(16, 80, body, (whi, wlo))
+
+
+def sha512_pad(msg: jnp.ndarray, msg_len: jnp.ndarray, max_len: int):
+    """Build padded message blocks in-graph for per-element lengths.
+
+    msg: (max_len, B) int32 byte rows; msg_len: (B,) actual lengths.
+    Returns (blocks_hi, blocks_lo): (NB, 16, B) uint32 word arrays, and
+    final_block: (B,) int32 index of each element's last block.
+    """
+    nb = (max_len + 17 + 127) // 128
+    total = nb * 128
+    b = msg.astype(jnp.int32)
+    pad_cfg = [(0, total - max_len)] + [(0, 0)] * (msg.ndim - 1)
+    buf = jnp.pad(b, pad_cfg)
+    pos = jnp.arange(total, dtype=jnp.int32).reshape((total,) + (1,) * (msg.ndim - 1))
+    keep = pos < msg_len[None]
+    buf = jnp.where(keep, buf, 0)
+    buf = buf + jnp.where(pos == msg_len[None], 0x80, 0)
+    # 128-bit big-endian length sits in the last 16 bytes of the final block;
+    # message bit-lengths here are < 2^32 so 4 bytes suffice.
+    final_block = (msg_len + 17 + 127) // 128 - 1
+    bitlen = msg_len * 8
+    base = final_block * 128
+    for j, sh in ((124, 24), (125, 16), (126, 8), (127, 0)):
+        buf = buf + jnp.where(pos == base[None] + j, (bitlen[None] >> sh) & 0xFF, 0)
+    # bytes -> big-endian u64 as u32 pairs
+    words = buf.reshape((nb * 32, 4) + buf.shape[1:]).astype(jnp.uint32)
+    w32 = (words[:, 0] << 24) | (words[:, 1] << 16) | (words[:, 2] << 8) | words[:, 3]
+    w32 = w32.reshape((nb, 16, 2) + buf.shape[1:])
+    return w32[:, :, 0], w32[:, :, 1], final_block
+
+
+def sha512_msg(msg: jnp.ndarray, msg_len: jnp.ndarray, max_len: int) -> jnp.ndarray:
+    """Batched SHA-512 of variable-length messages.
+
+    msg: (max_len, B) int32 byte rows (garbage beyond each msg_len is
+    ignored); msg_len: (B,).  Returns (64, B) digest byte rows.
+    """
+    blocks_hi, blocks_lo, final_block = sha512_pad(msg, msg_len, max_len)
+    nb = blocks_hi.shape[0]
+    batch = msg.shape[1:]
+    state = tuple(
+        (
+            jnp.full(batch, iv >> 32, dtype=jnp.uint32),
+            jnp.full(batch, iv & 0xFFFFFFFF, dtype=jnp.uint32),
+        )
+        for iv in _IV
+    )
+    result = jnp.zeros((16,) + batch, dtype=jnp.uint32)
+
+    def body(bi, carry):
+        state, result = carry
+        bh = jax.lax.dynamic_index_in_dim(blocks_hi, bi, keepdims=False)
+        bl = jax.lax.dynamic_index_in_dim(blocks_lo, bi, keepdims=False)
+        whi, wlo = _schedule(bh, bl)
+        state = _compress_block(state, whi, wlo)
+        flat = jnp.stack([s[0] for s in state] + [s[1] for s in state])
+        result = jnp.where(bi == final_block[None], flat, result)
+        return state, result
+
+    _, result = jax.lax.fori_loop(0, nb, body, (state, result))
+    # result rows: 8 hi then 8 lo; emit big-endian bytes per u64
+    out = []
+    for i in range(8):
+        hi, lo = result[i].astype(jnp.int32), result[i + 8].astype(jnp.int32)
+        for sh in (24, 16, 8, 0):
+            out.append((hi >> sh) & 0xFF)
+        for sh in (24, 16, 8, 0):
+            out.append((lo >> sh) & 0xFF)
+    return jnp.stack(out)

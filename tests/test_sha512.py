@@ -1,0 +1,37 @@
+"""Batched SHA-512 vs hashlib, mixed lengths in one batch."""
+
+import hashlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from firedancer_tpu.ops import sha512 as fsha
+
+
+def test_sha512_mixed_lengths(rng):
+    max_len = 300
+    lengths = [0, 1, 111, 112, 127, 128, 129, 239, 240, 255, 256, 300] + list(
+        rng.integers(0, max_len + 1, size=4)
+    )
+    msgs = [rng.bytes(int(n)) for n in lengths]
+    buf = np.zeros((max_len, len(msgs)), dtype=np.int32)
+    for i, m in enumerate(msgs):
+        buf[: len(m), i] = np.frombuffer(m, dtype=np.uint8)
+    out = np.asarray(
+        jax.jit(lambda b, n: fsha.sha512_msg(b, n, max_len))(
+            jnp.asarray(buf), jnp.asarray([len(m) for m in msgs], dtype=jnp.int32)
+        )
+    )
+    for i, m in enumerate(msgs):
+        expect = np.frombuffer(hashlib.sha512(m).digest(), dtype=np.uint8)
+        assert (out[:, i] == expect).all(), f"len={len(m)}"
+
+
+def test_sha512_empty_vector():
+    out = np.asarray(
+        jax.jit(lambda b, n: fsha.sha512_msg(b, n, 8))(
+            jnp.zeros((8, 1), dtype=jnp.int32), jnp.zeros(1, dtype=jnp.int32)
+        )
+    )
+    assert bytes(out[:, 0].astype(np.uint8)) == hashlib.sha512(b"").digest()

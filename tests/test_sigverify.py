@@ -1,0 +1,122 @@
+"""End-to-end sigverify kernel tests: honest signatures, corruptions, and the
+validator's strictness edge cases, differential vs the python ground truth."""
+
+import hashlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from firedancer_tpu.ops import sigverify as sv
+from firedancer_tpu.ops.ref import ed25519_ref as ref
+
+MAX_MSG = 128
+
+
+def run_batch(cases):
+    """cases: list of (msg, sig, pubkey) byte strings -> np bool array."""
+    b = len(cases)
+    msg = np.zeros((MAX_MSG, b), dtype=np.int32)
+    ln = np.zeros(b, dtype=np.int32)
+    sig = np.zeros((64, b), dtype=np.int32)
+    pk = np.zeros((32, b), dtype=np.int32)
+    for i, (m, s, p) in enumerate(cases):
+        msg[: len(m), i] = np.frombuffer(m, dtype=np.uint8)
+        ln[i] = len(m)
+        sig[:, i] = np.frombuffer(s, dtype=np.uint8)
+        pk[:, i] = np.frombuffer(p, dtype=np.uint8)
+    out = sv.ed25519_verify_batch(
+        jnp.asarray(msg), jnp.asarray(ln), jnp.asarray(sig), jnp.asarray(pk),
+        max_msg_len=MAX_MSG,
+    )
+    return np.asarray(out)
+
+
+def keypair(tag: bytes):
+    secret = hashlib.sha256(tag).digest()
+    return secret, ref.public_key(secret)
+
+
+def test_honest_and_corrupted(rng):
+    cases, expect = [], []
+    for i in range(8):
+        secret, pub = keypair(b"k%d" % i)
+        m = rng.bytes(int(rng.integers(0, MAX_MSG + 1)))
+        s = ref.sign(secret, m)
+        cases.append((m, s, pub))
+        expect.append(True)
+    # corrupted message
+    secret, pub = keypair(b"corrupt")
+    m = b"payload"
+    s = ref.sign(secret, m)
+    cases.append((b"payloae", s, pub))
+    expect.append(False)
+    # corrupted sig R
+    bad = bytearray(s)
+    bad[2] ^= 4
+    cases.append((m, bytes(bad), pub))
+    expect.append(False)
+    # corrupted sig S
+    bad = bytearray(s)
+    bad[40] ^= 4
+    cases.append((m, bytes(bad), pub))
+    expect.append(False)
+    # wrong key
+    _, pub2 = keypair(b"other")
+    cases.append((m, s, pub2))
+    expect.append(False)
+    got = run_batch(cases)
+    assert list(got) == expect
+    # cross-check every case against the python ground truth
+    assert [ref.verify(m, s, p) for (m, s, p) in cases] == expect
+
+
+def test_malleability_high_s():
+    secret, pub = keypair(b"mall")
+    m = b"tx"
+    s = ref.sign(secret, m)
+    sval = int.from_bytes(s[32:], "little")
+    forged = s[:32] + int.to_bytes(sval + ref.L, 32, "little")
+    got = run_batch([(m, s, pub), (m, forged, pub)])
+    assert list(got) == [True, False]
+
+
+def test_small_order_and_invalid_points():
+    secret, pub = keypair(b"so")
+    m = b"msg"
+    s = ref.sign(secret, m)
+    ident = int.to_bytes(1, 32, "little")  # identity: small order
+    two_tor = int.to_bytes(ref.P - 1, 32, "little")  # y=-1: order 2
+    # non-point: y with non-square x^2
+    bad_y = None
+    v = 2
+    while bad_y is None:
+        enc = int.to_bytes(v, 32, "little")
+        if ref.point_decompress(enc) is None:
+            bad_y = enc
+        v += 1
+    cases = [
+        (m, s, pub),          # honest
+        (m, s, ident),        # small-order pubkey
+        (m, s, two_tor),      # small-order pubkey (order 2)
+        (m, ident + s[32:], pub),   # small-order R
+        (m, s, bad_y),        # pubkey not on curve
+        (m, bad_y + s[32:], pub),   # R not on curve
+    ]
+    got = run_batch(cases)
+    assert list(got) == [True, False, False, False, False, False]
+    assert [ref.verify(mm, ss, pp) for (mm, ss, pp) in cases] == list(got)
+
+
+def test_non_canonical_pubkey_accepted():
+    """Parity with dalek 2.x / the reference: y >= p encodings are NOT
+    rejected per se — the point is reduced mod p and verification proceeds."""
+    secret, pub = keypair(b"noncanon")
+    y = int.from_bytes(pub, "little") & ((1 << 255) - 1)
+    sign_bit = int.from_bytes(pub, "little") >> 255
+    if y + ref.P < (1 << 255) and not sign_bit:
+        noncanon = int.to_bytes(y + ref.P, 32, "little")
+        m = b"m"
+        s = ref.sign(secret, m)
+        got = run_batch([(m, s, noncanon)])
+        assert list(got) == [ref.verify(m, s, noncanon)]
