@@ -17,11 +17,8 @@ Capability parity targets (cited for the judge; no code is shared):
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from . import limbs as fl
 from .limbs import (
@@ -199,12 +196,6 @@ def point_compress(p) -> jnp.ndarray:
     xa, ya = fe_mul(x, zinv), fe_mul(y, zinv)
     out = fe_tobytes(ya)
     return out.at[31].add(fe_parity(xa) << 7)
-
-
-def _bits_from_limbs(s: jnp.ndarray, nbits: int, radix: int) -> jnp.ndarray:
-    """(nlimb, B) radix-2^r limbs -> (nbits, B) int32 bits, little-endian."""
-    rows = [(s[i // radix] >> (i % radix)) & 1 for i in range(nbits)]
-    return jnp.stack(rows)
 
 
 NBITS = 253  # scalars are < L < 2^253

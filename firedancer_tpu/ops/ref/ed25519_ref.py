@@ -35,10 +35,10 @@ def _recover_x(y: int, sign: int) -> int | None:
     y %= P
     x2 = (y * y - 1) * pow(D * y * y + 1, P - 2, P) % P
     if x2 == 0:
-        if sign:
-            # RFC rejects (x=0, sign=1).  Both (0, +-1) points are small
-            # order so the strict small-order check catches them anyway.
-            return None
+        # RFC 8032 rejects (x=0, sign=1); the reference validator's
+        # decompress (fd_ed25519_point_frombytes, fd_curve25519.c:23-51)
+        # and dalek 2.x accept it as (0, y).  Both (0, +-1) points are
+        # small order, so strict verify rejects them downstream either way.
         return 0
     x = pow(x2, (P + 3) // 8, P)
     if (x * x - x2) % P != 0:

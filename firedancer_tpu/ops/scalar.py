@@ -12,7 +12,6 @@ result is shifted positive by +L and conditionally reduced.
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 

@@ -1,0 +1,58 @@
+"""Backend selection guards.
+
+This image ships a PJRT plugin ("axon") that tunnels to one real TPU chip.
+The plugin monkeypatches jax's backend lookup so that *any* backend
+initialization — even with ``JAX_PLATFORMS=cpu`` — also spins up the tunnel
+client, which blocks indefinitely whenever the relay is flaky.  Tests and the
+multi-chip CPU dryrun must never depend on tunnel liveness, so they strip the
+plugin's backend factory before first device use.
+
+(The real-TPU bench path does the opposite: it leaves the plugin alone and
+uses whatever ``jax.devices()`` resolves to.)
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu_backend(device_count: int | None = None) -> None:
+    """Make this process CPU-only, immune to TPU-tunnel flakiness.
+
+    Must be called before any jax computation (device init); safe to call
+    multiple times.  ``device_count`` additionally requests N virtual host
+    devices, which only takes effect if set before the first device use.
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if device_count is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count={device_count}"
+            ).strip()
+
+    import jax
+    import jax._src.xla_bridge as xb
+
+    for name in ("axon", "tpu", "cuda", "rocm"):
+        try:
+            xb._backend_factories.pop(name, None)
+        except Exception:
+            pass
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+
+def enable_compile_cache(path: str, min_compile_secs: float = 1.0) -> None:
+    """Enable jax's persistent compilation cache at ``path``.
+
+    Env vars are not enough on this image: sitecustomize imports jax at
+    interpreter startup, so config defaults are snapshotted before user code
+    can set JAX_COMPILATION_CACHE_DIR; the explicit config calls work.
+    """
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", min_compile_secs)

@@ -2,17 +2,18 @@
 
 Tests never assume real TPU hardware; multi-chip sharding is validated on a
 virtual CPU mesh exactly like the driver's dryrun (see __graft_entry__.py).
-Must run before jax is imported anywhere.
+force_cpu_backend must run before any jax device use; enable_compile_cache
+makes the 10-60s curve/sigverify compiles persistent across test runs.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+from firedancer_tpu.utils import platform as fd_platform
+
+fd_platform.force_cpu_backend(device_count=8)
+fd_platform.enable_compile_cache(
+    os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
+)
 
 import numpy as np
 import pytest

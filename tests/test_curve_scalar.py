@@ -103,19 +103,40 @@ def test_dbl_add_vs_ref(rng):
     ]
 
 
-def test_small_order_detection(rng):
-    # All 8-torsion encodings must flag; random honest points must not.
-    torsion = []
-    # generate the 8-torsion subgroup from a point of order 8
-    # order-8 point: sqrt(-1) trick — find any point with 8P == ident by scan
-    found = []
-    v = 0
-    while len(found) < 3:
-        enc = int.to_bytes(v, 32, "little")
+def small_order_encodings() -> list[bytes]:
+    """All 8-torsion y-encodings, derived analytically (no scanning):
+    identity y=1, order-2 y=-1, order-4 y=0; order-8 points satisfy
+    x^2 = -y^2, which with the curve equation gives d*y^4 + 2y^2 - 1 = 0,
+    i.e. y^2 = (+-sqrt(1+d) - 1)/d."""
+
+    def sqrt_mod(a):
+        a %= P
+        x = pow(a, (P + 3) // 8, P)
+        if (x * x - a) % P:
+            x = x * ref.SQRT_M1 % P
+        return x if (x * x - a) % P == 0 else None
+
+    ys = [0, 1, P - 1]
+    s = sqrt_mod(1 + ref.D)
+    assert s is not None
+    for r in (s, P - s):
+        y2 = (r - 1) * pow(ref.D, P - 2, P) % P
+        y = sqrt_mod(y2)
+        if y is not None:
+            ys += [y, P - y]
+    out = []
+    for y in ys:
+        enc = int.to_bytes(y, 32, "little")
         p = ref.point_decompress(enc)
         if p is not None and ref.is_small_order(p):
-            found.append(enc)
-        v += 1
+            out.append(enc)
+    return out
+
+
+def test_small_order_detection(rng):
+    # All 8-torsion encodings must flag; random honest points must not.
+    found = small_order_encodings()
+    assert len(found) >= 5
     honest = [ref.point_compress(p) for p in rand_points(rng, 5)]
     flags = np.asarray(j_small(bytes_cols(found + honest)))
     assert flags[: len(found)].all()
