@@ -1,0 +1,97 @@
+"""Headline benchmark: batched ed25519 sigverify throughput on one chip.
+
+Mirrors the reference's verify-tile measurement configs (BASELINE.md):
+1-signature transfer-sized messages, fixed batch, steady-state pipelined
+dispatch.  Baseline for the vs_baseline ratio is the reference's own
+accelerator backend: the wiredancer FPGA at 1.0 M verify/s
+(/root/reference/src/wiredancer/README.md:100-103,118-122).
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+BASELINE_VERIFY_PER_S = 1.0e6  # wiredancer FPGA, the reference's offload path
+BATCH = 4096
+MAX_MSG_LEN = 128
+STEADY_ROUNDS = 8
+INFLIGHT = 4
+
+
+def main() -> None:
+    if "--cpu" in sys.argv:
+        # Smoke-test mode: logic check without the TPU tunnel.
+        from firedancer_tpu.utils.platform import force_cpu_backend
+
+        force_cpu_backend()
+    import jax
+    import jax.numpy as jnp
+
+    from firedancer_tpu.ops import sigverify as sv
+    import __graft_entry__ as ge
+
+    dev = jax.devices()[0]
+    print(f"# bench: device={dev.platform}:{dev.device_kind}", file=sys.stderr)
+
+    msg, msg_len, sig, pk = ge._example_batch(BATCH)
+    args = tuple(
+        jax.device_put(jnp.asarray(a), dev) for a in (msg, msg_len, sig, pk)
+    )
+
+    def step(a):
+        return sv.ed25519_verify_batch(*a, max_msg_len=MAX_MSG_LEN)
+
+    # Warmup / compile.
+    t0 = time.time()
+    ok = step(args)
+    ok.block_until_ready()
+    n_ok = int(np.asarray(ok).sum())
+    print(
+        f"# compile+first batch {time.time()-t0:.1f}s, {n_ok}/{BATCH} ok",
+        file=sys.stderr,
+    )
+    assert n_ok == BATCH, "honest signatures must all verify"
+
+    # Steady state: keep INFLIGHT batches in flight, block only at the end —
+    # the async-offload shape the wiredancer path uses (requests pushed, the
+    # results ring drained later).
+    lat = []
+    outs = []
+    t0 = time.time()
+    for r in range(STEADY_ROUNDS):
+        t1 = time.time()
+        outs.append(step(args))
+        if len(outs) >= INFLIGHT:
+            outs.pop(0).block_until_ready()
+        lat.append(time.time() - t1)
+    for o in outs:
+        o.block_until_ready()
+    elapsed = time.time() - t0
+    total = BATCH * STEADY_ROUNDS
+    rate = total / elapsed
+    print(
+        f"# steady: {total} sigs in {elapsed:.3f}s, "
+        f"mean dispatch {np.mean(lat)*1e3:.2f}ms",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "ed25519_sigverify_per_s_per_chip",
+                "value": round(rate, 1),
+                "unit": "verify/s",
+                "vs_baseline": round(rate / BASELINE_VERIFY_PER_S, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
