@@ -1,0 +1,2 @@
+"""Host-side Solana protocol wire formats (the reference's ballet layer's
+parsers, re-implemented clean-room for the TPU framework's host stages)."""

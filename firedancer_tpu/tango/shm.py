@@ -1,0 +1,172 @@
+"""Shared-memory links: mcache + dcache + fseqs + cnc in one mappable block.
+
+The process-topology equivalent of the reference's workspace-backed links
+(fd_topo_link_t, src/disco/topo/fd_topo.h): a producer stage and N consumer
+stages in different processes map the same block by name and speak the
+tango protocol from rings.py over it.
+
+Layout (8-byte aligned):
+  [0, hdr)        header: depth, mtu, n_fseq
+  [hdr, a)        mcache table   (depth * 7 u64)
+  [a, b)          dcache data    (DCache.footprint bytes)
+  [b, c)          fseq cells     (n_fseq u64)
+  [c, end)        cnc cells
+"""
+
+from __future__ import annotations
+
+from multiprocessing import shared_memory
+
+import numpy as np
+
+from . import rings
+
+_HDR = 4 * 8  # depth, mtu, n_fseq, pad
+
+
+def _layout(depth: int, mtu: int, n_fseq: int):
+    a = _HDR
+    b = a + rings.MCache.footprint(depth)
+    c = b + rings.DCache.footprint(mtu, depth)
+    d = c + n_fseq * 8
+    e = d + rings.Cnc.footprint()
+    return a, b, c, d, e
+
+
+class ShmLink:
+    """One producer->consumers link over a named shared-memory block."""
+
+    def __init__(self, shm, depth: int, mtu: int, n_fseq: int, owner: bool):
+        self._shm = shm
+        self.owner = owner
+        self.depth = depth
+        self.mtu = mtu
+        self.n_fseq = n_fseq
+        a, b, c, d, e = _layout(depth, mtu, n_fseq)
+        buf = shm.buf
+        self.mcache = rings.MCache.__new__(rings.MCache)
+        self.mcache.depth = depth
+        self.mcache.table = np.frombuffer(buf, dtype=rings.U64, offset=a, count=depth * rings.MCache.NCOL).reshape(depth, rings.MCache.NCOL)
+        if owner:
+            for line in range(depth):
+                self.mcache.table[line, rings.MCache.COL_SEQ] = (line - depth) & ((1 << 64) - 1)
+        self.dcache = rings.DCache(mtu, depth, buf=np.frombuffer(buf, dtype=np.uint8, offset=b, count=rings.DCache.footprint(mtu, depth)))
+        self.fseqs = [
+            rings.Fseq(np.frombuffer(buf, dtype=rings.U64, offset=c + 8 * i, count=1))
+            for i in range(n_fseq)
+        ]
+        self.cnc = rings.Cnc(np.frombuffer(buf, dtype=rings.U64, offset=d, count=2 + rings.Cnc.NDIAG))
+
+    @classmethod
+    def create(cls, name: str, depth: int, mtu: int, n_fseq: int = 1) -> "ShmLink":
+        size = _layout(depth, mtu, n_fseq)[-1]
+        shm = shared_memory.SharedMemory(name=name, create=True, size=size)
+        hdr = np.frombuffer(shm.buf, dtype=np.int64, count=4)
+        hdr[0], hdr[1], hdr[2] = depth, mtu, n_fseq
+        return cls(shm, depth, mtu, n_fseq, owner=True)
+
+    @classmethod
+    def join(cls, name: str) -> "ShmLink":
+        shm = shared_memory.SharedMemory(name=name)
+        hdr = np.frombuffer(shm.buf, dtype=np.int64, count=4)
+        return cls(shm, int(hdr[0]), int(hdr[1]), int(hdr[2]), owner=False)
+
+    def close(self) -> None:
+        # Views into shm.buf must be dropped before the mapping can close;
+        # Producer/Consumer objects may still hold some.  Best effort: drop
+        # ours, collect, and let the mapping live until process exit if
+        # foreign views remain (harmless — shm is reference counted).
+        self.mcache = self.dcache = self.fseqs = self.cnc = None
+        import gc
+
+        gc.collect()
+        try:
+            self._shm.close()
+        except BufferError:
+            pass
+
+    def unlink(self) -> None:
+        self._shm.unlink()
+
+
+class Producer:
+    """Single-producer publish side of a link, with credit flow control."""
+
+    def __init__(self, link: ShmLink, reliable_fseq_idx: list[int] | None = None):
+        self.link = link
+        self.seq = 0
+        idxs = reliable_fseq_idx if reliable_fseq_idx is not None else list(range(link.n_fseq))
+        self.fctl = rings.FlowControl(link.depth, [link.fseqs[i] for i in idxs])
+        self.cr_avail = 0
+
+    def refresh_credits(self) -> None:
+        self.cr_avail = self.fctl.credits(self.seq)
+
+    def try_publish(self, payload: bytes, sig: int = 0, tsorig: int = 0) -> bool:
+        """Publish if credits allow; False means backpressured."""
+        if self.cr_avail <= 0:
+            self.refresh_credits()
+            if self.cr_avail <= 0:
+                return False
+        chunk = self.link.dcache.alloc(len(payload))
+        self.link.dcache.write(chunk, payload)
+        self.link.mcache.publish(
+            self.seq, sig=sig, chunk=chunk, sz=len(payload), tsorig=tsorig
+        )
+        self.seq += 1
+        self.cr_avail -= 1
+        return True
+
+
+POLL_EMPTY = "empty"
+POLL_OVERRUN = "overrun"
+
+
+class Consumer:
+    """One consumer's receive side; publishes progress to its fseq."""
+
+    def __init__(self, link: ShmLink, fseq_idx: int = 0, lazy: int = 64):
+        self.link = link
+        self.seq = 0
+        self.fseq = link.fseqs[fseq_idx]
+        self.lazy = lazy
+        self._since_publish = 0
+        self.ovrn_cnt = 0
+
+    def poll(self):
+        """Next frag: (meta_row, payload bytes), POLL_EMPTY, or POLL_OVERRUN.
+
+        On overrun the consumer resynchronizes to the producer's frontier
+        (skip-ahead, fd_tango_base.h:37-42) and counts the loss.
+        """
+        status, meta = self.link.mcache.query(self.seq)
+        if status < 0:
+            return POLL_EMPTY
+        if status > 0:
+            line_seq = int(
+                self.link.mcache.table[
+                    self.link.mcache.line(self.seq), rings.MCache.COL_SEQ
+                ]
+            )
+            skipped = rings.seq_diff(line_seq, self.seq)
+            self.ovrn_cnt += max(skipped, 1)
+            self.seq = line_seq  # resync at the overwriting frag
+            return POLL_OVERRUN
+        sz = int(meta[rings.MCache.COL_SZ])
+        chunk = int(meta[rings.MCache.COL_CHUNK])
+        payload = self.link.dcache.read(chunk, sz)
+        # Speculative-copy re-check: if the producer lapped us mid-read the
+        # seq word changed and the bytes are torn -> treat as overrun.
+        status2, _ = self.link.mcache.query(self.seq)
+        if status2 != 0:
+            self.ovrn_cnt += 1
+            return POLL_OVERRUN
+        self.seq += 1
+        self._since_publish += 1
+        if self._since_publish >= self.lazy:
+            self.publish_progress()
+        return meta, payload
+
+    def publish_progress(self) -> None:
+        self.fseq.publish(self.seq)
+        self._since_publish = 0

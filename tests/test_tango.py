@@ -1,0 +1,167 @@
+"""Tango ring tests: seq math, publish/poll, overrun resync, flow control,
+tcache dedup, and a cross-process shm link (the test_ipc_* analog)."""
+
+import multiprocessing as mp
+import os
+
+import numpy as np
+import pytest
+
+from firedancer_tpu.tango import rings, shm
+
+
+def test_seq_diff_wraparound():
+    assert rings.seq_diff(5, 3) == 2
+    assert rings.seq_diff(3, 5) == -2
+    big = (1 << 64) - 1
+    assert rings.seq_diff(0, big) == 1
+    assert rings.seq_diff(big, 0) == -1
+
+
+def test_mcache_publish_query():
+    mc = rings.MCache(8)
+    s, _ = mc.query(0)
+    assert s == -1  # nothing published yet
+    mc.publish(0, sig=0xAB, chunk=3, sz=100)
+    s, meta = mc.query(0)
+    assert s == 0
+    assert int(meta[rings.MCache.COL_SIG]) == 0xAB
+    assert int(meta[rings.MCache.COL_SZ]) == 100
+    # consumer still at 0 after producer laps the ring -> overrun
+    for i in range(1, 9):
+        mc.publish(i)
+    s, _ = mc.query(0)
+    assert s == 1
+
+
+def test_dcache_compact_wrap():
+    dc = rings.DCache(mtu=100, depth=4)
+    seen = set()
+    for i in range(100):
+        c = dc.alloc(100)
+        dc.write(c, bytes([i % 256]) * 100)
+        assert dc.read(c, 100) == bytes([i % 256]) * 100
+        seen.add(c)
+    # compact allocation reuses a bounded set of chunk slots
+    assert len(seen) <= dc.wmark + 2
+
+
+def test_flow_control_credits():
+    f1, f2 = rings.Fseq(), rings.Fseq()
+    fc = rings.FlowControl(depth=8, fseqs=[f1, f2])
+    assert fc.credits(0) == 8
+    f1.publish(4)
+    f2.publish(2)
+    assert fc.credits(8) == 2  # slowest consumer at 2 -> lag 6
+    f2.publish(8)
+    assert fc.credits(8) == 4  # now f1 at 4 is slowest
+    f1.publish(8)
+    assert fc.credits(8) == 8
+
+
+def test_tcache_dedup_and_eviction():
+    tc = rings.TCache(depth=4)
+    assert not tc.insert(1)
+    assert tc.insert(1)  # duplicate
+    assert not tc.insert(2)
+    assert not tc.insert(3)
+    assert not tc.insert(4)
+    assert not tc.insert(5)  # evicts 1
+    assert not tc.insert(1)  # 1 was evicted -> fresh again
+    assert tc.query(5) and not tc.query(2)  # 2 evicted by the 1-reinsert
+    assert not tc.insert(0) and not tc.query(0)  # null tag never dedups
+
+
+def test_producer_consumer_in_process():
+    link = shm.ShmLink.create("fdtpu_test_pc_%d" % os.getpid(), depth=8, mtu=256)
+    try:
+        prod = shm.Producer(link)
+        cons = shm.Consumer(link, 0, lazy=1)
+        assert cons.poll() == shm.POLL_EMPTY
+        for i in range(6):
+            assert prod.try_publish(b"msg%d" % i, sig=i)
+        got = []
+        while (r := cons.poll()) != shm.POLL_EMPTY:
+            meta, payload = r
+            got.append(payload)
+        assert got == [b"msg%d" % i for i in range(6)]
+        # backpressure: consumer stalls at seq 6, producer can fill depth=8
+        n = 0
+        while prod.try_publish(b"x"):
+            n += 1
+        assert n == 8 - 0 - (6 - cons.seq)  # 8 credits beyond consumer seq
+    finally:
+        link.close()
+        link.unlink()
+
+
+def test_overrun_resync_unreliable_consumer():
+    link = shm.ShmLink.create("fdtpu_test_ov_%d" % os.getpid(), depth=4, mtu=64, n_fseq=0)
+    try:
+        prod = shm.Producer(link)  # no reliable consumers -> never backpressured
+        cons = shm.Consumer.__new__(shm.Consumer)
+        cons.link, cons.seq, cons.fseq, cons.lazy = link, 0, rings.Fseq(), 64
+        cons._since_publish, cons.ovrn_cnt = 0, 0
+        for i in range(10):  # laps the depth-4 ring
+            prod.refresh_credits()
+            assert prod.try_publish(b"p%d" % i)
+        r = cons.poll()
+        assert r == shm.POLL_OVERRUN
+        assert cons.ovrn_cnt > 0
+        assert cons.seq >= 6  # resynced near the frontier
+        resync_seq = cons.seq
+        meta, payload = cons.poll()
+        assert payload == b"p%d" % resync_seq  # consumed the resync frag
+    finally:
+        link.close()
+        link.unlink()
+
+
+def _consumer_proc(name: str, n: int, q):
+    link = shm.ShmLink.join(name)
+    cons = shm.Consumer(link, 0, lazy=4)
+    got = []
+    while len(got) < n:
+        r = cons.poll()
+        if r == shm.POLL_EMPTY:
+            continue
+        assert r != shm.POLL_OVERRUN
+        got.append(r[1])
+    cons.publish_progress()
+    q.put(got)
+    link.close()
+
+
+def test_cross_process_link():
+    name = "fdtpu_test_xp_%d" % os.getpid()
+    link = shm.ShmLink.create(name, depth=16, mtu=128)
+    try:
+        ctx = mp.get_context("spawn")
+        q = ctx.Queue()
+        n = 200
+        proc = ctx.Process(target=_consumer_proc, args=(name, n, q))
+        proc.start()
+        prod = shm.Producer(link)
+        sent = 0
+        while sent < n:
+            if prod.try_publish(b"frag-%05d" % sent, sig=sent):
+                sent += 1
+            else:
+                prod.refresh_credits()
+        got = q.get(timeout=60)
+        proc.join(timeout=30)
+        assert got == [b"frag-%05d" % i for i in range(n)]
+    finally:
+        link.close()
+        link.unlink()
+
+
+def test_cnc_signal_heartbeat():
+    cnc = rings.Cnc()
+    assert cnc.signal == rings.CNC_SIG_BOOT
+    cnc.signal = rings.CNC_SIG_RUN
+    cnc.heartbeat(12345)
+    assert cnc.signal == rings.CNC_SIG_RUN
+    assert cnc.last_heartbeat == 12345
+    cnc.diag_set(2, 99)
+    assert cnc.diag(2) == 99

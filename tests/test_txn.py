@@ -1,0 +1,221 @@
+"""Transaction wire-format parser tests: round-trips through the builder,
+validation edge cases mirroring fd_txn_parse's CHECK rules, and sigverify
+integration (parse -> batch kernel)."""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from firedancer_tpu.protocol import txn as ft
+from firedancer_tpu.ops.ref import ed25519_ref as ref
+
+
+def keypair(tag: bytes):
+    secret = hashlib.sha256(tag).digest()
+    return secret, ref.public_key(secret)
+
+
+def simple_legacy(n_extra_accts=1, n_instr=1, data=b"\x01\x02"):
+    secret, pub = keypair(b"payer")
+    accts = [pub] + [
+        hashlib.sha256(b"acct%d" % i).digest() for i in range(n_extra_accts)
+    ] + [ft.SYSTEM_PROGRAM]
+    prog = len(accts) - 1
+    msg = ft.message_build(
+        version=ft.VLEGACY,
+        signature_cnt=1,
+        readonly_signed_cnt=0,
+        readonly_unsigned_cnt=1,
+        acct_addrs=accts,
+        recent_blockhash=bytes(32),
+        instrs=[
+            ft.InstrSpec(program_id=prog, accounts=bytes([0, 1]), data=data)
+        ] * n_instr,
+    )
+    return ft.txn_assemble([ref.sign(secret, msg)], msg)
+
+
+def test_compact_u16_roundtrip():
+    for v in [0, 1, 0x7F, 0x80, 0x3FFF, 0x4000, 0xFFFF]:
+        enc = ft.compact_u16_encode(v)
+        got = ft.compact_u16_decode(enc, 0)
+        assert got == (v, len(enc)), v
+    # non-minimal encodings rejected
+    assert ft.compact_u16_decode(bytes([0x81, 0x00]), 0) is None
+    assert ft.compact_u16_decode(bytes([0x81, 0x80, 0x00]), 0) is None
+    # > 16 bits rejected
+    assert ft.compact_u16_decode(bytes([0xFF, 0xFF, 0x04]), 0) is None
+
+
+def test_parse_legacy_roundtrip():
+    p = simple_legacy()
+    t = ft.txn_parse(p)
+    assert t is not None
+    assert t.transaction_version == ft.VLEGACY
+    assert t.signature_cnt == 1
+    assert t.acct_addr_cnt == 3
+    assert len(t.instrs) == 1
+    assert t.instrs[0].program_id == 2
+    assert t.message(p) == p[t.message_off :]
+    assert t.signers(p)[0] == t.acct_addrs(p)[0]
+    assert p[t.instrs[0].data_off : t.instrs[0].data_off + t.instrs[0].data_sz] == b"\x01\x02"
+    # fee payer writable; program + recent accounts flagged right
+    assert t.is_writable(0) and t.is_writable(1) and not t.is_writable(2)
+
+
+def test_parse_v0_with_lut():
+    secret, pub = keypair(b"v0")
+    table = hashlib.sha256(b"table").digest()
+    msg = ft.message_build(
+        version=ft.V0,
+        signature_cnt=1,
+        readonly_signed_cnt=0,
+        readonly_unsigned_cnt=1,
+        acct_addrs=[pub, ft.SYSTEM_PROGRAM],
+        recent_blockhash=bytes(32),
+        instrs=[ft.InstrSpec(program_id=1, accounts=bytes([0, 2, 3]), data=b"")],
+        luts=[ft.LutSpec(table_addr=table, writable=bytes([5]), readonly=bytes([9]))],
+    )
+    p = ft.txn_assemble([ref.sign(secret, msg)], msg)
+    t = ft.txn_parse(p)
+    assert t is not None
+    assert t.transaction_version == ft.V0
+    assert t.addr_table_lookup_cnt == 1
+    assert t.addr_table_adtl_writable_cnt == 1
+    assert t.addr_table_adtl_cnt == 2
+    assert t.total_acct_cnt() == 4
+    lut = t.addr_luts[0]
+    assert p[lut.addr_off : lut.addr_off + 32] == table
+    # loaded writable account sits right after statics in the index space
+    assert t.is_writable(2) and not t.is_writable(3)
+
+
+def test_parse_transfer_builder():
+    secret, _ = keypair(b"from")
+    _, to = keypair(b"to")
+    p = ft.transfer_txn(secret, to, 1000, bytes(range(32)))
+    t = ft.txn_parse(p)
+    assert t is not None
+    assert t.signature_cnt == 1 and len(t.instrs) == 1
+    assert t.recent_blockhash(p) == bytes(range(32))
+    # signature actually verifies over the message
+    assert ref.verify(t.message(p), t.signatures(p)[0], t.signers(p)[0])
+
+
+@pytest.mark.parametrize(
+    "mutate",
+    [
+        lambda p: p + b"\x00",                     # trailing byte
+        lambda p: p[:-1],                          # truncated
+        lambda p: b"\x00" + p[1:],                 # zero signatures
+        lambda p: bytes([p[0] + 1]) + p[1:],       # sig cnt != header cnt
+        lambda p: p[:65] + bytes([p[65] ^ 0x7F]) + p[66:],  # header mismatch
+        lambda p: bytes(ft.TXN_MTU + 1),           # over MTU
+        lambda p: b"",                             # empty
+    ],
+)
+def test_parse_rejects(mutate):
+    p = simple_legacy()
+    assert ft.txn_parse(mutate(p)) is None
+
+
+def test_parse_rejects_bad_version():
+    p = bytearray(simple_legacy())
+    p[65] = 0x81  # versioned, version=1: only v0 recognized
+    assert ft.txn_parse(bytes(p)) is None
+
+
+def test_parse_rejects_ro_signed_overflow():
+    # readonly_signed_cnt must be < signature_cnt
+    secret, pub = keypair(b"payer")
+    msg = ft.message_build(
+        version=ft.VLEGACY,
+        signature_cnt=1,
+        readonly_signed_cnt=1,
+        readonly_unsigned_cnt=0,
+        acct_addrs=[pub, ft.SYSTEM_PROGRAM],
+        recent_blockhash=bytes(32),
+        instrs=[],
+    )
+    p = ft.txn_assemble([ref.sign(secret, msg)], msg)
+    assert ft.txn_parse(p) is None
+
+
+def test_parse_rejects_program_id_zero_or_oob():
+    secret, pub = keypair(b"payer")
+    for prog in (0, 3):  # fee payer can't be program; 3 is out of range
+        msg = ft.message_build(
+            version=ft.VLEGACY,
+            signature_cnt=1,
+            readonly_signed_cnt=0,
+            readonly_unsigned_cnt=1,
+            acct_addrs=[pub, hashlib.sha256(b"x").digest(), ft.SYSTEM_PROGRAM][:3],
+            recent_blockhash=bytes(32),
+            instrs=[ft.InstrSpec(program_id=prog, accounts=bytes([0]), data=b"")],
+        )
+        p = ft.txn_assemble([ref.sign(secret, msg)], msg)
+        assert ft.txn_parse(p) is None
+
+
+def test_parse_rejects_acct_index_oob():
+    secret, pub = keypair(b"payer")
+    msg = ft.message_build(
+        version=ft.VLEGACY,
+        signature_cnt=1,
+        readonly_signed_cnt=0,
+        readonly_unsigned_cnt=1,
+        acct_addrs=[pub, ft.SYSTEM_PROGRAM],
+        recent_blockhash=bytes(32),
+        instrs=[ft.InstrSpec(program_id=1, accounts=bytes([7]), data=b"")],
+    )
+    p = ft.txn_assemble([ref.sign(secret, msg)], msg)
+    assert ft.txn_parse(p) is None
+
+
+def test_parse_rejects_empty_lut():
+    secret, pub = keypair(b"v0")
+    msg = ft.message_build(
+        version=ft.V0,
+        signature_cnt=1,
+        readonly_signed_cnt=0,
+        readonly_unsigned_cnt=1,
+        acct_addrs=[pub, ft.SYSTEM_PROGRAM],
+        recent_blockhash=bytes(32),
+        instrs=[],
+        luts=[ft.LutSpec(table_addr=bytes(32), writable=b"", readonly=b"")],
+    )
+    p = ft.txn_assemble([ref.sign(secret, msg)], msg)
+    assert ft.txn_parse(p) is None
+
+
+def test_parse_rejects_legacy_with_lut_bytes():
+    # legacy txns have no LUT section: extra bytes -> trailing-byte reject
+    p = simple_legacy() + ft.compact_u16_encode(0)
+    assert ft.txn_parse(p) is None
+
+
+def test_multisig_txn():
+    secrets = [hashlib.sha256(b"s%d" % i).digest() for i in range(3)]
+    pubs = [ref.public_key(s) for s in secrets]
+    msg = ft.message_build(
+        version=ft.VLEGACY,
+        signature_cnt=3,
+        readonly_signed_cnt=1,
+        readonly_unsigned_cnt=1,
+        acct_addrs=pubs + [ft.SYSTEM_PROGRAM],
+        recent_blockhash=bytes(32),
+        instrs=[ft.InstrSpec(program_id=3, accounts=bytes([0, 1, 2]), data=b"hi")],
+    )
+    p = ft.txn_assemble([ref.sign(s, msg) for s in secrets], msg)
+    t = ft.txn_parse(p)
+    assert t is not None
+    assert t.signature_cnt == 3
+    sigs, signers = t.signatures(p), t.signers(p)
+    assert all(
+        ref.verify(t.message(p), s, k) for s, k in zip(sigs, signers)
+    )
+    # writability: signer 2 is readonly-signed tail, acct 3 readonly-unsigned
+    assert t.is_writable(0) and t.is_writable(1)
+    assert not t.is_writable(2) and not t.is_writable(3)
+    assert ft.MIN_SERIALIZED_SZ <= len(p) <= ft.TXN_MTU
