@@ -1,0 +1,125 @@
+"""The flagship model: the leader TPU pipeline, assembled.
+
+    benchg -> verify (TPU sigverify, xN round-robin) -> dedup -> pack
+
+This is the e2e slice of the reference's Frankendancer leader topology
+(/root/reference/src/app/fdctl/run/topos/fd_frankendancer.c:96-111) with
+ingress replaced by the synthetic generator (net/quic stages are later
+milestones).  Stages talk over tango shm links and are driven either by the
+in-process cooperative scheduler here (tests, bench) or by the process
+topology runner (own milestone).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+
+from firedancer_tpu.runtime.benchg import BenchGStage, gen_transfer_pool
+from firedancer_tpu.runtime.dedup import DedupStage
+from firedancer_tpu.runtime.pack_stub import PackStubStage
+from firedancer_tpu.runtime.verify import VerifyStage
+from firedancer_tpu.tango import shm
+
+
+@dataclass
+class LeaderPipeline:
+    stages: list
+    links: list
+    benchg: BenchGStage
+    verifies: list[VerifyStage]
+    dedup: DedupStage
+    pack: PackStubStage
+
+    def run(self, *, max_iters: int = 100_000, until_txns: int | None = None):
+        """Cooperative round-robin scheduling until pack has seen
+        `until_txns` txns or max_iters loop sweeps elapse."""
+        for _ in range(max_iters):
+            for s in self.stages:
+                s.run_once()
+            if until_txns is not None and self.pack.metrics.get("txn_in") >= until_txns:
+                break
+        for v in self.verifies:
+            v.flush()
+            # one more drain sweep so flushed txns flow through dedup/pack
+        for _ in range(64):
+            self.dedup.run_once()
+            self.pack.run_once()
+        self.pack.flush()
+
+    def close(self):
+        for link in self.links:
+            link.close()
+            link.unlink()
+
+    def report(self) -> dict:
+        return {s.name: dict(s.metrics.counters) for s in self.stages}
+
+
+def build_leader_pipeline(
+    *,
+    n_verify: int = 1,
+    pool_size: int = 512,
+    gen_limit: int | None = None,
+    batch: int = 128,
+    max_msg_len: int = 256,
+    depth: int = 1024,
+    batch_deadline_s: float = 0.002,
+) -> LeaderPipeline:
+    uid = f"{os.getpid()}_{int(time.monotonic_ns() % 1_000_000)}"
+    links = []
+
+    def mklink(name, mtu, n_consumers=1):
+        link = shm.ShmLink.create(
+            f"fdtpu_{name}_{uid}", depth=depth, mtu=mtu, n_fseq=n_consumers
+        )
+        links.append(link)
+        return link
+
+    # gen -> verify: one link, verify stages shard by seq round-robin.
+    gen_verify = mklink("gv", mtu=1232, n_consumers=n_verify)
+    # verify -> dedup: one link per verify stage (single-producer rings).
+    verify_dedup = [mklink(f"vd{i}", mtu=4096) for i in range(n_verify)]
+    dedup_pack = mklink("dp", mtu=4096)
+    pack_out = mklink("po", mtu=65536)
+
+    pool = gen_transfer_pool(pool_size)
+    benchg = BenchGStage(
+        pool,
+        "benchg",
+        outs=[shm.Producer(gen_verify)],
+        limit=gen_limit,
+    )
+    verifies = [
+        VerifyStage(
+            f"verify{i}",
+            ins=[shm.Consumer(gen_verify, fseq_idx=i, lazy=32)],
+            outs=[shm.Producer(verify_dedup[i])],
+            shard_idx=i,
+            shard_cnt=n_verify,
+            batch=batch,
+            max_msg_len=max_msg_len,
+            batch_deadline_s=batch_deadline_s,
+        )
+        for i in range(n_verify)
+    ]
+    dedup = DedupStage(
+        "dedup",
+        ins=[shm.Consumer(l, lazy=32) for l in verify_dedup],
+        outs=[shm.Producer(dedup_pack)],
+    )
+    pack = PackStubStage(
+        "pack",
+        ins=[shm.Consumer(dedup_pack, lazy=32)],
+        outs=[shm.Producer(pack_out, reliable_fseq_idx=[])],
+    )
+    stages = [benchg, *verifies, dedup, pack]
+    return LeaderPipeline(
+        stages=stages,
+        links=links,
+        benchg=benchg,
+        verifies=verifies,
+        dedup=dedup,
+        pack=pack,
+    )

@@ -1,0 +1,49 @@
+"""Synthetic transaction generator stage (the reference's benchg tile:
+src/app/fddev/tiles/fd_benchg.c) and the synthetic-load harness
+(src/disco/verify/verify_synth_load.c).
+
+Signing in pure python is slow (~15 ms/txn), so a pool of unique signed
+transfer txns is pregenerated once and streamed in a cycle.  For dedup
+realism every txn in the pool is unique (distinct lamports); cycling the
+pool re-sends duplicates, which is exactly what the dedup stage is for —
+size the pool >= the txns you intend to count as distinct.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from firedancer_tpu.protocol import txn as ft
+from .stage import Stage
+
+
+def gen_transfer_pool(n: int, seed: bytes = b"benchg") -> list[bytes]:
+    from firedancer_tpu.ops.ref import ed25519_ref as ref
+
+    secret = hashlib.sha256(seed + b"payer").digest()
+    payer_pub = ref.public_key(secret)
+    to = hashlib.sha256(seed + b"to").digest()
+    blockhash = hashlib.sha256(seed + b"bh").digest()
+    return [
+        ft.transfer_txn(
+            secret, to, 1 + i, blockhash, from_pubkey=payer_pub
+        )
+        for i in range(n)
+    ]
+
+
+class BenchGStage(Stage):
+    """Streams a pregenerated txn pool round-robin at max rate."""
+
+    def __init__(self, pool: list[bytes], *args, limit: int | None = None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.pool = pool
+        self.limit = limit
+        self._i = 0
+
+    def after_credit(self) -> None:
+        if self.limit is not None and self._i >= self.limit:
+            return
+        if self.publish(0, self.pool[self._i % len(self.pool)], sig=self._i):
+            self._i += 1
+            self.metrics.inc("txn_gen")

@@ -1,0 +1,30 @@
+"""Dedup stage: the global signature dedup after verify.
+
+Reference: src/app/fdctl/run/tiles/fd_dedup.c — one stage with a big tcache
+keyed on the first signature; drops duplicates, forwards everything else
+unchanged.  The verify stages' tiny tcaches only guard racing duplicates
+across round-robin peers; this is the authoritative filter.
+"""
+
+from __future__ import annotations
+
+from firedancer_tpu.tango.rings import TCache
+from .stage import Stage
+
+DEDUP_TCACHE_DEPTH = 1 << 16
+
+
+class DedupStage(Stage):
+    def __init__(self, *args, tcache_depth: int = DEDUP_TCACHE_DEPTH, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.tcache = TCache(tcache_depth)
+
+    def after_frag(self, in_idx: int, meta, payload: bytes) -> None:
+        from firedancer_tpu.tango.rings import MCache
+
+        tag = int(meta[MCache.COL_SIG])
+        if self.tcache.insert(tag):
+            self.metrics.inc("dedup_dup")
+            return
+        if self.outs:
+            self.publish(0, payload, sig=tag)

@@ -1,0 +1,43 @@
+"""Pack stub: collects verified txns into fixed-size microblocks.
+
+Placeholder for the real conflict-aware scheduler (ballet/pack port, its own
+milestone); preserves the pipeline position dedup -> pack -> bank and the
+microblock frame convention so the e2e slice exercises the full path.
+"""
+
+from __future__ import annotations
+
+from .stage import Stage
+from .verify import decode_verified
+
+
+class PackStubStage(Stage):
+    def __init__(self, *args, microblock_max: int = 64, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.microblock_max = microblock_max
+        self._pending: list[bytes] = []
+        self.microblocks: list[list[bytes]] = []  # kept for observers/tests
+
+    def after_frag(self, in_idx: int, meta, payload: bytes) -> None:
+        self._pending.append(payload)
+        self.metrics.inc("txn_in")
+        if len(self._pending) >= self.microblock_max:
+            self._emit()
+
+    def _emit(self) -> None:
+        mb = self._pending
+        self._pending = []
+        self.microblocks.append(mb)
+        self.metrics.inc("microblocks")
+        self.metrics.inc("txn_scheduled", len(mb))
+        if self.outs:
+            # frame: u16 count || (u16 len || frag)*
+            out = bytearray(len(mb).to_bytes(2, "little"))
+            for frag in mb:
+                out += len(frag).to_bytes(2, "little")
+                out += frag
+            self.publish(0, bytes(out))
+
+    def flush(self) -> None:
+        if self._pending:
+            self._emit()

@@ -1,0 +1,153 @@
+"""The stage run loop — this framework's fd_mux_tile.
+
+A Stage owns zero or more input links (as Consumers) and zero or more output
+links (as Producers) and exposes the reference mux's callback set
+(/root/reference/src/disco/mux/fd_mux.h:105-200):
+
+    during_housekeeping()  — lazy out-of-band work (credits, fseq, heartbeat)
+    before_credit()        — called every iteration before credit check
+    after_credit()         — called when there is room to publish (batch
+                             close / drain point for async device work)
+    before_frag(in_idx, seq, sig) -> bool   — cheap filter (False = skip)
+    during_frag(in_idx, meta, payload)      — speculative payload handling
+    after_frag(in_idx, meta, payload)       — commit: process and publish
+
+Differences from the reference, by design: the loop is cooperative
+(`run_once` does one iteration) so a single process can drive a whole
+topology deterministically in tests, while the process runner just calls
+`run()`; and "device work" (TPU batches) is naturally asynchronous via jax
+dispatch, so stages overlap host streaming with device compute without
+extra threads.  Housekeeping is scheduled by iteration count rather than
+tsc ticks (same randomized-lazy idea, fd_mux.c:389-474).
+"""
+
+from __future__ import annotations
+
+import random
+import time
+
+from firedancer_tpu.tango import shm
+from firedancer_tpu.tango.rings import CNC_SIG_HALT, CNC_SIG_RUN, Cnc, MCache
+
+
+class Metrics:
+    """Per-stage counters, a plain dict (metrics schema comes later)."""
+
+    def __init__(self):
+        self.counters: dict[str, int] = {}
+
+    def inc(self, name: str, v: int = 1) -> None:
+        self.counters[name] = self.counters.get(name, 0) + v
+
+    def get(self, name: str) -> int:
+        return self.counters.get(name, 0)
+
+
+class Stage:
+    def __init__(
+        self,
+        name: str,
+        ins: list[shm.Consumer] | None = None,
+        outs: list[shm.Producer] | None = None,
+        cnc: Cnc | None = None,
+        lazy: int = 128,
+        seed: int = 0,
+    ):
+        self.name = name
+        self.ins = ins or []
+        self.outs = outs or []
+        self.cnc = cnc or Cnc()
+        self.metrics = Metrics()
+        self.lazy = lazy
+        self._rng = random.Random(seed ^ hash(name))
+        self._next_housekeeping = 0
+        self._iter = 0
+        self._in_rr = 0  # round-robin input cursor
+        self.cnc.signal = CNC_SIG_RUN
+
+    # -- callbacks (override in subclasses) ---------------------------------
+
+    def during_housekeeping(self) -> None: ...
+
+    def before_credit(self) -> None: ...
+
+    def after_credit(self) -> None: ...
+
+    def before_frag(self, in_idx: int, seq: int, sig: int) -> bool:
+        return True
+
+    def during_frag(self, in_idx: int, meta, payload: bytes) -> None: ...
+
+    def after_frag(self, in_idx: int, meta, payload: bytes) -> None: ...
+
+    # -- the loop -----------------------------------------------------------
+
+    def _housekeeping(self) -> None:
+        for c in self.ins:
+            c.publish_progress()
+        for p in self.outs:
+            p.refresh_credits()
+        self.cnc.heartbeat(time.monotonic_ns())
+        self.during_housekeeping()
+        # randomized lazy interval: [lazy/2, 3*lazy/2) iterations
+        self._next_housekeeping = self._iter + self.lazy // 2 + self._rng.randrange(
+            max(self.lazy, 1)
+        )
+
+    def run_once(self) -> bool:
+        """One loop iteration; returns True if any frag was processed."""
+        self._iter += 1
+        if self._iter >= self._next_housekeeping:
+            self._housekeeping()
+            if self.cnc.signal == CNC_SIG_HALT:
+                return False
+        self.before_credit()
+        backpressured = any(p.cr_avail <= 0 for p in self.outs)
+        if not backpressured:
+            self.after_credit()
+        progressed = False
+        n_in = len(self.ins)
+        for k in range(n_in):
+            idx = (self._in_rr + k) % n_in
+            cons = self.ins[idx]
+            seq = cons.seq
+            res = cons.poll()
+            if res == shm.POLL_EMPTY:
+                continue
+            if res == shm.POLL_OVERRUN:
+                self.metrics.inc("overrun")
+                progressed = True
+                break
+            meta, payload = res
+            progressed = True
+            if not self.before_frag(idx, seq, int(meta[MCache.COL_SIG])):
+                self.metrics.inc("filtered")
+            else:
+                self.during_frag(idx, meta, payload)
+                self.after_frag(idx, meta, payload)
+                self.metrics.inc("frags_in")
+            self._in_rr = (idx + 1) % n_in
+            break
+        return progressed
+
+    def run(self, max_iters: int | None = None) -> None:
+        it = 0
+        while self.cnc.signal != CNC_SIG_HALT:
+            self.run_once()
+            it += 1
+            if max_iters is not None and it >= max_iters:
+                break
+
+    def halt(self) -> None:
+        self.cnc.signal = CNC_SIG_HALT
+
+    # -- helpers ------------------------------------------------------------
+
+    def publish(self, out_idx: int, payload: bytes, sig: int = 0) -> bool:
+        p = self.outs[out_idx]
+        ok = p.try_publish(payload, sig=sig)
+        if ok:
+            self.metrics.inc("frags_out")
+        else:
+            self.metrics.inc("backpressure")
+        return ok
