@@ -1,0 +1,199 @@
+"""Batched SHA-256 in JAX for TPU.
+
+Counterpart of the reference's sha256 component (/root/reference/src/ballet/
+sha256: SHANI asm + 16-way AVX-512 batch) — here the batch IS the vector
+lane dimension, and words are native uint32.
+
+Two entry points:
+  - sha256_msg: variable-length messages, one compiled program per
+    (max_len) bucket, per-element final-block capture (same scheme as
+    sha512.py).
+  - sha256_iter32: iterated hashing of a 32-byte state — the PoH hash-chain
+    primitive (fd_poh_append is sha256^n).  Sequential per chain but batched
+    across B independent chains/segments, which is how PoH *verification*
+    parallelizes (each leader-slot segment checked independently).
+
+Layout: byte rows lead, batch trails ((nbytes, B) int32), as in sha512.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_K = np.asarray(
+    [
+        0x428A2F98, 0x71374491, 0xB5C0FBCF, 0xE9B5DBA5, 0x3956C25B, 0x59F111F1,
+        0x923F82A4, 0xAB1C5ED5, 0xD807AA98, 0x12835B01, 0x243185BE, 0x550C7DC3,
+        0x72BE5D74, 0x80DEB1FE, 0x9BDC06A7, 0xC19BF174, 0xE49B69C1, 0xEFBE4786,
+        0x0FC19DC6, 0x240CA1CC, 0x2DE92C6F, 0x4A7484AA, 0x5CB0A9DC, 0x76F988DA,
+        0x983E5152, 0xA831C66D, 0xB00327C8, 0xBF597FC7, 0xC6E00BF3, 0xD5A79147,
+        0x06CA6351, 0x14292967, 0x27B70A85, 0x2E1B2138, 0x4D2C6DFC, 0x53380D13,
+        0x650A7354, 0x766A0ABB, 0x81C2C92E, 0x92722C85, 0xA2BFE8A1, 0xA81A664B,
+        0xC24B8B70, 0xC76C51A3, 0xD192E819, 0xD6990624, 0xF40E3585, 0x106AA070,
+        0x19A4C116, 0x1E376C08, 0x2748774C, 0x34B0BCB5, 0x391C0CB3, 0x4ED8AA4A,
+        0x5B9CCA4F, 0x682E6FF3, 0x748F82EE, 0x78A5636F, 0x84C87814, 0x8CC70208,
+        0x90BEFFFA, 0xA4506CEB, 0xBEF9A3F7, 0xC67178F2,
+    ],
+    dtype=np.uint32,
+)
+
+_IV = np.asarray(
+    [
+        0x6A09E667, 0xBB67AE85, 0x3C6EF372, 0xA54FF53A,
+        0x510E527F, 0x9B05688C, 0x1F83D9AB, 0x5BE0CD19,
+    ],
+    dtype=np.uint32,
+)
+
+
+def _rotr(x, n):
+    return (x >> n) | (x << (32 - n))
+
+
+def _compress_block(state, w16):
+    """One compression: state (8, B) uint32, w16 (16, B) uint32 -> (8, B)."""
+    k = jnp.asarray(_K)
+    pad = [(0, 64 - 16)] + [(0, 0)] * (w16.ndim - 1)
+    w = jnp.pad(w16, pad)
+
+    def sched(t, w):
+        g = lambda off: jax.lax.dynamic_index_in_dim(w, t - off, keepdims=False)
+        s0 = _rotr(g(15), 7) ^ _rotr(g(15), 18) ^ (g(15) >> 3)
+        s1 = _rotr(g(2), 17) ^ _rotr(g(2), 19) ^ (g(2) >> 10)
+        return jax.lax.dynamic_update_index_in_dim(
+            w, g(16) + s0 + g(7) + s1, t, 0
+        )
+
+    w = jax.lax.fori_loop(16, 64, sched, w)
+
+    def round_body(t, s):
+        a, b, c, d, e, f, g, h = s
+        wt = jax.lax.dynamic_index_in_dim(w, t, keepdims=False)
+        kt = jax.lax.dynamic_index_in_dim(k, t, keepdims=False)
+        s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + s1 + ch + kt + wt
+        s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = s0 + maj
+        return jnp.stack([t1 + t2, a, b, c, d + t1, e, f, g])
+
+    s = jax.lax.fori_loop(0, 64, round_body, state)
+    return state + s
+
+
+def sha256_pad(msg: jnp.ndarray, msg_len: jnp.ndarray, max_len: int):
+    """(max_len, B) bytes + (B,) lengths -> (NB, 16, B) word blocks and the
+    per-element final block index."""
+    nb = (max_len + 9 + 63) // 64
+    total = nb * 64
+    pad_cfg = [(0, total - max_len)] + [(0, 0)] * (msg.ndim - 1)
+    buf = jnp.pad(msg.astype(jnp.int32), pad_cfg)
+    pos = jnp.arange(total, dtype=jnp.int32).reshape(
+        (total,) + (1,) * (msg.ndim - 1)
+    )
+    keep = pos < msg_len[None]
+    buf = jnp.where(keep, buf, 0)
+    buf = buf + jnp.where(pos == msg_len[None], 0x80, 0)
+    final_block = (msg_len + 9 + 63) // 64 - 1
+    bitlen = msg_len * 8  # < 2^32: 4 length bytes suffice, top 4 stay 0
+    base = final_block * 64
+    for j, sh in ((60, 24), (61, 16), (62, 8), (63, 0)):
+        buf = buf + jnp.where(pos == base[None] + j, (bitlen[None] >> sh) & 0xFF, 0)
+    words = buf.reshape((nb * 16, 4) + buf.shape[1:]).astype(jnp.uint32)
+    w32 = (words[:, 0] << 24) | (words[:, 1] << 16) | (words[:, 2] << 8) | words[:, 3]
+    return w32.reshape((nb, 16) + buf.shape[1:]), final_block
+
+
+def _state_to_bytes(state: jnp.ndarray) -> jnp.ndarray:
+    """(8, B) uint32 -> (32, B) int32 big-endian byte rows."""
+    s = state.astype(jnp.int32)
+    out = []
+    for i in range(8):
+        for sh in (24, 16, 8, 0):
+            out.append((s[i] >> sh) & 0xFF)
+    return jnp.stack(out)
+
+
+def sha256_msg(msg: jnp.ndarray, msg_len: jnp.ndarray, max_len: int) -> jnp.ndarray:
+    """Batched SHA-256 of variable-length messages: (32, B) digest rows."""
+    blocks, final_block = sha256_pad(msg, msg_len, max_len)
+    nb = blocks.shape[0]
+    batch = msg.shape[1:]
+    state = jnp.broadcast_to(
+        jnp.asarray(_IV).reshape((8,) + (1,) * len(batch)), (8,) + batch
+    )
+    result = jnp.zeros((8,) + batch, dtype=jnp.uint32)
+
+    def body(bi, carry):
+        state, result = carry
+        blk = jax.lax.dynamic_index_in_dim(blocks, bi, keepdims=False)
+        state = _compress_block(state, blk)
+        result = jnp.where(bi == final_block[None], state, result)
+        return state, result
+
+    _, result = jax.lax.fori_loop(0, nb, body, (state, result))
+    return _state_to_bytes(result)
+
+
+def _bytes_to_words(b: jnp.ndarray) -> jnp.ndarray:
+    """(32, B) byte rows -> (8, B) big-endian uint32 words."""
+    w = b.reshape((8, 4) + b.shape[1:]).astype(jnp.uint32)
+    return (w[:, 0] << 24) | (w[:, 1] << 16) | (w[:, 2] << 8) | w[:, 3]
+
+
+# The constant second half of the single padded block for a 32-byte message:
+# 0x80 then zeros, bit length 256 in the last word.
+_PAD32_WORDS = np.zeros(8, dtype=np.uint32)
+_PAD32_WORDS[0] = 0x80000000
+_PAD32_WORDS[7] = 256
+
+
+def _iter32_block(state_words: jnp.ndarray) -> jnp.ndarray:
+    """One sha256(x) for x = current 32-byte state, all in words."""
+    batch = state_words.shape[1:]
+    pad = jnp.broadcast_to(
+        jnp.asarray(_PAD32_WORDS).reshape((8,) + (1,) * len(batch)),
+        (8,) + batch,
+    )
+    w16 = jnp.concatenate([state_words, pad], axis=0)
+    iv = jnp.broadcast_to(
+        jnp.asarray(_IV).reshape((8,) + (1,) * len(batch)), (8,) + batch
+    )
+    return _compress_block(iv, w16)
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def sha256_iter32(state: jnp.ndarray, n: int) -> jnp.ndarray:
+    """state^(n): n-fold iterated sha256 of (32, B) byte rows (PoH append).
+
+    B independent hash chains advance in lockstep — the batched PoH
+    verification primitive (each element one slot segment / one tick span).
+    """
+    words = _bytes_to_words(state)
+    words = jax.lax.fori_loop(0, n, lambda _, s: _iter32_block(s), words)
+    return _state_to_bytes(words)
+
+
+def sha256_mix32(state: jnp.ndarray, mixin: jnp.ndarray) -> jnp.ndarray:
+    """sha256(state || mixin) for (32, B) byte rows each (PoH mixin step).
+
+    64-byte message = exactly one data block plus one constant pad block.
+    """
+    batch = state.shape[1:]
+    w0 = jnp.concatenate([_bytes_to_words(state), _bytes_to_words(mixin)], axis=0)
+    iv = jnp.broadcast_to(
+        jnp.asarray(_IV).reshape((8,) + (1,) * len(batch)), (8,) + batch
+    )
+    s = _compress_block(iv, w0)
+    pad = np.zeros(16, dtype=np.uint32)
+    pad[0] = 0x80000000
+    pad[15] = 512
+    w1 = jnp.broadcast_to(
+        jnp.asarray(pad).reshape((16,) + (1,) * len(batch)), (16,) + batch
+    )
+    return _state_to_bytes(_compress_block(s, w1))

@@ -1,0 +1,85 @@
+"""Proof of History: the sequential hash clock and its batched verifier.
+
+The reference's PoH primitive is sha256 iterated in a chain with microblock
+hashes mixed in (/root/reference/src/ballet/poh/fd_poh.c: fd_poh_append,
+fd_poh_mixin; the poh tile fd_poh.c drives it).  Generation is inherently
+sequential — it stays on host (hashlib's C core), per SURVEY §7.1.
+*Verification* is embarrassingly parallel: split the chain into segments at
+known (hashcnt, hash) checkpoints and recompute every segment as one batch
+element on TPU (ops/sha256.sha256_iter32) — the axis the reference scales
+with one core per chain, this framework scales with lanes.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+def poh_append(h: bytes, n: int) -> bytes:
+    for _ in range(n):
+        h = hashlib.sha256(h).digest()
+    return h
+
+
+def poh_mixin(h: bytes, mix: bytes) -> bytes:
+    return hashlib.sha256(h + mix).digest()
+
+
+@dataclass
+class PohRecord:
+    hashcnt: int
+    hash: bytes
+    mixin: bytes | None  # None = tick boundary record
+
+
+@dataclass
+class PohChain:
+    """Host-side PoH state machine (generation side)."""
+
+    hash: bytes
+    hashcnt: int = 0
+    records: list[PohRecord] = field(default_factory=list)
+
+    def append(self, n: int) -> None:
+        self.hash = poh_append(self.hash, n)
+        self.hashcnt += n
+
+    def mixin(self, mix: bytes) -> None:
+        """Mix a microblock hash into the chain (counts as one hash)."""
+        self.hash = poh_mixin(self.hash, mix)
+        self.hashcnt += 1
+        self.records.append(PohRecord(self.hashcnt, self.hash, mix))
+
+    def tick(self) -> None:
+        self.records.append(PohRecord(self.hashcnt, self.hash, None))
+
+
+def verify_segments_host(
+    starts: list[bytes], counts: list[int], ends: list[bytes]
+) -> list[bool]:
+    return [poh_append(s, n) == e for s, n, e in zip(starts, counts, ends)]
+
+
+def verify_segments_tpu(
+    starts: list[bytes], count: int, ends: list[bytes]
+) -> np.ndarray:
+    """Batch-verify equal-length segments: sha256^count(start_i) == end_i.
+
+    Equal counts keep the compiled program static-shaped; a real block's
+    mixed-length segments get bucketed by count by the caller.
+    """
+    import jax.numpy as jnp
+
+    from firedancer_tpu.ops import sha256 as fsha
+
+    s = np.stack(
+        [np.frombuffer(x, dtype=np.uint8) for x in starts], axis=-1
+    ).astype(np.int32)
+    out = np.asarray(fsha.sha256_iter32(jnp.asarray(s), count))
+    expect = np.stack(
+        [np.frombuffer(x, dtype=np.uint8) for x in ends], axis=-1
+    ).astype(np.int32)
+    return (out == expect).all(axis=0)
