@@ -1,0 +1,2 @@
+"""Leader scheduling: cost model + conflict-aware microblock scheduler
+(the reference's ballet/pack library, re-designed host-side)."""

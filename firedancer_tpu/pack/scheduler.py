@@ -1,0 +1,259 @@
+"""Conflict-aware microblock scheduler (the pack library proper).
+
+Behavioral port of /root/reference/src/ballet/pack/fd_pack.c:
+
+  - pending transactions ordered by reward/cost ratio, compared exactly as
+    r1*c2 > r2*c1 (no floating point; fd_pack.c:41-47);
+  - separate pending pool for simple votes (scheduled against the vote
+    cost limit);
+  - an account in use by an in-flight microblock blocks conflicting txns:
+    write-locks are exclusive, read-locks are shared (fd_pack_bitset.h's
+    semantics via per-account reader/writer bank masks);
+  - consensus-critical block limits: total cost, vote cost, per-account
+    write cost, data bytes incl. 48-byte microblock overhead
+    (fd_pack.h:18-49);
+  - microblock_done(bank) releases that bank's account locks;
+  - end_block() resets block accounting, keeping unscheduled txns.
+
+The ordered pool is a sorted list with bisect insertion — the treap's role
+(ordered iteration + O(log n) insert/delete) at host-model scale.
+"""
+
+from __future__ import annotations
+
+import bisect
+from dataclasses import dataclass, field
+
+from firedancer_tpu.protocol import txn as ft
+from . import cost as fc
+
+
+@dataclass
+class OrdTxn:
+    payload: bytes
+    desc: ft.Txn
+    cost: fc.TxnCost
+    rewards: int
+
+    def sort_key(self):
+        # descending by rewards/cost; bisect needs ascending, so negate via
+        # ratio inversion: store (-rewards/cost) as exact fraction tuple.
+        # Compare r1/c1 > r2/c2 as r1*c2 > r2*c1 -> key = Fraction-free:
+        return _RatioKey(self.rewards, self.cost.total)
+
+    def first_sig(self) -> bytes:
+        return self.desc.signatures(self.payload)[0]
+
+    def accounts(self) -> tuple[set[bytes], set[bytes]]:
+        """(writable, readonly) static account addresses."""
+        addrs = self.desc.acct_addrs(self.payload)
+        w, r = set(), set()
+        for i, a in enumerate(addrs):
+            (w if self.desc.is_writable(i) else r).add(a)
+        return w, r
+
+
+class _RatioKey:
+    """Orders by rewards/cost DESC without floats: r1*c2 > r2*c1."""
+
+    __slots__ = ("r", "c")
+
+    def __init__(self, r: int, c: int):
+        self.r = r
+        self.c = max(c, 1)
+
+    def __lt__(self, other):  # "less" = schedules earlier = higher ratio
+        return self.r * other.c > other.r * self.c
+
+    def __eq__(self, other):
+        return self.r * other.c == other.r * self.c
+
+
+@dataclass
+class BlockLimits:
+    max_cost_per_block: int = fc.MAX_COST_PER_BLOCK
+    max_vote_cost_per_block: int = fc.MAX_VOTE_COST_PER_BLOCK
+    max_write_cost_per_acct: int = fc.MAX_WRITE_COST_PER_ACCT
+    max_data_bytes_per_block: int = fc.MAX_DATA_PER_BLOCK
+
+
+class Pack:
+    def __init__(
+        self,
+        *,
+        bank_cnt: int = 4,
+        depth: int = 4096,
+        limits: BlockLimits | None = None,
+        max_txn_per_microblock: int = 31,
+    ):
+        if bank_cnt > fc.MAX_BANK_TILES:
+            raise ValueError(f"bank_cnt > {fc.MAX_BANK_TILES}")
+        self.bank_cnt = bank_cnt
+        self.depth = depth
+        self.limits = limits or BlockLimits()
+        self.max_txn_per_microblock = max_txn_per_microblock
+        self._pending: list[OrdTxn] = []  # sorted by _RatioKey
+        self._pending_votes: list[OrdTxn] = []
+        self._sigs: set[bytes] = set()
+        # account locks: addr -> [writer_mask, reader_mask] of bank bits
+        self._in_use: dict[bytes, list[int]] = {}
+        self._bank_accts: list[list[tuple[bytes, bool]]] = [
+            [] for _ in range(bank_cnt)
+        ]
+        # block accounting
+        self.cost_used = 0
+        self.vote_cost_used = 0
+        self.data_bytes_used = 0
+        self._write_cost: dict[bytes, int] = {}
+
+    # -- intake --------------------------------------------------------------
+
+    def insert(self, payload: bytes, desc: ft.Txn | None = None) -> bool:
+        """Add a verified txn to the pool; False = rejected/dropped."""
+        t = desc or ft.txn_parse(payload)
+        if t is None:
+            return False
+        c = fc.compute_cost(payload, t)
+        if c is None:
+            return False
+        sig = t.signatures(payload)[0]
+        if sig in self._sigs:
+            return False
+        pool = self._pending_votes if c.is_simple_vote else self._pending
+        if len(self._pending) + len(self._pending_votes) >= self.depth:
+            # full: drop lowest priority if the newcomer beats it
+            tail = pool[-1] if pool else None
+            ord_txn = OrdTxn(payload, t, c, c.rewards(t.signature_cnt))
+            if tail is None or not (ord_txn.sort_key() < tail.sort_key()):
+                return False
+            self._remove(tail)
+            bisect.insort(pool, ord_txn, key=OrdTxn.sort_key)
+            self._sigs.add(sig)
+            return True
+        ord_txn = OrdTxn(payload, t, c, c.rewards(t.signature_cnt))
+        bisect.insort(pool, ord_txn, key=OrdTxn.sort_key)
+        self._sigs.add(sig)
+        return True
+
+    def _remove(self, o: OrdTxn) -> None:
+        for pool in (self._pending, self._pending_votes):
+            try:
+                pool.remove(o)
+                break
+            except ValueError:
+                continue
+        self._sigs.discard(o.first_sig())
+
+    def delete_by_sig(self, sig: bytes) -> bool:
+        for pool in (self._pending, self._pending_votes):
+            for o in pool:
+                if o.first_sig() == sig:
+                    pool.remove(o)
+                    self._sigs.discard(sig)
+                    return True
+        return False
+
+    def pending_cnt(self) -> int:
+        return len(self._pending) + len(self._pending_votes)
+
+    # -- scheduling ----------------------------------------------------------
+
+    def _conflicts(self, bank: int, writable: set, readonly: set) -> bool:
+        other = ~(1 << bank)
+        for a in writable:
+            u = self._in_use.get(a)
+            if u and ((u[0] | u[1]) & other):
+                return True
+        for a in readonly:
+            u = self._in_use.get(a)
+            if u and (u[0] & other):
+                return True
+        return False
+
+    def _fits_block(self, o: OrdTxn, vote: bool, writable: set) -> bool:
+        lim = self.limits
+        if self.cost_used + o.cost.total > lim.max_cost_per_block:
+            return False
+        if vote and self.vote_cost_used + o.cost.total > lim.max_vote_cost_per_block:
+            return False
+        sz = len(o.payload)
+        if self.data_bytes_used + sz + fc.MICROBLOCK_DATA_OVERHEAD > lim.max_data_bytes_per_block:
+            return False
+        for a in writable:
+            if self._write_cost.get(a, 0) + o.cost.total > lim.max_write_cost_per_acct:
+                return False
+        return True
+
+    def schedule_next_microblock(
+        self, bank: int, *, votes: bool = False
+    ) -> list[OrdTxn]:
+        """Select a conflict-free microblock for `bank` (fd_pack.c
+        fd_pack_schedule_next_microblock).  Chosen txns' accounts become
+        in-use by this bank until microblock_done(bank)."""
+        if not 0 <= bank < self.bank_cnt:
+            raise ValueError("bad bank index")
+        pool = self._pending_votes if votes else self._pending
+        chosen: list[OrdTxn] = []
+        taken_w: set[bytes] = set()
+        taken_r: set[bytes] = set()
+        skipped: list[OrdTxn] = []
+        while pool and len(chosen) < self.max_txn_per_microblock:
+            o = pool[0]
+            w, r = o.accounts()
+            # conflicts within this microblock too: serial execution inside
+            # a microblock is NOT a thing — the bank executes it as one
+            # conflict-free parallel burst.
+            if (
+                self._conflicts(bank, w, r)
+                or (w & (taken_w | taken_r))
+                or (r & taken_w)
+                or not self._fits_block(o, votes, w)
+            ):
+                skipped.append(pool.pop(0))
+                continue
+            pool.pop(0)
+            self._sigs.discard(o.first_sig())
+            chosen.append(o)
+            taken_w |= w
+            taken_r |= r
+        # skipped txns go back in order
+        for o in skipped:
+            bisect.insort(pool, o, key=OrdTxn.sort_key)
+            # note: sigs for skipped txns were never discarded
+        if not chosen:
+            return []
+        # commit locks + block accounting
+        for o in chosen:
+            w, r = o.accounts()
+            for a in w:
+                self._in_use.setdefault(a, [0, 0])[0] |= 1 << bank
+                self._bank_accts[bank].append((a, True))
+                self._write_cost[a] = self._write_cost.get(a, 0) + o.cost.total
+            for a in r:
+                self._in_use.setdefault(a, [0, 0])[1] |= 1 << bank
+                self._bank_accts[bank].append((a, False))
+            self.cost_used += o.cost.total
+            if votes:
+                self.vote_cost_used += o.cost.total
+            self.data_bytes_used += len(o.payload)
+        self.data_bytes_used += fc.MICROBLOCK_DATA_OVERHEAD
+        return chosen
+
+    def microblock_done(self, bank: int) -> None:
+        """Release `bank`'s account locks (execution finished)."""
+        for a, was_write in self._bank_accts[bank]:
+            u = self._in_use.get(a)
+            if u is None:
+                continue
+            u[0 if was_write else 1] &= ~(1 << bank)
+            if not (u[0] | u[1]):
+                del self._in_use[a]
+        self._bank_accts[bank] = []
+
+    def end_block(self) -> None:
+        self.cost_used = 0
+        self.vote_cost_used = 0
+        self.data_bytes_used = 0
+        self._write_cost.clear()
+        for b in range(self.bank_cnt):
+            self.microblock_done(b)

