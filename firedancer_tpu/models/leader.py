@@ -42,10 +42,20 @@ class LeaderPipeline:
                 break
         for v in self.verifies:
             v.flush()
-            # one more drain sweep so flushed txns flow through dedup/pack
-        for _ in range(64):
+        # drain sweeps until quiescent: each run_once moves at most one frag
+        # per stage, so sweep dedup/pack until neither makes progress (a
+        # fixed sweep count loses the tail when verify flushes > count frags).
+        while True:
+            before = self.dedup.metrics.get("frags_in") + self.pack.metrics.get(
+                "frags_in"
+            )
             self.dedup.run_once()
             self.pack.run_once()
+            after = self.dedup.metrics.get("frags_in") + self.pack.metrics.get(
+                "frags_in"
+            )
+            if after == before:
+                break
         self.pack.flush()
 
     def close(self):

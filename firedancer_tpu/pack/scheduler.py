@@ -34,6 +34,7 @@ class OrdTxn:
     desc: ft.Txn
     cost: fc.TxnCost
     rewards: int
+    _sets: tuple | None = field(default=None, repr=False, compare=False)
 
     def sort_key(self):
         # descending by rewards/cost; bisect needs ascending, so negate via
@@ -44,12 +45,30 @@ class OrdTxn:
     def first_sig(self) -> bytes:
         return self.desc.signatures(self.payload)[0]
 
+    def acct_sets(self) -> tuple[set[bytes], set[bytes], set[bytes]]:
+        """(static_writable, readonly, lock_writable), computed once.
+
+        lock_writable = static_writable plus, for v0 txns, the address of
+        every referenced lookup table: ALT-loaded accounts cannot be
+        resolved without an address-resolution stage, so any txn with
+        lookups conservatively write-locks the table address itself — two
+        txns loading from the same table serialize, and can never write the
+        same ALT-loaded account concurrently (the reference locks resolved
+        ALT accounts, fd_pack_bitset.h semantics)."""
+        if self._sets is None:
+            addrs = self.desc.acct_addrs(self.payload)
+            w, r = set(), set()
+            for i, a in enumerate(addrs):
+                (w if self.desc.is_writable(i) else r).add(a)
+            lw = set(w)
+            for lut in self.desc.addr_luts:
+                lw.add(self.payload[lut.addr_off : lut.addr_off + 32])
+            self._sets = (w, r, lw)
+        return self._sets
+
     def accounts(self) -> tuple[set[bytes], set[bytes]]:
         """(writable, readonly) static account addresses."""
-        addrs = self.desc.acct_addrs(self.payload)
-        w, r = set(), set()
-        for i, a in enumerate(addrs):
-            (w if self.desc.is_writable(i) else r).add(a)
+        w, r, _ = self.acct_sets()
         return w, r
 
 
@@ -170,17 +189,41 @@ class Pack:
                 return True
         return False
 
-    def _fits_block(self, o: OrdTxn, vote: bool, writable: set) -> bool:
+    def _fits_block(
+        self,
+        o: OrdTxn,
+        vote: bool,
+        writable: set,
+        mb_cost: int,
+        mb_vote_cost: int,
+        mb_data: int,
+        mb_write_cost: dict[bytes, int],
+    ) -> bool:
+        """Limit checks including cost already chosen *within* the current
+        microblock (mb_*) — the reference decrements its running cu/byte
+        limits inside the scheduling loop (fd_pack.c:1134), so limits bind
+        per selection, not merely per committed microblock."""
         lim = self.limits
-        if self.cost_used + o.cost.total > lim.max_cost_per_block:
+        if self.cost_used + mb_cost + o.cost.total > lim.max_cost_per_block:
             return False
-        if vote and self.vote_cost_used + o.cost.total > lim.max_vote_cost_per_block:
+        if vote and (
+            self.vote_cost_used + mb_vote_cost + o.cost.total
+            > lim.max_vote_cost_per_block
+        ):
             return False
         sz = len(o.payload)
-        if self.data_bytes_used + sz + fc.MICROBLOCK_DATA_OVERHEAD > lim.max_data_bytes_per_block:
+        if (
+            self.data_bytes_used + mb_data + sz + fc.MICROBLOCK_DATA_OVERHEAD
+            > lim.max_data_bytes_per_block
+        ):
             return False
         for a in writable:
-            if self._write_cost.get(a, 0) + o.cost.total > lim.max_write_cost_per_acct:
+            if (
+                self._write_cost.get(a, 0)
+                + mb_write_cost.get(a, 0)
+                + o.cost.total
+                > lim.max_write_cost_per_acct
+            ):
                 return False
         return True
 
@@ -197,25 +240,37 @@ class Pack:
         taken_w: set[bytes] = set()
         taken_r: set[bytes] = set()
         skipped: list[OrdTxn] = []
+        mb_cost = 0
+        mb_vote_cost = 0
+        mb_data = 0
+        mb_write_cost: dict[bytes, int] = {}
         while pool and len(chosen) < self.max_txn_per_microblock:
             o = pool[0]
-            w, r = o.accounts()
+            sw, lr, lw = o.acct_sets()
             # conflicts within this microblock too: serial execution inside
             # a microblock is NOT a thing — the bank executes it as one
             # conflict-free parallel burst.
             if (
-                self._conflicts(bank, w, r)
-                or (w & (taken_w | taken_r))
-                or (r & taken_w)
-                or not self._fits_block(o, votes, w)
+                self._conflicts(bank, lw, lr)
+                or (lw & (taken_w | taken_r))
+                or (lr & taken_w)
+                or not self._fits_block(
+                    o, votes, sw, mb_cost, mb_vote_cost, mb_data, mb_write_cost
+                )
             ):
                 skipped.append(pool.pop(0))
                 continue
             pool.pop(0)
             self._sigs.discard(o.first_sig())
             chosen.append(o)
-            taken_w |= w
-            taken_r |= r
+            taken_w |= lw
+            taken_r |= lr
+            mb_cost += o.cost.total
+            if votes:
+                mb_vote_cost += o.cost.total
+            mb_data += len(o.payload)
+            for a in sw:
+                mb_write_cost[a] = mb_write_cost.get(a, 0) + o.cost.total
         # skipped txns go back in order
         for o in skipped:
             bisect.insort(pool, o, key=OrdTxn.sort_key)
@@ -224,14 +279,15 @@ class Pack:
             return []
         # commit locks + block accounting
         for o in chosen:
-            w, r = o.accounts()
-            for a in w:
+            sw, lr, lw = o.acct_sets()
+            for a in lw:
                 self._in_use.setdefault(a, [0, 0])[0] |= 1 << bank
                 self._bank_accts[bank].append((a, True))
-                self._write_cost[a] = self._write_cost.get(a, 0) + o.cost.total
-            for a in r:
+            for a in lr:
                 self._in_use.setdefault(a, [0, 0])[1] |= 1 << bank
                 self._bank_accts[bank].append((a, False))
+            for a in sw:
+                self._write_cost[a] = self._write_cost.get(a, 0) + o.cost.total
             self.cost_used += o.cost.total
             if votes:
                 self.vote_cost_used += o.cost.total

@@ -100,13 +100,22 @@ class VerifyStage(Stage):
         if self.tcache.insert(sig_tag(sigs[0])):
             self.metrics.inc("dedup_dup")
             return
-        if not self._cur_elems:
-            self._opened_at = time.monotonic()
-        start = len(self._cur_elems)
         msg = t.message(payload)
         if len(msg) > self.max_msg_len:
             self.metrics.inc("msg_too_long")
             return
+        # a txn's elements must land in ONE device batch (the txn-level
+        # pass-iff-all-pass rule is evaluated per batch): drop txns that can
+        # never fit, and close the current batch first if this txn would
+        # straddle the fixed batch shape.
+        if t.signature_cnt > self.batch:
+            self.metrics.inc("too_many_sigs")
+            return
+        if self._cur_elems and len(self._cur_elems) + t.signature_cnt > self.batch:
+            self._close_batch()
+        if not self._cur_elems:
+            self._opened_at = time.monotonic()
+        start = len(self._cur_elems)
         for s, pk in zip(sigs, t.signers(payload)):
             self._cur_elems.append((msg, s, pk))
         self._cur_ranges.append((start, len(self._cur_elems)))

@@ -56,6 +56,13 @@ class MCache:
     NCOL = 7
     COL_SEQ, COL_SIG, COL_CHUNK, COL_SZ, COL_CTL, COL_TSORIG, COL_TSPUB = range(7)
 
+    # Reserved "row being overwritten" bit in the stored seq word.  No
+    # consumer ever polls a seq with this bit set (seqs are < 2^63 for the
+    # lifetime of any real deployment), so a busy row can never satisfy a
+    # reader's d==0 match — closing the ABA window where the previous lap's
+    # frag at this line (seq - depth) could be consumed torn.
+    BUSY = 1 << 63
+
     def __init__(self, depth: int, buf: np.ndarray | None = None):
         if depth & (depth - 1) or depth <= 0:
             raise ValueError("depth must be a power of 2")
@@ -65,10 +72,10 @@ class MCache:
         self.table = buf.reshape(depth, self.NCOL)
         if not self.table.flags.writeable:
             raise ValueError("mcache buffer must be writable")
-        # Initialize each line to "ancient" seq = line - depth (mod 2^64) so
-        # consumers starting at seq 0 see negative diff (not yet published).
+        # Initialize each line as busy-at-its-own-first-seq: a consumer
+        # polling seq k (any lap) sees "not yet published".
         for line in range(depth):
-            self.table[line, self.COL_SEQ] = (line - depth) & _MASK64
+            self.table[line, self.COL_SEQ] = self.BUSY | line
 
     @classmethod
     def footprint(cls, depth: int) -> int:
@@ -88,9 +95,10 @@ class MCache:
         tspub: int = 0,
     ) -> None:
         row = self.table[self.line(seq)]
-        # Mark line in-progress with an "ancient" seq so concurrent readers
-        # can't mistake a half-written row for frag `seq`.
-        row[self.COL_SEQ] = (int(seq) - self.depth) & _MASK64
+        # Mark line in-progress with the BUSY bit set: a value no consumer
+        # can match (they poll seqs < 2^63), unlike the previous lap's seq
+        # (seq - depth) which a lagging consumer could legitimately poll.
+        row[self.COL_SEQ] = self.BUSY | (int(seq) & _MASK64)
         row[self.COL_SIG] = int(sig) & _MASK64
         row[self.COL_CHUNK] = int(chunk) & _MASK64
         row[self.COL_SZ] = int(sz) & _MASK64
@@ -107,6 +115,12 @@ class MCache:
         """
         row = self.table[self.line(seq)]
         mseq = int(row[self.COL_SEQ])
+        if mseq & self.BUSY:
+            # Row is mid-overwrite with frag `mseq & ~BUSY`: if that frag is
+            # newer than what we want, ours is gone (overrun); otherwise
+            # (it IS ours, still being written) not yet published.
+            d = seq_diff(mseq & ~self.BUSY, seq)
+            return (1, None) if d > 0 else (-1, None)
         d = seq_diff(mseq, seq)
         if d == 0:
             meta = row.copy()

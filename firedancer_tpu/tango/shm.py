@@ -49,7 +49,9 @@ class ShmLink:
         self.mcache.table = np.frombuffer(buf, dtype=rings.U64, offset=a, count=depth * rings.MCache.NCOL).reshape(depth, rings.MCache.NCOL)
         if owner:
             for line in range(depth):
-                self.mcache.table[line, rings.MCache.COL_SEQ] = (line - depth) & ((1 << 64) - 1)
+                self.mcache.table[line, rings.MCache.COL_SEQ] = (
+                    rings.MCache.BUSY | line
+                )
         self.dcache = rings.DCache(mtu, depth, buf=np.frombuffer(buf, dtype=np.uint8, offset=b, count=rings.DCache.footprint(mtu, depth)))
         self.fseqs = [
             rings.Fseq(np.frombuffer(buf, dtype=rings.U64, offset=c + 8 * i, count=1))
@@ -147,7 +149,7 @@ class Consumer:
                 self.link.mcache.table[
                     self.link.mcache.line(self.seq), rings.MCache.COL_SEQ
                 ]
-            )
+            ) & ~rings.MCache.BUSY
             skipped = rings.seq_diff(line_seq, self.seq)
             self.ovrn_cnt += max(skipped, 1)
             self.seq = line_seq  # resync at the overwriting frag
