@@ -8,11 +8,21 @@ accelerator backend: the wiredancer FPGA at 1.0 M verify/s
 
 Prints exactly one JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Robustness (round-1 postmortem: BENCH_r01 recorded rc=1, no number): the
+TPU tunnel ("axon" PJRT plugin) can be flaky, and a bare jax.devices() can
+hang forever or raise.  Device discovery therefore happens in a *subprocess*
+with a hard timeout and bounded retries; if the tunnel never comes up the
+bench re-runs itself on the CPU backend so a numeric value is always
+recorded (clearly marked "backend": "cpu" — the TPU number is the one that
+counts against the target).
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 
@@ -23,16 +33,69 @@ BATCH = 4096
 MAX_MSG_LEN = 128
 STEADY_ROUNDS = 8
 INFLIGHT = 4
+PROBE_TIMEOUT_S = 120
+PROBE_RETRIES = 3
+PROBE_WAIT_S = 15
 
 
-def main() -> None:
-    if "--cpu" in sys.argv:
-        # Smoke-test mode: logic check without the TPU tunnel.
+def probe_backend() -> bool:
+    """True if a real accelerator backend initializes in a subprocess.
+
+    A hung tunnel blocks jax.devices() forever inside *that* subprocess; the
+    parent enforces the timeout and retries, keeping this process clean for
+    the CPU fallback.  A probe that comes back as the CPU platform counts as
+    a failure too: jax silently falls back to CPU when the plugin raises
+    fast, and that must trigger the retry path, not record a fake
+    "accelerator" run.
+    """
+    code = (
+        "import jax; d = jax.devices();"
+        "print(d[0].platform, d[0].device_kind)"
+    )
+    for attempt in range(1, PROBE_RETRIES + 1):
+        t0 = time.time()
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", code],
+                timeout=PROBE_TIMEOUT_S,
+                capture_output=True,
+                text=True,
+            )
+            platform = out.stdout.split()[0] if out.stdout.strip() else "?"
+            if out.returncode == 0 and platform not in ("cpu", "?"):
+                print(f"# probe ok ({time.time()-t0:.1f}s): {out.stdout.strip()}",
+                      file=sys.stderr)
+                return True
+            err_tail = (
+                out.stderr.strip().splitlines()[-1] if out.stderr.strip() else "?"
+            )
+            print(
+                f"# probe attempt {attempt} rc={out.returncode} "
+                f"platform={platform}: {err_tail}",
+                file=sys.stderr,
+            )
+        except subprocess.TimeoutExpired:
+            print(
+                f"# probe attempt {attempt} timed out after {PROBE_TIMEOUT_S}s "
+                "(tunnel hung)",
+                file=sys.stderr,
+            )
+        if attempt < PROBE_RETRIES:
+            time.sleep(PROBE_WAIT_S)
+    return False
+
+
+def run_bench(backend: str) -> None:
+    from firedancer_tpu.utils.platform import enable_compile_cache
+
+    if backend == "cpu":
         from firedancer_tpu.utils.platform import force_cpu_backend
 
         force_cpu_backend()
     import jax
     import jax.numpy as jnp
+
+    enable_compile_cache()
 
     from firedancer_tpu.ops import sigverify as sv
     import __graft_entry__ as ge
@@ -61,24 +124,31 @@ def main() -> None:
 
     # Steady state: keep INFLIGHT batches in flight, block only at the end —
     # the async-offload shape the wiredancer path uses (requests pushed, the
-    # results ring drained later).
-    lat = []
+    # results ring drained later).  Per-batch completion latency is measured
+    # in a second, serialized pass.
     outs = []
     t0 = time.time()
     for r in range(STEADY_ROUNDS):
-        t1 = time.time()
         outs.append(step(args))
         if len(outs) >= INFLIGHT:
             outs.pop(0).block_until_ready()
-        lat.append(time.time() - t1)
     for o in outs:
         o.block_until_ready()
     elapsed = time.time() - t0
     total = BATCH * STEADY_ROUNDS
     rate = total / elapsed
+
+    lat = []
+    for _ in range(STEADY_ROUNDS):
+        t1 = time.time()
+        step(args).block_until_ready()
+        lat.append(time.time() - t1)
+    lat_ms = np.array(sorted(lat)) * 1e3
+    p50 = lat_ms[len(lat_ms) // 2]
+    p99 = lat_ms[min(int(len(lat_ms) * 0.99), len(lat_ms) - 1)]
     print(
-        f"# steady: {total} sigs in {elapsed:.3f}s, "
-        f"mean dispatch {np.mean(lat)*1e3:.2f}ms",
+        f"# steady: {total} sigs in {elapsed:.3f}s; batch latency "
+        f"p50={p50:.2f}ms p99={p99:.2f}ms (batch={BATCH})",
         file=sys.stderr,
     )
     print(
@@ -88,9 +158,25 @@ def main() -> None:
                 "value": round(rate, 1),
                 "unit": "verify/s",
                 "vs_baseline": round(rate / BASELINE_VERIFY_PER_S, 4),
+                "backend": dev.platform,
+                "batch_latency_p99_ms": round(float(p99), 3),
             }
         )
     )
+
+
+def main() -> None:
+    if "--cpu" in sys.argv:
+        run_bench("cpu")
+        return
+    if probe_backend():
+        run_bench("accel")
+    else:
+        print(
+            "# TPU tunnel unavailable after retries -> CPU fallback number",
+            file=sys.stderr,
+        )
+        run_bench("cpu")
 
 
 if __name__ == "__main__":

@@ -45,7 +45,17 @@ def force_cpu_backend(device_count: int | None = None) -> None:
         pass
 
 
-def enable_compile_cache(path: str, min_compile_secs: float = 1.0) -> None:
+def default_cache_dir() -> str:
+    """The repo-wide persistent compile-cache dir (single source of truth:
+    bench.py, __graft_entry__.py and tests/conftest.py all share one cache,
+    so no path drift can silently split it)."""
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+        ".jax_cache",
+    )
+
+
+def enable_compile_cache(path: str | None = None, min_compile_secs: float = 1.0) -> None:
     """Enable jax's persistent compilation cache at ``path``.
 
     Env vars are not enough on this image: sitecustomize imports jax at
@@ -54,5 +64,5 @@ def enable_compile_cache(path: str, min_compile_secs: float = 1.0) -> None:
     """
     import jax
 
-    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_compilation_cache_dir", path or default_cache_dir())
     jax.config.update("jax_persistent_cache_min_compile_time_secs", min_compile_secs)

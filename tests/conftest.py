@@ -6,14 +6,10 @@ force_cpu_backend must run before any jax device use; enable_compile_cache
 makes the 10-60s curve/sigverify compiles persistent across test runs.
 """
 
-import os
-
 from firedancer_tpu.utils import platform as fd_platform
 
 fd_platform.force_cpu_backend(device_count=8)
-fd_platform.enable_compile_cache(
-    os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
-)
+fd_platform.enable_compile_cache()
 
 import numpy as np
 import pytest
