@@ -1,0 +1,78 @@
+"""GF(2^8) linear maps as MXU matmuls — the TPU Reed-Solomon arithmetic.
+
+The insight (shared with the reference's GFNI backend,
+/root/reference/src/ballet/reedsol/fd_reedsol_arith_gfni.h, which feeds
+8x8 bit matrices to vgf2p8affineqb): multiplication by a *constant* in
+GF(2^8) is linear over GF(2), so a whole GF matrix A (p x d) lifts to a
+bit-block matrix B (8p x 8d) over GF(2), and
+
+    parity = A @gf data   ==   pack( (B @ unpack(data)) mod 2 )
+
+i.e. one integer matmul + parity reduction.  On TPU that matmul is exactly
+MXU-shaped: B is at most 536 x 536 (d, p <= 67), data unpacks to
+(8d, shred_sz * n_sets) int8 — large, batched, static shapes.  XOR
+accumulation becomes integer accumulation followed by mod 2 (safe: counts
+<= 8*67 = 536 << 2^31).
+
+Host-side code (matrix construction, inversion for recovery) lives in
+ops/ref/gf256_ref.py; this module only ships bits to the MXU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .ref import gf256_ref as gr
+
+
+def gf_matrix_to_bits(a: np.ndarray) -> np.ndarray:
+    """Lift a GF(2^8) matrix (m, k) to its GF(2) block matrix (8m, 8k).
+
+    Block (r, c) is the 8x8 bit matrix of multiplication by a[r, c]:
+    column j holds the bits of a[r,c] * x^j (LSB-first rows).
+    """
+    a = np.asarray(a, dtype=np.uint8)
+    m, k = a.shape
+    # cols[r, c, j] = a[r,c] * x^j  (uint8)
+    xj = (1 << np.arange(8, dtype=np.int32)).astype(np.uint8)
+    cols = gr.gf_mul(a[:, :, None], xj[None, None, :]).astype(np.uint8)
+    # bits[r, c, i, j] = bit i of cols[r, c, j]
+    bits = (cols[:, :, None, :] >> np.arange(8, dtype=np.uint8)[None, None, :, None]) & 1
+    # assemble (8m, 8k): rows = (r, i), cols = (c, j)
+    return bits.transpose(0, 2, 1, 3).reshape(8 * m, 8 * k).astype(np.int8)
+
+
+def unpack_bits(data: jnp.ndarray) -> jnp.ndarray:
+    """(k, ...) uint8/int32 bytes -> (8k, ...) int8 bits, LSB-first."""
+    d = data.astype(jnp.int32)
+    bits = (d[:, None] >> jnp.arange(8, dtype=jnp.int32).reshape((1, 8) + (1,) * (d.ndim - 1))) & 1
+    return bits.reshape((8 * data.shape[0],) + data.shape[1:]).astype(jnp.int8)
+
+
+def pack_bits(bits: jnp.ndarray) -> jnp.ndarray:
+    """(8m, ...) bits -> (m, ...) uint8 bytes, LSB-first."""
+    b = bits.astype(jnp.int32).reshape((bits.shape[0] // 8, 8) + bits.shape[1:])
+    w = (1 << jnp.arange(8, dtype=jnp.int32)).reshape((1, 8) + (1,) * (bits.ndim - 1))
+    return jnp.sum(b * w, axis=1).astype(jnp.uint8)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def _gf2_matmul_bits(b_bits: jnp.ndarray, data_bits: jnp.ndarray) -> jnp.ndarray:
+    """(8m, 8k) x (8k, S) -> (8m, S) over GF(2): int matmul then mod 2."""
+    acc = jax.lax.dot_general(
+        b_bits.astype(jnp.int8),
+        data_bits.astype(jnp.int8),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    return (acc & 1).astype(jnp.int8)
+
+
+def gf_apply(a_gf: np.ndarray, data: jnp.ndarray) -> jnp.ndarray:
+    """Apply a host GF matrix (m, k) to device data (k, S) -> (m, S) uint8."""
+    b_bits = jnp.asarray(gf_matrix_to_bits(a_gf))
+    return pack_bits(_gf2_matmul_bits(b_bits, unpack_bits(data)))
