@@ -1,0 +1,164 @@
+"""Binary merkle tree over SHA-256 with 20-byte nodes (the bmtree layer).
+
+Capability parity with /root/reference/src/ballet/bmtree/fd_bmtree.c
+(fd_bmtree_hash_leaf, fd_bmtree_commit_*, proof get/verify) for the shred
+merkle trees: leaves are sha256 in the LEAF domain, branch nodes are
+sha256(NODE_PREFIX || left20 || right20) truncated to 20 bytes, an odd
+trailing node pairs with itself, and proofs list the 20-byte sibling per
+level bottom-up.  The domain-separation prefixes and 20-byte truncation are
+protocol constants (Solana merkle-tree spec).
+
+TPU-native twist: the reference hashes one tree at a time with a 16-way
+sha256 batch; here every *layer* is one batched sha256_msg dispatch with the
+lane dimension spanning all pairs of all trees in flight (`root_batch`) —
+FEC sets arrive in batches, so the hash batch is (pairs x sets), far wider
+than 16.  The host path (hashlib) is the differential ground truth and the
+small-tree fast path.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+LEAF_PREFIX = b"\x00SOLANA_MERKLE_SHREDS_LEAF"
+NODE_PREFIX = b"\x01SOLANA_MERKLE_SHREDS_NODE"
+NODE_SZ = 20
+
+
+def hash_leaf(data: bytes) -> bytes:
+    """sha256(leaf-domain prefix || data), truncated to 20 bytes."""
+    return hashlib.sha256(LEAF_PREFIX + data).digest()[:NODE_SZ]
+
+
+def _merge(a: bytes, b: bytes) -> bytes:
+    return hashlib.sha256(NODE_PREFIX + a[:NODE_SZ] + b[:NODE_SZ]).digest()[:NODE_SZ]
+
+
+def depth(leaf_cnt: int) -> int:
+    """Layers including the root (fd_bmtree_depth): 1 leaf -> 1."""
+    if leaf_cnt <= 1:
+        return leaf_cnt
+    d = 1
+    while (1 << (d - 1)) < leaf_cnt:
+        d += 1
+    return d
+
+
+def tree_layers(leaves: list[bytes]) -> list[list[bytes]]:
+    """All layers bottom-up; layer[0] = leaves, layer[-1] = [root]."""
+    if not leaves:
+        raise ValueError("empty tree")
+    layers = [[x[:NODE_SZ] for x in leaves]]
+    while len(layers[-1]) > 1:
+        cur = layers[-1]
+        nxt = []
+        for i in range(0, len(cur), 2):
+            a = cur[i]
+            b = cur[i + 1] if i + 1 < len(cur) else cur[i]  # odd: self-pair
+            nxt.append(_merge(a, b))
+        layers.append(nxt)
+    return layers
+
+
+def root(leaves: list[bytes]) -> bytes:
+    return tree_layers(leaves)[-1][0]
+
+
+def get_proof(layers: list[list[bytes]], leaf_idx: int) -> list[bytes]:
+    """Sibling per non-root level, bottom-up (fd_bmtree_get_proof)."""
+    proof = []
+    idx = leaf_idx
+    for layer in layers[:-1]:
+        sib = idx ^ 1
+        proof.append(layer[sib] if sib < len(layer) else layer[idx])
+        idx >>= 1
+    return proof
+
+
+def verify_proof(leaf: bytes, leaf_idx: int, proof: list[bytes]) -> bytes:
+    """Root implied by (leaf, proof) — caller compares/signature-checks it
+    (fd_bmtree_from_proof's derive-then-compare shape)."""
+    node = leaf[:NODE_SZ]
+    idx = leaf_idx
+    for sib in proof:
+        node = _merge(sib, node) if idx & 1 else _merge(node, sib)
+        idx >>= 1
+    return node
+
+
+# -- batched device path ------------------------------------------------------
+
+
+def hash_leaves_batch(data: np.ndarray) -> np.ndarray:
+    """Leaf-hash B equal-length blobs on device: (sz, B) bytes -> (20, B).
+
+    One fixed-shape sha256_msg dispatch; B spans every shred of every FEC
+    set in flight.
+    """
+    import jax.numpy as jnp
+
+    from . import sha256 as fsha
+
+    sz, bsz = data.shape
+    prefix = np.frombuffer(LEAF_PREFIX, dtype=np.uint8).astype(np.int32)
+    msg = jnp.concatenate(
+        [
+            jnp.broadcast_to(jnp.asarray(prefix)[:, None], (len(prefix), bsz)),
+            jnp.asarray(data, dtype=jnp.int32),
+        ],
+        axis=0,
+    )
+    ln = jnp.full((bsz,), len(prefix) + sz, dtype=jnp.int32)
+    return fsha.sha256_msg(msg, ln, max_len=len(prefix) + sz)[:NODE_SZ]
+
+
+def _merge_layer(nodes):
+    """(2k or 2k-1, 20, T) device nodes -> (k, 20, T) parent nodes."""
+    import jax.numpy as jnp
+
+    from . import sha256 as fsha
+
+    n, _, t = nodes.shape
+    if n % 2:  # odd trailing node pairs with itself
+        nodes = jnp.concatenate([nodes, nodes[-1:]], axis=0)
+        n += 1
+    k = n // 2
+    prefix = np.frombuffer(NODE_PREFIX, dtype=np.uint8).astype(np.int32)
+    pairs = nodes.reshape(k, 2 * NODE_SZ, t)  # left||right byte rows
+    msg = jnp.concatenate(
+        [
+            jnp.broadcast_to(
+                jnp.asarray(prefix)[None, :, None], (k, len(prefix), t)
+            ),
+            pairs.astype(jnp.int32),
+        ],
+        axis=1,
+    )
+    total = len(prefix) + 2 * NODE_SZ
+    flat = msg.transpose(1, 0, 2).reshape(total, k * t)
+    ln = jnp.full((k * t,), total, dtype=jnp.int32)
+    out = fsha.sha256_msg(flat, ln, max_len=total)[:NODE_SZ]
+    return out.reshape(NODE_SZ, k, t).transpose(1, 0, 2)
+
+
+def layers_batch(leaves: np.ndarray) -> list:
+    """Batched trees: (n_leaves, 20, T) -> list of device layers bottom-up.
+
+    T trees with identical leaf counts (FEC sets of the same shape) advance
+    together; each level is one sha256 dispatch over (pairs x T) lanes.
+    """
+    import jax.numpy as jnp
+
+    cur = jnp.asarray(leaves, dtype=jnp.int32)
+    layers = [cur]
+    while cur.shape[0] > 1:
+        cur = _merge_layer(cur)
+        layers.append(cur)
+    return layers
+
+
+def root_batch(leaves: np.ndarray) -> np.ndarray:
+    """(n_leaves, 20, T) -> (20, T) roots."""
+    return layers_batch(leaves)[-1][0]

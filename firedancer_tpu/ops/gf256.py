@@ -76,3 +76,20 @@ def gf_apply(a_gf: np.ndarray, data: jnp.ndarray) -> jnp.ndarray:
     """Apply a host GF matrix (m, k) to device data (k, S) -> (m, S) uint8."""
     b_bits = jnp.asarray(gf_matrix_to_bits(a_gf))
     return pack_bits(_gf2_matmul_bits(b_bits, unpack_bits(data)))
+
+
+@functools.partial(jax.jit, static_argnames=())
+def _gf2_bmm_bits(b_bits: jnp.ndarray, data_bits: jnp.ndarray) -> jnp.ndarray:
+    """Batched GF(2) matmul: (T, 8m, 8k) x (T, 8k, S) -> (T, 8m, S).
+
+    One MXU batch-matmul applies T *different* linear maps at once — the
+    shape of batched RS recovery, where each FEC set's erasure pattern
+    yields its own rebuild matrix.
+    """
+    acc = jax.lax.dot_general(
+        b_bits.astype(jnp.int8),
+        data_bits.astype(jnp.int8),
+        (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.int32,
+    )
+    return (acc & 1).astype(jnp.int8)

@@ -40,9 +40,15 @@ def _encode_bits(d: int, p: int):
     return jnp.asarray(g2.gf_matrix_to_bits(g[d:]))
 
 
-@functools.lru_cache(maxsize=None)
+@functools.lru_cache(maxsize=512)
 def _recover_bits(d: int, n: int, present_key: tuple):
-    """Cached bit-block matrix rebuilding ALL n shreds from d survivors."""
+    """Cached bit-block matrix rebuilding ALL n shreds from d survivors.
+
+    Bounded: erasure patterns are attacker-influenced (which shreds arrive
+    is network-controlled), so an unbounded cache keyed on the pattern is a
+    memory-growth vector; 512 entries cover the bursty-loss reuse that
+    makes caching worthwhile and cap the damage of adversarial patterns.
+    """
     present_idx = np.flatnonzero(np.array(present_key, dtype=bool))[:d]
     g = gr.generator_matrix(d, n)
     sub_inv = gr.gf_mat_inv(g[present_idx])
@@ -92,3 +98,46 @@ def recover(shreds, present, d: int):
     ):
         return ERR_CORRUPT, None
     return SUCCESS, out
+
+
+def recover_batch(shreds, present, d: int):
+    """Batched recover over T same-shape FEC sets in ONE device dispatch.
+
+    shreds:  (T, n, sz) uint8 — garbage rows where present is False
+    present: (T, n) bool — may differ per set (each loss pattern lifts to
+             its own rebuild matrix; the batched GF(2) bmm applies all T
+             at once, the streaming shape of fd_fec_resolver.c)
+    Returns (statuses, rebuilt): statuses (T,) int with the per-set
+    SUCCESS/ERR_PARTIAL/ERR_CORRUPT contract of recover(); rebuilt
+    (T, n, sz) uint8, valid only where statuses == SUCCESS.
+    """
+    shreds_np = np.asarray(shreds, dtype=np.uint8)
+    present = np.asarray(present, dtype=bool)
+    t, n, sz = shreds_np.shape
+    statuses = np.full((t,), SUCCESS, dtype=np.int32)
+    mats = np.zeros((t, 8 * n, 8 * d), dtype=np.int8)
+    surv = np.zeros((t, d, sz), dtype=np.uint8)
+    extras: list[np.ndarray] = []
+    for k in range(t):
+        if int(present[k].sum()) < d:
+            statuses[k] = ERR_PARTIAL
+            extras.append(np.empty(0, dtype=np.int64))
+            continue
+        bbits, present_idx = _recover_bits(d, n, tuple(bool(x) for x in present[k]))
+        mats[k] = np.asarray(bbits)
+        surv[k] = shreds_np[k, present_idx]
+        extras.append(np.flatnonzero(present[k])[d:])
+    data_bits = g2.unpack_bits(
+        jnp.asarray(surv).transpose(1, 0, 2)
+    ).transpose(1, 0, 2)  # (T, 8d, sz)
+    out_bits = g2._gf2_bmm_bits(jnp.asarray(mats), data_bits)  # (T, 8n, sz)
+    out = np.asarray(
+        g2.pack_bits(out_bits.transpose(1, 0, 2)).transpose(1, 0, 2)
+    )  # (T, n, sz)
+    for k in range(t):
+        if statuses[k] != SUCCESS:
+            continue
+        ex = extras[k]
+        if len(ex) and not np.array_equal(out[k, ex], shreds_np[k, ex]):
+            statuses[k] = ERR_CORRUPT
+    return statuses, out

@@ -353,6 +353,154 @@ def txn_parse(payload: bytes) -> Txn | None:
     )
 
 
+# -- packed binary descriptor (fd_txn_t's wire-able analog) ------------------
+#
+# The parsed descriptor rides behind the payload in every post-verify frag
+# (the parsed-txn trailer convention, fd_disco_base.h:33-45 / fd_verify.c:
+# 93-100), so it needs a fixed binary layout — not pickle — to be a wire
+# format the native runtime can read.  All offsets fit u16 (payload <= 1232).
+#
+# Layout, little-endian, byte-packed:
+#   header (17 B): version u8, sig_cnt u8, sig_off u16, msg_off u16,
+#     ro_signed u8, ro_unsigned u8, acct_cnt u8, acct_off u16, bh_off u16,
+#     lut_cnt u8, adtl_writable u8, adtl_cnt u8, instr_cnt u8
+#   per instr (9 B):  program_id u8, acct_cnt u16, data_sz u16,
+#                     acct_off u16, data_off u16
+#   per lut  (10 B):  addr_off u16, writable_cnt u16, readonly_cnt u16,
+#                     writable_off u16, readonly_off u16
+
+import struct
+
+_DESC_HDR = struct.Struct("<BBHHBBBHHBBBB")
+_DESC_INSTR = struct.Struct("<BHHHH")
+_DESC_LUT = struct.Struct("<HHHHH")
+
+
+def txn_pack(t: Txn) -> bytes:
+    """Serialize a descriptor to its packed binary form."""
+    out = bytearray(
+        _DESC_HDR.pack(
+            t.transaction_version,
+            t.signature_cnt,
+            t.signature_off,
+            t.message_off,
+            t.readonly_signed_cnt,
+            t.readonly_unsigned_cnt,
+            t.acct_addr_cnt,
+            t.acct_addr_off,
+            t.recent_blockhash_off,
+            t.addr_table_lookup_cnt,
+            t.addr_table_adtl_writable_cnt,
+            t.addr_table_adtl_cnt,
+            len(t.instrs),
+        )
+    )
+    for ins in t.instrs:
+        out += _DESC_INSTR.pack(
+            ins.program_id, ins.acct_cnt, ins.data_sz, ins.acct_off, ins.data_off
+        )
+    for lut in t.addr_luts:
+        out += _DESC_LUT.pack(
+            lut.addr_off,
+            lut.writable_cnt,
+            lut.readonly_cnt,
+            lut.writable_off,
+            lut.readonly_off,
+        )
+    return bytes(out)
+
+
+def txn_packed_sz(instr_cnt: int, lut_cnt: int) -> int:
+    return _DESC_HDR.size + _DESC_INSTR.size * instr_cnt + _DESC_LUT.size * lut_cnt
+
+
+def txn_unpack(buf: bytes, off: int = 0) -> tuple[Txn, int]:
+    """Deserialize a packed descriptor at buf[off:]; returns (Txn, end)."""
+    (
+        version,
+        sig_cnt,
+        sig_off,
+        msg_off,
+        ro_signed,
+        ro_unsigned,
+        acct_cnt,
+        acct_off,
+        bh_off,
+        lut_cnt,
+        adtl_writable,
+        adtl_cnt,
+        instr_cnt,
+    ) = _DESC_HDR.unpack_from(buf, off)
+    i = off + _DESC_HDR.size
+    instrs = []
+    for _ in range(instr_cnt):
+        instrs.append(TxnInstr(*_DESC_INSTR.unpack_from(buf, i)))
+        i += _DESC_INSTR.size
+    luts = []
+    for _ in range(lut_cnt):
+        luts.append(TxnAddrLut(*_DESC_LUT.unpack_from(buf, i)))
+        i += _DESC_LUT.size
+    return (
+        Txn(
+            transaction_version=version,
+            signature_cnt=sig_cnt,
+            signature_off=sig_off,
+            message_off=msg_off,
+            readonly_signed_cnt=ro_signed,
+            readonly_unsigned_cnt=ro_unsigned,
+            acct_addr_cnt=acct_cnt,
+            acct_addr_off=acct_off,
+            recent_blockhash_off=bh_off,
+            addr_table_lookup_cnt=lut_cnt,
+            addr_table_adtl_writable_cnt=adtl_writable,
+            addr_table_adtl_cnt=adtl_cnt,
+            instrs=tuple(instrs),
+            addr_luts=tuple(luts),
+        ),
+        i,
+    )
+
+
+def txn_desc_valid(t: Txn, payload_sz: int) -> bool:
+    """Cheap structural validation of an *untrusted* unpacked descriptor:
+    every count within protocol bounds and every offset range inside the
+    payload — the invariants txn_parse guarantees for descriptors it built.
+    A trailer that crossed a trust boundary must pass this before its
+    accessors are used (slicing would silently truncate, not raise)."""
+    if not 1 <= t.signature_cnt <= SIG_MAX:
+        return False
+    if not (t.signature_cnt <= t.acct_addr_cnt <= ACCT_ADDR_MAX):
+        return False
+    if t.readonly_signed_cnt >= t.signature_cnt:
+        return False
+    if t.signature_cnt + t.readonly_unsigned_cnt > t.acct_addr_cnt:
+        return False
+    if len(t.instrs) > INSTR_MAX or len(t.addr_luts) > ADDR_TABLE_LOOKUP_MAX:
+        return False
+    if t.addr_table_lookup_cnt != len(t.addr_luts):
+        return False
+    if t.acct_addr_cnt + t.addr_table_adtl_cnt > ACCT_ADDR_MAX:
+        return False
+    if t.addr_table_adtl_writable_cnt > t.addr_table_adtl_cnt:
+        return False
+    spans = [
+        (t.signature_off, SIGNATURE_SZ * t.signature_cnt),
+        (t.message_off, 1),
+        (t.acct_addr_off, ACCT_ADDR_SZ * t.acct_addr_cnt),
+        (t.recent_blockhash_off, BLOCKHASH_SZ),
+    ]
+    for ins in t.instrs:
+        spans.append((ins.acct_off, ins.acct_cnt))
+        spans.append((ins.data_off, ins.data_sz))
+        if not 0 < ins.program_id < t.acct_addr_cnt:
+            return False
+    for lut in t.addr_luts:
+        spans.append((lut.addr_off, ACCT_ADDR_SZ))
+        spans.append((lut.writable_off, lut.writable_cnt))
+        spans.append((lut.readonly_off, lut.readonly_cnt))
+    return all(0 <= off and off + sz <= payload_sz for off, sz in spans)
+
+
 # -- builder (fd_txn_generate analog, for tests and the synthetic load) ------
 
 

@@ -28,7 +28,6 @@ without the retry).
 
 from __future__ import annotations
 
-import pickle
 import time
 from dataclasses import dataclass
 
@@ -213,19 +212,23 @@ class VerifyStage(Stage):
 
 
 def encode_verified(payload: bytes, desc: ft.Txn) -> bytes:
-    """payload || parsed-descriptor trailer || u16 payload_sz.
+    """payload || packed-descriptor trailer || u16 payload_sz.
 
     The parsed-txn trailer convention (fd_disco_base.h:33-45): downstream
     stages get payload + descriptor in one frag and never reparse.  The
-    descriptor is pickled (host-side convenience; the C++ runtime will use a
-    packed struct).
+    descriptor uses the packed fixed-offset binary layout (txn.txn_pack) —
+    a real wire format, safe across trust/process boundaries and readable
+    by the native runtime.
     """
-    desc_b = pickle.dumps(desc, protocol=pickle.HIGHEST_PROTOCOL)
-    return payload + desc_b + len(payload).to_bytes(2, "little")
+    return payload + ft.txn_pack(desc) + len(payload).to_bytes(2, "little")
 
 
 def decode_verified(frag: bytes) -> tuple[bytes, ft.Txn]:
     payload_sz = int.from_bytes(frag[-2:], "little")
     payload = frag[:payload_sz]
-    desc = pickle.loads(frag[payload_sz:-2])
+    desc, end = ft.txn_unpack(frag, payload_sz)
+    if end != len(frag) - 2:
+        raise ValueError("verified-frag trailer size mismatch")
+    if not ft.txn_desc_valid(desc, payload_sz):
+        raise ValueError("verified-frag descriptor fails validation")
     return payload, desc

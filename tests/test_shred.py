@@ -1,0 +1,354 @@
+"""Shred pipeline tests: bmtree merkle, shred wire format, shredder ->
+FEC sets, FEC resolver recovery, batched recover.  Mirrors the reference's
+test strategy for fd_bmtree/fd_shred/fd_shredder/fd_fec_resolver
+(differential where a host ground truth exists, round-trip otherwise)."""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from firedancer_tpu.ops import bmtree, reedsol
+from firedancer_tpu.ops.ref import ed25519_ref as ref
+from firedancer_tpu.protocol import shred as fs
+from firedancer_tpu.runtime.fec_resolver import FecResolver, entry_batch_from_sets
+from firedancer_tpu.runtime import shredder as fsh
+
+
+# -- bmtree -------------------------------------------------------------------
+
+
+def test_bmtree_depth():
+    assert bmtree.depth(1) == 1
+    assert bmtree.depth(2) == 2
+    assert bmtree.depth(3) == 3
+    assert bmtree.depth(4) == 3
+    assert bmtree.depth(5) == 4
+    assert bmtree.depth(64) == 7
+    assert bmtree.depth(65) == 8
+
+
+def test_bmtree_single_leaf_root_is_leaf():
+    leaf = bmtree.hash_leaf(b"hello")
+    assert bmtree.root([leaf]) == leaf
+    assert len(leaf) == 20
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 5, 8, 11, 64, 67])
+def test_bmtree_proofs_verify(n):
+    leaves = [bmtree.hash_leaf(b"leaf%d" % i) for i in range(n)]
+    layers = bmtree.tree_layers(leaves)
+    root = layers[-1][0]
+    for i in range(n):
+        proof = bmtree.get_proof(layers, i)
+        assert len(proof) == len(layers) - 1
+        assert bmtree.verify_proof(leaves[i], i, proof) == root
+    # wrong index / wrong leaf must NOT verify
+    if n > 1:
+        proof = bmtree.get_proof(layers, 0)
+        assert bmtree.verify_proof(leaves[0], 1, proof) != root
+        assert bmtree.verify_proof(bmtree.hash_leaf(b"evil"), 0, proof) != root
+
+
+def test_bmtree_domain_separation():
+    """A leaf value reused as a node input must not produce the same hash
+    (the 0x00/0x01 prefix split)."""
+    a, b = bmtree.hash_leaf(b"a"), bmtree.hash_leaf(b"b")
+    inner = bmtree.root([a, b])
+    assert inner != bmtree.hash_leaf(a + b)[:20]
+
+
+def test_bmtree_batch_matches_host():
+    """Device batched layers == host hashlib tree, 3 trees at once."""
+    n = 6
+    trees = []
+    arr = np.zeros((n, 20, 3), dtype=np.uint8)
+    for t in range(3):
+        leaves = [bmtree.hash_leaf(b"t%d-%d" % (t, i)) for i in range(n)]
+        trees.append(bmtree.root(leaves))
+        for i, leaf in enumerate(leaves):
+            arr[i, :, t] = np.frombuffer(leaf, dtype=np.uint8)
+    roots = np.asarray(bmtree.root_batch(arr))
+    for t in range(3):
+        assert roots[:, t].astype(np.uint8).tobytes() == trees[t]
+
+
+def test_bmtree_hash_leaves_batch():
+    datas = [b"x" * 50, b"y" * 50, b"z" * 50]
+    arr = np.stack(
+        [np.frombuffer(d, dtype=np.uint8) for d in datas], axis=-1
+    )
+    out = np.asarray(bmtree.hash_leaves_batch(arr))
+    for i, d in enumerate(datas):
+        assert out[:, i].astype(np.uint8).tobytes() == bmtree.hash_leaf(d)
+
+
+# -- shred wire format --------------------------------------------------------
+
+
+def test_shred_build_parse_data():
+    payload = b"\xab" * 500
+    buf = fs.build_data_shred(
+        slot=7, idx=3, version=1, fec_set_idx=2, parent_off=1,
+        flags=fs.DATA_FLAG_DATA_COMPLETE | 5, payload=payload,
+        merkle_proof_cnt=6,
+    )
+    assert len(buf) == fs.MIN_SZ == 1203
+    s = fs.parse(bytes(buf))
+    assert s is not None and s.is_data
+    assert (s.slot, s.idx, s.version, s.fec_set_idx) == (7, 3, 1, 2)
+    assert s.flags & fs.DATA_FLAG_DATA_COMPLETE
+    assert (s.flags & fs.DATA_REF_TICK_MASK) == 5
+    assert s.payload(bytes(buf)) == payload
+    assert fs.merkle_off(s.variant) == 1203 - 20 * 6
+
+
+def test_shred_build_parse_code():
+    parity = b"\xcd" * fs.code_payload_sz(6)
+    buf = fs.build_code_shred(
+        slot=7, idx=40, version=1, fec_set_idx=2, data_cnt=32, code_cnt=32,
+        code_idx=8, parity=parity, merkle_proof_cnt=6,
+    )
+    assert len(buf) == fs.MAX_SZ == 1228
+    s = fs.parse(bytes(buf))
+    assert s is not None and not s.is_data
+    assert (s.data_cnt, s.code_cnt, s.code_idx) == (32, 32, 8)
+    assert s.payload(bytes(buf)) == parity
+
+
+def test_shred_parse_rejects():
+    assert fs.parse(b"") is None
+    assert fs.parse(b"\x00" * 100) is None
+    buf = fs.build_data_shred(
+        slot=1, idx=0, version=0, fec_set_idx=0, parent_off=1, flags=0,
+        payload=b"x", merkle_proof_cnt=6,
+    )
+    assert fs.parse(bytes(buf)[:-1]) is None          # truncated
+    bad = bytearray(buf); bad[64] = 0xA0 | 5          # legacy variant
+    assert fs.parse(bytes(bad)) is None
+    bad = bytearray(buf)
+    bad[0x56:0x58] = (5000).to_bytes(2, "little")     # size > merkle_off
+    assert fs.parse(bytes(bad)) is None
+
+
+def test_shred_payload_region_consistency():
+    """Data+code wire sizes interlock: a code element covers exactly a data
+    shred's post-signature header + payload region (fd_shred.h comment)."""
+    for depth in range(1, 9):
+        region = fs.data_payload_region_sz(depth)
+        elt = fs.code_payload_sz(depth)
+        assert elt == region + (fs.DATA_HEADER_SZ - fs.SIGNATURE_SZ)
+        assert fs.DATA_HEADER_SZ + region + depth * 20 == fs.MIN_SZ
+        assert fs.CODE_HEADER_SZ + elt + depth * 20 == fs.MAX_SZ
+
+
+# -- shredder counts (reference table behavior) -------------------------------
+
+
+def test_shredder_counts_normal_multiple():
+    sz = 2 * 31840
+    assert fsh.count_fec_sets(sz) == 2
+    assert fsh.count_data_shreds(sz) == 64
+    assert fsh.count_parity_shreds(sz) == 64
+
+
+def test_shredder_counts_small():
+    assert fsh.count_fec_sets(1) == 1
+    assert fsh.count_data_shreds(1) == 1
+    assert fsh.count_parity_shreds(1) == fsh.DATA_TO_PARITY[1] == 17
+    assert fsh.count_data_shreds(9135) == 9
+    assert fsh.count_data_shreds(9136) == 10  # next bucket: 995 B/shred
+
+
+def test_shredder_counts_odd_tail():
+    # 31841..63679 stays ONE set (no split until >= 2 full normal sets)
+    sz = 40000
+    assert fsh.count_fec_sets(sz) == 1
+    d = fsh.count_data_shreds(sz)
+    assert d == (sz + 974) // 975
+    assert fsh.count_parity_shreds(sz) == d  # d > 32 -> parity == data
+
+
+# -- shredder -> resolver round trip ------------------------------------------
+
+
+def _mk_signer(tag=b"leader"):
+    secret = hashlib.sha256(tag).digest()
+    pub = ref.public_key(secret)
+    return (lambda root: ref.sign(secret, root)), pub
+
+
+def test_shredder_produces_parseable_signed_sets():
+    signer, pub = _mk_signer()
+    sh = fsh.Shredder(signer=signer, shred_version=3)
+    batch = bytes(np.random.default_rng(1).integers(0, 256, 5000, dtype=np.uint8))
+    sets = sh.entry_batch_to_fec_sets(batch, slot=11)
+    assert len(sets) == 1
+    st = sets[0]
+    assert len(st.data_shreds) == fsh.count_data_shreds(5000)
+    assert len(st.parity_shreds) == fsh.count_parity_shreds(5000)
+    for i, buf in enumerate(st.data_shreds):
+        s = fs.parse(buf)
+        assert s is not None and s.is_data and s.slot == 11
+        assert s.idx == i and s.fec_set_idx == 0 and s.version == 3
+        # inclusion proof -> root -> leader signature
+        leaf = bmtree.hash_leaf(s.merkle_leaf_data(buf))
+        root = bmtree.verify_proof(leaf, i, s.merkle_proof(buf))
+        assert root == st.merkle_root
+        assert ref.verify(root, s.signature(buf), pub)
+    # last shred carries DATA_COMPLETE
+    last = fs.parse(st.data_shreds[-1])
+    assert last.flags & fs.DATA_FLAG_DATA_COMPLETE
+    assert not (fs.parse(st.data_shreds[0]).flags & fs.DATA_FLAG_DATA_COMPLETE)
+
+
+def test_shredder_multi_set_indices_continue():
+    signer, _ = _mk_signer()
+    sh = fsh.Shredder(signer=signer)
+    # 2 sets: one normal 31840 + one odd 38160 (the tail only splits off
+    # while >= 2 normal sets of bytes remain, fd_shredder.c:151-154)
+    batch = bytes(70000)
+    sets = sh.entry_batch_to_fec_sets(batch, slot=5)
+    assert len(sets) == 2
+    assert sets[0].fec_set_idx == 0
+    assert sets[1].fec_set_idx == 32
+    d0 = fs.parse(sets[1].data_shreds[0])
+    assert d0.idx == 32
+    # second batch in the same slot continues numbering
+    sets2 = sh.entry_batch_to_fec_sets(bytes(100), slot=5)
+    total_d = fsh.count_data_shreds(70000)
+    assert fs.parse(sets2[0].data_shreds[0]).idx == total_d
+    # new slot resets
+    sets3 = sh.entry_batch_to_fec_sets(bytes(100), slot=6)
+    assert fs.parse(sets3[0].data_shreds[0]).idx == 0
+
+
+def test_fec_resolver_no_loss():
+    signer, pub = _mk_signer()
+    sh = fsh.Shredder(signer=signer)
+    batch = b"batchdata" * 300
+    (st,) = sh.entry_batch_to_fec_sets(batch, slot=2)
+    res = FecResolver(verify_sig=lambda r, s: ref.verify(r, s, pub))
+    done = None
+    for buf in st.parity_shreds[:1] + st.data_shreds:
+        out = res.add_shred(buf)
+        done = out or done
+    assert done is not None
+    assert done.merkle_root == st.merkle_root
+    assert [bytes(b) for b in done.data_shreds] == list(st.data_shreds)
+    assert entry_batch_from_sets([done]) == batch
+
+
+def test_fec_resolver_recovers_dropped_data():
+    signer, pub = _mk_signer()
+    sh = fsh.Shredder(signer=signer)
+    rng = np.random.default_rng(7)
+    batch = bytes(rng.integers(0, 256, 20000, dtype=np.uint8))
+    (st,) = sh.entry_batch_to_fec_sets(batch, slot=3)
+    d = len(st.data_shreds)
+    p = len(st.parity_shreds)
+    # drop as many data shreds as recoverable (<= p), feed rest mixed up
+    drop = set(rng.choice(d, size=min(p - 1, d - 1), replace=False).tolist())
+    feed = [b for i, b in enumerate(st.data_shreds) if i not in drop]
+    feed += list(st.parity_shreds)
+    rng.shuffle(feed)
+    res = FecResolver(verify_sig=lambda r, s: ref.verify(r, s, pub))
+    done = None
+    for buf in feed:
+        out = res.add_shred(buf)
+        done = out or done
+    assert done is not None
+    # recovered data shreds are byte-identical to the originals
+    assert [bytes(b) for b in done.data_shreds] == list(st.data_shreds)
+    assert [bytes(b) for b in done.parity_shreds] == list(st.parity_shreds)
+    assert entry_batch_from_sets([done]) == batch
+    assert res.metrics["sets_completed"] == 1
+
+
+def test_fec_resolver_rejects_foreign_and_corrupt():
+    signer, pub = _mk_signer()
+    sh = fsh.Shredder(signer=signer)
+    (st,) = sh.entry_batch_to_fec_sets(b"A" * 3000, slot=4)
+    evil_signer, _ = _mk_signer(b"evil")
+    sh2 = fsh.Shredder(signer=evil_signer)
+    (st2,) = sh2.entry_batch_to_fec_sets(b"B" * 3000, slot=4)
+    res = FecResolver(verify_sig=lambda r, s: ref.verify(r, s, pub))
+    # evil first shred: signature check fails, set never admitted
+    assert res.add_shred(st2.data_shreds[0]) is None
+    assert res.metrics["shred_rejected"] == 1
+    # honest set in; evil shreds for the same key rejected by root mismatch
+    res.add_shred(st.data_shreds[0])
+    assert res.add_shred(st2.data_shreds[1]) is None
+    # corrupted payload fails its own inclusion proof -> new root -> but
+    # same (slot, fec_set_idx) key with mismatched root -> rejected
+    bad = bytearray(st.data_shreds[1]); bad[200] ^= 1
+    assert res.add_shred(bytes(bad)) is None
+    assert res.metrics["shred_rejected"] == 3
+
+
+def test_fec_resolver_late_and_eviction():
+    signer, pub = _mk_signer()
+    sh = fsh.Shredder(signer=signer)
+    (st,) = sh.entry_batch_to_fec_sets(b"C" * 1500, slot=9)
+    res = FecResolver(verify_sig=lambda r, s: ref.verify(r, s, pub), max_inflight=2)
+    for buf in st.parity_shreds[:1] + list(st.data_shreds):
+        res.add_shred(buf)
+    assert res.metrics["sets_completed"] == 1
+    # duplicates of a completed set count as late
+    late_before = res.metrics["shred_late"]
+    assert res.add_shred(st.data_shreds[0]) is None
+    assert res.metrics["shred_late"] == late_before + 1
+    # flooding bogus keys evicts oldest in-progress, bounded memory
+    for slot in range(20, 25):
+        (sx,) = fsh.Shredder(signer=signer).entry_batch_to_fec_sets(
+            b"D" * 1200, slot=slot
+        )
+        res.add_shred(sx.data_shreds[0])
+    assert len(res._sets) <= 2
+    assert res.metrics["sets_evicted"] >= 3
+
+
+# -- batched recover ----------------------------------------------------------
+
+
+def test_recover_batch_mixed_patterns():
+    rng = np.random.default_rng(3)
+    d, p, sz, t = 8, 4, 64, 5
+    n = d + p
+    data = rng.integers(0, 256, (t, d, sz), dtype=np.uint8)
+    parity = np.asarray(reedsol.encode(data, p))
+    full = np.concatenate([data, parity], axis=1)
+    shreds = full.copy()
+    present = np.ones((t, n), dtype=bool)
+    # set 0: intact; set 1: drop 2 data; set 2: drop p mixed; set 3: too
+    # many losses (partial); set 4: corrupt a surviving extra shred
+    present[1, [0, 3]] = False
+    present[2, [1, 2, d, d + 1]] = False
+    present[3, : p + 1] = False
+    shreds[1, 0] = 0
+    shreds[2, 1] = 0
+    shreds[4, d + 2] ^= 0xFF
+    statuses, rebuilt = reedsol.recover_batch(shreds, present, d)
+    assert statuses[0] == reedsol.SUCCESS
+    assert statuses[1] == reedsol.SUCCESS
+    assert statuses[2] == reedsol.SUCCESS
+    assert statuses[3] == reedsol.ERR_PARTIAL
+    assert statuses[4] == reedsol.ERR_CORRUPT
+    for k in (0, 1, 2):
+        assert np.array_equal(rebuilt[k], full[k])
+
+
+def test_recover_batch_matches_single():
+    rng = np.random.default_rng(4)
+    d, p, sz = 6, 3, 32
+    data = rng.integers(0, 256, (2, d, sz), dtype=np.uint8)
+    parity = np.asarray(reedsol.encode(data, p))
+    full = np.concatenate([data, parity], axis=1)
+    present = np.ones((2, d + p), dtype=bool)
+    present[0, 2] = False
+    present[1, [0, d]] = False
+    statuses, rebuilt = reedsol.recover_batch(full, present, d)
+    for k in range(2):
+        s1, r1 = reedsol.recover(full[k], present[k], d)
+        assert statuses[k] == s1 == reedsol.SUCCESS
+        assert np.array_equal(rebuilt[k], np.asarray(r1))

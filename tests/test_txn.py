@@ -219,3 +219,90 @@ def test_multisig_txn():
     assert t.is_writable(0) and t.is_writable(1)
     assert not t.is_writable(2) and not t.is_writable(3)
     assert ft.MIN_SERIALIZED_SZ <= len(p) <= ft.TXN_MTU
+
+
+# -- packed binary descriptor (the wire trailer format) ----------------------
+
+
+def test_txn_pack_roundtrip_legacy():
+    p = simple_legacy(n_extra_accts=3, n_instr=4, data=b"abcdef")
+    t = ft.txn_parse(p)
+    buf = ft.txn_pack(t)
+    assert len(buf) == ft.txn_packed_sz(len(t.instrs), len(t.addr_luts))
+    t2, end = ft.txn_unpack(buf)
+    assert end == len(buf)
+    assert t2 == t
+
+
+def test_txn_pack_roundtrip_v0_luts():
+    secret, pub = keypair(b"v0pack")
+    msg = ft.message_build(
+        version=ft.V0,
+        signature_cnt=1,
+        readonly_signed_cnt=0,
+        readonly_unsigned_cnt=1,
+        acct_addrs=[pub, ft.SYSTEM_PROGRAM],
+        recent_blockhash=bytes(32),
+        instrs=[ft.InstrSpec(program_id=1, accounts=bytes([0, 2, 3]), data=b"xy")],
+        luts=[
+            ft.LutSpec(
+                table_addr=hashlib.sha256(b"t%d" % i).digest(),
+                writable=bytes([5]),
+                readonly=bytes([9, 10]),
+            )
+            for i in range(3)
+        ],
+    )
+    p = ft.txn_assemble([ref.sign(secret, msg)], msg)
+    t = ft.txn_parse(p)
+    assert t is not None and len(t.addr_luts) == 3
+    t2, _ = ft.txn_unpack(ft.txn_pack(t))
+    assert t2 == t
+
+
+def test_txn_pack_at_offset():
+    p = simple_legacy()
+    t = ft.txn_parse(p)
+    frag = p + ft.txn_pack(t)
+    t2, end = ft.txn_unpack(frag, len(p))
+    assert t2 == t and end == len(frag)
+
+
+def test_encode_verified_trailer():
+    from firedancer_tpu.runtime.verify import decode_verified, encode_verified
+
+    p = simple_legacy(n_extra_accts=2, n_instr=2)
+    t = ft.txn_parse(p)
+    frag = encode_verified(p, t)
+    # trailer is payload || packed desc || u16 payload_sz, nothing else
+    assert frag[: len(p)] == p
+    assert int.from_bytes(frag[-2:], "little") == len(p)
+    payload, desc = decode_verified(frag)
+    assert payload == p and desc == t
+    # corrupt trailer size -> rejected, not garbage
+    bad = frag[:-2] + (len(p) - 1).to_bytes(2, "little")
+    with pytest.raises(Exception):
+        decode_verified(bad)
+
+
+def test_txn_desc_valid_rejects_hostile():
+    p = simple_legacy()
+    t = ft.txn_parse(p)
+    assert ft.txn_desc_valid(t, len(p))
+    import dataclasses
+
+    bad = dataclasses.replace(t, signature_off=60000)
+    assert not ft.txn_desc_valid(bad, len(p))
+    bad = dataclasses.replace(t, signature_cnt=200)
+    assert not ft.txn_desc_valid(bad, len(p))
+    bad = dataclasses.replace(t, acct_addr_cnt=100)  # 32*100 > payload
+    assert not ft.txn_desc_valid(bad, len(p))
+
+    from firedancer_tpu.runtime.verify import decode_verified, encode_verified
+
+    # a frag whose trailer passes the size check but encodes bad offsets
+    frag = p + ft.txn_pack(dataclasses.replace(t, signature_off=1200)) + len(
+        p
+    ).to_bytes(2, "little")
+    with pytest.raises(ValueError):
+        decode_verified(frag)
