@@ -1,0 +1,7 @@
+from .funk import (  # noqa: F401
+    ERR_FROZEN,
+    ERR_KEY,
+    ERR_TXN,
+    Funk,
+    FunkError,
+)

@@ -1,24 +1,43 @@
-"""The flagship model: the leader TPU pipeline, assembled.
+"""The flagship model: the full leader TPU pipeline, assembled.
 
-    benchg -> verify (TPU sigverify, xN round-robin) -> dedup -> pack
+    benchg -> verify (TPU sigverify, xN round-robin) -> dedup
+           -> pack -> bank xB -> poh -> shred -> store
 
 This is the e2e slice of the reference's Frankendancer leader topology
 (/root/reference/src/app/fdctl/run/topos/fd_frankendancer.c:96-111) with
-ingress replaced by the synthetic generator (net/quic stages are later
-milestones).  Stages talk over tango shm links and are driven either by the
-in-process cooperative scheduler here (tests, bench) or by the process
-topology runner (own milestone).
+ingress replaced by the synthetic generator (net/quic are later
+milestones) and the store stage doubling as the FEC-resolver receive path
+that proves the emitted shreds reassemble.  Stages talk over tango shm
+links and are driven either by the in-process cooperative scheduler here
+(tests, bench) or by the process topology runner.
+
+Link map (names follow the reference's link table, fd_frankendancer.c:55-83):
+    gen_verify      benchg -> verify xN (round-robin by seq)
+    verify_dedup[i] verify i -> dedup (single-producer rings)
+    dedup_pack      dedup -> pack
+    pack_bank[b]    pack -> bank b (microblock frames)
+    bank_poh[b]     bank b -> poh (executed microblocks)
+    bank_done[b]    bank b -> pack (lock release; the reference uses
+                    bank_busy fseqs, same role)
+    poh_shred       poh -> shred (entries)
+    shred_store     shred -> store (wire shreds)
 """
 
 from __future__ import annotations
 
+import hashlib
 import os
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
+from firedancer_tpu.ops.ref import ed25519_ref as ref
+from firedancer_tpu.runtime.bank import BankStage
 from firedancer_tpu.runtime.benchg import BenchGStage, gen_transfer_pool
 from firedancer_tpu.runtime.dedup import DedupStage
-from firedancer_tpu.runtime.pack_stub import PackStubStage
+from firedancer_tpu.runtime.pack_stage import PackStage
+from firedancer_tpu.runtime.poh_stage import PohStage
+from firedancer_tpu.runtime.shred_stage import ShredStage
+from firedancer_tpu.runtime.store import StoreStage
 from firedancer_tpu.runtime.verify import VerifyStage
 from firedancer_tpu.tango import shm
 
@@ -30,33 +49,52 @@ class LeaderPipeline:
     benchg: BenchGStage
     verifies: list[VerifyStage]
     dedup: DedupStage
-    pack: PackStubStage
+    pack: PackStage
+    banks: list[BankStage]
+    poh: PohStage
+    shred: ShredStage
+    store: StoreStage
+    leader_pub: bytes
 
-    def run(self, *, max_iters: int = 100_000, until_txns: int | None = None):
-        """Cooperative round-robin scheduling until pack has seen
-        `until_txns` txns or max_iters loop sweeps elapse."""
+    def run(self, *, max_iters: int = 200_000, until_txns: int | None = None):
+        """Cooperative round-robin until pack has accepted `until_txns`
+        txns (or max_iters sweeps), then drain the whole pipe to the store."""
         for _ in range(max_iters):
             for s in self.stages:
                 s.run_once()
-            if until_txns is not None and self.pack.metrics.get("txn_in") >= until_txns:
+            if (
+                until_txns is not None
+                and self.pack.metrics.get("txn_in") >= until_txns
+            ):
                 break
+        self.finish()
+
+    def finish(self, *, max_sweeps: int = 50_000) -> None:
+        """Drain: verify flush -> pack force-flush -> stop the poh clock ->
+        shred flush -> sweep until quiescent."""
+        self.benchg.limit = 0  # stop generating
         for v in self.verifies:
             v.flush()
-        # drain sweeps until quiescent: each run_once moves at most one frag
-        # per stage, so sweep dedup/pack until neither makes progress (a
-        # fixed sweep count loses the tail when verify flushes > count frags).
-        while True:
-            before = self.dedup.metrics.get("frags_in") + self.pack.metrics.get(
-                "frags_in"
-            )
-            self.dedup.run_once()
-            self.pack.run_once()
-            after = self.dedup.metrics.get("frags_in") + self.pack.metrics.get(
-                "frags_in"
-            )
-            if after == before:
-                break
+        self._sweep(max_sweeps)
         self.pack.flush()
+        self._sweep(max_sweeps)
+        # stop the clock so tick entries stop flowing, then final shred
+        self.poh.hashes_per_iter = 0
+        self._sweep(max_sweeps)
+        self.shred.flush(block_complete=True)
+        self._sweep(max_sweeps)
+
+    def _sweep(self, max_sweeps: int) -> None:
+        """Run non-generator stages until none makes frag progress."""
+        stages = [s for s in self.stages if s is not self.benchg]
+        for _ in range(max_sweeps):
+            progressed = False
+            for s in stages:
+                progressed |= bool(s.run_once())
+            # pack may be waiting on schedulability rather than frags
+            self.pack.after_credit()
+            if not progressed and not self.pack.pack.pending_cnt():
+                break
 
     def close(self):
         for link in self.links:
@@ -67,39 +105,59 @@ class LeaderPipeline:
         return {s.name: dict(s.metrics.counters) for s in self.stages}
 
 
+def build_leader_pipeline_from_config(cfg, **overrides) -> "LeaderPipeline":
+    """Topology derived from a typed Config (utils/config.py) — the
+    config_parse -> topos/fd_frankendancer.c split."""
+    kw = dict(
+        n_verify=cfg.layout.verify_stage_count,
+        n_bank=cfg.layout.bank_stage_count,
+        batch=cfg.verify.batch,
+        max_msg_len=cfg.verify.max_msg_len,
+        depth=cfg.verify.receive_buffer_depth,
+        batch_deadline_s=cfg.verify.batch_deadline_ms / 1e3,
+    )
+    kw.update(overrides)
+    return build_leader_pipeline(**kw)
+
+
 def build_leader_pipeline(
     *,
     n_verify: int = 1,
+    n_bank: int = 2,
     pool_size: int = 512,
     gen_limit: int | None = None,
     batch: int = 128,
     max_msg_len: int = 256,
     depth: int = 1024,
     batch_deadline_s: float = 0.002,
+    slot: int = 1,
+    leader_seed: bytes = b"leader",
 ) -> LeaderPipeline:
     uid = f"{os.getpid()}_{int(time.monotonic_ns() % 1_000_000)}"
     links = []
 
-    def mklink(name, mtu, n_consumers=1):
+    def mklink(name, mtu, n_consumers=1, d=None):
         link = shm.ShmLink.create(
-            f"fdtpu_{name}_{uid}", depth=depth, mtu=mtu, n_fseq=n_consumers
+            f"fdtpu_{name}_{uid}", depth=d or depth, mtu=mtu, n_fseq=n_consumers
         )
         links.append(link)
         return link
 
-    # gen -> verify: one link, verify stages shard by seq round-robin.
     gen_verify = mklink("gv", mtu=1232, n_consumers=n_verify)
-    # verify -> dedup: one link per verify stage (single-producer rings).
     verify_dedup = [mklink(f"vd{i}", mtu=4096) for i in range(n_verify)]
     dedup_pack = mklink("dp", mtu=4096)
-    pack_out = mklink("po", mtu=65536)
+    pack_bank = [mklink(f"pb{b}", mtu=65536) for b in range(n_bank)]
+    bank_poh = [mklink(f"bp{b}", mtu=65536) for b in range(n_bank)]
+    bank_done = [mklink(f"bd{b}", mtu=64) for b in range(n_bank)]
+    poh_shred = mklink("ps", mtu=65536)
+    shred_store = mklink("ss", mtu=1232, d=4096)
+
+    secret = hashlib.sha256(leader_seed).digest()
+    leader_pub = ref.public_key(secret)
 
     pool = gen_transfer_pool(pool_size)
     benchg = BenchGStage(
-        pool,
-        "benchg",
-        outs=[shm.Producer(gen_verify)],
-        limit=gen_limit,
+        pool, "benchg", outs=[shm.Producer(gen_verify)], limit=gen_limit
     )
     verifies = [
         VerifyStage(
@@ -119,12 +177,44 @@ def build_leader_pipeline(
         ins=[shm.Consumer(l, lazy=32) for l in verify_dedup],
         outs=[shm.Producer(dedup_pack)],
     )
-    pack = PackStubStage(
+    pack = PackStage(
         "pack",
-        ins=[shm.Consumer(dedup_pack, lazy=32)],
-        outs=[shm.Producer(pack_out, reliable_fseq_idx=[])],
+        ins=[shm.Consumer(dedup_pack, lazy=32)]
+        + [shm.Consumer(l, lazy=8) for l in bank_done],
+        outs=[shm.Producer(l) for l in pack_bank],
+        bank_cnt=n_bank,
     )
-    stages = [benchg, *verifies, dedup, pack]
+    banks = [
+        BankStage(
+            f"bank{b}",
+            ins=[shm.Consumer(pack_bank[b], lazy=8)],
+            outs=[shm.Producer(bank_poh[b]), shm.Producer(bank_done[b])],
+            bank_idx=b,
+        )
+        for b in range(n_bank)
+    ]
+    for bstage in banks:
+        bstage.require_credit = True
+    poh = PohStage(
+        "poh",
+        ins=[shm.Consumer(l, lazy=8) for l in bank_poh],
+        outs=[shm.Producer(poh_shred)],
+    )
+    poh.require_credit = True
+    shred = ShredStage(
+        "shred",
+        ins=[shm.Consumer(poh_shred, lazy=8)],
+        outs=[shm.Producer(shred_store)],
+        signer=lambda root: ref.sign(secret, root),
+        slot=slot,
+        keep_sets=True,
+    )
+    store = StoreStage(
+        "store",
+        ins=[shm.Consumer(shred_store, lazy=64)],
+        verify_sig=lambda r, s: ref.verify(r, s, leader_pub),
+    )
+    stages = [benchg, *verifies, dedup, pack, *banks, poh, shred, store]
     return LeaderPipeline(
         stages=stages,
         links=links,
@@ -132,4 +222,9 @@ def build_leader_pipeline(
         verifies=verifies,
         dedup=dedup,
         pack=pack,
+        banks=banks,
+        poh=poh,
+        shred=shred,
+        store=store,
+        leader_pub=leader_pub,
     )

@@ -1,0 +1,100 @@
+"""Bank stage: executes pack's microblocks, feeds PoH, releases locks.
+
+Pipeline position mirrors the reference's bank tile
+(/root/reference/src/app/fdctl/run/tiles/fd_bank.c): consume a microblock
+from pack, execute + commit it, hand the executed microblock to poh for
+mixin, and signal pack that this bank is idle again (the bank_busy
+release that lets pack schedule conflicting txns).
+
+Execution here is the *Frankendancer* shape — the reference bank tile is
+itself a thin wrapper that ships txns across an FFI to Agave's runtime
+(fd_bank.c:99-104); the native runtime (flamenco analog) is its own
+milestone.  The stub executes a system transfer ledger over an in-memory
+lamport map so tests can assert real state transitions, and computes the
+microblock mixin hash = sha256 over the txns' first signatures (the entry
+hash the poh stage mixes in).
+
+Inputs:  ins[0] = pack->bank microblocks.
+Outputs: outs[0] = bank->poh executed microblocks; outs[1] = done->pack.
+
+Entry frame out: 32B mixin | u16 txn_cnt | (u16 len || raw txn payload)*.
+Done frame out: empty payload, sig = bank index.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from firedancer_tpu.protocol import txn as ft
+from firedancer_tpu.tango.rings import MCache
+from .stage import Stage
+from .verify import decode_verified
+
+
+def parse_microblock(frame: bytes) -> tuple[int, list[bytes]]:
+    """-> (mb_seq, [verified-frag bytes])."""
+    mb_seq = int.from_bytes(frame[:4], "little")
+    cnt = int.from_bytes(frame[4:6], "little")
+    frags = []
+    o = 6
+    for _ in range(cnt):
+        ln = int.from_bytes(frame[o : o + 2], "little")
+        o += 2
+        frags.append(frame[o : o + ln])
+        o += ln
+    return mb_seq, frags
+
+
+class BankStage(Stage):
+    def __init__(self, *args, bank_idx: int = 0, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.bank_idx = bank_idx
+        self.lamports: dict[bytes, int] = {}  # account -> balance (stub state)
+        # per-microblock commit latency vs the oldest txn's origin stamp
+        # (the bencho measurement point: txn acknowledged by the runtime)
+        self.commit_latencies_ns: list[int] = []
+
+    def after_frag(self, in_idx: int, meta, payload: bytes) -> None:
+        mb_seq, frags = parse_microblock(payload)
+        sigs = []
+        out = bytearray()
+        txns = []
+        for frag in frags:
+            p, desc = decode_verified(frag)
+            self._execute(p, desc)
+            sigs.append(desc.signatures(p)[0])
+            txns.append(p)
+            self.metrics.inc("txn_exec")
+        mixin = hashlib.sha256(b"".join(sigs)).digest()
+        out += mixin
+        out += len(txns).to_bytes(2, "little")
+        for p in txns:
+            out += len(p).to_bytes(2, "little")
+            out += p
+        self.metrics.inc("microblocks")
+        tsorig = int(meta[MCache.COL_TSORIG])
+        if tsorig and len(self.commit_latencies_ns) < 100_000:
+            from firedancer_tpu.tango.shm import now_ns
+
+            self.commit_latencies_ns.append(now_ns() - tsorig)
+        self.publish(0, bytes(out), sig=mb_seq, tsorig=tsorig)  # -> poh
+        self.publish(1, b"", sig=self.bank_idx)  # -> pack (lock release)
+
+    def _execute(self, payload: bytes, desc: ft.Txn) -> None:
+        """System-transfer interpreter over the lamport map (the stub
+        runtime; enough to observe state transitions in tests)."""
+        addrs = desc.acct_addrs(payload)
+        for ins in desc.instrs:
+            prog = addrs[ins.program_id]
+            if prog != ft.SYSTEM_PROGRAM or ins.data_sz < 12:
+                continue
+            data = payload[ins.data_off : ins.data_off + ins.data_sz]
+            if int.from_bytes(data[:4], "little") != 2:  # transfer tag
+                continue
+            lamports = int.from_bytes(data[4:12], "little")
+            acct_idx = payload[ins.acct_off : ins.acct_off + ins.acct_cnt]
+            if len(acct_idx) < 2:
+                continue
+            src, dst = addrs[acct_idx[0]], addrs[acct_idx[1]]
+            self.lamports[src] = self.lamports.get(src, 0) - lamports
+            self.lamports[dst] = self.lamports.get(dst, 0) + lamports

@@ -17,16 +17,27 @@ from firedancer_tpu.protocol import txn as ft
 from .stage import Stage
 
 
-def gen_transfer_pool(n: int, seed: bytes = b"benchg") -> list[bytes]:
+def gen_transfer_pool(
+    n: int, seed: bytes = b"benchg", n_payers: int = 8, n_dests: int = 64
+) -> list[bytes]:
+    """Pool of signed transfers rotating over `n_payers` payer keypairs and
+    `n_dests` destinations (fd_benchg.c rotates accounts the same way so
+    pack sees schedulable parallelism, not one serializing hot account)."""
     from firedancer_tpu.ops.ref import ed25519_ref as ref
 
-    secret = hashlib.sha256(seed + b"payer").digest()
-    payer_pub = ref.public_key(secret)
-    to = hashlib.sha256(seed + b"to").digest()
+    n_payers = max(1, min(n_payers, n))
+    payers = []
+    for k in range(n_payers):
+        secret = hashlib.sha256(seed + b"payer%d" % k).digest()
+        payers.append((secret, ref.public_key(secret)))
     blockhash = hashlib.sha256(seed + b"bh").digest()
     return [
         ft.transfer_txn(
-            secret, to, 1 + i, blockhash, from_pubkey=payer_pub
+            payers[i % n_payers][0],
+            hashlib.sha256(seed + b"to%d" % (i % n_dests)).digest(),
+            1 + i,
+            blockhash,
+            from_pubkey=payers[i % n_payers][1],
         )
         for i in range(n)
     ]

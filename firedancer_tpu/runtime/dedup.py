@@ -27,4 +27,6 @@ class DedupStage(Stage):
             self.metrics.inc("dedup_dup")
             return
         if self.outs:
-            self.publish(0, payload, sig=tag)
+            self.publish(
+                0, payload, sig=tag, tsorig=int(meta[MCache.COL_TSORIG])
+            )

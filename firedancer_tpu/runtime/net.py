@@ -1,0 +1,77 @@
+"""UDP ingress: real packets off a socket into the pipeline.
+
+The plain-UDP transport position of the reference
+(/root/reference/src/waltz/udpsock/fd_udpsock.c — the non-XDP fallback,
+and the TPU/UDP half of the quic tile, src/app/fdctl/run/tiles/fd_quic.c:
+one datagram = one whole transaction, no stream reassembly).  The QUIC
+server is its own milestone; this stage makes the pipeline's front door a
+real socket today: ingress -> verify is network bytes, not an in-process
+generator.
+
+Nonblocking: each loop iteration drains up to `rx_burst` datagrams into
+the out link (credits permitting), so the cooperative scheduler never
+stalls on an idle socket.  Oversized datagrams (> TXN_MTU) are dropped
+and counted, mirroring fd_quic's MTU policy.
+"""
+
+from __future__ import annotations
+
+import errno
+import socket
+
+from firedancer_tpu.protocol.txn import TXN_MTU
+from .stage import Stage
+
+
+class UdpIngressStage(Stage):
+    def __init__(
+        self,
+        *args,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        sock: socket.socket | None = None,
+        rx_burst: int = 64,
+        **kwargs,
+    ):
+        super().__init__(*args, **kwargs)
+        if sock is None:
+            sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            sock.bind((host, port))
+        sock.setblocking(False)
+        self.sock = sock
+        self.rx_burst = rx_burst
+
+    @property
+    def addr(self) -> tuple[str, int]:
+        return self.sock.getsockname()
+
+    def after_credit(self) -> None:
+        for _ in range(self.rx_burst):
+            try:
+                data, _src = self.sock.recvfrom(2048)
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError as e:  # pragma: no cover - platform specific
+                if e.errno in (errno.EAGAIN, errno.EWOULDBLOCK):
+                    return
+                raise
+            if len(data) > TXN_MTU:
+                self.metrics.inc("oversize_drop")
+                continue
+            self.metrics.inc("pkt_rx")
+            if not self.publish(0, data, sig=self.metrics.get("pkt_rx")):
+                self.metrics.inc("pkt_drop_backpressure")
+                return
+
+    def close(self) -> None:
+        self.sock.close()
+
+
+def send_txns(addr: tuple[str, int], txns: list[bytes]) -> None:
+    """Test/bench helper: blast txns at a UDP ingress (benchs analog)."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        for t in txns:
+            s.sendto(t, addr)
+    finally:
+        s.close()

@@ -1,0 +1,144 @@
+"""Pack stage: the real conflict-aware scheduler wired into the pipeline.
+
+Pipeline position and dataflow mirror the reference's pack tile
+(/root/reference/src/app/fdctl/run/tiles/fd_pack.c): verified txns arrive
+from dedup, conflict-free microblocks go out to B bank stages, and each
+bank reports microblock completion back so its account locks release
+(fd_pack.c microblock_done / bank_busy fseqs).  This build's pipeline is
+always leader (the became_leader poh->pack message arrives when a poh stage
+precedes pack in a full validator; the synthetic pipeline produces blocks
+continuously).
+
+Inputs:  ins[0] = dedup->pack txns; ins[1+b] = bank b's done feedback.
+Outputs: outs[b] = pack->bank b microblock link.
+
+Microblock frame: u32 bank_seq | u16 txn_cnt | (u16 len || verified-frag)*
+where each verified-frag is payload||packed-desc||u16 (runtime/verify.py) —
+banks never reparse.
+
+Batching policy: a microblock is scheduled for an idle bank when at least
+`min_pending` txns are waiting or the oldest has waited `mb_deadline_s`
+(the same full-or-deadline shape as the verify stage's device batches).
+"""
+
+from __future__ import annotations
+
+import time
+
+from firedancer_tpu.pack.scheduler import Pack
+from firedancer_tpu.tango.rings import MCache
+from .stage import Stage
+from .verify import decode_verified
+
+
+class PackStage(Stage):
+    def __init__(
+        self,
+        *args,
+        bank_cnt: int = 2,
+        depth: int = 4096,
+        max_txn_per_microblock: int = 31,
+        min_pending: int = 8,
+        mb_deadline_s: float = 0.002,
+        **kwargs,
+    ):
+        super().__init__(*args, **kwargs)
+        if len(self.outs) != bank_cnt:
+            raise ValueError("need one output link per bank")
+        self.bank_cnt = bank_cnt
+        self.pack = Pack(
+            bank_cnt=bank_cnt,
+            depth=depth,
+            max_txn_per_microblock=max_txn_per_microblock,
+        )
+        self.min_pending = min_pending
+        self.mb_deadline_s = mb_deadline_s
+        self.force_flush = False  # end-of-run: drain regardless of policy
+        self._bank_busy = [False] * bank_cnt
+        self._mb_seq = 0
+        self._first_pending_at: float | None = None
+        # first-sig -> tsorig for end-to-end latency attribution; bounded:
+        # entries for txns evicted from the pool would otherwise leak
+        self._tsorig_by_sig: dict[bytes, int] = {}
+
+    # -- callbacks ----------------------------------------------------------
+
+    def after_frag(self, in_idx: int, meta, payload: bytes) -> None:
+        if in_idx == 0:
+            try:
+                p, desc = decode_verified(payload)
+            except ValueError:
+                self.metrics.inc("bad_frag")
+                return
+            if self.pack.insert(p, desc):
+                self.metrics.inc("txn_in")
+                if self._first_pending_at is None:
+                    self._first_pending_at = time.monotonic()
+                if len(self._tsorig_by_sig) > 2 * self.pack.depth:
+                    self._tsorig_by_sig.clear()
+                self._tsorig_by_sig[desc.signatures(p)[0]] = int(
+                    meta[MCache.COL_TSORIG]
+                )
+            else:
+                self.metrics.inc("txn_dropped")
+        else:
+            bank = in_idx - 1
+            self.pack.microblock_done(bank)
+            self._bank_busy[bank] = False
+            self.metrics.inc("microblock_done")
+
+    def after_credit(self) -> None:
+        if not self._ready_to_schedule():
+            return
+        for bank in range(self.bank_cnt):
+            if self._bank_busy[bank]:
+                continue
+            if self.outs[bank].cr_avail <= 0:
+                continue
+            chosen = self.pack.schedule_next_microblock(bank)
+            if not chosen:
+                chosen = self.pack.schedule_next_microblock(bank, votes=True)
+            if not chosen:
+                break  # nothing schedulable right now (conflicts/empty)
+            self._emit(bank, chosen)
+        if self.pack.pending_cnt() == 0:
+            self._first_pending_at = None
+
+    # -- internals ----------------------------------------------------------
+
+    def _ready_to_schedule(self) -> bool:
+        n = self.pack.pending_cnt()
+        if n == 0:
+            return False
+        if self.force_flush or n >= self.min_pending:
+            return True
+        return (
+            self._first_pending_at is not None
+            and time.monotonic() - self._first_pending_at >= self.mb_deadline_s
+        )
+
+    def _emit(self, bank: int, chosen) -> None:
+        from .verify import encode_verified
+
+        tsorig = 0
+        frame = bytearray()
+        frame += self._mb_seq.to_bytes(4, "little")
+        frame += len(chosen).to_bytes(2, "little")
+        for o in chosen:
+            frag = encode_verified(o.payload, o.desc)
+            frame += len(frag).to_bytes(2, "little")
+            frame += frag
+            ts = self._tsorig_by_sig.pop(o.first_sig(), 0)
+            # the microblock inherits its OLDEST txn's origin stamp
+            tsorig = min(tsorig, ts) if tsorig and ts else (tsorig or ts)
+        self._mb_seq += 1
+        self.publish(bank, bytes(frame), sig=self._mb_seq, tsorig=tsorig)
+        self._bank_busy[bank] = True
+        self.metrics.inc("microblocks")
+        self.metrics.inc("txn_scheduled", len(chosen))
+
+    def flush(self) -> None:
+        """Force remaining txns out (end of run); banks must keep draining
+        their done feedback for this to terminate."""
+        self.force_flush = True
+        self.after_credit()

@@ -63,6 +63,46 @@ def verify_segments_host(
     return [poh_append(s, n) == e for s, n, e in zip(starts, counts, ends)]
 
 
+def replay_entries(
+    seed: bytes, entries: list[tuple[int, bytes, list[bytes]]]
+) -> tuple[bool, list[tuple[bytes, int, bytes]]]:
+    """Re-run the PoH chain over wire entries (num_hashes, hash, txns) —
+    the validation-side check that a received block's clock is honest
+    (what the reference's replay does before executing a slot).
+
+    The mixin for a txn entry is sha256 over the txns' first signatures
+    (matching the bank stage's entry hash).  Returns (ok, segments) where
+    segments are the pure append runs (start, n, end) suitable for batched
+    TPU verification via verify_segments_tpu.
+    """
+    from firedancer_tpu.protocol import txn as ft
+
+    h = seed
+    segments = []
+    ok = True
+    for num_hashes, expect, txns in entries:
+        if txns and num_hashes < 1:
+            # a txn entry consumes at least its own mixin hash; accepting
+            # num_hashes=0 would let a block deflate the clock
+            return False, segments
+        n_append = num_hashes - (1 if txns else 0)
+        start = h
+        h = poh_append(h, n_append)
+        if n_append:
+            segments.append((start, n_append, h))
+        if txns:
+            sigs = []
+            for p in txns:
+                t = ft.txn_parse(p)
+                if t is None:
+                    return False, segments
+                sigs.append(t.signatures(p)[0])
+            h = poh_mixin(h, hashlib.sha256(b"".join(sigs)).digest())
+        if h != expect:
+            ok = False
+    return ok, segments
+
+
 def verify_segments_tpu(
     starts: list[bytes], count: int, ends: list[bytes]
 ) -> np.ndarray:

@@ -59,6 +59,11 @@ class Stage:
         self.cnc = cnc or Cnc()
         self.metrics = Metrics()
         self.lazy = lazy
+        # Stages that publish from after_frag set this so they never consume
+        # an input frag they couldn't forward (losing e.g. a lock-release
+        # message would wedge upstream; the reference makes such links
+        # reliable via credit flow, fd_topo.h:99-101).
+        self.require_credit = False
         self._rng = random.Random(seed ^ hash(name))
         self._next_housekeeping = 0
         self._iter = 0
@@ -82,12 +87,25 @@ class Stage:
 
     # -- the loop -----------------------------------------------------------
 
+    # cnc diagnostic word layout (read by the monitor, fd_cnc.h diag words)
+    DIAG_FRAGS_IN = 0
+    DIAG_FRAGS_OUT = 1
+    DIAG_OVERRUN = 2
+    DIAG_BACKPRESSURE = 3
+    DIAG_ITER = 4
+
     def _housekeeping(self) -> None:
         for c in self.ins:
             c.publish_progress()
         for p in self.outs:
             p.refresh_credits()
         self.cnc.heartbeat(time.monotonic_ns())
+        m = self.metrics
+        self.cnc.diag_set(self.DIAG_FRAGS_IN, m.get("frags_in"))
+        self.cnc.diag_set(self.DIAG_FRAGS_OUT, m.get("frags_out"))
+        self.cnc.diag_set(self.DIAG_OVERRUN, m.get("overrun"))
+        self.cnc.diag_set(self.DIAG_BACKPRESSURE, m.get("backpressure"))
+        self.cnc.diag_set(self.DIAG_ITER, self._iter)
         self.during_housekeeping()
         # randomized lazy interval: [lazy/2, 3*lazy/2) iterations
         self._next_housekeeping = self._iter + self.lazy // 2 + self._rng.randrange(
@@ -103,8 +121,18 @@ class Stage:
                 return False
         self.before_credit()
         backpressured = any(p.cr_avail <= 0 for p in self.outs)
+        if backpressured:
+            for p in self.outs:  # stale credits? re-read consumer fseqs
+                p.refresh_credits()
+            backpressured = any(p.cr_avail <= 0 for p in self.outs)
         if not backpressured:
             self.after_credit()
+        if self.require_credit and any(p.cr_avail <= 0 for p in self.outs):
+            # Re-checked AFTER after_credit: it may have spent the last
+            # credit (e.g. a poh tick entry), and consuming an input frag
+            # we can't forward would silently drop it.
+            self.metrics.inc("backpressure_stall")
+            return False
         progressed = False
         n_in = len(self.ins)
         for k in range(n_in):
@@ -143,9 +171,11 @@ class Stage:
 
     # -- helpers ------------------------------------------------------------
 
-    def publish(self, out_idx: int, payload: bytes, sig: int = 0) -> bool:
+    def publish(
+        self, out_idx: int, payload: bytes, sig: int = 0, tsorig: int = 0
+    ) -> bool:
         p = self.outs[out_idx]
-        ok = p.try_publish(payload, sig=sig)
+        ok = p.try_publish(payload, sig=sig, tsorig=tsorig)
         if ok:
             self.metrics.inc("frags_out")
         else:

@@ -1,0 +1,42 @@
+"""Store stage: consumes wire shreds, resolves FEC sets, stores batches.
+
+Pipeline position mirrors the reference's store tile
+(/root/reference/src/app/fdctl/run/tiles/fd_store.c — shreds into the
+blockstore) fused with the receive half of fd_fec_resolver.c: the e2e
+pipeline publishes every shred onto the wire link and this stage proves
+they reassemble — the same component a non-leader validator runs on
+turbine ingress.
+
+Inputs: ins[0] = shred -> store wire shreds.
+State:  completed FEC sets per slot + reassembled entry-batch bytes.
+"""
+
+from __future__ import annotations
+
+from firedancer_tpu.protocol import shred as fs
+from .fec_resolver import FecResolver
+from .stage import Stage
+
+
+class StoreStage(Stage):
+    def __init__(self, *args, verify_sig=None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.resolver = FecResolver(verify_sig=verify_sig, max_inflight=256)
+        self.sets_by_slot: dict[int, list] = {}
+
+    def after_frag(self, in_idx: int, meta, payload: bytes) -> None:
+        out = self.resolver.add_shred(payload)
+        self.metrics.inc("shreds_in")
+        if out is not None:
+            self.sets_by_slot.setdefault(out.slot, []).append(out)
+            self.metrics.inc("sets_stored")
+
+    def entry_batch_bytes(self, slot: int) -> bytes:
+        """Reassembled data-shred payloads for `slot`, in fec_set order."""
+        sets = sorted(self.sets_by_slot.get(slot, []), key=lambda s: s.fec_set_idx)
+        out = bytearray()
+        for st in sets:
+            for buf in st.data_shreds:
+                sh = fs.parse(buf)
+                out += sh.payload(buf)
+        return bytes(out)

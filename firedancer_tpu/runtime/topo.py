@@ -1,0 +1,246 @@
+"""Process topology runner: spawn stages as processes, supervise, monitor.
+
+The process-isolation model of the reference
+(/root/reference/src/disco/topo/fd_topo_run.c:50-190 boots one tile per
+process; src/app/fdctl/run/run.c:252-330 is the parent that watches the
+brood and kills the whole topology when any tile dies): a Topology is a
+declarative description of links and stages; `launch` creates every shm
+link, spawns one OS process per stage (fork), hands each its Consumers /
+Producers / a shared-memory cnc, and returns a handle whose supervisor
+loop watches process liveness and cnc heartbeats.  One dead or wedged
+stage takes the whole topology down — crash containment by process
+boundary, not by try/except.
+
+The monitor (`snapshot` / `format_monitor`) is the fdctl-monitor analog
+(src/app/fdctl/monitor/monitor.c): per-stage heartbeat age and the diag
+counters each stage exports during housekeeping (frags in/out, overruns,
+backpressure).
+
+Stage construction runs IN THE CHILD: specs carry a builder callable
+invoked after the links are joined, so device handles / caches are never
+shared across fork.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import time
+from dataclasses import dataclass, field
+from multiprocessing import shared_memory
+
+import numpy as np
+
+from firedancer_tpu.tango import rings, shm
+from firedancer_tpu.tango.rings import CNC_SIG_FAIL, CNC_SIG_HALT, CNC_SIG_RUN, Cnc
+from firedancer_tpu.utils import log as fl
+
+_log = fl.get_logger("topo")
+
+
+@dataclass
+class LinkSpec:
+    name: str
+    depth: int = 1024
+    mtu: int = 4096
+    n_consumers: int = 1
+
+
+@dataclass
+class StageSpec:
+    """builder(links: dict[str, ShmLink], cnc: Cnc) -> Stage; runs in child."""
+
+    name: str
+    builder: object
+    kwargs: dict = field(default_factory=dict)
+
+
+@dataclass
+class Topology:
+    links: list[LinkSpec] = field(default_factory=list)
+    stages: list[StageSpec] = field(default_factory=list)
+
+    def link(self, name: str, **kw) -> "LinkSpec":
+        spec = LinkSpec(name, **kw)
+        self.links.append(spec)
+        return spec
+
+    def stage(self, name: str, builder, **kwargs) -> "StageSpec":
+        spec = StageSpec(name, builder, kwargs)
+        self.stages.append(spec)
+        return spec
+
+
+def _cnc_shm_name(uid: str, stage: str) -> str:
+    return f"fdtpu_cnc_{uid}_{stage}"
+
+
+def _stage_main(spec: StageSpec, link_names: dict, uid: str) -> None:
+    """Child entry: join links + cnc, build the stage, run until HALT."""
+    cnc_shm = shared_memory.SharedMemory(name=_cnc_shm_name(uid, spec.name))
+    cnc = Cnc(np.frombuffer(cnc_shm.buf, dtype=rings.U64, count=2 + Cnc.NDIAG))
+    links = {n: shm.ShmLink.join(sn) for n, sn in link_names.items()}
+    try:
+        stage = spec.builder(links, cnc, **spec.kwargs)
+        stage.run()
+    except Exception:
+        cnc.signal = CNC_SIG_FAIL
+        raise
+
+
+class TopologyHandle:
+    def __init__(self, topo, uid, links, cncs, cnc_shms, procs):
+        self.topo = topo
+        self.uid = uid
+        self.links = links  # name -> ShmLink (parent-side joins)
+        self.cncs = cncs  # stage name -> Cnc
+        self._cnc_shms = cnc_shms
+        self.procs = procs  # stage name -> mp.Process
+        self.failed: str | None = None
+
+    # -- supervision --------------------------------------------------------
+
+    def supervise(
+        self,
+        *,
+        until=None,
+        timeout_s: float = 30.0,
+        heartbeat_timeout_s: float = 5.0,
+        poll_s: float = 0.02,
+    ) -> bool:
+        """Watchdog loop (run.c:252-330): returns True when `until()` says
+        done; kills the whole topology and returns False if any stage dies,
+        signals FAIL, or stops heartbeating."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if until is not None and until(self):
+                return True
+            now = time.monotonic_ns()
+            for name, p in self.procs.items():
+                cnc = self.cncs[name]
+                if not p.is_alive() or cnc.signal == CNC_SIG_FAIL:
+                    self.failed = name
+                    _log.warning(
+                        f"stage '{name}' died (alive={p.is_alive()}, "
+                        f"signal={cnc.signal}); killing topology"
+                    )
+                    self.kill()
+                    return False
+                hb = cnc.last_heartbeat
+                if hb and now - hb > heartbeat_timeout_s * 1e9:
+                    self.failed = name
+                    _log.warning(
+                        f"stage '{name}' heartbeat stale "
+                        f"({(now - hb) / 1e9:.1f}s); killing topology"
+                    )
+                    self.kill()
+                    return False
+            time.sleep(poll_s)
+        return until is None  # plain timeout counts as failure iff waiting
+
+    def halt(self, timeout_s: float = 10.0) -> None:
+        """Clean shutdown: HALT every cnc, join, terminate stragglers."""
+        for cnc in self.cncs.values():
+            if cnc.signal != CNC_SIG_FAIL:
+                cnc.signal = CNC_SIG_HALT
+        deadline = time.monotonic() + timeout_s
+        for p in self.procs.values():
+            p.join(max(deadline - time.monotonic(), 0.1))
+        self.kill()
+
+    def kill(self) -> None:
+        for p in self.procs.values():
+            if p.is_alive():
+                p.terminate()
+        for p in self.procs.values():
+            p.join(timeout=5)
+
+    def close(self) -> None:
+        self.kill()
+        for link in self.links.values():
+            link.close()
+            try:
+                link.unlink()
+            except FileNotFoundError:
+                pass
+        for s in self._cnc_shms.values():
+            try:
+                s.close()
+                s.unlink()
+            except (BufferError, FileNotFoundError):
+                pass
+
+    # -- monitor ------------------------------------------------------------
+
+    def snapshot(self) -> list[dict]:
+        """Per-stage liveness + diag counters (the monitor sample)."""
+        from firedancer_tpu.runtime.stage import Stage
+
+        now = time.monotonic_ns()
+        out = []
+        for name, p in self.procs.items():
+            cnc = self.cncs[name]
+            hb = cnc.last_heartbeat
+            out.append(
+                {
+                    "stage": name,
+                    "alive": p.is_alive(),
+                    "signal": cnc.signal,
+                    "heartbeat_age_ms": (now - hb) / 1e6 if hb else None,
+                    "frags_in": cnc.diag(Stage.DIAG_FRAGS_IN),
+                    "frags_out": cnc.diag(Stage.DIAG_FRAGS_OUT),
+                    "overrun": cnc.diag(Stage.DIAG_OVERRUN),
+                    "backpressure": cnc.diag(Stage.DIAG_BACKPRESSURE),
+                    "iters": cnc.diag(Stage.DIAG_ITER),
+                }
+            )
+        return out
+
+    def format_monitor(self) -> str:
+        rows = self.snapshot()
+        hdr = (
+            f"{'stage':<12}{'alive':<7}{'hb_ms':>8}{'in':>10}{'out':>10}"
+            f"{'ovrn':>7}{'bkp':>7}"
+        )
+        lines = [hdr]
+        for r in rows:
+            hb = f"{r['heartbeat_age_ms']:.1f}" if r["heartbeat_age_ms"] else "-"
+            lines.append(
+                f"{r['stage']:<12}{str(r['alive']):<7}{hb:>8}"
+                f"{r['frags_in']:>10}{r['frags_out']:>10}"
+                f"{r['overrun']:>7}{r['backpressure']:>7}"
+            )
+        return "\n".join(lines)
+
+
+def launch(topo: Topology) -> TopologyHandle:
+    ctx = mp.get_context("fork")  # builders may close over local state
+    uid = f"{os.getpid()}_{int(time.monotonic_ns() % 1_000_000)}"
+    links: dict[str, shm.ShmLink] = {}
+    link_names: dict[str, str] = {}
+    for spec in topo.links:
+        sn = f"fdtpu_{spec.name}_{uid}"
+        links[spec.name] = shm.ShmLink.create(
+            sn, depth=spec.depth, mtu=spec.mtu, n_fseq=spec.n_consumers
+        )
+        link_names[spec.name] = sn
+    cncs: dict[str, Cnc] = {}
+    cnc_shms: dict[str, shared_memory.SharedMemory] = {}
+    for spec in topo.stages:
+        s = shared_memory.SharedMemory(
+            name=_cnc_shm_name(uid, spec.name), create=True, size=Cnc.footprint()
+        )
+        cnc_shms[spec.name] = s
+        cncs[spec.name] = Cnc(
+            np.frombuffer(s.buf, dtype=rings.U64, count=2 + Cnc.NDIAG)
+        )
+    procs: dict[str, mp.Process] = {}
+    for spec in topo.stages:
+        p = ctx.Process(
+            target=_stage_main, args=(spec, link_names, uid), name=spec.name
+        )
+        p.daemon = True
+        p.start()
+        procs[spec.name] = p
+        _log.info(f"spawned stage '{spec.name}' pid={p.pid}")
+    return TopologyHandle(topo, uid, links, cncs, cnc_shms, procs)

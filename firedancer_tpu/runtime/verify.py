@@ -34,8 +34,10 @@ from dataclasses import dataclass
 import numpy as np
 
 from firedancer_tpu.protocol import txn as ft
-from firedancer_tpu.tango.rings import TCache
+from firedancer_tpu.tango.rings import MCache, TCache
 from .stage import Stage
+
+MCACHE_COL_TSORIG = MCache.COL_TSORIG
 
 VERIFY_TCACHE_DEPTH = 16  # tiny by design (fd_verify.h:6-7)
 
@@ -52,6 +54,7 @@ class _Pending:
     payloads: list[bytes]
     descs: list[ft.Txn]
     elem_ranges: list[tuple[int, int]]
+    tsorigs: list[int]
     n_elems: int
     result: object  # jax array future
 
@@ -82,6 +85,7 @@ class VerifyStage(Stage):
         self._cur_descs: list[ft.Txn] = []
         self._cur_elems: list[tuple[bytes, bytes, bytes]] = []  # (msg, sig, pk)
         self._cur_ranges: list[tuple[int, int]] = []
+        self._cur_tsorigs: list[int] = []
         self._opened_at = 0.0
         self._inflight: list[_Pending] = []
 
@@ -120,6 +124,7 @@ class VerifyStage(Stage):
         self._cur_ranges.append((start, len(self._cur_elems)))
         self._cur_payloads.append(payload)
         self._cur_descs.append(t)
+        self._cur_tsorigs.append(int(meta[MCACHE_COL_TSORIG]))
         if len(self._cur_elems) >= self.batch:
             self._close_batch()
 
@@ -166,6 +171,7 @@ class VerifyStage(Stage):
                 payloads=self._cur_payloads,
                 descs=self._cur_descs,
                 elem_ranges=self._cur_ranges,
+                tsorigs=self._cur_tsorigs,
                 n_elems=n,
                 result=result,
             )
@@ -174,6 +180,7 @@ class VerifyStage(Stage):
         self.metrics.inc("batch_elems", n)
         self._cur_payloads, self._cur_descs = [], []
         self._cur_elems, self._cur_ranges = [], []
+        self._cur_tsorigs = []
 
     def _drain(self, block: bool) -> None:
         while self._inflight:
@@ -186,21 +193,23 @@ class VerifyStage(Stage):
                     return
             mask = np.asarray(head.result)
             self._inflight.pop(0)
-            for payload, desc, (a, b) in zip(
-                head.payloads, head.descs, head.elem_ranges
+            for payload, desc, (a, b), tsorig in zip(
+                head.payloads, head.descs, head.elem_ranges, head.tsorigs
             ):
                 if bool(mask[a:b].all()):
-                    self._emit(payload, desc)
+                    self._emit(payload, desc, tsorig)
                 else:
                     self.metrics.inc("verify_fail")
             if block:
                 break
 
-    def _emit(self, payload: bytes, desc: ft.Txn) -> None:
+    def _emit(self, payload: bytes, desc: ft.Txn, tsorig: int = 0) -> None:
         out = encode_verified(payload, desc)
         if self.outs:
             # first signature's tag rides in the frag sig for cheap dedup
-            self.publish(0, out, sig=sig_tag(desc.signatures(payload)[0]))
+            self.publish(
+                0, out, sig=sig_tag(desc.signatures(payload)[0]), tsorig=tsorig
+            )
         self.metrics.inc("txn_verified")
 
     def flush(self) -> None:

@@ -15,6 +15,7 @@ Layout (8-byte aligned):
 
 from __future__ import annotations
 
+import time
 from multiprocessing import shared_memory
 
 import numpy as np
@@ -22,6 +23,11 @@ import numpy as np
 from . import rings
 
 _HDR = 4 * 8  # depth, mtu, n_fseq, pad
+
+
+def now_ns() -> int:
+    """The frag-timestamp clock (tsorig/tspub, fd_tango_base.h:48-60)."""
+    return time.monotonic_ns()
 
 
 def _layout(depth: int, mtu: int, n_fseq: int):
@@ -105,15 +111,27 @@ class Producer:
         self.cr_avail = self.fctl.credits(self.seq)
 
     def try_publish(self, payload: bytes, sig: int = 0, tsorig: int = 0) -> bool:
-        """Publish if credits allow; False means backpressured."""
+        """Publish if credits allow; False means backpressured.
+
+        tsorig is the frag's *origin* timestamp, carried unchanged down the
+        whole pipeline for end-to-end latency attribution; tspub is stamped
+        here at every hop (fd_tango_base.h:48-60).  tsorig=0 means "this
+        stage is the origin" and stamps now.
+        """
         if self.cr_avail <= 0:
             self.refresh_credits()
             if self.cr_avail <= 0:
                 return False
+        ts = now_ns()
         chunk = self.link.dcache.alloc(len(payload))
         self.link.dcache.write(chunk, payload)
         self.link.mcache.publish(
-            self.seq, sig=sig, chunk=chunk, sz=len(payload), tsorig=tsorig
+            self.seq,
+            sig=sig,
+            chunk=chunk,
+            sz=len(payload),
+            tsorig=tsorig or ts,
+            tspub=ts,
         )
         self.seq += 1
         self.cr_avail -= 1

@@ -1,0 +1,137 @@
+"""Layered TOML configuration -> typed config (the fdctl config system).
+
+The reference embeds a default.toml, overlays the operator's --config TOML,
+and parses the result into one typed config_t struct, rejecting unknown
+keys (/root/reference/src/app/fdctl/config_parse.c; defaults
+src/app/fdctl/config/default.toml).  Same shape here: DEFAULTS below is
+the embedded layer, `load_config` deep-merges an optional TOML file and
+explicit overrides on top, validates every key against the dataclass
+schema (unknown keys are hard errors — silent typos in operator config
+are how validators die), and returns a typed `Config`.
+
+Topology is *derived* from config by code (models/leader.py
+build_leader_pipeline takes these values), not data — matching the
+reference's split between config_parse and topos/fd_frankendancer.c.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import tomllib
+from dataclasses import dataclass, field
+
+
+@dataclass
+class LayoutConfig:
+    verify_stage_count: int = 1
+    bank_stage_count: int = 2
+
+
+@dataclass
+class VerifyConfig:
+    batch: int = 256
+    max_msg_len: int = 1232
+    batch_deadline_ms: float = 2.0
+    max_inflight: int = 3
+    receive_buffer_depth: int = 1024
+
+
+@dataclass
+class PackConfig:
+    depth: int = 4096
+    max_txn_per_microblock: int = 31
+    min_pending: int = 8
+    microblock_deadline_ms: float = 2.0
+
+
+@dataclass
+class PohConfig:
+    hashes_per_tick: int = 64
+    ticks_per_slot: int = 8
+    hashes_per_iter: int = 16
+
+
+@dataclass
+class ShredConfig:
+    shred_version: int = 1
+    batch_target_sz: int = 16384
+
+
+@dataclass
+class NetConfig:
+    listen_host: str = "127.0.0.1"
+    listen_port: int = 0
+    rx_burst: int = 64
+
+
+@dataclass
+class LogConfig:
+    path: str = ""
+    level_stderr: str = "NOTICE"
+    level_file: str = "INFO"
+
+
+@dataclass
+class Config:
+    layout: LayoutConfig = field(default_factory=LayoutConfig)
+    verify: VerifyConfig = field(default_factory=VerifyConfig)
+    pack: PackConfig = field(default_factory=PackConfig)
+    poh: PohConfig = field(default_factory=PohConfig)
+    shred: ShredConfig = field(default_factory=ShredConfig)
+    net: NetConfig = field(default_factory=NetConfig)
+    log: LogConfig = field(default_factory=LogConfig)
+
+
+class ConfigError(ValueError):
+    pass
+
+
+def _merge_into(obj, data: dict, path: str) -> None:
+    """Apply a nested dict onto a dataclass tree, strictly typed."""
+    names = {f.name: f for f in dataclasses.fields(obj)}
+    for key, val in data.items():
+        if key not in names:
+            raise ConfigError(f"unknown config key '{path}{key}'")
+        cur = getattr(obj, key)
+        if dataclasses.is_dataclass(cur):
+            if not isinstance(val, dict):
+                raise ConfigError(f"'{path}{key}' must be a table")
+            _merge_into(cur, val, f"{path}{key}.")
+            continue
+        want = type(cur)
+        if want is float and isinstance(val, int):
+            val = float(val)
+        if not isinstance(val, want) or isinstance(val, bool) != (want is bool):
+            raise ConfigError(
+                f"'{path}{key}' must be {want.__name__}, "
+                f"got {type(val).__name__}"
+            )
+        setattr(obj, key, val)
+
+
+def load_config(
+    path: str | None = None, overrides: dict | None = None
+) -> Config:
+    """defaults <- TOML file at `path` <- `overrides` dict, validated."""
+    cfg = Config()
+    if path is not None:
+        with open(path, "rb") as f:
+            data = tomllib.load(f)
+        _merge_into(cfg, data, "")
+    if overrides:
+        _merge_into(cfg, overrides, "")
+    _validate(cfg)
+    return cfg
+
+
+def _validate(cfg: Config) -> None:
+    if cfg.layout.verify_stage_count < 1:
+        raise ConfigError("layout.verify_stage_count must be >= 1")
+    if not 1 <= cfg.layout.bank_stage_count <= 62:  # fd_pack.h MAX_BANK_TILES
+        raise ConfigError("layout.bank_stage_count must be in [1, 62]")
+    if cfg.verify.batch < 1 or cfg.verify.batch & (cfg.verify.batch - 1):
+        raise ConfigError("verify.batch must be a power of 2")
+    if cfg.poh.hashes_per_tick < 1 or cfg.poh.ticks_per_slot < 1:
+        raise ConfigError("poh cadence must be positive")
+    if cfg.shred.batch_target_sz < 1:
+        raise ConfigError("shred.batch_target_sz must be positive")

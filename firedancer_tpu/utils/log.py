@@ -1,0 +1,119 @@
+"""Two-stream leveled logging (the util/log layer).
+
+Semantics follow the reference's fd_log (/root/reference/src/util/log,
+levels documented in src/app/fdctl/config/default.toml:69-82): eight
+syslog-style levels; an *ephemeral* stream to stderr for the operator and
+a *permanent* stream to a logfile for forensics, each with its own level
+threshold.  WARNING+ always flushes; ERR+ raises by default in-process
+(the reference aborts the tile — crash containment is the supervisor's
+job, fd_topo_run.c).
+
+Config by env (read at first use, override with init()):
+    FDTPU_LOG_PATH          logfile path ("" disables the permanent stream)
+    FDTPU_LOG_LEVEL_STDERR  default NOTICE
+    FDTPU_LOG_LEVEL_FILE    default INFO
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+DEBUG, INFO, NOTICE, WARNING, ERR, CRIT, ALERT, EMERG = range(8)
+_NAMES = ["DEBUG", "INFO", "NOTICE", "WARNING", "ERR", "CRIT", "ALERT", "EMERG"]
+_BY_NAME = {n: i for i, n in enumerate(_NAMES)}
+
+
+class LogError(RuntimeError):
+    """Raised for ERR+ logs (the fd_log abort analog, catchable in python)."""
+
+
+class _LogState:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.stderr_level = _BY_NAME.get(
+            os.environ.get("FDTPU_LOG_LEVEL_STDERR", "NOTICE"), NOTICE
+        )
+        self.file_level = _BY_NAME.get(
+            os.environ.get("FDTPU_LOG_LEVEL_FILE", "INFO"), INFO
+        )
+        self.path = os.environ.get("FDTPU_LOG_PATH", "")
+        self._file = None
+        self.raise_on_err = True
+
+    def file(self):
+        if self._file is None and self.path:
+            self._file = open(self.path, "a", buffering=1)
+        return self._file
+
+
+_state = _LogState()
+
+
+def init(
+    *,
+    path: str | None = None,
+    stderr_level: int | None = None,
+    file_level: int | None = None,
+    raise_on_err: bool | None = None,
+) -> None:
+    with _state.lock:
+        if path is not None:
+            _state.path = path
+            _state._file = None
+        if stderr_level is not None:
+            _state.stderr_level = stderr_level
+        if file_level is not None:
+            _state.file_level = file_level
+        if raise_on_err is not None:
+            _state.raise_on_err = raise_on_err
+
+
+def _emit(level: int, tag: str, msg: str) -> None:
+    if level < min(_state.stderr_level, _state.file_level) and level < ERR:
+        return
+    ts = time.strftime("%H:%M:%S", time.localtime())
+    line = f"{ts} {_NAMES[level]:<7} {os.getpid()} {tag}: {msg}"
+    with _state.lock:
+        if level >= _state.stderr_level:
+            print(line, file=sys.stderr)
+            if level >= WARNING:
+                sys.stderr.flush()
+        f = _state.file()
+        if f is not None and level >= _state.file_level:
+            f.write(line + "\n")
+    if level >= ERR and _state.raise_on_err:
+        raise LogError(msg)
+
+
+class Logger:
+    """Per-component handle; `tag` prefixes every line (the tile name)."""
+
+    __slots__ = ("tag",)
+
+    def __init__(self, tag: str):
+        self.tag = tag
+
+    def debug(self, msg: str) -> None:
+        _emit(DEBUG, self.tag, msg)
+
+    def info(self, msg: str) -> None:
+        _emit(INFO, self.tag, msg)
+
+    def notice(self, msg: str) -> None:
+        _emit(NOTICE, self.tag, msg)
+
+    def warning(self, msg: str) -> None:
+        _emit(WARNING, self.tag, msg)
+
+    def err(self, msg: str) -> None:
+        _emit(ERR, self.tag, msg)
+
+    def crit(self, msg: str) -> None:
+        _emit(CRIT, self.tag, msg)
+
+
+def get_logger(tag: str) -> Logger:
+    return Logger(tag)

@@ -1,0 +1,183 @@
+"""Schema-driven metrics: declared layout -> flat u64 array -> Prometheus.
+
+The reference compiles metrics.xml into per-tile accessor headers over a
+plain ulong array in shared memory, then a metric tile serves Prometheus
+(/root/reference/src/disco/metrics/fd_metrics.h:22-47,
+run/tiles/fd_metric.c).  Same shape here: a MetricsSchema declares
+counters/gauges/histograms per stage kind, MetricsRegistry lays them out
+in one flat uint64 numpy array (shared-memory-backable, so a monitor
+process reads producers' metrics without cooperation), and
+render_prometheus emits the text exposition format.
+
+Histograms are fixed-bucket log-spaced (the fd_histf shape): `buckets`
+edges; value counts land in the first bucket whose edge >= value, plus a
++Inf overflow bucket and a running sum for averages.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+COUNTER = "counter"
+GAUGE = "gauge"
+HISTOGRAM = "histogram"
+
+
+@dataclass(frozen=True)
+class MetricDef:
+    name: str
+    kind: str
+    help: str = ""
+    buckets: tuple = ()  # histogram edges, ascending
+
+    def words(self) -> int:
+        if self.kind == HISTOGRAM:
+            return len(self.buckets) + 2  # buckets + overflow + sum
+        return 1
+
+
+@dataclass
+class MetricsSchema:
+    defs: list[MetricDef] = field(default_factory=list)
+
+    def counter(self, name: str, help: str = "") -> "MetricsSchema":
+        self.defs.append(MetricDef(name, COUNTER, help))
+        return self
+
+    def gauge(self, name: str, help: str = "") -> "MetricsSchema":
+        self.defs.append(MetricDef(name, GAUGE, help))
+        return self
+
+    def histogram(self, name: str, buckets, help: str = "") -> "MetricsSchema":
+        edges = tuple(buckets)
+        if list(edges) != sorted(edges) or not edges:
+            raise ValueError("histogram buckets must be ascending, non-empty")
+        self.defs.append(MetricDef(name, HISTOGRAM, help, edges))
+        return self
+
+    def footprint(self) -> int:
+        return sum(d.words() for d in self.defs)
+
+
+def exp_buckets(lo: float, hi: float, n: int) -> tuple:
+    """Log-spaced bucket edges (the fd_histf approximate-exponential shape)."""
+    return tuple(float(x) for x in np.geomspace(lo, hi, n))
+
+
+class MetricsRegistry:
+    """One stage's metric words over a (shareable) uint64 array."""
+
+    def __init__(self, schema: MetricsSchema, buf: np.ndarray | None = None):
+        self.schema = schema
+        n = schema.footprint()
+        self.words = buf if buf is not None else np.zeros(n, dtype=np.uint64)
+        if len(self.words) < n:
+            raise ValueError("buffer too small for schema")
+        self._off: dict[str, tuple[MetricDef, int]] = {}
+        off = 0
+        for d in schema.defs:
+            self._off[d.name] = (d, off)
+            off += d.words()
+
+    # -- producers ----------------------------------------------------------
+
+    def inc(self, name: str, v: int = 1) -> None:
+        d, off = self._off[name]
+        if d.kind not in (COUNTER, GAUGE):
+            raise TypeError(f"{name} is a {d.kind}")
+        self.words[off] += np.uint64(v)
+
+    def set(self, name: str, v: int) -> None:
+        d, off = self._off[name]
+        if d.kind != GAUGE:
+            raise TypeError(f"{name} is a {d.kind}")
+        self.words[off] = np.uint64(v)
+
+    def observe(self, name: str, value: float) -> None:
+        d, off = self._off[name]
+        if d.kind != HISTOGRAM:
+            raise TypeError(f"{name} is a {d.kind}")
+        idx = int(np.searchsorted(np.asarray(d.buckets), value, side="left"))
+        self.words[off + idx] += np.uint64(1)  # overflow lands at len(buckets)
+        self.words[off + len(d.buckets) + 1] += np.uint64(max(int(value), 0))
+
+    # -- readers ------------------------------------------------------------
+
+    def get(self, name: str) -> int:
+        d, off = self._off[name]
+        if d.kind == HISTOGRAM:
+            raise TypeError("use hist() for histograms")
+        return int(self.words[off])
+
+    def hist(self, name: str) -> dict:
+        d, off = self._off[name]
+        counts = [int(self.words[off + i]) for i in range(len(d.buckets) + 1)]
+        return {
+            "buckets": list(d.buckets),
+            "counts": counts,
+            "sum": int(self.words[off + len(d.buckets) + 1]),
+            "count": sum(counts),
+        }
+
+    def quantile(self, name: str, q: float) -> float:
+        """Upper-edge estimate of the q-quantile from bucket counts."""
+        h = self.hist(name)
+        total = h["count"]
+        if total == 0:
+            return 0.0
+        target = q * total
+        run = 0
+        for edge, c in zip(h["buckets"] + [float("inf")], h["counts"]):
+            run += c
+            if run >= target:
+                return edge
+        return float("inf")
+
+
+def render_prometheus(stages: dict[str, MetricsRegistry]) -> str:
+    """Text exposition over {stage_name: registry} (fd_metric.c's endpoint)."""
+    seen_help: set[str] = set()
+    lines: list[str] = []
+    for stage, reg in stages.items():
+        for d in reg.schema.defs:
+            if d.name not in seen_help:
+                seen_help.add(d.name)
+                if d.help:
+                    lines.append(f"# HELP {d.name} {d.help}")
+                lines.append(f"# TYPE {d.name} {d.kind}")
+            label = f'{{stage="{stage}"}}'
+            if d.kind == HISTOGRAM:
+                h = reg.hist(d.name)
+                run = 0
+                for edge, c in zip(h["buckets"], h["counts"]):
+                    run += c
+                    lines.append(
+                        f'{d.name}_bucket{{stage="{stage}",le="{edge}"}} {run}'
+                    )
+                lines.append(
+                    f'{d.name}_bucket{{stage="{stage}",le="+Inf"}} {h["count"]}'
+                )
+                lines.append(f"{d.name}_sum{label} {h['sum']}")
+                lines.append(f"{d.name}_count{label} {h['count']}")
+            else:
+                lines.append(f"{d.name}{label} {reg.get(d.name)}")
+    return "\n".join(lines) + "\n"
+
+
+# The stage-loop schema every pipeline stage shares (the "all tiles" block
+# of metrics.xml): frag counters + latency histograms.
+def stage_schema() -> MetricsSchema:
+    return (
+        MetricsSchema()
+        .counter("frags_in", "fragments consumed")
+        .counter("frags_out", "fragments published")
+        .counter("overrun", "input overruns detected")
+        .counter("backpressure", "publishes dropped for credits")
+        .histogram(
+            "frag_latency_ns",
+            exp_buckets(1e3, 1e10, 24),
+            "tsorig->processing latency per frag",
+        )
+    )

@@ -1,0 +1,87 @@
+"""TOML config system tests (config_parse.c analog): defaults, layered
+override, strict unknown-key/type rejection, validation rules."""
+
+import pytest
+
+from firedancer_tpu.utils.config import Config, ConfigError, load_config
+
+
+def test_defaults():
+    cfg = load_config()
+    assert cfg.layout.verify_stage_count == 1
+    assert cfg.verify.batch == 256
+    assert cfg.poh.hashes_per_tick == 64
+
+
+def test_toml_overlay(tmp_path):
+    p = tmp_path / "op.toml"
+    p.write_text(
+        """
+[layout]
+verify_stage_count = 4
+bank_stage_count = 8
+
+[verify]
+batch = 1024
+batch_deadline_ms = 0.5
+
+[log]
+path = "/tmp/fd.log"
+"""
+    )
+    cfg = load_config(str(p))
+    assert cfg.layout.verify_stage_count == 4
+    assert cfg.layout.bank_stage_count == 8
+    assert cfg.verify.batch == 1024
+    assert cfg.verify.batch_deadline_ms == 0.5
+    assert cfg.log.path == "/tmp/fd.log"
+    # untouched sections keep defaults
+    assert cfg.poh.ticks_per_slot == 8
+
+
+def test_overrides_beat_file(tmp_path):
+    p = tmp_path / "op.toml"
+    p.write_text("[verify]\nbatch = 512\n")
+    cfg = load_config(str(p), overrides={"verify": {"batch": 128}})
+    assert cfg.verify.batch == 128
+
+
+def test_unknown_key_rejected(tmp_path):
+    p = tmp_path / "op.toml"
+    p.write_text("[verify]\nbathc = 512\n")  # typo must be fatal
+    with pytest.raises(ConfigError, match="unknown config key 'verify.bathc'"):
+        load_config(str(p))
+    with pytest.raises(ConfigError, match="unknown config key 'vrfy'"):
+        load_config(overrides={"vrfy": {}})
+
+
+def test_type_mismatch_rejected(tmp_path):
+    p = tmp_path / "op.toml"
+    p.write_text('[verify]\nbatch = "lots"\n')
+    with pytest.raises(ConfigError, match="verify.batch"):
+        load_config(str(p))
+
+
+def test_validation_rules():
+    with pytest.raises(ConfigError, match="bank_stage_count"):
+        load_config(overrides={"layout": {"bank_stage_count": 63}})
+    with pytest.raises(ConfigError, match="power of 2"):
+        load_config(overrides={"verify": {"batch": 100}})
+
+
+def test_config_drives_topology():
+    from firedancer_tpu.models.leader import build_leader_pipeline_from_config
+
+    cfg = load_config(
+        overrides={
+            "layout": {"verify_stage_count": 2, "bank_stage_count": 3},
+            "verify": {"batch": 32, "max_msg_len": 256},
+        }
+    )
+    pipe = build_leader_pipeline_from_config(cfg, pool_size=4, gen_limit=0)
+    try:
+        assert len(pipe.verifies) == 2
+        assert len(pipe.banks) == 3
+        assert pipe.verifies[0].batch == 32
+    finally:
+        pipe.close()

@@ -187,3 +187,25 @@ def test_double_scalar_mul_base(rng):
         for k, s, p in zip(ks, ss, pts)
     ]
     assert got == expect
+
+
+def test_windowed_matches_ladder(rng):
+    """Differential: the windowed fast path == the 1-bit Shamir ladder on
+    random (k, s, A) triples (both must equal the host ref, but checking
+    them against each other catches shared-helper regressions too)."""
+    ks = [int.from_bytes(rng.bytes(32), "little") % L for _ in range(4)]
+    ss = [int.from_bytes(rng.bytes(32), "little") % L for _ in range(4)]
+    pts = rand_points(rng, 4)
+    enc = bytes_cols([ref.point_compress(p) for p in pts])
+
+    def sc(vals):
+        return fs.sc_frombytes(
+            bytes_cols([int.to_bytes(v, 32, "little") for v in vals])
+        )
+
+    kb = jax.jit(fs.sc_bits)(sc(ks))
+    sb = jax.jit(fs.sc_bits)(sc(ss))
+    a, _ = jax.jit(fc.point_decompress)(enc)
+    fast = points_from_jax(jax.jit(fc.double_scalar_mul_base)(kb, a, sb))
+    slow = points_from_jax(jax.jit(fc.double_scalar_mul_base_ladder)(kb, a, sb))
+    assert fast == slow  # affine (x, y) pairs

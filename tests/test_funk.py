@@ -1,0 +1,147 @@
+"""funk fork-aware DB tests: fork tree prepare/publish/cancel, overlay
+queries, tombstones, frozen-txn protection, competing-fork resolution —
+the fd_funk_txn.c / fd_funk_rec.c semantics."""
+
+import pytest
+
+from firedancer_tpu.funk import ERR_FROZEN, ERR_KEY, ERR_TXN, Funk, FunkError
+
+
+def test_root_records():
+    f = Funk()
+    f.rec_insert(None, b"k1", b"v1")
+    assert f.rec_query(None, b"k1") == b"v1"
+    assert f.rec_query(None, b"nope") is None
+    f.rec_remove(None, b"k1")
+    assert f.rec_query(None, b"k1") is None
+    with pytest.raises(FunkError) as e:
+        f.rec_remove(None, b"k1")
+    assert e.value.code == ERR_KEY
+
+
+def test_overlay_query_through_ancestors():
+    f = Funk()
+    f.rec_insert(None, b"acct", b"root-v")
+    a = f.txn_prepare(None, b"A")
+    b = f.txn_prepare(a, b"B")
+    # unmodified: reads through to root
+    assert f.rec_query(b, b"acct") == b"root-v"
+    # B speculates a new value (before freezing it with a child)
+    f.rec_insert(b, b"acct", b"B-v")
+    c = f.txn_prepare(b, b"C")
+    # C sees B's overlay, A does not
+    assert f.rec_query(c, b"acct") == b"B-v"
+    assert f.rec_query(b, b"acct") == b"B-v"
+    assert f.rec_query(a, b"acct") == b"root-v"
+    # C overrides again; nearest overlay wins
+    f.rec_insert(c, b"acct", b"C-v")
+    assert f.rec_query(c, b"acct") == b"C-v"
+    assert f.rec_query(b, b"acct") == b"B-v"
+
+
+def test_tombstone_hides_root():
+    f = Funk()
+    f.rec_insert(None, b"k", b"v")
+    a = f.txn_prepare(None, b"A")
+    f.rec_remove(a, b"k")
+    assert f.rec_query(a, b"k") is None
+    assert f.rec_query(None, b"k") == b"v"  # root untouched until publish
+    f.txn_publish(a)
+    assert f.rec_query(None, b"k") is None
+
+
+def test_frozen_txn_rejects_writes():
+    f = Funk()
+    a = f.txn_prepare(None, b"A")
+    f.rec_insert(a, b"k", b"v1")
+    f.txn_prepare(a, b"B")
+    assert f.txn_is_frozen(a)
+    with pytest.raises(FunkError) as e:
+        f.rec_insert(a, b"k", b"v2")
+    assert e.value.code == ERR_FROZEN
+    # the child can still write
+    f.rec_insert(b"B", b"k", b"v2")
+    assert f.rec_query(b"B", b"k") == b"v2"
+
+
+def test_publish_chain_and_competing_forks():
+    r"""
+         root
+        /    \
+       A      X     publish(B): A then B merge to root;
+      / \           X (A's competitor) and C (B's competitor) cancelled.
+     B   C
+    """
+    f = Funk()
+    a = f.txn_prepare(None, b"A")
+    x = f.txn_prepare(None, b"X")
+    b = f.txn_prepare(a, b"B")
+    c = f.txn_prepare(a, b"C")
+    f.rec_insert(x, b"k", b"X-v")
+    f.rec_insert(b, b"k", b"B-v")
+    f.rec_insert(c, b"k", b"C-v")
+    assert f.txn_publish(b) == 2  # A then B
+    assert f.rec_query(None, b"k") == b"B-v"
+    assert f.txn_cnt() == 0  # X and C cancelled
+    assert f.last_publish == b"B"
+    for xid in (a, x, b, c):
+        with pytest.raises(FunkError):
+            f.rec_query(xid, b"k")
+
+
+def test_publish_keeps_descendants_of_winner():
+    f = Funk()
+    a = f.txn_prepare(None, b"A")
+    b = f.txn_prepare(a, b"B")
+    d = f.txn_prepare(b, b"D")
+    f.rec_insert(d, b"k", b"D-v")
+    f.txn_publish(a)
+    # B (and its child D) survive, reparented onto root
+    assert f.txn_cnt() == 2
+    assert f.txn_ancestry(d) == [b"B", b"D"]
+    assert f.rec_query(d, b"k") == b"D-v"
+
+
+def test_cancel_subtree():
+    f = Funk()
+    a = f.txn_prepare(None, b"A")
+    f.txn_prepare(a, b"B")
+    f.txn_prepare(b"B", b"C")
+    assert f.txn_cancel(a) == 3
+    assert f.txn_cnt() == 0
+    with pytest.raises(FunkError) as e:
+        f.txn_prepare(b"B", b"E")
+    assert e.value.code == ERR_TXN
+
+
+def test_duplicate_xid_rejected():
+    f = Funk()
+    f.txn_prepare(None, b"A")
+    with pytest.raises(FunkError):
+        f.txn_prepare(None, b"A")
+
+
+def test_bank_fork_scenario():
+    """The Solana shape: per-slot txns forked off the last published
+    bank; consensus publishes one, the rest die; state rolls forward."""
+    f = Funk()
+    f.rec_insert(None, b"alice", (100).to_bytes(8, "little"))
+    f.rec_insert(None, b"bob", (0).to_bytes(8, "little"))
+
+    def transfer(xid, src, dst, amt):
+        s = int.from_bytes(f.rec_query(xid, src), "little")
+        d = int.from_bytes(f.rec_query(xid, dst), "little")
+        f.rec_insert(xid, src, (s - amt).to_bytes(8, "little"))
+        f.rec_insert(xid, dst, (d + amt).to_bytes(8, "little"))
+
+    slot1a = f.txn_prepare(None, b"slot1a")
+    slot1b = f.txn_prepare(None, b"slot1b")
+    transfer(slot1a, b"alice", b"bob", 30)
+    transfer(slot1b, b"alice", b"bob", 99)
+    slot2 = f.txn_prepare(slot1a, b"slot2")
+    transfer(slot2, b"bob", b"alice", 10)
+    assert int.from_bytes(f.rec_query(slot2, b"bob"), "little") == 20
+    f.txn_publish(slot2)
+    assert int.from_bytes(f.rec_query(None, b"alice"), "little") == 80
+    assert int.from_bytes(f.rec_query(None, b"bob"), "little") == 20
+    assert f.txn_cnt() == 0

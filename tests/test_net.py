@@ -1,0 +1,77 @@
+"""UDP ingress tests: real datagrams through a socket into the verify
+pipeline (the udpsock/TPU-UDP ingress position)."""
+
+import os
+import time
+
+import pytest
+
+from firedancer_tpu.runtime.benchg import gen_transfer_pool
+from firedancer_tpu.runtime.net import UdpIngressStage, send_txns
+from firedancer_tpu.runtime.verify import VerifyStage, decode_verified
+from firedancer_tpu.tango import shm
+
+
+@pytest.fixture
+def links():
+    uid = f"{os.getpid()}_{int(time.monotonic_ns() % 1_000_000)}"
+    net_verify = shm.ShmLink.create(f"fdtpu_nv_{uid}", depth=256, mtu=1232)
+    verify_out = shm.ShmLink.create(f"fdtpu_vo_{uid}", depth=256, mtu=4096)
+    yield net_verify, verify_out
+    for l in (net_verify, verify_out):
+        l.close()
+        l.unlink()
+
+
+def test_udp_ingress_to_verify(links):
+    net_verify, verify_out = links
+    ingress = UdpIngressStage(
+        "net", outs=[shm.Producer(net_verify)], rx_burst=32
+    )
+    verify = VerifyStage(
+        "verify0",
+        ins=[shm.Consumer(net_verify, lazy=8)],
+        outs=[shm.Producer(verify_out)],
+        batch=32,
+        max_msg_len=256,
+        batch_deadline_s=0.001,
+    )
+    sink = shm.Consumer(verify_out, lazy=8)
+    pool = gen_transfer_pool(24, seed=b"udp")
+    try:
+        send_txns(ingress.addr, pool)  # over the real loopback socket
+        got = []
+        deadline = time.monotonic() + 30
+        while len(got) < 24 and time.monotonic() < deadline:
+            ingress.run_once()
+            verify.run_once()
+            verify.flush_deadline() if hasattr(verify, "flush_deadline") else None
+            res = sink.poll()
+            if isinstance(res, tuple):
+                got.append(res[1])
+        verify.flush()
+        while len(got) < 24:
+            res = sink.poll()
+            if not isinstance(res, tuple):
+                break
+            got.append(res[1])
+        assert ingress.metrics.get("pkt_rx") == 24
+        assert len(got) == 24
+        payloads = {decode_verified(f)[0] for f in got}
+        assert payloads == set(pool)
+    finally:
+        ingress.close()
+
+
+def test_udp_ingress_drops_oversize(links):
+    net_verify, _ = links
+    ingress = UdpIngressStage("net", outs=[shm.Producer(net_verify)])
+    try:
+        send_txns(ingress.addr, [b"x" * 1400, b"ok"])
+        deadline = time.monotonic() + 10
+        while ingress.metrics.get("pkt_rx") < 1 and time.monotonic() < deadline:
+            ingress.run_once()
+        assert ingress.metrics.get("oversize_drop") == 1
+        assert ingress.metrics.get("pkt_rx") == 1
+    finally:
+        ingress.close()

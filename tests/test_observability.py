@@ -1,0 +1,96 @@
+"""Logging + schema metrics tests (util/log and disco/metrics analogs)."""
+
+import numpy as np
+import pytest
+
+from firedancer_tpu.utils import log as fl
+from firedancer_tpu.utils import metrics as fm
+
+
+# -- logging ------------------------------------------------------------------
+
+
+def test_log_two_streams(tmp_path, capsys):
+    path = str(tmp_path / "fd.log")
+    fl.init(path=path, stderr_level=fl.NOTICE, file_level=fl.INFO)
+    log = fl.get_logger("teststage")
+    log.debug("invisible everywhere")
+    log.info("file only")
+    log.notice("both streams")
+    err = capsys.readouterr().err
+    assert "both streams" in err
+    assert "file only" not in err
+    content = open(path).read()
+    assert "file only" in content and "both streams" in content
+    assert "invisible everywhere" not in content
+    assert "teststage" in content
+
+
+def test_log_err_raises(tmp_path):
+    fl.init(path="", raise_on_err=True)
+    log = fl.get_logger("x")
+    with pytest.raises(fl.LogError):
+        log.err("fatal condition")
+    fl.init(raise_on_err=False)
+    log.err("tolerated in supervisor tests")
+    fl.init(raise_on_err=True)
+
+
+# -- metrics ------------------------------------------------------------------
+
+
+def test_counters_and_gauges():
+    schema = fm.MetricsSchema().counter("a", "help a").gauge("g")
+    reg = fm.MetricsRegistry(schema)
+    reg.inc("a")
+    reg.inc("a", 5)
+    reg.set("g", 42)
+    assert reg.get("a") == 6
+    assert reg.get("g") == 42
+    with pytest.raises(TypeError):
+        reg.set("a", 1)
+
+
+def test_histogram_buckets_and_quantile():
+    schema = fm.MetricsSchema().histogram("lat", [10, 100, 1000])
+    reg = fm.MetricsRegistry(schema)
+    for v in [1, 5, 50, 500, 5000, 50000]:
+        reg.observe("lat", v)
+    h = reg.hist("lat")
+    assert h["counts"] == [2, 1, 1, 2]  # <=10, <=100, <=1000, +Inf
+    assert h["count"] == 6
+    assert h["sum"] == 55556
+    assert reg.quantile("lat", 0.5) == 100
+    assert reg.quantile("lat", 0.99) == float("inf")
+
+
+def test_registry_over_shared_buffer():
+    """The monitor-reads-producer-memory property: two registries over one
+    buffer see each other's writes (fd_metrics shm array)."""
+    schema = fm.stage_schema()
+    buf = np.zeros(schema.footprint(), dtype=np.uint64)
+    producer = fm.MetricsRegistry(schema, buf=buf)
+    monitor = fm.MetricsRegistry(schema, buf=buf)
+    producer.inc("frags_in", 7)
+    producer.observe("frag_latency_ns", 5e5)
+    assert monitor.get("frags_in") == 7
+    assert monitor.hist("frag_latency_ns")["count"] == 1
+
+
+def test_prometheus_exposition():
+    schema = fm.MetricsSchema().counter("txn_total", "txns").histogram(
+        "lat_ns", [10.0, 100.0]
+    )
+    r1 = fm.MetricsRegistry(schema)
+    r2 = fm.MetricsRegistry(schema)
+    r1.inc("txn_total", 3)
+    r2.inc("txn_total", 4)
+    r1.observe("lat_ns", 50)
+    text = fm.render_prometheus({"verify0": r1, "verify1": r2})
+    assert '# TYPE txn_total counter' in text
+    assert 'txn_total{stage="verify0"} 3' in text
+    assert 'txn_total{stage="verify1"} 4' in text
+    assert 'lat_ns_bucket{stage="verify0",le="100.0"} 1' in text
+    assert 'lat_ns_count{stage="verify0"} 1' in text
+    # HELP/TYPE emitted once per metric, not per stage
+    assert text.count("# TYPE txn_total counter") == 1

@@ -1,51 +1,118 @@
-"""End-to-end leader pipeline tests: gen -> verify(TPU) -> dedup -> pack on
-the CPU backend, including corruption drops, duplicate filtering, and
-round-robin verify fan-out."""
+"""End-to-end leader pipeline tests: gen -> verify(TPU) -> dedup -> pack ->
+bank -> poh -> shred -> store on the CPU backend.  Asserts the full block
+path: conflict-aware scheduling, stub execution state, PoH chain honesty
+(host + TPU segment verify), FEC sets reassembling byte-identically."""
+
+import hashlib
 
 import numpy as np
 import pytest
 
 from firedancer_tpu.models.leader import build_leader_pipeline
-from firedancer_tpu.runtime.verify import decode_verified, encode_verified
 from firedancer_tpu.protocol import txn as ft
+from firedancer_tpu.runtime import poh as fpoh
+from firedancer_tpu.runtime.poh_stage import parse_entry
+from firedancer_tpu.runtime.shred_stage import deshred_entry_batch
+from firedancer_tpu.runtime.verify import decode_verified, encode_verified
 
 
 @pytest.fixture(scope="module")
-def small_pipeline_result():
-    """Run once, assert from multiple tests (compiles one 64-batch kernel)."""
+def pipeline_result():
+    """Run the full pipeline once; assert from multiple tests."""
     pipe = build_leader_pipeline(
-        n_verify=1, pool_size=96, gen_limit=96, batch=64, max_msg_len=256
+        n_verify=1, n_bank=2, pool_size=96, gen_limit=96, batch=64,
+        max_msg_len=256, slot=1,
     )
     try:
         pipe.run(until_txns=96, max_iters=200_000)
         report = pipe.report()
-        microblocks = list(pipe.pack.microblocks)
+        result = {
+            "report": report,
+            "entry_batch": pipe.store.entry_batch_bytes(1),
+            "lamports": [dict(b.lamports) for b in pipe.banks],
+            "pool": list(pipe.benchg.pool),
+            "n_sets_emitted": len(pipe.shred.sets),
+        }
     finally:
         pipe.close()
-    return report, microblocks
+    return result
 
 
-def test_all_honest_txns_flow_through(small_pipeline_result):
-    report, microblocks = small_pipeline_result
+def test_all_txns_reach_banks(pipeline_result):
+    report = pipeline_result["report"]
     assert report["benchg"]["txn_gen"] == 96
     assert report["verify0"]["txn_verified"] == 96
-    assert report["verify0"].get("parse_fail", 0) == 0
-    assert report["verify0"].get("verify_fail", 0) == 0
-    assert report["dedup"].get("dedup_dup", 0) == 0
     assert report["pack"]["txn_in"] == 96
-    total = sum(len(mb) for mb in microblocks)
-    assert total == 96
+    assert report["pack"]["txn_scheduled"] == 96
+    execs = sum(report[f"bank{b}"].get("txn_exec", 0) for b in range(2))
+    assert execs == 96
+    # every scheduled microblock came back as a lock release
+    assert report["pack"]["microblocks"] == report["pack"]["microblock_done"]
 
 
-def test_verified_frags_carry_descriptor(small_pipeline_result):
-    _, microblocks = small_pipeline_result
-    frame = microblocks[0][0]
-    payload, desc = decode_verified(frame)
-    assert ft.txn_parse(payload) is not None
-    assert desc.signature_cnt == 1
-    t = ft.txn_parse(payload)
-    assert t.signature_off == desc.signature_off
-    assert t.instrs == desc.instrs
+def test_bank_state_transitions(pipeline_result):
+    """The stub runtime executed real transfers: payer balances went
+    negative by the lamports sent, destinations positive."""
+    merged: dict[bytes, int] = {}
+    for lam in pipeline_result["lamports"]:
+        for k, v in lam.items():
+            merged[k] = merged.get(k, 0) + v
+    total_sent = sum(1 + i for i in range(96))  # lamports = 1+i per txn
+    negatives = -sum(v for v in merged.values() if v < 0)
+    positives = sum(v for v in merged.values() if v > 0)
+    assert negatives == positives == total_sent
+
+
+def test_entry_batches_reassemble_and_carry_all_txns(pipeline_result):
+    batch = pipeline_result["entry_batch"]
+    assert len(batch) > 0
+    entries = [parse_entry(e) for e in deshred_entry_batch(batch)]
+    wire_txns = [p for _, _, txns in entries for p in txns]
+    assert sorted(wire_txns) == sorted(pipeline_result["pool"])
+    # ticks interleave with txn entries
+    assert any(not txns for _, _, txns in entries)
+    assert pipeline_result["n_sets_emitted"] == pipeline_result["report"][
+        "store"
+    ].get("sets_stored", 0)
+
+
+def test_poh_chain_verifies_host_and_tpu(pipeline_result):
+    batch = pipeline_result["entry_batch"]
+    entries = [parse_entry(e) for e in deshred_entry_batch(batch)]
+    ok, segments = fpoh.replay_entries(b"\x00" * 32, entries)
+    assert ok, "PoH chain replay failed"
+    assert segments
+    # TPU batch-verify all equal-length segments (the wide verification
+    # axis); host-check the stragglers
+    from collections import defaultdict
+
+    by_n = defaultdict(list)
+    for start, n, end in segments:
+        by_n[n].append((start, end))
+    n, group = max(by_n.items(), key=lambda kv: len(kv[1]))
+    starts = [s for s, _ in group]
+    ends = [e for _, e in group]
+    mask = fpoh.verify_segments_tpu(starts, n, ends)
+    assert bool(np.asarray(mask).all())
+    # corrupted end hash must fail
+    bad_ends = [ends[0][:-1] + bytes([ends[0][-1] ^ 1])] + ends[1:]
+    mask2 = np.asarray(fpoh.verify_segments_tpu(starts, n, bad_ends))
+    assert not mask2[0] and mask2[1:].all()
+
+
+def test_microblocks_respect_write_conflicts(pipeline_result):
+    """No microblock contains two txns writing the same account."""
+    batch = pipeline_result["entry_batch"]
+    entries = [parse_entry(e) for e in deshred_entry_batch(batch)]
+    for _, _, txns in entries:
+        writable: set[bytes] = set()
+        for p in txns:
+            t = ft.txn_parse(p)
+            addrs = t.acct_addrs(p)
+            for i, a in enumerate(addrs):
+                if t.is_writable(i):
+                    assert a not in writable, "write conflict inside microblock"
+                    writable.add(a)
 
 
 def test_duplicates_are_dropped():
@@ -56,8 +123,6 @@ def test_duplicates_are_dropped():
     try:
         pipe.run(until_txns=32, max_iters=200_000)
         report = pipe.report()
-        # verify's tiny tcache (depth 16) can't hold 32 txns, so dups reach
-        # dedup; between the two tcaches all 64 dups die.
         dups = report["verify0"].get("dedup_dup", 0) + report["dedup"].get(
             "dedup_dup", 0
         )

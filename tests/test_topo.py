@@ -1,0 +1,145 @@
+"""Process topology runner tests: stages as real OS processes over shm
+links, cnc supervision, watchdog kill on stage death, monitor snapshot.
+Mirrors the reference's boot/supervise model (fd_topo_run.c, run.c:252-330)
+and the mux IPC script tests (src/disco/mux/test_mux_ipc_*)."""
+
+import time
+
+import pytest
+
+from firedancer_tpu.runtime import topo as ft
+from firedancer_tpu.runtime.stage import Stage
+from firedancer_tpu.tango import shm
+from firedancer_tpu.tango.rings import CNC_SIG_FAIL
+
+
+class GenStage(Stage):
+    def __init__(self, *args, limit=100, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.limit = limit
+        self._i = 0
+
+    def after_credit(self):
+        if self._i < self.limit:
+            if self.publish(0, b"frag%06d" % self._i, sig=self._i):
+                self._i += 1
+
+
+class RelayStage(Stage):
+    def after_frag(self, in_idx, meta, payload):
+        self.publish(0, payload, sig=int(meta[1]))
+
+
+class SinkStage(Stage):
+    pass  # counts frags_in via the base metrics/diag export
+
+
+class CrashStage(Stage):
+    def after_frag(self, in_idx, meta, payload):
+        if int(meta[1]) >= 10:
+            raise RuntimeError("injected stage crash")
+        self.publish(0, payload, sig=int(meta[1]))
+
+
+def build_gen(links, cnc, limit=100):
+    return GenStage("gen", outs=[shm.Producer(links["gr"])], cnc=cnc, limit=limit)
+
+
+def build_relay(links, cnc):
+    return RelayStage(
+        "relay",
+        ins=[shm.Consumer(links["gr"], lazy=8)],
+        outs=[shm.Producer(links["rs"])],
+        cnc=cnc,
+    )
+
+
+def build_sink(links, cnc):
+    return SinkStage("sink", ins=[shm.Consumer(links["rs"], lazy=8)], cnc=cnc)
+
+
+def build_crash(links, cnc):
+    return CrashStage(
+        "relay",
+        ins=[shm.Consumer(links["gr"], lazy=8)],
+        outs=[shm.Producer(links["rs"])],
+        cnc=cnc,
+    )
+
+
+N = 200
+
+
+def test_three_process_topology_end_to_end():
+    topo = ft.Topology()
+    topo.link("gr", depth=256, mtu=64)
+    topo.link("rs", depth=256, mtu=64)
+    topo.stage("gen", build_gen, limit=N)
+    topo.stage("relay", build_relay)
+    topo.stage("sink", build_sink)
+    h = ft.launch(topo)
+    try:
+        ok = h.supervise(
+            until=lambda h: h.cncs["sink"].diag(Stage.DIAG_FRAGS_IN) >= N,
+            timeout_s=60,
+        )
+        assert ok, f"supervisor failed (failed stage: {h.failed})"
+        snap = {r["stage"]: r for r in h.snapshot()}
+        assert snap["gen"]["frags_out"] == N
+        assert snap["relay"]["frags_in"] == N
+        assert snap["relay"]["frags_out"] == N
+        assert snap["sink"]["frags_in"] >= N
+        assert all(r["alive"] for r in snap.values())
+        mon = h.format_monitor()
+        assert "sink" in mon and str(N) in mon
+        h.halt()
+        assert all(not p.is_alive() for p in h.procs.values())
+        assert all(p.exitcode == 0 for p in h.procs.values())
+    finally:
+        h.close()
+
+
+def test_watchdog_kills_topology_on_stage_crash():
+    topo = ft.Topology()
+    topo.link("gr", depth=256, mtu=64)
+    topo.link("rs", depth=256, mtu=64)
+    topo.stage("gen", build_gen, limit=N)
+    topo.stage("relay", build_crash)
+    topo.stage("sink", build_sink)
+    h = ft.launch(topo)
+    try:
+        ok = h.supervise(
+            until=lambda h: h.cncs["sink"].diag(Stage.DIAG_FRAGS_IN) >= N,
+            timeout_s=60,
+        )
+        assert not ok, "supervisor should have detected the crash"
+        assert h.failed == "relay"
+        # crash containment: the WHOLE topology is down (run.c:252-330)
+        assert all(not p.is_alive() for p in h.procs.values())
+        assert h.cncs["relay"].signal == CNC_SIG_FAIL
+    finally:
+        h.close()
+
+
+def test_supervise_detects_missing_heartbeat():
+    """A stage that never boots (builder hangs) trips the heartbeat
+    watchdog rather than wedging the parent."""
+
+    topo = ft.Topology()
+    topo.link("gr", depth=256, mtu=64)
+    topo.stage("gen", build_gen, limit=N)
+    topo.stage("hang", _build_hang)
+    h = ft.launch(topo)
+    try:
+        t0 = time.monotonic()
+        ok = h.supervise(timeout_s=30, heartbeat_timeout_s=1.0, until=lambda h: False)
+        assert not ok
+        assert h.failed == "hang"
+        assert time.monotonic() - t0 < 25
+    finally:
+        h.close()
+
+
+def _build_hang(links, cnc):
+    cnc.heartbeat(time.monotonic_ns())  # one beat, then wedge
+    time.sleep(3600)

@@ -1,0 +1,160 @@
+"""External ed25519 conformance vectors (SURVEY §4.4, VERDICT r2 task 5).
+
+Two public fixture sets, read as DATA from the reference tree at test time:
+
+  - Project Wycheproof ed25519 verify vectors (public Apache-2.0 test data,
+    embedded in the reference as a generated C table,
+    src/ballet/ed25519/test_ed25519_wycheproof.c) — 100+ cases covering
+    malformed signatures, non-canonical S, wrong-order points, truncations.
+    The reference requires verify(...) == ok EXACTLY (test_ed25519.c:1082);
+    so do we, for both the python ref and the TPU kernel.
+  - The Zcash-derived signature-malleability fixtures
+    (test_ed25519_signature_malleability_should_{pass,fail}.bin): 96-byte
+    (sig || pub) records over the fixed message "Zcash", exercising every
+    combination of small-order A/R and non-canonical encodings.
+
+Breaking on either set means a strictness divergence from the reference's
+accept set — exactly the silent-shared-misunderstanding failure mode
+self-referential testing can't catch.
+"""
+
+import os
+import re
+
+import numpy as np
+import pytest
+
+from firedancer_tpu.ops.ref import ed25519_ref as ref
+
+REF_DIR = "/root/reference/src/ballet/ed25519"
+WYCHEPROOF_C = os.path.join(REF_DIR, "test_ed25519_wycheproof.c")
+MALLEABILITY = {
+    True: os.path.join(REF_DIR, "test_ed25519_signature_malleability_should_pass.bin"),
+    False: os.path.join(REF_DIR, "test_ed25519_signature_malleability_should_fail.bin"),
+}
+
+pytestmark = pytest.mark.skipif(
+    not os.path.exists(WYCHEPROOF_C), reason="reference fixture tree not mounted"
+)
+
+
+def _c_bytes(lit: str) -> bytes:
+    """Decode a C string literal body ("\\x41\\x42...") to bytes."""
+    return lit.encode("latin1").decode("unicode_escape").encode("latin1")
+
+
+def load_wycheproof():
+    src = open(WYCHEPROOF_C, encoding="latin1").read()
+    pat = re.compile(
+        r"\.tc_id\s*=\s*(\d+),\s*"
+        r"\.comment\s*=\s*\"((?:[^\"\\]|\\.)*)\",\s*"
+        r"\.msg\s*=\s*\(uchar const \*\)\"((?:[^\"\\]|\\.)*)\",\s*"
+        r"\.msg_sz\s*=\s*(\d+)UL,\s*"
+        r"\.sig\s*=\s*\"((?:[^\"\\]|\\.)*)\",\s*"
+        r"\.pub\s*=\s*\"((?:[^\"\\]|\\.)*)\",\s*"
+        r"\.ok\s*=\s*(\d+)",
+        re.S,
+    )
+    out = []
+    for m in pat.finditer(src):
+        tc_id, comment, msg, msg_sz, sig, pub, ok = m.groups()
+        msg_b = _c_bytes(msg)
+        sig_b = _c_bytes(sig)
+        pub_b = _c_bytes(pub)
+        assert len(msg_b) == int(msg_sz), f"tc {tc_id}: msg decode length"
+        # C literals NUL-pad short arrays (e.g. sig given as < 64 chars)
+        sig_b = sig_b[:64].ljust(64, b"\x00")
+        pub_b = pub_b[:32].ljust(32, b"\x00")
+        out.append((int(tc_id), msg_b, sig_b, pub_b, bool(int(ok))))
+    assert len(out) > 100, f"only parsed {len(out)} wycheproof vectors"
+    return out
+
+
+def load_malleability(should_pass: bool):
+    raw = open(MALLEABILITY[should_pass], "rb").read()
+    assert len(raw) % 96 == 0
+    return [
+        (raw[o : o + 64], raw[o + 64 : o + 96]) for o in range(0, len(raw), 96)
+    ]
+
+
+# -- python reference implementation ------------------------------------------
+
+
+def test_wycheproof_python_ref():
+    bad = []
+    for tc_id, msg, sig, pub, ok in load_wycheproof():
+        if ref.verify(msg, sig, pub) != ok:
+            bad.append(tc_id)
+    assert not bad, f"python ref diverges from Wycheproof on tc_ids {bad}"
+
+
+@pytest.mark.parametrize("should_pass", [True, False])
+def test_malleability_python_ref(should_pass):
+    msg = b"Zcash"
+    bad = [
+        i
+        for i, (sig, pub) in enumerate(load_malleability(should_pass))
+        if ref.verify(msg, sig, pub) != should_pass
+    ]
+    assert not bad, (
+        f"python ref diverges from malleability should_"
+        f"{'pass' if should_pass else 'fail'} at indices {bad[:10]}"
+        f" ({len(bad)} total)"
+    )
+
+
+# -- TPU kernel ---------------------------------------------------------------
+
+
+def _kernel_verdicts(cases, max_msg_len=64):
+    """Run (msg, sig, pub) triples through ed25519_verify_batch, one batch."""
+    import jax.numpy as jnp
+
+    from firedancer_tpu.ops import sigverify as sv
+
+    b = len(cases)
+    msg = np.zeros((max_msg_len, b), dtype=np.int32)
+    ln = np.zeros((b,), dtype=np.int32)
+    sig = np.zeros((64, b), dtype=np.int32)
+    pk = np.zeros((32, b), dtype=np.int32)
+    for i, (m, s, p) in enumerate(cases):
+        msg[: len(m), i] = np.frombuffer(m, dtype=np.uint8)
+        ln[i] = len(m)
+        sig[:, i] = np.frombuffer(s, dtype=np.uint8)
+        pk[:, i] = np.frombuffer(p, dtype=np.uint8)
+    out = sv.ed25519_verify_batch(
+        jnp.asarray(msg), jnp.asarray(ln), jnp.asarray(sig), jnp.asarray(pk),
+        max_msg_len=max_msg_len,
+    )
+    return np.asarray(out).astype(bool)
+
+
+def test_wycheproof_tpu_kernel():
+    vecs = [v for v in load_wycheproof() if len(v[1]) <= 64]
+    verdicts = _kernel_verdicts([(m, s, p) for _, m, s, p, _ in vecs])
+    bad = [
+        tc_id
+        for (tc_id, _, _, _, ok), got in zip(vecs, verdicts)
+        if bool(got) != ok
+    ]
+    assert not bad, f"TPU kernel diverges from Wycheproof on tc_ids {bad}"
+
+
+def test_malleability_tpu_kernel():
+    msg = b"Zcash"
+    cases = []
+    expected = []
+    for should_pass in (True, False):
+        for sig, pub in load_malleability(should_pass):
+            cases.append((msg, sig, pub))
+            expected.append(should_pass)
+    verdicts = _kernel_verdicts(cases)
+    bad = [
+        i for i, (got, want) in enumerate(zip(verdicts, expected))
+        if bool(got) != want
+    ]
+    assert not bad, (
+        f"TPU kernel diverges from malleability fixtures at {bad[:10]} "
+        f"({len(bad)} of {len(cases)})"
+    )
