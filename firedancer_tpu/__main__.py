@@ -1,0 +1,167 @@
+"""fdctl-style CLI: `python -m firedancer_tpu <action>`.
+
+Mirrors the reference's action table (/root/reference/src/app/fdctl/
+main1.c: run / monitor / keys / configure / version, and fddev's bench):
+
+    run      build the leader pipeline from a TOML config and drive it;
+             prints a monitor table + txn/s on exit
+    keys     new <path> | pubkey <path> — identity keypair management
+    bench    quick pipeline throughput measurement (bench.py has the
+             full headline benchmark)
+    config   print the effective layered configuration
+    version  print the framework version
+
+Every action takes --config <file.toml> where relevant (layered over the
+embedded defaults, utils/config.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+import time
+
+__version__ = "0.3.0"  # round 3
+
+
+def _load_cfg(args):
+    from firedancer_tpu.utils.config import load_config
+
+    return load_config(args.config)
+
+
+def cmd_run(args) -> int:
+    from firedancer_tpu.utils.platform import enable_compile_cache, force_cpu_backend
+
+    if args.cpu:
+        force_cpu_backend()
+    enable_compile_cache()
+    from firedancer_tpu.models.leader import build_leader_pipeline_from_config
+
+    cfg = _load_cfg(args)
+    pipe = build_leader_pipeline_from_config(
+        cfg,
+        pool_size=args.txns,
+        gen_limit=args.txns,
+        batch=min(cfg.verify.batch, 256),
+        max_msg_len=256,
+    )
+    try:
+        print(f"# leader pipeline: {len(pipe.verifies)} verify, "
+              f"{len(pipe.banks)} bank stages; {args.txns} txns", file=sys.stderr)
+        t0 = time.time()
+        pipe.run(until_txns=args.txns, max_iters=2_000_000)
+        dt = time.time() - t0
+        executed = sum(b.metrics.get("txn_exec") for b in pipe.banks)
+        print(f"{'stage':<10}{'in':>10}{'out':>10}{'extra':>30}")
+        for s in pipe.stages:
+            m = s.metrics
+            extra = ""
+            if s is pipe.pack:
+                extra = f"microblocks={m.get('microblocks')}"
+            if s is pipe.shred:
+                extra = f"fec_sets={m.get('fec_sets')}"
+            print(f"{s.name:<10}{m.get('frags_in'):>10}{m.get('frags_out'):>10}"
+                  f"{extra:>30}")
+        print(f"# {executed} txns committed in {dt:.2f}s "
+              f"({executed / dt:.0f} txn/s)")
+        return 0 if executed == args.txns else 1
+    finally:
+        pipe.close()
+
+
+def cmd_keys(args) -> int:
+    from firedancer_tpu.ops.ref import ed25519_ref as ref
+    from firedancer_tpu.protocol.base58 import b58_encode
+
+    if args.action == "new":
+        secret = os.urandom(32)
+        with open(args.path, "wb") as f:
+            os.fchmod(f.fileno(), 0o600)
+            f.write(secret)
+        print(f"wrote identity key to {args.path}")
+        print(f"pubkey: {b58_encode(ref.public_key(secret))}")
+        return 0
+    secret = open(args.path, "rb").read()
+    if len(secret) != 32:
+        print("malformed key file", file=sys.stderr)
+        return 1
+    print(b58_encode(ref.public_key(secret)))
+    return 0
+
+
+def cmd_bench(args) -> int:
+    from firedancer_tpu.utils.platform import enable_compile_cache, force_cpu_backend
+
+    if args.cpu:
+        force_cpu_backend()
+    enable_compile_cache()
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import bench as bench_mod
+
+    import jax
+
+    out = bench_mod.run_pipeline_bench(jax.devices()[0].platform)
+    print(json.dumps(out))
+    return 0
+
+
+def cmd_config(args) -> int:
+    import dataclasses
+
+    cfg = _load_cfg(args)
+
+    def dump(obj, indent=""):
+        for f in dataclasses.fields(obj):
+            v = getattr(obj, f.name)
+            if dataclasses.is_dataclass(v):
+                print(f"{indent}[{f.name}]")
+                dump(v, indent)
+            else:
+                print(f"{indent}{f.name} = {v!r}")
+
+    dump(cfg)
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="firedancer_tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    runp = sub.add_parser("run", help="drive the leader pipeline")
+    runp.add_argument("--config", default=None)
+    runp.add_argument("--txns", type=int, default=256)
+    runp.add_argument("--cpu", action="store_true", help="force CPU backend")
+
+    keysp = sub.add_parser("keys", help="identity keypair management")
+    keysp.add_argument("action", choices=["new", "pubkey"])
+    keysp.add_argument("path")
+
+    benchp = sub.add_parser("bench", help="pipeline throughput bench")
+    benchp.add_argument("--cpu", action="store_true")
+
+    cfgp = sub.add_parser("config", help="print effective configuration")
+    cfgp.add_argument("--config", default=None)
+
+    sub.add_parser("version", help="print version")
+
+    args = p.parse_args(argv)
+    if args.cmd == "run":
+        return cmd_run(args)
+    if args.cmd == "keys":
+        return cmd_keys(args)
+    if args.cmd == "bench":
+        return cmd_bench(args)
+    if args.cmd == "config":
+        return cmd_config(args)
+    if args.cmd == "version":
+        print(f"firedancer_tpu {__version__}")
+        return 0
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,2 @@
+from .ghost import Ghost  # noqa: F401
+from .tower import MAX_LOCKOUT, SWITCH_PCT, THRESHOLD_DEPTH, THRESHOLD_PCT, Tower  # noqa: F401

@@ -1,0 +1,106 @@
+"""LMD-GHOST fork choice (the choreo/ghost layer).
+
+Behavioral port of /root/reference/src/choreo/ghost/fd_ghost.h: a tree of
+slots where each node tracks the stake voting for exactly that slot and
+the recursive subtree `weight`; only each validator's LATEST vote counts
+(LMD — a new vote moves that validator's stake); the head is found by
+greedily descending into the heaviest child (ties break toward the lower
+slot, the reference's deterministic rule); advancing the root prunes
+every node not descending from the new root (the publish operation).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class _Node:
+    slot: int
+    parent: int | None
+    children: list[int] = field(default_factory=list)
+    stake: int = 0   # stake voting exactly this slot
+    weight: int = 0  # stake voting this subtree
+
+
+class Ghost:
+    def __init__(self, root_slot: int):
+        self.root = root_slot
+        self.nodes: dict[int, _Node] = {root_slot: _Node(root_slot, None)}
+        self.latest_vote: dict[bytes, tuple[int, int]] = {}  # key -> (slot, stake)
+
+    # -- tree maintenance ---------------------------------------------------
+
+    def insert(self, slot: int, parent: int) -> None:
+        if slot in self.nodes:
+            raise ValueError(f"slot {slot} already in tree")
+        if parent not in self.nodes:
+            raise ValueError(f"unknown parent {parent}")
+        self.nodes[slot] = _Node(slot, parent)
+        self.nodes[parent].children.append(slot)
+
+    def is_ancestor(self, a: int, b: int) -> bool:
+        """True iff a is b or an ancestor of b."""
+        cur: int | None = b
+        while cur is not None:
+            if cur == a:
+                return True
+            cur = self.nodes[cur].parent
+        return False
+
+    # -- votes --------------------------------------------------------------
+
+    def vote(self, key: bytes, slot: int, stake: int) -> None:
+        """Latest-message rule: this validator's stake moves to `slot`."""
+        if slot not in self.nodes:
+            raise ValueError(f"vote for unknown slot {slot}")
+        prev = self.latest_vote.get(key)
+        if prev is not None:
+            pslot, pstake = prev
+            if pslot in self.nodes:  # may have been pruned by publish
+                self.nodes[pslot].stake -= pstake
+                self._bump(pslot, -pstake)
+        self.latest_vote[key] = (slot, stake)
+        self.nodes[slot].stake += stake
+        self._bump(slot, stake)
+
+    def _bump(self, slot: int, delta: int) -> None:
+        cur: int | None = slot
+        while cur is not None:
+            self.nodes[cur].weight += delta
+            cur = self.nodes[cur].parent
+
+    def weight(self, slot: int) -> int:
+        return self.nodes[slot].weight
+
+    # -- fork choice --------------------------------------------------------
+
+    def head(self) -> int:
+        """Greedy heaviest-subtree walk from the root."""
+        cur = self.root
+        while True:
+            kids = self.nodes[cur].children
+            if not kids:
+                return cur
+            # heaviest child; ties toward the lower slot
+            best = min(kids, key=lambda s: (-self.nodes[s].weight, s))
+            cur = best
+
+    # -- publish (root advance) ---------------------------------------------
+
+    def publish(self, new_root: int) -> int:
+        """Prune everything not in new_root's subtree; returns pruned count."""
+        if new_root not in self.nodes:
+            raise ValueError("unknown new root")
+        keep: set[int] = set()
+        stack = [new_root]
+        while stack:
+            s = stack.pop()
+            keep.add(s)
+            stack.extend(self.nodes[s].children)
+        pruned = [s for s in self.nodes if s not in keep]
+        for s in pruned:
+            del self.nodes[s]
+        self.nodes[new_root].parent = None
+        self.root = new_root
+        return len(pruned)

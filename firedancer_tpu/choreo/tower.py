@@ -1,0 +1,120 @@
+"""TowerBFT vote tower (the choreo/tower layer).
+
+Behavioral port of /root/reference/src/choreo/tower/fd_tower.h, whose
+long header comment is the spec implemented here:
+
+  - the tower is a deque of (slot, confirmation_count) votes; lockout =
+    2^conf and expiration = slot + lockout;
+  - a new vote first expires stale votes TOP-DOWN contiguously (a
+    non-expired vote shields the ones beneath it), then pushes with
+    conf 1, then doubles lockouts by cascading +1 through votes whose
+    confirmation counts are consecutive with the one above;
+  - a vote reaching MAX_LOCKOUT (32) confirmations is rooted: popped
+    from the bottom, and the caller prunes state behind it (publish);
+  - lockout check: a validator may only vote for a slot on a different
+    fork than a previous vote after that vote's expiration slot;
+  - threshold check: the vote at THRESHOLD_DEPTH (8) from the top must
+    be on a fork holding >= 2/3 of stake — keeps a partitioned
+    validator from building lockouts the cluster won't honor;
+  - switch check: abandoning the current heaviest-vote fork requires
+    >= 38% of stake to be visibly voting on forks incompatible with
+    our last vote.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass
+
+MAX_LOCKOUT = 32
+THRESHOLD_DEPTH = 8
+THRESHOLD_PCT = 2 / 3
+SWITCH_PCT = 0.38
+
+
+@dataclass
+class Vote:
+    slot: int
+    conf: int
+
+    @property
+    def lockout(self) -> int:
+        return 1 << self.conf
+
+    @property
+    def expiration(self) -> int:
+        return self.slot + self.lockout
+
+
+class Tower:
+    def __init__(self):
+        self.votes: deque[Vote] = deque()  # bottom .. top
+        self.root: int | None = None
+
+    # -- state transition ---------------------------------------------------
+
+    def vote(self, slot: int) -> int | None:
+        """Record a vote; returns a newly rooted slot or None."""
+        if self.votes and slot <= self.votes[-1].slot:
+            raise ValueError("votes must increase in slot")
+        # top-down contiguous expiry: stop at the first live vote
+        while self.votes and self.votes[-1].expiration < slot:
+            self.votes.pop()
+        self.votes.append(Vote(slot, 1))
+        # cascade doubling through consecutive confirmation counts
+        v = list(self.votes)
+        for i in range(len(v) - 2, -1, -1):
+            if v[i].conf == v[i + 1].conf:
+                v[i].conf += 1
+        rooted = None
+        if v and v[0].conf >= MAX_LOCKOUT:
+            rooted = self.votes.popleft().slot
+            self.root = rooted
+        return rooted
+
+    def last_vote(self) -> int | None:
+        return self.votes[-1].slot if self.votes else None
+
+    # -- the three checks ---------------------------------------------------
+
+    def lockout_check(self, slot: int, is_ancestor) -> bool:
+        """May we vote for `slot`?  Every tower vote must be on `slot`'s
+        fork (its slot an ancestor of `slot`) or already expired at
+        `slot` (fd_tower.h lockout check).  is_ancestor(a, b) is the
+        fork-tree oracle (ghost.is_ancestor)."""
+        for v in self.votes:
+            if v.expiration < slot:
+                continue
+            if not is_ancestor(v.slot, slot):
+                return False
+        return True
+
+    def threshold_check(
+        self, slot: int, fork_stake, total_stake: int
+    ) -> bool:
+        """Simulate the vote; the vote THRESHOLD_DEPTH from the top (after
+        expiry) must sit on a fork with >= 2/3 of stake voting for it.
+        fork_stake(slot) -> stake observed voting for slot's subtree
+        (ghost.weight)."""
+        # replicate vote()'s TOP-DOWN contiguous expiry: a live vote
+        # shields expired votes beneath it (a flat filter would simulate
+        # a different tower and probe the wrong depth-8 slot)
+        sim = list(self.votes)
+        while sim and sim[-1].expiration < slot:
+            sim.pop()
+        sim.append(Vote(slot, 1))
+        if len(sim) <= THRESHOLD_DEPTH:
+            return True  # tower too shallow to have a depth-8 vote
+        probe = sim[-1 - THRESHOLD_DEPTH]
+        return fork_stake(probe.slot) >= THRESHOLD_PCT * total_stake
+
+    def switch_check(
+        self, slot: int, is_ancestor, conflicting_stake: int, total_stake: int
+    ) -> bool:
+        """Switching forks (slot NOT descending from our last vote) needs
+        >= 38% of stake on forks incompatible with our last vote;
+        same-fork votes never need a switch proof."""
+        last = self.last_vote()
+        if last is None or is_ancestor(last, slot):
+            return True
+        return conflicting_stake >= SWITCH_PCT * total_stake

@@ -1,0 +1,9 @@
+from .runtime import (  # noqa: F401
+    BlockResult,
+    TXN_SUCCESS,
+    TXN_ERR_INSUFFICIENT_FUNDS,
+    TXN_ERR_FEE,
+    execute_block,
+    generate_waves,
+    replay_block,
+)

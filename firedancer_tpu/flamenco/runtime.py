@@ -1,0 +1,256 @@
+"""Runtime: slot execution over funk with conflict-wave parallelism.
+
+The execution-side slice of the reference's flamenco runtime
+(/root/reference/src/flamenco/runtime/fd_runtime.c): a block's
+transactions execute against a funk fork in *waves* — maximal groups of
+transactions with disjoint account rw-sets (wave generation
+fd_runtime.c:1717-1736, fd_runtime_execute_txns_in_waves_tpool :1815) —
+and the slot finalizes into a bank hash chaining the parent hash, the
+accounts-delta lattice hash, the signature count and the PoH hash
+(fd_hashes.c's formula shape).
+
+TPU-native twist: a wave's txns are executable in any order — the same
+property the reference exploits with a tpool is what batches device
+work here: per-wave sigverify batches ride ops/sigverify, and the
+accounts-delta hash sums every modified account's lattice hash in ONE
+device reduction (ops/lthash.combine_device) instead of a sequential
+accumulation.
+
+Account model (host, the VM/native-program surface grows in place):
+value bytes = u64 lamports LE || opaque data.  Implemented programs:
+the system program transfer (the bank stage's stub grows up here into
+fee charging + failure semantics: a failed txn still pays its fee,
+errors never abort the block).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from firedancer_tpu.funk import Funk
+from firedancer_tpu.ops import lthash as lt
+from firedancer_tpu.protocol import txn as ft
+
+LAMPORTS_PER_SIGNATURE = 5000
+
+TXN_SUCCESS = 0
+TXN_ERR_FEE = -1                 # payer cannot cover the fee: txn dropped
+TXN_ERR_INSUFFICIENT_FUNDS = -2  # program failed: fee charged, no effects
+
+
+def acct_lamports(val: bytes | None) -> int:
+    return int.from_bytes(val[:8], "little") if val else 0
+
+
+def acct_build(lamports: int, data: bytes = b"") -> bytes:
+    return lamports.to_bytes(8, "little") + data
+
+
+@dataclass
+class TxnResult:
+    status: int
+    fee: int
+
+
+@dataclass
+class BlockResult:
+    slot: int
+    bank_hash: bytes
+    accounts_delta: np.ndarray  # (1024,) uint16 lattice value
+    signature_cnt: int
+    fees: int
+    results: list[TxnResult]
+    waves: list[list[int]]  # txn indices per wave
+    xid: bytes
+
+
+def _rw_sets(payload: bytes, desc: ft.Txn) -> tuple[set[bytes], set[bytes]]:
+    addrs = desc.acct_addrs(payload)
+    w, r = set(), set()
+    for i, a in enumerate(addrs):
+        (w if desc.is_writable(i) else r).add(a)
+    return w, r
+
+
+def generate_waves(txns: list[tuple[bytes, ft.Txn]]) -> list[list[int]]:
+    """Partition txn indices into conflict-free waves, equivalent to
+    serial block order: a writer lands strictly after every earlier
+    reader AND writer of each of its accounts; a reader lands strictly
+    after every earlier writer (readers may share a wave).  No
+    gap-filling below a conflict — that would let a later txn's effects
+    become visible to an earlier txn (the property the reference's wave
+    generation preserves, fd_runtime.c:1717-1736)."""
+    waves: list[list[int]] = []
+    last_w: dict[bytes, int] = {}  # acct -> last wave with a writer
+    last_r: dict[bytes, int] = {}  # acct -> last wave with a reader
+    for i, (payload, desc) in enumerate(txns):
+        w, r = _rw_sets(payload, desc)
+        wi = 0
+        for a in w:
+            wi = max(wi, last_w.get(a, -1) + 1, last_r.get(a, -1) + 1)
+        for a in r:
+            wi = max(wi, last_w.get(a, -1) + 1)
+        while wi >= len(waves):
+            waves.append([])
+        waves[wi].append(i)
+        for a in w:
+            last_w[a] = max(last_w.get(a, -1), wi)
+        for a in r:
+            last_r[a] = max(last_r.get(a, -1), wi)
+    return waves
+
+
+def _execute_txn(funk: Funk, xid: bytes, payload: bytes, desc: ft.Txn) -> TxnResult:
+    addrs = desc.acct_addrs(payload)
+    payer = addrs[0]
+    fee = LAMPORTS_PER_SIGNATURE * desc.signature_cnt
+    payer_val = funk.rec_query(xid, payer)
+    if acct_lamports(payer_val) < fee:
+        return TxnResult(TXN_ERR_FEE, 0)
+    # charge the fee unconditionally (failed txns still pay, fd_executor)
+    funk.rec_insert(
+        xid, payer, acct_build(acct_lamports(payer_val) - fee, (payer_val or b"")[8:])
+    )
+
+    # snapshot for rollback of program effects (fee stays charged)
+    touched = {a for a in addrs}
+    before = {a: funk.rec_query(xid, a) for a in touched}
+
+    for ins in desc.instrs:
+        prog = addrs[ins.program_id]
+        if prog != ft.SYSTEM_PROGRAM:
+            continue  # unknown programs: no-op (the VM is a later layer)
+        data = payload[ins.data_off : ins.data_off + ins.data_sz]
+        if len(data) < 12 or int.from_bytes(data[:4], "little") != 2:
+            continue
+        lamports = int.from_bytes(data[4:12], "little")
+        idx = payload[ins.acct_off : ins.acct_off + ins.acct_cnt]
+        if len(idx) < 2:
+            continue
+        src, dst = addrs[idx[0]], addrs[idx[1]]
+        sv, dv = funk.rec_query(xid, src), funk.rec_query(xid, dst)
+        if acct_lamports(sv) < lamports:
+            # roll back program effects; the fee remains charged
+            for a, v in before.items():
+                if funk.rec_query(xid, a) != v:
+                    if v is None:
+                        funk.rec_remove(xid, a)
+                    else:
+                        funk.rec_insert(xid, a, v)
+            return TxnResult(TXN_ERR_INSUFFICIENT_FUNDS, fee)
+        funk.rec_insert(xid, src, acct_build(acct_lamports(sv) - lamports, (sv or b"")[8:]))
+        funk.rec_insert(xid, dst, acct_build(acct_lamports(dv) + lamports, (dv or b"")[8:]))
+    return TxnResult(TXN_SUCCESS, fee)
+
+
+def execute_block(
+    funk: Funk,
+    *,
+    slot: int,
+    txns: list[bytes],
+    parent_bank_hash: bytes = b"\x00" * 32,
+    poh_hash: bytes = b"\x00" * 32,
+    parent_xid: bytes | None = None,
+    publish: bool = False,
+) -> BlockResult:
+    """Execute a block's txns on a fresh funk fork; compute the bank hash.
+
+    The fork stays in-prep (consensus decides) unless publish=True."""
+    parsed = []
+    for p in txns:
+        t = ft.txn_parse(p)
+        if t is None:
+            raise ValueError("malformed txn in block")
+        parsed.append((p, t))
+    xid = b"slot:%d:%s" % (slot, (parent_xid or b"root"))
+    funk.txn_prepare(parent_xid, xid)
+    waves = generate_waves(parsed)
+
+    # track every account any txn touches, for the delta hash
+    touched: set[bytes] = set()
+    before: dict[bytes, bytes | None] = {}
+    for p, t in parsed:
+        for a in t.acct_addrs(p):
+            if a not in before:
+                before[a] = funk.rec_query(xid, a)
+            touched.add(a)
+
+    results: list[TxnResult] = [None] * len(parsed)
+    for wave in waves:
+        # wave txns are conflict-free: host executes in index order, a
+        # tpool/device executes them concurrently — same result either way
+        for i in wave:
+            p, t = parsed[i]
+            results[i] = _execute_txn(funk, xid, p, t)
+
+    # accounts-delta lattice hash: one device reduction over +new / -old
+    vals = []
+    signs = []
+    for a in sorted(touched):
+        after = funk.rec_query(xid, a)
+        if after == before[a]:
+            continue
+        if before[a] is not None:
+            vals.append(lt.lthash_of(a + before[a]))
+            signs.append(-1)
+        if after is not None:
+            vals.append(lt.lthash_of(a + after))
+            signs.append(1)
+    if vals:
+        delta = np.asarray(lt.combine_device(np.stack(vals), np.asarray(signs)))
+    else:
+        delta = lt.lthash_zero()
+
+    sig_cnt = sum(t.signature_cnt for _, t in parsed)
+    fees = sum(r.fee for r in results)
+    bank_hash = hashlib.sha256(
+        parent_bank_hash
+        + hashlib.sha256(delta.tobytes()).digest()
+        + sig_cnt.to_bytes(8, "little")
+        + poh_hash
+    ).digest()
+    if publish:
+        funk.txn_publish(xid)
+    return BlockResult(
+        slot=slot,
+        bank_hash=bank_hash,
+        accounts_delta=delta,
+        signature_cnt=sig_cnt,
+        fees=fees,
+        results=results,
+        waves=waves,
+        xid=xid,
+    )
+
+
+def replay_block(
+    funk: Funk,
+    *,
+    slot: int,
+    entries: list[tuple[int, bytes, list[bytes]]],
+    poh_seed: bytes,
+    parent_bank_hash: bytes = b"\x00" * 32,
+    parent_xid: bytes | None = None,
+    publish: bool = False,
+) -> BlockResult | None:
+    """The non-leader path: verify the PoH chain over wire entries, then
+    execute the block (fd_replay's after_frag shape).  None = PoH fraud."""
+    from firedancer_tpu.runtime import poh as fpoh
+
+    ok, _segments = fpoh.replay_entries(poh_seed, entries)
+    if not ok:
+        return None
+    txns = [p for _, _, txs in entries for p in txs]
+    poh_hash = entries[-1][1] if entries else b"\x00" * 32
+    return execute_block(
+        funk,
+        slot=slot,
+        txns=txns,
+        parent_bank_hash=parent_bank_hash,
+        poh_hash=poh_hash,
+        parent_xid=parent_xid,
+        publish=publish,
+    )

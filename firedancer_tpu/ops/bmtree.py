@@ -27,13 +27,25 @@ NODE_PREFIX = b"\x01SOLANA_MERKLE_SHREDS_NODE"
 NODE_SZ = 20
 
 
+def hash_leaf_full(data: bytes) -> bytes:
+    """sha256(leaf-domain prefix || data) — full 32 bytes.  Nodes STORE
+    the 20-byte truncation, but the ROOT stays untruncated (it is what
+    the leader signs, fd_bmtree_commit_fini's 'untruncated regardless of
+    hash_sz' contract)."""
+    return hashlib.sha256(LEAF_PREFIX + data).digest()
+
+
 def hash_leaf(data: bytes) -> bytes:
-    """sha256(leaf-domain prefix || data), truncated to 20 bytes."""
-    return hashlib.sha256(LEAF_PREFIX + data).digest()[:NODE_SZ]
+    """Truncated 20-byte leaf node (tree storage form)."""
+    return hash_leaf_full(data)[:NODE_SZ]
+
+
+def _merge_full(a: bytes, b: bytes) -> bytes:
+    return hashlib.sha256(NODE_PREFIX + a[:NODE_SZ] + b[:NODE_SZ]).digest()
 
 
 def _merge(a: bytes, b: bytes) -> bytes:
-    return hashlib.sha256(NODE_PREFIX + a[:NODE_SZ] + b[:NODE_SZ]).digest()[:NODE_SZ]
+    return _merge_full(a, b)[:NODE_SZ]
 
 
 def depth(leaf_cnt: int) -> int:
@@ -63,7 +75,30 @@ def tree_layers(leaves: list[bytes]) -> list[list[bytes]]:
 
 
 def root(leaves: list[bytes]) -> bytes:
+    """20-byte (storage-form) root."""
     return tree_layers(leaves)[-1][0]
+
+
+def root32_from_layers(layers: list[list[bytes]], leaves_full: list[bytes]) -> bytes:
+    """Untruncated 32-byte root — the value the leader signs
+    (fd_bmtree_commit_fini keeps the root full-width) — derived from an
+    ALREADY-BUILT layer stack: only the final merge recomputes, so the
+    tree is hashed once even when both proofs and the signed root are
+    needed."""
+    if len(layers[0]) == 1:
+        return leaves_full[0]
+    top = layers[-2]  # the final merge's children
+    return _merge_full(top[0], top[1] if len(top) > 1 else top[0])
+
+
+def root32(leaves_full: list[bytes]) -> bytes:
+    """Untruncated 32-byte root from FULL (32-byte) leaves.  Intermediate
+    merges truncate to 20 bytes exactly like the stored tree; only the
+    final output keeps all 32."""
+    if not leaves_full:
+        raise ValueError("empty tree")
+    layers = tree_layers([x[:NODE_SZ] for x in leaves_full])
+    return root32_from_layers(layers, leaves_full)
 
 
 def get_proof(layers: list[list[bytes]], leaf_idx: int) -> list[bytes]:
@@ -77,13 +112,18 @@ def get_proof(layers: list[list[bytes]], leaf_idx: int) -> list[bytes]:
     return proof
 
 
-def verify_proof(leaf: bytes, leaf_idx: int, proof: list[bytes]) -> bytes:
-    """Root implied by (leaf, proof) — caller compares/signature-checks it
-    (fd_bmtree_from_proof's derive-then-compare shape)."""
-    node = leaf[:NODE_SZ]
+def verify_proof(leaf_full: bytes, leaf_idx: int, proof: list[bytes]) -> bytes:
+    """UNTRUNCATED (32-byte) root implied by (full leaf, proof) — the
+    caller compares it to the set root / checks the leader signature over
+    it (fd_bmtree_from_proof's derive-then-compare shape).  Intermediate
+    nodes truncate to 20 bytes; the final merge keeps all 32."""
+    if not proof:
+        return leaf_full
+    node = leaf_full[:NODE_SZ]
     idx = leaf_idx
-    for sib in proof:
-        node = _merge(sib, node) if idx & 1 else _merge(node, sib)
+    for k, sib in enumerate(proof):
+        full = _merge_full(sib, node) if idx & 1 else _merge_full(node, sib)
+        node = full if k == len(proof) - 1 else full[:NODE_SZ]
         idx >>= 1
     return node
 

@@ -1,0 +1,160 @@
+"""ChaCha20 block function (batched on device + host) and the Solana
+protocol RNG.
+
+Capability parity with /root/reference/src/ballet/chacha20/
+(fd_chacha20.h block function; fd_chacha20rng.h the rand_chacha-compatible
+RNG Solana uses for leader-schedule generation and Turbine trees).  The
+round structure and constants are RFC 7539/8439 (protocol constants); the
+RNG semantics are pinned to rand_chacha::ChaCha20Rng::from_seed — key =
+seed, nonce 0, counter 0, 64-byte blocks consumed as little-endian u64s —
+with the two rejection-sampling "roll" modes Solana mixes (MOD for leader
+schedule, SHIFT for Turbine).
+
+TPU-native twist: `chacha20_keystream` generates B independent 64-byte
+blocks in one dispatch — 16 u32 state lanes wide in the byte dimension,
+batched over B in the lane dimension.  The hot use is bulk keystream
+(account shuffles over many seeds at once); the *sequential* RNG consumer
+(ChaCha20Rng) is host-side by nature — each roll depends on the last —
+and uses the same block function on numpy.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+MASK32 = 0xFFFFFFFF
+# "expand 32-byte k" (RFC 7539 constant)
+SIGMA = (0x61707865, 0x3320646E, 0x79622D32, 0x6B206574)
+
+
+def _quarter_np(s, a, b, c, d):
+    s[a] = (s[a] + s[b]) & MASK32
+    s[d] = ((s[d] ^ s[a]) << 16 | (s[d] ^ s[a]) >> 16) & MASK32
+    s[c] = (s[c] + s[d]) & MASK32
+    s[b] = ((s[b] ^ s[c]) << 12 | (s[b] ^ s[c]) >> 20) & MASK32
+    s[a] = (s[a] + s[b]) & MASK32
+    s[d] = ((s[d] ^ s[a]) << 8 | (s[d] ^ s[a]) >> 24) & MASK32
+    s[c] = (s[c] + s[d]) & MASK32
+    s[b] = ((s[b] ^ s[c]) << 7 | (s[b] ^ s[c]) >> 25) & MASK32
+
+
+_ROUND = [
+    (0, 4, 8, 12), (1, 5, 9, 13), (2, 6, 10, 14), (3, 7, 11, 15),
+    (0, 5, 10, 15), (1, 6, 11, 12), (2, 7, 8, 13), (3, 4, 9, 14),
+]
+
+
+def chacha20_block_host(key: bytes, idx: int, nonce: bytes = b"\x00" * 12) -> bytes:
+    """One 64-byte block: 32-byte key, u32 block index, 12-byte nonce."""
+    state = np.zeros(16, dtype=np.uint64)  # u64 lanes avoid overflow fuss
+    state[:4] = SIGMA
+    state[4:12] = np.frombuffer(key, dtype="<u4").astype(np.uint64)
+    state[12] = idx & MASK32
+    state[13:16] = np.frombuffer(nonce, dtype="<u4").astype(np.uint64)
+    s = state.copy()
+    for _ in range(10):
+        for a, b, c, d in _ROUND:
+            _quarter_np(s, a, b, c, d)
+    out = (s + state) & MASK32
+    return out.astype("<u4").tobytes()
+
+
+# -- batched device path ------------------------------------------------------
+
+
+def chacha20_keystream(keys, idxs, nonces=None):
+    """B independent blocks on device.
+
+    keys:   (32, B) int32 byte rows
+    idxs:   (B,) int32/uint32 block indices
+    nonces: (12, B) byte rows or None (zero nonce)
+    Returns (64, B) int32 keystream byte rows.
+    """
+    import jax.numpy as jnp
+
+    keys = jnp.asarray(keys, dtype=jnp.uint32)
+    b = keys.shape[1]
+    kw = keys.reshape(8, 4, b)
+    key_words = kw[:, 0] | (kw[:, 1] << 8) | (kw[:, 2] << 16) | (kw[:, 3] << 24)
+    if nonces is None:
+        nonce_words = jnp.zeros((3, b), dtype=jnp.uint32)
+    else:
+        nw = jnp.asarray(nonces, dtype=jnp.uint32).reshape(3, 4, b)
+        nonce_words = nw[:, 0] | (nw[:, 1] << 8) | (nw[:, 2] << 16) | (nw[:, 3] << 24)
+    sigma = jnp.broadcast_to(
+        jnp.asarray(SIGMA, dtype=jnp.uint32)[:, None], (4, b)
+    )
+    state = jnp.concatenate(
+        [sigma, key_words, jnp.asarray(idxs, dtype=jnp.uint32)[None], nonce_words],
+        axis=0,
+    )  # (16, B)
+
+    def rotl(x, n):
+        return (x << n) | (x >> (32 - n))
+
+    s = list(state)
+    for _ in range(10):
+        for a, bb, c, d in _ROUND:
+            s[a] = s[a] + s[bb]
+            s[d] = rotl(s[d] ^ s[a], 16)
+            s[c] = s[c] + s[d]
+            s[bb] = rotl(s[bb] ^ s[c], 12)
+            s[a] = s[a] + s[bb]
+            s[d] = rotl(s[d] ^ s[a], 8)
+            s[c] = s[c] + s[d]
+            s[bb] = rotl(s[bb] ^ s[c], 7)
+    out = jnp.stack(s) + state  # (16, B) u32
+    bytes_out = jnp.stack(
+        [(out >> sh) & 0xFF for sh in (0, 8, 16, 24)], axis=1
+    )  # (16, 4, B)
+    return bytes_out.reshape(64, b).astype(jnp.int32)
+
+
+# -- the Solana protocol RNG (host, sequential by nature) ---------------------
+
+MODE_MOD = 1    # leader schedule (largest rejection zone)
+MODE_SHIFT = 2  # Turbine (power-of-two zone, no mod on the fast path)
+
+U64 = 1 << 64
+
+
+class ChaCha20Rng:
+    """rand_chacha::ChaCha20Rng::from_seed-compatible stream + rolls."""
+
+    def __init__(self, seed: bytes, mode: int = MODE_MOD):
+        if len(seed) != 32:
+            raise ValueError("seed must be 32 bytes")
+        self.key = bytes(seed)
+        self.mode = mode
+        self._block_idx = 0
+        self._buf = b""
+        self._off = 0
+
+    def _refill(self) -> None:
+        self._buf = chacha20_block_host(self.key, self._block_idx)
+        self._block_idx += 1
+        self._off = 0
+
+    def ulong(self) -> int:
+        """Next u64, little-endian off the keystream."""
+        if self._off + 8 > len(self._buf):
+            self._refill()
+        v = int.from_bytes(self._buf[self._off : self._off + 8], "little")
+        self._off += 8
+        return v
+
+    def ulong_roll(self, n: int) -> int:
+        """Unbiased uniform in [0, n) — the widening-multiply rejection
+        scheme of the Rust rand crate (zone per mode, fd_chacha20rng.h)."""
+        if not 0 < n < U64:
+            raise ValueError("n out of range")
+        if self.mode == MODE_MOD:
+            zone = (U64 - 1) - (U64 - n) % n
+        else:  # smallest power-of-two k with k*n >= 2^63; fits u64 always
+            zone = (n << (63 - (n.bit_length() - 1))) - 1
+        while True:
+            v = self.ulong()
+            res = v * n
+            hi, lo = res >> 64, res & (U64 - 1)
+            if lo <= zone:
+                return hi

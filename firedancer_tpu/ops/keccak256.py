@@ -1,0 +1,197 @@
+"""Batched Keccak-256 on TPU (legacy 0x01 padding, the Solana syscall
+flavor).
+
+Counterpart of /root/reference/src/ballet/keccak256/fd_keccak256.c (rate
+136, capacity 512, Keccak padding 0x01...0x80 — NOT the SHA-3 0x06
+variant; this is what sol_keccak256 and secp256k1_recover consume).
+
+TPU-native shape: keccak-f[1600] works on 25 64-bit lanes; with no native
+u64 the state is two (25, B) uint32 planes (lo, hi) — the same 2x32
+emulation as sha512.py — and the batch B rides the trailing lane
+dimension.  Variable-length messages absorb block-by-block with the
+per-element final-block capture trick (each element's digest is the state
+snapshot after ITS padded block; longer elements keep absorbing).
+
+The python-int host implementation is the differential ground truth
+(hashlib has only the 0x06 sha3 variant).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+RATE = 136
+OUT_SZ = 32
+
+# round constants (Keccak spec, LFSR-generated protocol constants)
+_RC = [
+    0x0000000000000001, 0x0000000000008082, 0x800000000000808A,
+    0x8000000080008000, 0x000000000000808B, 0x0000000080000001,
+    0x8000000080008081, 0x8000000000008009, 0x000000000000008A,
+    0x0000000000000088, 0x0000000080008009, 0x000000008000000A,
+    0x000000008000808B, 0x800000000000008B, 0x8000000000008089,
+    0x8000000000008003, 0x8000000000008002, 0x8000000000000080,
+    0x000000000000800A, 0x800000008000000A, 0x8000000080008081,
+    0x8000000000008080, 0x0000000080000001, 0x8000000080008008,
+]
+# rotation offsets r[x][y] flattened by lane index 5y + x... we index
+# lanes as idx = x + 5*y (row-major x fastest), matching the theta/pi
+# formulas below.
+_ROT = [
+    0, 1, 62, 28, 27,
+    36, 44, 6, 55, 20,
+    3, 10, 43, 25, 39,
+    41, 45, 15, 21, 8,
+    18, 2, 61, 56, 14,
+]
+_M64 = (1 << 64) - 1
+
+
+def _rotl64(v: int, n: int) -> int:
+    return ((v << n) | (v >> (64 - n))) & _M64 if n else v
+
+
+def _keccak_f_host(a: list[int]) -> list[int]:
+    for rc in _RC:
+        # theta
+        c = [a[x] ^ a[x + 5] ^ a[x + 10] ^ a[x + 15] ^ a[x + 20] for x in range(5)]
+        d = [c[(x - 1) % 5] ^ _rotl64(c[(x + 1) % 5], 1) for x in range(5)]
+        a = [a[i] ^ d[i % 5] for i in range(25)]
+        # rho + pi
+        b = [0] * 25
+        for x in range(5):
+            for y in range(5):
+                b[y + 5 * ((2 * x + 3 * y) % 5)] = _rotl64(
+                    a[x + 5 * y], _ROT[x + 5 * y]
+                )
+        # chi
+        a = [
+            b[i] ^ ((~b[(i + 1) % 5 + 5 * (i // 5)]) & b[(i + 2) % 5 + 5 * (i // 5)] & _M64)
+            for i in range(25)
+        ]
+        # iota
+        a[0] ^= rc
+    return a
+
+
+def keccak256_host(msg: bytes) -> bytes:
+    a = [0] * 25
+    padded = bytearray(msg)
+    padded.append(0x01)
+    while len(padded) % RATE:
+        padded.append(0)
+    padded[-1] ^= 0x80
+    for off in range(0, len(padded), RATE):
+        block = padded[off : off + RATE]
+        for i in range(RATE // 8):
+            a[i] ^= int.from_bytes(block[8 * i : 8 * i + 8], "little")
+        a = _keccak_f_host(a)
+    out = b"".join(a[i].to_bytes(8, "little") for i in range(4))
+    return out
+
+
+# -- batched device path ------------------------------------------------------
+
+
+def _rotl_pair(lo, hi, n: int):
+    """Rotate the u64 (hi:lo) left by n, in two uint32 planes."""
+    import jax.numpy as jnp
+
+    if n == 0:
+        return lo, hi
+    if n == 32:
+        return hi, lo
+    if n < 32:
+        return (
+            (lo << n) | (hi >> (32 - n)),
+            (hi << n) | (lo >> (32 - n)),
+        )
+    n -= 32
+    return (
+        (hi << n) | (lo >> (32 - n)),
+        (lo << n) | (hi >> (32 - n)),
+    )
+
+
+def _keccak_f(lo, hi):
+    """One permutation over (25, B) uint32 planes."""
+    import jax.numpy as jnp
+
+    for rc in _RC:
+        c_lo = [lo[x] ^ lo[x + 5] ^ lo[x + 10] ^ lo[x + 15] ^ lo[x + 20] for x in range(5)]
+        c_hi = [hi[x] ^ hi[x + 5] ^ hi[x + 10] ^ hi[x + 15] ^ hi[x + 20] for x in range(5)]
+        d = []
+        for x in range(5):
+            rl, rh = _rotl_pair(c_lo[(x + 1) % 5], c_hi[(x + 1) % 5], 1)
+            d.append((c_lo[(x - 1) % 5] ^ rl, c_hi[(x - 1) % 5] ^ rh))
+        lo = [lo[i] ^ d[i % 5][0] for i in range(25)]
+        hi = [hi[i] ^ d[i % 5][1] for i in range(25)]
+        b_lo, b_hi = [None] * 25, [None] * 25
+        for x in range(5):
+            for y in range(5):
+                rl, rh = _rotl_pair(lo[x + 5 * y], hi[x + 5 * y], _ROT[x + 5 * y])
+                b_lo[y + 5 * ((2 * x + 3 * y) % 5)] = rl
+                b_hi[y + 5 * ((2 * x + 3 * y) % 5)] = rh
+        lo = [
+            b_lo[i] ^ (~b_lo[(i + 1) % 5 + 5 * (i // 5)] & b_lo[(i + 2) % 5 + 5 * (i // 5)])
+            for i in range(25)
+        ]
+        hi = [
+            b_hi[i] ^ (~b_hi[(i + 1) % 5 + 5 * (i // 5)] & b_hi[(i + 2) % 5 + 5 * (i // 5)])
+            for i in range(25)
+        ]
+        lo[0] = lo[0] ^ jnp.uint32(rc & 0xFFFFFFFF)
+        hi[0] = hi[0] ^ jnp.uint32(rc >> 32)
+    return lo, hi
+
+
+def keccak256_msg(msg, msg_len, max_len: int):
+    """Batched keccak256 of variable-length messages.
+
+    msg: (max_len, B) int32 byte rows; msg_len: (B,); -> (32, B) int32.
+    """
+    import jax.numpy as jnp
+
+    msg = jnp.asarray(msg, dtype=jnp.int32)
+    msg_len = jnp.asarray(msg_len, dtype=jnp.int32)
+    batch = msg.shape[1:]
+    nb = (max_len + 1 + RATE - 1) // RATE  # +1: the 0x01 pad byte
+    total = nb * RATE
+    buf = jnp.pad(msg, [(0, total - max_len)] + [(0, 0)] * len(batch))
+    pos = jnp.arange(total, dtype=jnp.int32).reshape((total,) + (1,) * len(batch))
+    buf = jnp.where(pos < msg_len[None], buf, 0)
+    buf = buf + jnp.where(pos == msg_len[None], 0x01, 0)
+    final_block = msg_len // RATE  # block containing the 0x01 pad
+    last_byte = final_block * RATE + (RATE - 1)
+    buf = buf ^ jnp.where(pos == last_byte[None], 0x80, 0)
+    # bytes -> u64 pairs: (nb, RATE/8, 8, B)
+    words = buf.astype(jnp.uint32).reshape((nb, RATE // 8, 8) + batch)
+    w_lo = (
+        words[:, :, 0] | (words[:, :, 1] << 8) | (words[:, :, 2] << 16)
+        | (words[:, :, 3] << 24)
+    )
+    w_hi = (
+        words[:, :, 4] | (words[:, :, 5] << 8) | (words[:, :, 6] << 16)
+        | (words[:, :, 7] << 24)
+    )
+
+    zeros = jnp.zeros((25,) + batch, dtype=jnp.uint32)
+    lo = [zeros[i] for i in range(25)]
+    hi = [zeros[i] for i in range(25)]
+    res_lo = [zeros[i] for i in range(4)]
+    res_hi = [zeros[i] for i in range(4)]
+    for bi in range(nb):  # nb is static (few blocks); unrolled absorb
+        for i in range(RATE // 8):
+            lo[i] = lo[i] ^ w_lo[bi, i]
+            hi[i] = hi[i] ^ w_hi[bi, i]
+        lo, hi = _keccak_f(lo, hi)
+        take = final_block == bi
+        for i in range(4):
+            res_lo[i] = jnp.where(take, lo[i], res_lo[i])
+            res_hi[i] = jnp.where(take, hi[i], res_hi[i])
+    out = []
+    for i in range(4):
+        for plane in (res_lo[i], res_hi[i]):
+            for sh in (0, 8, 16, 24):
+                out.append(((plane >> sh) & 0xFF).astype(jnp.int32))
+    return jnp.stack(out)

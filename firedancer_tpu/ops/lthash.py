@@ -1,0 +1,51 @@
+"""LtHash: the lattice-based incremental accounts hash.
+
+Counterpart of /root/reference/src/ballet/lthash/fd_lthash.h: a hash
+value is 2048 bytes viewed as 1024 u16 lanes; hashing an input is BLAKE3
+with 2048-byte extended output; combining is elementwise u16 add
+(wrapping), removal is subtract — so the accounts-delta hash updates
+incrementally as accounts change, in any order (the lattice property).
+
+TPU-native shape: combining N account hashes is one (N, 1024) integer
+reduction — `combine_device` sums thousands of account deltas in a
+single dispatch, which is the hot path of the bank-hash computation
+(individual account XOFs are 32 sequential root compressions each and
+stay on host until a batched XOF kernel is profitable).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import blake3 as b3
+
+LEN_BYTES = 2048
+LEN_ELEMS = 1024
+
+
+def lthash_of(msg: bytes) -> np.ndarray:
+    """(1024,) uint16 lattice hash of one input."""
+    return np.frombuffer(b3.blake3_xof_host(msg, LEN_BYTES), dtype="<u2").copy()
+
+
+def lthash_zero() -> np.ndarray:
+    return np.zeros(LEN_ELEMS, dtype=np.uint16)
+
+
+def lthash_add(r: np.ndarray, a: np.ndarray) -> np.ndarray:
+    return (r + a).astype(np.uint16)
+
+
+def lthash_sub(r: np.ndarray, a: np.ndarray) -> np.ndarray:
+    return (r - a).astype(np.uint16)
+
+
+def combine_device(values, signs=None):
+    """Sum (N, 1024) u16 lattice values (optionally signed +-1 per row)
+    in one device reduction; returns (1024,) uint16."""
+    import jax.numpy as jnp
+
+    v = jnp.asarray(np.asarray(values, dtype=np.uint16), dtype=jnp.int32)
+    if signs is not None:
+        v = v * jnp.asarray(np.asarray(signs, dtype=np.int32))[:, None]
+    return (jnp.sum(v, axis=0) & 0xFFFF).astype(jnp.uint16)

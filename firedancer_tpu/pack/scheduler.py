@@ -114,6 +114,9 @@ class Pack:
         self._pending: list[OrdTxn] = []  # sorted by _RatioKey
         self._pending_votes: list[OrdTxn] = []
         self._sigs: set[bytes] = set()
+        # sig -> (pool, OrdTxn) index: delete_by_sig without a pool scan
+        # (the treap+map pairing of fd_pack.c, at host-model scale)
+        self._by_sig: dict[bytes, OrdTxn] = {}
         # account locks: addr -> [writer_mask, reader_mask] of bank bits
         self._in_use: dict[bytes, list[int]] = {}
         self._bank_accts: list[list[tuple[bytes, bool]]] = [
@@ -139,19 +142,22 @@ class Pack:
         if sig in self._sigs:
             return False
         pool = self._pending_votes if c.is_simple_vote else self._pending
-        if len(self._pending) + len(self._pending_votes) >= self.depth:
-            # full: drop lowest priority if the newcomer beats it
-            tail = pool[-1] if pool else None
-            ord_txn = OrdTxn(payload, t, c, c.rewards(t.signature_cnt))
-            if tail is None or not (ord_txn.sort_key() < tail.sort_key()):
-                return False
-            self._remove(tail)
-            bisect.insort(pool, ord_txn, key=OrdTxn.sort_key)
-            self._sigs.add(sig)
-            return True
         ord_txn = OrdTxn(payload, t, c, c.rewards(t.signature_cnt))
+        if len(self._pending) + len(self._pending_votes) >= self.depth:
+            # full: evict the GLOBALLY lowest-priority txn iff the
+            # newcomer beats it (both pools' tails considered — evicting
+            # only from the newcomer's own pool would let a low-value
+            # vote survive a high-value txn, fd_pack's delete-worst rule)
+            tails = [p[-1] for p in (self._pending, self._pending_votes) if p]
+            if not tails:  # depth <= 0: nothing to evict, refuse
+                return False
+            worst = max(tails, key=OrdTxn.sort_key)  # key orders best-first
+            if not (ord_txn.sort_key() < worst.sort_key()):
+                return False
+            self._remove(worst)
         bisect.insort(pool, ord_txn, key=OrdTxn.sort_key)
         self._sigs.add(sig)
+        self._by_sig[sig] = ord_txn
         return True
 
     def _remove(self, o: OrdTxn) -> None:
@@ -162,15 +168,14 @@ class Pack:
             except ValueError:
                 continue
         self._sigs.discard(o.first_sig())
+        self._by_sig.pop(o.first_sig(), None)
 
     def delete_by_sig(self, sig: bytes) -> bool:
-        for pool in (self._pending, self._pending_votes):
-            for o in pool:
-                if o.first_sig() == sig:
-                    pool.remove(o)
-                    self._sigs.discard(sig)
-                    return True
-        return False
+        o = self._by_sig.get(sig)
+        if o is None:
+            return False
+        self._remove(o)
+        return True
 
     def pending_cnt(self) -> int:
         return len(self._pending) + len(self._pending_votes)
@@ -262,6 +267,7 @@ class Pack:
                 continue
             pool.pop(0)
             self._sigs.discard(o.first_sig())
+            self._by_sig.pop(o.first_sig(), None)
             chosen.append(o)
             taken_w |= lw
             taken_r |= lr

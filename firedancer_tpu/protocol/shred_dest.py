@@ -1,0 +1,172 @@
+"""Turbine tree: who to send each shred to (the shred_dest layer).
+
+Behavioral port of /root/reference/src/disco/shred/fd_shred_dest.c:
+
+  - per-shred deterministic seed: sha256 over the packed 45-byte struct
+    {slot u64, type u8 (0xA5 data / 0x5A code), idx u32, leader pubkey}
+    (shred_dest_input, fd_shred_dest.c:24-31) — every validator computes
+    the identical tree without coordination;
+  - the seed keys the protocol ChaCha20Rng in SHIFT mode (Turbine's roll
+    mode), driving a stake-weighted shuffle: staked validators sampled
+    weighted-without-replacement first, then unstaked uniformly;
+  - the leader sends each shred to the shuffle's root (compute_first,
+    excluding itself from the candidates);
+  - a non-leader at shuffled position i retransmits to: positions
+    1..fanout if i == 0 (the root), positions i+fanout, i+2*fanout, ...,
+    i+fanout^2 if 1 <= i <= fanout, nobody otherwise — the two-level
+    fanout tree (fd_shred_dest.c:414-415).
+
+The destination list is indexed in the caller's order: staked (stake
+descending, the lsched order) first, then unstaked — index maps to full
+contact info exactly like fd_shred_dest_idx_to_dest.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+from dataclasses import dataclass
+
+from firedancer_tpu.ops.chacha20 import MODE_SHIFT, ChaCha20Rng
+from firedancer_tpu.protocol import shred as fs
+from firedancer_tpu.protocol.wsample import INDETERMINATE, WSample
+
+NO_DEST = 0xFFFF
+MAX_SHRED_CNT = 134  # DATA_SHREDS_MAX + PARITY_SHREDS_MAX
+
+_SEED_STRUCT = struct.Struct("<QBI")  # slot, type byte, shred idx
+
+
+@dataclass
+class Dest:
+    """One potential destination (contact info from gossip)."""
+
+    pubkey: bytes
+    stake: int = 0
+    ip4: int = 0
+    port: int = 0
+
+
+def shred_seed(slot: int, shred_idx: int, is_data: bool, leader: bytes) -> bytes:
+    t = 0xA5 if is_data else 0x5A
+    return hashlib.sha256(
+        _SEED_STRUCT.pack(slot, t, shred_idx) + leader
+    ).digest()
+
+
+class ShredDest:
+    def __init__(
+        self,
+        dests: list[Dest],
+        lsched,  # EpochLeaders
+        source: bytes,
+        excluded_stake: int = 0,
+    ):
+        staked = [d for d in dests if d.stake > 0]
+        unstaked = [d for d in dests if d.stake == 0]
+        if [d.pubkey for d in dests] != [d.pubkey for d in staked + unstaked]:
+            raise ValueError("dests must be ordered staked-first")
+        self.dests = dests
+        self.staked_cnt = len(staked)
+        self.unstaked_cnt = len(unstaked)
+        self.lsched = lsched
+        self.excluded_stake = excluded_stake
+        self._idx_of = {d.pubkey: i for i, d in enumerate(dests)}
+        if source not in self._idx_of:
+            raise ValueError("source must be among dests")
+        self.source_idx = self._idx_of[source]
+
+    # -- shuffles -----------------------------------------------------------
+
+    def _rng(self, seed: bytes) -> ChaCha20Rng:
+        return ChaCha20Rng(seed, mode=MODE_SHIFT)
+
+    def _sample_unstaked(self, rng: ChaCha20Rng, exclude: int | None) -> list[int]:
+        """Uniform shuffle (without replacement) of unstaked indices."""
+        pool = [
+            self.staked_cnt + i
+            for i in range(self.unstaked_cnt)
+            if self.staked_cnt + i != exclude
+        ]
+        out = []
+        while pool:
+            out.append(pool.pop(rng.ulong_roll(len(pool))))
+        return out
+
+    def _shuffle(self, seed: bytes) -> list[int]:
+        """Full Turbine ordering for one shred: staked weighted shuffle
+        (INDETERMINATE truncates — excluded stake won a roll and the rest
+        of the order is unknowable), then unstaked uniform."""
+        rng = self._rng(seed)
+        order: list[int] = []
+        if self.staked_cnt:
+            ws = WSample(
+                rng,
+                [self.dests[i].stake for i in range(self.staked_cnt)],
+                excluded_weight=self.excluded_stake,
+            )
+            for _ in range(self.staked_cnt):
+                idx = ws.sample_and_remove()
+                if idx == INDETERMINATE:
+                    return order  # poisoned: no further order is known
+                order.append(idx)
+        order.extend(self._sample_unstaked(rng, exclude=None))
+        return order
+
+    # -- public API ---------------------------------------------------------
+
+    def compute_first(self, shreds: list[bytes]) -> list[int]:
+        """Leader side: the Turbine root for each shred (dest index or
+        NO_DEST)."""
+        out = []
+        for buf in shreds:
+            s = fs.parse(buf)
+            leader = self.lsched.leader_for_slot(s.slot)
+            if leader is None:
+                out.append(NO_DEST)
+                continue
+            rng = self._rng(shred_seed(s.slot, s.idx, s.is_data, leader))
+            src_staked = self.source_idx < self.staked_cnt
+            weights = [
+                self.dests[i].stake
+                for i in range(self.staked_cnt)
+                if i != self.source_idx
+            ]
+            idx_map = [i for i in range(self.staked_cnt) if i != self.source_idx]
+            if weights:
+                ws = WSample(rng, weights, excluded_weight=self.excluded_stake)
+                got = ws.sample()
+                out.append(NO_DEST if got == INDETERMINATE else idx_map[got])
+            else:
+                cands = self._sample_unstaked(rng, exclude=self.source_idx)
+                out.append(cands[0] if cands else NO_DEST)
+        return out
+
+    def compute_children(
+        self, shreds: list[bytes], *, fanout: int
+    ) -> list[list[int]]:
+        """Non-leader side: this validator's retransmit targets per shred."""
+        out = []
+        for buf in shreds:
+            s = fs.parse(buf)
+            leader = self.lsched.leader_for_slot(s.slot)
+            if leader is None or leader == self.dests[self.source_idx].pubkey:
+                out.append([])  # the leader uses compute_first
+                continue
+            order = self._shuffle(shred_seed(s.slot, s.idx, s.is_data, leader))
+            # the leader doesn't participate in its own tree
+            leader_idx = self._idx_of.get(leader)
+            order = [i for i in order if i != leader_idx]
+            try:
+                my = order.index(self.source_idx)
+            except ValueError:
+                out.append([])  # we fell past a poisoned (truncated) order
+                continue
+            if my == 0:
+                positions = range(1, fanout + 1)
+            elif my <= fanout:
+                positions = range(my + fanout, my + fanout * fanout + 1, fanout)
+            else:
+                positions = range(0)
+            out.append([order[p] for p in positions if p < len(order)])
+        return out

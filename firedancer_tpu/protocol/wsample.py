@@ -1,0 +1,125 @@
+"""Weighted random sampling (the wsample layer) + epoch leader schedule.
+
+Capability parity with /root/reference/src/ballet/wsample/fd_wsample.h and
+/root/reference/src/flamenco/leaders/fd_leaders.c:
+
+  - WSample: sample indices with probability proportional to weight, with
+    or without removal, driven by the protocol ChaCha20Rng.  The
+    "poisoned"/excluded-stake contract matches fd_wsample: a roll landing
+    in the excluded tail returns INDETERMINATE and (in removal mode)
+    poisons the sampler — once the schedule diverges from the full stake
+    list the rest is unknowable.  The reference organizes cumulative
+    weights in a radix-8 tree for O(log n) search; semantically that is
+    interval search over insertion-order cumulative sums, which is what
+    the host model does (np.searchsorted over the prefix array).
+  - epoch_leaders: the Solana leader schedule — seed = epoch number LE in
+    a 32-byte key, MODE_MOD rng, one weighted sample (no removal) per
+    4-slot rotation (fd_leaders.c:72-86, FD_EPOCH_SLOTS_PER_ROTATION).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from firedancer_tpu.ops.chacha20 import MODE_MOD, ChaCha20Rng
+
+EMPTY = (1 << 64) - 1          # FD_WSAMPLE_EMPTY
+INDETERMINATE = (1 << 64) - 2  # FD_WSAMPLE_INDETERMINATE
+
+SLOTS_PER_ROTATION = 4
+
+
+class WSample:
+    def __init__(self, rng: ChaCha20Rng, weights, excluded_weight: int = 0):
+        self.rng = rng
+        self.weights = [int(w) for w in weights]
+        if any(w <= 0 for w in self.weights):
+            raise ValueError("weights must be positive")
+        self.excluded_weight = int(excluded_weight)
+        self.removed = [False] * len(self.weights)
+        self.unremoved_weight = sum(self.weights)
+        self.poisoned = False
+        self._prefix = np.cumsum(self.weights, dtype=np.uint64)
+
+    def _map_sample(self, x: int) -> int:
+        """Index whose cumulative interval contains x (insertion order)."""
+        return int(np.searchsorted(self._prefix, x, side="right"))
+
+    def sample(self) -> int:
+        if self.unremoved_weight == 0:
+            return EMPTY
+        if self.poisoned:
+            return INDETERMINATE
+        x = self.rng.ulong_roll(self.unremoved_weight + self.excluded_weight)
+        if x >= self.unremoved_weight:
+            return INDETERMINATE
+        return self._map_sample(x)
+
+    def sample_and_remove(self) -> int:
+        if self.unremoved_weight == 0:
+            return EMPTY
+        if self.poisoned:
+            return INDETERMINATE
+        x = self.rng.ulong_roll(self.unremoved_weight + self.excluded_weight)
+        if x >= self.unremoved_weight:
+            self.poisoned = True
+            return INDETERMINATE
+        idx = self._map_sample(x)
+        w = self.weights[idx]
+        self.weights[idx] = 0
+        self.removed[idx] = True
+        self.unremoved_weight -= w
+        self._prefix = np.cumsum(self.weights, dtype=np.uint64)
+        return idx
+
+    def sample_many(self, cnt: int) -> list[int]:
+        return [self.sample() for _ in range(cnt)]
+
+    def sample_and_remove_many(self, cnt: int) -> list[int]:
+        return [self.sample_and_remove() for _ in range(cnt)]
+
+
+@dataclass
+class EpochLeaders:
+    epoch: int
+    slot0: int
+    slot_cnt: int
+    pubkeys: list[bytes]  # stake order; index pub_cnt = indeterminate marker
+    sched: list[int]      # one pubkey index per rotation
+
+    def leader_for_slot(self, slot: int) -> bytes | None:
+        if not self.slot0 <= slot < self.slot0 + self.slot_cnt:
+            return None
+        idx = self.sched[(slot - self.slot0) // SLOTS_PER_ROTATION]
+        if idx >= len(self.pubkeys):
+            return None  # indeterminate (excluded stake won the roll)
+        return self.pubkeys[idx]
+
+
+def epoch_leaders(
+    epoch: int,
+    slot0: int,
+    slot_cnt: int,
+    stakes: list[tuple[bytes, int]],
+    excluded_stake: int = 0,
+) -> EpochLeaders:
+    """Derive the leader schedule (fd_epoch_leaders_new).
+
+    stakes: (pubkey, stake) pairs, pre-sorted by the caller the way the
+    runtime hands them over (stake desc, then pubkey — Agave order).
+    """
+    seed = epoch.to_bytes(8, "little") + bytes(24)
+    rng = ChaCha20Rng(seed, mode=MODE_MOD)
+    ws = WSample(rng, [s for _, s in stakes], excluded_weight=excluded_stake)
+    sched_cnt = (slot_cnt + SLOTS_PER_ROTATION - 1) // SLOTS_PER_ROTATION
+    pub_cnt = len(stakes)
+    sched = [min(ws.sample(), pub_cnt) for _ in range(sched_cnt)]
+    return EpochLeaders(
+        epoch=epoch,
+        slot0=slot0,
+        slot_cnt=slot_cnt,
+        pubkeys=[k for k, _ in stakes],
+        sched=sched,
+    )

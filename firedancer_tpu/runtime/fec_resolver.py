@@ -84,9 +84,10 @@ class FecResolver:
             self.metrics["shred_late"] += 1
             return None
 
-        # membership proof: leaf through the shred's own proof to a root
+        # membership proof: leaf through the shred's own proof to the
+        # (untruncated 32-byte) root
         depth = fs.merkle_cnt(s.variant)
-        leaf = bmtree.hash_leaf(s.merkle_leaf_data(buf))
+        leaf = bmtree.hash_leaf_full(s.merkle_leaf_data(buf))
         pos = (s.idx - s.fec_set_idx) if s.is_data else None
         if s.is_data:
             leaf_idx = pos
@@ -203,15 +204,19 @@ class FecResolver:
                 code_bufs[cidx] = b
 
         # validate the rebuild: the full tree must reproduce the set root
-        leaves = [
-            bmtree.hash_leaf(bytes(b[fs.SIGNATURE_SZ : fs.merkle_off(b[fs.SIGNATURE_SZ])]))
+        leaves_full = [
+            bmtree.hash_leaf_full(
+                bytes(b[fs.SIGNATURE_SZ : fs.merkle_off(b[fs.SIGNATURE_SZ])])
+            )
             for b in data_bufs
         ] + [
-            bmtree.hash_leaf(bytes(b[fs.SIGNATURE_SZ : fs.merkle_off(b[fs.SIGNATURE_SZ])]))
+            bmtree.hash_leaf_full(
+                bytes(b[fs.SIGNATURE_SZ : fs.merkle_off(b[fs.SIGNATURE_SZ])])
+            )
             for b in code_bufs
         ]
-        layers = bmtree.tree_layers(leaves)
-        if layers[-1][0] != ctx.merkle_root:
+        layers = bmtree.tree_layers([x[: bmtree.NODE_SZ] for x in leaves_full])
+        if bmtree.root32_from_layers(layers, leaves_full) != ctx.merkle_root:
             self.metrics["recover_fail"] += 1
             return None
 

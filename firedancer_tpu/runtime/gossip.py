@@ -1,0 +1,187 @@
+"""Gossip: signed contact-info exchange over UDP (the CRDS core).
+
+The cluster-discovery position of the reference
+(/root/reference/src/flamenco/gossip/fd_gossip.c — Solana's CRDS
+push/pull protocol).  This build implements the protocol's load-bearing
+core with its own compact encoding: a replicated table of SIGNED
+contact-info records, newest-wallclock-wins, spread by push (send my
+record to peers) and pull (ask a peer for its whole table).  The
+Solana-exact bincode encoding layers onto the same table later; what the
+rest of the framework needs — peer discovery feeding Turbine destination
+lists and repair peer selection — consumes the table, not the encoding.
+
+Wire format:
+    record:  32B pubkey | u64 wallclock | u16 shred_version | u32 ip4 |
+             u16 gossip_port | u16 tvu_port | u16 repair_port
+             | 64B sig over the preceding bytes
+    push:    "FDGO" | u8 1 | u16 record_cnt | record*
+    pull_rq: "FDGO" | u8 2
+    (a pull response is a push frame)
+
+Records are verified on receipt; an older wallclock never overwrites a
+newer one (CRDS upsert rule); self-records are refreshed on every push.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import time
+from dataclasses import dataclass
+
+from firedancer_tpu.ops.ref import ed25519_ref as ref
+
+MAGIC = b"FDGO"
+T_PUSH = 1
+T_PULL = 2
+
+_REC = struct.Struct("<QHIHHH")  # wallclock, shred_version, ip4, ports x3
+REC_SZ = 32 + _REC.size + 64
+
+
+@dataclass(frozen=True)
+class ContactInfo:
+    pubkey: bytes
+    wallclock: int
+    shred_version: int
+    ip4: int
+    gossip_port: int
+    tvu_port: int
+    repair_port: int
+
+    def body(self) -> bytes:
+        return self.pubkey + _REC.pack(
+            self.wallclock, self.shred_version, self.ip4,
+            self.gossip_port, self.tvu_port, self.repair_port,
+        )
+
+
+def encode_record(info: ContactInfo, signer) -> bytes:
+    body = info.body()
+    return body + signer(body)
+
+
+def decode_record(buf: bytes) -> ContactInfo | None:
+    if len(buf) != REC_SZ:
+        return None
+    pubkey = buf[:32]
+    body, sig = buf[:-64], buf[-64:]
+    if not ref.verify(body, sig, pubkey):
+        return None
+    wallclock, sv, ip4, gp, tp, rp = _REC.unpack_from(buf, 32)
+    return ContactInfo(pubkey, wallclock, sv, ip4, gp, tp, rp)
+
+
+class GossipNode:
+    def __init__(
+        self,
+        identity_secret: bytes,
+        *,
+        shred_version: int = 1,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        tvu_port: int = 0,
+        repair_port: int = 0,
+        clock=None,
+    ):
+        self._secret = identity_secret
+        self.pubkey = ref.public_key(identity_secret)
+        self.shred_version = shred_version
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.sock.bind((host, port))
+        self.sock.setblocking(False)
+        self.tvu_port = tvu_port
+        self.repair_port = repair_port
+        self.clock = clock or (lambda: int(time.time() * 1000))
+        self.table: dict[bytes, ContactInfo] = {}
+        self.metrics = {"push_rx": 0, "pull_rx": 0, "rec_rejected": 0,
+                        "rec_upserted": 0, "rec_stale": 0}
+
+    @property
+    def addr(self):
+        return self.sock.getsockname()
+
+    def _self_record(self) -> bytes:
+        host, port = self.addr
+        ip4 = int.from_bytes(socket.inet_aton(host), "big")
+        info = ContactInfo(
+            self.pubkey, self.clock(), self.shred_version, ip4,
+            port, self.tvu_port, self.repair_port,
+        )
+        return encode_record(info, lambda m: ref.sign(self._secret, m))
+
+    def _push_frame(self, records: list[bytes]) -> bytes:
+        return (
+            MAGIC + bytes([T_PUSH]) + struct.pack("<H", len(records))
+            + b"".join(records)
+        )
+
+    def push(self, peers: list[tuple[str, int]]) -> None:
+        """Send my (re-signed, fresh-wallclock) record to peers."""
+        frame = self._push_frame([self._self_record()])
+        for p in peers:
+            self.sock.sendto(frame, p)
+
+    def pull(self, peer: tuple[str, int]) -> None:
+        """Ask a peer for its table (response arrives via poll)."""
+        self.sock.sendto(MAGIC + bytes([T_PULL]), peer)
+
+    def poll(self, burst: int = 32) -> None:
+        for _ in range(burst):
+            try:
+                data, src = self.sock.recvfrom(65536)
+            except (BlockingIOError, InterruptedError):
+                return
+            if len(data) < 5 or data[:4] != MAGIC:
+                continue
+            t = data[4]
+            if t == T_PUSH:
+                self.metrics["push_rx"] += 1
+                (cnt,) = struct.unpack_from("<H", data, 5)
+                off = 7
+                for _ in range(cnt):
+                    self._upsert(data[off : off + REC_SZ])
+                    off += REC_SZ
+            elif t == T_PULL:
+                self.metrics["pull_rx"] += 1
+                # respond with my record + every cached SIGNED record,
+                # chunked to MTU-sized frames (one giant datagram would
+                # EMSGSIZE past ~570 peers and kill the loop)
+                records = [self._self_record()] + list(
+                    self._signed_cache.values()
+                )
+                per_frame = max(1, (1200 - 7) // REC_SZ)
+                for off in range(0, len(records), per_frame):
+                    self.sock.sendto(
+                        self._push_frame(records[off : off + per_frame]), src
+                    )
+
+    # signed records are cached verbatim: we cannot re-sign other
+    # validators' records (we don't have their keys), so pull responses
+    # forward the original signed bytes (exactly what CRDS does)
+    @property
+    def _signed_cache(self) -> dict[bytes, bytes]:
+        if not hasattr(self, "_signed"):
+            self._signed: dict[bytes, bytes] = {}
+        return self._signed
+
+    def _upsert(self, rec_bytes: bytes) -> None:
+        info = decode_record(rec_bytes)
+        if info is None:
+            self.metrics["rec_rejected"] += 1
+            return
+        if info.pubkey == self.pubkey:
+            return  # my own record reflected back
+        cur = self.table.get(info.pubkey)
+        if cur is not None and cur.wallclock >= info.wallclock:
+            self.metrics["rec_stale"] += 1
+            return
+        self.table[info.pubkey] = info
+        self._signed_cache[info.pubkey] = bytes(rec_bytes)
+        self.metrics["rec_upserted"] += 1
+
+    def peers(self) -> list[ContactInfo]:
+        return list(self.table.values())
+
+    def close(self):
+        self.sock.close()

@@ -1,0 +1,166 @@
+"""Shred repair: request missing shreds from peers over UDP.
+
+The repair-protocol position of the reference
+(/root/reference/src/flamenco/repair/fd_repair.c — request shreds the
+turbine fan-out never delivered; served from the peer's blockstore).
+Wire format is this framework's own compact framing (the reference
+speaks Solana's repair protocol; protocol-exact encoding rides on this
+same structure later):
+
+    request:  "FDRP" | u8 1 | u64 slot | u32 shred_idx | u32 nonce |
+              32B requester pubkey | 64B sig over the preceding bytes
+    response: "FDRP" | u8 2 | u32 nonce | shred bytes
+
+Requests are signed (the reference signs repair requests so servers can
+prioritize staked peers); the server verifies before serving.  The
+client validates that the response parses and matches the requested
+(slot, idx) before handing it to the FEC resolver — repair peers are
+untrusted; the resolver's merkle checks stay the real gate.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+
+from firedancer_tpu.ops.ref import ed25519_ref as ref
+from firedancer_tpu.protocol import shred as fs
+
+MAGIC = b"FDRP"
+T_REQUEST = 1
+T_RESPONSE = 2
+
+_REQ = struct.Struct("<QII")  # slot, shred_idx, nonce
+
+
+def encode_request(
+    slot: int, shred_idx: int, nonce: int, pubkey: bytes, signer
+) -> bytes:
+    body = MAGIC + bytes([T_REQUEST]) + _REQ.pack(slot, shred_idx, nonce) + pubkey
+    return body + signer(body)
+
+
+def decode_request(buf: bytes):
+    """-> (slot, shred_idx, nonce, pubkey) or None (bad frame/signature)."""
+    if len(buf) != 4 + 1 + _REQ.size + 32 + 64:
+        return None
+    if buf[:4] != MAGIC or buf[4] != T_REQUEST:
+        return None
+    slot, idx, nonce = _REQ.unpack_from(buf, 5)
+    pubkey = buf[5 + _REQ.size : 5 + _REQ.size + 32]
+    sig = buf[-64:]
+    if not ref.verify(buf[:-64], sig, pubkey):
+        return None
+    return slot, idx, nonce, pubkey
+
+
+def encode_response(nonce: int, shred: bytes) -> bytes:
+    return MAGIC + bytes([T_RESPONSE]) + struct.pack("<I", nonce) + shred
+
+
+def decode_response(buf: bytes):
+    """-> (nonce, shred bytes) or None."""
+    if len(buf) < 9 or buf[:4] != MAGIC or buf[4] != T_RESPONSE:
+        return None
+    (nonce,) = struct.unpack_from("<I", buf, 5)
+    return nonce, buf[9:]
+
+
+class Blockstore:
+    """Minimal shred-by-(slot, idx) store the server serves from (the
+    blockstore's repair-facing face; StoreStage feeds it)."""
+
+    def __init__(self):
+        self._shreds: dict[tuple[int, int], bytes] = {}
+
+    def put_set(self, fec_set) -> None:
+        for buf in fec_set.data_shreds:
+            s = fs.parse(buf)
+            self._shreds[(s.slot, s.idx)] = bytes(buf)
+
+    def put_shred(self, buf: bytes) -> None:
+        s = fs.parse(buf)
+        if s is not None and s.is_data:
+            self._shreds[(s.slot, s.idx)] = bytes(buf)
+
+    def get(self, slot: int, idx: int) -> bytes | None:
+        return self._shreds.get((slot, idx))
+
+    def __len__(self) -> int:
+        return len(self._shreds)
+
+
+class RepairServer:
+    def __init__(self, store: Blockstore, *, host="127.0.0.1", port=0):
+        self.store = store
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.sock.bind((host, port))
+        self.sock.setblocking(False)
+        self.served = 0
+        self.refused = 0
+
+    @property
+    def addr(self):
+        return self.sock.getsockname()
+
+    def poll(self, burst: int = 32) -> None:
+        for _ in range(burst):
+            try:
+                data, src = self.sock.recvfrom(2048)
+            except (BlockingIOError, InterruptedError):
+                return
+            req = decode_request(data)
+            if req is None:
+                self.refused += 1
+                continue
+            slot, idx, nonce, _pub = req
+            shred = self.store.get(slot, idx)
+            if shred is not None:
+                self.sock.sendto(encode_response(nonce, shred), src)
+                self.served += 1
+
+    def close(self):
+        self.sock.close()
+
+
+class RepairClient:
+    def __init__(self, identity_secret: bytes, *, signer=None):
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.sock.setblocking(False)
+        self.pubkey = ref.public_key(identity_secret)
+        self._signer = signer or (lambda msg: ref.sign(identity_secret, msg))
+        self._nonce = 0
+        self.metrics = {"req": 0, "ok": 0, "bad_response": 0}
+
+    def request(
+        self, peer, slot: int, shred_idx: int, *, spin=None, max_spins=200_000
+    ) -> bytes | None:
+        """One request/response round trip; None on timeout/bad reply."""
+        self._nonce += 1
+        nonce = self._nonce
+        self.sock.sendto(
+            encode_request(slot, shred_idx, nonce, self.pubkey, self._signer), peer
+        )
+        self.metrics["req"] += 1
+        for _ in range(max_spins):
+            if spin is not None:
+                spin()
+            try:
+                data, _src = self.sock.recvfrom(2048)
+            except (BlockingIOError, InterruptedError):
+                continue
+            res = decode_response(data)
+            if res is None or res[0] != nonce:
+                self.metrics["bad_response"] += 1
+                continue
+            shred = res[1]
+            s = fs.parse(shred)
+            if s is None or s.slot != slot or s.idx != shred_idx:
+                self.metrics["bad_response"] += 1
+                continue
+            self.metrics["ok"] += 1
+            return shred
+        return None
+
+    def close(self):
+        self.sock.close()

@@ -224,15 +224,20 @@ class Shredder:
                 )
                 for j in range(p)
             ]
-            leaves = [
-                bmtree.hash_leaf(bytes(b[fs.SIGNATURE_SZ : fs.merkle_off(b[fs.SIGNATURE_SZ])]))
+            leaves_full = [
+                bmtree.hash_leaf_full(
+                    bytes(b[fs.SIGNATURE_SZ : fs.merkle_off(b[fs.SIGNATURE_SZ])])
+                )
                 for b in data_bufs
             ] + [
-                bmtree.hash_leaf(bytes(b[fs.SIGNATURE_SZ : fs.merkle_off(b[fs.SIGNATURE_SZ])]))
+                bmtree.hash_leaf_full(
+                    bytes(b[fs.SIGNATURE_SZ : fs.merkle_off(b[fs.SIGNATURE_SZ])])
+                )
                 for b in parity_bufs
             ]
-            layers = bmtree.tree_layers(leaves)
-            root = layers[-1][0]
+            layers = bmtree.tree_layers([x[: bmtree.NODE_SZ] for x in leaves_full])
+            # the signature covers the UNTRUNCATED 32-byte root
+            root = bmtree.root32_from_layers(layers, leaves_full)
             sig = self.signer(root)
             for i, buf in enumerate(data_bufs):
                 fs.set_signature(buf, sig)

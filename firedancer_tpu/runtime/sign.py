@@ -1,0 +1,107 @@
+"""Sign stage + keyguard: the only holder of the validator private key.
+
+Mirrors the reference's sign tile and keyguard broker
+(/root/reference/src/app/fdctl/run/tiles/fd_sign.c,
+src/disco/keyguard/fd_keyguard.h): every component that needs a
+signature (shred merkle roots, gossip messages, votes, QUIC TLS
+handshakes, repair requests) talks to ONE stage over a dedicated
+request/response link pair; the private key never leaves this stage's
+process.  Each request link is bound to a ROLE at topology-build time,
+and the keyguard refuses payloads that don't match the role's shape —
+a compromised shred stage cannot exfiltrate vote signatures
+(fd_keyguard_payload_authorize).
+
+Request frame: the raw payload to sign.  Response frame: the 64-byte
+ed25519 signature.  Link MTUs mirror the reference's tiny sign links
+(fd_frankendancer.c:78-82).
+"""
+
+from __future__ import annotations
+
+from firedancer_tpu.ops.ref import ed25519_ref as ref
+from .stage import Stage
+
+ROLE_VOTER = 0
+ROLE_GOSSIP = 1
+ROLE_LEADER = 2  # block producer: signs 32-byte shred merkle roots
+ROLE_QUIC = 3
+ROLE_REPAIR = 4
+
+MAX_REQ_SZ = 1232
+
+
+def payload_authorize(role: int, payload: bytes) -> bool:
+    """Role-gated payload acceptance (fd_keyguard_payload_authorize's
+    shape rules, conservatively tightened for implemented roles)."""
+    n = len(payload)
+    if n == 0 or n > MAX_REQ_SZ:
+        return False
+    if role == ROLE_LEADER:
+        return n == 32  # merkle roots only
+    if role == ROLE_GOSSIP:
+        # gossip signable payloads are small CRDS-ish blobs, never txn-like
+        return n <= 256 and not payload[:1] == b"\x01"
+    if role == ROLE_QUIC:
+        return n == 130  # TLS-1.3 CertificateVerify transcript shape
+    if role == ROLE_REPAIR:
+        return n <= 160
+    if role == ROLE_VOTER:
+        return n <= MAX_REQ_SZ
+    return False
+
+
+class SignStage(Stage):
+    """ins[i] = request link for role roles[i]; outs[i] = response link."""
+
+    def __init__(self, *args, secret: bytes, roles: list[int], **kwargs):
+        super().__init__(*args, **kwargs)
+        if len(roles) != len(self.ins) or len(roles) != len(self.outs):
+            raise ValueError("one role per request/response link pair")
+        self._secret = secret
+        self.public_key = ref.public_key(secret)
+        self.roles = roles
+        self.require_credit = True
+
+    def after_frag(self, in_idx: int, meta, payload: bytes) -> None:
+        if not payload_authorize(self.roles[in_idx], payload):
+            self.metrics.inc("refused")
+            return
+        sig = ref.sign(self._secret, payload)
+        self.publish(in_idx, sig, sig=int(meta[1]))
+        self.metrics.inc("signed")
+
+
+class KeyguardClient:
+    """Blocking request/response helper over a sign link pair
+    (fd_keyguard_client_sign).  `spin` is called while waiting so the
+    cooperative scheduler can keep the sign stage running; the process
+    runner passes None and genuinely blocks on the ring."""
+
+    def __init__(self, producer, consumer, *, spin=None, max_spins: int = 1_000_000):
+        self.producer = producer
+        self.consumer = consumer
+        self.spin = spin
+        self.max_spins = max_spins
+        self._req_seq = 0
+
+    def sign(self, payload: bytes) -> bytes:
+        from firedancer_tpu.tango.rings import MCache
+
+        self._req_seq += 1
+        if not self.producer.try_publish(payload, sig=self._req_seq):
+            raise RuntimeError("sign request ring full")
+        for _ in range(self.max_spins):
+            res = self.consumer.poll()
+            if isinstance(res, tuple):
+                meta, sig = res
+                # correlate by the echoed request seq: a stale response to
+                # a timed-out earlier request must not answer THIS one (it
+                # would sign the wrong payload forever after)
+                if int(meta[MCache.COL_SIG]) != self._req_seq:
+                    continue
+                if len(sig) != 64:
+                    raise RuntimeError("malformed sign response")
+                return sig
+            if self.spin is not None:
+                self.spin()
+        raise TimeoutError("sign stage did not respond")

@@ -1,0 +1,139 @@
+"""Framed compressed checkpoint/restore (the util/checkpt layer).
+
+Capability parity with /root/reference/src/util/checkpt/fd_checkpt.h: a
+checkpoint is a sequence of independent *frames*, each holding a sequence
+of variable-size data buffers, stored RAW or stream-compressed; frames
+are independent so they can be produced in parallel and restored
+selectively.  The reference compresses with LZ4; this build uses zlib
+(the codec baked into this image) behind the same frame abstraction —
+the wire format is this framework's own.
+
+File layout (little-endian):
+    magic "FDTPUCKP" | u32 version | u32 frame_cnt
+    per frame: u8 style | u32 name_len | name | u64 payload_sz | payload
+    payload (after decompression for ZLIB style):
+        u32 buf_cnt | (u64 len | bytes)*
+
+`checkpt`/`restore` round-trip {name: [buffers]} dicts; higher layers
+(funk snapshot, PoH state, pipeline state) serialize onto this.
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+
+MAGIC = b"FDTPUCKP"
+VERSION = 1
+
+STYLE_RAW = 0
+STYLE_ZLIB = 1
+
+
+def _encode_frame(bufs: list[bytes]) -> bytes:
+    out = bytearray(struct.pack("<I", len(bufs)))
+    for b in bufs:
+        out += struct.pack("<Q", len(b))
+        out += b
+    return bytes(out)
+
+
+def _decode_frame(payload: bytes) -> list[bytes]:
+    (cnt,) = struct.unpack_from("<I", payload, 0)
+    off = 4
+    bufs = []
+    for _ in range(cnt):
+        (ln,) = struct.unpack_from("<Q", payload, off)
+        off += 8
+        bufs.append(payload[off : off + ln])
+        off += ln
+    if off != len(payload):
+        raise ValueError("trailing bytes in checkpoint frame")
+    return bufs
+
+
+def checkpt(
+    path: str, frames: dict[str, list[bytes]], *, style: int = STYLE_ZLIB
+) -> int:
+    """Write named frames; returns bytes written."""
+    out = bytearray(MAGIC)
+    out += struct.pack("<II", VERSION, len(frames))
+    for name, bufs in frames.items():
+        nb = name.encode()
+        payload = _encode_frame(bufs)
+        if style == STYLE_ZLIB:
+            payload = zlib.compress(payload, 6)
+        out += struct.pack("<BI", style, len(nb))
+        out += nb
+        out += struct.pack("<Q", len(payload))
+        out += payload
+    with open(path, "wb") as f:
+        f.write(out)
+    return len(out)
+
+
+def restore(path: str, *, only: set[str] | None = None) -> dict[str, list[bytes]]:
+    """Read frames back (optionally a subset — frames are independent)."""
+    data = open(path, "rb").read()
+    if data[:8] != MAGIC:
+        raise ValueError("bad checkpoint magic")
+    version, cnt = struct.unpack_from("<II", data, 8)
+    if version != VERSION:
+        raise ValueError(f"unsupported checkpoint version {version}")
+    off = 16
+    out: dict[str, list[bytes]] = {}
+    for _ in range(cnt):
+        style, name_len = struct.unpack_from("<BI", data, off)
+        off += 5
+        name = data[off : off + name_len].decode()
+        off += name_len
+        (sz,) = struct.unpack_from("<Q", data, off)
+        off += 8
+        payload = data[off : off + sz]
+        off += sz
+        if only is not None and name not in only:
+            continue
+        if style == STYLE_ZLIB:
+            payload = zlib.decompress(payload)
+        elif style != STYLE_RAW:
+            raise ValueError(f"unknown frame style {style}")
+        out[name] = _decode_frame(payload)
+    return out
+
+
+# -- funk + poh state serialization (the snapshot consumers) ------------------
+
+
+def funk_checkpt(path: str, funk) -> int:
+    """Snapshot a funk's ROOT store (published state — in-prep forks are
+    speculative by definition and not checkpointable, matching the funk
+    archive's published-only scope, fd_funk_archive.c)."""
+    bufs = []
+    for key, val in sorted(funk._root.items()):
+        bufs.append(key)
+        bufs.append(val)
+    return checkpt(path, {"funk_root": bufs})
+
+
+def funk_restore(path: str, funk_cls):
+    f = funk_cls()
+    bufs = restore(path, only={"funk_root"})["funk_root"]
+    if len(bufs) % 2:
+        raise ValueError("funk frame must hold key/value pairs")
+    for i in range(0, len(bufs), 2):
+        f.rec_insert(None, bufs[i], bufs[i + 1])
+    return f
+
+
+def poh_checkpt(path: str, chain) -> int:
+    """PoH clock state: hash + hashcnt (resume continues the chain)."""
+    return checkpt(
+        path,
+        {"poh": [chain.hash, chain.hashcnt.to_bytes(8, "little")]},
+        style=STYLE_RAW,
+    )
+
+
+def poh_restore(path: str, chain_cls):
+    h, cnt = restore(path, only={"poh"})["poh"]
+    return chain_cls(hash=h, hashcnt=int.from_bytes(cnt, "little"))

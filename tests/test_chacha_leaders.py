@@ -1,0 +1,125 @@
+"""ChaCha20 block + protocol RNG + weighted sampling + leader schedule.
+
+Pinned to public vectors: the RFC 7539 2.3.2 block vector, and the
+rand_chacha stream values the reference also requires
+(test_chacha20rng.c: first u64 and the u64 after 100001 reads)."""
+
+import numpy as np
+import pytest
+
+from firedancer_tpu.ops import chacha20 as cc
+from firedancer_tpu.protocol import wsample as ws
+
+RFC_KEY = bytes(range(32))
+RFC_NONCE = bytes([0, 0, 0, 9, 0, 0, 0, 0x4A, 0, 0, 0, 0])
+RFC_BLOCK1 = bytes.fromhex(
+    "10f1e7e4d13b5915500fdd1fa32071c4"
+    "c7d1f4c733c068030422aa9ac3d46c4e"
+    "d2826446079faa0914c2d705d98b02a2"
+    "b5129cd1de164eb9cbd083e8a2503c4e"
+)
+
+
+def test_block_host_rfc7539():
+    assert cc.chacha20_block_host(RFC_KEY, 1, RFC_NONCE) == RFC_BLOCK1
+
+
+def test_keystream_device_matches_host():
+    rng = np.random.default_rng(2)
+    b = 5
+    keys = rng.integers(0, 256, (32, b), dtype=np.int32)
+    nonces = rng.integers(0, 256, (12, b), dtype=np.int32)
+    idxs = np.asarray([0, 1, 2, 7, 1000], dtype=np.int32)
+    out = np.asarray(cc.chacha20_keystream(keys, idxs, nonces))
+    for i in range(b):
+        expect = cc.chacha20_block_host(
+            keys[:, i].astype(np.uint8).tobytes(),
+            int(idxs[i]),
+            nonces[:, i].astype(np.uint8).tobytes(),
+        )
+        assert out[:, i].astype(np.uint8).tobytes() == expect
+
+
+def test_rng_rand_chacha_stream():
+    rng = cc.ChaCha20Rng(RFC_KEY, mode=cc.MODE_MOD)
+    assert rng.ulong() == 0x6A19C5D97D2BFD39
+    for _ in range(100_000):
+        rng.ulong()
+    assert rng.ulong() == 0xF4682B7E28EAE4A7
+
+
+def test_roll_ranges_and_determinism():
+    for mode in (cc.MODE_MOD, cc.MODE_SHIFT):
+        rng = cc.ChaCha20Rng(b"\x07" * 32, mode=mode)
+        vals = [rng.ulong_roll(10) for _ in range(2000)]
+        assert all(0 <= v < 10 for v in vals)
+        assert len(set(vals)) == 10  # all residues hit
+        # deterministic for a fixed seed
+        rng2 = cc.ChaCha20Rng(b"\x07" * 32, mode=mode)
+        assert [rng2.ulong_roll(10) for _ in range(2000)] == vals
+    # the two modes reject differently -> different streams
+    a = cc.ChaCha20Rng(b"\x09" * 32, mode=cc.MODE_MOD)
+    b = cc.ChaCha20Rng(b"\x09" * 32, mode=cc.MODE_SHIFT)
+    assert [a.ulong_roll(7) for _ in range(100)] != [
+        b.ulong_roll(7) for _ in range(100)
+    ]
+
+
+def test_wsample_distribution_and_removal():
+    rng = cc.ChaCha20Rng(b"\x01" * 32)
+    w = ws.WSample(rng, [90, 9, 1])
+    counts = [0, 0, 0]
+    for _ in range(3000):
+        counts[w.sample()] += 1
+    assert counts[0] > counts[1] > counts[2] > 0
+    assert counts[0] > 2500  # ~90%
+    # removal: each index exactly once, then EMPTY
+    rng = cc.ChaCha20Rng(b"\x02" * 32)
+    w = ws.WSample(rng, [5, 5, 5, 5])
+    got = sorted(w.sample_and_remove_many(4))
+    assert got == [0, 1, 2, 3]
+    assert w.sample_and_remove() == ws.EMPTY
+
+
+def test_wsample_excluded_poisons():
+    # excluded weight dominates: the first roll that lands in the excluded
+    # tail returns INDETERMINATE and poisons removal-mode sampling
+    rng = cc.ChaCha20Rng(b"\x03" * 32)
+    w = ws.WSample(rng, [1], excluded_weight=1 << 40)
+    assert w.sample_and_remove() == ws.INDETERMINATE
+    assert w.poisoned
+    assert w.sample_and_remove() == ws.INDETERMINATE
+    # no-removal mode: INDETERMINATE rolls don't poison
+    rng = cc.ChaCha20Rng(b"\x04" * 32)
+    w = ws.WSample(rng, [1 << 40], excluded_weight=1)
+    vals = {w.sample() for _ in range(50)}
+    assert vals == {0} or ws.INDETERMINATE in vals and 0 in vals
+
+
+def test_epoch_leaders_schedule():
+    stakes = [
+        (b"A" * 32, 4_000_000),
+        (b"B" * 32, 2_000_000),
+        (b"C" * 32, 1_000_000),
+    ]
+    lead = ws.epoch_leaders(epoch=7, slot0=1000, slot_cnt=80, stakes=stakes)
+    assert len(lead.sched) == 20  # 80 slots / 4 per rotation
+    # leader constant within a rotation
+    for r in range(20):
+        slot = 1000 + r * 4
+        leaders = {lead.leader_for_slot(slot + i) for i in range(4)}
+        assert len(leaders) == 1
+    # deterministic in epoch
+    again = ws.epoch_leaders(epoch=7, slot0=1000, slot_cnt=80, stakes=stakes)
+    assert again.sched == lead.sched
+    other = ws.epoch_leaders(epoch=8, slot0=1000, slot_cnt=80, stakes=stakes)
+    assert other.sched != lead.sched
+    # out of range
+    assert lead.leader_for_slot(999) is None
+    assert lead.leader_for_slot(1080) is None
+    # stake-weighted: A leads most rotations over a bigger schedule
+    big = ws.epoch_leaders(epoch=3, slot0=0, slot_cnt=4000, stakes=stakes)
+    from collections import Counter
+
+    c = Counter(big.sched)
+    assert c[0] > c[1] > c[2]

@@ -1,0 +1,72 @@
+"""Checkpoint/restore tests: frame round trips (raw + compressed),
+selective restore, funk snapshot, PoH clock resume."""
+
+import numpy as np
+import pytest
+
+from firedancer_tpu.funk import Funk
+from firedancer_tpu.runtime.poh import PohChain, poh_append
+from firedancer_tpu.utils import checkpt as ck
+
+
+def test_roundtrip_styles(tmp_path):
+    rng = np.random.default_rng(5)
+    frames = {
+        "a": [b"", b"x", rng.bytes(10000)],
+        "b": [rng.bytes(100) for _ in range(17)],
+        "empty": [],
+    }
+    for style in (ck.STYLE_RAW, ck.STYLE_ZLIB):
+        p = str(tmp_path / f"c{style}.ckpt")
+        n = ck.checkpt(p, frames, style=style)
+        assert n > 0
+        assert ck.restore(p) == frames
+    # compressible data compresses
+    comp = {"z": [b"\x00" * 100_000]}
+    raw_sz = ck.checkpt(str(tmp_path / "r.ckpt"), comp, style=ck.STYLE_RAW)
+    z_sz = ck.checkpt(str(tmp_path / "z.ckpt"), comp, style=ck.STYLE_ZLIB)
+    assert z_sz < raw_sz // 10
+
+
+def test_selective_restore(tmp_path):
+    p = str(tmp_path / "s.ckpt")
+    ck.checkpt(p, {"one": [b"1"], "two": [b"2"], "three": [b"3"]})
+    assert ck.restore(p, only={"two"}) == {"two": [b"2"]}
+
+
+def test_corrupt_rejected(tmp_path):
+    p = str(tmp_path / "bad.ckpt")
+    ck.checkpt(p, {"a": [b"data"]})
+    blob = bytearray(open(p, "rb").read())
+    blob[0] ^= 0xFF
+    open(p, "wb").write(bytes(blob))
+    with pytest.raises(ValueError, match="magic"):
+        ck.restore(p)
+
+
+def test_funk_snapshot_roundtrip(tmp_path):
+    f = Funk()
+    f.rec_insert(None, b"alice", b"100")
+    f.rec_insert(None, b"bob", b"7")
+    a = f.txn_prepare(None, b"A")
+    f.rec_insert(a, b"alice", b"speculative")  # in-prep: NOT checkpointed
+    p = str(tmp_path / "funk.ckpt")
+    ck.funk_checkpt(p, f)
+    g = ck.funk_restore(p, Funk)
+    assert g.rec_query(None, b"alice") == b"100"
+    assert g.rec_query(None, b"bob") == b"7"
+    assert g.txn_cnt() == 0
+    assert g.rec_cnt_root() == 2
+
+
+def test_poh_resume_continues_chain(tmp_path):
+    c = PohChain(hash=b"\x11" * 32)
+    c.append(100)
+    p = str(tmp_path / "poh.ckpt")
+    ck.poh_checkpt(p, c)
+    r = ck.poh_restore(p, PohChain)
+    assert (r.hash, r.hashcnt) == (c.hash, 100)
+    # resuming and appending equals never having stopped
+    r.append(50)
+    assert r.hash == poh_append(b"\x11" * 32, 150)
+    assert r.hashcnt == 150

@@ -1,0 +1,144 @@
+"""Consensus tests: LMD-GHOST fork choice and TowerBFT lockouts — the
+worked examples from the reference's tower spec drive the assertions."""
+
+import pytest
+
+from firedancer_tpu.choreo import Ghost, Tower
+from firedancer_tpu.choreo.tower import MAX_LOCKOUT, Vote
+
+
+# -- ghost --------------------------------------------------------------------
+
+
+def _fork_tree():
+    r"""1 -- 2 -- 3 -- 4
+              \-- 5"""
+    g = Ghost(1)
+    g.insert(2, 1)
+    g.insert(3, 2)
+    g.insert(4, 3)
+    g.insert(5, 2)
+    return g
+
+
+def test_ghost_head_follows_stake():
+    g = _fork_tree()
+    g.vote(b"A", 4, 10)
+    assert g.head() == 4
+    g.vote(b"B", 5, 15)
+    assert g.head() == 5
+    assert g.weight(2) == 25  # both forks' stake flows through 2
+
+
+def test_ghost_lmd_vote_moves():
+    g = _fork_tree()
+    g.vote(b"A", 4, 10)
+    g.vote(b"A", 5, 10)  # latest message only: stake MOVES
+    assert g.weight(4) == 0
+    assert g.weight(5) == 10
+    assert g.head() == 5
+
+
+def test_ghost_tie_breaks_low_slot():
+    g = _fork_tree()
+    g.vote(b"A", 4, 10)
+    g.vote(b"B", 5, 10)
+    assert g.head() == 4  # equal weight: lower branch slot wins (3 < 5)
+
+
+def test_ghost_publish_prunes_exact():
+    g = _fork_tree()
+    assert g.publish(3) == 3  # drops 1, 2, 5; keeps 3, 4
+    assert set(g.nodes) == {3, 4}
+    assert g.root == 3
+    with pytest.raises(ValueError):
+        g.insert(6, 5)  # pruned parent is gone
+
+
+def test_ghost_is_ancestor():
+    g = _fork_tree()
+    assert g.is_ancestor(2, 4) and g.is_ancestor(2, 5)
+    assert not g.is_ancestor(3, 5)
+    assert g.is_ancestor(4, 4)
+
+
+# -- tower: the spec's worked examples ---------------------------------------
+
+
+def tower_with(votes):
+    t = Tower()
+    t.votes.extend(Vote(s, c) for s, c in votes)
+    return t
+
+
+def test_vote_expiry_example():
+    """fd_tower.h: tower [1|4, 2|3, 3|2, 4|1]; vote 9 expires 4 and 3
+    (expirations 6, 7) but NOT 2 (expiry is top-down contiguous)."""
+    t = tower_with([(1, 4), (2, 3), (3, 2), (4, 1)])
+    t.vote(9)
+    assert [(v.slot, v.conf) for v in t.votes] == [(1, 4), (2, 3), (9, 1)]
+
+
+def test_vote_doubling_example():
+    """Continuing: vote 11 stacks on 9 and doubles only the consecutive
+    confirmation counts."""
+    t = tower_with([(1, 4), (2, 3), (9, 1)])
+    t.vote(11)
+    assert [(v.slot, v.conf) for v in t.votes] == [
+        (1, 4), (2, 3), (9, 2), (11, 1),
+    ]
+
+
+def test_full_cascade_doubles_everything():
+    t = tower_with([(1, 4), (2, 3), (3, 2), (4, 1)])
+    t.vote(5)
+    assert [(v.slot, v.conf) for v in t.votes] == [
+        (1, 5), (2, 4), (3, 3), (4, 2), (5, 1),
+    ]
+
+
+def test_rooting_at_max_lockout():
+    t = Tower()
+    rooted = []
+    for s in range(1, 40):
+        r = t.vote(s)
+        if r is not None:
+            rooted.append((s, r))
+    # a fully consecutive tower roots its bottom vote once conf hits 32
+    assert rooted and rooted[0] == (32, 1)
+    assert t.root is not None
+    assert len(t.votes) <= MAX_LOCKOUT
+
+
+def test_lockout_check_blocks_other_fork():
+    g = _fork_tree()
+    t = Tower()
+    t.vote(3)
+    t.vote(4)  # tower: [3|2, 4|1]; expirations 7, 6
+    # voting for 5 (other fork) at slot 5: 4 not expired (exp 6) -> locked
+    assert not t.lockout_check(5, g.is_ancestor)
+    # after expiry both votes are dead for the other fork: slot 8 > 6, 7
+    g.insert(8, 5)
+    assert t.lockout_check(8, g.is_ancestor)
+
+
+def test_threshold_check():
+    t = tower_with([(s, 10 - s) for s in range(1, 10)])  # depth 9 tower
+    total = 100
+    # the depth-8 vote (slot 1) needs 2/3 of stake on its fork
+    assert t.threshold_check(11, lambda s: 70, total)
+    assert not t.threshold_check(11, lambda s: 60, total)
+    shallow = tower_with([(1, 2), (2, 1)])
+    assert shallow.threshold_check(3, lambda s: 0, total)  # too shallow
+
+
+def test_switch_check():
+    g = _fork_tree()
+    t = Tower()
+    t.vote(4)
+    total = 100
+    # same fork (descendant of 4... here 4 itself): no proof needed
+    assert t.switch_check(4, g.is_ancestor, conflicting_stake=0, total_stake=total)
+    # other fork: needs >= 38% conflicting stake
+    assert not t.switch_check(5, g.is_ancestor, conflicting_stake=30, total_stake=total)
+    assert t.switch_check(5, g.is_ancestor, conflicting_stake=40, total_stake=total)

@@ -1,0 +1,30 @@
+"""CLI tests (fast actions; `run` is covered by the pipeline e2e suite)."""
+
+import subprocess
+import sys
+
+from firedancer_tpu.__main__ import main
+
+
+def test_version(capsys):
+    assert main(["version"]) == 0
+    assert "firedancer_tpu" in capsys.readouterr().out
+
+
+def test_keys_roundtrip(tmp_path, capsys):
+    path = str(tmp_path / "id.key")
+    assert main(["keys", "new", path]) == 0
+    out1 = capsys.readouterr().out
+    assert "pubkey:" in out1
+    assert main(["keys", "pubkey", path]) == 0
+    out2 = capsys.readouterr().out.strip()
+    assert out2 and out2 in out1
+
+
+def test_config_dump(tmp_path, capsys):
+    p = tmp_path / "op.toml"
+    p.write_text("[layout]\nbank_stage_count = 5\n")
+    assert main(["config", "--config", str(p)]) == 0
+    out = capsys.readouterr().out
+    assert "bank_stage_count = 5" in out
+    assert "[poh]" in out

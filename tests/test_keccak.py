@@ -1,0 +1,51 @@
+"""Keccak-256 tests: public known-answer vectors (legacy 0x01 padding) +
+host-vs-device differential across lengths straddling the rate boundary."""
+
+import numpy as np
+import pytest
+
+from firedancer_tpu.ops import keccak256 as kk
+
+# public known-answer vectors for legacy keccak256 (Ethereum flavor)
+KAT = {
+    b"": "c5d2460186f7233c927e7db2dcc703c0e500b653ca82273b7bfad8045d85a470",
+    b"abc": "4e03657aea45a94fc7d47ba826c8d667c0d1e6e33a64a036ec44f58fa12d6c45",
+    b"The quick brown fox jumps over the lazy dog":
+        "4d741b6f1eb29cb2a9b9911c82f56fa8d73b04959d3d9d222895df6c0b28aa15",
+}
+
+
+def test_host_known_answers():
+    for msg, hexdigest in KAT.items():
+        assert kk.keccak256_host(msg).hex() == hexdigest
+
+
+def test_host_rate_boundaries():
+    # 135/136/137 bytes straddle the single-block padding edge
+    for n in (135, 136, 137, 271, 272, 273):
+        out = kk.keccak256_host(b"\xaa" * n)
+        assert len(out) == 32
+        assert out != kk.keccak256_host(b"\xaa" * (n + 1))
+
+
+def test_device_matches_host():
+    rng = np.random.default_rng(9)
+    msgs = [
+        b"",
+        b"abc",
+        rng.bytes(64),
+        rng.bytes(135),
+        rng.bytes(136),
+        rng.bytes(137),
+        rng.bytes(200),
+    ]
+    max_len = 256
+    b = len(msgs)
+    arr = np.zeros((max_len, b), dtype=np.int32)
+    lens = np.zeros((b,), dtype=np.int32)
+    for i, m in enumerate(msgs):
+        arr[: len(m), i] = np.frombuffer(m, dtype=np.uint8)
+        lens[i] = len(m)
+    out = np.asarray(kk.keccak256_msg(arr, lens, max_len))
+    for i, m in enumerate(msgs):
+        assert out[:, i].astype(np.uint8).tobytes() == kk.keccak256_host(m), i

@@ -176,3 +176,38 @@ def test_delete_by_sig():
     assert pack.delete_by_sig(ta.signatures(a)[0])
     assert pack.pending_cnt() == 0
     assert pack.schedule_next_microblock(0) == []
+
+
+def test_full_pool_evicts_global_worst():
+    """Eviction compares against the lowest-priority txn across BOTH
+    pools, not just the newcomer's own pool tail; delete_by_sig uses the
+    sig index."""
+    pack = Pack(depth=2)
+    cu = (2).to_bytes(1, "little") + (100_000).to_bytes(4, "little")
+
+    def prio(tag, micro_lamports):
+        return build_txn(
+            tag,
+            cb_instrs=(
+                cu,
+                (3).to_bytes(1, "little") + micro_lamports.to_bytes(8, "little"),
+            ),
+        )
+
+    lo, t_lo = prio(b"ev-lo", 1)
+    hi, t_hi = prio(b"ev-hi", 10_000_000)
+    mid, t_mid = prio(b"ev-mid", 50_000)
+    assert pack.insert(lo, t_lo)
+    assert pack.insert(hi, t_hi)
+    assert pack.pending_cnt() == 2
+    # pool full: mid beats lo -> lo evicted, mid admitted
+    assert pack.insert(mid, t_mid)
+    assert pack.pending_cnt() == 2
+    assert not pack.delete_by_sig(t_lo.signatures(lo)[0])  # lo is gone
+    assert pack.delete_by_sig(t_mid.signatures(mid)[0])
+    assert pack.pending_cnt() == 1
+    # a txn worse than everything refuses when full
+    pack2 = Pack(depth=1)
+    assert pack2.insert(hi, t_hi)
+    worst, t_worst = prio(b"ev-worst", 0)
+    assert not pack2.insert(worst, t_worst)

@@ -36,18 +36,21 @@ def test_bmtree_single_leaf_root_is_leaf():
 
 @pytest.mark.parametrize("n", [1, 2, 3, 5, 8, 11, 64, 67])
 def test_bmtree_proofs_verify(n):
-    leaves = [bmtree.hash_leaf(b"leaf%d" % i) for i in range(n)]
-    layers = bmtree.tree_layers(leaves)
-    root = layers[-1][0]
+    leaves_full = [bmtree.hash_leaf_full(b"leaf%d" % i) for i in range(n)]
+    layers = bmtree.tree_layers([x[:20] for x in leaves_full])
+    r32 = bmtree.root32(leaves_full)
+    assert len(r32) == 32
+    # the stored (20-byte) root is the truncation of the signed root
+    assert layers[-1][0] == r32[:20]
     for i in range(n):
         proof = bmtree.get_proof(layers, i)
         assert len(proof) == len(layers) - 1
-        assert bmtree.verify_proof(leaves[i], i, proof) == root
+        assert bmtree.verify_proof(leaves_full[i], i, proof) == r32
     # wrong index / wrong leaf must NOT verify
     if n > 1:
         proof = bmtree.get_proof(layers, 0)
-        assert bmtree.verify_proof(leaves[0], 1, proof) != root
-        assert bmtree.verify_proof(bmtree.hash_leaf(b"evil"), 0, proof) != root
+        assert bmtree.verify_proof(leaves_full[0], 1, proof) != r32
+        assert bmtree.verify_proof(bmtree.hash_leaf_full(b"evil"), 0, proof) != r32
 
 
 def test_bmtree_domain_separation():
@@ -191,10 +194,10 @@ def test_shredder_produces_parseable_signed_sets():
         s = fs.parse(buf)
         assert s is not None and s.is_data and s.slot == 11
         assert s.idx == i and s.fec_set_idx == 0 and s.version == 3
-        # inclusion proof -> root -> leader signature
-        leaf = bmtree.hash_leaf(s.merkle_leaf_data(buf))
+        # inclusion proof -> untruncated root -> leader signature
+        leaf = bmtree.hash_leaf_full(s.merkle_leaf_data(buf))
         root = bmtree.verify_proof(leaf, i, s.merkle_proof(buf))
-        assert root == st.merkle_root
+        assert root == st.merkle_root and len(root) == 32
         assert ref.verify(root, s.signature(buf), pub)
     # last shred carries DATA_COMPLETE
     last = fs.parse(st.data_shreds[-1])

@@ -1,0 +1,138 @@
+"""Turbine tree tests: deterministic per-shred shuffles, leader root
+computation, two-level fanout children, whole-tree coverage invariants."""
+
+import hashlib
+
+import pytest
+
+from firedancer_tpu.protocol import shred as fs
+from firedancer_tpu.protocol import wsample as ws
+from firedancer_tpu.protocol.shred_dest import NO_DEST, Dest, ShredDest, shred_seed
+
+
+def _mk_cluster(n_staked=12, n_unstaked=4):
+    dests = [
+        Dest(pubkey=hashlib.sha256(b"v%d" % i).digest(),
+             stake=(n_staked - i) * 1_000_000)
+        for i in range(n_staked)
+    ] + [
+        Dest(pubkey=hashlib.sha256(b"u%d" % i).digest(), stake=0)
+        for i in range(n_unstaked)
+    ]
+    stakes = [(d.pubkey, d.stake) for d in dests if d.stake > 0]
+    lsched = ws.epoch_leaders(epoch=1, slot0=0, slot_cnt=1000, stakes=stakes)
+    return dests, lsched
+
+
+def _mk_shreds(slot, idxs):
+    return [
+        bytes(
+            fs.build_data_shred(
+                slot=slot, idx=i, version=1, fec_set_idx=0, parent_off=1,
+                flags=0, payload=b"x", merkle_proof_cnt=6,
+            )
+        )
+        for i in idxs
+    ]
+
+
+def test_seed_is_shred_specific():
+    leader = b"L" * 32
+    s1 = shred_seed(5, 0, True, leader)
+    assert s1 != shred_seed(5, 1, True, leader)   # idx matters
+    assert s1 != shred_seed(6, 0, True, leader)   # slot matters
+    assert s1 != shred_seed(5, 0, False, leader)  # data/code matters
+    assert s1 == shred_seed(5, 0, True, leader)   # deterministic
+
+
+def test_compute_first_excludes_leader_self():
+    dests, lsched = _mk_cluster()
+    slot = 8
+    leader = lsched.leader_for_slot(slot)
+    sd = ShredDest(dests, lsched, source=leader)
+    shreds = _mk_shreds(slot, range(20))
+    roots = sd.compute_first(shreds)
+    assert len(roots) == 20
+    leader_idx = [i for i, d in enumerate(dests) if d.pubkey == leader][0]
+    for r in roots:
+        assert r != NO_DEST
+        assert r != leader_idx  # never send to self
+    # deterministic, and different shreds get different roots sometimes
+    assert roots == sd.compute_first(shreds)
+    assert len(set(roots)) > 1
+
+
+def test_every_validator_agrees_on_the_tree():
+    """The root's children lists and each child's own view compose into a
+    consistent tree: whoever the leader sends to (root) forwards to level
+    1; level-1 nodes forward to level 2; nobody is contacted twice."""
+    dests, lsched = _mk_cluster(n_staked=10, n_unstaked=3)
+    slot = 4
+    leader = lsched.leader_for_slot(slot)
+    fanout = 3
+    shreds = _mk_shreds(slot, [7])
+    sd_leader = ShredDest(dests, lsched, source=leader)
+    root_idx = sd_leader.compute_first(shreds)[0]
+    seen = {root_idx}
+    frontier = [root_idx]
+    leader_idx = [i for i, d in enumerate(dests) if d.pubkey == leader][0]
+    while frontier:
+        nxt = []
+        for v in frontier:
+            sd_v = ShredDest(dests, lsched, source=dests[v].pubkey)
+            for child in sd_v.compute_children(shreds, fanout=fanout)[0]:
+                assert child not in seen, "validator contacted twice"
+                assert child != leader_idx
+                seen.add(child)
+                nxt.append(child)
+        frontier = nxt
+    # full coverage: every non-leader validator got the shred
+    assert seen == set(range(len(dests))) - {leader_idx}
+
+
+def test_children_layout_two_level():
+    dests, lsched = _mk_cluster(n_staked=30, n_unstaked=0)
+    slot = 12
+    leader = lsched.leader_for_slot(slot)
+    shreds = _mk_shreds(slot, [0])
+    fanout = 4
+    # find the shuffled root (position 0): it must have exactly fanout kids
+    sd_leader = ShredDest(dests, lsched, source=leader)
+    root = sd_leader.compute_first(shreds)[0]
+    kids = ShredDest(dests, lsched, source=dests[root].pubkey).compute_children(
+        shreds, fanout=fanout
+    )[0]
+    assert len(kids) == fanout
+    # a level-1 node has up to fanout children; level-2 nodes have none
+    lvl2 = ShredDest(dests, lsched, source=dests[kids[0]].pubkey).compute_children(
+        shreds, fanout=fanout
+    )[0]
+    assert len(lvl2) <= fanout
+    for g in lvl2:
+        assert (
+            ShredDest(dests, lsched, source=dests[g].pubkey).compute_children(
+                shreds, fanout=fanout
+            )[0]
+            == []
+        )
+
+
+def test_leader_gets_empty_children():
+    dests, lsched = _mk_cluster()
+    slot = 0
+    leader = lsched.leader_for_slot(slot)
+    sd = ShredDest(dests, lsched, source=leader)
+    assert sd.compute_children(_mk_shreds(slot, [0]), fanout=3) == [[]]
+
+
+def test_unstaked_only_cluster():
+    dests = [Dest(pubkey=hashlib.sha256(b"q%d" % i).digest(), stake=0)
+             for i in range(5)]
+    # leader from a separate staked set (not in dests contact list is not
+    # allowed; put leader in as unstaked too)
+    stakes = [(dests[0].pubkey, 1)]
+    lsched = ws.epoch_leaders(epoch=2, slot0=0, slot_cnt=100, stakes=stakes)
+    sd = ShredDest(dests, lsched, source=dests[0].pubkey)
+    roots = sd.compute_first(_mk_shreds(0, [1, 2, 3]))
+    for r in roots:
+        assert r != NO_DEST and r != 0  # picked an unstaked non-self dest

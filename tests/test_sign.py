@@ -1,0 +1,90 @@
+"""Sign stage / keyguard tests: role-gated signing over link pairs, the
+single-key-holder property, client round trip, shredder integration."""
+
+import hashlib
+import os
+import time
+
+import pytest
+
+from firedancer_tpu.ops.ref import ed25519_ref as ref
+from firedancer_tpu.runtime import sign as fsign
+from firedancer_tpu.runtime.shredder import Shredder
+from firedancer_tpu.tango import shm
+
+
+@pytest.fixture
+def sign_setup():
+    uid = f"{os.getpid()}_{int(time.monotonic_ns() % 1_000_000)}"
+    links = []
+
+    def mk(name, mtu):
+        l = shm.ShmLink.create(f"fdtpu_sg_{name}_{uid}", depth=64, mtu=mtu)
+        links.append(l)
+        return l
+
+    req_leader, res_leader = mk("rql", 1232), mk("rsl", 64)
+    req_gossip, res_gossip = mk("rqg", 1232), mk("rsg", 64)
+    secret = hashlib.sha256(b"identity").digest()
+    stage = fsign.SignStage(
+        "sign",
+        ins=[shm.Consumer(req_leader, lazy=4), shm.Consumer(req_gossip, lazy=4)],
+        outs=[shm.Producer(res_leader), shm.Producer(res_gossip)],
+        secret=secret,
+        roles=[fsign.ROLE_LEADER, fsign.ROLE_GOSSIP],
+    )
+    clients = {
+        "leader": fsign.KeyguardClient(
+            shm.Producer(req_leader),
+            shm.Consumer(res_leader, lazy=1),
+            spin=stage.run_once,
+        ),
+        "gossip": fsign.KeyguardClient(
+            shm.Producer(req_gossip),
+            shm.Consumer(res_gossip, lazy=1),
+            spin=stage.run_once,
+        ),
+    }
+    yield stage, clients
+    for l in links:
+        l.close()
+        l.unlink()
+
+
+def test_leader_role_signs_roots(sign_setup):
+    stage, clients = sign_setup
+    root = hashlib.sha256(b"merkle").digest()
+    sig = clients["leader"].sign(root)
+    assert ref.verify(root, sig, stage.public_key)
+    assert stage.metrics.get("signed") == 1
+
+
+def test_role_payload_gating(sign_setup):
+    stage, clients = sign_setup
+    # leader role refuses anything that isn't a 32-byte root
+    with pytest.raises(TimeoutError):
+        clients["leader"].max_spins = 500
+        clients["leader"].sign(b"not-a-root")
+    assert stage.metrics.get("refused") == 1
+    # gossip role signs small blobs
+    sig = clients["gossip"].sign(b"\x00gossip-blob")
+    assert ref.verify(b"\x00gossip-blob", sig, stage.public_key)
+
+
+def test_shredder_through_keyguard(sign_setup):
+    """The shredder's signer can be a keyguard client: the shred stage
+    then never touches the private key (the reference topology shape)."""
+    stage, clients = sign_setup
+    clients["leader"].max_spins = 1_000_000
+    sh = Shredder(signer=clients["leader"].sign)
+    (st,) = sh.entry_batch_to_fec_sets(b"E" * 2000, slot=3)
+    assert ref.verify(st.merkle_root, st.data_shreds[0][:64], stage.public_key)
+
+
+def test_authorize_rules():
+    assert fsign.payload_authorize(fsign.ROLE_LEADER, b"\x00" * 32)
+    assert not fsign.payload_authorize(fsign.ROLE_LEADER, b"\x00" * 33)
+    assert not fsign.payload_authorize(fsign.ROLE_LEADER, b"")
+    assert fsign.payload_authorize(fsign.ROLE_QUIC, b"\x00" * 130)
+    assert not fsign.payload_authorize(fsign.ROLE_QUIC, b"\x00" * 131)
+    assert not fsign.payload_authorize(99, b"\x00" * 32)
