@@ -39,6 +39,8 @@ LAMPORTS_PER_SIGNATURE = 5000
 TXN_SUCCESS = 0
 TXN_ERR_FEE = -1                 # payer cannot cover the fee: txn dropped
 TXN_ERR_INSUFFICIENT_FUNDS = -2  # program failed: fee charged, no effects
+TXN_ERR_ACCT = -3                # unresolvable account index (ALT accounts
+                                 # need the address-resolution stage)
 
 
 def acct_lamports(val: bytes | None) -> int:
@@ -72,6 +74,12 @@ def _rw_sets(payload: bytes, desc: ft.Txn) -> tuple[set[bytes], set[bytes]]:
     w, r = set(), set()
     for i, a in enumerate(addrs):
         (w if desc.is_writable(i) else r).add(a)
+    # ALT-loaded accounts are unresolvable without the address-resolution
+    # stage: conservatively WRITE-lock the table address itself so two
+    # txns loading from one table never share a wave (the same rule the
+    # pack scheduler applies, pack/scheduler.py acct_sets)
+    for lut in desc.addr_luts:
+        w.add(payload[lut.addr_off : lut.addr_off + 32])
     return w, r
 
 
@@ -130,9 +138,8 @@ def _execute_txn(funk: Funk, xid: bytes, payload: bytes, desc: ft.Txn) -> TxnRes
         idx = payload[ins.acct_off : ins.acct_off + ins.acct_cnt]
         if len(idx) < 2:
             continue
-        src, dst = addrs[idx[0]], addrs[idx[1]]
-        sv, dv = funk.rec_query(xid, src), funk.rec_query(xid, dst)
-        if acct_lamports(sv) < lamports:
+
+        def _fail(status):
             # roll back program effects; the fee remains charged
             for a, v in before.items():
                 if funk.rec_query(xid, a) != v:
@@ -140,9 +147,26 @@ def _execute_txn(funk: Funk, xid: bytes, payload: bytes, desc: ft.Txn) -> TxnRes
                         funk.rec_remove(xid, a)
                     else:
                         funk.rec_insert(xid, a, v)
-            return TxnResult(TXN_ERR_INSUFFICIENT_FUNDS, fee)
-        funk.rec_insert(xid, src, acct_build(acct_lamports(sv) - lamports, (sv or b"")[8:]))
-        funk.rec_insert(xid, dst, acct_build(acct_lamports(dv) + lamports, (dv or b"")[8:]))
+            return TxnResult(status, fee)
+
+        if idx[0] >= len(addrs) or idx[1] >= len(addrs):
+            # ALT-loaded index: unresolvable until the address-resolution
+            # stage exists — a typed failure, never an abort of the block
+            return _fail(TXN_ERR_ACCT)
+        src, dst = addrs[idx[0]], addrs[idx[1]]
+        sv = funk.rec_query(xid, src)
+        if acct_lamports(sv) < lamports:
+            return _fail(TXN_ERR_INSUFFICIENT_FUNDS)
+        if src == dst:
+            continue  # self-transfer: a no-op, NOT a mint (stale-read trap)
+        funk.rec_insert(
+            xid, src, acct_build(acct_lamports(sv) - lamports, (sv or b"")[8:])
+        )
+        dv = funk.rec_query(xid, dst)  # read AFTER the src write (src may
+        # alias dst through future program semantics; order is the rule)
+        funk.rec_insert(
+            xid, dst, acct_build(acct_lamports(dv) + lamports, (dv or b"")[8:])
+        )
     return TxnResult(TXN_SUCCESS, fee)
 
 

@@ -1,0 +1,286 @@
+"""sBPF virtual machine interpreter (the flamenco/vm layer).
+
+Counterpart of /root/reference/src/flamenco/vm/fd_vm_interp_core.c (the
+872-line computed-goto loop) and the fd_vm memory map (fd_vm.h:22-42):
+eleven 64-bit registers, a compute budget charged per instruction, and a
+segmented virtual address space —
+
+    0x1_0000_0000  program rodata     (read-only)
+    0x2_0000_0000  stack              (read-write)
+    0x3_0000_0000  heap               (read-write)
+    0x4_0000_0000  input (accounts)   (read-write)
+
+Every load/store translates through the region table with bounds checks;
+faults, division by zero, bad calls and budget exhaustion abort cleanly
+with a typed error (the VM is branchy host-side work by design — SURVEY
+§7.1 keeps it off the TPU; the device-batchable pieces, sigverify and
+hashing, are syscalls into the ops layer).
+
+Syscalls are registered by 32-bit id (the reference hashes syscall names
+into ids; registration is the deployer's choice here) and receive
+(vm, r1..r5), returning the new r0.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from firedancer_tpu.protocol import sbpf
+
+MM_PROGRAM = 1 << 32
+MM_STACK = 2 << 32
+MM_HEAP = 3 << 32
+MM_INPUT = 4 << 32
+
+STACK_SZ = 64 * 1024
+HEAP_SZ = 32 * 1024
+DEFAULT_BUDGET = 200_000
+
+_M64 = (1 << 64) - 1
+_M32 = (1 << 32) - 1
+
+
+class VmError(RuntimeError):
+    pass
+
+
+class VmFault(VmError):
+    """Memory access violation."""
+
+
+class VmBudget(VmError):
+    """Compute budget exhausted."""
+
+
+@dataclass
+class Region:
+    start: int
+    data: bytearray
+    writable: bool
+
+
+@dataclass
+class Vm:
+    program: sbpf.Program
+    input_data: bytes = b""
+    budget: int = DEFAULT_BUDGET
+    syscalls: dict[int, object] = field(default_factory=dict)
+
+    def __post_init__(self):
+        self.regs = [0] * 11
+        self.pc = self.program.entry_pc
+        self.cu_used = 0
+        self.insns = {i.pc: i for i in sbpf.decode(self.program.text())}
+        self.regions = [
+            Region(MM_PROGRAM, bytearray(self.program.rodata), False),
+            Region(MM_STACK, bytearray(STACK_SZ), True),
+            Region(MM_HEAP, bytearray(HEAP_SZ), True),
+            Region(MM_INPUT, bytearray(self.input_data), True),
+        ]
+        self.regs[10] = MM_STACK + STACK_SZ  # frame pointer at stack top
+        self.regs[1] = MM_INPUT
+
+    # -- memory -------------------------------------------------------------
+
+    def _region(self, vaddr: int, sz: int, write: bool) -> tuple[Region, int]:
+        for r in self.regions:
+            off = vaddr - r.start
+            if 0 <= off and off + sz <= len(r.data):
+                if write and not r.writable:
+                    raise VmFault(f"write to read-only 0x{vaddr:x}")
+                return r, off
+        raise VmFault(f"access violation at 0x{vaddr:x} sz {sz}")
+
+    def mem_read(self, vaddr: int, sz: int) -> int:
+        r, off = self._region(vaddr, sz, write=False)
+        return int.from_bytes(r.data[off : off + sz], "little")
+
+    def mem_read_bytes(self, vaddr: int, sz: int) -> bytes:
+        r, off = self._region(vaddr, sz, write=False)
+        return bytes(r.data[off : off + sz])
+
+    def mem_write(self, vaddr: int, sz: int, val: int) -> None:
+        r, off = self._region(vaddr, sz, write=True)
+        r.data[off : off + sz] = (val & ((1 << (8 * sz)) - 1)).to_bytes(sz, "little")
+
+    # -- execution ----------------------------------------------------------
+
+    @staticmethod
+    def _s64(v: int) -> int:
+        return v - (1 << 64) if v >> 63 else v
+
+    @staticmethod
+    def _s32(v: int) -> int:
+        v &= _M32
+        return v - (1 << 32) if v >> 31 else v
+
+    def run(self) -> int:
+        """Execute until exit; returns r0."""
+        regs = self.regs
+        while True:
+            self.cu_used += 1
+            if self.cu_used > self.budget:
+                raise VmBudget(f"compute budget exceeded ({self.budget})")
+            ins = self.insns.get(self.pc)
+            if ins is None:
+                raise VmError(f"bad pc {self.pc}")
+            mn = ins.mnemonic
+            dst, src, off, imm = ins.dst, ins.src, ins.off, ins.imm
+            nxt = self.pc + (2 if mn == "lddw" else 1)
+
+            if mn == "exit":
+                return regs[0]
+            elif mn == "lddw":
+                regs[dst] = imm & _M64
+            elif mn == "call":
+                fn = self.syscalls.get(imm & _M32)
+                if fn is None:
+                    raise VmError(f"unknown syscall 0x{imm & _M32:x}")
+                regs[0] = fn(self, *regs[1:6]) & _M64
+            elif mn == "callx":
+                raise VmError("callx unsupported")
+            elif mn.startswith("j"):
+                taken = self._jump_taken(mn, regs, dst, src, imm)
+                if taken:
+                    nxt = self.pc + 1 + off
+            elif mn.startswith(("ldx",)):
+                sz = {"ldxb": 1, "ldxh": 2, "ldxw": 4, "ldxdw": 8}[mn]
+                regs[dst] = self.mem_read((regs[src] + off) & _M64, sz)
+            elif mn.startswith("stx"):
+                sz = {"stxb": 1, "stxh": 2, "stxw": 4, "stxdw": 8}[mn]
+                self.mem_write((regs[dst] + off) & _M64, sz, regs[src])
+            elif mn.startswith("st"):
+                sz = {"stb": 1, "sth": 2, "stw": 4, "stdw": 8}[mn]
+                self.mem_write((regs[dst] + off) & _M64, sz, imm & _M64)
+            else:
+                self._alu(mn, regs, dst, src, imm)
+            self.pc = nxt
+
+    def _jump_taken(self, mn, regs, dst, src, imm) -> bool:
+        if mn == "ja":
+            return True
+        kind, mode = mn[1:].rsplit("_", 1)
+        b = regs[src] if mode == "reg" else imm & _M64
+        a = regs[dst]
+        sa, sb = self._s64(a), self._s64(b)
+        return {
+            "eq": a == b, "ne": a != b, "set": bool(a & b),
+            "gt": a > b, "ge": a >= b, "lt": a < b, "le": a <= b,
+            "sgt": sa > sb, "sge": sa >= sb, "slt": sa < sb, "sle": sa <= sb,
+        }[kind]
+
+    def _alu(self, mn, regs, dst, src, imm) -> None:
+        is32 = "32" in mn
+        mask = _M32 if is32 else _M64
+        if mn in ("neg64", "neg32"):
+            regs[dst] = (-regs[dst]) & mask
+            return
+        if mn in ("le", "be"):  # byte-order ops: widths via imm (16/32/64)
+            width = imm
+            if width not in (16, 32, 64):
+                raise VmError(f"bad byte-order width {width}")
+            v = regs[dst] & ((1 << width) - 1)
+            if mn == "be":
+                v = int.from_bytes(
+                    v.to_bytes(width // 8, "little"), "big"
+                )
+            regs[dst] = v
+            return
+        op, mode = mn.rsplit("_", 1)
+        b = (regs[src] if mode == "reg" else imm) & mask
+        a = regs[dst] & mask
+        if op.startswith("add"):
+            r = a + b
+        elif op.startswith("sub"):
+            r = a - b
+        elif op.startswith("mul"):
+            r = a * b
+        elif op.startswith("div"):
+            if b == 0:
+                raise VmError("division by zero")
+            r = a // b
+        elif op.startswith("mod"):
+            if b == 0:
+                raise VmError("division by zero")
+            r = a % b
+        elif op.startswith("or"):
+            r = a | b
+        elif op.startswith("and"):
+            r = a & b
+        elif op.startswith("xor"):
+            r = a ^ b
+        elif op.startswith("lsh"):
+            r = a << (b & (31 if is32 else 63))
+        elif op.startswith("rsh"):
+            r = a >> (b & (31 if is32 else 63))
+        elif op.startswith("arsh"):
+            s = self._s32(a) if is32 else self._s64(a)
+            r = s >> (b & (31 if is32 else 63))
+        elif op.startswith("mov"):
+            r = b
+        else:
+            raise VmError(f"unhandled alu {mn}")
+        regs[dst] = r & mask
+
+
+# -- the device-backed syscalls (the TPU bridge) ------------------------------
+
+SYSCALL_SOL_SHA256 = 0x11F49D86
+SYSCALL_SOL_KECCAK256 = 0xD7793ABB
+SYSCALL_SOL_LOG = 0x207559BD
+SYSCALL_SOL_SECP256K1_RECOVER = 0x17E40350
+
+
+def register_default_syscalls(vm: Vm, *, log_sink: list | None = None) -> None:
+    """sol_sha256 / sol_keccak256 / sol_log — the hashing syscalls route
+    into the ops layer (host path here; the batched device path serves
+    bulk callers), mirroring fd_vm_syscall_sol_sha256 etc."""
+    import hashlib
+
+    from firedancer_tpu.ops import keccak256 as kk
+
+    def sol_sha256(vm_, vals_addr, vals_len, result_addr, *_):
+        data = b""
+        for i in range(vals_len):
+            addr = vm_.mem_read(vals_addr + 16 * i, 8)
+            sz = vm_.mem_read(vals_addr + 16 * i + 8, 8)
+            data += vm_.mem_read_bytes(addr, sz)
+        digest = hashlib.sha256(data).digest()
+        for j, byte in enumerate(digest):
+            vm_.mem_write(result_addr + j, 1, byte)
+        return 0
+
+    def sol_keccak256(vm_, vals_addr, vals_len, result_addr, *_):
+        data = b""
+        for i in range(vals_len):
+            addr = vm_.mem_read(vals_addr + 16 * i, 8)
+            sz = vm_.mem_read(vals_addr + 16 * i + 8, 8)
+            data += vm_.mem_read_bytes(addr, sz)
+        digest = kk.keccak256_host(data)
+        for j, byte in enumerate(digest):
+            vm_.mem_write(result_addr + j, 1, byte)
+        return 0
+
+    def sol_log(vm_, addr, sz, *_):
+        msg = vm_.mem_read_bytes(addr, sz)
+        if log_sink is not None:
+            log_sink.append(msg)
+        return 0
+
+    def sol_secp256k1_recover(vm_, hash_addr, recovery_id, sig_addr, result_addr, *_):
+        from firedancer_tpu.ops import secp256k1 as sk
+
+        h = vm_.mem_read_bytes(hash_addr, 32)
+        sig = vm_.mem_read_bytes(sig_addr, 64)
+        try:
+            pub = sk.recover(h, recovery_id, sig)
+        except sk.RecoverError:
+            return 1  # the syscall's error convention: nonzero r0
+        for j, byte in enumerate(pub):
+            vm_.mem_write(result_addr + j, 1, byte)
+        return 0
+
+    vm.syscalls[SYSCALL_SOL_SHA256] = sol_sha256
+    vm.syscalls[SYSCALL_SOL_KECCAK256] = sol_keccak256
+    vm.syscalls[SYSCALL_SOL_LOG] = sol_log
+    vm.syscalls[SYSCALL_SOL_SECP256K1_RECOVER] = sol_secp256k1_recover

@@ -1,0 +1,147 @@
+"""secp256k1 ECDSA recover/verify (the secp256k1_recover syscall).
+
+Counterpart of /root/reference/src/ballet/secp256k1/ (a wrapper over
+vendored libsecp256k1 serving the sol_secp256k1_recover syscall and the
+Ethereum-compatibility precompile).  Host integer implementation of the
+public curve math — short Weierstrass y^2 = x^3 + 7 over p, Jacobian-free
+affine ops (python ints carry the bigint work; this path is a syscall,
+not the streaming hot loop — batching onto device limbs follows the
+ed25519 blueprint if a workload ever needs it).
+
+API mirrors the syscall surface: recover(msg_hash, recovery_id, sig) ->
+uncompressed 64-byte pubkey; plus sign/verify used by tests and the
+Ethereum-style address derivation.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+
+P = 2**256 - 2**32 - 977
+N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+GX = 0x79BE667EF9DCBBAC55A06295CE870B07029BFCDB2DCE28D959F2815B16F81798
+GY = 0x483ADA7726A3C4655DA4FBFC0E1108A8FD17B448A68554199C47D08FFB10D4B8
+G = (GX, GY)
+
+
+class RecoverError(ValueError):
+    pass
+
+
+def _inv(a: int, m: int) -> int:
+    return pow(a, m - 2, m)
+
+
+def _add(p1, p2):
+    if p1 is None:
+        return p2
+    if p2 is None:
+        return p1
+    x1, y1 = p1
+    x2, y2 = p2
+    if x1 == x2:
+        if (y1 + y2) % P == 0:
+            return None  # inverse points
+        lam = (3 * x1 * x1) * _inv(2 * y1, P) % P
+    else:
+        lam = (y2 - y1) * _inv(x2 - x1, P) % P
+    x3 = (lam * lam - x1 - x2) % P
+    return (x3, (lam * (x1 - x3) - y1) % P)
+
+
+def _mul(k: int, pt):
+    acc = None
+    while k:
+        if k & 1:
+            acc = _add(acc, pt)
+        pt = _add(pt, pt)
+        k >>= 1
+    return acc
+
+
+def pubkey_of(secret: int) -> tuple[int, int]:
+    if not 0 < secret < N:
+        raise ValueError("secret out of range")
+    return _mul(secret, G)
+
+
+def _rfc6979_k(secret: int, msg_hash: bytes) -> int:
+    """Deterministic nonce (RFC 6979, SHA-256) — sign() is test support;
+    the validator only ever recovers."""
+    x = secret.to_bytes(32, "big")
+    v = b"\x01" * 32
+    k = b"\x00" * 32
+    k = hmac.new(k, v + b"\x00" + x + msg_hash, hashlib.sha256).digest()
+    v = hmac.new(k, v, hashlib.sha256).digest()
+    k = hmac.new(k, v + b"\x01" + x + msg_hash, hashlib.sha256).digest()
+    v = hmac.new(k, v, hashlib.sha256).digest()
+    while True:
+        v = hmac.new(k, v, hashlib.sha256).digest()
+        cand = int.from_bytes(v, "big")
+        if 0 < cand < N:
+            return cand
+        k = hmac.new(k, v + b"\x00", hashlib.sha256).digest()
+        v = hmac.new(k, v, hashlib.sha256).digest()
+
+
+def sign(secret: int, msg_hash: bytes) -> tuple[bytes, int]:
+    """-> (64-byte r||s signature, recovery_id in {0,1}); low-s form."""
+    z = int.from_bytes(msg_hash, "big") % N
+    k = _rfc6979_k(secret, msg_hash)
+    x, y = _mul(k, G)
+    r = x % N
+    s = _inv(k, N) * (z + r * secret) % N
+    rec = (y & 1) ^ (1 if x >= N else 0)
+    if s > N // 2:  # canonical low-s; flips the recovery parity
+        s = N - s
+        rec ^= 1
+    return r.to_bytes(32, "big") + s.to_bytes(32, "big"), rec
+
+
+def recover(msg_hash: bytes, recovery_id: int, sig: bytes) -> bytes:
+    """Recover the signer: -> 64-byte uncompressed pubkey (x || y), the
+    sol_secp256k1_recover contract (32-byte hash, id in [0,4), 64-byte
+    r||s).  Raises RecoverError on any invalid input."""
+    if len(msg_hash) != 32 or len(sig) != 64:
+        raise RecoverError("bad input length")
+    if not 0 <= recovery_id < 4:
+        raise RecoverError("bad recovery id")
+    r = int.from_bytes(sig[:32], "big")
+    s = int.from_bytes(sig[32:], "big")
+    if not (0 < r < N and 0 < s < N):
+        raise RecoverError("signature scalar out of range")
+    x = r + (N if recovery_id >= 2 else 0)
+    if x >= P:
+        raise RecoverError("r + N overflows the field")
+    y2 = (pow(x, 3, P) + 7) % P
+    y = pow(y2, (P + 1) // 4, P)
+    if y * y % P != y2:
+        raise RecoverError("r is not an x-coordinate on the curve")
+    if (y & 1) != (recovery_id & 1):
+        y = P - y
+    z = int.from_bytes(msg_hash, "big") % N
+    rinv = _inv(r, N)
+    # Q = r^-1 (s*R - z*G)
+    q = _add(_mul(s * rinv % N, (x, y)), _mul((-z * rinv) % N, G))
+    if q is None:
+        raise RecoverError("recovered the point at infinity")
+    return q[0].to_bytes(32, "big") + q[1].to_bytes(32, "big")
+
+
+def verify(msg_hash: bytes, sig: bytes, pubkey64: bytes) -> bool:
+    for rec in (0, 1, 2, 3):
+        try:
+            if recover(msg_hash, rec, sig) == pubkey64:
+                return True
+        except RecoverError:
+            continue
+    return False
+
+
+def eth_address(pubkey64: bytes) -> bytes:
+    """keccak256(pubkey)[12:] — the Ethereum address derivation the
+    precompile pairs with."""
+    from . import keccak256 as kk
+
+    return kk.keccak256_host(pubkey64)[-20:]

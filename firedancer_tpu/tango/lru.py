@@ -1,0 +1,77 @@
+"""LRU tag cache + housekeeping-interval models (tango's lru + tempo).
+
+fd_lru (/root/reference/src/tango/lru/fd_lru.h): like the tcache but
+eviction follows RECENCY of use, not insertion order — querying a tag
+refreshes it.  The reference uses it for QUIC connection tracking where
+hot connections must not age out under churn.  Host model: dict +
+doubly-linked order via OrderedDict move_to_end (the same tag->node map +
+linked-list structure).
+
+fd_tempo (/root/reference/src/tango/tempo/fd_tempo.h): the housekeeping
+cadence model.  `lazy_default(cr_max)` is the reference's closed-form
+bound — housekeeping must refresh flow-control state faster than a
+producer can exhaust cr_max credits; 1 + floor(9*cr_max/4) ns keeps the
+credit loop off the critical path (derivation in the header comment).
+`async_reload(rng, lazy)` draws the randomized next-event delay in
+[lazy/2, 3*lazy/2) so co-scheduled stages don't phase-lock their
+housekeeping (the fd_tempo_async_reload shape the Stage loop uses in
+iteration units)."""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+LAZY_MAX_NS = 1 << 31
+
+
+class LruCache:
+    """Most-recently-USED tag cache; query refreshes recency."""
+
+    def __init__(self, depth: int):
+        if depth <= 0:
+            raise ValueError("depth must be positive")
+        self.depth = depth
+        self._map: OrderedDict[int, None] = OrderedDict()
+
+    def query(self, tag: int) -> bool:
+        """True if present; refreshes the tag's recency (the lru
+        property — a tcache query would not)."""
+        if tag == 0 or tag not in self._map:
+            return False
+        self._map.move_to_end(tag)
+        return True
+
+    def insert(self, tag: int) -> bool:
+        """Insert (or refresh); True if it was already present.  Evicts
+        the LEAST recently used tag when full."""
+        if tag == 0:
+            return False
+        if tag in self._map:
+            self._map.move_to_end(tag)
+            return True
+        if len(self._map) >= self.depth:
+            self._map.popitem(last=False)
+        self._map[tag] = None
+        return False
+
+    def remove(self, tag: int) -> bool:
+        return self._map.pop(tag, 1) is None  # None stored for present tags
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+
+def lazy_default(cr_max: int) -> int:
+    """Target housekeeping interval in ns for a flow with cr_max credits
+    (fd_tempo_lazy_default's 1 + floor(9*cr_max/4), saturated)."""
+    if cr_max > 954_437_176:
+        return LAZY_MAX_NS - 1
+    return 1 + (9 * cr_max >> 2)
+
+
+def async_reload(rng, lazy: int) -> int:
+    """Randomized next housekeeping delay in [lazy/2, 3*lazy/2) — breaks
+    phase lock between co-scheduled stages (fd_tempo_async_reload)."""
+    if lazy < 1:
+        raise ValueError("lazy must be positive")
+    return lazy // 2 + rng.randrange(max(lazy, 1))

@@ -104,6 +104,19 @@ def test_failed_txn_pays_fee_but_has_no_effects():
     assert funk.rec_query(res.xid, b"z" * 32) is None
 
 
+def test_self_transfer_is_not_a_mint():
+    """src == dst transfer must not create lamports (stale-read trap)."""
+    funk = Funk()
+    secret, pub = keypair(b"selfy")
+    bh = hashlib.sha256(b"bh-self").digest()
+    t = ft.transfer_txn(secret, pub, 100, bh, from_pubkey=pub)
+    fund(funk, pub, 1_000_000)
+    res = execute_block(funk, slot=1, txns=[t])
+    assert res.results[0].status == TXN_SUCCESS
+    # only the fee leaves; the transfer is a no-op
+    assert acct_lamports(funk.rec_query(res.xid, pub)) == 1_000_000 - 5000
+
+
 def test_fee_unpayable_txn_is_dropped():
     funk = Funk()
     t, p = transfer(b"broke", b"q" * 32, 1)

@@ -1,0 +1,143 @@
+"""sBPF loader tests: synthetic ELF64 construction -> load -> validate ->
+relocate, plus ISA decode round trips."""
+
+import struct
+
+import pytest
+
+from firedancer_tpu.protocol import sbpf
+
+
+def ins(opcode, dst=0, src=0, off=0, imm=0):
+    return bytes([opcode, (src << 4) | dst]) + off.to_bytes(
+        2, "little", signed=True
+    ) + (imm & 0xFFFFFFFF).to_bytes(4, "little")
+
+
+def build_elf(
+    text: bytes,
+    *,
+    machine=sbpf.EM_BPF,
+    entry_slot=0,
+    rodata=b"",
+    rels=(),
+    text_addr=0x100,
+):
+    """Minimal valid little-endian ELF64 for the loader."""
+    shstr = b"\x00.text\x00.rodata\x00.rel.dyn\x00.shstrtab\x00"
+    n_text, n_ro, n_rel, n_shstr = 1, 7, 15, 24
+    ehsz = 64
+    shnum = 5 if rels else (4 if rodata else 3)
+    # layout: ehdr | text | rodata | rels | shstrtab | shdrs
+    text_off = ehsz
+    ro_off = text_off + len(text)
+    rel_bytes = b"".join(struct.pack("<QQ", off, info) for off, info in rels)
+    rel_off = ro_off + len(rodata)
+    str_off = rel_off + len(rel_bytes)
+    shoff = str_off + len(shstr)
+
+    def shdr(name, type_, flags, addr, off, size):
+        return struct.pack(
+            "<IIQQQQIIQQ", name, type_, flags, addr, off, size, 0, 0, 0, 0
+        )
+
+    shdrs = [shdr(0, 0, 0, 0, 0, 0)]  # null section
+    shdrs.append(shdr(n_text, 1, 0x6, text_addr, text_off, len(text)))
+    if rodata:
+        shdrs.append(shdr(n_ro, 1, 0x2, 0x1000, ro_off, len(rodata)))
+    if rels:
+        shdrs.append(shdr(n_rel, 9, 0, 0, rel_off, len(rel_bytes)))
+    shstrndx = len(shdrs)
+    shdrs.append(shdr(n_shstr, 3, 0, 0, str_off, len(shstr)))
+
+    ehdr = struct.pack(
+        "<16sHHIQQQIHHHHHH",
+        b"\x7fELF" + bytes([2, 1, 1]) + bytes(9),
+        3, machine, 1,
+        text_addr + 8 * entry_slot,  # e_entry
+        0, shoff, 0, ehsz, 0, 0,
+        struct.calcsize("<IIQQQQIIQQ"), len(shdrs), shstrndx,
+    )
+    blob = bytearray(ehdr)
+    blob += text
+    blob += rodata
+    blob += rel_bytes
+    blob += shstr
+    for s in shdrs:
+        blob += s
+    return bytes(blob)
+
+
+EXIT = ins(0x95)
+MOV = ins(0xB7, dst=0, imm=42)
+
+
+def test_load_minimal_program():
+    prog = sbpf.load(build_elf(MOV + EXIT, entry_slot=0))
+    assert prog.text() == MOV + EXIT
+    assert prog.entry_pc == 0
+    insns = sbpf.decode(prog.text())
+    assert [i.mnemonic for i in insns] == ["mov64_imm", "exit"]
+    assert insns[0].imm == 42
+
+
+def test_load_rejects_bad_inputs():
+    with pytest.raises(sbpf.SbpfError, match="magic"):
+        sbpf.load(b"\x00" * 200)
+    with pytest.raises(sbpf.SbpfError, match="machine"):
+        sbpf.load(build_elf(EXIT, machine=62))  # x86-64
+    with pytest.raises(sbpf.SbpfError, match="entrypoint"):
+        sbpf.load(build_elf(EXIT, entry_slot=5))
+    with pytest.raises(sbpf.SbpfError, match="slot"):
+        sbpf.load(build_elf(EXIT + b"\x01"))  # ragged text
+
+
+def test_relative_relocation_rebases():
+    # an lddw whose low imm holds a file offset into .rodata
+    text = ins(0x18, dst=1, imm=0x1000) + bytes(8) + EXIT
+    elf = build_elf(
+        text,
+        rodata=b"hello-program-data",
+        # r_offset points at the lddw SLOT (imm pair at +4 / +12)
+        rels=((64, sbpf.R_BPF_64_RELATIVE),),
+    )
+    prog = sbpf.load(elf)
+    insns = sbpf.decode(prog.text())
+    assert insns[0].mnemonic == "lddw"
+    # the FULL 64-bit imm must be rebased (masking to 32 bits would make
+    # this assertion a tautology since MM_PROGRAM_START == 2^32)
+    assert insns[0].imm == 0x1000 + sbpf.MM_PROGRAM_START
+
+
+def test_relocation_out_of_bounds_rejected():
+    # relocation whose hi word would land past the image end: the slice
+    # assign must not silently grow the program image
+    text = ins(0x18, dst=1, imm=0) + bytes(8) + EXIT
+    elf = build_elf(text, rels=((64 + len(text) - 8, sbpf.R_BPF_64_RELATIVE),))
+    with pytest.raises(sbpf.SbpfError, match="out of bounds"):
+        sbpf.load(elf)
+
+
+def test_decode_rejects_bad_registers():
+    bad = bytes([0xB7, 12]) + bytes(6)  # mov64 dst=r12
+    with pytest.raises(sbpf.SbpfError, match="bad register"):
+        sbpf.decode(bad)
+
+
+def test_decode_lddw_and_jumps():
+    text = (
+        ins(0x18, dst=2, imm=0xDEAD) + (0xBEEF).to_bytes(4, "little").rjust(8, b"\x00")[:8]
+    )
+    # build the second lddw slot properly: bytes 4..8 hold the high imm
+    text = ins(0x18, dst=2, imm=0xDEAD) + bytes(4) + (0xBEEF).to_bytes(4, "little")
+    text += ins(0x15, dst=2, off=-2, imm=7)  # jeq back
+    text += EXIT
+    insns = sbpf.decode(text)
+    assert insns[0].mnemonic == "lddw"
+    assert insns[0].imm == (0xBEEF << 32) | 0xDEAD
+    assert insns[1].pc == 2  # lddw consumed two slots
+    assert insns[1].off == -2
+    with pytest.raises(sbpf.SbpfError, match="unknown opcode"):
+        sbpf.decode(ins(0xFF))
+    with pytest.raises(sbpf.SbpfError, match="lddw at end"):
+        sbpf.decode(ins(0x18))

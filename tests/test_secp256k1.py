@@ -1,0 +1,76 @@
+"""secp256k1 recover tests: curve sanity against public constants,
+sign->recover round trips, malleability/low-s, invalid-input rejection,
+Ethereum address derivation."""
+
+import hashlib
+
+import pytest
+
+from firedancer_tpu.ops import secp256k1 as sk
+
+
+def test_generator_on_curve_and_order():
+    assert (sk.GY * sk.GY - (sk.GX**3 + 7)) % sk.P == 0
+    assert sk._mul(sk.N, sk.G) is None  # n*G = infinity
+    # 2G's x is a public constant
+    two_g = sk._mul(2, sk.G)
+    assert two_g[0] == 0xC6047F9441ED7D6D3045406E95C07CD85C778E4B8CEF3CA7ABAC09B95C709EE5
+
+
+def test_sign_recover_roundtrip():
+    for i in range(1, 6):
+        secret = int.from_bytes(hashlib.sha256(b"k%d" % i).digest(), "big") % sk.N
+        pub = sk.pubkey_of(secret)
+        pub64 = pub[0].to_bytes(32, "big") + pub[1].to_bytes(32, "big")
+        h = hashlib.sha256(b"msg%d" % i).digest()
+        sig, rec = sk.sign(secret, h)
+        assert sk.recover(h, rec, sig) == pub64
+        assert sk.verify(h, sig, pub64)
+        # wrong recovery id yields a DIFFERENT key (or an error), never ours
+        try:
+            other = sk.recover(h, rec ^ 1, sig)
+            assert other != pub64
+        except sk.RecoverError:
+            pass
+
+
+def test_low_s_canonical():
+    secret = 12345
+    h = hashlib.sha256(b"low-s").digest()
+    sig, _ = sk.sign(secret, h)
+    s = int.from_bytes(sig[32:], "big")
+    assert s <= sk.N // 2
+
+
+def test_recover_rejects_invalid():
+    h = hashlib.sha256(b"x").digest()
+    with pytest.raises(sk.RecoverError):
+        sk.recover(h, 5, b"\x01" * 64)  # bad id
+    with pytest.raises(sk.RecoverError):
+        sk.recover(h, 0, b"\x00" * 64)  # r = s = 0
+    with pytest.raises(sk.RecoverError):
+        sk.recover(h[:-1], 0, b"\x01" * 64)  # short hash
+    # r = N (out of scalar range)
+    bad = sk.N.to_bytes(32, "big") + (1).to_bytes(32, "big")
+    with pytest.raises(sk.RecoverError):
+        sk.recover(h, 0, bad)
+
+
+def test_tampered_message_recovers_different_key():
+    secret = 999
+    pub = sk.pubkey_of(secret)
+    pub64 = pub[0].to_bytes(32, "big") + pub[1].to_bytes(32, "big")
+    h = hashlib.sha256(b"honest").digest()
+    sig, rec = sk.sign(secret, h)
+    h2 = hashlib.sha256(b"forged").digest()
+    try:
+        assert sk.recover(h2, rec, sig) != pub64
+    except sk.RecoverError:
+        pass
+
+
+def test_eth_address():
+    # address of privkey 1's pubkey is a public constant
+    pub = sk.pubkey_of(1)
+    pub64 = pub[0].to_bytes(32, "big") + pub[1].to_bytes(32, "big")
+    assert sk.eth_address(pub64).hex() == "7e5f4552091a69125d5dfcb7b8c2659029395bdf"

@@ -165,3 +165,53 @@ def test_cnc_signal_heartbeat():
     assert cnc.last_heartbeat == 12345
     cnc.diag_set(2, 99)
     assert cnc.diag(2) == 99
+
+
+# -- lru + tempo --------------------------------------------------------------
+
+
+def test_lru_recency_eviction():
+    from firedancer_tpu.tango.lru import LruCache
+
+    lru = LruCache(3)
+    for t in (1, 2, 3):
+        assert not lru.insert(t)
+    assert lru.query(1)  # refresh 1: now 2 is least-recent
+    assert not lru.insert(4)  # evicts 2
+    assert not lru.query(2)
+    assert lru.query(1) and lru.query(3) and lru.query(4)
+    # duplicate insert reports presence and refreshes
+    assert lru.insert(3)
+    assert len(lru) == 3
+    # null tag never caches
+    assert not lru.insert(0) and not lru.query(0)
+
+
+def test_lru_differs_from_tcache():
+    """The property split: tcache evicts by INSERTION age (a queried tag
+    still dies); lru evicts by USE age (a queried tag survives)."""
+    from firedancer_tpu.tango.lru import LruCache
+    from firedancer_tpu.tango.rings import TCache
+
+    tc, lru = TCache(2), LruCache(2)
+    for t in (1, 2):
+        tc.insert(t)
+        lru.insert(t)
+    tc.query(1), lru.query(1)
+    tc.insert(3), lru.insert(3)  # full: evict
+    assert not tc.query(1)  # tcache: 1 was oldest-inserted, gone
+    assert lru.query(1)     # lru: 1 was refreshed, survives; 2 died
+    assert not lru.query(2)
+
+
+def test_tempo_models():
+    import random
+
+    from firedancer_tpu.tango.lru import async_reload, lazy_default
+
+    assert lazy_default(1024) == 1 + (9 * 1024 >> 2)
+    assert lazy_default(10**18) < (1 << 31)  # saturates
+    rng = random.Random(7)
+    draws = [async_reload(rng, 128) for _ in range(1000)]
+    assert all(64 <= d < 192 for d in draws)
+    assert len(set(draws)) > 50  # actually randomized

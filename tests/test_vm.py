@@ -1,0 +1,131 @@
+"""sBPF VM interpreter tests: ALU, jumps/loops, memory map + faults,
+compute budget, syscalls (including the hashing bridge)."""
+
+import hashlib
+import struct
+
+import pytest
+
+from firedancer_tpu.flamenco import vm as fvm
+from firedancer_tpu.protocol import sbpf
+from tests.test_sbpf import build_elf, ins
+
+EXIT = ins(0x95)
+
+
+def run_text(text, *, input_data=b"", budget=200_000, syscalls=None):
+    prog = sbpf.load(build_elf(text))
+    m = fvm.Vm(prog, input_data=input_data, budget=budget)
+    if syscalls:
+        m.syscalls.update(syscalls)
+    return m
+
+
+def test_alu_basics():
+    text = (
+        ins(0xB7, dst=0, imm=7)        # mov64 r0, 7
+        + ins(0x07, dst=0, imm=5)      # add64 r0, 5
+        + ins(0xB7, dst=1, imm=3)      # mov64 r1, 3
+        + ins(0x2F, dst=0, src=1)      # mul64 r0, r1 -> 36
+        + ins(0x17, dst=0, imm=1)      # sub64 r0, 1 -> 35
+        + ins(0x97, dst=0, imm=8)      # mod64 r0, 8 -> 3
+        + EXIT
+    )
+    assert run_text(text).run() == 3
+
+
+def test_alu_32bit_wraps():
+    text = (
+        ins(0xB4, dst=0, imm=-1)       # mov32 r0, 0xFFFFFFFF
+        + ins(0x04, dst=0, imm=2)      # add32 -> wraps to 1
+        + EXIT
+    )
+    assert run_text(text).run() == 1
+
+
+def test_loop_sums():
+    # r0 = sum(1..10) via a jlt loop
+    text = (
+        ins(0xB7, dst=0, imm=0)
+        + ins(0xB7, dst=1, imm=1)
+        + ins(0x0F, dst=0, src=1)      # loop: r0 += r1
+        + ins(0x07, dst=1, imm=1)      # r1 += 1
+        + ins(0xB5, dst=1, off=-3, imm=10)  # jle r1, 10, loop
+        + EXIT
+    )
+    assert run_text(text).run() == 55
+
+
+def test_memory_stack_roundtrip():
+    text = (
+        ins(0xB7, dst=1, imm=0x1234)
+        + ins(0x7B, dst=10, src=1, off=-8)   # stxdw [r10-8], r1
+        + ins(0x79, dst=0, src=10, off=-8)   # ldxdw r0, [r10-8]
+        + EXIT
+    )
+    assert run_text(text).run() == 0x1234
+
+
+def test_memory_faults():
+    # write into rodata -> fault
+    text = ins(0x18, dst=1, imm=fvm.MM_PROGRAM & 0xFFFFFFFF) + bytes(4) + (
+        fvm.MM_PROGRAM >> 32
+    ).to_bytes(4, "little") + ins(0x7B, dst=1, src=0) + EXIT
+    with pytest.raises(fvm.VmFault, match="read-only"):
+        run_text(text).run()
+    # wild address -> fault
+    text = ins(0x79, dst=0, src=0, off=0) + EXIT  # r0 = [0]
+    with pytest.raises(fvm.VmFault, match="access violation"):
+        run_text(text).run()
+
+
+def test_div_by_zero_and_budget():
+    text = ins(0xB7, dst=0, imm=1) + ins(0x37, dst=0, imm=0) + EXIT
+    with pytest.raises(fvm.VmError, match="division"):
+        run_text(text).run()
+    infinite = ins(0x05, off=-1)  # ja -1: spin forever
+    with pytest.raises(fvm.VmBudget):
+        run_text(infinite + EXIT, budget=1000).run()
+
+
+def test_input_region_and_syscall_hash():
+    """Program hashes its input via sol_sha256: builds the (addr, len)
+    slice descriptor on the stack, calls, returns first digest byte."""
+    payload = b"hello-vm"
+    text = (
+        # r1 points at input (set up by the VM); build slice on stack:
+        ins(0x7B, dst=10, src=1, off=-24)          # [r10-24] = input addr
+        + ins(0xB7, dst=2, imm=len(payload))
+        + ins(0x7B, dst=10, src=2, off=-16)        # [r10-16] = len
+        + ins(0xBF, dst=1, src=10)
+        + ins(0x07, dst=1, imm=-24)                # r1 = &slice
+        + ins(0xB7, dst=2, imm=1)                  # r2 = 1 slice
+        + ins(0xBF, dst=3, src=10)
+        + ins(0x07, dst=3, imm=-64)                # r3 = result buf
+        + ins(0x85, imm=fvm.SYSCALL_SOL_SHA256)    # call sol_sha256
+        + ins(0x71, dst=0, src=10, off=-64)        # r0 = result[0]
+        + EXIT
+    )
+    m = run_text(text, input_data=payload)
+    fvm.register_default_syscalls(m)
+    expect = hashlib.sha256(payload).digest()[0]
+    assert m.run() == expect
+
+
+def test_sol_log_and_unknown_syscall():
+    logs = []
+    text = (
+        ins(0xBF, dst=1, src=10)
+        + ins(0x07, dst=1, imm=-8)
+        + ins(0xB7, dst=2, imm=3)
+        + ins(0x62, dst=10, off=-8, imm=0x636261)  # "abc" on stack
+        + ins(0x85, imm=fvm.SYSCALL_SOL_LOG)
+        + EXIT
+    )
+    m = run_text(text)
+    fvm.register_default_syscalls(m, log_sink=logs)
+    assert m.run() == 0
+    assert logs == [b"abc"]
+    bad = ins(0x85, imm=0x12345678) + EXIT
+    with pytest.raises(fvm.VmError, match="unknown syscall"):
+        run_text(bad).run()
