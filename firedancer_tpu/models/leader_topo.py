@@ -1,0 +1,179 @@
+"""The leader pipeline as a PROCESS topology (fdctl-run shape).
+
+models/leader.py wires the flagship pipeline for the cooperative
+in-process scheduler (tests, bench); this module wires the SAME stages
+into runtime/topo's process runner — one OS process per stage over the
+same shm links, cnc supervision, monitor — the reference's operational
+model (fd_topo_run.c boots tiles as processes; run.c supervises).
+
+Builders are MODULE-LEVEL functions (the topo runner spawns fresh
+interpreters — see runtime/topo.py on why fork is unusable with XLA —
+so every builder and its kwargs must pickle).  Each jax-using child
+forces the CPU backend and joins the shared persistent compile cache
+before its first dispatch.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from firedancer_tpu.runtime import topo as ft
+from firedancer_tpu.tango import shm
+
+
+def _cpu():
+    from firedancer_tpu.utils.platform import enable_compile_cache, force_cpu_backend
+
+    force_cpu_backend()
+    enable_compile_cache()
+
+
+def build_benchg(links, cnc, *, pool_size, n_txns):
+    from firedancer_tpu.runtime.benchg import BenchGStage, gen_transfer_pool
+
+    return BenchGStage(
+        gen_transfer_pool(pool_size),
+        "benchg",
+        outs=[shm.Producer(links["gv"])],
+        cnc=cnc,
+        limit=n_txns,
+    )
+
+
+def build_verify(links, cnc, *, batch):
+    _cpu()
+    from firedancer_tpu.runtime.verify import VerifyStage
+
+    return VerifyStage(
+        "verify0",
+        ins=[shm.Consumer(links["gv"], lazy=32)],
+        outs=[shm.Producer(links["vd"])],
+        cnc=cnc,
+        batch=batch,
+        max_msg_len=256,
+        batch_deadline_s=0.002,
+    )
+
+
+def build_dedup(links, cnc):
+    from firedancer_tpu.runtime.dedup import DedupStage
+
+    return DedupStage(
+        "dedup",
+        ins=[shm.Consumer(links["vd"], lazy=32)],
+        outs=[shm.Producer(links["dp"])],
+        cnc=cnc,
+    )
+
+
+def build_pack(links, cnc, *, n_bank):
+    from firedancer_tpu.runtime.pack_stage import PackStage
+
+    return PackStage(
+        "pack",
+        ins=[shm.Consumer(links["dp"], lazy=32)]
+        + [shm.Consumer(links[f"bd{b}"], lazy=8) for b in range(n_bank)],
+        outs=[shm.Producer(links[f"pb{b}"]) for b in range(n_bank)],
+        cnc=cnc,
+        bank_cnt=n_bank,
+        # a process pipeline has real inter-stage latency: schedule as
+        # soon as anything is pending
+        min_pending=1,
+        mb_deadline_s=0.0,
+    )
+
+
+def build_bank(links, cnc, *, bank_idx):
+    from firedancer_tpu.runtime.bank import BankStage
+
+    stage = BankStage(
+        f"bank{bank_idx}",
+        ins=[shm.Consumer(links[f"pb{bank_idx}"], lazy=8)],
+        outs=[
+            shm.Producer(links[f"bp{bank_idx}"]),
+            shm.Producer(links[f"bd{bank_idx}"]),
+        ],
+        cnc=cnc,
+        bank_idx=bank_idx,
+    )
+    stage.require_credit = True
+    return stage
+
+
+def build_poh(links, cnc, *, n_bank):
+    from firedancer_tpu.runtime.poh_stage import PohStage
+
+    stage = PohStage(
+        "poh",
+        ins=[shm.Consumer(links[f"bp{b}"], lazy=8) for b in range(n_bank)],
+        outs=[shm.Producer(links["ps"])],
+        cnc=cnc,
+    )
+    stage.require_credit = True
+    return stage
+
+
+def build_shred(links, cnc, *, secret, slot):
+    _cpu()  # reedsol dispatches on device: never let a child init the tunnel
+    from firedancer_tpu.ops.ref import ed25519_ref as ref
+    from firedancer_tpu.runtime.shred_stage import ShredStage
+
+    return ShredStage(
+        "shred",
+        ins=[shm.Consumer(links["ps"], lazy=8)],
+        outs=[shm.Producer(links["ss"])],
+        cnc=cnc,
+        signer=lambda root: ref.sign(secret, root),
+        slot=slot,
+        batch_target_sz=4096,
+    )
+
+
+def build_store(links, cnc, *, leader_pub):
+    _cpu()  # the resolver's RS recover dispatches on device
+    from firedancer_tpu.ops.ref import ed25519_ref as ref
+    from firedancer_tpu.runtime.store import StoreStage
+
+    return StoreStage(
+        "store",
+        ins=[shm.Consumer(links["ss"], lazy=64)],
+        cnc=cnc,
+        verify_sig=lambda r, s: ref.verify(r, s, leader_pub),
+    )
+
+
+def build_leader_topology(
+    *,
+    n_txns: int = 64,
+    pool_size: int = 64,
+    batch: int = 32,
+    n_bank: int = 2,
+    leader_seed: bytes = b"leader",
+    slot: int = 1,
+) -> ft.Topology:
+    from firedancer_tpu.ops.ref import ed25519_ref as ref
+
+    topo = ft.Topology()
+    topo.link("gv", depth=1024, mtu=1232)
+    topo.link("vd", depth=1024, mtu=4096)
+    topo.link("dp", depth=1024, mtu=4096)
+    for b in range(n_bank):
+        topo.link(f"pb{b}", depth=256, mtu=65536)
+        topo.link(f"bp{b}", depth=256, mtu=65536)
+        topo.link(f"bd{b}", depth=256, mtu=64)
+    topo.link("ps", depth=1024, mtu=65536)
+    topo.link("ss", depth=4096, mtu=1232)
+
+    secret = hashlib.sha256(leader_seed).digest()
+    leader_pub = ref.public_key(secret)
+
+    topo.stage("benchg", build_benchg, pool_size=pool_size, n_txns=n_txns)
+    topo.stage("verify0", build_verify, batch=batch)
+    topo.stage("dedup", build_dedup)
+    topo.stage("pack", build_pack, n_bank=n_bank)
+    for b in range(n_bank):
+        topo.stage(f"bank{b}", build_bank, bank_idx=b)
+    topo.stage("poh", build_poh, n_bank=n_bank)
+    topo.stage("shred", build_shred, secret=secret, slot=slot)
+    topo.stage("store", build_store, leader_pub=leader_pub)
+    return topo

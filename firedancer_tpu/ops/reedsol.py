@@ -56,6 +56,18 @@ def _recover_bits(d: int, n: int, present_key: tuple):
     return jnp.asarray(g2.gf_matrix_to_bits(full)), present_idx
 
 
+def encode_core(bbits, data):
+    """Jittable parity core: bit-block matrix (8p, 8d) x data (nsets, d,
+    sz) -> (nsets, p, sz).  The single implementation the unsharded
+    encode() AND the mesh-sharded leader step both call — one place owns
+    the flatten/bit-matmul/pack layout."""
+    nsets, d, sz = data.shape
+    # (nsets, d, sz) -> (d, nsets*sz): one big matmul over all sets
+    flat = data.transpose(1, 0, 2).reshape(d, nsets * sz)
+    par = g2.pack_bits(g2._gf2_matmul_bits(bbits, g2.unpack_bits(flat)))
+    return par.reshape(-1, nsets, sz).transpose(1, 0, 2)
+
+
 def encode(data, parity_cnt: int):
     """(d, sz) or (nsets, d, sz) uint8 -> (p, sz) / (nsets, p, sz) parity."""
     data = jnp.asarray(data, dtype=jnp.uint8)
@@ -65,11 +77,7 @@ def encode(data, parity_cnt: int):
     nsets, d, sz = data.shape
     if not (0 < d <= DATA_SHREDS_MAX and 0 < parity_cnt <= PARITY_SHREDS_MAX):
         raise ValueError("bad shred counts")
-    bbits = _encode_bits(d, parity_cnt)
-    # (nsets, d, sz) -> (d, nsets*sz): one big matmul over all sets
-    flat = data.transpose(1, 0, 2).reshape(d, nsets * sz)
-    par = g2.pack_bits(g2._gf2_matmul_bits(bbits, g2.unpack_bits(flat)))
-    par = par.reshape(parity_cnt, nsets, sz).transpose(1, 0, 2)
+    par = encode_core(_encode_bits(d, parity_cnt), data)
     return par if batched else par[0]
 
 

@@ -92,7 +92,9 @@ def sign(secret: int, msg_hash: bytes) -> tuple[bytes, int]:
     x, y = _mul(k, G)
     r = x % N
     s = _inv(k, N) * (z + r * secret) % N
-    rec = (y & 1) ^ (1 if x >= N else 0)
+    # bit 0 = nonce point's y parity; bit 1 = x overflowed the scalar
+    # order (recover() reconstructs from r + N for ids 2/3)
+    rec = (y & 1) | (2 if x >= N else 0)
     if s > N // 2:  # canonical low-s; flips the recovery parity
         s = N - s
         rec ^= 1

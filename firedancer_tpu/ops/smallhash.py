@@ -1,0 +1,103 @@
+"""Murmur3-32 and SipHash-1-3 (the small keyed/unkeyed hashes).
+
+Counterparts of /root/reference/src/ballet/murmur3/ and
+/root/reference/src/ballet/siphash13/: murmur3_32 is how Solana derives
+sBPF syscall ids from their names (murmur3_32("sol_sha256") ==
+0x11f49d86 — the ids flamenco/vm registers); siphash-1-3 keys the
+flood-resistant hash maps (pubkey->idx tables).  Both are public
+algorithms; the round structures below are their specifications.
+"""
+
+from __future__ import annotations
+
+_M32 = 0xFFFFFFFF
+_M64 = (1 << 64) - 1
+
+
+def _rotl32(x: int, n: int) -> int:
+    return ((x << n) | (x >> (32 - n))) & _M32
+
+
+def murmur3_32(data: bytes, seed: int = 0) -> int:
+    h = seed & _M32
+    n = len(data)
+    for i in range(0, n - n % 4, 4):
+        k = int.from_bytes(data[i : i + 4], "little")
+        k = (k * 0xCC9E2D51) & _M32
+        k = _rotl32(k, 15)
+        k = (k * 0x1B873593) & _M32
+        h ^= k
+        h = _rotl32(h, 13)
+        h = (h * 5 + 0xE6546B64) & _M32
+    tail = data[n - n % 4 :]
+    if tail:
+        k = int.from_bytes(tail, "little")
+        k = (k * 0xCC9E2D51) & _M32
+        k = _rotl32(k, 15)
+        k = (k * 0x1B873593) & _M32
+        h ^= k
+    h ^= n
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & _M32
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & _M32
+    h ^= h >> 16
+    return h
+
+
+def syscall_id(name: str | bytes) -> int:
+    """The Solana syscall-id derivation: murmur3_32(name, seed 0)."""
+    if isinstance(name, str):
+        name = name.encode()
+    return murmur3_32(name, 0)
+
+
+def _rotl64(x: int, n: int) -> int:
+    return ((x << n) | (x >> (64 - n))) & _M64
+
+
+def siphash13(key: bytes, data: bytes) -> int:
+    """SipHash-1-3 (1 compression round, 3 finalization rounds)."""
+    if len(key) != 16:
+        raise ValueError("siphash key is 16 bytes")
+    k0 = int.from_bytes(key[:8], "little")
+    k1 = int.from_bytes(key[8:], "little")
+    v0 = k0 ^ 0x736F6D6570736575
+    v1 = k1 ^ 0x646F72616E646F6D
+    v2 = k0 ^ 0x6C7967656E657261
+    v3 = k1 ^ 0x7465646279746573
+
+    def sipround():
+        nonlocal v0, v1, v2, v3
+        v0 = (v0 + v1) & _M64
+        v1 = _rotl64(v1, 13)
+        v1 ^= v0
+        v0 = _rotl64(v0, 32)
+        v2 = (v2 + v3) & _M64
+        v3 = _rotl64(v3, 16)
+        v3 ^= v2
+        v0 = (v0 + v3) & _M64
+        v3 = _rotl64(v3, 21)
+        v3 ^= v0
+        v2 = (v2 + v1) & _M64
+        v1 = _rotl64(v1, 17)
+        v1 ^= v2
+        v2 = _rotl64(v2, 32)
+
+    n = len(data)
+    for i in range(0, n - n % 8, 8):
+        m = int.from_bytes(data[i : i + 8], "little")
+        v3 ^= m
+        sipround()
+        v0 ^= m
+    last = (n & 0xFF) << 56
+    tail = data[n - n % 8 :]
+    last |= int.from_bytes(tail, "little")
+    v3 ^= last
+    sipround()
+    v0 ^= last
+    v2 ^= 0xFF
+    sipround()
+    sipround()
+    sipround()
+    return (v0 ^ v1 ^ v2 ^ v3) & _M64

@@ -116,3 +116,87 @@ def sharded_verify(mesh, msg, msg_len, sig, pk, *, max_msg_len: int, axis: str =
         *args, jnp.int32(n_real), max_msg_len=max_msg_len
     )
     return np.asarray(ok)[:n_real], int(total)
+
+
+# -- the full leader compute step, sharded ------------------------------------
+
+_leader_step = None
+
+
+def _get_leader_step():
+    """ONE jitted program covering every device-side op of the leader
+    pipeline — sigverify (ingress), Reed-Solomon parity (shred), PoH
+    segment verification (replay check) — each data-parallel over the
+    mesh with a psum'd summary, the way the reference fans the same work
+    across verify/shred tiles."""
+    global _leader_step
+    if _leader_step is None:
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+
+        from firedancer_tpu.ops import reedsol as rs
+        from firedancer_tpu.ops import sha256 as fsha
+        from firedancer_tpu.ops import sigverify as sv
+
+        @functools.partial(
+            jax.jit, static_argnames=("max_msg_len", "poh_iters")
+        )
+        def step(
+            msg, msg_len, sig, pubkey, rs_bits, shreds, poh_start, poh_end,
+            n_real, *, max_msg_len, poh_iters,
+        ):
+            ok = sv.ed25519_verify_batch(
+                msg, msg_len, sig, pubkey, max_msg_len=max_msg_len
+            )
+            real = jnp.arange(ok.shape[0]) < n_real
+            n_ok = jnp.sum((ok & real).astype(jnp.int32))
+            # RS parity for every FEC set in flight (sets sharded); the
+            # layout lives in reedsol.encode_core, shared with encode()
+            par = rs.encode_core(rs_bits, shreds)
+            # PoH segments (chains sharded)
+            got = fsha.sha256_iter32(poh_start, poh_iters)
+            poh_ok = jnp.sum(jnp.all(got == poh_end, axis=0).astype(jnp.int32))
+            return ok, n_ok, par, poh_ok
+
+        _leader_step = step
+    return _leader_step
+
+
+def sharded_leader_step(
+    mesh,
+    msg, msg_len, sig, pk,
+    fec_data, parity_cnt: int,
+    poh_starts, poh_ends, poh_iters: int,
+    *,
+    max_msg_len: int,
+    axis: str = AXIS,
+):
+    """Run the leader pipeline's device work in ONE sharded program.
+
+    fec_data: (nsets, d, sz) uint8, nsets divisible by the mesh size;
+    poh_starts/ends: (32, n_chains) byte rows, n_chains divisible too.
+    Returns (ok_mask, n_ok, parity (nsets, p, sz), poh_ok_count).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from firedancer_tpu.ops import reedsol as rs
+
+    args, n_real = shard_verify_args(mesh, msg, msg_len, sig, pk, axis)
+    d = fec_data.shape[1]
+    rs_bits = jax.device_put(
+        rs._encode_bits(d, parity_cnt), NamedSharding(mesh, P(None, None))
+    )
+    sets_s = NamedSharding(mesh, P(axis, None, None))
+    rows_s = NamedSharding(mesh, P(None, axis))
+    fec = jax.device_put(jnp.asarray(fec_data, dtype=jnp.uint8), sets_s)
+    p_start = jax.device_put(jnp.asarray(poh_starts, dtype=jnp.int32), rows_s)
+    p_end = jax.device_put(jnp.asarray(poh_ends, dtype=jnp.int32), rows_s)
+    ok, n_ok, par, poh_ok = _get_leader_step()(
+        *args, rs_bits, fec, p_start, p_end, jnp.int32(n_real),
+        max_msg_len=max_msg_len, poh_iters=poh_iters,
+    )
+    return np.asarray(ok)[:n_real], int(n_ok), np.asarray(par), int(poh_ok)

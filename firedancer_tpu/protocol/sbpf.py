@@ -83,8 +83,10 @@ def load(elf: bytes) -> Program:
     str_off, str_sz = raw_shdrs[e_shstrndx][4], raw_shdrs[e_shstrndx][5]
 
     def name_at(off: int) -> str:
-        end = elf.index(b"\x00", str_off + off, str_off + str_sz)
-        return elf[str_off + off : end].decode()
+        end = elf.find(b"\x00", str_off + off, str_off + str_sz)
+        if end < 0:
+            raise SbpfError("unterminated section name")
+        return elf[str_off + off : end].decode(errors="replace")
 
     sections = []
     for sh in raw_shdrs:
@@ -96,18 +98,28 @@ def load(elf: bytes) -> Program:
     text = next((s for s in sections if s.name == ".text"), None)
     if text is None or text.size == 0:
         raise SbpfError("missing .text")
+    if not text.flags & 0x2:
+        raise SbpfError(".text must be an ALLOC section")
     if text.offset + text.size > len(elf):
         raise SbpfError(".text out of bounds")
     if text.size % 8:
         raise SbpfError(".text not a whole number of instruction slots")
 
     # program image: every alloc section copied at its file offset (the
-    # reference builds a contiguous rodata image indexed by file offset)
-    image_sz = max(s.offset + s.size for s in sections if s.flags & 0x2)  # ALLOC
+    # reference builds a contiguous rodata image indexed by file offset).
+    # EVERY copy is bounds-checked: a slice assignment fed fewer bytes
+    # than its target SHRINKS a bytearray silently, corrupting the image.
+    alloc = [s for s in sections if s.flags & 0x2]
+    if not alloc:
+        raise SbpfError("no loadable sections")
+    image_sz = max(s.offset + s.size for s in alloc)
     rodata = bytearray(image_sz)
-    for s in sections:
-        if s.flags & 0x2 and s.sh_type != 8:  # SHT_NOBITS carries no bytes
-            rodata[s.offset : s.offset + s.size] = elf[s.offset : s.offset + s.size]
+    for s in alloc:
+        if s.sh_type == 8:  # SHT_NOBITS carries no bytes
+            continue
+        if s.offset + s.size > len(elf):
+            raise SbpfError(f"section '{s.name}' out of bounds")
+        rodata[s.offset : s.offset + s.size] = elf[s.offset : s.offset + s.size]
 
     # entrypoint: e_entry is a VM address inside .text
     if not (text.addr <= e_entry < text.addr + text.size):
@@ -120,7 +132,9 @@ def load(elf: bytes) -> Program:
     rel = next((s for s in sections if s.name in (".rel.dyn", ".rel.text")), None)
     symtab = next((s for s in sections if s.name in (".dynsym", ".symtab")), None)
     if rel is not None:
-        for off in range(rel.offset, rel.offset + rel.size, _REL.size):
+        if rel.offset + rel.size > len(elf):
+            raise SbpfError("relocation table out of bounds")
+        for off in range(rel.offset, rel.offset + rel.size - _REL.size + 1, _REL.size):
             r_offset, r_info = _REL.unpack_from(elf, off)
             r_type = r_info & 0xFFFFFFFF
             r_sym = r_info >> 32
@@ -139,6 +153,8 @@ def load(elf: bytes) -> Program:
                 if symtab is None:
                     raise SbpfError("symbol relocation without symtab")
                 sym_off = symtab.offset + r_sym * _SYM.size
+                if sym_off + _SYM.size > len(elf):
+                    raise SbpfError("relocation symbol out of bounds")
                 _n, _i, _o, _shn, st_value, _sz = _SYM.unpack_from(elf, sym_off)
                 addr = st_value + MM_PROGRAM_START
             rodata[r_offset + 4 : r_offset + 8] = (addr & 0xFFFFFFFF).to_bytes(

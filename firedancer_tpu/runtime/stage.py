@@ -158,10 +158,26 @@ class Stage:
             break
         return progressed
 
-    def run(self, max_iters: int | None = None) -> None:
+    def run(
+        self,
+        max_iters: int | None = None,
+        *,
+        idle_spins: int = 256,
+        idle_sleep_s: float = 0.001,
+    ) -> None:
+        """The process-runner loop.  The reference spins with PAUSE on a
+        DEDICATED core; without core pinning a hot spin just steals CPU
+        from busy sibling stages, so after `idle_spins` empty iterations
+        the loop naps briefly (progress resets the counter)."""
         it = 0
+        idle = 0
         while self.cnc.signal != CNC_SIG_HALT:
-            self.run_once()
+            if self.run_once():
+                idle = 0
+            else:
+                idle += 1
+                if idle >= idle_spins:
+                    time.sleep(idle_sleep_s)
             it += 1
             if max_iters is not None and it >= max_iters:
                 break

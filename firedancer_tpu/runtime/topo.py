@@ -18,7 +18,11 @@ backpressure).
 
 Stage construction runs IN THE CHILD: specs carry a builder callable
 invoked after the links are joined, so device handles / caches are never
-shared across fork.
+shared across processes.  Children START FRESH (the multiprocessing
+"spawn" method, not fork): a forked child inherits the parent's
+initialized XLA runtime whose thread pools did not survive the fork, and
+its first device dispatch deadlocks — so builders must be module-level
+(picklable) functions, with per-stage parameters in StageSpec.kwargs.
 """
 
 from __future__ import annotations
@@ -214,7 +218,7 @@ class TopologyHandle:
 
 
 def launch(topo: Topology) -> TopologyHandle:
-    ctx = mp.get_context("fork")  # builders may close over local state
+    ctx = mp.get_context("spawn")  # fresh interpreters: see module docstring
     uid = f"{os.getpid()}_{int(time.monotonic_ns() % 1_000_000)}"
     links: dict[str, shm.ShmLink] = {}
     link_names: dict[str, str] = {}

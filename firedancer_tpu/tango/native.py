@@ -1,0 +1,178 @@
+"""ctypes bindings for the native (C++) tango ring hot path.
+
+The runtime around the TPU compute is native where the reference's is
+(SURVEY §7.1): native/fd_ring.cpp implements the per-frag critical path
+(publish + poll with the BUSY-bit/speculative-read protocol) directly
+over the SAME shared-memory blocks tango/shm.py creates — a native
+producer interoperates with a Python consumer and vice versa, which the
+differential tests assert.  The layout offsets are computed once in
+Python (shm._layout) and handed to C++ in the init struct: one source of
+truth for the wire format.
+
+The .so builds on demand with the baked-in g++ and is cached next to the
+source; environments without a toolchain raise NativeUnavailable and
+callers fall back to the Python rings.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+from . import rings, shm
+
+_SRC = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+    "fd_ring.cpp",
+)
+_SO = os.path.join(os.path.dirname(_SRC), "fd_ring.so")
+
+
+class NativeUnavailable(RuntimeError):
+    pass
+
+
+class _Link(ctypes.Structure):
+    _fields_ = [
+        ("base", ctypes.c_void_p),
+        ("depth", ctypes.c_uint64),
+        ("mtu", ctypes.c_uint64),
+        ("mcache_off", ctypes.c_uint64),
+        ("dcache_off", ctypes.c_uint64),
+        ("dcache_sz", ctypes.c_uint64),
+    ]
+
+
+class _Producer(ctypes.Structure):
+    _fields_ = [
+        ("seq", ctypes.c_uint64),
+        ("chunk", ctypes.c_uint64),
+        ("wmark", ctypes.c_uint64),
+    ]
+
+
+class _Consumer(ctypes.Structure):
+    _fields_ = [("seq", ctypes.c_uint64), ("ovrn_cnt", ctypes.c_uint64)]
+
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+        try:
+            subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", "-o", _SO, _SRC],
+                check=True,
+                capture_output=True,
+                text=True,
+            )
+        except (OSError, subprocess.CalledProcessError) as e:
+            raise NativeUnavailable(f"cannot build fd_ring.so: {e}") from e
+    lib = ctypes.CDLL(_SO)
+    lib.fdr_producer_init.argtypes = [
+        ctypes.POINTER(_Link), ctypes.POINTER(_Producer),
+    ]
+    lib.fdr_publish.argtypes = [
+        ctypes.POINTER(_Link), ctypes.POINTER(_Producer),
+        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
+        ctypes.c_uint64, ctypes.c_uint64,
+    ]
+    lib.fdr_poll.argtypes = [
+        ctypes.POINTER(_Link), ctypes.POINTER(_Consumer),
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.fdr_poll.restype = ctypes.c_int
+    lib.fdr_publish_n.argtypes = [
+        ctypes.POINTER(_Link), ctypes.POINTER(_Producer),
+        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
+    ]
+    lib.fdr_consume_n.argtypes = [
+        ctypes.POINTER(_Link), ctypes.POINTER(_Consumer),
+        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
+    ]
+    lib.fdr_consume_n.restype = ctypes.c_uint64
+    _lib = lib
+    return lib
+
+
+def _link_struct(link: shm.ShmLink) -> tuple[_Link, object]:
+    a, b, c, d, e = shm._layout(link.depth, link.mtu, link.n_fseq)
+    buf = (ctypes.c_char * link._shm.size).from_buffer(link._shm.buf)
+    ls = _Link(
+        base=ctypes.addressof(buf),
+        depth=link.depth,
+        mtu=link.mtu,
+        mcache_off=a,
+        dcache_off=b,
+        dcache_sz=rings.DCache.footprint(link.mtu, link.depth),
+    )
+    return ls, buf  # buf must outlive the struct (holds the buffer ref)
+
+
+class NativeProducer:
+    """Drop-in for shm.Producer's publish path, native hot loop."""
+
+    def __init__(self, link: shm.ShmLink):
+        self._lib = _load()
+        self._ls, self._keep = _link_struct(link)
+        self._p = _Producer()
+        self._lib.fdr_producer_init(ctypes.byref(self._ls), ctypes.byref(self._p))
+
+    @property
+    def seq(self) -> int:
+        return self._p.seq
+
+    def publish(self, payload: bytes, sig: int = 0, tsorig: int = 0) -> None:
+        ts = tsorig or shm.now_ns()
+        self._lib.fdr_publish(
+            ctypes.byref(self._ls), ctypes.byref(self._p),
+            payload, len(payload), sig, ts, shm.now_ns(),
+        )
+
+    def publish_n(self, payload: bytes, n: int) -> None:
+        self._lib.fdr_publish_n(
+            ctypes.byref(self._ls), ctypes.byref(self._p), payload,
+            len(payload), n,
+        )
+
+
+class NativeConsumer:
+    """Drop-in for shm.Consumer's poll path, native hot loop."""
+
+    def __init__(self, link: shm.ShmLink):
+        self._lib = _load()
+        self._ls, self._keep = _link_struct(link)
+        self._c = _Consumer()
+        self._out = ctypes.create_string_buffer(link.mtu)
+        self._meta = (ctypes.c_uint64 * 7)()
+
+    @property
+    def seq(self) -> int:
+        return self._c.seq
+
+    @property
+    def ovrn_cnt(self) -> int:
+        return self._c.ovrn_cnt
+
+    def poll(self):
+        """(meta tuple, payload bytes) | shm.POLL_EMPTY | shm.POLL_OVERRUN."""
+        rc = self._lib.fdr_poll(
+            ctypes.byref(self._ls), ctypes.byref(self._c), self._out, self._meta
+        )
+        if rc == -1:
+            return shm.POLL_EMPTY
+        if rc == 1:
+            return shm.POLL_OVERRUN
+        meta = tuple(self._meta)
+        return meta, self._out.raw[: self._meta[3]]
+
+    def consume_n(self, n: int, spin_limit: int = 1 << 30) -> int:
+        return self._lib.fdr_consume_n(
+            ctypes.byref(self._ls), ctypes.byref(self._c), self._out, n, spin_limit
+        )

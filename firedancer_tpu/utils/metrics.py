@@ -166,6 +166,56 @@ def render_prometheus(stages: dict[str, MetricsRegistry]) -> str:
     return "\n".join(lines) + "\n"
 
 
+class MetricsServer:
+    """The metric-tile endpoint: serves the Prometheus text exposition
+    over HTTP (run/tiles/fd_metric.c:1-3).  `stages` may be swapped or
+    mutated live; every scrape renders the current registries."""
+
+    def __init__(self, stages: dict[str, MetricsRegistry], *, host="127.0.0.1", port=0):
+        import http.server
+        import threading
+
+        registry = self  # closure hook
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (http.server API)
+                if self.path not in ("/metrics", "/"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                # snapshot the dict: a registrar may add stages while a
+                # scrape renders (the handler runs on its own thread)
+                body = render_prometheus(dict(registry.stages)).encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # quiet
+                pass
+
+        self.stages = stages
+        # threading server: one stalled/idle client must not block every
+        # later scrape; per-request timeout bounds half-open connections
+        Handler.timeout = 10
+        self._httpd = http.server.ThreadingHTTPServer((host, port), Handler)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    @property
+    def addr(self):
+        return self._httpd.server_address
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
 # The stage-loop schema every pipeline stage shares (the "all tiles" block
 # of metrics.xml): frag counters + latency histograms.
 def stage_schema() -> MetricsSchema:

@@ -1,0 +1,162 @@
+// Native tango ring hot path: single-producer publish + consumer poll.
+//
+// The C++ half of the runtime (the reference's tango layer is C for the
+// same reason: the ring protocol IS the per-frag overhead).  Operates on
+// the exact shared-memory layout tango/shm.py creates — the layout
+// offsets arrive in the init struct from Python, so there is exactly one
+// source of truth for the format.  Protocol parity with tango/rings.py:
+//
+//   - mcache rows of 7 u64 (seq, sig, chunk, sz, ctl, tsorig, tspub);
+//     BUSY bit (1<<63) set in the seq word while a row is mid-overwrite;
+//     seq word written LAST on publish (release), checked before AND
+//     after the payload copy on poll (the speculative-read discipline);
+//   - compact dcache chunk allocation (64-byte granules, wrap at wmark);
+//   - overrun detection by seq comparison in 64-bit wraparound space.
+//
+// Build: g++ -O2 -shared -fPIC -o fd_ring.so fd_ring.cpp
+// (tango/native.py builds and loads it via ctypes).
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr uint64_t BUSY = 1ull << 63;
+constexpr uint64_t CHUNK_SZ = 64;
+constexpr int NCOL = 7;
+
+inline int64_t seq_diff(uint64_t a, uint64_t b) {
+  return (int64_t)(a - b);
+}
+
+inline std::atomic<uint64_t>* row(uint8_t* base, uint64_t mcache_off,
+                                  uint64_t depth, uint64_t seq) {
+  uint64_t line = seq & (depth - 1);
+  return reinterpret_cast<std::atomic<uint64_t>*>(base + mcache_off +
+                                                  line * NCOL * 8);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Mirrors the python-side link geometry; filled by tango/native.py from
+// shm._layout so C++ never re-derives the format.
+struct fdr_link {
+  uint8_t* base;
+  uint64_t depth;
+  uint64_t mtu;
+  uint64_t mcache_off;
+  uint64_t dcache_off;
+  uint64_t dcache_sz;
+};
+
+struct fdr_producer {
+  uint64_t seq;
+  uint64_t chunk;  // compact dcache cursor (granules)
+  uint64_t wmark;  // last chunk a max-size payload may start at
+};
+
+struct fdr_consumer {
+  uint64_t seq;
+  uint64_t ovrn_cnt;
+};
+
+void fdr_producer_init(const fdr_link* l, fdr_producer* p) {
+  p->seq = 0;
+  p->chunk = 0;
+  uint64_t chunk_mtu = (l->mtu + CHUNK_SZ - 1) / CHUNK_SZ;
+  p->wmark = l->dcache_sz / CHUNK_SZ - chunk_mtu;
+}
+
+// Publish one frag.  No credit logic here: flow control stays host-side
+// (it is lazy by design); this is the per-frag critical path.
+void fdr_publish(const fdr_link* l, fdr_producer* p, const uint8_t* payload,
+                 uint64_t sz, uint64_t sig, uint64_t tsorig, uint64_t tspub) {
+  uint64_t chunk = p->chunk;
+  if (chunk > p->wmark) chunk = 0;
+  p->chunk = chunk + (sz > 0 ? (sz + CHUNK_SZ - 1) / CHUNK_SZ : 1);
+
+  std::memcpy(l->base + l->dcache_off + chunk * CHUNK_SZ, payload, sz);
+
+  std::atomic<uint64_t>* r = row(l->base, l->mcache_off, l->depth, p->seq);
+  r[0].store(BUSY | p->seq, std::memory_order_release);
+  r[1].store(sig, std::memory_order_relaxed);
+  r[2].store(chunk, std::memory_order_relaxed);
+  r[3].store(sz, std::memory_order_relaxed);
+  r[4].store(3 /* SOM|EOM */, std::memory_order_relaxed);
+  r[5].store(tsorig, std::memory_order_relaxed);
+  r[6].store(tspub, std::memory_order_relaxed);
+  r[0].store(p->seq, std::memory_order_release);  // seq word LAST
+  p->seq++;
+}
+
+// Poll for the consumer's next frag.
+//   returns  0 = frag copied out (meta[7] filled, payload into out)
+//           -1 = not yet published (caught up)
+//            1 = overrun (consumer resynced to the overwriting frag)
+int fdr_poll(const fdr_link* l, fdr_consumer* c, uint8_t* out,
+             uint64_t* meta_out) {
+  std::atomic<uint64_t>* r = row(l->base, l->mcache_off, l->depth, c->seq);
+  uint64_t mseq = r[0].load(std::memory_order_acquire);
+  if (mseq & BUSY) {
+    int64_t d = seq_diff(mseq & ~BUSY, c->seq);
+    if (d > 0) {  // our frag is being overwritten: resync
+      c->ovrn_cnt += (uint64_t)d;
+      c->seq = mseq & ~BUSY;
+      return 1;
+    }
+    return -1;  // our own frag mid-write: not ready
+  }
+  int64_t d = seq_diff(mseq, c->seq);
+  if (d < 0) return -1;
+  if (d > 0) {
+    c->ovrn_cnt += (uint64_t)d;
+    c->seq = mseq;
+    return 1;
+  }
+  uint64_t sig = r[1].load(std::memory_order_relaxed);
+  uint64_t chunk = r[2].load(std::memory_order_relaxed);
+  uint64_t sz = r[3].load(std::memory_order_relaxed);
+  uint64_t ctl = r[4].load(std::memory_order_relaxed);
+  uint64_t tsorig = r[5].load(std::memory_order_relaxed);
+  uint64_t tspub = r[6].load(std::memory_order_relaxed);
+  if (sz > l->mtu) sz = l->mtu;  // torn row cannot overrun the out buffer
+  std::memcpy(out, l->base + l->dcache_off + chunk * CHUNK_SZ, sz);
+  // speculative-copy re-check: producer may have lapped us mid-copy
+  if (r[0].load(std::memory_order_acquire) != c->seq) {
+    c->ovrn_cnt += 1;
+    return 1;
+  }
+  meta_out[0] = mseq;
+  meta_out[1] = sig;
+  meta_out[2] = chunk;
+  meta_out[3] = sz;
+  meta_out[4] = ctl;
+  meta_out[5] = tsorig;
+  meta_out[6] = tspub;
+  c->seq++;
+  return 0;
+}
+
+// Bulk benchmark helpers: move n frags entirely in native code (the
+// ping-pong microbench shape, bench_frag_tx analog).
+void fdr_publish_n(const fdr_link* l, fdr_producer* p, const uint8_t* payload,
+                   uint64_t sz, uint64_t n) {
+  for (uint64_t i = 0; i < n; i++) fdr_publish(l, p, payload, sz, i, 0, 0);
+}
+
+uint64_t fdr_consume_n(const fdr_link* l, fdr_consumer* c, uint8_t* scratch,
+                       uint64_t n, uint64_t spin_limit) {
+  uint64_t meta[7];
+  uint64_t got = 0, spins = 0;
+  while (got < n && spins < spin_limit) {
+    int rc = fdr_poll(l, c, scratch, meta);
+    if (rc == 0) got++;
+    else if (rc == -1) spins++;
+  }
+  return got;
+}
+
+}  // extern "C"

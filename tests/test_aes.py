@@ -1,0 +1,60 @@
+"""AES + GCM tests: FIPS-197 appendix KATs, NIST SP 800-38D GCM vectors,
+round trips, tamper rejection."""
+
+import pytest
+
+from firedancer_tpu.ops.aes import Aes, AesGcm
+
+
+def test_fips197_block_kats():
+    # FIPS-197 Appendix C.1 (AES-128) and C.3 (AES-256)
+    key = bytes.fromhex("000102030405060708090a0b0c0d0e0f")
+    pt = bytes.fromhex("00112233445566778899aabbccddeeff")
+    assert Aes(key).encrypt_block(pt).hex() == "69c4e0d86a7b0430d8cdb78070b4c55a"
+    key256 = bytes.fromhex(
+        "000102030405060708090a0b0c0d0e0f101112131415161718191a1b1c1d1e1f"
+    )
+    assert Aes(key256).encrypt_block(pt).hex() == "8ea2b7ca516745bfeafc49904b496089"
+
+
+def test_gcm_nist_vectors():
+    # SP 800-38D / GCM spec test cases 1 and 2 (AES-128, zero key/IV)
+    g = AesGcm(bytes(16))
+    ct, tag = g.seal(bytes(12), b"")
+    assert ct == b""
+    assert tag.hex() == "58e2fccefa7e3061367f1d57a4e7455a"
+    ct, tag = g.seal(bytes(12), bytes(16))
+    assert ct.hex() == "0388dace60b6a392f328c2b971b2fe78"
+    assert tag.hex() == "ab6e47d42cec13bdf53a67b21257bddf"
+
+
+def test_gcm_roundtrip_with_aad():
+    import hashlib
+
+    key = hashlib.sha256(b"quic-key").digest()[:16]
+    g = AesGcm(key)
+    iv = b"\x01" * 12
+    pt = b"QUIC packet payload bytes, variable length..."
+    aad = b"packet header"
+    ct, tag = g.seal(iv, pt, aad)
+    assert ct != pt and len(ct) == len(pt)
+    assert g.open(iv, ct, tag, aad) == pt
+    # wrong aad, tampered ct, wrong tag, wrong iv: all reject
+    assert g.open(iv, ct, tag, b"other") is None
+    bad = bytes([ct[0] ^ 1]) + ct[1:]
+    assert g.open(iv, bad, tag, aad) is None
+    assert g.open(iv, ct, bytes(16), aad) is None
+    assert g.open(b"\x02" * 12, ct, tag, aad) is None
+
+
+def test_gcm_aes256_roundtrip():
+    g = AesGcm(bytes(range(32)))
+    ct, tag = g.seal(b"\x07" * 12, b"x" * 100, b"hdr")
+    assert g.open(b"\x07" * 12, ct, tag, b"hdr") == b"x" * 100
+
+
+def test_key_size_validation():
+    with pytest.raises(ValueError):
+        Aes(b"short")
+    with pytest.raises(ValueError):
+        AesGcm(bytes(16)).seal(b"\x00" * 8, b"")  # bad IV size

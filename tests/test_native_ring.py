@@ -1,0 +1,117 @@
+"""Native (C++) ring tests: build via ctypes, differential interop with
+the Python rings in BOTH directions, overrun semantics, and a throughput
+sanity race (native must beat the Python loop)."""
+
+import os
+import time
+
+import pytest
+
+from firedancer_tpu.tango import shm
+from firedancer_tpu.tango.rings import MCache
+
+try:
+    from firedancer_tpu.tango import native as fn
+
+    fn._load()
+    HAVE_NATIVE = True
+except Exception:  # toolchain-less environment
+    HAVE_NATIVE = False
+
+pytestmark = pytest.mark.skipif(not HAVE_NATIVE, reason="no g++ toolchain")
+
+
+@pytest.fixture
+def link():
+    l = shm.ShmLink.create(
+        f"fdtpu_nr_{os.getpid()}_{int(time.monotonic_ns() % 1_000_000)}",
+        depth=64,
+        mtu=256,
+    )
+    yield l
+    l.close()
+    l.unlink()
+
+
+def test_native_producer_python_consumer(link):
+    prod = fn.NativeProducer(link)
+    cons = shm.Consumer(link)
+    msgs = [b"frag-%03d" % i for i in range(50)]
+    for i, m in enumerate(msgs):
+        prod.publish(m, sig=1000 + i)
+    got = []
+    while len(got) < 50:
+        res = cons.poll()
+        assert res != shm.POLL_OVERRUN
+        if isinstance(res, tuple):
+            got.append(res)
+    assert [p for _, p in got] == msgs
+    assert [int(m[MCache.COL_SIG]) for m, _ in got] == list(range(1000, 1050))
+    assert all(int(m[MCache.COL_TSPUB]) > 0 for m, _ in got)
+
+
+def test_python_producer_native_consumer(link):
+    prod = shm.Producer(link)
+    cons = fn.NativeConsumer(link)
+    for i in range(40):
+        assert prod.try_publish(b"x%d" % i, sig=i)
+    got = []
+    while len(got) < 40:
+        res = cons.poll()
+        if isinstance(res, tuple):
+            got.append(res)
+    assert [p for _, p in got] == [b"x%d" % i for i in range(40)]
+    assert cons.ovrn_cnt == 0
+
+
+def test_native_overrun_detection(link):
+    prod = fn.NativeProducer(link)
+    cons = fn.NativeConsumer(link)
+    # lap the consumer: 64-deep ring, publish 100 without consuming
+    for i in range(100):
+        prod.publish(b"y%d" % i, sig=i)
+    res = cons.poll()
+    assert res == shm.POLL_OVERRUN
+    assert cons.ovrn_cnt >= 100 - 64
+    # after resync the stream continues coherently
+    res = cons.poll()
+    assert isinstance(res, tuple)
+
+
+def test_native_bulk_roundtrip_and_speed(link):
+    n = 20_000
+    payload = b"z" * 200
+    prod = fn.NativeProducer(link)
+    cons = fn.NativeConsumer(link)
+    # interleave in bulk chunks sized under the ring depth so nothing drops
+    t0 = time.perf_counter()
+    done = 0
+    while done < n:
+        burst = min(48, n - done)
+        prod.publish_n(payload, burst)
+        got = cons.consume_n(burst)
+        assert got == burst
+        done += burst
+    native_dt = time.perf_counter() - t0
+
+    prod2 = shm.Producer(link)
+    prod2.seq = prod.seq
+    cons2 = shm.Consumer(link, lazy=16)
+    cons2.seq = prod.seq
+    cons2.publish_progress()  # native path never touched the fseq: prime
+    # the credit loop so the python producer isn't still at lap 0
+    t0 = time.perf_counter()
+    done = 0
+    while done < n:
+        burst = min(48, n - done)
+        for _ in range(burst):
+            assert prod2.try_publish(payload)
+        got = 0
+        while got < burst:
+            if isinstance(cons2.poll(), tuple):
+                got += 1
+        done += burst
+    py_dt = time.perf_counter() - t0
+    rate = n / native_dt
+    print(f"native ring: {rate:,.0f} frags/s vs python {n / py_dt:,.0f}")
+    assert native_dt < py_dt, "native hot path should outrun the Python loop"

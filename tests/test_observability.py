@@ -94,3 +94,32 @@ def test_prometheus_exposition():
     assert 'lat_ns_count{stage="verify0"} 1' in text
     # HELP/TYPE emitted once per metric, not per stage
     assert text.count("# TYPE txn_total counter") == 1
+
+
+def test_prometheus_http_endpoint():
+    """The metric-tile analog: live registries scraped over HTTP."""
+    import urllib.request
+
+    schema = fm.MetricsSchema().counter("txn_total")
+    reg = fm.MetricsRegistry(schema)
+    srv = fm.MetricsServer({"verify0": reg})
+    try:
+        host, port = srv.addr
+        reg.inc("txn_total", 5)
+        body = urllib.request.urlopen(
+            f"http://{host}:{port}/metrics", timeout=10
+        ).read().decode()
+        assert 'txn_total{stage="verify0"} 5' in body
+        # live: a later scrape sees new values
+        reg.inc("txn_total", 2)
+        body = urllib.request.urlopen(
+            f"http://{host}:{port}/metrics", timeout=10
+        ).read().decode()
+        assert 'txn_total{stage="verify0"} 7' in body
+        # unknown path 404s
+        import urllib.error
+
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"http://{host}:{port}/nope", timeout=10)
+    finally:
+        srv.close()

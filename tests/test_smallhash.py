@@ -1,0 +1,60 @@
+"""murmur3_32 + siphash13 against their public vector sets (the Solana
+syscall-id table and the SipHash-1-3 reference vectors)."""
+
+import pytest
+
+from firedancer_tpu.flamenco import vm as fvm
+from firedancer_tpu.ops.smallhash import murmur3_32, siphash13, syscall_id
+
+# the Solana syscall-id derivation (public protocol constants)
+SYSCALL_IDS = {
+    "abort": 0xB6FC1A11,
+    "sol_panic_": 0x686093BB,
+    "sol_log_": 0x207559BD,
+    "sol_sha256": 0x11F49D86,
+    "sol_keccak256": 0xD7793ABB,
+    "sol_secp256k1_recover": 0x17E40350,
+    "sol_blake3": 0x174C5122,
+}
+
+
+def test_murmur3_syscall_ids():
+    for name, want in SYSCALL_IDS.items():
+        assert syscall_id(name) == want, name
+
+
+def test_vm_ids_are_name_hashes():
+    """flamenco/vm's registered ids ARE the murmur3 name hashes."""
+    assert fvm.SYSCALL_SOL_SHA256 == syscall_id("sol_sha256")
+    assert fvm.SYSCALL_SOL_KECCAK256 == syscall_id("sol_keccak256")
+    assert fvm.SYSCALL_SOL_LOG == syscall_id("sol_log_")
+    assert fvm.SYSCALL_SOL_SECP256K1_RECOVER == syscall_id("sol_secp256k1_recover")
+
+
+def test_murmur3_seed_and_tails():
+    # seed changes the hash; all tail lengths exercise the partial block
+    assert murmur3_32(b"abcd", 1) != murmur3_32(b"abcd", 2)
+    vals = {murmur3_32(b"x" * n) for n in range(9)}
+    assert len(vals) == 9
+
+
+def test_siphash13_reference_vectors():
+    """The SipHash-1-3 vector set: key 00..0f, message 00,01,..,i-1
+    (the same public vectors the reference embeds, test_siphash13.c)."""
+    key = bytes(range(16))
+    expect = [
+        0xABAC0158050FC4DC,
+        0xC9F49BF37D57CA93,
+        0x82CB9B024DC7D44D,
+        0x8BF80AB8E7DDF7FB,
+        0xCF75576088D38328,
+    ]
+    for i, want in enumerate(expect):
+        msg = bytes(range(i))
+        assert siphash13(key, msg) == want, i
+
+
+def test_siphash13_keyed():
+    assert siphash13(bytes(16), b"data") != siphash13(bytes(range(16)), b"data")
+    with pytest.raises(ValueError):
+        siphash13(b"short", b"")

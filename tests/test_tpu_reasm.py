@@ -1,0 +1,78 @@
+"""TPU stream reassembly tests: fragmented txns complete at FIN, slot
+stealing under pressure, oversize cancel, interop with the verify parser."""
+
+import pytest
+
+from firedancer_tpu.runtime.benchg import gen_transfer_pool
+from firedancer_tpu.runtime.tpu_reasm import TpuReasm
+from firedancer_tpu.protocol import txn as ft
+
+
+def test_fragmented_txn_reassembles():
+    txn = gen_transfer_pool(1, seed=b"reasm")[0]
+    r = TpuReasm()
+    # deliver in 3 fragments on one stream, interleaved with another stream
+    a, b, c = txn[:100], txn[100:180], txn[180:]
+    assert r.append(("c1", 5), a) is None
+    assert r.append(("c2", 1), b"other-stream") is None
+    assert r.append(("c1", 5), b) is None
+    out = r.append(("c1", 5), c, fin=True)
+    assert out == txn
+    assert ft.txn_parse(out) is not None
+    assert r.metrics["published"] == 1
+    assert r.active() == 1  # c2 still open
+
+
+def test_single_fragment_fast_path():
+    r = TpuReasm()
+    assert r.append(("c", 0), b"whole", fin=True) == b"whole"
+    assert r.active() == 0
+
+
+def test_oversize_stream_cancelled():
+    r = TpuReasm(mtu=100)
+    assert r.append(("c", 0), b"x" * 80) is None
+    assert r.append(("c", 0), b"x" * 40, fin=True) is None  # 120 > 100
+    assert r.metrics["oversz"] == 1
+    assert r.active() == 0
+
+
+def test_oversize_poison_is_sticky():
+    """A long stream crossing the MTU mid-flight must not re-open fresh
+    slots with every continuation frame (it would churn-evict honest
+    streams) nor publish its tail as a txn at FIN."""
+    r = TpuReasm(depth=2, mtu=100)
+    r.append(("honest", 1), b"partial")
+    assert r.append(("big", 0), b"x" * 120) is None  # poisoned at once
+    # continuation frames are swallowed: no eviction churn, no new slots
+    for _ in range(10):
+        assert r.append(("big", 0), b"y" * 50) is None
+    assert r.metrics["evicted"] == 0
+    # the FIN tail is NOT published as a bogus whole txn
+    assert r.append(("big", 0), b"tail", fin=True) is None
+    # the honest stream survived and the key is reusable afterwards
+    assert r.append(("honest", 1), b"!", fin=True) == b"partial!"
+    assert r.append(("big", 0), b"fresh", fin=True) == b"fresh"
+
+
+def test_slot_stealing_under_pressure():
+    r = TpuReasm(depth=4)
+    for i in range(4):
+        r.append(("stalled", i), b"frag")
+    r.append(("stalled", 1), b"more")  # refresh stream 1's recency
+    r.append(("new", 99), b"data")     # pool full: steals stream 0
+    assert r.metrics["evicted"] == 1
+    assert r.active() == 4
+    # the stolen stream is gone; finishing it starts a FRESH slot
+    out = r.append(("stalled", 0), b"tail", fin=True)
+    assert out == b"tail"
+    # the refreshed stream survived the steal
+    assert r.append(("stalled", 1), b"!", fin=True) == b"fragmore!"
+
+
+def test_cancel():
+    r = TpuReasm()
+    r.append(("c", 0), b"partial")
+    assert r.cancel(("c", 0))
+    assert not r.cancel(("c", 0))
+    assert r.active() == 0
