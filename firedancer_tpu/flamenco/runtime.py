@@ -33,6 +33,7 @@ import numpy as np
 from firedancer_tpu.funk import Funk
 from firedancer_tpu.ops import lthash as lt
 from firedancer_tpu.protocol import txn as ft
+from firedancer_tpu.protocol.txn import VOTE_PROGRAM
 
 LAMPORTS_PER_SIGNATURE = 5000
 
@@ -127,8 +128,51 @@ def _execute_txn(funk: Funk, xid: bytes, payload: bytes, desc: ft.Txn) -> TxnRes
     touched = {a for a in addrs}
     before = {a: funk.rec_query(xid, a) for a in touched}
 
+    def _mk_fail(status):
+        for a, v in before.items():
+            if funk.rec_query(xid, a) != v:
+                if v is None:
+                    funk.rec_remove(xid, a)
+                else:
+                    funk.rec_insert(xid, a, v)
+        return TxnResult(status, fee)
+
     for ins in desc.instrs:
         prog = addrs[ins.program_id]
+        if prog == VOTE_PROGRAM:
+            # the vote native program: record the vote on the vote account
+            # (data = u64 last_voted_slot | u64 vote_count; feeds tower/
+            # ghost via the caller).  Instruction: u32 tag=1 | u64 slot.
+            data = payload[ins.data_off : ins.data_off + ins.data_sz]
+            idx = payload[ins.acct_off : ins.acct_off + ins.acct_cnt]
+            if (
+                len(data) < 12
+                or int.from_bytes(data[:4], "little") != 1
+                or len(idx) < 1
+            ):
+                continue
+            if idx[0] >= len(addrs):
+                return _mk_fail(TXN_ERR_ACCT)
+            if not desc.is_writable(idx[0]):
+                # writes must go through accounts the wave generator SAW
+                # as writable, or concurrent wave execution diverges from
+                # serial order
+                return _mk_fail(TXN_ERR_ACCT)
+            vote_slot = int.from_bytes(data[4:12], "little")
+            acct = addrs[idx[0]]
+            cur = funk.rec_query(xid, acct)
+            cnt = int.from_bytes((cur or bytes(24))[16:24], "little")
+            lam = acct_lamports(cur)
+            funk.rec_insert(
+                xid,
+                acct,
+                acct_build(
+                    lam,
+                    vote_slot.to_bytes(8, "little")
+                    + (cnt + 1).to_bytes(8, "little"),
+                ),
+            )
+            continue
         if prog != ft.SYSTEM_PROGRAM:
             continue  # unknown programs: no-op (the VM is a later layer)
         data = payload[ins.data_off : ins.data_off + ins.data_sz]
@@ -138,25 +182,18 @@ def _execute_txn(funk: Funk, xid: bytes, payload: bytes, desc: ft.Txn) -> TxnRes
         idx = payload[ins.acct_off : ins.acct_off + ins.acct_cnt]
         if len(idx) < 2:
             continue
-
-        def _fail(status):
-            # roll back program effects; the fee remains charged
-            for a, v in before.items():
-                if funk.rec_query(xid, a) != v:
-                    if v is None:
-                        funk.rec_remove(xid, a)
-                    else:
-                        funk.rec_insert(xid, a, v)
-            return TxnResult(status, fee)
-
         if idx[0] >= len(addrs) or idx[1] >= len(addrs):
             # ALT-loaded index: unresolvable until the address-resolution
             # stage exists — a typed failure, never an abort of the block
-            return _fail(TXN_ERR_ACCT)
+            return _mk_fail(TXN_ERR_ACCT)
+        if not (desc.is_writable(idx[0]) and desc.is_writable(idx[1])):
+            # transfers mutate both accounts; a readonly flag would hide
+            # the write from the conflict-wave generator
+            return _mk_fail(TXN_ERR_ACCT)
         src, dst = addrs[idx[0]], addrs[idx[1]]
         sv = funk.rec_query(xid, src)
         if acct_lamports(sv) < lamports:
-            return _fail(TXN_ERR_INSUFFICIENT_FUNDS)
+            return _mk_fail(TXN_ERR_INSUFFICIENT_FUNDS)
         if src == dst:
             continue  # self-transfer: a no-op, NOT a mint (stale-read trap)
         funk.rec_insert(

@@ -229,6 +229,8 @@ SYSCALL_SOL_SHA256 = 0x11F49D86
 SYSCALL_SOL_KECCAK256 = 0xD7793ABB
 SYSCALL_SOL_LOG = 0x207559BD
 SYSCALL_SOL_SECP256K1_RECOVER = 0x17E40350
+SYSCALL_SOL_CREATE_PROGRAM_ADDRESS = 0x9377323C
+SYSCALL_SOL_TRY_FIND_PROGRAM_ADDRESS = 0x48504A38
 
 
 def register_default_syscalls(vm: Vm, *, log_sink: list | None = None) -> None:
@@ -280,7 +282,58 @@ def register_default_syscalls(vm: Vm, *, log_sink: list | None = None) -> None:
             vm_.mem_write(result_addr + j, 1, byte)
         return 0
 
+    def _read_seeds(vm_, seeds_addr, seeds_len):
+        from firedancer_tpu.protocol import pda
+
+        if seeds_len > pda.MAX_SEEDS:
+            return None
+        seeds = []
+        for i in range(seeds_len):
+            addr = vm_.mem_read(seeds_addr + 16 * i, 8)
+            sz = vm_.mem_read(seeds_addr + 16 * i + 8, 8)
+            if sz > pda.MAX_SEED_LEN:
+                return None
+            seeds.append(vm_.mem_read_bytes(addr, sz))
+        return seeds
+
+    def sol_create_program_address(vm_, seeds_addr, seeds_len, prog_addr,
+                                   result_addr, *_):
+        from firedancer_tpu.protocol import pda
+
+        seeds = _read_seeds(vm_, seeds_addr, seeds_len)
+        if seeds is None:
+            return 1
+        try:
+            addr = pda.create_program_address(
+                seeds, vm_.mem_read_bytes(prog_addr, 32)
+            )
+        except pda.PdaError:
+            return 1
+        for j, byte in enumerate(addr):
+            vm_.mem_write(result_addr + j, 1, byte)
+        return 0
+
+    def sol_try_find_program_address(vm_, seeds_addr, seeds_len, prog_addr,
+                                     result_addr, bump_addr):
+        from firedancer_tpu.protocol import pda
+
+        seeds = _read_seeds(vm_, seeds_addr, seeds_len)
+        if seeds is None:
+            return 1
+        try:  # e.g. 16 guest seeds + the bump seed exceeds MAX_SEEDS
+            addr, bump = pda.find_program_address(
+                seeds, vm_.mem_read_bytes(prog_addr, 32)
+            )
+        except pda.PdaError:
+            return 1
+        for j, byte in enumerate(addr):
+            vm_.mem_write(result_addr + j, 1, byte)
+        vm_.mem_write(bump_addr, 1, bump)
+        return 0
+
     vm.syscalls[SYSCALL_SOL_SHA256] = sol_sha256
     vm.syscalls[SYSCALL_SOL_KECCAK256] = sol_keccak256
     vm.syscalls[SYSCALL_SOL_LOG] = sol_log
     vm.syscalls[SYSCALL_SOL_SECP256K1_RECOVER] = sol_secp256k1_recover
+    vm.syscalls[SYSCALL_SOL_CREATE_PROGRAM_ADDRESS] = sol_create_program_address
+    vm.syscalls[SYSCALL_SOL_TRY_FIND_PROGRAM_ADDRESS] = sol_try_find_program_address

@@ -43,7 +43,9 @@ MAX_DATA_PER_BLOCK = ((32 * 1024 - 17) // 31) * 25871 + 48
 MICROBLOCK_DATA_OVERHEAD = 48
 MAX_BANK_TILES = 62
 
-VOTE_PROGRAM = b58_decode32("Vote111111111111111111111111111111111111111")
+from firedancer_tpu.protocol.txn import VOTE_PROGRAM  # protocol constant
+
+assert VOTE_PROGRAM == b58_decode32("Vote111111111111111111111111111111111111111")
 COMPUTE_BUDGET_PROGRAM = b58_decode32("ComputeBudget111111111111111111111111111111")
 ED25519_SV_PROGRAM = b58_decode32("Ed25519SigVerify111111111111111111111111111")
 KECCAK_SECP_PROGRAM = b58_decode32("KeccakSecp256k11111111111111111111111111111")

@@ -1,0 +1,59 @@
+"""Program-derived addresses (the PDA derivation the VM exposes).
+
+The public Solana derivation served by sol_create_program_address /
+sol_try_find_program_address (fd_vm syscalls in the reference): address
+= sha256(seed_0 || .. || seed_n || program_id || "ProgramDerivedAddress"),
+valid only when the digest is NOT a point on the ed25519 curve (PDAs must
+have no private key); try_find appends a bump byte 255..0 until the
+derivation falls off-curve.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from firedancer_tpu.ops.ref import ed25519_ref as ref
+
+_MARKER = b"ProgramDerivedAddress"
+MAX_SEEDS = 16
+MAX_SEED_LEN = 32
+
+
+class PdaError(ValueError):
+    pass
+
+
+def _off_curve(addr: bytes) -> bool:
+    return ref.point_decompress(addr) is None
+
+
+def create_program_address(seeds: list[bytes], program_id: bytes) -> bytes:
+    """Derive; raises PdaError if the result lands ON the curve (caller
+    picks different seeds — the create syscall's error contract)."""
+    if len(seeds) > MAX_SEEDS:
+        raise PdaError("too many seeds")
+    for s in seeds:
+        if len(s) > MAX_SEED_LEN:
+            raise PdaError("seed too long")
+    if len(program_id) != 32:
+        raise PdaError("bad program id")
+    h = hashlib.sha256()
+    for s in seeds:
+        h.update(s)
+    h.update(program_id)
+    h.update(_MARKER)
+    addr = h.digest()
+    if not _off_curve(addr):
+        raise PdaError("derived address is on the curve")
+    return addr
+
+
+def find_program_address(seeds: list[bytes], program_id: bytes) -> tuple[bytes, int]:
+    """Append bump 255..0 until off-curve; -> (address, bump)."""
+    for bump in range(255, -1, -1):
+        try:
+            return create_program_address(seeds + [bytes([bump])], program_id), bump
+        except PdaError as e:
+            if "on the curve" not in str(e):
+                raise
+    raise PdaError("no viable bump found")  # pragma: no cover (2^-255)

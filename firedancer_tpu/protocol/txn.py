@@ -576,6 +576,40 @@ def txn_assemble(signatures: list[bytes], message: bytes) -> bytes:
 
 
 SYSTEM_PROGRAM = bytes(32)
+# "Vote111..." — protocol constant; lives here (the protocol layer) so
+# pack's cost model and the runtime's native program both import DOWN
+VOTE_PROGRAM = bytes.fromhex(
+    "0761481d357474bb7c4d7624ebd3bdb3d8355e73d11043fc0da3538000000000"
+)
+
+
+def vote_txn(
+    voter_secret: bytes,
+    vote_account: bytes,
+    slot: int,
+    recent_blockhash: bytes,
+    *,
+    voter_pubkey: bytes | None = None,
+) -> bytes:
+    """A simple vote (the shape pack routes to its vote lane and the
+    runtime's vote program consumes: one instr to the vote program,
+    data = u32 tag 1 | u64 slot)."""
+    from firedancer_tpu.ops.ref import ed25519_ref as ref
+
+    voter = voter_pubkey if voter_pubkey is not None else ref.public_key(
+        voter_secret
+    )
+    data = (1).to_bytes(4, "little") + slot.to_bytes(8, "little")
+    msg = message_build(
+        version=VLEGACY,
+        signature_cnt=1,
+        readonly_signed_cnt=0,
+        readonly_unsigned_cnt=1,
+        acct_addrs=[voter, vote_account, VOTE_PROGRAM],
+        recent_blockhash=recent_blockhash,
+        instrs=[InstrSpec(program_id=2, accounts=bytes([1, 0]), data=data)],
+    )
+    return txn_assemble([ref.sign(voter_secret, msg)], msg)
 
 
 def transfer_txn(

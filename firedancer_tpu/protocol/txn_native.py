@@ -1,0 +1,77 @@
+"""ctypes bindings for the native (C++) transaction parser.
+
+native/fd_txn_parse.cpp implements protocol/txn.py's validation rules and
+emits the packed descriptor format directly (txn_pack's layout), so the
+two parsers are drop-in interchangeable — the differential tests assert
+accept/reject AND descriptor equality over valid, malformed, and fuzzed
+inputs.  The verify stage's per-packet parse is the host hot path this
+accelerates (fd_txn_parse is C in the reference for the same reason).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+from . import txn as ft
+
+_SRC = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+    "fd_txn_parse.cpp",
+)
+_SO = os.path.join(os.path.dirname(_SRC), "fd_txn_parse.so")
+
+_lib = None
+_OUT_CAP = 4096
+
+
+class NativeUnavailable(RuntimeError):
+    pass
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+        try:
+            subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", "-o", _SO, _SRC],
+                check=True,
+                capture_output=True,
+                text=True,
+            )
+        except (OSError, subprocess.CalledProcessError) as e:
+            raise NativeUnavailable(f"cannot build fd_txn_parse.so: {e}") from e
+    lib = ctypes.CDLL(_SO)
+    lib.fd_txn_parse.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint64,
+    ]
+    lib.fd_txn_parse.restype = ctypes.c_int64
+    _lib = lib
+    return lib
+
+
+def txn_parse_packed(payload: bytes) -> bytes | None:
+    """Native parse -> packed descriptor bytes (txn_pack layout), or None
+    on malformed input."""
+    lib = _load()
+    out = ctypes.create_string_buffer(_OUT_CAP)
+    n = lib.fd_txn_parse(payload, len(payload), out, _OUT_CAP)
+    if n < 0:
+        return None
+    return out.raw[:n]
+
+
+def txn_parse_native(payload: bytes) -> ft.Txn | None:
+    """Native parse -> the same Txn descriptor object python's parser
+    builds (unpacked from the shared binary layout)."""
+    packed = txn_parse_packed(payload)
+    if packed is None:
+        return None
+    desc, end = ft.txn_unpack(packed)
+    if end != len(packed):
+        return None
+    return desc

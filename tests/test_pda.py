@@ -1,0 +1,100 @@
+"""PDA derivation tests: off-curve invariant, bump search, determinism,
+the public well-known derivation, and the VM syscall path."""
+
+import hashlib
+
+import pytest
+
+from firedancer_tpu.ops.ref import ed25519_ref as ref
+from firedancer_tpu.ops.smallhash import syscall_id
+from firedancer_tpu.protocol import pda
+from firedancer_tpu.flamenco import vm as fvm
+
+
+def test_find_program_address_properties():
+    prog = hashlib.sha256(b"prog").digest()
+    addr, bump = pda.find_program_address([b"metadata", b"acct"], prog)
+    assert len(addr) == 32 and 0 <= bump <= 255
+    # off-curve: no ed25519 point decompresses from a PDA
+    assert ref.point_decompress(addr) is None
+    # deterministic
+    again, bump2 = pda.find_program_address([b"metadata", b"acct"], prog)
+    assert (addr, bump) == (again, bump2)
+    # create with the found bump reproduces it
+    assert pda.create_program_address(
+        [b"metadata", b"acct", bytes([bump])], prog
+    ) == addr
+    # different seeds / programs diverge
+    other, _ = pda.find_program_address([b"metadata", b"other"], prog)
+    assert other != addr
+
+
+def test_create_rejects_on_curve_and_bad_inputs():
+    prog = hashlib.sha256(b"p2").digest()
+    # scan for a seed whose direct derivation IS on-curve (p ~ 0.5)
+    on_curve_seed = None
+    for i in range(64):
+        s = b"probe%d" % i
+        try:
+            pda.create_program_address([s], prog)
+        except pda.PdaError:
+            on_curve_seed = s
+            break
+    assert on_curve_seed is not None, "no on-curve derivation in 64 tries?!"
+    with pytest.raises(pda.PdaError, match="on the curve"):
+        pda.create_program_address([on_curve_seed], prog)
+    with pytest.raises(pda.PdaError, match="too many"):
+        pda.create_program_address([b"x"] * 17, prog)
+    # 16 guest seeds is legal for create but leaves no room for the bump
+    with pytest.raises(pda.PdaError, match="too many"):
+        pda.find_program_address([b"x"] * 16, prog)
+    with pytest.raises(pda.PdaError, match="seed too long"):
+        pda.create_program_address([b"x" * 33], prog)
+
+
+def test_vm_syscall_ids_match_names():
+    assert fvm.SYSCALL_SOL_CREATE_PROGRAM_ADDRESS == syscall_id(
+        "sol_create_program_address"
+    )
+    assert fvm.SYSCALL_SOL_TRY_FIND_PROGRAM_ADDRESS == syscall_id(
+        "sol_try_find_program_address"
+    )
+
+
+def test_vm_try_find_syscall():
+    """A program derives its own PDA in-VM and returns the bump."""
+    from tests.test_sbpf import build_elf, ins
+
+    prog_key = hashlib.sha256(b"vmprog").digest()
+    seed = b"vault"
+    expect_addr, expect_bump = pda.find_program_address([seed], prog_key)
+    # input = seed(5) @0 .. then program id @8
+    input_data = seed + bytes(3) + prog_key
+    text = (
+        ins(0xBF, dst=6, src=1)
+        # slice descriptor for the one seed on the stack: [addr, len]
+        + ins(0x7B, dst=10, src=6, off=-16)       # [r10-16] = seed addr
+        + ins(0xB7, dst=2, imm=5)
+        + ins(0x7B, dst=10, src=2, off=-8)        # [r10-8]  = seed len
+        + ins(0xBF, dst=1, src=10) + ins(0x07, dst=1, imm=-16)  # r1 = &slices
+        + ins(0xB7, dst=2, imm=1)                                # r2 = 1 seed
+        + ins(0xBF, dst=3, src=6) + ins(0x07, dst=3, imm=8)      # r3 = &prog
+        + ins(0xBF, dst=4, src=10) + ins(0x07, dst=4, imm=-64)   # r4 = addr out
+        + ins(0xBF, dst=5, src=10) + ins(0x07, dst=5, imm=-72)   # r5 = bump out
+        + ins(0x85, imm=fvm.SYSCALL_SOL_TRY_FIND_PROGRAM_ADDRESS)
+        + ins(0x55, dst=0, off=2, imm=0)          # syscall failed -> fail
+        + ins(0x71, dst=0, src=10, off=-72)       # r0 = bump
+        + ins(0x95)
+        + ins(0xB7, dst=0, imm=999) + ins(0x95)
+    )
+    m = fvm.Vm(
+        __import__("firedancer_tpu.protocol.sbpf", fromlist=["load"]).load(
+            build_elf(text)
+        ),
+        input_data=input_data,
+    )
+    fvm.register_default_syscalls(m)
+    assert m.run() == expect_bump
+    # the derived address landed in VM stack memory
+    got = m.mem_read_bytes(m.regs[10] - 64, 32)
+    assert got == expect_addr

@@ -183,3 +183,64 @@ def test_replay_block_checks_poh():
     # tampered entry hash -> PoH fraud -> block rejected
     bad = [(11, b"\x00" * 32, [t])]
     assert replay_block(Funk(), slot=3, entries=bad, poh_seed=seed) is None
+
+
+def test_vote_program_updates_vote_account():
+    """The vote native program: simple votes execute in the vote lane,
+    recording (last slot, count) on the vote account — the state tower
+    and ghost consume."""
+    from firedancer_tpu.flamenco.runtime import LAMPORTS_PER_SIGNATURE
+
+    funk = Funk()
+    secret, voter = keypair(b"voter")
+    vote_acct = hashlib.sha256(b"vote-acct").digest()
+    fund(funk, voter, 1_000_000)
+    bh = hashlib.sha256(b"bh-v").digest()
+    t1 = ft.vote_txn(secret, vote_acct, 100, bh)
+    bh2 = hashlib.sha256(b"bh-v2").digest()
+    t2 = ft.vote_txn(secret, vote_acct, 101, bh2)
+    # cost model must classify them as simple votes (the pack vote lane)
+    from firedancer_tpu.pack import cost as fc
+
+    c = fc.compute_cost(t1, ft.txn_parse(t1))
+    assert c is not None and c.is_simple_vote
+    res = execute_block(funk, slot=5, txns=[t1, t2])
+    assert [r.status for r in res.results] == [TXN_SUCCESS, TXN_SUCCESS]
+    # votes on the same account serialize into separate waves
+    assert len(res.waves) == 2
+    data = funk.rec_query(res.xid, vote_acct)
+    assert int.from_bytes(data[8:16], "little") == 101  # last voted slot
+    assert int.from_bytes(data[16:24], "little") == 2   # vote count
+    # fees charged to the voter
+    assert acct_lamports(funk.rec_query(res.xid, voter)) == (
+        1_000_000 - 2 * LAMPORTS_PER_SIGNATURE
+    )
+
+
+def test_readonly_accounts_reject_writes():
+    """A txn marking its write target readonly must fail typed: silent
+    writes through readonly flags would break wave conflict-freedom."""
+    from firedancer_tpu.flamenco.runtime import TXN_ERR_ACCT
+    from firedancer_tpu.ops.ref import ed25519_ref as rf
+
+    secret, pub = keypair(b"ro")
+    dest = b"R" * 32
+    # hand-build a transfer whose DEST is in the readonly-unsigned tail
+    data = (2).to_bytes(4, "little") + (5).to_bytes(8, "little")
+    msg = ft.message_build(
+        version=ft.VLEGACY,
+        signature_cnt=1,
+        readonly_signed_cnt=0,
+        readonly_unsigned_cnt=2,  # dest AND program readonly
+        acct_addrs=[pub, dest, ft.SYSTEM_PROGRAM],
+        recent_blockhash=bytes(32),
+        instrs=[ft.InstrSpec(program_id=2, accounts=bytes([0, 1]), data=data)],
+    )
+    t = ft.txn_assemble([rf.sign(secret, msg)], msg)
+    funk = Funk()
+    fund(funk, pub, 1_000_000)
+    res = execute_block(funk, slot=1, txns=[t])
+    assert res.results[0].status == TXN_ERR_ACCT
+    assert funk.rec_query(res.xid, dest) is None
+    # fee still charged
+    assert acct_lamports(funk.rec_query(res.xid, pub)) == 1_000_000 - 5000

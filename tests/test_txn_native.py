@@ -1,0 +1,135 @@
+"""Native txn parser differential tests: valid corpus, every rejection
+case the python parser's tests exercise, mutation fuzz, and a throughput
+sanity race."""
+
+import time
+
+import numpy as np
+import pytest
+
+from firedancer_tpu.protocol import txn as ft
+from tests.test_txn import keypair, simple_legacy
+
+try:
+    from firedancer_tpu.protocol import txn_native as fn
+
+    fn._load()
+    HAVE_NATIVE = True
+except Exception:
+    HAVE_NATIVE = False
+
+pytestmark = pytest.mark.skipif(not HAVE_NATIVE, reason="no g++ toolchain")
+
+
+def both(payload: bytes):
+    return ft.txn_parse(payload), fn.txn_parse_native(payload)
+
+
+def assert_agree(payload: bytes):
+    py, nat = both(payload)
+    assert (py is None) == (nat is None), payload.hex()
+    if py is not None:
+        assert py == nat
+
+
+def _v0_with_luts():
+    import hashlib
+
+    secret, pub = keypair(b"v0nat")
+    msg = ft.message_build(
+        version=ft.V0,
+        signature_cnt=1,
+        readonly_signed_cnt=0,
+        readonly_unsigned_cnt=1,
+        acct_addrs=[pub, ft.SYSTEM_PROGRAM],
+        recent_blockhash=bytes(32),
+        instrs=[ft.InstrSpec(program_id=1, accounts=bytes([0, 2]), data=b"zz")],
+        luts=[
+            ft.LutSpec(
+                table_addr=hashlib.sha256(b"t%d" % i).digest(),
+                writable=bytes([1]),
+                readonly=bytes([7, 9]),
+            )
+            for i in range(2)
+        ],
+    )
+    from firedancer_tpu.ops.ref import ed25519_ref as ref
+
+    return ft.txn_assemble([ref.sign(secret, msg)], msg)
+
+
+def test_valid_corpus_agrees():
+    from firedancer_tpu.runtime.benchg import gen_transfer_pool
+
+    corpus = (
+        [simple_legacy(n_extra_accts=k, n_instr=j, data=b"d" * (k + 1))
+         for k in (1, 3) for j in (1, 4)]
+        + gen_transfer_pool(8, seed=b"natcorp")
+        + [_v0_with_luts()]
+        + [ft.vote_txn(keypair(b"nv")[0], b"V" * 32, 7, bytes(32))]
+    )
+    for p in corpus:
+        py, nat = both(p)
+        assert py is not None and py == nat
+        # packed bytes themselves match txn_pack exactly
+        assert fn.txn_parse_packed(p) == ft.txn_pack(py)
+
+
+def test_rejections_agree():
+    base = simple_legacy()
+    bad_cases = [
+        b"",
+        b"\x00",
+        base[:-1],                      # truncated tail
+        base + b"\x00",                 # trailing byte
+        b"\x00" + base[1:],             # sig_cnt 0
+        base[:200],                     # truncated mid-message
+        bytes([200]) + base[1:],        # sig_cnt > 127
+    ]
+    # header count mismatch
+    b2 = bytearray(base)
+    b2[65] = 9
+    bad_cases.append(bytes(b2))
+    # versioned with version 1
+    b3 = bytearray(base)
+    b3[65] = 0x81
+    bad_cases.append(bytes(b3))
+    for p in bad_cases:
+        py, nat = both(p)
+        assert py is None and nat is None, p.hex()
+
+
+def test_mutation_fuzz_agrees():
+    rng = np.random.default_rng(0xF12E)
+    seeds = [simple_legacy(), _v0_with_luts()]
+    for seed in seeds:
+        for _ in range(400):
+            m = bytearray(seed)
+            for _ in range(rng.integers(1, 4)):
+                op = rng.integers(0, 3)
+                if op == 0 and len(m) > 1:
+                    m[rng.integers(0, len(m))] = rng.integers(0, 256)
+                elif op == 1 and len(m) > 2:
+                    del m[rng.integers(0, len(m))]
+                else:
+                    m.insert(rng.integers(0, len(m) + 1), rng.integers(0, 256))
+            assert_agree(bytes(m))
+    # pure noise
+    for n in (0, 1, 50, 300, 1232, 1233):
+        for _ in range(30):
+            assert_agree(rng.bytes(n))
+
+
+def test_native_parse_speed():
+    p = simple_legacy(n_extra_accts=3, n_instr=3)
+    n = 3000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        fn.txn_parse_packed(p)
+    nat_dt = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(n):
+        ft.txn_parse(p)
+    py_dt = time.perf_counter() - t0
+    print(f"native parse {n/nat_dt:,.0f}/s vs python {n/py_dt:,.0f}/s")
+    assert nat_dt < py_dt
