@@ -37,6 +37,18 @@ from firedancer_tpu.protocol import txn as ft
 from firedancer_tpu.tango.rings import MCache, TCache
 from .stage import Stage
 
+# the per-packet parse is this stage's host hot path: prefer the native
+# (C++) parser — differentially proven byte-identical — and fall back to
+# the python parser where no toolchain exists
+try:
+    from firedancer_tpu.protocol.txn_native import txn_parse_native as _txn_parse
+
+    _txn_parse(b"")  # force the .so build/load now, not mid-stream
+    PARSER = "native"
+except Exception:  # pragma: no cover - toolchain-less environment
+    _txn_parse = ft.txn_parse
+    PARSER = "python"
+
 MCACHE_COL_TSORIG = MCache.COL_TSORIG
 
 VERIFY_TCACHE_DEPTH = 16  # tiny by design (fd_verify.h:6-7)
@@ -95,7 +107,7 @@ class VerifyStage(Stage):
         return (seq % self.shard_cnt) == self.shard_idx
 
     def after_frag(self, in_idx: int, meta, payload: bytes) -> None:
-        t = ft.txn_parse(payload)
+        t = _txn_parse(payload)
         if t is None:
             self.metrics.inc("parse_fail")
             return

@@ -65,13 +65,15 @@ def _load():
     if _lib is not None:
         return _lib
     if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+        tmp = f"{_SO}.{os.getpid()}"  # concurrent builders: atomic rename
         try:
             subprocess.run(
-                ["g++", "-O2", "-shared", "-fPIC", "-o", _SO, _SRC],
+                ["g++", "-O2", "-shared", "-fPIC", "-o", tmp, _SRC],
                 check=True,
                 capture_output=True,
                 text=True,
             )
+            os.replace(tmp, _SO)
         except (OSError, subprocess.CalledProcessError) as e:
             raise NativeUnavailable(f"cannot build fd_ring.so: {e}") from e
     lib = ctypes.CDLL(_SO)
