@@ -12,7 +12,8 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
+
+from firedancer_tpu.utils.nativebuild import NativeUnavailable, build_so
 
 from . import txn as ft
 
@@ -27,26 +28,11 @@ _lib = None
 _OUT_CAP = 4096
 
 
-class NativeUnavailable(RuntimeError):
-    pass
-
-
 def _load():
     global _lib
     if _lib is not None:
         return _lib
-    if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
-        tmp = f"{_SO}.{os.getpid()}"  # concurrent builders (spawned stage
-        try:  # processes) must not clobber each other: build + atomic rename
-            subprocess.run(
-                ["g++", "-O2", "-shared", "-fPIC", "-o", tmp, _SRC],
-                check=True,
-                capture_output=True,
-                text=True,
-            )
-            os.replace(tmp, _SO)
-        except (OSError, subprocess.CalledProcessError) as e:
-            raise NativeUnavailable(f"cannot build fd_txn_parse.so: {e}") from e
+    build_so(_SRC, _SO)
     lib = ctypes.CDLL(_SO)
     lib.fd_txn_parse.argtypes = [
         ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint64,

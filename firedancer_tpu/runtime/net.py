@@ -75,3 +75,88 @@ def send_txns(addr: tuple[str, int], txns: list[bytes]) -> None:
             s.sendto(t, addr)
     finally:
         s.close()
+
+
+# -- stream ingress: multi-datagram txns through the reassembler --------------
+#
+# The QUIC-position transport: a txn larger than one datagram arrives as
+# stream FRAMES that reassemble before verify (fd_quic.c + fd_tpu_reasm).
+# Frame format (this framework's stream framing; QUIC proper replaces the
+# outer layer, the reassembly discipline stays):
+#     "FDST" | u64 conn_id | u32 stream_id | u8 flags (1 = FIN) | data
+
+import struct as _struct
+
+_FRAME_HDR = _struct.Struct("<8sQIB")
+_FRAME_MAGIC = b"FDST\x00\x00\x00\x00"
+
+
+def encode_stream_frame(
+    conn_id: int, stream_id: int, data: bytes, fin: bool
+) -> bytes:
+    return _FRAME_HDR.pack(_FRAME_MAGIC, conn_id, stream_id, 1 if fin else 0) + data
+
+
+class StreamIngressStage(UdpIngressStage):
+    """UDP datagrams carrying stream frames -> reassembled whole txns.
+
+    Extends UdpIngressStage (same socket scaffolding and receive loop):
+    each datagram is a stream FRAME fed through the reassembler; whole
+    txns publish downstream.  One-frame streams take the fast path
+    through the same slot logic.
+    """
+
+    def __init__(self, *args, reasm_depth: int = 64, **kwargs):
+        super().__init__(*args, **kwargs)
+        from .tpu_reasm import TpuReasm
+
+        self.reasm = TpuReasm(depth=reasm_depth)
+
+    def after_credit(self) -> None:
+        for _ in range(self.rx_burst):
+            try:
+                data, _src = self.sock.recvfrom(2048)
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError as e:  # pragma: no cover - platform specific
+                if e.errno in (errno.EAGAIN, errno.EWOULDBLOCK):
+                    return
+                raise
+            if len(data) < _FRAME_HDR.size:
+                self.metrics.inc("bad_frame")
+                continue
+            magic, conn_id, stream_id, flags = _FRAME_HDR.unpack_from(data)
+            if magic != _FRAME_MAGIC:  # all 8 bytes, not a 4-byte prefix
+                self.metrics.inc("bad_frame")
+                continue
+            self.metrics.inc("frame_rx")
+            txn = self.reasm.append(
+                (conn_id, stream_id),
+                data[_FRAME_HDR.size :],
+                fin=bool(flags & 1),
+            )
+            if txn is None:
+                continue
+            self.metrics.inc("txn_rx")
+            if not self.publish(0, txn, sig=self.metrics.get("txn_rx")):
+                self.metrics.inc("txn_drop_backpressure")
+                return
+
+
+def send_stream_txn(
+    addr: tuple[str, int],
+    txn: bytes,
+    *,
+    conn_id: int = 1,
+    stream_id: int = 0,
+    frame_sz: int = 512,
+) -> None:
+    """Send one txn as a fragmented stream (test/bench helper)."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        for off in range(0, len(txn), frame_sz):
+            chunk = txn[off : off + frame_sz]
+            fin = off + frame_sz >= len(txn)
+            s.sendto(encode_stream_frame(conn_id, stream_id, chunk, fin), addr)
+    finally:
+        s.close()

@@ -18,7 +18,8 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
+
+from firedancer_tpu.utils.nativebuild import NativeUnavailable, build_so
 
 from . import rings, shm
 
@@ -28,10 +29,6 @@ _SRC = os.path.join(
     "fd_ring.cpp",
 )
 _SO = os.path.join(os.path.dirname(_SRC), "fd_ring.so")
-
-
-class NativeUnavailable(RuntimeError):
-    pass
 
 
 class _Link(ctypes.Structure):
@@ -64,18 +61,7 @@ def _load():
     global _lib
     if _lib is not None:
         return _lib
-    if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
-        tmp = f"{_SO}.{os.getpid()}"  # concurrent builders: atomic rename
-        try:
-            subprocess.run(
-                ["g++", "-O2", "-shared", "-fPIC", "-o", tmp, _SRC],
-                check=True,
-                capture_output=True,
-                text=True,
-            )
-            os.replace(tmp, _SO)
-        except (OSError, subprocess.CalledProcessError) as e:
-            raise NativeUnavailable(f"cannot build fd_ring.so: {e}") from e
+    build_so(_SRC, _SO)
     lib = ctypes.CDLL(_SO)
     lib.fdr_producer_init.argtypes = [
         ctypes.POINTER(_Link), ctypes.POINTER(_Producer),

@@ -1,0 +1,40 @@
+"""Shared on-demand builder for the native (C++) components.
+
+One place owns the build-to-temp + atomic-rename discipline (concurrent
+stage processes must never clobber each other's half-written .so) and the
+temp cleanup on failure; tango/native.py and protocol/txn_native.py both
+load through it.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+
+
+class NativeUnavailable(RuntimeError):
+    pass
+
+
+def build_so(src: str, so: str) -> None:
+    """Compile `src` -> `so` if missing/stale; raises NativeUnavailable
+    when no toolchain exists or the compile fails."""
+    if os.path.exists(so) and os.path.getmtime(so) >= os.path.getmtime(src):
+        return
+    tmp = f"{so}.{os.getpid()}"
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-o", tmp, src],
+            check=True,
+            capture_output=True,
+            text=True,
+        )
+        os.replace(tmp, so)
+    except (OSError, subprocess.CalledProcessError) as e:
+        raise NativeUnavailable(f"cannot build {os.path.basename(so)}: {e}") from e
+    finally:
+        if os.path.exists(tmp):  # failed/interrupted compile leftovers
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass

@@ -123,3 +123,34 @@ def test_prometheus_http_endpoint():
             urllib.request.urlopen(f"http://{host}:{port}/nope", timeout=10)
     finally:
         srv.close()
+
+
+# -- util rng -----------------------------------------------------------------
+
+
+def test_rng_deterministic_and_distinct_streams():
+    from firedancer_tpu.utils.rng import Rng
+
+    a, b = Rng(7, 0), Rng(7, 0)
+    assert [a.ulong() for _ in range(100)] == [b.ulong() for _ in range(100)]
+    # distinct (seq, idx) pairs give distinct streams — including the
+    # shift-xor aliasing pairs (1,0)/(0,2)
+    streams = {
+        (seq, idx): tuple(Rng(seq, idx).ulong() for _ in range(5))
+        for seq, idx in [(7, 0), (7, 1), (1, 0), (0, 2), (0, 0), (2**63, 0)]
+    }
+    assert len(set(streams.values())) == len(streams)
+
+
+def test_rng_roll_and_float():
+    from firedancer_tpu.utils.rng import Rng
+
+    r = Rng(3)
+    vals = [r.roll(10) for _ in range(5000)]
+    assert set(vals) == set(range(10))
+    counts = [vals.count(k) for k in range(10)]
+    assert min(counts) > 350  # rough uniformity
+    fs = [r.float01() for _ in range(1000)]
+    assert all(0.0 <= f < 1.0 for f in fs)
+    xs = r.shuffle(list(range(50)))
+    assert sorted(xs) == list(range(50)) and xs != list(range(50))
