@@ -49,7 +49,15 @@ def cmd_run(args) -> int:
         batch=min(cfg.verify.batch, 256),
         max_msg_len=256,
     )
-    try:
+    rpc_srv = None
+    try:  # the pipeline must close even if the RPC bind fails (EADDRINUSE)
+        if args.rpc_port is not None:
+            from firedancer_tpu.runtime.rpc import PipelineView, RpcServer
+
+            rpc_srv = RpcServer(
+                PipelineView(pipeline=pipe), port=args.rpc_port
+            )
+            print(f"# rpc listening on {rpc_srv.addr}", file=sys.stderr)
         print(f"# leader pipeline: {len(pipe.verifies)} verify, "
               f"{len(pipe.banks)} bank stages; {args.txns} txns", file=sys.stderr)
         t0 = time.time()
@@ -70,6 +78,8 @@ def cmd_run(args) -> int:
               f"({executed / dt:.0f} txn/s)")
         return 0 if executed == args.txns else 1
     finally:
+        if rpc_srv is not None:
+            rpc_srv.close()
         pipe.close()
 
 
@@ -135,6 +145,10 @@ def main(argv=None) -> int:
     runp.add_argument("--config", default=None)
     runp.add_argument("--txns", type=int, default=256)
     runp.add_argument("--cpu", action="store_true", help="force CPU backend")
+    runp.add_argument(
+        "--rpc-port", type=int, default=None,
+        help="serve JSON-RPC (getTransactionCount/getSlot/...) during the run",
+    )
 
     keysp = sub.add_parser("keys", help="identity keypair management")
     keysp.add_argument("action", choices=["new", "pubkey"])

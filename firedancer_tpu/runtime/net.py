@@ -46,22 +46,30 @@ class UdpIngressStage(Stage):
         return self.sock.getsockname()
 
     def after_credit(self) -> None:
+        """One receive loop for every ingress flavor; subclasses override
+        only the per-datagram handling (_on_datagram)."""
         for _ in range(self.rx_burst):
             try:
-                data, _src = self.sock.recvfrom(2048)
+                data, src = self.sock.recvfrom(2048)
             except (BlockingIOError, InterruptedError):
                 return
             except OSError as e:  # pragma: no cover - platform specific
                 if e.errno in (errno.EAGAIN, errno.EWOULDBLOCK):
                     return
                 raise
-            if len(data) > TXN_MTU:
-                self.metrics.inc("oversize_drop")
-                continue
-            self.metrics.inc("pkt_rx")
-            if not self.publish(0, data, sig=self.metrics.get("pkt_rx")):
-                self.metrics.inc("pkt_drop_backpressure")
-                return
+            if not self._on_datagram(data, src):
+                return  # backpressured: stop draining the socket
+
+    def _on_datagram(self, data: bytes, src) -> bool:
+        """Handle one datagram; False = stop the burst (backpressure)."""
+        if len(data) > TXN_MTU:
+            self.metrics.inc("oversize_drop")
+            return True
+        self.metrics.inc("pkt_rx")
+        if not self.publish(0, data, sig=self.metrics.get("pkt_rx")):
+            self.metrics.inc("pkt_drop_backpressure")
+            return False
+        return True
 
     def close(self) -> None:
         self.sock.close()
@@ -112,35 +120,31 @@ class StreamIngressStage(UdpIngressStage):
 
         self.reasm = TpuReasm(depth=reasm_depth)
 
-    def after_credit(self) -> None:
-        for _ in range(self.rx_burst):
-            try:
-                data, _src = self.sock.recvfrom(2048)
-            except (BlockingIOError, InterruptedError):
-                return
-            except OSError as e:  # pragma: no cover - platform specific
-                if e.errno in (errno.EAGAIN, errno.EWOULDBLOCK):
-                    return
-                raise
-            if len(data) < _FRAME_HDR.size:
-                self.metrics.inc("bad_frame")
-                continue
-            magic, conn_id, stream_id, flags = _FRAME_HDR.unpack_from(data)
-            if magic != _FRAME_MAGIC:  # all 8 bytes, not a 4-byte prefix
-                self.metrics.inc("bad_frame")
-                continue
-            self.metrics.inc("frame_rx")
-            txn = self.reasm.append(
-                (conn_id, stream_id),
-                data[_FRAME_HDR.size :],
-                fin=bool(flags & 1),
-            )
-            if txn is None:
-                continue
-            self.metrics.inc("txn_rx")
-            if not self.publish(0, txn, sig=self.metrics.get("txn_rx")):
-                self.metrics.inc("txn_drop_backpressure")
-                return
+    def _on_datagram(self, data: bytes, src) -> bool:
+        if len(data) < _FRAME_HDR.size:
+            self.metrics.inc("bad_frame")
+            return True
+        magic, conn_id, stream_id, flags = _FRAME_HDR.unpack_from(data)
+        if magic != _FRAME_MAGIC:  # all 8 bytes, not a 4-byte prefix
+            self.metrics.inc("bad_frame")
+            return True
+        self.metrics.inc("frame_rx")
+        # the slot key includes the SENDER: peer-chosen (conn, stream) ids
+        # must never interleave two peers' frames or let one peer poison
+        # another's in-flight stream (QUIC's conn identity plays this
+        # role; the UDP source address is its stand-in here)
+        txn = self.reasm.append(
+            (src, conn_id, stream_id),
+            data[_FRAME_HDR.size :],
+            fin=bool(flags & 1),
+        )
+        if txn is None:
+            return True
+        self.metrics.inc("txn_rx")
+        if not self.publish(0, txn, sig=self.metrics.get("txn_rx")):
+            self.metrics.inc("txn_drop_backpressure")
+            return False
+        return True
 
 
 def send_stream_txn(
@@ -154,6 +158,9 @@ def send_stream_txn(
     """Send one txn as a fragmented stream (test/bench helper)."""
     s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
     try:
+        if not txn:  # empty payload still ends with an explicit FIN frame
+            s.sendto(encode_stream_frame(conn_id, stream_id, b"", True), addr)
+            return
         for off in range(0, len(txn), frame_sz):
             chunk = txn[off : off + frame_sz]
             fin = off + frame_sz >= len(txn)

@@ -1,0 +1,145 @@
+"""JSON-RPC server: the operator/bench query surface.
+
+Counterpart of /root/reference/src/app/rpcserver (a JSON-RPC server over
+replay notifications) scoped to the methods the tooling actually drives —
+fddev's bencho polls getTransactionCount once a second to print txn/s
+(tiles/fd_bencho.c:10-26), operators poll slots/balances:
+
+    getTransactionCount  -> txns committed by the bank stages
+    getSlot              -> the current/last slot
+    getBalance           -> lamports from funk (base58 pubkey param)
+    getHealth            -> "ok"
+
+The server reads live state through a provided `view` object (duck-typed:
+.transaction_count() .slot() .balance(pubkey)); the pipeline adapter
+below wires it to a LeaderPipeline + funk.  Standard JSON-RPC 2.0 over
+HTTP POST, stdlib server, threaded like the metrics endpoint.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from dataclasses import dataclass
+
+
+@dataclass
+class PipelineView:
+    """Live view over the flagship pipeline (+ optional funk)."""
+
+    pipeline: object = None
+    funk: object = None
+    slot_fn: object = None
+
+    def transaction_count(self) -> int:
+        if self.pipeline is None:
+            return 0
+        return sum(b.metrics.get("txn_exec") for b in self.pipeline.banks)
+
+    def slot(self) -> int:
+        if self.slot_fn is not None:
+            return int(self.slot_fn())
+        if self.pipeline is not None:
+            return int(self.pipeline.shred.slot)
+        return 0
+
+    def balance(self, pubkey: bytes) -> int:
+        if self.funk is None:
+            return 0
+        val = self.funk.rec_query(None, pubkey)
+        return int.from_bytes(val[:8], "little") if val else 0
+
+
+class RpcServer:
+    def __init__(self, view, *, host: str = "127.0.0.1", port: int = 0):
+        import http.server
+
+        server = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            timeout = 10
+
+            def do_POST(self):  # noqa: N802 (http.server API)
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(n))
+                    resp = server._dispatch(req)
+                except Exception:
+                    resp = {
+                        "jsonrpc": "2.0",
+                        "id": None,
+                        "error": {"code": -32700, "message": "parse error"},
+                    }
+                body = json.dumps(resp).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):
+                pass
+
+        self.view = view
+        self._httpd = http.server.ThreadingHTTPServer((host, port), Handler)
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    @property
+    def addr(self):
+        return self._httpd.server_address
+
+    def _dispatch(self, req: dict) -> dict:
+        rid = req.get("id")
+        method = req.get("method")
+        params = req.get("params") or []
+
+        def ok(result):
+            return {"jsonrpc": "2.0", "id": rid, "result": result}
+
+        def err(code, msg):
+            return {
+                "jsonrpc": "2.0", "id": rid,
+                "error": {"code": code, "message": msg},
+            }
+
+        try:
+            if method == "getTransactionCount":
+                return ok(self.view.transaction_count())
+            if method == "getSlot":
+                return ok(self.view.slot())
+            if method == "getHealth":
+                return ok("ok")
+            if method == "getBalance":
+                from firedancer_tpu.protocol.base58 import b58_decode32
+
+                if not params:
+                    return err(-32602, "missing pubkey param")
+                pubkey = b58_decode32(params[0])
+                return ok(
+                    {"context": {"slot": self.view.slot()},
+                     "value": self.view.balance(pubkey)}
+                )
+            return err(-32601, f"method not found: {method}")
+        except Exception as e:
+            return err(-32603, f"internal error: {type(e).__name__}")
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def rpc_call(addr, method: str, params=None, *, rid: int = 1):
+    """Client helper (the bencho poll, tiles/fd_bencho.c's RPC shape)."""
+    import urllib.request
+
+    body = json.dumps(
+        {"jsonrpc": "2.0", "id": rid, "method": method, "params": params or []}
+    ).encode()
+    req = urllib.request.Request(
+        f"http://{addr[0]}:{addr[1]}/",
+        data=body,
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read())

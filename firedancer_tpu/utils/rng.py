@@ -29,14 +29,15 @@ def _rotl(v: int, n: int) -> int:
 
 class Rng:
     def __init__(self, seq: int = 0, idx: int = 0):
-        # seq and idx each drive their OWN splitmix stream, xor-combined:
-        # a shift-xor of the raw values would alias distinct (seq, idx)
-        # pairs (e.g. (1,0) vs (0,2)) into identical streams
+        # the pair seeds SEQUENTIALLY through splitmix: idx enters keyed
+        # by a seq-derived value, so there is no closed-form (seq, idx)
+        # symmetry (xor-combining two streams aliased under seq <-> ~idx;
+        # raw shift-xor aliased (1,0) with (0,2))
         ga = _splitmix64(seq & _M64)
-        gb = _splitmix64(~idx & _M64)
-        self._s = [next(ga) ^ next(gb) for _ in range(4)]
+        gb = _splitmix64((next(ga) ^ idx) & _M64)
+        self._s = [next(gb) for _ in range(4)]
         if not any(self._s):  # all-zero state is xoshiro's fixed point
-            self._s[0] = 1
+            self._s[0] = 1  # pragma: no cover (splitmix never emits 4 zeros)
 
     def ulong(self) -> int:
         s = self._s

@@ -133,13 +133,18 @@ def test_rng_deterministic_and_distinct_streams():
 
     a, b = Rng(7, 0), Rng(7, 0)
     assert [a.ulong() for _ in range(100)] == [b.ulong() for _ in range(100)]
-    # distinct (seq, idx) pairs give distinct streams — including the
-    # shift-xor aliasing pairs (1,0)/(0,2)
-    streams = {
-        (seq, idx): tuple(Rng(seq, idx).ulong() for _ in range(5))
-        for seq, idx in [(7, 0), (7, 1), (1, 0), (0, 2), (0, 0), (2**63, 0)]
-    }
+    # distinct (seq, idx) pairs give distinct streams — including every
+    # aliasing family earlier constructions fell to: shift-xor ((1,0) vs
+    # (0,2)), the seq <-> ~idx symmetry, and complement-pair degeneracy
+    M = (1 << 64) - 1
+    pairs = [
+        (7, 0), (7, 1), (1, 0), (0, 2), (0, 0), (2**63, 0),
+        (0, M), (1, M - 1), (5, ~5 & M), (M, M),
+    ]
+    streams = {p: tuple(Rng(*p).ulong() for _ in range(5)) for p in pairs}
     assert len(set(streams.values())) == len(streams)
+    # and no degenerate near-zero stream
+    assert all(max(s) > 1 << 32 for s in streams.values())
 
 
 def test_rng_roll_and_float():

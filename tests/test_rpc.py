@@ -1,0 +1,75 @@
+"""JSON-RPC server tests: the bencho poll methods served from live
+state (funk balances, txn counts, slots), protocol error handling."""
+
+import hashlib
+
+import pytest
+
+from firedancer_tpu.flamenco.runtime import acct_build
+from firedancer_tpu.funk import Funk
+from firedancer_tpu.protocol.base58 import b58_encode
+from firedancer_tpu.runtime.rpc import PipelineView, RpcServer, rpc_call
+
+
+class _FakeBank:
+    def __init__(self, n):
+        from firedancer_tpu.runtime.stage import Metrics
+
+        self.metrics = Metrics()
+        self.metrics.inc("txn_exec", n)
+
+
+class _FakePipe:
+    def __init__(self):
+        self.banks = [_FakeBank(70), _FakeBank(50)]
+
+        class _S:  # shred stage stand-in
+            slot = 42
+
+        self.shred = _S()
+
+
+@pytest.fixture
+def server():
+    funk = Funk()
+    pub = hashlib.sha256(b"rpc-acct").digest()
+    funk.rec_insert(None, pub, acct_build(123_456))
+    view = PipelineView(pipeline=_FakePipe(), funk=funk)
+    srv = RpcServer(view)
+    yield srv, pub
+    srv.close()
+
+
+def test_bencho_methods(server):
+    srv, pub = server
+    assert rpc_call(srv.addr, "getHealth")["result"] == "ok"
+    assert rpc_call(srv.addr, "getTransactionCount")["result"] == 120
+    assert rpc_call(srv.addr, "getSlot")["result"] == 42
+    r = rpc_call(srv.addr, "getBalance", [b58_encode(pub)])
+    assert r["result"]["value"] == 123_456
+    assert r["result"]["context"]["slot"] == 42
+
+
+def test_errors(server):
+    srv, _ = server
+    r = rpc_call(srv.addr, "getBlockProduction")
+    assert r["error"]["code"] == -32601
+    r = rpc_call(srv.addr, "getBalance")  # missing param
+    assert r["error"]["code"] == -32602
+    r = rpc_call(srv.addr, "getBalance", ["not-base58!!"])
+    assert r["error"]["code"] == -32603
+    # unknown account -> zero balance, not an error
+    other = hashlib.sha256(b"nobody").digest()
+    assert rpc_call(srv.addr, "getBalance", [b58_encode(other)])["result"][
+        "value"
+    ] == 0
+
+
+def test_bencho_style_rate_poll(server):
+    """The bencho loop: poll getTransactionCount twice, diff / dt."""
+    srv, _ = server
+    c1 = rpc_call(srv.addr, "getTransactionCount")["result"]
+    # pipeline commits more txns between polls
+    srv.view.pipeline.banks[0].metrics.inc("txn_exec", 30)
+    c2 = rpc_call(srv.addr, "getTransactionCount")["result"]
+    assert c2 - c1 == 30
