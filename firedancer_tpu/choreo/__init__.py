@@ -1,2 +1,4 @@
+from .forks import Fork, ForkError, Forks  # noqa: F401
 from .ghost import Ghost  # noqa: F401
 from .tower import MAX_LOCKOUT, SWITCH_PCT, THRESHOLD_DEPTH, THRESHOLD_PCT, Tower  # noqa: F401
+from .voter import Voter  # noqa: F401

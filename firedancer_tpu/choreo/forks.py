@@ -1,0 +1,124 @@
+"""Fork frontier: the set of active bank tips (choreo/forks layer).
+
+Counterpart of /root/reference/src/choreo/forks/fd_forks.h — the
+"frontier" of banks still being extended, keyed by slot.  Replay adds a
+child fork when a new slot's shreds complete, advances it after
+execution, and prunes everything not descending from the published root
+(the SMR): exactly how fd_forks coordinates with ghost/tower and funk's
+fork tree.
+
+Each fork carries the state downstream stages need to extend it:
+funk xid of the tip, bank hash, PoH hash — the triple replay threads
+through execute_block/replay_block.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Fork:
+    slot: int
+    parent_slot: int
+    xid: bytes | None = None          # funk fork id of the executed tip
+    bank_hash: bytes = b"\x00" * 32
+    poh_hash: bytes = b"\x00" * 32
+    frozen: bool = False              # executed + hashed; extendable
+
+
+class ForkError(RuntimeError):
+    pass
+
+
+class Forks:
+    def __init__(self, root_slot: int, *, root_xid: bytes | None = None,
+                 root_bank_hash: bytes = b"\x00" * 32):
+        root = Fork(root_slot, root_slot, xid=root_xid,
+                    bank_hash=root_bank_hash, frozen=True)
+        self._forks: dict[int, Fork] = {root_slot: root}
+        self._children: dict[int, list[int]] = {root_slot: []}
+        self.root_slot = root_slot
+
+    def __contains__(self, slot: int) -> bool:
+        return slot in self._forks
+
+    def get(self, slot: int) -> Fork:
+        f = self._forks.get(slot)
+        if f is None:
+            raise ForkError(f"unknown fork slot {slot}")
+        return f
+
+    def insert(self, slot: int, parent_slot: int) -> Fork:
+        """Register a new bank extending `parent_slot`.  The parent must
+        be frozen (you extend executed banks, not in-progress ones)."""
+        if slot in self._forks:
+            raise ForkError(f"fork {slot} already exists")
+        parent = self.get(parent_slot)
+        if not parent.frozen:
+            raise ForkError(f"parent {parent_slot} not frozen")
+        if slot <= parent_slot:
+            raise ForkError(f"child slot {slot} <= parent {parent_slot}")
+        f = Fork(slot, parent_slot)
+        self._forks[slot] = f
+        self._children.setdefault(parent_slot, []).append(slot)
+        self._children[slot] = []
+        return f
+
+    def freeze(self, slot: int, *, xid: bytes, bank_hash: bytes,
+               poh_hash: bytes) -> None:
+        """Record execution results; the fork becomes extendable."""
+        f = self.get(slot)
+        f.xid, f.bank_hash, f.poh_hash = xid, bank_hash, poh_hash
+        f.frozen = True
+
+    def frontier(self) -> list[Fork]:
+        """Leaf banks (no children): the candidate tips tower votes on."""
+        return [
+            self._forks[s]
+            for s, kids in self._children.items()
+            if not kids and self._forks[s].frozen
+        ]
+
+    def ancestors(self, slot: int) -> list[int]:
+        out = []
+        while slot != self.root_slot:
+            f = self._forks.get(slot)
+            if f is None:
+                break
+            slot = f.parent_slot
+            out.append(slot)
+        return out
+
+    def is_ancestor(self, a: int, b: int) -> bool:
+        """True if `a` is an ancestor of (or equal to) `b`."""
+        return a == b or a in self.ancestors(b)
+
+    def publish(self, new_root: int) -> list[int]:
+        """Advance the root to `new_root` (must descend from the current
+        root); prunes every fork not on the new root's subtree.  Returns
+        pruned slots — their funk forks get cancelled by the caller (the
+        fd_forks/funk_publish coordination in fd_replay.c:481-511)."""
+        self.get(new_root)
+        if not self.is_ancestor(self.root_slot, new_root):
+            raise ForkError(f"{new_root} does not descend from the root")
+        keep = {new_root} | set(self.ancestors(new_root))
+        stack = [new_root]
+        while stack:
+            s = stack.pop()
+            for c in self._children.get(s, []):
+                keep.add(c)
+                stack.append(c)
+        # ancestors of the new root are retired too (published into root)
+        retired = set(self.ancestors(new_root))
+        pruned = [
+            s for s in self._forks
+            if s not in keep or (s in retired and s != new_root)
+        ]
+        for s in pruned:
+            self._forks.pop(s, None)
+            self._children.pop(s, None)
+        for kids in self._children.values():
+            kids[:] = [c for c in kids if c in self._forks]
+        self.root_slot = new_root
+        return sorted(pruned)

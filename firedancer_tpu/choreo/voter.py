@@ -1,0 +1,72 @@
+"""Voter: turn tower decisions into signed vote transactions
+(choreo/voter, /root/reference/src/choreo/voter/fd_voter.h — vote-txn
+construction + authority tracking; the sender tile ships them to the
+leader's TPU).
+
+The voter owns the vote-authority keypair reference (via the keyguard
+sign stage — the secret itself never leaves the sign stage's role-gated
+holder, runtime/sign.py), tracks the vote account, and emits a
+protocol/txn vote transaction for each tower-approved slot.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from firedancer_tpu.choreo.tower import Tower
+from firedancer_tpu.protocol import txn as ft
+
+
+@dataclass
+class Voter:
+    vote_account: bytes
+    voter_pubkey: bytes
+    sign: object  # callable(payload: bytes) -> 64-byte signature
+    tower: Tower = field(default_factory=Tower)
+    last_sent: int | None = None
+
+    def maybe_vote(
+        self,
+        slot: int,
+        recent_blockhash: bytes,
+        *,
+        is_ancestor,
+        ghost_weight=None,
+        total_stake: int = 0,
+    ) -> bytes | None:
+        """Run the tower's safety checks for `slot`; on approval record
+        the vote and return the signed vote txn (None = abstain).
+
+        is_ancestor(a, b): fork-tree ancestry oracle (Forks.is_ancestor
+        or Ghost.is_ancestor).  ghost_weight+total_stake feed the
+        threshold check when provided (fd_tower's threshold rule needs
+        cluster stake context; without it only lockout safety runs).
+        """
+        if self.last_sent is not None and slot <= self.last_sent:
+            return None
+        if not self.tower.lockout_check(slot, is_ancestor):
+            return None
+        if ghost_weight is not None and total_stake > 0:
+            if not self.tower.threshold_check(
+                slot, ghost_weight, total_stake
+            ):
+                return None
+        self.tower.vote(slot)
+        self.last_sent = slot
+        payload = self._build(slot, recent_blockhash)
+        return payload
+
+    def _build(self, slot: int, recent_blockhash: bytes) -> bytes:
+        data = (1).to_bytes(4, "little") + slot.to_bytes(8, "little")
+        msg = ft.message_build(
+            version=ft.VLEGACY,
+            signature_cnt=1,
+            readonly_signed_cnt=0,
+            readonly_unsigned_cnt=1,
+            acct_addrs=[self.voter_pubkey, self.vote_account,
+                        ft.VOTE_PROGRAM],
+            recent_blockhash=recent_blockhash,
+            instrs=[ft.InstrSpec(program_id=2, accounts=bytes([1, 0]),
+                                 data=data)],
+        )
+        return ft.txn_assemble([self.sign(msg)], msg)

@@ -1,0 +1,433 @@
+"""Transaction executor: program dispatch, BPF serialization, CPI.
+
+Counterpart of /root/reference/src/flamenco/runtime/fd_executor.c (per-txn
+account loading + instruction dispatch) and the CPI syscall machinery in
+/root/reference/src/flamenco/vm/syscall/fd_vm_syscall_cpi.c.  The runtime
+(flamenco/runtime.py) calls `execute_txn_instrs` per transaction; each
+instruction resolves to either
+
+  - a *native program* registered by program id (system, vote, and the
+    stake program in flamenco/stake.py), a plain Python callable over the
+    instruction context; or
+  - an *sBPF program*: the program account's ELF is loaded
+    (protocol/sbpf.py), the instruction accounts are serialized into the
+    VM's input region in the BPF-loader "aligned" layout, the VM runs
+    (flamenco/vm.py), and account effects are deserialized back with
+    privilege + lamport-conservation checks.
+
+Cross-program invocation (`sol_invoke_signed_c`) re-enters this executor:
+the callee instruction is read out of VM memory, PDA signer seeds are
+resolved against the *caller's* program id (protocol/pda.py), privilege
+escalation is rejected (a callee account can be signer/writable only if
+the caller could already sign/write it), and on return the caller's
+serialized view of every shared account is refreshed — the same
+translate→invoke→sync shape as fd_vm_syscall_cpi_c.
+
+Account encoding in funk record values (grows the round-2 u64||data
+layout): `u64 lamports | 32B owner | u8 executable | data`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from firedancer_tpu.protocol import sbpf
+from firedancer_tpu.protocol.txn import SYSTEM_PROGRAM, VOTE_PROGRAM
+
+MAX_INSTR_STACK = 5  # Solana's max invoke stack height (top level = 1)
+MAX_PERMITTED_DATA_INCREASE = 10 * 1024
+MAX_CPI_INSTRUCTION_DATA_LEN = 10 * 1024
+MAX_CPI_ACCOUNT_INFOS = 128
+
+# well-known loader id: accounts owned by it with executable=1 hold sBPF
+# ELFs directly (the upgradeable-loader indirection is not modeled)
+BPF_LOADER_PROGRAM = b"BpfLoader2" + bytes(22)
+
+ACCT_HDR = 8 + 32 + 1  # lamports | owner | executable
+
+
+def acct_encode(lamports: int, owner: bytes = SYSTEM_PROGRAM,
+                executable: bool = False, data: bytes = b"") -> bytes:
+    assert len(owner) == 32
+    return (
+        lamports.to_bytes(8, "little") + owner + bytes([1 if executable else 0])
+        + data
+    )
+
+
+def acct_decode(val: bytes | None) -> tuple[int, bytes, bool, bytes]:
+    """-> (lamports, owner, executable, data); a missing/short record is
+    the zero account owned by the system program."""
+    if not val:
+        return 0, SYSTEM_PROGRAM, False, b""
+    if len(val) < ACCT_HDR:  # legacy u64||data records: data after lamports
+        return int.from_bytes(val[:8], "little"), SYSTEM_PROGRAM, False, val[8:]
+    return (
+        int.from_bytes(val[:8], "little"),
+        val[8:40],
+        val[40] != 0,
+        val[41:],
+    )
+
+
+@dataclass
+class Account:
+    key: bytes
+    lamports: int
+    owner: bytes
+    executable: bool
+    data: bytearray
+
+    @classmethod
+    def from_value(cls, key: bytes, val: bytes | None) -> "Account":
+        lam, owner, ex, data = acct_decode(val)
+        return cls(key, lam, owner, ex, bytearray(data))
+
+    def to_value(self) -> bytes:
+        return acct_encode(self.lamports, self.owner, self.executable,
+                          bytes(self.data))
+
+    @property
+    def exists(self) -> bool:
+        return self.lamports > 0 or len(self.data) > 0 or self.owner != SYSTEM_PROGRAM
+
+
+@dataclass
+class InstrAccount:
+    txn_idx: int
+    is_signer: bool
+    is_writable: bool
+
+
+class InstrError(Exception):
+    """Typed instruction failure; aborts the transaction (fee still paid)."""
+
+    def __init__(self, msg: str, custom: int | None = None):
+        super().__init__(msg)
+        self.custom = custom
+
+
+@dataclass
+class TxnCtx:
+    """Per-transaction execution context: the unique account set with
+    txn-level privileges, the shared compute budget, the invoke stack."""
+
+    accounts: list[Account]
+    signer: list[bool]
+    writable: list[bool]
+    budget: int = 200_000
+    cu_used: int = 0
+    logs: list[bytes] = field(default_factory=list)
+    stack: list[bytes] = field(default_factory=list)  # program ids
+    return_data: tuple[bytes, bytes] = (bytes(32), b"")
+
+    def charge(self, n: int) -> None:
+        self.cu_used += n
+        if self.cu_used > self.budget:
+            raise InstrError(f"compute budget exceeded ({self.budget})")
+
+    def index_of(self, key: bytes) -> int | None:
+        for i, a in enumerate(self.accounts):
+            if a.key == key:
+                return i
+        return None
+
+
+class Executor:
+    """Program registry + instruction dispatch."""
+
+    def __init__(self):
+        from firedancer_tpu.flamenco import programs, stake
+
+        self.native = {
+            SYSTEM_PROGRAM: programs.system_program,
+            VOTE_PROGRAM: programs.vote_program,
+            stake.STAKE_PROGRAM: stake.stake_program,
+        }
+
+    def register(self, program_id: bytes, fn) -> None:
+        self.native[program_id] = fn
+
+    def execute_instr(
+        self,
+        ctx: TxnCtx,
+        program_id: bytes,
+        iaccts: list[InstrAccount],
+        data: bytes,
+        *,
+        pda_signers: frozenset[bytes] = frozenset(),
+    ) -> None:
+        if len(ctx.stack) >= MAX_INSTR_STACK:
+            raise InstrError("max instruction stack depth")
+        ctx.stack.append(program_id)
+        uniq = {ia.txn_idx for ia in iaccts}
+        lam_before = sum(ctx.accounts[i].lamports for i in uniq)
+        try:
+            fn = self.native.get(program_id)
+            if fn is not None:
+                fn(self, ctx, program_id, iaccts, data,
+                   pda_signers=pda_signers)
+            else:
+                prog_idx = ctx.index_of(program_id)
+                if prog_idx is None:
+                    return  # unknown program not present: no-op (pre-VM parity)
+                pacct = ctx.accounts[prog_idx]
+                if not pacct.executable or pacct.owner != BPF_LOADER_PROGRAM:
+                    return  # data account as program target: no-op
+                self._execute_bpf(ctx, pacct, program_id, iaccts, data,
+                                  pda_signers)
+            # instruction-level lamport conservation over the UNIQUE
+            # account set (duplicate metas are legal and must not double-
+            # count; fd_executor's sum check)
+            lam_after = sum(ctx.accounts[i].lamports for i in uniq)
+            if lam_after != lam_before:
+                raise InstrError(
+                    f"lamport sum changed {lam_before} -> {lam_after}"
+                )
+        finally:
+            ctx.stack.pop()
+
+    # -- sBPF dispatch --------------------------------------------------------
+
+    def _execute_bpf(self, ctx, pacct, program_id, iaccts, data, pda_signers):
+        from firedancer_tpu.flamenco import vm as fvm
+
+        try:
+            prog = sbpf.load(bytes(pacct.data))
+        except sbpf.SbpfError as e:
+            raise InstrError(f"program load failed: {e}") from e
+        blob, smap = serialize_aligned(ctx, iaccts, data, program_id)
+        v = fvm.Vm(program=prog, input_data=blob,
+                   budget=ctx.budget - ctx.cu_used)
+        fvm.register_default_syscalls(v, log_sink=ctx.logs)
+        register_cpi_syscall(self, v, ctx, iaccts, program_id, smap,
+                             pda_signers)
+        try:
+            r0 = v.run()
+        except fvm.VmError as e:
+            ctx.cu_used += min(v.cu_used, ctx.budget - ctx.cu_used)
+            raise InstrError(f"vm error: {e}") from e
+        ctx.cu_used += v.cu_used
+        if ctx.cu_used > ctx.budget:
+            ctx.cu_used = ctx.budget
+            raise InstrError("compute budget exceeded")
+        if r0 != 0:
+            raise InstrError(f"program error 0x{r0:x}", custom=r0)
+        writeback_aligned(ctx, v, smap, program_id)
+
+
+# -- BPF loader "aligned" account serialization -------------------------------
+#
+# Layout per unique account (dups reference the first occurrence):
+#   u8 0xFF | u8 is_signer | u8 is_writable | u8 executable | 4B pad |
+#   32B key | 32B owner | u64 lamports | u64 data_len | data |
+#   MAX_PERMITTED_DATA_INCREASE spare | pad to 8 | u64 rent_epoch
+# then u64 instr_data_len | instr_data | 32B program_id.
+
+
+@dataclass
+class SerialEntry:
+    txn_idx: int
+    lamports_off: int
+    owner_off: int
+    data_len_off: int
+    data_off: int
+    orig_data_len: int
+    writable: bool
+
+
+def serialize_aligned(
+    ctx: TxnCtx, iaccts: list[InstrAccount], data: bytes, program_id: bytes
+) -> tuple[bytes, list[SerialEntry]]:
+    out = bytearray()
+    out += len(iaccts).to_bytes(8, "little")
+    seen: dict[int, int] = {}  # txn_idx -> serial position
+    smap: list[SerialEntry] = []
+    for pos, ia in enumerate(iaccts):
+        if ia.txn_idx in seen:
+            out += bytes([seen[ia.txn_idx]]) + bytes(7)
+            continue
+        seen[ia.txn_idx] = pos
+        a = ctx.accounts[ia.txn_idx]
+        out += bytes([0xFF, 1 if ia.is_signer else 0,
+                      1 if ia.is_writable else 0, 1 if a.executable else 0])
+        out += bytes(4)
+        out += a.key
+        owner_off = len(out)
+        out += a.owner
+        lam_off = len(out)
+        out += a.lamports.to_bytes(8, "little")
+        dlen_off = len(out)
+        out += len(a.data).to_bytes(8, "little")
+        d_off = len(out)
+        out += bytes(a.data)
+        out += bytes(MAX_PERMITTED_DATA_INCREASE)
+        pad = (-len(out)) % 8
+        out += bytes(pad)
+        out += (0).to_bytes(8, "little")  # rent_epoch
+        smap.append(SerialEntry(ia.txn_idx, lam_off, owner_off, dlen_off,
+                                d_off, len(a.data), ia.is_writable))
+    out += len(data).to_bytes(8, "little")
+    out += data
+    out += program_id
+    return bytes(out), smap
+
+
+def writeback_aligned(ctx: TxnCtx, v, smap: list[SerialEntry],
+                      program_id: bytes) -> None:
+    """Deserialize account effects out of the VM input region.  Only
+    writable accounts read back; data growth is capped at
+    MAX_PERMITTED_DATA_INCREASE over the serialized length; and the
+    owner-may-debit/modify rule holds (fd_executor's account checks): a
+    program may credit any writable account, but debiting lamports,
+    changing data, or reassigning the owner requires owning it."""
+    region = v.regions[3].data  # input region backing store
+    for e in smap:
+        if not e.writable:
+            continue
+        a = ctx.accounts[e.txn_idx]
+        owns = a.owner == program_id
+        new_lam = int.from_bytes(region[e.lamports_off : e.lamports_off + 8],
+                                 "little")
+        new_owner = bytes(region[e.owner_off : e.owner_off + 32])
+        new_len = int.from_bytes(
+            region[e.data_len_off : e.data_len_off + 8], "little"
+        )
+        if new_len > e.orig_data_len + MAX_PERMITTED_DATA_INCREASE:
+            raise InstrError(
+                f"account data grew past the permitted increase ({new_len})"
+            )
+        new_data = bytearray(region[e.data_off : e.data_off + new_len])
+        if not owns:
+            if new_lam < a.lamports:
+                raise InstrError("program debited an account it does not own")
+            if new_owner != a.owner:
+                raise InstrError("program reassigned a foreign account")
+            if new_data != a.data:
+                raise InstrError("program modified foreign account data")
+        a.lamports = new_lam
+        a.owner = new_owner
+        a.data = new_data
+
+
+def sync_into_vm(ctx: TxnCtx, v, smap: list[SerialEntry]) -> None:
+    """Refresh the caller VM's serialized view after a CPI returns
+    (lamports/owner/data of shared accounts may have changed)."""
+    region = v.regions[3].data
+    for e in smap:
+        a = ctx.accounts[e.txn_idx]
+        region[e.lamports_off : e.lamports_off + 8] = a.lamports.to_bytes(
+            8, "little"
+        )
+        region[e.owner_off : e.owner_off + 32] = a.owner
+        cap = e.orig_data_len + MAX_PERMITTED_DATA_INCREASE
+        if len(a.data) > cap:
+            raise InstrError("callee grew account past caller's capacity")
+        region[e.data_len_off : e.data_len_off + 8] = len(a.data).to_bytes(
+            8, "little"
+        )
+        region[e.data_off : e.data_off + len(a.data)] = a.data
+        # zero the tail so stale caller bytes don't leak past the new length
+        region[e.data_off + len(a.data) : e.data_off + cap] = bytes(
+            cap - len(a.data)
+        )
+
+
+# -- CPI: sol_invoke_signed_c -------------------------------------------------
+#
+# C ABI structs read out of VM memory (fd_vm_syscall_cpi.c's C path):
+#   SolInstruction  { u64 program_id_addr; u64 accounts_addr; u64 accounts_len;
+#                     u64 data_addr; u64 data_len; }
+#   SolAccountMeta  { u64 pubkey_addr; u8 is_writable; u8 is_signer; }
+#   SolSignerSeedsC { u64 addr; u64 len; }  of  SolSignerSeedC { addr; len; }
+
+
+def register_cpi_syscall(executor, v, ctx, caller_iaccts, caller_program_id,
+                         smap, caller_pda_signers):
+    from firedancer_tpu.flamenco import vm as fvm
+    from firedancer_tpu.protocol import pda
+
+    caller_priv: dict[int, InstrAccount] = {}
+    for ia in caller_iaccts:
+        cur = caller_priv.get(ia.txn_idx)
+        if cur is None:
+            caller_priv[ia.txn_idx] = InstrAccount(
+                ia.txn_idx, ia.is_signer, ia.is_writable
+            )
+        else:  # privileges union over duplicate listings
+            cur.is_signer |= ia.is_signer
+            cur.is_writable |= ia.is_writable
+
+    def sol_invoke_signed_c(vm_, instr_addr, _infos_addr, infos_len,
+                            seeds_addr, seeds_len):
+        vm_.charge(fvm.SYSCALL_BASE_COST * 10)
+        if infos_len > MAX_CPI_ACCOUNT_INFOS:
+            raise fvm.VmError("too many account infos")
+        prog_addr = vm_.mem_read(instr_addr, 8)
+        metas_addr = vm_.mem_read(instr_addr + 8, 8)
+        metas_len = vm_.mem_read(instr_addr + 16, 8)
+        data_addr = vm_.mem_read(instr_addr + 24, 8)
+        data_len = vm_.mem_read(instr_addr + 32, 8)
+        if data_len > MAX_CPI_INSTRUCTION_DATA_LEN:
+            raise fvm.VmError("cpi instruction data too long")
+        callee_prog = vm_.mem_read_bytes(prog_addr, 32)
+        data = vm_.mem_read_bytes(data_addr, data_len) if data_len else b""
+
+        # PDA signers: seeds sign for addresses derived from the CALLER
+        pda_signers = set(caller_pda_signers)
+        for i in range(seeds_len):
+            arr_addr = vm_.mem_read(seeds_addr + 16 * i, 8)
+            arr_len = vm_.mem_read(seeds_addr + 16 * i + 8, 8)
+            if arr_len > pda.MAX_SEEDS:
+                raise fvm.VmError("too many signer seeds")
+            seeds = []
+            for j in range(arr_len):
+                s_addr = vm_.mem_read(arr_addr + 16 * j, 8)
+                s_len = vm_.mem_read(arr_addr + 16 * j + 8, 8)
+                if s_len > pda.MAX_SEED_LEN:
+                    raise fvm.VmError("signer seed too long")
+                seeds.append(vm_.mem_read_bytes(s_addr, s_len))
+            try:
+                pda_signers.add(
+                    pda.create_program_address(seeds, caller_program_id)
+                )
+            except pda.PdaError as e:
+                raise fvm.VmError(f"bad signer seeds: {e}") from e
+
+        # translate metas -> instruction accounts with privilege checks
+        iaccts: list[InstrAccount] = []
+        for i in range(metas_len):
+            m_addr = metas_addr + 10 * i  # packed C layout: u64 + u8 + u8
+            pk_addr = vm_.mem_read(m_addr, 8)
+            m_writable = vm_.mem_read(m_addr + 8, 1) != 0
+            m_signer = vm_.mem_read(m_addr + 9, 1) != 0
+            key = vm_.mem_read_bytes(pk_addr, 32)
+            idx = ctx.index_of(key)
+            if idx is None:
+                raise fvm.VmError("cpi account not in transaction")
+            prv = caller_priv.get(idx)
+            may_sign = (prv is not None and prv.is_signer) or key in pda_signers
+            may_write = prv is not None and prv.is_writable
+            if m_signer and not may_sign:
+                raise fvm.VmError("cpi signer privilege escalation")
+            if m_writable and not may_write:
+                raise fvm.VmError("cpi writable privilege escalation")
+            iaccts.append(InstrAccount(idx, m_signer, m_writable))
+
+        # the program may have mutated its serialized accounts before the
+        # CPI — pull the current state into ctx first (same owner rules)
+        writeback_aligned(ctx, vm_, smap, caller_program_id)
+        ctx.cu_used += vm_.cu_used  # budget is shared across the stack
+        try:
+            executor.execute_instr(
+                ctx, callee_prog, iaccts, data,
+                pda_signers=frozenset(pda_signers),
+            )
+        except InstrError as e:
+            raise fvm.VmError(f"cpi failed: {e}") from e
+        finally:
+            ctx.cu_used -= vm_.cu_used
+            sync_into_vm(ctx, vm_, smap)
+        return 0
+
+    v.syscalls[fvm.SYSCALL_SOL_INVOKE_SIGNED_C] = sol_invoke_signed_c

@@ -1,0 +1,56 @@
+"""Feature gates (counterpart of the reference's generated
+fd_features.h table, /root/reference/src/flamenco/features/).
+
+A feature is a named gate identified by a 32-byte id (here: sha256 of
+the name, deterministic without an external registry) that activates at
+a recorded slot.  Runtime code queries `features.is_active(name, slot)`
+to pick behavior; the set is carried on the bank/epoch context and can
+be extended at genesis or via feature accounts.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+
+U64_MAX = (1 << 64) - 1
+
+
+def feature_id(name: str) -> bytes:
+    return hashlib.sha256(b"feature:" + name.encode()).digest()
+
+
+# the default gate table: every known feature starts inactive
+KNOWN_FEATURES = (
+    "stake_warmup_cooldown",
+    "strict_ed25519_verify",
+    "blake3_account_hash",
+    "cpi_account_data_growth",
+    "vote_state_credits",
+    "fee_burn_half",
+)
+
+
+@dataclass
+class FeatureSet:
+    """name -> activation slot (U64_MAX = never)."""
+
+    activated: dict[str, int] = field(default_factory=dict)
+
+    @classmethod
+    def all_enabled(cls) -> "FeatureSet":
+        return cls({n: 0 for n in KNOWN_FEATURES})
+
+    def activate(self, name: str, slot: int) -> None:
+        if name not in KNOWN_FEATURES:
+            raise KeyError(f"unknown feature {name!r}")
+        cur = self.activated.get(name, U64_MAX)
+        self.activated[name] = min(cur, slot)
+
+    def is_active(self, name: str, slot: int) -> bool:
+        return self.activated.get(name, U64_MAX) <= slot
+
+    def ids(self) -> dict[bytes, int]:
+        """Account-keyed view (feature accounts hold the activation
+        slot on chain; this is the id -> slot projection)."""
+        return {feature_id(n): s for n, s in self.activated.items()}

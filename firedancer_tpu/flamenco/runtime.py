@@ -16,11 +16,11 @@ accounts-delta hash sums every modified account's lattice hash in ONE
 device reduction (ops/lthash.combine_device) instead of a sequential
 accumulation.
 
-Account model (host, the VM/native-program surface grows in place):
-value bytes = u64 lamports LE || opaque data.  Implemented programs:
-the system program transfer (the bank stage's stub grows up here into
-fee charging + failure semantics: a failed txn still pays its fee,
-errors never abort the block).
+Account model: funk value bytes = `u64 lamports | 32B owner |
+u8 executable | data` (executor.acct_encode/decode).  Program dispatch
+goes through flamenco/executor.py — native programs (system, vote,
+stake) plus sBPF programs with CPI; a failed txn still pays its fee,
+errors never abort the block.
 """
 
 from __future__ import annotations
@@ -30,10 +30,19 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from firedancer_tpu.flamenco import executor as fexec
+from firedancer_tpu.flamenco.executor import (
+    Account,
+    Executor,
+    InstrAccount,
+    InstrError,
+    TxnCtx,
+    acct_decode,
+    acct_encode,
+)
 from firedancer_tpu.funk import Funk
 from firedancer_tpu.ops import lthash as lt
 from firedancer_tpu.protocol import txn as ft
-from firedancer_tpu.protocol.txn import VOTE_PROGRAM
 
 LAMPORTS_PER_SIGNATURE = 5000
 
@@ -42,14 +51,17 @@ TXN_ERR_FEE = -1                 # payer cannot cover the fee: txn dropped
 TXN_ERR_INSUFFICIENT_FUNDS = -2  # program failed: fee charged, no effects
 TXN_ERR_ACCT = -3                # unresolvable account index (ALT accounts
                                  # need the address-resolution stage)
+TXN_ERR_PROGRAM = -4             # program/VM error: fee charged, no effects
 
 
 def acct_lamports(val: bytes | None) -> int:
-    return int.from_bytes(val[:8], "little") if val else 0
+    return acct_decode(val)[0]
 
 
-def acct_build(lamports: int, data: bytes = b"") -> bytes:
-    return lamports.to_bytes(8, "little") + data
+def acct_build(lamports: int, data: bytes = b"",
+               owner: bytes = ft.SYSTEM_PROGRAM,
+               executable: bool = False) -> bytes:
+    return acct_encode(lamports, owner, executable, data)
 
 
 @dataclass
@@ -112,98 +124,82 @@ def generate_waves(txns: list[tuple[bytes, ft.Txn]]) -> list[list[int]]:
     return waves
 
 
-def _execute_txn(funk: Funk, xid: bytes, payload: bytes, desc: ft.Txn) -> TxnResult:
+_DEFAULT_EXECUTOR: Executor | None = None
+
+
+def default_executor() -> Executor:
+    global _DEFAULT_EXECUTOR
+    if _DEFAULT_EXECUTOR is None:
+        _DEFAULT_EXECUTOR = Executor()
+    return _DEFAULT_EXECUTOR
+
+
+def _execute_txn(
+    funk: Funk, xid: bytes, payload: bytes, desc: ft.Txn,
+    executor: Executor | None = None,
+) -> TxnResult:
+    from firedancer_tpu.flamenco.programs import AcctError, FundsError
+
+    executor = executor or default_executor()
     addrs = desc.acct_addrs(payload)
+    if len(set(addrs)) != len(addrs):
+        # AccountLoadedTwice analog: duplicate addresses would load as
+        # independent copies — stale reads + lamport mint/burn at commit
+        return TxnResult(TXN_ERR_ACCT, 0)
     payer = addrs[0]
     fee = LAMPORTS_PER_SIGNATURE * desc.signature_cnt
     payer_val = funk.rec_query(xid, payer)
     if acct_lamports(payer_val) < fee:
         return TxnResult(TXN_ERR_FEE, 0)
-    # charge the fee unconditionally (failed txns still pay, fd_executor)
-    funk.rec_insert(
-        xid, payer, acct_build(acct_lamports(payer_val) - fee, (payer_val or b"")[8:])
-    )
+    # charge the fee unconditionally (failed txns still pay, fd_executor);
+    # written straight to funk so program failure cannot roll it back
+    plam, powner, pex, pdata = acct_decode(payer_val)
+    funk.rec_insert(xid, payer, acct_encode(plam - fee, powner, pex, pdata))
 
-    # snapshot for rollback of program effects (fee stays charged)
-    touched = {a for a in addrs}
-    before = {a: funk.rec_query(xid, a) for a in touched}
-
-    def _mk_fail(status):
-        for a, v in before.items():
-            if funk.rec_query(xid, a) != v:
-                if v is None:
-                    funk.rec_remove(xid, a)
-                else:
-                    funk.rec_insert(xid, a, v)
-        return TxnResult(status, fee)
+    # load the unique account set into host objects; program effects land
+    # in funk only at commit, so failure = skip the writeback (fee stays)
+    accounts = [
+        Account.from_value(a, funk.rec_query(xid, a)) for a in addrs
+    ]
+    signer = [i < desc.signature_cnt for i in range(len(addrs))]
+    writable = [desc.is_writable(i) for i in range(len(addrs))]
+    baseline = [a.to_value() for a in accounts]
+    ctx = TxnCtx(accounts=accounts, signer=signer, writable=writable)
 
     for ins in desc.instrs:
+        if ins.program_id >= len(addrs):
+            return TxnResult(TXN_ERR_ACCT, fee)
         prog = addrs[ins.program_id]
-        if prog == VOTE_PROGRAM:
-            # the vote native program: record the vote on the vote account
-            # (data = u64 last_voted_slot | u64 vote_count; feeds tower/
-            # ghost via the caller).  Instruction: u32 tag=1 | u64 slot.
-            data = payload[ins.data_off : ins.data_off + ins.data_sz]
-            idx = payload[ins.acct_off : ins.acct_off + ins.acct_cnt]
-            if (
-                len(data) < 12
-                or int.from_bytes(data[:4], "little") != 1
-                or len(idx) < 1
-            ):
-                continue
-            if idx[0] >= len(addrs):
-                return _mk_fail(TXN_ERR_ACCT)
-            if not desc.is_writable(idx[0]):
-                # writes must go through accounts the wave generator SAW
-                # as writable, or concurrent wave execution diverges from
-                # serial order
-                return _mk_fail(TXN_ERR_ACCT)
-            vote_slot = int.from_bytes(data[4:12], "little")
-            acct = addrs[idx[0]]
-            cur = funk.rec_query(xid, acct)
-            cnt = int.from_bytes((cur or bytes(24))[16:24], "little")
-            lam = acct_lamports(cur)
-            funk.rec_insert(
-                xid,
-                acct,
-                acct_build(
-                    lam,
-                    vote_slot.to_bytes(8, "little")
-                    + (cnt + 1).to_bytes(8, "little"),
-                ),
-            )
-            continue
-        if prog != ft.SYSTEM_PROGRAM:
-            continue  # unknown programs: no-op (the VM is a later layer)
         data = payload[ins.data_off : ins.data_off + ins.data_sz]
-        if len(data) < 12 or int.from_bytes(data[:4], "little") != 2:
-            continue
-        lamports = int.from_bytes(data[4:12], "little")
         idx = payload[ins.acct_off : ins.acct_off + ins.acct_cnt]
-        if len(idx) < 2:
-            continue
-        if idx[0] >= len(addrs) or idx[1] >= len(addrs):
+        if any(i >= len(addrs) for i in idx):
             # ALT-loaded index: unresolvable until the address-resolution
             # stage exists — a typed failure, never an abort of the block
-            return _mk_fail(TXN_ERR_ACCT)
-        if not (desc.is_writable(idx[0]) and desc.is_writable(idx[1])):
-            # transfers mutate both accounts; a readonly flag would hide
-            # the write from the conflict-wave generator
-            return _mk_fail(TXN_ERR_ACCT)
-        src, dst = addrs[idx[0]], addrs[idx[1]]
-        sv = funk.rec_query(xid, src)
-        if acct_lamports(sv) < lamports:
-            return _mk_fail(TXN_ERR_INSUFFICIENT_FUNDS)
-        if src == dst:
-            continue  # self-transfer: a no-op, NOT a mint (stale-read trap)
-        funk.rec_insert(
-            xid, src, acct_build(acct_lamports(sv) - lamports, (sv or b"")[8:])
-        )
-        dv = funk.rec_query(xid, dst)  # read AFTER the src write (src may
-        # alias dst through future program semantics; order is the rule)
-        funk.rec_insert(
-            xid, dst, acct_build(acct_lamports(dv) + lamports, (dv or b"")[8:])
-        )
+            return TxnResult(TXN_ERR_ACCT, fee)
+        iaccts = [InstrAccount(i, signer[i], writable[i]) for i in idx]
+        try:
+            executor.execute_instr(ctx, prog, iaccts, data)
+        except FundsError:
+            return TxnResult(TXN_ERR_INSUFFICIENT_FUNDS, fee)
+        except AcctError:
+            return TxnResult(TXN_ERR_ACCT, fee)
+        except InstrError:
+            return TxnResult(TXN_ERR_PROGRAM, fee)
+
+    # commit: writes may only land on accounts the wave generator saw as
+    # writable, or concurrent wave execution diverges from serial order.
+    # Validate EVERYTHING before the first insert — a partial commit
+    # would break the "fee charged, no effects" failure contract.
+    changed = []
+    for i, a in enumerate(accounts):
+        val = a.to_value()
+        if val == baseline[i]:
+            continue
+        if not writable[i]:
+            return TxnResult(TXN_ERR_ACCT, fee)
+        changed.append((a.key, val))
+    for key, val in changed:
+        funk.rec_insert(xid, key, val)
     return TxnResult(TXN_SUCCESS, fee)
 
 

@@ -1,0 +1,302 @@
+"""Stake program + epoch stakes/rewards (flamenco/runtime/program/
+fd_stake_program.c and the stakes/rewards subsystem fd_stakes.c /
+fd_rewards.c counterparts).
+
+Stake account data layout (this framework's own fixed encoding):
+
+    u32 state      0 = uninitialized, 1 = initialized, 2 = delegated
+    32B staker     authority allowed to delegate/deactivate
+    32B withdrawer authority allowed to withdraw
+    32B voter      vote account delegated to (state 2)
+    u64 stake      delegated lamports
+    u64 activation_epoch    (state 2; UINT64_MAX = not yet)
+    u64 deactivation_epoch  (UINT64_MAX = active)
+
+Activation/deactivation follow the protocol's warmup/cooldown ramp: at
+most WARMUP_RATE (25%) of the cluster's total effective stake may
+activate or deactivate per epoch boundary; `effective_stake` walks the
+epochs from activation to the target epoch applying the ramp — the same
+history-walk the reference does against fd_stake_history (simplified to
+a uniform per-account fraction, no per-epoch cluster history record).
+
+Rewards: `epoch_rewards` distributes an inflation pot over (stake ×
+vote-credits) points, the fd_rewards.c shape: each stake account earns
+pot * its_points / total_points, paid onto the stake account and
+auto-compounded into the delegation.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from firedancer_tpu.flamenco.executor import InstrError
+from firedancer_tpu.flamenco.programs import AcctError, FundsError, _u32, _u64
+
+STAKE_PROGRAM = b"Stake11111" + bytes(22)
+
+U64_MAX = (1 << 64) - 1
+WARMUP_DIV = 4  # a quarter of delegated stake (de)activates per epoch
+
+STATE_UNINIT = 0
+STATE_INIT = 1
+STATE_DELEGATED = 2
+
+_DATA_LEN = 4 + 32 * 3 + 8 * 3
+
+
+@dataclass
+class StakeState:
+    state: int = STATE_UNINIT
+    staker: bytes = bytes(32)
+    withdrawer: bytes = bytes(32)
+    voter: bytes = bytes(32)
+    stake: int = 0
+    activation_epoch: int = U64_MAX
+    deactivation_epoch: int = U64_MAX
+
+    def encode(self) -> bytes:
+        return (
+            self.state.to_bytes(4, "little")
+            + self.staker
+            + self.withdrawer
+            + self.voter
+            + self.stake.to_bytes(8, "little")
+            + self.activation_epoch.to_bytes(8, "little")
+            + self.deactivation_epoch.to_bytes(8, "little")
+        )
+
+    @classmethod
+    def decode(cls, data: bytes) -> "StakeState":
+        if len(data) < _DATA_LEN:
+            return cls()
+        return cls(
+            state=_u32(data),
+            staker=data[4:36],
+            withdrawer=data[36:68],
+            voter=data[68:100],
+            stake=_u64(data[100:]),
+            activation_epoch=_u64(data[108:]),
+            deactivation_epoch=_u64(data[116:]),
+        )
+
+
+def effective_stake(st: StakeState, epoch: int) -> int:
+    """Delegated lamports counted at `epoch`, after the warmup/cooldown
+    ramp.  Full stake takes 1/WARMUP_RATE epoch boundaries.  Integer
+    arithmetic throughout — this value feeds consensus (leader schedule,
+    rewards), so float rounding above 2^53 lamports is unacceptable."""
+    if st.state != STATE_DELEGATED or epoch < st.activation_epoch:
+        return 0
+    # warmup: a quarter of the target per boundary crossed since activation
+    boundaries = epoch - st.activation_epoch
+    eff = min(st.stake, st.stake * boundaries // WARMUP_DIV)
+    if st.deactivation_epoch != U64_MAX and epoch >= st.deactivation_epoch:
+        gone = st.stake * (epoch - st.deactivation_epoch) // WARMUP_DIV
+        eff = max(0, eff - gone)
+    return eff
+
+
+def locked_stake(st: StakeState, epoch: int) -> int:
+    """Lamports a Withdraw may NOT touch: the whole delegation while it
+    is active or warming up (warming stake is committed even though not
+    yet effective — otherwise freshly delegated lamports could be
+    withdrawn leaving phantom stake in the epoch snapshots), ramping to
+    zero through cooldown after deactivation."""
+    if st.state != STATE_DELEGATED:
+        return 0
+    if st.deactivation_epoch == U64_MAX or epoch < st.deactivation_epoch:
+        return st.stake
+    released = st.stake * (epoch - st.deactivation_epoch) // WARMUP_DIV
+    return max(0, st.stake - released)
+
+
+# -- the stake native program -------------------------------------------------
+# instruction tags: 0 Initialize{staker,withdrawer} | 1 Delegate |
+# 2 Deactivate | 3 Withdraw{lamports} | 4 Split{lamports}
+
+
+def stake_program(executor, ctx, program_id, iaccts, data, *, pda_signers):
+    if len(data) < 4:
+        return
+    tag = _u32(data)
+
+    def acct(i, *, owned: bool = True):
+        if i >= len(iaccts):
+            raise AcctError(f"stake instr needs account {i}")
+        a = ctx.accounts[iaccts[i].txn_idx]
+        if owned and a.owner != STAKE_PROGRAM:
+            # the owner-may-modify/debit rule: the stake program only
+            # touches its own accounts (blocks draining foreign accounts
+            # through the uninitialized-state paths)
+            raise AcctError(f"account {i} not owned by the stake program")
+        return a
+
+    def signed_by(key: bytes) -> bool:
+        for ia in iaccts:
+            if ctx.accounts[ia.txn_idx].key == key and (
+                ia.is_signer
+                or ctx.accounts[ia.txn_idx].key in pda_signers
+            ):
+                return True
+        return False
+
+    def need_writable(i):
+        if not iaccts[i].is_writable:
+            raise AcctError(f"stake account {i} not writable")
+
+    if tag == 0:  # Initialize { staker 32 | withdrawer 32 }
+        if len(data) < 4 + 64:
+            raise AcctError("malformed stake initialize")
+        a = acct(0)
+        need_writable(0)
+        st = StakeState.decode(bytes(a.data))
+        if st.state != STATE_UNINIT:
+            raise AcctError("stake account already initialized")
+        if len(a.data) < _DATA_LEN:
+            raise AcctError("stake account too small")
+        st = StakeState(
+            state=STATE_INIT, staker=data[4:36], withdrawer=data[36:68]
+        )
+        a.data[:_DATA_LEN] = st.encode()
+    elif tag == 1:  # Delegate { epoch u64 }; accounts: [stake, vote]
+        if len(data) < 12:
+            raise AcctError("malformed delegate")
+        epoch = _u64(data[4:])
+        a, vote = acct(0), acct(1, owned=False)
+        need_writable(0)
+        st = StakeState.decode(bytes(a.data))
+        if st.state == STATE_UNINIT:
+            raise AcctError("delegate of uninitialized stake")
+        if not signed_by(st.staker):
+            raise AcctError("delegate missing staker signature")
+        st.state = STATE_DELEGATED
+        st.voter = vote.key
+        st.stake = a.lamports  # whole balance delegates (rent exempt 0 here)
+        st.activation_epoch = epoch
+        st.deactivation_epoch = U64_MAX
+        a.data[:_DATA_LEN] = st.encode()
+    elif tag == 2:  # Deactivate { epoch u64 }
+        if len(data) < 12:
+            raise AcctError("malformed deactivate")
+        epoch = _u64(data[4:])
+        a = acct(0)
+        need_writable(0)
+        st = StakeState.decode(bytes(a.data))
+        if st.state != STATE_DELEGATED:
+            raise AcctError("deactivate of undelegated stake")
+        if not signed_by(st.staker):
+            raise AcctError("deactivate missing staker signature")
+        st.deactivation_epoch = epoch
+        a.data[:_DATA_LEN] = st.encode()
+    elif tag == 3:  # Withdraw { lamports u64, epoch u64 }; [stake, dest]
+        if len(data) < 20:
+            raise AcctError("malformed withdraw")
+        lamports = _u64(data[4:])
+        epoch = _u64(data[12:])
+        a, dest = acct(0), acct(1, owned=False)
+        need_writable(0)
+        need_writable(1)
+        st = StakeState.decode(bytes(a.data))
+        if st.state == STATE_UNINIT:
+            # an uninitialized stake account withdraws under its OWN key
+            if not signed_by(a.key):
+                raise AcctError("withdraw missing stake-account signature")
+        elif not signed_by(st.withdrawer):
+            raise AcctError("withdraw missing withdrawer signature")
+        locked = locked_stake(st, epoch)
+        if a.lamports - locked < lamports:
+            raise FundsError(
+                f"withdraw {lamports} exceeds free balance "
+                f"({a.lamports} - {locked} locked)"
+            )
+        if a.key == dest.key:
+            return
+        a.lamports -= lamports
+        dest.lamports += lamports
+    elif tag == 4:  # Split { lamports u64 }; [stake, new_stake]
+        if len(data) < 12:
+            raise AcctError("malformed split")
+        lamports = _u64(data[4:])
+        a, new = acct(0), acct(1)
+        need_writable(0)
+        need_writable(1)
+        st = StakeState.decode(bytes(a.data))
+        if st.state != STATE_DELEGATED:
+            raise AcctError("split of undelegated stake")
+        if not signed_by(st.staker):
+            raise AcctError("split missing staker signature")
+        if lamports > st.stake or lamports > a.lamports:
+            raise FundsError("split larger than delegation")
+        if len(new.data) < _DATA_LEN:
+            raise AcctError("split target too small")
+        nst = StakeState.decode(bytes(new.data))
+        if nst.state != STATE_UNINIT:
+            raise AcctError("split target already in use")
+        st.stake -= lamports
+        a.lamports -= lamports
+        a.data[:_DATA_LEN] = st.encode()
+        new.lamports += lamports
+        nst = StakeState(
+            state=STATE_DELEGATED, staker=st.staker,
+            withdrawer=st.withdrawer, voter=st.voter, stake=lamports,
+            activation_epoch=st.activation_epoch,
+            deactivation_epoch=st.deactivation_epoch,
+        )
+        new.data[:_DATA_LEN] = nst.encode()
+    # other tags: no-op
+
+
+# -- epoch stakes + rewards ---------------------------------------------------
+
+
+@dataclass
+class StakeEntry:
+    stake_key: bytes
+    state: StakeState
+
+
+def collect_stakes(entries: list[StakeEntry], epoch: int) -> dict[bytes, int]:
+    """voter pubkey -> total effective stake at `epoch` (the per-epoch
+    snapshot fd_stakes.c maintains; feeds the leader schedule via
+    protocol/wsample.epoch_leaders)."""
+    out: dict[bytes, int] = {}
+    for e in entries:
+        eff = effective_stake(e.state, epoch)
+        if eff > 0:
+            out[e.state.voter] = out.get(e.state.voter, 0) + eff
+    return out
+
+
+def epoch_rewards(
+    entries: list[StakeEntry],
+    credits: dict[bytes, int],
+    *,
+    epoch: int,
+    pot: int,
+) -> dict[bytes, int]:
+    """Distribute `pot` lamports over stake accounts by points =
+    effective_stake × voter credits (fd_rewards.c's point model).
+    Returns stake_key -> reward; remainder lamports stay undistributed
+    (burned), matching the integer-division convention."""
+    points: dict[bytes, int] = {}
+    total = 0
+    for e in entries:
+        p = effective_stake(e.state, epoch) * credits.get(e.state.voter, 0)
+        if p > 0:
+            points[e.stake_key] = p
+            total += p
+    if total == 0:
+        return {}
+    return {k: pot * p // total for k, p in points.items()}
+
+
+def apply_rewards(accounts: dict[bytes, "object"], rewards: dict[bytes, int]):
+    """Pay rewards onto stake accounts, compounding the delegation (the
+    auto-compound rule: a delegated stake's reward joins its stake)."""
+    for key, amount in rewards.items():
+        a = accounts[key]
+        a.lamports += amount
+        st = StakeState.decode(bytes(a.data))
+        if st.state == STATE_DELEGATED:
+            st.stake += amount
+            a.data[:_DATA_LEN] = st.encode()

@@ -1,0 +1,388 @@
+"""Solana wire types: bincode codec combinators + core types.
+
+Counterpart of /root/reference/src/flamenco/types/ — there, ~42k lines
+of *generated* bincode (de)serializers (fd_types.c from fd_types.json
+via gen_stubs.py).  Here the same capability is a combinator library: a
+`Codec` composes from primitives exactly as bincode does (little-endian
+fixed-width ints, u64 length-prefixed vecs, 1-byte Option tags, enums
+as u32 tag + payload), so each type is declared in a few lines and the
+encoder/decoder pair can never disagree.
+
+Concrete types provided: the sysvars (Clock, Rent, EpochSchedule,
+SlotHash(es)), the vote instruction (Vote / VoteInstruction), and
+gossip's LegacyContactInfo with SocketAddr — the types the gossip,
+repair and runtime layers exchange on the wire.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, fields, is_dataclass
+
+
+class CodecError(ValueError):
+    pass
+
+
+class Codec:
+    def encode(self, v) -> bytes:
+        raise NotImplementedError
+
+    def decode(self, buf: bytes, off: int = 0):
+        """-> (value, new_off)"""
+        raise NotImplementedError
+
+    def loads(self, buf: bytes):
+        v, off = self.decode(buf, 0)
+        if off != len(buf):
+            raise CodecError(f"{len(buf) - off} trailing bytes")
+        return v
+
+
+class _Int(Codec):
+    def __init__(self, size: int, signed: bool = False):
+        self.size, self.signed = size, signed
+
+    def encode(self, v) -> bytes:
+        return int(v).to_bytes(self.size, "little", signed=self.signed)
+
+    def decode(self, buf, off=0):
+        if off + self.size > len(buf):
+            raise CodecError("short int")
+        return (
+            int.from_bytes(buf[off : off + self.size], "little",
+                           signed=self.signed),
+            off + self.size,
+        )
+
+
+U8, U16, U32, U64 = _Int(1), _Int(2), _Int(4), _Int(8)
+I64 = _Int(8, signed=True)
+
+
+class _F64(Codec):
+    def encode(self, v) -> bytes:
+        return struct.pack("<d", float(v))
+
+    def decode(self, buf, off=0):
+        if off + 8 > len(buf):
+            raise CodecError("short f64")
+        return struct.unpack_from("<d", buf, off)[0], off + 8
+
+
+F64 = _F64()
+
+
+class _Bool(Codec):
+    def encode(self, v) -> bytes:
+        return b"\x01" if v else b"\x00"
+
+    def decode(self, buf, off=0):
+        if off >= len(buf):
+            raise CodecError("short bool")
+        if buf[off] > 1:
+            raise CodecError(f"bad bool byte {buf[off]}")
+        return buf[off] == 1, off + 1
+
+
+Bool = _Bool()
+
+
+class FixedBytes(Codec):
+    def __init__(self, n: int):
+        self.n = n
+
+    def encode(self, v) -> bytes:
+        if len(v) != self.n:
+            raise CodecError(f"need {self.n} bytes, got {len(v)}")
+        return bytes(v)
+
+    def decode(self, buf, off=0):
+        if off + self.n > len(buf):
+            raise CodecError("short fixed bytes")
+        return bytes(buf[off : off + self.n]), off + self.n
+
+
+Pubkey = FixedBytes(32)
+Hash32 = FixedBytes(32)
+Signature = FixedBytes(64)
+
+
+class Vec(Codec):
+    """bincode Vec<T>: u64 count + elements."""
+
+    def __init__(self, inner: Codec, max_len: int = 1 << 20):
+        self.inner, self.max_len = inner, max_len
+
+    def encode(self, v) -> bytes:
+        out = U64.encode(len(v))
+        for x in v:
+            out += self.inner.encode(x)
+        return out
+
+    def decode(self, buf, off=0):
+        n, off = U64.decode(buf, off)
+        if n > self.max_len:
+            raise CodecError(f"vec too long ({n})")
+        out = []
+        for _ in range(n):
+            x, off = self.inner.decode(buf, off)
+            out.append(x)
+        return out, off
+
+
+class VarBytes(Codec):
+    """Vec<u8> without per-element dispatch."""
+
+    def __init__(self, max_len: int = 1 << 20):
+        self.max_len = max_len
+
+    def encode(self, v) -> bytes:
+        return U64.encode(len(v)) + bytes(v)
+
+    def decode(self, buf, off=0):
+        n, off = U64.decode(buf, off)
+        if n > self.max_len or off + n > len(buf):
+            raise CodecError("bad byte vec")
+        return bytes(buf[off : off + n]), off + n
+
+
+class String(Codec):
+    def encode(self, v) -> bytes:
+        raw = v.encode("utf-8")
+        return U64.encode(len(raw)) + raw
+
+    def decode(self, buf, off=0):
+        raw, off = VarBytes().decode(buf, off)
+        return raw.decode("utf-8"), off
+
+
+class Option(Codec):
+    def __init__(self, inner: Codec):
+        self.inner = inner
+
+    def encode(self, v) -> bytes:
+        if v is None:
+            return b"\x00"
+        return b"\x01" + self.inner.encode(v)
+
+    def decode(self, buf, off=0):
+        if off >= len(buf):
+            raise CodecError("short option")
+        tag = buf[off]
+        if tag == 0:
+            return None, off + 1
+        if tag != 1:
+            raise CodecError(f"bad option tag {tag}")
+        return self.inner.decode(buf, off + 1)
+
+
+class StructCodec(Codec):
+    """Binds a dataclass to an ordered (name, codec) field list."""
+
+    def __init__(self, cls, *spec):
+        self.cls, self.spec = cls, spec
+        if is_dataclass(cls):
+            names = [f.name for f in fields(cls)]
+            assert [n for n, _ in spec] == names, (
+                f"{cls.__name__} codec fields {names} != spec"
+            )
+
+    def encode(self, v) -> bytes:
+        return b"".join(c.encode(getattr(v, n)) for n, c in self.spec)
+
+    def decode(self, buf, off=0):
+        kw = {}
+        for n, c in self.spec:
+            kw[n], off = c.decode(buf, off)
+        return self.cls(**kw), off
+
+
+class Enum(Codec):
+    """bincode enum: u32 LE tag + variant payload."""
+
+    def __init__(self, *variants):
+        """variants: (tag, name, codec-or-None)"""
+        self.by_tag = {t: (n, c) for t, n, c in variants}
+        self.by_name = {n: (t, c) for t, n, c in variants}
+
+    def encode(self, v) -> bytes:
+        name, payload = v
+        t, c = self.by_name[name]
+        return U32.encode(t) + (c.encode(payload) if c else b"")
+
+    def decode(self, buf, off=0):
+        t, off = U32.decode(buf, off)
+        if t not in self.by_tag:
+            raise CodecError(f"unknown enum tag {t}")
+        name, c = self.by_tag[t]
+        if c is None:
+            return (name, None), off
+        payload, off = c.decode(buf, off)
+        return (name, payload), off
+
+
+# -- sysvars ------------------------------------------------------------------
+
+
+@dataclass
+class Clock:
+    slot: int = 0
+    epoch_start_timestamp: int = 0
+    epoch: int = 0
+    leader_schedule_epoch: int = 0
+    unix_timestamp: int = 0
+
+
+CLOCK = StructCodec(
+    Clock,
+    ("slot", U64),
+    ("epoch_start_timestamp", I64),
+    ("epoch", U64),
+    ("leader_schedule_epoch", U64),
+    ("unix_timestamp", I64),
+)
+
+
+@dataclass
+class Rent:
+    lamports_per_byte_year: int = 3480
+    exemption_threshold: float = 2.0
+    burn_percent: int = 50
+
+
+RENT = StructCodec(
+    Rent,
+    ("lamports_per_byte_year", U64),
+    ("exemption_threshold", F64),
+    ("burn_percent", U8),
+)
+
+
+def rent_exempt_minimum(rent: Rent, data_len: int) -> int:
+    """The balance making an account of `data_len` bytes rent-exempt
+    (the 128-byte account-storage overhead included, the protocol's
+    constant)."""
+    return int(
+        (data_len + 128) * rent.lamports_per_byte_year
+        * rent.exemption_threshold
+    )
+
+
+@dataclass
+class EpochSchedule:
+    slots_per_epoch: int = 432_000
+    leader_schedule_slot_offset: int = 432_000
+    warmup: bool = False
+    first_normal_epoch: int = 0
+    first_normal_slot: int = 0
+
+
+EPOCH_SCHEDULE = StructCodec(
+    EpochSchedule,
+    ("slots_per_epoch", U64),
+    ("leader_schedule_slot_offset", U64),
+    ("warmup", Bool),
+    ("first_normal_epoch", U64),
+    ("first_normal_slot", U64),
+)
+
+
+def epoch_of_slot(sched: EpochSchedule, slot: int) -> tuple[int, int]:
+    """(epoch, slot_index) for a post-warmup schedule."""
+    if slot < sched.first_normal_slot:
+        raise CodecError("warmup epochs not modeled")
+    rel = slot - sched.first_normal_slot
+    return (
+        sched.first_normal_epoch + rel // sched.slots_per_epoch,
+        rel % sched.slots_per_epoch,
+    )
+
+
+@dataclass
+class SlotHash:
+    slot: int
+    hash: bytes
+
+
+SLOT_HASH = StructCodec(SlotHash, ("slot", U64), ("hash", Hash32))
+SLOT_HASHES = Vec(SLOT_HASH, max_len=512)
+
+
+# -- vote instruction ---------------------------------------------------------
+
+
+@dataclass
+class Vote:
+    slots: list
+    hash: bytes
+    timestamp: int | None = None
+
+
+VOTE = StructCodec(
+    Vote,
+    ("slots", Vec(U64, max_len=1 << 16)),
+    ("hash", Hash32),
+    ("timestamp", Option(I64)),
+)
+
+# VoteInstruction enum (the tags the reference's vote program handles;
+# 2 = Vote is the one the leader pipeline sees constantly)
+VOTE_INSTRUCTION = Enum(
+    (2, "vote", VOTE),
+)
+
+
+# -- gossip: LegacyContactInfo ------------------------------------------------
+
+# SocketAddr: enum { V4(u32 tag 0: [u8;4], u16 port), V6(tag 1: [u8;16],
+# u16 port) } — ports in LE like every bincode int
+@dataclass
+class SockAddr:
+    ip: bytes
+    port: int
+
+
+SOCKET_ADDR = Enum(
+    (0, "v4", StructCodec(SockAddr, ("ip", FixedBytes(4)), ("port", U16))),
+    (1, "v6", StructCodec(SockAddr, ("ip", FixedBytes(16)), ("port", U16))),
+)
+
+
+def sockaddr_v4(ip: str, port: int):
+    return ("v4", SockAddr(bytes(int(x) for x in ip.split(".")), port))
+
+
+@dataclass
+class LegacyContactInfo:
+    id: bytes
+    gossip: tuple
+    tvu: tuple
+    tvu_forwards: tuple
+    repair: tuple
+    tpu: tuple
+    tpu_forwards: tuple
+    tpu_vote: tuple
+    rpc: tuple
+    rpc_pubsub: tuple
+    serve_repair: tuple
+    wallclock: int = 0
+    shred_version: int = 0
+
+
+LEGACY_CONTACT_INFO = StructCodec(
+    LegacyContactInfo,
+    ("id", Pubkey),
+    ("gossip", SOCKET_ADDR),
+    ("tvu", SOCKET_ADDR),
+    ("tvu_forwards", SOCKET_ADDR),
+    ("repair", SOCKET_ADDR),
+    ("tpu", SOCKET_ADDR),
+    ("tpu_forwards", SOCKET_ADDR),
+    ("tpu_vote", SOCKET_ADDR),
+    ("rpc", SOCKET_ADDR),
+    ("rpc_pubsub", SOCKET_ADDR),
+    ("serve_repair", SOCKET_ADDR),
+    ("wallclock", U64),
+    ("shred_version", U16),
+)

@@ -16,9 +16,16 @@ with a typed error (the VM is branchy host-side work by design — SURVEY
 §7.1 keeps it off the TPU; the device-batchable pieces, sigverify and
 hashing, are syscalls into the ops layer).
 
-Syscalls are registered by 32-bit id (the reference hashes syscall names
-into ids; registration is the deployer's choice here) and receive
-(vm, r1..r5), returning the new r0.
+Syscalls are registered by 32-bit id (murmur3_32 of the name, Solana's
+own derivation — ops/smallhash.syscall_id) and receive (vm, r1..r5),
+returning the new r0.
+
+sBPF function calls (fd_vm_interp_core.c's CALL_IMM/CALL_REG paths):
+`call` with src==1 is a bpf-to-bpf call to pc+imm+1; `callx` jumps to a
+code address held in the register named by imm.  Each call pushes the
+caller's r6-r9 + return pc and advances the frame pointer by one 4 KiB
+stack frame (FD_VM_STACK_FRAME_SZ semantics); `exit` pops a frame if one
+is live, and only returns to the host from the outermost frame.
 """
 
 from __future__ import annotations
@@ -32,7 +39,9 @@ MM_STACK = 2 << 32
 MM_HEAP = 3 << 32
 MM_INPUT = 4 << 32
 
-STACK_SZ = 64 * 1024
+FRAME_SZ = 4096
+MAX_CALL_DEPTH = 64
+STACK_SZ = FRAME_SZ * MAX_CALL_DEPTH
 HEAP_SZ = 32 * 1024
 DEFAULT_BUDGET = 200_000
 
@@ -77,8 +86,18 @@ class Vm:
             Region(MM_HEAP, bytearray(HEAP_SZ), True),
             Region(MM_INPUT, bytearray(self.input_data), True),
         ]
-        self.regs[10] = MM_STACK + STACK_SZ  # frame pointer at stack top
+        self.regs[10] = MM_STACK + FRAME_SZ  # frame 0's top; grows UP per call
         self.regs[1] = MM_INPUT
+        self.call_stack: list[tuple[int, int, int, int, int]] = []  # (ret_pc, r6..r9)
+        self.heap_pos = 0  # bump cursor for sol_alloc_free_
+        self.logs: list[bytes] = []
+
+    def charge(self, n: int) -> None:
+        """Charge `n` compute units; syscalls use this for their fixed +
+        per-byte costs (fd_vm's FD_VM_CONSUME_CU shape)."""
+        self.cu_used += n
+        if self.cu_used > self.budget:
+            raise VmBudget(f"compute budget exceeded ({self.budget})")
 
     # -- memory -------------------------------------------------------------
 
@@ -102,6 +121,12 @@ class Vm:
     def mem_write(self, vaddr: int, sz: int, val: int) -> None:
         r, off = self._region(vaddr, sz, write=True)
         r.data[off : off + sz] = (val & ((1 << (8 * sz)) - 1)).to_bytes(sz, "little")
+
+    def _write_span(self, vaddr: int, data: bytes) -> None:
+        if not data:
+            return
+        r, off = self._region(vaddr, len(data), write=True)
+        r.data[off : off + len(data)] = data
 
     # -- execution ----------------------------------------------------------
 
@@ -129,16 +154,33 @@ class Vm:
             nxt = self.pc + (2 if mn == "lddw" else 1)
 
             if mn == "exit":
-                return regs[0]
+                if not self.call_stack:
+                    return regs[0]
+                ret_pc, r6, r7, r8, r9 = self.call_stack.pop()
+                regs[6], regs[7], regs[8], regs[9] = r6, r7, r8, r9
+                regs[10] -= FRAME_SZ
+                nxt = ret_pc
             elif mn == "lddw":
                 regs[dst] = imm & _M64
             elif mn == "call":
-                fn = self.syscalls.get(imm & _M32)
-                if fn is None:
-                    raise VmError(f"unknown syscall 0x{imm & _M32:x}")
-                regs[0] = fn(self, *regs[1:6]) & _M64
+                if ins.src == 1:  # bpf-to-bpf: pc-relative target
+                    nxt = self._call_enter(self.pc + 1, self.pc + 1 + imm)
+                else:
+                    fn = self.syscalls.get(imm & _M32)
+                    if fn is None:
+                        # Solana also routes registered-function calls
+                        # through CALL_IMM with a pc hash; unknown ids
+                        # land here either way
+                        raise VmError(f"unknown syscall 0x{imm & _M32:x}")
+                    regs[0] = fn(self, *regs[1:6]) & _M64
             elif mn == "callx":
-                raise VmError("callx unsupported")
+                addr = regs[imm & 0xF] if (imm & 0xF) <= 10 else None
+                if addr is None:
+                    raise VmError("callx bad register")
+                off_b = addr - MM_PROGRAM - self.program.text_off
+                if off_b % 8:
+                    raise VmError(f"callx to unaligned 0x{addr:x}")
+                nxt = self._call_enter(self.pc + 1, off_b // 8)
             elif mn.startswith("j"):
                 taken = self._jump_taken(mn, regs, dst, src, imm)
                 if taken:
@@ -155,6 +197,16 @@ class Vm:
             else:
                 self._alu(mn, regs, dst, src, imm)
             self.pc = nxt
+
+    def _call_enter(self, ret_pc: int, target_pc: int) -> int:
+        if len(self.call_stack) >= MAX_CALL_DEPTH - 1:
+            raise VmError(f"call depth exceeded ({MAX_CALL_DEPTH})")
+        if target_pc not in self.insns:
+            raise VmError(f"call to bad pc {target_pc}")
+        r = self.regs
+        self.call_stack.append((ret_pc, r[6], r[7], r[8], r[9]))
+        r[10] += FRAME_SZ
+        return target_pc
 
     def _jump_taken(self, mn, regs, dst, src, imm) -> bool:
         if mn == "ja":
@@ -225,12 +277,42 @@ class Vm:
 
 # -- the device-backed syscalls (the TPU bridge) ------------------------------
 
+from firedancer_tpu.ops.smallhash import syscall_id as _sid
+
 SYSCALL_SOL_SHA256 = 0x11F49D86
 SYSCALL_SOL_KECCAK256 = 0xD7793ABB
 SYSCALL_SOL_LOG = 0x207559BD
 SYSCALL_SOL_SECP256K1_RECOVER = 0x17E40350
 SYSCALL_SOL_CREATE_PROGRAM_ADDRESS = 0x9377323C
 SYSCALL_SOL_TRY_FIND_PROGRAM_ADDRESS = 0x48504A38
+SYSCALL_SOL_MEMCPY = _sid("sol_memcpy_")
+SYSCALL_SOL_MEMMOVE = _sid("sol_memmove_")
+SYSCALL_SOL_MEMSET = _sid("sol_memset_")
+SYSCALL_SOL_MEMCMP = _sid("sol_memcmp_")
+SYSCALL_SOL_ALLOC_FREE = _sid("sol_alloc_free_")
+SYSCALL_SOL_LOG_64 = _sid("sol_log_64_")
+SYSCALL_SOL_LOG_PUBKEY = _sid("sol_log_pubkey")
+SYSCALL_SOL_LOG_CU = _sid("sol_log_compute_units_")
+SYSCALL_SOL_LOG_DATA = _sid("sol_log_data")
+SYSCALL_SOL_PANIC = _sid("sol_panic_")
+SYSCALL_SOL_INVOKE_SIGNED_C = _sid("sol_invoke_signed_c")
+SYSCALL_SOL_ALT_BN128 = _sid("sol_alt_bn128_group_op")
+
+# sol_alt_bn128_group_op op selectors (Solana's ALT_BN128_* convention)
+ALT_BN128_ADD = 0
+ALT_BN128_MUL = 2
+ALT_BN128_PAIRING = 3
+ALT_BN128_COSTS = {ALT_BN128_ADD: 334, ALT_BN128_MUL: 3_840,
+                   ALT_BN128_PAIRING: 36_364}  # + per-pair for pairing
+
+# fd_vm cost model constants (FD_VM_*_COST shape): a fixed base per
+# syscall plus per-byte for the bulk ops
+SYSCALL_BASE_COST = 100
+CPI_BYTES_PER_CU = 250
+MEM_OP_BASE_COST = 10
+LOG_PUBKEY_COST = 100
+HASH_BASE_COST = 85
+HASH_BYTE_COST_DIV = 2  # 1 CU per 2 bytes hashed
 
 
 def register_default_syscalls(vm: Vm, *, log_sink: list | None = None) -> None:
@@ -241,33 +323,121 @@ def register_default_syscalls(vm: Vm, *, log_sink: list | None = None) -> None:
 
     from firedancer_tpu.ops import keccak256 as kk
 
-    def sol_sha256(vm_, vals_addr, vals_len, result_addr, *_):
+    def _write_bytes(vm_, addr, data):
+        vm_._write_span(addr, data)
+
+    def _gather(vm_, vals_addr, vals_len):
         data = b""
         for i in range(vals_len):
             addr = vm_.mem_read(vals_addr + 16 * i, 8)
             sz = vm_.mem_read(vals_addr + 16 * i + 8, 8)
             data += vm_.mem_read_bytes(addr, sz)
+        return data
+
+    def sol_sha256(vm_, vals_addr, vals_len, result_addr, *_):
+        data = _gather(vm_, vals_addr, vals_len)
+        vm_.charge(HASH_BASE_COST + len(data) // HASH_BYTE_COST_DIV)
         digest = hashlib.sha256(data).digest()
-        for j, byte in enumerate(digest):
-            vm_.mem_write(result_addr + j, 1, byte)
+        _write_bytes(vm_, result_addr, digest)
         return 0
 
     def sol_keccak256(vm_, vals_addr, vals_len, result_addr, *_):
-        data = b""
-        for i in range(vals_len):
-            addr = vm_.mem_read(vals_addr + 16 * i, 8)
-            sz = vm_.mem_read(vals_addr + 16 * i + 8, 8)
-            data += vm_.mem_read_bytes(addr, sz)
+        data = _gather(vm_, vals_addr, vals_len)
+        vm_.charge(HASH_BASE_COST + len(data) // HASH_BYTE_COST_DIV)
         digest = kk.keccak256_host(data)
-        for j, byte in enumerate(digest):
-            vm_.mem_write(result_addr + j, 1, byte)
+        _write_bytes(vm_, result_addr, digest)
         return 0
 
-    def sol_log(vm_, addr, sz, *_):
-        msg = vm_.mem_read_bytes(addr, sz)
+    def _emit(vm_, msg: bytes):
+        vm_.logs.append(msg)
         if log_sink is not None:
             log_sink.append(msg)
+
+    def sol_log(vm_, addr, sz, *_):
+        vm_.charge(max(SYSCALL_BASE_COST, sz))
+        _emit(vm_, vm_.mem_read_bytes(addr, sz))
         return 0
+
+    def sol_log_64(vm_, a, b, c, d, e):
+        vm_.charge(SYSCALL_BASE_COST)
+        _emit(vm_, b"0x%x, 0x%x, 0x%x, 0x%x, 0x%x" % (a, b, c, d, e))
+        return 0
+
+    def sol_log_pubkey(vm_, addr, *_):
+        from firedancer_tpu.protocol import base58
+
+        vm_.charge(LOG_PUBKEY_COST)
+        _emit(vm_, base58.b58_encode32(vm_.mem_read_bytes(addr, 32)).encode())
+        return 0
+
+    def sol_log_compute_units(vm_, *_):
+        vm_.charge(SYSCALL_BASE_COST)
+        _emit(vm_, b"consumed %d of %d" % (vm_.cu_used, vm_.budget))
+        return 0
+
+    def sol_log_data(vm_, vals_addr, vals_len, *_):
+        import base64 as b64
+
+        data = _gather(vm_, vals_addr, vals_len)
+        vm_.charge(SYSCALL_BASE_COST + len(data))
+        _emit(vm_, b"data: " + b64.b64encode(data))
+        return 0
+
+    def sol_panic(vm_, file_addr, file_sz, line, col, *_):
+        fname = b"?"
+        try:
+            fname = vm_.mem_read_bytes(file_addr, file_sz)
+        except VmFault:
+            pass
+        raise VmError(
+            f"program panicked at {fname.decode('utf-8', 'replace')}:{line}:{col}"
+        )
+
+    # -- memops (fd_vm_syscall_sol_mem{cpy,move,set,cmp}_) --------------------
+
+    def _mem_cost(vm_, n):
+        vm_.charge(max(MEM_OP_BASE_COST, n // CPI_BYTES_PER_CU))
+
+    def sol_memcpy(vm_, dst, src, n, *_):
+        _mem_cost(vm_, n)
+        if n and not (dst + n <= src or src + n <= dst):
+            raise VmError("memcpy overlapping ranges")
+        vm_._write_span(dst, vm_.mem_read_bytes(src, n))
+        return 0
+
+    def sol_memmove(vm_, dst, src, n, *_):
+        _mem_cost(vm_, n)
+        vm_._write_span(dst, vm_.mem_read_bytes(src, n))
+        return 0
+
+    def sol_memset(vm_, dst, c, n, *_):
+        _mem_cost(vm_, n)
+        vm_._write_span(dst, bytes([c & 0xFF]) * n)
+        return 0
+
+    def sol_memcmp(vm_, a_addr, b_addr, n, result_addr, *_):
+        _mem_cost(vm_, n)
+        a = vm_.mem_read_bytes(a_addr, n)
+        b = vm_.mem_read_bytes(b_addr, n)
+        r = 0
+        for x, y in zip(a, b):
+            if x != y:
+                r = x - y
+                break
+        vm_.mem_write(result_addr, 4, r & _M32)
+        return 0
+
+    def sol_alloc_free(vm_, sz, free_addr, *_):
+        # bump allocator over the heap region; free is a no-op (the
+        # reference's fd_vm_syscall_sol_alloc_free_ behaves identically)
+        if free_addr != 0:
+            return 0
+        align = 8
+        pos = (vm_.heap_pos + align - 1) & ~(align - 1)
+        if pos + sz > HEAP_SZ:
+            return 0  # NULL: allocation failure, not a fault
+        vm_.heap_pos = pos + sz
+        return MM_HEAP + pos
 
     def sol_secp256k1_recover(vm_, hash_addr, recovery_id, sig_addr, result_addr, *_):
         from firedancer_tpu.ops import secp256k1 as sk
@@ -334,6 +504,39 @@ def register_default_syscalls(vm: Vm, *, log_sink: list | None = None) -> None:
     vm.syscalls[SYSCALL_SOL_SHA256] = sol_sha256
     vm.syscalls[SYSCALL_SOL_KECCAK256] = sol_keccak256
     vm.syscalls[SYSCALL_SOL_LOG] = sol_log
+    vm.syscalls[SYSCALL_SOL_LOG_64] = sol_log_64
+    vm.syscalls[SYSCALL_SOL_LOG_PUBKEY] = sol_log_pubkey
+    vm.syscalls[SYSCALL_SOL_LOG_CU] = sol_log_compute_units
+    vm.syscalls[SYSCALL_SOL_LOG_DATA] = sol_log_data
+    vm.syscalls[SYSCALL_SOL_PANIC] = sol_panic
+    vm.syscalls[SYSCALL_SOL_MEMCPY] = sol_memcpy
+    vm.syscalls[SYSCALL_SOL_MEMMOVE] = sol_memmove
+    vm.syscalls[SYSCALL_SOL_MEMSET] = sol_memset
+    vm.syscalls[SYSCALL_SOL_MEMCMP] = sol_memcmp
+    vm.syscalls[SYSCALL_SOL_ALLOC_FREE] = sol_alloc_free
+    def sol_alt_bn128_group_op(vm_, op, input_addr, input_len, result_addr, *_):
+        from firedancer_tpu.ops import bn254 as bn
+
+        cost = ALT_BN128_COSTS.get(op)
+        if cost is None:
+            return 1
+        if op == ALT_BN128_PAIRING:
+            cost += 12_121 * max(0, input_len // 192 - 1)
+        vm_.charge(cost)
+        data = vm_.mem_read_bytes(input_addr, input_len) if input_len else b""
+        try:
+            if op == ALT_BN128_ADD:
+                out = bn.alt_bn128_addition(data)
+            elif op == ALT_BN128_MUL:
+                out = bn.alt_bn128_multiplication(data)
+            else:
+                out = bn.alt_bn128_pairing(data)
+        except bn.Bn254Error:
+            return 1
+        vm_._write_span(result_addr, out)
+        return 0
+
+    vm.syscalls[SYSCALL_SOL_ALT_BN128] = sol_alt_bn128_group_op
     vm.syscalls[SYSCALL_SOL_SECP256K1_RECOVER] = sol_secp256k1_recover
     vm.syscalls[SYSCALL_SOL_CREATE_PROGRAM_ADDRESS] = sol_create_program_address
     vm.syscalls[SYSCALL_SOL_TRY_FIND_PROGRAM_ADDRESS] = sol_try_find_program_address

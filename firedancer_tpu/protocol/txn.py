@@ -627,14 +627,24 @@ def transfer_txn(
 
     payer = from_pubkey if from_pubkey is not None else ref.public_key(from_secret)
     data = (2).to_bytes(4, "little") + lamports.to_bytes(8, "little")
+    if to_pubkey == payer:
+        # account lists are unique (AccountLoadedTwice rule): a
+        # self-transfer references the payer entry from both slots
+        addrs = [payer, SYSTEM_PROGRAM]
+        accounts = bytes([0, 0])
+        prog_idx = 1
+    else:
+        addrs = [payer, to_pubkey, SYSTEM_PROGRAM]
+        accounts = bytes([0, 1])
+        prog_idx = 2
     msg = message_build(
         version=VLEGACY,
         signature_cnt=1,
         readonly_signed_cnt=0,
         readonly_unsigned_cnt=1,
-        acct_addrs=[payer, to_pubkey, SYSTEM_PROGRAM],
+        acct_addrs=addrs,
         recent_blockhash=recent_blockhash,
-        instrs=[InstrSpec(program_id=2, accounts=bytes([0, 1]), data=data)],
+        instrs=[InstrSpec(program_id=prog_idx, accounts=accounts, data=data)],
     )
     sig = (sign_fn or ref.sign)(from_secret, msg)
     return txn_assemble([sig], msg)

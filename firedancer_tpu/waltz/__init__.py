@@ -1,0 +1,9 @@
+"""waltz: networking — QUIC + TLS 1.3 + UDP transports.
+
+Counterpart of /root/reference/src/waltz/: the TPU ingress protocol
+stack.  The datagram/stream UDP transports live in runtime/net.py (the
+stage layer); this package holds the protocol engines: tls13 (the
+fd_tls counterpart) and quic (the fd_quic counterpart).
+"""
+
+from . import quic, tls13  # noqa: F401

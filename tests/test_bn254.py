@@ -1,0 +1,110 @@
+"""BN254 (alt_bn128): G1 group law, subgroup/curve validation, the ate
+pairing (bilinearity + degeneracy), EIP-196/197 wire encodings, and the
+VM syscall bridge."""
+
+import pytest
+
+from firedancer_tpu.ops import bn254 as bn
+
+
+def test_g1_group_law():
+    g = bn.G1_GEN
+    d = bn.g1_add(g, g)
+    # independent affine doubling check
+    s = 3 * pow(4, bn.P - 2, bn.P) % bn.P
+    x3 = (s * s - 2) % bn.P
+    y3 = (s * (1 - x3) - 2) % bn.P
+    assert d == (x3, y3)
+    assert bn.g1_mul(g, 2) == d
+    assert bn.g1_add(d, (g[0], bn.P - g[1])) == g  # 2G - G = G
+    assert bn.g1_mul(g, 3) == bn.g1_add(d, g)
+    # identity
+    assert bn.g1_add(g, None) == g
+    assert bn.g1_add(None, None) is None
+    assert bn.g1_mul(g, 0) is None
+    assert bn.g1_mul(g, bn.R) is None  # order-r subgroup
+
+
+def test_g1_rejects_off_curve():
+    with pytest.raises(bn.Bn254Error, match="not on G1"):
+        bn.g1_check((1, 3))
+    with pytest.raises(bn.Bn254Error, match="out of range"):
+        bn.g1_check((bn.P, 2))
+
+
+def test_g2_validation():
+    q = bn.g2_embed(bn.G2_GEN)
+    assert q is not None
+    bad = ((1, 2), (3, 4))
+    with pytest.raises(bn.Bn254Error, match="not on twisted G2"):
+        bn.g2_embed(bad)
+
+
+def test_pairing_inverse_pair_cancels():
+    neg_g1 = (1, bn.P - 2)
+    assert bn.pairing_check([(bn.G1_GEN, bn.G2_GEN), (neg_g1, bn.G2_GEN)])
+    assert not bn.pairing_check([(bn.G1_GEN, bn.G2_GEN)])
+    assert bn.pairing_check([])  # empty product is 1
+
+
+def test_pairing_bilinearity():
+    """e(aG, Q) * e(-G, aQ) == 1 — scalar moves across the pairing."""
+    a = 7
+    ag = bn.g1_mul(bn.G1_GEN, a)
+    neg_g = (1, bn.P - 2)
+    q = bn.g2_embed(bn.G2_GEN)
+    aq = bn._ec_mul(q, a)
+    p_ag = (bn.f12_from_fp(ag[0]), bn.f12_from_fp(ag[1]))
+    p_ng = (bn.f12_from_fp(neg_g[0]), bn.f12_from_fp(neg_g[1]))
+    acc = bn.f12_mul(bn.miller_loop(q, p_ag), bn.miller_loop(aq, p_ng))
+    assert bn.f12_pow(acc, bn._FINAL_EXP) == bn.f12_one()
+
+
+def test_wire_encodings():
+    g = bn.G1_GEN
+    enc = bn.g1_encode(g)
+    assert bn.g1_decode(enc) == g
+    assert bn.g1_decode(bytes(64)) is None
+    assert bn.g1_encode(None) == bytes(64)
+    # add via wire: G + G == 2G
+    out = bn.alt_bn128_addition(enc + enc)
+    assert bn.g1_decode(out) == bn.g1_add(g, g)
+    # mul via wire
+    out = bn.alt_bn128_multiplication(enc + (5).to_bytes(32, "big"))
+    assert bn.g1_decode(out) == bn.g1_mul(g, 5)
+    # pairing via wire: e(G,Q)·e(-G,Q) == 1
+    g2e = (
+        bn.G2_GEN[0][0].to_bytes(32, "big")
+        + bn.G2_GEN[0][1].to_bytes(32, "big")
+        + bn.G2_GEN[1][0].to_bytes(32, "big")
+        + bn.G2_GEN[1][1].to_bytes(32, "big")
+    )
+    neg = bn.g1_encode((1, bn.P - 2))
+    res = bn.alt_bn128_pairing(enc + g2e + neg + g2e)
+    assert res == (1).to_bytes(32, "big")
+    with pytest.raises(bn.Bn254Error, match="multiple of 192"):
+        bn.alt_bn128_pairing(b"\x00" * 100)
+
+
+def test_vm_syscall_bridge():
+    from firedancer_tpu.flamenco import vm as fvm
+    from tests.test_executor import lddw
+    from tests.test_sbpf import ins
+
+    g = bn.g1_encode(bn.G1_GEN)
+    # input = G || G via the input region; result written back to input+128
+    text = (
+        ins(0xB7, dst=1, imm=fvm.ALT_BN128_ADD)
+        + lddw(2, fvm.MM_INPUT)
+        + ins(0xB7, dst=3, imm=128)
+        + lddw(4, fvm.MM_INPUT + 128)
+        + ins(0x85, imm=fvm.SYSCALL_SOL_ALT_BN128)
+        + ins(0x95)
+    )
+    from tests.test_vm import run_text
+
+    m = run_text(text, input_data=g + g + bytes(64))
+    fvm.register_default_syscalls(m)
+    assert m.run() == 0
+    out = bytes(m.regions[3].data[128:192])
+    assert bn.g1_decode(out) == bn.g1_add(bn.G1_GEN, bn.G1_GEN)

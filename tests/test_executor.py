@@ -1,0 +1,426 @@
+"""Executor + VM completion tests: bpf-to-bpf calls, memops/alloc
+syscalls, the aligned account serialization, sBPF programs executing
+against runtime accounts, and CPI (sol_invoke_signed_c) with privilege
+enforcement — the fd_executor.c / fd_vm_syscall_cpi.c surface."""
+
+import pytest
+
+from firedancer_tpu.flamenco import vm as fvm
+from firedancer_tpu.flamenco.executor import (
+    Account,
+    BPF_LOADER_PROGRAM,
+    Executor,
+    InstrAccount,
+    InstrError,
+    TxnCtx,
+    serialize_aligned,
+)
+from firedancer_tpu.flamenco.programs import AcctError, FundsError
+from firedancer_tpu.protocol import sbpf
+from firedancer_tpu.protocol.txn import SYSTEM_PROGRAM
+from tests.test_sbpf import build_elf, ins
+from tests.test_vm import run_text
+
+EXIT = ins(0x95)
+
+
+def lddw(dst, val):
+    return (
+        ins(0x18, dst=dst, imm=val & 0xFFFFFFFF)
+        + bytes(4)
+        + ((val >> 32) & 0xFFFFFFFF).to_bytes(4, "little")
+    )
+
+
+# -- VM: function calls and frames -------------------------------------------
+
+
+def test_bpf_to_bpf_call_and_frame_isolation():
+    # main: r6=5; call f; r0 = r6 + r0  (f clobbers its own r6, returns 37)
+    text = (
+        ins(0xB7, dst=6, imm=5)            # mov r6, 5
+        + ins(0x85, src=1, imm=2)          # call +2 (f at pc 4)
+        + ins(0x0F, dst=0, src=6)          # add r0, r6  -> 37 + 5
+        + EXIT
+        # f:
+        + ins(0xB7, dst=6, imm=1000)       # clobber r6 in the callee
+        + ins(0xB7, dst=0, imm=37)
+        + EXIT                             # pops the frame
+    )
+    assert run_text(text).run() == 42
+
+
+def test_callx_via_register():
+    prog = sbpf.load(build_elf(ins(0xB7, dst=0, imm=0) + EXIT))
+    # f is at pc 5 (lddw below occupies two slots)
+    text = (
+        lddw(1, fvm.MM_PROGRAM + prog.text_off + 5 * 8)
+        + ins(0x8D, imm=1)                 # callx r1
+        + ins(0x07, dst=0, imm=1)          # r0 += 1 after return
+        + EXIT
+        + ins(0xB7, dst=0, imm=9)          # f: r0 = 9
+        + EXIT
+    )
+    assert run_text(text).run() == 10
+
+
+def test_call_depth_limit():
+    # f calls itself forever -> depth error before budget at small budget
+    text = ins(0x85, src=1, imm=-1) + EXIT
+    with pytest.raises(fvm.VmError, match="depth"):
+        run_text(text, budget=100_000).run()
+
+
+def test_exit_from_outermost_returns():
+    assert run_text(ins(0xB7, dst=0, imm=3) + EXIT).run() == 3
+
+
+# -- VM: memops + alloc syscalls ----------------------------------------------
+
+
+def _with_syscalls(text, **kw):
+    m = run_text(text, **kw)
+    fvm.register_default_syscalls(m)
+    return m
+
+
+def test_memset_memcpy_memcmp():
+    text = (
+        # memset([r10-16], 0xAB, 8)
+        ins(0xBF, dst=1, src=10) + ins(0x07, dst=1, imm=-16)
+        + ins(0xB7, dst=2, imm=0xAB) + ins(0xB7, dst=3, imm=8)
+        + ins(0x85, imm=fvm.SYSCALL_SOL_MEMSET)
+        # memcpy([r10-8], [r10-16], 8)
+        + ins(0xBF, dst=1, src=10) + ins(0x07, dst=1, imm=-8)
+        + ins(0xBF, dst=2, src=10) + ins(0x07, dst=2, imm=-16)
+        + ins(0xB7, dst=3, imm=8)
+        + ins(0x85, imm=fvm.SYSCALL_SOL_MEMCPY)
+        # memcmp([r10-8], [r10-16], 8) -> result u32 at [r10-24]
+        + ins(0xBF, dst=1, src=10) + ins(0x07, dst=1, imm=-8)
+        + ins(0xBF, dst=2, src=10) + ins(0x07, dst=2, imm=-16)
+        + ins(0xB7, dst=3, imm=8)
+        + ins(0xBF, dst=4, src=10) + ins(0x07, dst=4, imm=-24)
+        + ins(0x85, imm=fvm.SYSCALL_SOL_MEMCMP)
+        + ins(0x61, dst=0, src=10, off=-24)  # r0 = cmp result (0 = equal)
+        + EXIT
+    )
+    assert _with_syscalls(text).run() == 0
+
+
+def test_memcpy_overlap_faults():
+    text = (
+        ins(0xBF, dst=1, src=10) + ins(0x07, dst=1, imm=-12)
+        + ins(0xBF, dst=2, src=10) + ins(0x07, dst=2, imm=-16)
+        + ins(0xB7, dst=3, imm=8)
+        + ins(0x85, imm=fvm.SYSCALL_SOL_MEMCPY)
+        + EXIT
+    )
+    with pytest.raises(fvm.VmError, match="overlap"):
+        _with_syscalls(text).run()
+
+
+def test_alloc_free_bump():
+    # two 16-byte allocations: distinct, heap-region addresses
+    text = (
+        ins(0xB7, dst=1, imm=16) + ins(0xB7, dst=2, imm=0)
+        + ins(0x85, imm=fvm.SYSCALL_SOL_ALLOC_FREE)
+        + ins(0xBF, dst=6, src=0)
+        + ins(0xB7, dst=1, imm=16) + ins(0xB7, dst=2, imm=0)
+        + ins(0x85, imm=fvm.SYSCALL_SOL_ALLOC_FREE)
+        + ins(0x1F, dst=0, src=6)          # r0 = second - first
+        + EXIT
+    )
+    m = _with_syscalls(text)
+    assert m.run() == 16
+
+
+def test_log_64_and_data(capsys=None):
+    logs = []
+    text = (
+        ins(0xB7, dst=1, imm=1) + ins(0xB7, dst=2, imm=2)
+        + ins(0xB7, dst=3, imm=3) + ins(0xB7, dst=4, imm=4)
+        + ins(0xB7, dst=5, imm=5)
+        + ins(0x85, imm=fvm.SYSCALL_SOL_LOG_64)
+        + ins(0x85, imm=fvm.SYSCALL_SOL_LOG_CU)
+        + ins(0xB7, dst=0, imm=0)
+        + EXIT
+    )
+    m = run_text(text)
+    fvm.register_default_syscalls(m, log_sink=logs)
+    assert m.run() == 0
+    assert logs[0] == b"0x1, 0x2, 0x3, 0x4, 0x5"
+    assert logs[1].startswith(b"consumed ")
+
+
+# -- executor: native program dispatch ----------------------------------------
+
+
+def _ctx(*accts, signer=None, writable=None):
+    accounts = list(accts)
+    n = len(accounts)
+    return TxnCtx(
+        accounts=accounts,
+        signer=signer if signer is not None else [True] * n,
+        writable=writable if writable is not None else [True] * n,
+    )
+
+
+def _sys_acct(key, lamports, data=b""):
+    return Account(key, lamports, SYSTEM_PROGRAM, False, bytearray(data))
+
+
+def _transfer_ix(lamports):
+    return (2).to_bytes(4, "little") + lamports.to_bytes(8, "little")
+
+
+def test_system_transfer_and_conservation():
+    ex = Executor()
+    ctx = _ctx(_sys_acct(b"A" * 32, 1000), _sys_acct(b"B" * 32, 0))
+    ex.execute_instr(
+        ctx, SYSTEM_PROGRAM,
+        [InstrAccount(0, True, True), InstrAccount(1, False, True)],
+        _transfer_ix(400),
+    )
+    assert ctx.accounts[0].lamports == 600
+    assert ctx.accounts[1].lamports == 400
+
+
+def test_system_transfer_requires_signer():
+    ex = Executor()
+    ctx = _ctx(_sys_acct(b"A" * 32, 1000), _sys_acct(b"B" * 32, 0))
+    with pytest.raises(AcctError, match="signature"):
+        ex.execute_instr(
+            ctx, SYSTEM_PROGRAM,
+            [InstrAccount(0, False, True), InstrAccount(1, False, True)],
+            _transfer_ix(1),
+        )
+
+
+def test_system_create_assign_allocate():
+    ex = Executor()
+    owner = b"P" * 32
+    ctx = _ctx(_sys_acct(b"A" * 32, 10_000), _sys_acct(b"N" * 32, 0))
+    create = (
+        (0).to_bytes(4, "little")
+        + (5_000).to_bytes(8, "little")
+        + (64).to_bytes(8, "little")
+        + owner
+    )
+    ex.execute_instr(
+        ctx, SYSTEM_PROGRAM,
+        [InstrAccount(0, True, True), InstrAccount(1, True, True)],
+        create,
+    )
+    new = ctx.accounts[1]
+    assert (new.lamports, new.owner, len(new.data)) == (5_000, owner, 64)
+    # creating over an existing account fails
+    with pytest.raises(AcctError, match="in use"):
+        ex.execute_instr(
+            ctx, SYSTEM_PROGRAM,
+            [InstrAccount(0, True, True), InstrAccount(1, True, True)],
+            create,
+        )
+    # allocate + assign on a fresh system account
+    ctx2 = _ctx(_sys_acct(b"Z" * 32, 0))
+    ex.execute_instr(
+        ctx2, SYSTEM_PROGRAM, [InstrAccount(0, True, True)],
+        (8).to_bytes(4, "little") + (32).to_bytes(8, "little"),
+    )
+    assert len(ctx2.accounts[0].data) == 32
+    ex.execute_instr(
+        ctx2, SYSTEM_PROGRAM, [InstrAccount(0, True, True)],
+        (1).to_bytes(4, "little") + owner,
+    )
+    assert ctx2.accounts[0].owner == owner
+
+
+def test_insufficient_funds_typed():
+    ex = Executor()
+    ctx = _ctx(_sys_acct(b"A" * 32, 10), _sys_acct(b"B" * 32, 0))
+    with pytest.raises(FundsError):
+        ex.execute_instr(
+            ctx, SYSTEM_PROGRAM,
+            [InstrAccount(0, True, True), InstrAccount(1, False, True)],
+            _transfer_ix(100),
+        )
+
+
+# -- executor: sBPF programs over serialized accounts -------------------------
+
+
+def _bpf_program_account(key, text):
+    return Account(key, 1, BPF_LOADER_PROGRAM, True, bytearray(build_elf(text)))
+
+
+def _serial_offsets(n_data: int) -> dict:
+    """Input-region offsets for instruction account 0 with data_len
+    n_data (aligned layout)."""
+    base = 8
+    return {
+        "key": base + 8,
+        "owner": base + 40,
+        "lamports": base + 72,
+        "data_len": base + 80,
+        "data": base + 88,
+    }
+
+
+def test_bpf_program_mutates_account_data():
+    # program: input[data] = 0x2A on account 0; return 0
+    off = _serial_offsets(8)
+    text = (
+        lddw(1, fvm.MM_INPUT + off["data"])
+        + ins(0xB7, dst=2, imm=0x2A)
+        + ins(0x73, dst=1, src=2)          # stxb [r1], r2
+        + ins(0xB7, dst=0, imm=0)
+        + EXIT
+    )
+    prog_key = b"p" * 32
+    ex = Executor()
+    # the mutated account is owned by the program (owner-may-modify rule)
+    acct = Account(b"D" * 32, 5, prog_key, False, bytearray(8))
+    ctx = _ctx(
+        acct,
+        _bpf_program_account(prog_key, text),
+        writable=[True, False],
+    )
+    ex.execute_instr(ctx, prog_key, [InstrAccount(0, False, True)], b"")
+    assert ctx.accounts[0].data[0] == 0x2A
+
+
+def test_bpf_program_nonzero_return_is_error():
+    text = ins(0xB7, dst=0, imm=7) + EXIT
+    prog_key = b"p" * 32
+    ex = Executor()
+    ctx = _ctx(
+        _sys_acct(b"D" * 32, 5),
+        _bpf_program_account(prog_key, text),
+        writable=[True, False],
+    )
+    with pytest.raises(InstrError, match="program error"):
+        ex.execute_instr(ctx, prog_key, [InstrAccount(0, False, True)], b"")
+
+
+def test_bpf_readonly_account_writeback_skipped():
+    # program writes its view of a READONLY account; effects must not land
+    off = _serial_offsets(8)
+    text = (
+        lddw(1, fvm.MM_INPUT + off["lamports"])
+        + ins(0xB7, dst=2, imm=999)
+        + ins(0x7B, dst=1, src=2)          # stxdw [r1], r2
+        + ins(0xB7, dst=0, imm=0)
+        + EXIT
+    )
+    prog_key = b"p" * 32
+    ex = Executor()
+    ctx = _ctx(
+        _sys_acct(b"D" * 32, 5, bytes(8)),
+        _bpf_program_account(prog_key, text),
+        writable=[False, False],
+    )
+    ex.execute_instr(ctx, prog_key, [InstrAccount(0, False, False)], b"")
+    assert ctx.accounts[0].lamports == 5  # unchanged
+
+
+def test_serialize_dup_accounts():
+    ctx = _ctx(_sys_acct(b"D" * 32, 5, b"xy"))
+    blob, smap = serialize_aligned(
+        ctx,
+        [InstrAccount(0, True, True), InstrAccount(0, True, True)],
+        b"ix",
+        b"q" * 32,
+    )
+    assert blob[:8] == (2).to_bytes(8, "little")
+    assert len(smap) == 1  # dup serialized as a 1-byte back-reference
+    assert blob[8 + 8 + 32 + 32 + 8 + 8 : 8 + 8 + 32 + 32 + 8 + 8 + 2] == b"xy"
+
+
+# -- CPI ----------------------------------------------------------------------
+
+
+def _cpi_caller_text(callee_prog_id_addr, acct_key_addr, *, signer=0):
+    """Builds SolAccountMeta + SolInstruction on the stack and invokes."""
+    return (
+        # meta at [r10-64]: pubkey_addr | is_writable=1 | is_signer
+        lddw(1, acct_key_addr)
+        + ins(0x7B, dst=10, src=1, off=-64)
+        + ins(0xB7, dst=1, imm=1)
+        + ins(0x73, dst=10, src=1, off=-56)
+        + ins(0xB7, dst=1, imm=signer)
+        + ins(0x73, dst=10, src=1, off=-55)
+        # instruction at [r10-48]
+        + lddw(1, callee_prog_id_addr)
+        + ins(0x7B, dst=10, src=1, off=-48)   # program_id_addr
+        + ins(0xBF, dst=1, src=10) + ins(0x07, dst=1, imm=-64)
+        + ins(0x7B, dst=10, src=1, off=-40)   # accounts_addr
+        + ins(0xB7, dst=1, imm=1)
+        + ins(0x7B, dst=10, src=1, off=-32)   # accounts_len = 1
+        + ins(0xB7, dst=1, imm=0)
+        + ins(0x7B, dst=10, src=1, off=-24)   # data_addr = 0
+        + ins(0x7B, dst=10, src=1, off=-16)   # data_len = 0
+        # invoke(&instr, NULL, 0, NULL, 0)
+        + ins(0xBF, dst=1, src=10) + ins(0x07, dst=1, imm=-48)
+        + ins(0xB7, dst=2, imm=0) + ins(0xB7, dst=3, imm=0)
+        + ins(0xB7, dst=4, imm=0) + ins(0xB7, dst=5, imm=0)
+        + ins(0x85, imm=fvm.SYSCALL_SOL_INVOKE_SIGNED_C)
+        + ins(0xB7, dst=0, imm=0)
+        + EXIT
+    )
+
+
+def _cpi_fixture(*, signer=0):
+    """Caller BPF program CPIs into a native bump program that increments
+    account 0's data[0].  Callee program id rides in the caller's
+    instruction data; the target account key is read from the caller's
+    own serialized input."""
+    bump_id = b"B" * 32
+    off = _serial_offsets(8)
+    acct_entry_sz = 8 + 32 + 32 + 8 + 8 + 8 + 10 * 1024 + 8  # data_len 8
+    instr_data_off = 8 + acct_entry_sz
+    caller_text = _cpi_caller_text(
+        fvm.MM_INPUT + instr_data_off + 8,  # prog id embedded in instr data
+        fvm.MM_INPUT + off["key"],
+        signer=signer,
+    )
+    prog_key = b"c" * 32
+    ex = Executor()
+
+    def bump(ex_, ctx_, pid, iaccts, data, *, pda_signers):
+        a = ctx_.accounts[iaccts[0].txn_idx]
+        if not iaccts[0].is_writable:
+            raise InstrError("bump needs writable")
+        a.data[0] += 1
+
+    ex.register(bump_id, bump)
+    ctx = _ctx(
+        _sys_acct(b"D" * 32, 5, bytes(8)),
+        _bpf_program_account(prog_key, caller_text),
+        signer=[False, False],
+        writable=[True, False],
+    )
+    return ex, ctx, prog_key, bump_id
+
+
+def test_cpi_invokes_native_callee_and_syncs():
+    ex, ctx, prog_key, bump_id = _cpi_fixture()
+    ex.execute_instr(
+        ctx, prog_key, [InstrAccount(0, False, True)], bump_id,
+    )
+    assert ctx.accounts[0].data[0] == 1
+
+
+def test_cpi_signer_escalation_rejected():
+    ex, ctx, prog_key, bump_id = _cpi_fixture(signer=1)
+    with pytest.raises(InstrError, match="escalation"):
+        ex.execute_instr(
+            ctx, prog_key, [InstrAccount(0, False, True)], bump_id,
+        )
+
+
+def test_cpi_writable_escalation_rejected():
+    ex, ctx, prog_key, bump_id = _cpi_fixture()
+    # caller holds the account READONLY -> callee asking writable must die
+    with pytest.raises(InstrError, match="escalation"):
+        ex.execute_instr(
+            ctx, prog_key, [InstrAccount(0, False, False)], bump_id,
+        )

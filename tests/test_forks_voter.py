@@ -1,0 +1,123 @@
+"""choreo forks (bank frontier) + voter (vote-txn emission), and the
+consensus loop wiring: replay -> forks -> ghost -> tower -> voter ->
+vote txns the runtime's vote program executes."""
+
+import pytest
+
+from firedancer_tpu.choreo import Forks, ForkError, Ghost, Voter
+from firedancer_tpu.funk import Funk
+from firedancer_tpu.flamenco import runtime as rt
+from firedancer_tpu.ops.ref import ed25519_ref as ref
+from firedancer_tpu.protocol import txn as ft
+
+
+def test_forks_insert_freeze_frontier():
+    f = Forks(0)
+    f.insert(1, 0)
+    with pytest.raises(ForkError, match="not frozen"):
+        f.insert(2, 1)  # parent 1 not executed yet
+    f.freeze(1, xid=b"x1", bank_hash=b"h" * 32, poh_hash=b"p" * 32)
+    f.insert(2, 1)
+    f.insert(3, 1)  # competing fork off slot 1
+    f.freeze(2, xid=b"x2", bank_hash=b"h" * 32, poh_hash=b"p" * 32)
+    f.freeze(3, xid=b"x3", bank_hash=b"h" * 32, poh_hash=b"p" * 32)
+    tips = sorted(x.slot for x in f.frontier())
+    assert tips == [2, 3]
+    assert f.is_ancestor(1, 3) and not f.is_ancestor(2, 3)
+
+
+def test_forks_duplicate_and_bad_parent():
+    f = Forks(0)
+    f.insert(5, 0)
+    with pytest.raises(ForkError, match="already exists"):
+        f.insert(5, 0)
+    with pytest.raises(ForkError, match="unknown fork"):
+        f.insert(7, 6)
+    f.freeze(5, xid=b"x", bank_hash=b"h" * 32, poh_hash=b"p" * 32)
+    with pytest.raises(ForkError, match="<= parent"):
+        f.insert(4, 5)
+
+
+def test_forks_publish_prunes_losers():
+    f = Forks(0)
+    for slot, parent in [(1, 0), (2, 1), (3, 1), (4, 2)]:
+        f.insert(slot, parent)
+        f.freeze(slot, xid=b"x%d" % slot, bank_hash=b"h" * 32,
+                 poh_hash=b"p" * 32)
+    pruned = f.publish(2)
+    # loser fork 3 pruned; retired ancestors 0,1 gone; 2 is root, 4 kept
+    assert pruned == [0, 1, 3]
+    assert f.root_slot == 2
+    assert 4 in f and 3 not in f
+    with pytest.raises(ForkError):
+        f.publish(3)
+
+
+def test_voter_emits_and_respects_lockout():
+    secret = bytes(range(32))
+    pub = ref.public_key(secret)
+    vote_acct = b"V" * 32
+    f = Forks(0)
+    for slot, parent in [(1, 0), (2, 1)]:
+        f.insert(slot, parent)
+        f.freeze(slot, xid=b"x", bank_hash=b"h" * 32, poh_hash=b"p" * 32)
+    v = Voter(vote_account=vote_acct, voter_pubkey=pub,
+              sign=lambda m: ref.sign(secret, m))
+    bh = b"B" * 32
+    t1 = v.maybe_vote(1, bh, is_ancestor=f.is_ancestor)
+    assert t1 is not None
+    parsed = ft.txn_parse(t1)
+    assert parsed is not None
+    # no double/backwards vote
+    assert v.maybe_vote(1, bh, is_ancestor=f.is_ancestor) is None
+    t2 = v.maybe_vote(2, bh, is_ancestor=f.is_ancestor)
+    assert t2 is not None
+
+    # a conflicting fork at slot 3 (off 1): locked out by the vote on 2
+    f.insert(3, 1)
+    f.freeze(3, xid=b"x", bank_hash=b"h" * 32, poh_hash=b"p" * 32)
+    assert v.maybe_vote(3, bh, is_ancestor=f.is_ancestor) is None
+
+
+def test_consensus_loop_end_to_end():
+    """Votes flow: voter txn -> runtime vote program -> ghost weights ->
+    head selection -> forks.publish at the tower root."""
+    secret = bytes(range(32))
+    pub = ref.public_key(secret)
+    vote_acct = b"V" * 32
+
+    funk = Funk()
+    funk.rec_insert(None, pub, rt.acct_build(10_000_000))
+    funk.rec_insert(None, vote_acct, rt.acct_build(0, owner=ft.VOTE_PROGRAM))
+
+    ghost = Ghost(0)
+    forks = Forks(0, root_xid=None)
+    voter = Voter(vote_account=vote_acct, voter_pubkey=pub,
+                  sign=lambda m: ref.sign(secret, m))
+
+    parent_hash = b"\x00" * 32
+    parent_xid = None
+    for slot in (1, 2):
+        ghost.insert(slot, slot - 1)
+        forks.insert(slot, slot - 1)
+        vt = voter.maybe_vote(slot, b"B" * 32, is_ancestor=forks.is_ancestor)
+        assert vt is not None
+        res = rt.execute_block(
+            funk, slot=slot, txns=[vt], parent_bank_hash=parent_hash,
+            parent_xid=parent_xid,
+        )
+        assert res.results[0].status == rt.TXN_SUCCESS
+        forks.freeze(slot, xid=res.xid, bank_hash=res.bank_hash,
+                     poh_hash=b"p" * 32)
+        ghost.vote(pub, slot, 1_000)
+        parent_hash, parent_xid = res.bank_hash, res.xid
+
+    assert ghost.head() == 2
+    from firedancer_tpu.flamenco.executor import acct_decode
+
+    vote_data = acct_decode(funk.rec_query(parent_xid, vote_acct))[3]
+    assert int.from_bytes(vote_data[0:8], "little") == 2  # last voted slot
+    assert int.from_bytes(vote_data[8:16], "little") == 2  # two votes landed
+
+    pruned = forks.publish(1)
+    assert 0 in pruned and forks.root_slot == 1

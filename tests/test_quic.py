@@ -1,0 +1,133 @@
+"""QUIC + TLS 1.3: RFC 9001 Appendix-A key-derivation conformance,
+varints, packet seal/open round-trips, the full handshake over
+in-memory datagrams, and stream delivery into reassembly."""
+
+import pytest
+
+from firedancer_tpu.waltz import quic, tls13
+
+
+# -- RFC 9001 Appendix A: Initial keys for DCID 0x8394c8f03e515708 ------------
+
+
+def test_rfc9001_initial_secrets():
+    dcid = bytes.fromhex("8394c8f03e515708")
+    csec, ssec = quic.initial_secrets(dcid)
+    assert csec == bytes.fromhex(
+        "c00cf151ca5be075ed0ebfb5c80323c42d6b7db67881289af4008f1f6c357aea"
+    )
+    assert ssec == bytes.fromhex(
+        "3c199828fd139efd216c155ad844cc81fb82fa8d7446fa7d78be803acdda951b"
+    )
+    keys = quic.Keys.from_secret(csec)
+    assert keys.iv == bytes.fromhex("fa044b2f42a3fd3b46fb255c")
+    # hp key check: known value drives the Aes schedule; verify by
+    # deriving again at the label layer
+    assert tls13.hkdf_expand_label(csec, "quic key", b"", 16) == bytes.fromhex(
+        "1f369613dd76d5467730efcbe3b1a22d"
+    )
+    assert tls13.hkdf_expand_label(csec, "quic hp", b"", 16) == bytes.fromhex(
+        "9f50449e04a0e810283a1e9933adedd2"
+    )
+
+
+def test_varint_roundtrip():
+    for v in (0, 63, 64, 16383, 16384, (1 << 30) - 1, 1 << 30, (1 << 62) - 1):
+        enc = quic.varint_encode(v)
+        dec, off = quic.varint_decode(enc, 0)
+        assert (dec, off) == (v, len(enc))
+    with pytest.raises(quic.QuicError):
+        quic.varint_encode(1 << 62)
+    # RFC 9000 §A.1 example: 0xc2197c5eff14e88c -> 151288809941952652
+    dec, _ = quic.varint_decode(bytes.fromhex("c2197c5eff14e88c"), 0)
+    assert dec == 151_288_809_941_952_652
+
+
+def test_packet_seal_open_roundtrip():
+    dcid = b"\x11" * 8
+    csec, ssec = quic.initial_secrets(dcid)
+    tx = quic.Keys.from_secret(csec)
+    rx = quic.Keys.from_secret(csec)
+    payload = quic.crypto_frame(0, b"hello quic") + bytes(20)
+    pkt = quic.seal_packet(tx, level=quic.INITIAL, dcid=dcid, scid=b"\x22" * 8,
+                           pn=7, payload=payload)
+    out, end = quic.open_packet(pkt, 0, lambda lvl, d: rx, short_dcid_len=8)
+    assert end == len(pkt)
+    assert out.pn == 7 and out.payload == payload
+    assert out.dcid == dcid and out.scid == b"\x22" * 8
+    # tampering breaks authentication
+    bad = bytearray(pkt)
+    bad[-1] ^= 1
+    with pytest.raises(quic.QuicError, match="authentication"):
+        quic.open_packet(bytes(bad), 0, lambda lvl, d: rx, short_dcid_len=8)
+
+
+def _handshake_pair(**kw):
+    identity = bytes(range(32))
+    from firedancer_tpu.ops.ref import ed25519_ref
+
+    server = quic.Connection.server_new(identity, transport_params=b"srv-tp")
+    client = quic.Connection.client_new(
+        expected_peer=ed25519_ref.public_key(identity),
+        transport_params=b"cli-tp", **kw,
+    )
+    # drive datagrams until both sides are established (reliable pipe)
+    for _ in range(6):
+        for dg in client.flush():
+            server.receive(dg)
+        for dg in server.flush():
+            client.receive(dg)
+        if client.established and server.established:
+            break
+    return client, server
+
+
+def test_full_handshake_and_stream():
+    client, server = _handshake_pair()
+    assert client.established and server.established
+    # transport params crossed over
+    assert client.tls.peer_transport_params == b"srv-tp"
+    assert server.tls.peer_transport_params == b"cli-tp"
+
+    # client->server unidirectional stream (id 2): a txn payload
+    txn = b"\xAB" * 700
+    client.send_stream(2, txn[:400])
+    client.send_stream(2, txn[400:], fin=True)
+    got = []
+    for dg in client.flush():
+        events = server.receive(dg)
+        got += server.receive_stream_events(events)
+    data = b"".join(chunk for _, chunk, _ in got)
+    assert data == txn
+    assert got[-1][2] is True  # fin seen
+
+
+def test_handshake_rejects_wrong_identity():
+    identity = bytes(range(32))
+    wrong_pin = b"\x99" * 32
+    server = quic.Connection.server_new(identity)
+    client = quic.Connection.client_new(expected_peer=wrong_pin)
+    with pytest.raises(tls13.TlsError, match="pinned"):
+        for _ in range(4):
+            for dg in client.flush():
+                server.receive(dg)
+            for dg in server.flush():
+                client.receive(dg)
+
+
+def test_out_of_order_stream_reassembly():
+    client, server = _handshake_pair()
+    ev = [
+        quic.StreamEvent(2, 100, b"B" * 50, False),
+        quic.StreamEvent(2, 0, b"A" * 100, False),
+        quic.StreamEvent(2, 150, b"C" * 10, True),
+    ]
+    chunks = server.receive_stream_events(ev)
+    data = b"".join(c for _, c, _ in chunks)
+    assert data == b"A" * 100 + b"B" * 50 + b"C" * 10
+
+
+def test_client_initial_is_padded():
+    client = quic.Connection.client_new()
+    dgs = client.flush()
+    assert dgs and len(dgs[0]) >= 1200  # §14.1 anti-amplification floor

@@ -195,6 +195,8 @@ def test_vote_program_updates_vote_account():
     secret, voter = keypair(b"voter")
     vote_acct = hashlib.sha256(b"vote-acct").digest()
     fund(funk, voter, 1_000_000)
+    # vote accounts are vote-program-owned (owner-may-modify rule)
+    funk.rec_insert(None, vote_acct, acct_build(0, owner=ft.VOTE_PROGRAM))
     bh = hashlib.sha256(b"bh-v").digest()
     t1 = ft.vote_txn(secret, vote_acct, 100, bh)
     bh2 = hashlib.sha256(b"bh-v2").digest()
@@ -208,9 +210,11 @@ def test_vote_program_updates_vote_account():
     assert [r.status for r in res.results] == [TXN_SUCCESS, TXN_SUCCESS]
     # votes on the same account serialize into separate waves
     assert len(res.waves) == 2
-    data = funk.rec_query(res.xid, vote_acct)
-    assert int.from_bytes(data[8:16], "little") == 101  # last voted slot
-    assert int.from_bytes(data[16:24], "little") == 2   # vote count
+    from firedancer_tpu.flamenco.executor import acct_decode
+
+    data = acct_decode(funk.rec_query(res.xid, vote_acct))[3]
+    assert int.from_bytes(data[0:8], "little") == 101   # last voted slot
+    assert int.from_bytes(data[8:16], "little") == 2    # vote count
     # fees charged to the voter
     assert acct_lamports(funk.rec_query(res.xid, voter)) == (
         1_000_000 - 2 * LAMPORTS_PER_SIGNATURE
@@ -244,3 +248,29 @@ def test_readonly_accounts_reject_writes():
     assert funk.rec_query(res.xid, dest) is None
     # fee still charged
     assert acct_lamports(funk.rec_query(res.xid, pub)) == 1_000_000 - 5000
+
+
+def test_duplicate_account_addresses_rejected():
+    """AccountLoadedTwice analog: a txn listing one address at two
+    account slots would load as independent copies (stale reads, mint/
+    burn at commit) — typed failure, fee untouched."""
+    funk = Funk()
+    secret, pub = keypair(b"dup")
+    bh = hashlib.sha256(b"bh-dup").digest()
+    data = (2).to_bytes(4, "little") + (1).to_bytes(8, "little")
+    msg = ft.message_build(
+        version=ft.VLEGACY,
+        signature_cnt=1,
+        readonly_signed_cnt=0,
+        readonly_unsigned_cnt=1,
+        acct_addrs=[pub, pub, ft.SYSTEM_PROGRAM],  # duplicate!
+        recent_blockhash=bh,
+        instrs=[ft.InstrSpec(program_id=2, accounts=bytes([0, 1]), data=data)],
+    )
+    t = ft.txn_assemble([ref.sign(secret, msg)], msg)
+    fund(funk, pub, 1_000_000)
+    res = execute_block(funk, slot=1, txns=[t])
+    from firedancer_tpu.flamenco.runtime import TXN_ERR_ACCT
+
+    assert res.results[0].status == TXN_ERR_ACCT
+    assert acct_lamports(funk.rec_query(res.xid, pub)) == 1_000_000

@@ -1,0 +1,194 @@
+"""Stake program + warmup ramp + epoch rewards + feature gates."""
+
+import pytest
+
+from firedancer_tpu.flamenco import stake as fs
+from firedancer_tpu.flamenco.executor import Account, Executor, InstrAccount, TxnCtx
+from firedancer_tpu.flamenco.features import FeatureSet, feature_id
+from firedancer_tpu.flamenco.programs import AcctError, FundsError
+from firedancer_tpu.protocol.txn import SYSTEM_PROGRAM
+
+STAKER = b"s" * 32
+WITHDRAWER = b"w" * 32
+VOTER = b"v" * 32
+
+
+def _stake_acct(key=b"K" * 32, lamports=1_000_000):
+    return Account(key, lamports, fs.STAKE_PROGRAM, False,
+                   bytearray(fs._DATA_LEN))
+
+
+def _auth_acct(key):
+    return Account(key, 0, SYSTEM_PROGRAM, False, bytearray())
+
+
+def _ctx(*accts, signer=None, writable=None):
+    n = len(accts)
+    return TxnCtx(
+        accounts=list(accts),
+        signer=signer if signer is not None else [True] * n,
+        writable=writable if writable is not None else [True] * n,
+    )
+
+
+def _ix_init():
+    return (0).to_bytes(4, "little") + STAKER + WITHDRAWER
+
+
+def _ix_delegate(epoch):
+    return (1).to_bytes(4, "little") + epoch.to_bytes(8, "little")
+
+
+def _ix_deactivate(epoch):
+    return (2).to_bytes(4, "little") + epoch.to_bytes(8, "little")
+
+
+def _ix_withdraw(lamports, epoch):
+    return (
+        (3).to_bytes(4, "little")
+        + lamports.to_bytes(8, "little")
+        + epoch.to_bytes(8, "little")
+    )
+
+
+def _delegated_ctx(ex, lamports=1_000_000):
+    stake = _stake_acct(lamports=lamports)
+    vote = Account(VOTER, 1, SYSTEM_PROGRAM, False, bytearray())
+    staker = _auth_acct(STAKER)
+    ctx = _ctx(stake, vote, staker)
+    ia = [InstrAccount(0, False, True), InstrAccount(1, False, False),
+          InstrAccount(2, True, False)]
+    ex.execute_instr(ctx, fs.STAKE_PROGRAM, ia[:1], _ix_init())
+    ex.execute_instr(ctx, fs.STAKE_PROGRAM, ia, _ix_delegate(10))
+    return ctx, stake
+
+
+def test_initialize_delegate_roundtrip():
+    ex = Executor()
+    ctx, stake = _delegated_ctx(ex)
+    st = fs.StakeState.decode(bytes(stake.data))
+    assert st.state == fs.STATE_DELEGATED
+    assert st.voter == VOTER
+    assert st.stake == 1_000_000
+    assert st.activation_epoch == 10
+
+
+def test_delegate_requires_staker_signature():
+    ex = Executor()
+    stake = _stake_acct()
+    vote = Account(VOTER, 1, SYSTEM_PROGRAM, False, bytearray())
+    ctx = _ctx(stake, vote)
+    ex.execute_instr(ctx, fs.STAKE_PROGRAM,
+                     [InstrAccount(0, False, True)], _ix_init())
+    with pytest.raises(AcctError, match="staker signature"):
+        ex.execute_instr(
+            ctx, fs.STAKE_PROGRAM,
+            [InstrAccount(0, False, True), InstrAccount(1, False, False)],
+            _ix_delegate(10),
+        )
+
+
+def test_warmup_ramp():
+    st = fs.StakeState(
+        state=fs.STATE_DELEGATED, voter=VOTER, stake=1000,
+        activation_epoch=10,
+    )
+    assert fs.effective_stake(st, 9) == 0
+    assert fs.effective_stake(st, 10) == 0
+    assert fs.effective_stake(st, 11) == 250
+    assert fs.effective_stake(st, 12) == 500
+    assert fs.effective_stake(st, 14) == 1000
+    assert fs.effective_stake(st, 20) == 1000
+    st.deactivation_epoch = 20
+    assert fs.effective_stake(st, 21) == 750
+    assert fs.effective_stake(st, 24) == 0
+
+
+def test_withdraw_respects_locked_stake():
+    ex = Executor()
+    ctx, stake = _delegated_ctx(ex)
+    dest = _auth_acct(b"d" * 32)
+    wa = _auth_acct(WITHDRAWER)
+    ctx.accounts += [dest, wa]
+    ia = [InstrAccount(0, False, True), InstrAccount(3, False, True),
+          InstrAccount(4, True, False)]
+    # at epoch 14 the full 1M is effective -> nothing free
+    with pytest.raises(FundsError):
+        ex.execute_instr(ctx, fs.STAKE_PROGRAM, ia, _ix_withdraw(1, 14))
+    # deactivate at 20; by 24 all free
+    ex.execute_instr(
+        ctx, fs.STAKE_PROGRAM,
+        [InstrAccount(0, False, True), InstrAccount(2, True, False)],
+        _ix_deactivate(20),
+    )
+    ex.execute_instr(ctx, fs.STAKE_PROGRAM, ia, _ix_withdraw(400_000, 24))
+    assert dest.lamports == 400_000
+    assert stake.lamports == 600_000
+
+
+def test_split():
+    ex = Executor()
+    ctx, stake = _delegated_ctx(ex)
+    new = _stake_acct(key=b"N" * 32, lamports=0)
+    staker = ctx.accounts[2]
+    ctx.accounts.append(new)
+    ex.execute_instr(
+        ctx, fs.STAKE_PROGRAM,
+        [InstrAccount(0, False, True), InstrAccount(3, False, True),
+         InstrAccount(2, True, False)],
+        (4).to_bytes(4, "little") + (250_000).to_bytes(8, "little"),
+    )
+    st = fs.StakeState.decode(bytes(stake.data))
+    nst = fs.StakeState.decode(bytes(new.data))
+    assert (st.stake, nst.stake) == (750_000, 250_000)
+    assert nst.voter == VOTER and nst.activation_epoch == st.activation_epoch
+    assert (stake.lamports, new.lamports) == (750_000, 250_000)
+    _ = staker
+
+
+def test_collect_stakes_and_rewards():
+    def entry(key, stake, voter, act=0):
+        return fs.StakeEntry(key, fs.StakeState(
+            state=fs.STATE_DELEGATED, voter=voter, stake=stake,
+            activation_epoch=act,
+        ))
+
+    entries = [
+        entry(b"a" * 32, 1000, b"V1" + bytes(30)),
+        entry(b"b" * 32, 3000, b"V2" + bytes(30)),
+        entry(b"c" * 32, 500, b"V1" + bytes(30)),
+    ]
+    stakes = fs.collect_stakes(entries, epoch=10)
+    assert stakes == {b"V1" + bytes(30): 1500, b"V2" + bytes(30): 3000}
+
+    rewards = fs.epoch_rewards(
+        entries, {b"V1" + bytes(30): 10, b"V2" + bytes(30): 10},
+        epoch=10, pot=45_000,
+    )
+    # points: a=10000, b=30000, c=5000 -> shares 10/45, 30/45, 5/45
+    assert rewards == {b"a" * 32: 10_000, b"b" * 32: 30_000, b"c" * 32: 5_000}
+
+
+def test_apply_rewards_compounds():
+    a = _stake_acct()
+    st = fs.StakeState(state=fs.STATE_DELEGATED, voter=VOTER, stake=500,
+                       activation_epoch=0)
+    a.data[: fs._DATA_LEN] = st.encode()
+    fs.apply_rewards({a.key: a}, {a.key: 100})
+    assert a.lamports == 1_000_100
+    assert fs.StakeState.decode(bytes(a.data)).stake == 600
+
+
+def test_feature_gates():
+    f = FeatureSet()
+    assert not f.is_active("strict_ed25519_verify", 10**9)
+    f.activate("strict_ed25519_verify", 500)
+    assert not f.is_active("strict_ed25519_verify", 499)
+    assert f.is_active("strict_ed25519_verify", 500)
+    # earlier activation wins; unknown names rejected
+    f.activate("strict_ed25519_verify", 100)
+    assert f.activated["strict_ed25519_verify"] == 100
+    with pytest.raises(KeyError):
+        f.activate("not_a_feature", 0)
+    assert len(feature_id("x")) == 32
+    assert FeatureSet.all_enabled().is_active("fee_burn_half", 0)

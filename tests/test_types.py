@@ -1,0 +1,80 @@
+"""bincode codec combinators + sysvar/vote/gossip types: round-trips,
+exact wire bytes, and malformed-input rejection."""
+
+import pytest
+
+from firedancer_tpu.flamenco import types as T
+
+
+def test_int_and_bool_wire():
+    assert T.U64.encode(1) == (1).to_bytes(8, "little")
+    assert T.I64.encode(-2) == (-2).to_bytes(8, "little", signed=True)
+    assert T.Bool.encode(True) == b"\x01"
+    with pytest.raises(T.CodecError, match="bad bool"):
+        T.Bool.decode(b"\x02")
+    with pytest.raises(T.CodecError, match="short"):
+        T.U32.decode(b"\x01")
+
+
+def test_vec_option_string_roundtrip():
+    v = T.Vec(T.U16)
+    assert v.loads(v.encode([1, 2, 3])) == [1, 2, 3]
+    assert v.encode([7]) == (1).to_bytes(8, "little") + (7).to_bytes(2, "little")
+    o = T.Option(T.U64)
+    assert o.loads(o.encode(None)) is None
+    assert o.loads(o.encode(9)) == 9
+    assert o.encode(None) == b"\x00"
+    s = T.String()
+    assert s.loads(s.encode("héllo")) == "héllo"
+    with pytest.raises(T.CodecError, match="trailing"):
+        T.U8.loads(b"\x01\x02")
+
+
+def test_clock_rent_epoch_schedule():
+    c = T.Clock(slot=5, epoch=1, unix_timestamp=-3)
+    assert T.CLOCK.loads(T.CLOCK.encode(c)) == c
+    assert len(T.CLOCK.encode(c)) == 40
+
+    r = T.Rent()
+    assert T.RENT.loads(T.RENT.encode(r)) == r
+    assert len(T.RENT.encode(r)) == 17
+    # the canonical mainnet rent-exempt minimum for 0-byte accounts
+    assert T.rent_exempt_minimum(r, 0) == 890_880
+
+    es = T.EpochSchedule()
+    assert T.EPOCH_SCHEDULE.loads(T.EPOCH_SCHEDULE.encode(es)) == es
+    assert T.epoch_of_slot(es, 432_000 * 2 + 5) == (2, 5)
+
+
+def test_vote_instruction_wire():
+    vote = T.Vote(slots=[10, 11], hash=b"h" * 32, timestamp=123)
+    enc = T.VOTE_INSTRUCTION.encode(("vote", vote))
+    assert enc[:4] == (2).to_bytes(4, "little")  # enum tag
+    name, decoded = T.VOTE_INSTRUCTION.loads(enc)
+    assert name == "vote" and decoded == vote
+    # no-timestamp form is 1 byte shorter at the tail
+    enc2 = T.VOTE.encode(T.Vote(slots=[1], hash=b"x" * 32))
+    assert enc2[-1:] == b"\x00"
+    with pytest.raises(T.CodecError, match="unknown enum tag"):
+        T.VOTE_INSTRUCTION.loads((99).to_bytes(4, "little"))
+
+
+def test_slot_hashes():
+    shs = [T.SlotHash(3, b"a" * 32), T.SlotHash(2, b"b" * 32)]
+    assert T.SLOT_HASHES.loads(T.SLOT_HASHES.encode(shs)) == shs
+
+
+def test_legacy_contact_info_roundtrip():
+    a = T.sockaddr_v4("127.0.0.1", 8001)
+    ci = T.LegacyContactInfo(
+        id=b"I" * 32, gossip=a, tvu=a, tvu_forwards=a, repair=a, tpu=a,
+        tpu_forwards=a, tpu_vote=a, rpc=a, rpc_pubsub=a, serve_repair=a,
+        wallclock=42, shred_version=7,
+    )
+    enc = T.LEGACY_CONTACT_INFO.encode(ci)
+    out = T.LEGACY_CONTACT_INFO.loads(enc)
+    assert out == ci
+    # v4 socket wire shape: u32 tag 0 | 4 ip bytes | u16 port
+    assert T.SOCKET_ADDR.encode(a) == bytes(4) + bytes([127, 0, 0, 1]) + (
+        8001
+    ).to_bytes(2, "little")

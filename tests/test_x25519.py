@@ -1,0 +1,54 @@
+"""X25519 RFC 7748 vectors + DH agreement + small-order rejection."""
+
+import pytest
+
+from firedancer_tpu.ops.x25519 import public_key, shared_secret, x25519
+
+
+def test_rfc7748_vector_1():
+    k = bytes.fromhex(
+        "a546e36bf0527c9d3b16154b82465edd62144c0ac1fc5a18506a2244ba449ac4"
+    )
+    u = bytes.fromhex(
+        "e6db6867583030db3594c1a424b15f7c726624ec26b3353b10a903a6d0ab1c4c"
+    )
+    assert x25519(k, u) == bytes.fromhex(
+        "c3da55379de9c6908e94ea4df28d084f32eccf03491c71f754b4075577a28552"
+    )
+
+
+def test_rfc7748_vector_2():
+    k = bytes.fromhex(
+        "4b66e9d4d1b4673c5ad22691957d6af5c11b6421e0ea01d42ca4169e7918ba0d"
+    )
+    u = bytes.fromhex(
+        "e5210f12786811d3f4b7959d0538ae2c31dbe7106fc03c3efc4cd549c715a493"
+    )
+    assert x25519(k, u) == bytes.fromhex(
+        "95cbde9476e8907d7aade45cb4b873f88b595a68799fa152e6f8f7647aac7957"
+    )
+
+
+def test_dh_agreement_rfc_6_1():
+    a = bytes.fromhex(
+        "77076d0a7318a57d3c16c17251b26645df4c2f87ebc0992ab177fba51db92c2a"
+    )
+    b = bytes.fromhex(
+        "5dab087e624a8a4b79e17f8b83800ee66f3bb1292618b6fd1c2f8b27ff88e0eb"
+    )
+    assert public_key(a) == bytes.fromhex(
+        "8520f0098930a754748b7ddcb43ef75a0dbf3a0d26381af4eba4a98eaa9b4e6a"
+    )
+    assert public_key(b) == bytes.fromhex(
+        "de9edb7d7b7dc1b4d35b61c2ece435373f8343c85b78674dadfc7e146f882b4f"
+    )
+    ss = bytes.fromhex(
+        "4a5d9d5ba4ce2de1728e3bf480350f25e07e21c947d19e3376f09b3c1e161742"
+    )
+    assert shared_secret(a, public_key(b)) == ss
+    assert shared_secret(b, public_key(a)) == ss
+
+
+def test_small_order_rejected():
+    with pytest.raises(ValueError, match="small-order"):
+        shared_secret(b"\x01" * 32, bytes(32))  # u = 0 is small order
