@@ -1,0 +1,229 @@
+"""Solana-exact gossip wire format (CRDS protocol messages).
+
+Counterpart of the wire layer in /root/reference/src/flamenco/gossip/
+fd_gossip.c: the bincode `Protocol` enum exchanged between validators —
+
+    0 PullRequest(CrdsFilter, CrdsValue)
+    1 PullResponse(Pubkey, Vec<CrdsValue>)
+    2 PushMessage(Pubkey, Vec<CrdsValue>)
+    3 PruneMessage(Pubkey, PruneData)
+    4 PingMessage(Ping)
+    5 PongMessage(Pong)
+
+built from the bincode combinators in flamenco/types.py.  A CrdsValue
+is `signature(64) | CrdsData`, where the Ed25519 signature covers the
+bincode serialization of the CrdsData — exactly the signable-data rule
+CRDS uses.  CrdsData variants implemented: LegacyContactInfo (tag 0),
+the variant cluster discovery runs on; other tags decode to a rejection
+(they cannot be skipped — bincode carries no length prefix for enum
+payloads — and this node never produces them).
+
+The PullRequest filter is encoded faithfully (Bloom { keys, Option
+bits, num_bits_set } + mask/mask_bits); this node sends the match-all
+filter and ignores received filters (serving every record is always
+protocol-legal, just less bandwidth-optimal).
+
+Ping/Pong follow the token scheme: Pong.hash = sha256("SOLANA_PING_PONG"
+|| ping.token), both signed by their sender.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+
+from firedancer_tpu.flamenco import types as T
+from firedancer_tpu.ops.ref import ed25519_ref as ref
+
+PING_PONG_PREFIX = b"SOLANA_PING_PONG"
+
+# -- CrdsData -----------------------------------------------------------------
+
+CRDS_DATA = T.Enum(
+    (0, "legacy_contact_info", T.LEGACY_CONTACT_INFO),
+)
+
+
+@dataclass
+class CrdsValue:
+    signature: bytes
+    data: tuple  # ("legacy_contact_info", LegacyContactInfo)
+
+    def signable(self) -> bytes:
+        return CRDS_DATA.encode(self.data)
+
+    def verify(self) -> bool:
+        kind, payload = self.data
+        return ref.verify(self.signable(), self.signature, payload.id)
+
+    @property
+    def pubkey(self) -> bytes:
+        return self.data[1].id
+
+    @property
+    def wallclock(self) -> int:
+        return self.data[1].wallclock
+
+
+class _CrdsValueCodec(T.Codec):
+    def encode(self, v: CrdsValue) -> bytes:
+        return T.Signature.encode(v.signature) + CRDS_DATA.encode(v.data)
+
+    def decode(self, buf, off=0):
+        sig, off = T.Signature.decode(buf, off)
+        data, off = CRDS_DATA.decode(buf, off)
+        return CrdsValue(sig, data), off
+
+
+CRDS_VALUE = _CrdsValueCodec()
+
+
+def sign_value(secret: bytes, data: tuple) -> CrdsValue:
+    return CrdsValue(ref.sign(secret, CRDS_DATA.encode(data)), data)
+
+
+def contact_info_value(
+    secret: bytes,
+    *,
+    gossip: tuple,
+    tvu: tuple,
+    repair: tuple,
+    tpu: tuple,
+    wallclock: int,
+    shred_version: int = 1,
+) -> CrdsValue:
+    """Build + sign this node's LegacyContactInfo CrdsValue.  Unused
+    sockets carry the unspecified v4 address (the protocol's
+    convention for 'not serving this')."""
+    unspec = ("v4", T.SockAddr(bytes(4), 0))
+    ci = T.LegacyContactInfo(
+        id=ref.public_key(secret),
+        gossip=gossip, tvu=tvu, tvu_forwards=unspec, repair=repair,
+        tpu=tpu, tpu_forwards=unspec, tpu_vote=unspec, rpc=unspec,
+        rpc_pubsub=unspec, serve_repair=repair,
+        wallclock=wallclock, shred_version=shred_version,
+    )
+    return sign_value(secret, ("legacy_contact_info", ci))
+
+
+# -- Ping / Pong --------------------------------------------------------------
+
+
+@dataclass
+class Ping:
+    from_: bytes
+    token: bytes
+    signature: bytes
+
+
+PING = T.StructCodec(
+    Ping, ("from_", T.Pubkey), ("token", T.FixedBytes(32)),
+    ("signature", T.Signature),
+)
+
+
+def ping_make(secret: bytes, token: bytes) -> Ping:
+    return Ping(ref.public_key(secret), token, ref.sign(secret, token))
+
+
+def ping_verify(p: Ping) -> bool:
+    return ref.verify(p.token, p.signature, p.from_)
+
+
+@dataclass
+class Pong:
+    from_: bytes
+    hash: bytes
+    signature: bytes
+
+
+PONG = T.StructCodec(
+    Pong, ("from_", T.Pubkey), ("hash", T.Hash32),
+    ("signature", T.Signature),
+)
+
+
+def pong_make(secret: bytes, ping_token: bytes) -> Pong:
+    h = hashlib.sha256(PING_PONG_PREFIX + ping_token).digest()
+    return Pong(ref.public_key(secret), h, ref.sign(secret, h))
+
+
+def pong_verify(p: Pong, ping_token: bytes) -> bool:
+    want = hashlib.sha256(PING_PONG_PREFIX + ping_token).digest()
+    return p.hash == want and ref.verify(p.hash, p.signature, p.from_)
+
+
+# -- PullRequest filter -------------------------------------------------------
+# CrdsFilter { filter: Bloom { keys: Vec<u64>, bits: BitVec<u64>
+# (Option<Vec<u64>> + u64 len), num_bits_set: u64 }, mask: u64,
+# mask_bits: u32 }
+
+
+class _BloomCodec(T.Codec):
+    def encode(self, v) -> bytes:
+        keys, bits, num_set = v
+        out = T.Vec(T.U64).encode(keys)
+        out += T.Option(T.Vec(T.U64)).encode(bits)
+        out += T.U64.encode(len(bits) * 64 if bits is not None else 0)
+        out += T.U64.encode(num_set)
+        return out
+
+    def decode(self, buf, off=0):
+        keys, off = T.Vec(T.U64).decode(buf, off)
+        bits, off = T.Option(T.Vec(T.U64)).decode(buf, off)
+        _len, off = T.U64.decode(buf, off)
+        num_set, off = T.U64.decode(buf, off)
+        return (keys, bits, num_set), off
+
+
+@dataclass
+class CrdsFilter:
+    bloom: tuple = ((), None, 0)
+    mask: int = (1 << 64) - 1  # match-all
+    mask_bits: int = 0
+
+
+CRDS_FILTER = T.StructCodec(
+    CrdsFilter, ("bloom", _BloomCodec()), ("mask", T.U64),
+    ("mask_bits", T.U32),
+)
+
+
+# -- the Protocol enum --------------------------------------------------------
+
+
+class _Pair(T.Codec):
+    def __init__(self, a: T.Codec, b: T.Codec):
+        self.a, self.b = a, b
+
+    def encode(self, v) -> bytes:
+        return self.a.encode(v[0]) + self.b.encode(v[1])
+
+    def decode(self, buf, off=0):
+        x, off = self.a.decode(buf, off)
+        y, off = self.b.decode(buf, off)
+        return (x, y), off
+
+
+PROTOCOL = T.Enum(
+    (0, "pull_request", _Pair(CRDS_FILTER, CRDS_VALUE)),
+    (1, "pull_response", _Pair(T.Pubkey, T.Vec(CRDS_VALUE, max_len=4096))),
+    (2, "push_message", _Pair(T.Pubkey, T.Vec(CRDS_VALUE, max_len=4096))),
+    (4, "ping", PING),
+    (5, "pong", PONG),
+)
+
+
+def encode_message(name: str, payload) -> bytes:
+    return PROTOCOL.encode((name, payload))
+
+
+def decode_message(buf: bytes):
+    """-> (name, payload) or None on any malformed input (gossip drops
+    bad datagrams silently; counters belong to the node)."""
+    import struct
+
+    try:
+        return PROTOCOL.loads(buf)
+    except (T.CodecError, ValueError, struct.error):
+        return None

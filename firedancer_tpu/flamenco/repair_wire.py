@@ -1,0 +1,127 @@
+"""Solana-exact repair (ServeRepair) wire format.
+
+Counterpart of the wire layer in /root/reference/src/flamenco/repair/
+fd_repair.c: the bincode `RepairProtocol` enum —
+
+     9 WindowIndex        { header, slot: u64, shred_index: u64 }
+    10 HighestWindowIndex { header, slot: u64, shred_index: u64 }
+    11 Orphan             { header, slot: u64 }
+
+with RepairRequestHeader { signature(64), sender, recipient, timestamp
+u64 ms, nonce u32 }.  The signature covers the serialized request with
+the signature bytes EXCISED: the 4-byte enum tag followed by everything
+after the 64-byte signature field (Solana's ServeRepair signing rule —
+the signature cannot cover itself).
+
+A repair response is the raw shred bytes with the u32 LE nonce appended
+(the nonce ties the response to the request so off-path attackers can't
+inject shreds they merely guessed a slot for).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from firedancer_tpu.flamenco import types as T
+from firedancer_tpu.ops.ref import ed25519_ref as ref
+
+
+@dataclass
+class RepairRequestHeader:
+    signature: bytes
+    sender: bytes
+    recipient: bytes
+    timestamp: int
+    nonce: int
+
+
+HEADER = T.StructCodec(
+    RepairRequestHeader,
+    ("signature", T.Signature),
+    ("sender", T.Pubkey),
+    ("recipient", T.Pubkey),
+    ("timestamp", T.U64),
+    ("nonce", T.U32),
+)
+
+
+@dataclass
+class WindowIndex:
+    header: RepairRequestHeader
+    slot: int
+    shred_index: int
+
+
+@dataclass
+class HighestWindowIndex:
+    header: RepairRequestHeader
+    slot: int
+    shred_index: int
+
+
+@dataclass
+class Orphan:
+    header: RepairRequestHeader
+    slot: int
+
+
+_WINDOW = T.StructCodec(
+    WindowIndex, ("header", HEADER), ("slot", T.U64), ("shred_index", T.U64)
+)
+_HIGHEST = T.StructCodec(
+    HighestWindowIndex, ("header", HEADER), ("slot", T.U64),
+    ("shred_index", T.U64),
+)
+_ORPHAN = T.StructCodec(Orphan, ("header", HEADER), ("slot", T.U64))
+
+PROTOCOL = T.Enum(
+    (9, "window_index", _WINDOW),
+    (10, "highest_window_index", _HIGHEST),
+    (11, "orphan", _ORPHAN),
+)
+
+_SIG_START = 4  # after the u32 enum tag
+_SIG_END = 4 + 64
+
+
+def signable_bytes(encoded: bytes) -> bytes:
+    """Tag + everything after the signature field."""
+    return encoded[:_SIG_START] + encoded[_SIG_END:]
+
+
+def sign_request(secret: bytes | None, name: str, payload, *,
+                 signer=None) -> bytes:
+    """Fill payload.header.signature over the serialized request.  Pass
+    `signer` (payload -> 64B sig) to keep the key out-of-process (the
+    keyguard pattern); otherwise `secret` signs locally."""
+    payload.header.signature = bytes(64)
+    enc = PROTOCOL.encode((name, payload))
+    if signer is None:
+        signer = lambda msg: ref.sign(secret, msg)  # noqa: E731
+    payload.header.signature = signer(signable_bytes(enc))
+    return PROTOCOL.encode((name, payload))
+
+
+def verify_request(encoded: bytes):
+    """-> (name, payload) with a valid header signature, else None."""
+    import struct
+
+    try:
+        name, payload = PROTOCOL.loads(encoded)
+    except (T.CodecError, ValueError, struct.error):
+        return None
+    h = payload.header
+    if not ref.verify(signable_bytes(encoded), h.signature, h.sender):
+        return None
+    return name, payload
+
+
+def encode_response(shred: bytes, nonce: int) -> bytes:
+    return shred + nonce.to_bytes(4, "little")
+
+
+def decode_response(buf: bytes):
+    """-> (shred bytes, nonce) or None."""
+    if len(buf) < 5:
+        return None
+    return buf[:-4], int.from_bytes(buf[-4:], "little")

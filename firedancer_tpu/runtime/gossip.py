@@ -1,46 +1,38 @@
-"""Gossip: signed contact-info exchange over UDP (the CRDS core).
+"""Gossip node: CRDS contact-info exchange over UDP, Solana wire format.
 
 The cluster-discovery position of the reference
-(/root/reference/src/flamenco/gossip/fd_gossip.c — Solana's CRDS
-push/pull protocol).  This build implements the protocol's load-bearing
-core with its own compact encoding: a replicated table of SIGNED
-contact-info records, newest-wallclock-wins, spread by push (send my
-record to peers) and pull (ask a peer for its whole table).  The
-Solana-exact bincode encoding layers onto the same table later; what the
-rest of the framework needs — peer discovery feeding Turbine destination
-lists and repair peer selection — consumes the table, not the encoding.
+(/root/reference/src/flamenco/gossip/fd_gossip.c).  Round-3 upgrade:
+the wire format is the protocol's own bincode `Protocol` enum
+(flamenco/gossip_wire.py — PushMessage / PullRequest / PullResponse /
+Ping / Pong carrying signed CrdsValues), replacing the earlier compact
+framing.  The CRDS core semantics are unchanged: a replicated table of
+SIGNED LegacyContactInfo records, newest-wallclock-wins upsert, spread
+by push (my record to peers) and pull (a peer's whole table to me);
+signed records are cached verbatim because only the origin can re-sign
+them (exactly CRDS's rule).
 
-Wire format:
-    record:  32B pubkey | u64 wallclock | u16 shred_version | u32 ip4 |
-             u16 gossip_port | u16 tvu_port | u16 repair_port
-             | 64B sig over the preceding bytes
-    push:    "FDGO" | u8 1 | u16 record_cnt | record*
-    pull_rq: "FDGO" | u8 2
-    (a pull response is a push frame)
-
-Records are verified on receipt; an older wallclock never overwrites a
-newer one (CRDS upsert rule); self-records are refreshed on every push.
+The rest of the framework — Turbine destination lists, repair peer
+selection — consumes the table view (`ContactInfo`), not the wire.
 """
 
 from __future__ import annotations
 
+import os
 import socket
-import struct
 import time
 from dataclasses import dataclass
 
+from firedancer_tpu.flamenco import gossip_wire as gw
+from firedancer_tpu.flamenco import types as T
 from firedancer_tpu.ops.ref import ed25519_ref as ref
 
-MAGIC = b"FDGO"
-T_PUSH = 1
-T_PULL = 2
-
-_REC = struct.Struct("<QHIHHH")  # wallclock, shred_version, ip4, ports x3
-REC_SZ = 32 + _REC.size + 64
+MAX_DATAGRAM = 1200
 
 
 @dataclass(frozen=True)
 class ContactInfo:
+    """Table view over a verified LegacyContactInfo record."""
+
     pubkey: bytes
     wallclock: int
     shred_version: int
@@ -49,27 +41,19 @@ class ContactInfo:
     tvu_port: int
     repair_port: int
 
-    def body(self) -> bytes:
-        return self.pubkey + _REC.pack(
-            self.wallclock, self.shred_version, self.ip4,
-            self.gossip_port, self.tvu_port, self.repair_port,
+    @classmethod
+    def from_crds(cls, ci: T.LegacyContactInfo) -> "ContactInfo":
+        kind, g = ci.gossip
+        ip4 = int.from_bytes(g.ip, "big") if kind == "v4" else 0
+        return cls(
+            pubkey=ci.id,
+            wallclock=ci.wallclock,
+            shred_version=ci.shred_version,
+            ip4=ip4,
+            gossip_port=g.port,
+            tvu_port=ci.tvu[1].port,
+            repair_port=ci.repair[1].port,
         )
-
-
-def encode_record(info: ContactInfo, signer) -> bytes:
-    body = info.body()
-    return body + signer(body)
-
-
-def decode_record(buf: bytes) -> ContactInfo | None:
-    if len(buf) != REC_SZ:
-        return None
-    pubkey = buf[:32]
-    body, sig = buf[:-64], buf[-64:]
-    if not ref.verify(body, sig, pubkey):
-        return None
-    wallclock, sv, ip4, gp, tp, rp = _REC.unpack_from(buf, 32)
-    return ContactInfo(pubkey, wallclock, sv, ip4, gp, tp, rp)
 
 
 class GossipNode:
@@ -94,37 +78,66 @@ class GossipNode:
         self.repair_port = repair_port
         self.clock = clock or (lambda: int(time.time() * 1000))
         self.table: dict[bytes, ContactInfo] = {}
+        self._signed: dict[bytes, gw.CrdsValue] = {}  # pubkey -> signed value
+        self._ping_tokens_by_addr: dict = {}  # peer addr -> pending token
+        self.verified_peers: set[bytes] = set()  # pong-verified pubkeys
         self.metrics = {"push_rx": 0, "pull_rx": 0, "rec_rejected": 0,
-                        "rec_upserted": 0, "rec_stale": 0}
+                        "rec_upserted": 0, "rec_stale": 0,
+                        "ping_rx": 0, "pong_rx": 0}
 
     @property
     def addr(self):
         return self.sock.getsockname()
 
-    def _self_record(self) -> bytes:
-        host, port = self.addr
-        ip4 = int.from_bytes(socket.inet_aton(host), "big")
-        info = ContactInfo(
-            self.pubkey, self.clock(), self.shred_version, ip4,
-            port, self.tvu_port, self.repair_port,
-        )
-        return encode_record(info, lambda m: ref.sign(self._secret, m))
+    # -- record building --
 
-    def _push_frame(self, records: list[bytes]) -> bytes:
-        return (
-            MAGIC + bytes([T_PUSH]) + struct.pack("<H", len(records))
-            + b"".join(records)
+    def _self_value(self) -> gw.CrdsValue:
+        host, port = self.addr
+        me = ("v4", T.SockAddr(socket.inet_aton(host), port))
+        tvu = ("v4", T.SockAddr(socket.inet_aton(host), self.tvu_port))
+        rep = ("v4", T.SockAddr(socket.inet_aton(host), self.repair_port))
+        return gw.contact_info_value(
+            self._secret, gossip=me, tvu=tvu, repair=rep, tpu=me,
+            wallclock=self.clock(), shred_version=self.shred_version,
         )
+
+    def _self_record(self) -> bytes:
+        return gw.CRDS_VALUE.encode(self._self_value())
+
+    @staticmethod
+    def _push_frame(records: list[bytes], from_pubkey: bytes = bytes(32)) -> bytes:
+        """PushMessage from raw CrdsValue bytes (test hook: lets a
+        corrupted record ride a well-formed frame)."""
+        return (
+            (2).to_bytes(4, "little") + from_pubkey
+            + len(records).to_bytes(8, "little") + b"".join(records)
+        )
+
+    # -- send --
 
     def push(self, peers: list[tuple[str, int]]) -> None:
         """Send my (re-signed, fresh-wallclock) record to peers."""
-        frame = self._push_frame([self._self_record()])
+        frame = gw.encode_message("push_message",
+                                  (self.pubkey, [self._self_value()]))
         for p in peers:
             self.sock.sendto(frame, p)
 
     def pull(self, peer: tuple[str, int]) -> None:
-        """Ask a peer for its table (response arrives via poll)."""
-        self.sock.sendto(MAGIC + bytes([T_PULL]), peer)
+        """Ask a peer for its table (match-all filter; response arrives
+        via poll as PullResponse frames)."""
+        frame = gw.encode_message(
+            "pull_request", (gw.CrdsFilter(), self._self_value())
+        )
+        self.sock.sendto(frame, peer)
+
+    def ping(self, peer: tuple[str, int]) -> None:
+        token = os.urandom(32)
+        self._ping_tokens_by_addr[peer] = token
+        self.sock.sendto(
+            gw.encode_message("ping", gw.ping_make(self._secret, token)), peer
+        )
+
+    # -- receive --
 
     def poll(self, burst: int = 32) -> None:
         for _ in range(burst):
@@ -132,52 +145,69 @@ class GossipNode:
                 data, src = self.sock.recvfrom(65536)
             except (BlockingIOError, InterruptedError):
                 return
-            if len(data) < 5 or data[:4] != MAGIC:
+            msg = gw.decode_message(data)
+            if msg is None:
+                self.metrics["rec_rejected"] += 1
                 continue
-            t = data[4]
-            if t == T_PUSH:
+            name, payload = msg
+            if name == "push_message":
                 self.metrics["push_rx"] += 1
-                (cnt,) = struct.unpack_from("<H", data, 5)
-                off = 7
-                for _ in range(cnt):
-                    self._upsert(data[off : off + REC_SZ])
-                    off += REC_SZ
-            elif t == T_PULL:
+                _from, values = payload
+                for v in values:
+                    self._upsert(v)
+            elif name == "pull_response":
+                _from, values = payload
+                for v in values:
+                    self._upsert(v)
+            elif name == "pull_request":
                 self.metrics["pull_rx"] += 1
-                # respond with my record + every cached SIGNED record,
-                # chunked to MTU-sized frames (one giant datagram would
-                # EMSGSIZE past ~570 peers and kill the loop)
-                records = [self._self_record()] + list(
-                    self._signed_cache.values()
-                )
-                per_frame = max(1, (1200 - 7) // REC_SZ)
-                for off in range(0, len(records), per_frame):
-                    self.sock.sendto(
-                        self._push_frame(records[off : off + per_frame]), src
-                    )
+                _filter, caller = payload
+                self._upsert(caller)
+                self._serve_pull(src)
+            elif name == "ping":
+                self.metrics["ping_rx"] += 1
+                if gw.ping_verify(payload):
+                    pong = gw.pong_make(self._secret, payload.token)
+                    self.sock.sendto(gw.encode_message("pong", pong), src)
+            elif name == "pong":
+                self.metrics["pong_rx"] += 1
+                token = self._ping_tokens_by_addr.get(src)
+                if token is not None and gw.pong_verify(payload, token):
+                    self.verified_peers.add(payload.from_)
+                    del self._ping_tokens_by_addr[src]
 
-    # signed records are cached verbatim: we cannot re-sign other
-    # validators' records (we don't have their keys), so pull responses
-    # forward the original signed bytes (exactly what CRDS does)
-    @property
-    def _signed_cache(self) -> dict[bytes, bytes]:
-        if not hasattr(self, "_signed"):
-            self._signed: dict[bytes, bytes] = {}
-        return self._signed
+    def _serve_pull(self, src) -> None:
+        """Respond with my record + every cached signed record, chunked
+        under the datagram MTU (one giant datagram would EMSGSIZE).
+        Frames go through gossip_wire's codec — re-encoding a decoded
+        CrdsValue is byte-identical, so cached signatures survive."""
+        values = [self._self_value()] + list(self._signed.values())
+        per = max(1, MAX_DATAGRAM // max(len(gw.CRDS_VALUE.encode(values[0])), 1))
+        for off in range(0, len(values), per):
+            frame = gw.encode_message(
+                "pull_response", (self.pubkey, values[off : off + per])
+            )
+            self.sock.sendto(frame, src)
 
-    def _upsert(self, rec_bytes: bytes) -> None:
-        info = decode_record(rec_bytes)
-        if info is None:
+    def _upsert(self, value) -> None:
+        if isinstance(value, (bytes, bytearray)):
+            try:
+                value = gw.CRDS_VALUE.loads(bytes(value))
+            except Exception:
+                self.metrics["rec_rejected"] += 1
+                return
+        if not value.verify():
             self.metrics["rec_rejected"] += 1
             return
-        if info.pubkey == self.pubkey:
+        if value.pubkey == self.pubkey:
             return  # my own record reflected back
+        info = ContactInfo.from_crds(value.data[1])
         cur = self.table.get(info.pubkey)
         if cur is not None and cur.wallclock >= info.wallclock:
             self.metrics["rec_stale"] += 1
             return
         self.table[info.pubkey] = info
-        self._signed_cache[info.pubkey] = bytes(rec_bytes)
+        self._signed[info.pubkey] = value
         self.metrics["rec_upserted"] += 1
 
     def peers(self) -> list[ContactInfo]:

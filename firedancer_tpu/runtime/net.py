@@ -167,3 +167,117 @@ def send_stream_txn(
             s.sendto(encode_stream_frame(conn_id, stream_id, chunk, fin), addr)
     finally:
         s.close()
+
+
+class QuicIngressStage(UdpIngressStage):
+    """The real QUIC/TPU server position (fd_quic tile,
+    /root/reference/src/app/fdctl/run/tiles/fd_quic.c): QUIC v1 packets
+    off the UDP socket, one waltz.quic server connection per peer
+    address (the reference shards by UDP flow the same way), handshake
+    via the embedded TLS engine, stream chunks through the TPU
+    reassembler, whole txns published downstream.
+
+    The stage owns the server's Ed25519 identity (in production the
+    sign stage holds it; QUIC cert self-signing is the one role fd_tls
+    keeps near the socket)."""
+
+    def __init__(self, *args, identity_secret: bytes, reasm_depth: int = 64,
+                 max_conns: int = 64, **kwargs):
+        super().__init__(*args, **kwargs)
+        from .tpu_reasm import TpuReasm
+
+        self.identity_secret = identity_secret
+        self.max_conns = max_conns
+        self.conns: dict = {}
+        self.reasm = TpuReasm(depth=reasm_depth)
+
+    def _on_datagram(self, data: bytes, src) -> bool:
+        from firedancer_tpu.waltz import quic, tls13
+
+        conn = self.conns.get(src)
+        fresh = conn is None
+        if fresh:
+            if len(self.conns) >= self.max_conns and not self._evict():
+                self.metrics.inc("conn_drop")
+                return True
+            conn = quic.Connection.server_new(self.identity_secret)
+        try:
+            events = conn.receive(data)
+        except (quic.QuicError, tls13.TlsError):
+            # a failed first datagram never occupies a conn slot: a
+            # garbage-spraying peer (or scanner) must not fill max_conns
+            self.metrics.inc("bad_packet")
+            if not fresh:
+                del self.conns[src]
+            return True
+        if fresh:
+            self.conns[src] = conn
+        self.metrics.inc("pkt_rx")
+        for dg in conn.flush():
+            self.sock.sendto(dg, src)
+        ok = True
+        for sid, chunk, fin in conn.receive_stream_events(events):
+            # every chunk feeds reassembly even under backpressure — the
+            # datagram is already ACKed, so a skipped chunk would be a
+            # permanent hole in its stream; only completed txns can drop
+            txn = self.reasm.append((src, sid), chunk, fin=fin)
+            if txn is None:
+                continue
+            if not self.publish(0, txn, sig=self.metrics.get("txn_rx") + 1):
+                self.metrics.inc("txn_drop_backpressure")
+                ok = False
+                continue
+            self.metrics.inc("txn_rx")
+        return ok
+
+    def _evict(self) -> bool:
+        """Drop a closed or not-yet-established connection to make room
+        (handshake-stalled peers lose their slot first)."""
+        for src, conn in list(self.conns.items()):
+            if conn.closed or not conn.established:
+                del self.conns[src]
+                self.metrics.inc("conn_evict")
+                return True
+        return False
+
+
+class QuicTxnClient:
+    """Handshakes to a QuicIngressStage and ships txns, one
+    client-initiated unidirectional stream (ids 2, 6, 10, ...) per txn —
+    the benchs-tile sender position (src/app/fddev/tiles/fd_benchs.c)."""
+
+    def __init__(self, addr, *, expected_peer: bytes | None = None,
+                 timeout_s: float = 10.0):
+        from firedancer_tpu.waltz import quic
+
+        self.addr = addr
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.sock.settimeout(0.05)
+        self.conn = quic.Connection.client_new(expected_peer=expected_peer)
+        self._next_stream = 2
+        deadline = None
+        import time as _time
+
+        deadline = _time.monotonic() + timeout_s
+        while not self.conn.established:
+            for dg in self.conn.flush():
+                self.sock.sendto(dg, addr)
+            try:
+                data, _ = self.sock.recvfrom(2048)
+                self.conn.receive(data)
+            except socket.timeout:
+                pass
+            if _time.monotonic() > deadline:
+                raise TimeoutError("QUIC handshake timed out")
+        for dg in self.conn.flush():  # final Finished flight
+            self.sock.sendto(dg, addr)
+
+    def send_txn(self, txn: bytes) -> None:
+        sid = self._next_stream
+        self._next_stream += 4
+        self.conn.send_stream(sid, txn, fin=True)
+        for dg in self.conn.flush():
+            self.sock.sendto(dg, self.addr)
+
+    def close(self) -> None:
+        self.sock.close()

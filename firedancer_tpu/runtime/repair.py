@@ -3,17 +3,14 @@
 The repair-protocol position of the reference
 (/root/reference/src/flamenco/repair/fd_repair.c — request shreds the
 turbine fan-out never delivered; served from the peer's blockstore).
-Wire format is this framework's own compact framing (the reference
-speaks Solana's repair protocol; protocol-exact encoding rides on this
-same structure later):
+Round-3 upgrade: the wire format is Solana's ServeRepair protocol
+(flamenco/repair_wire.py — signed RepairRequestHeader, WindowIndex /
+HighestWindowIndex / Orphan requests, shred||nonce responses), replacing
+the earlier compact framing.
 
-    request:  "FDRP" | u8 1 | u64 slot | u32 shred_idx | u32 nonce |
-              32B requester pubkey | 64B sig over the preceding bytes
-    response: "FDRP" | u8 2 | u32 nonce | shred bytes
-
-Requests are signed (the reference signs repair requests so servers can
-prioritize staked peers); the server verifies before serving.  The
-client validates that the response parses and matches the requested
+Requests are signed (servers can prioritize staked peers); the server
+verifies the header signature and the recipient pubkey before serving.
+The client validates that the response parses and matches the requested
 (slot, idx) before handing it to the FEC resolver — repair peers are
 untrusted; the resolver's merkle checks stay the real gate.
 """
@@ -21,49 +18,11 @@ untrusted; the resolver's merkle checks stay the real gate.
 from __future__ import annotations
 
 import socket
-import struct
+import time
 
+from firedancer_tpu.flamenco import repair_wire as rw
 from firedancer_tpu.ops.ref import ed25519_ref as ref
 from firedancer_tpu.protocol import shred as fs
-
-MAGIC = b"FDRP"
-T_REQUEST = 1
-T_RESPONSE = 2
-
-_REQ = struct.Struct("<QII")  # slot, shred_idx, nonce
-
-
-def encode_request(
-    slot: int, shred_idx: int, nonce: int, pubkey: bytes, signer
-) -> bytes:
-    body = MAGIC + bytes([T_REQUEST]) + _REQ.pack(slot, shred_idx, nonce) + pubkey
-    return body + signer(body)
-
-
-def decode_request(buf: bytes):
-    """-> (slot, shred_idx, nonce, pubkey) or None (bad frame/signature)."""
-    if len(buf) != 4 + 1 + _REQ.size + 32 + 64:
-        return None
-    if buf[:4] != MAGIC or buf[4] != T_REQUEST:
-        return None
-    slot, idx, nonce = _REQ.unpack_from(buf, 5)
-    pubkey = buf[5 + _REQ.size : 5 + _REQ.size + 32]
-    sig = buf[-64:]
-    if not ref.verify(buf[:-64], sig, pubkey):
-        return None
-    return slot, idx, nonce, pubkey
-
-
-def encode_response(nonce: int, shred: bytes) -> bytes:
-    return MAGIC + bytes([T_RESPONSE]) + struct.pack("<I", nonce) + shred
-
-
-def decode_response(buf: bytes):
-    """-> (nonce, shred bytes) or None."""
-    if len(buf) < 9 or buf[:4] != MAGIC or buf[4] != T_RESPONSE:
-        return None
-    (nonce,) = struct.unpack_from("<I", buf, 5)
-    return nonce, buf[9:]
 
 
 class Blockstore:
@@ -72,27 +31,46 @@ class Blockstore:
 
     def __init__(self):
         self._shreds: dict[tuple[int, int], bytes] = {}
+        self._max_idx: dict[int, int] = {}  # slot -> highest stored idx
+
+    def _put(self, slot: int, idx: int, buf: bytes) -> None:
+        self._shreds[(slot, idx)] = bytes(buf)
+        if idx > self._max_idx.get(slot, -1):
+            self._max_idx[slot] = idx
 
     def put_set(self, fec_set) -> None:
         for buf in fec_set.data_shreds:
             s = fs.parse(buf)
-            self._shreds[(s.slot, s.idx)] = bytes(buf)
+            self._put(s.slot, s.idx, buf)
 
     def put_shred(self, buf: bytes) -> None:
         s = fs.parse(buf)
         if s is not None and s.is_data:
-            self._shreds[(s.slot, s.idx)] = bytes(buf)
+            self._put(s.slot, s.idx, buf)
 
     def get(self, slot: int, idx: int) -> bytes | None:
         return self._shreds.get((slot, idx))
+
+    def highest(self, slot: int, min_idx: int = 0) -> bytes | None:
+        """The highest-index stored shred of `slot` at idx >= min_idx
+        (the HighestWindowIndex serving rule); O(1) via the per-slot
+        max-index map — the poll loop must not scan the whole store."""
+        hi = self._max_idx.get(slot, -1)
+        if hi < min_idx:
+            return None
+        return self._shreds.get((slot, hi))
 
     def __len__(self) -> int:
         return len(self._shreds)
 
 
 class RepairServer:
-    def __init__(self, store: Blockstore, *, host="127.0.0.1", port=0):
+    def __init__(self, store: Blockstore, identity_secret: bytes | None = None,
+                 *, host="127.0.0.1", port=0):
         self.store = store
+        self.pubkey = (
+            ref.public_key(identity_secret) if identity_secret else None
+        )
         self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         self.sock.bind((host, port))
         self.sock.setblocking(False)
@@ -109,14 +87,23 @@ class RepairServer:
                 data, src = self.sock.recvfrom(2048)
             except (BlockingIOError, InterruptedError):
                 return
-            req = decode_request(data)
+            req = rw.verify_request(data)
             if req is None:
                 self.refused += 1
                 continue
-            slot, idx, nonce, _pub = req
-            shred = self.store.get(slot, idx)
+            name, payload = req
+            h = payload.header
+            if self.pubkey is not None and h.recipient != self.pubkey:
+                self.refused += 1  # misdirected request
+                continue
+            if name == "window_index":
+                shred = self.store.get(payload.slot, payload.shred_index)
+            elif name == "highest_window_index":
+                shred = self.store.highest(payload.slot, payload.shred_index)
+            else:  # orphan: serve the highest shred of the slot
+                shred = self.store.highest(payload.slot)
             if shred is not None:
-                self.sock.sendto(encode_response(nonce, shred), src)
+                self.sock.sendto(rw.encode_response(shred, h.nonce), src)
                 self.served += 1
 
     def close(self):
@@ -124,23 +111,40 @@ class RepairServer:
 
 
 class RepairClient:
-    def __init__(self, identity_secret: bytes, *, signer=None):
+    def __init__(self, identity_secret: bytes, *, signer=None,
+                 pubkey: bytes | None = None):
+        """`signer` (msg -> 64B sig) keeps the real key out-of-process
+        (the sign-stage pattern); pass the matching `pubkey` with it."""
         self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         self.sock.setblocking(False)
-        self.pubkey = ref.public_key(identity_secret)
-        self._signer = signer or (lambda msg: ref.sign(identity_secret, msg))
+        self._secret = identity_secret
+        self._signer = signer
+        self.pubkey = pubkey or ref.public_key(identity_secret)
         self._nonce = 0
         self.metrics = {"req": 0, "ok": 0, "bad_response": 0}
 
+    def _request(self, peer, name: str, payload) -> bytes:
+        return rw.sign_request(self._secret, name, payload,
+                               signer=self._signer)
+
     def request(
-        self, peer, slot: int, shred_idx: int, *, spin=None, max_spins=200_000
+        self, peer, slot: int, shred_idx: int, *, spin=None,
+        max_spins=200_000, recipient: bytes = bytes(32), kind="window_index",
     ) -> bytes | None:
         """One request/response round trip; None on timeout/bad reply."""
         self._nonce += 1
         nonce = self._nonce
-        self.sock.sendto(
-            encode_request(slot, shred_idx, nonce, self.pubkey, self._signer), peer
+        header = rw.RepairRequestHeader(
+            signature=bytes(64), sender=self.pubkey, recipient=recipient,
+            timestamp=int(time.time() * 1000), nonce=nonce,
         )
+        if kind == "window_index":
+            payload = rw.WindowIndex(header, slot, shred_idx)
+        elif kind == "highest_window_index":
+            payload = rw.HighestWindowIndex(header, slot, shred_idx)
+        else:
+            payload = rw.Orphan(header, slot)
+        self.sock.sendto(self._request(peer, kind, payload), peer)
         self.metrics["req"] += 1
         for _ in range(max_spins):
             if spin is not None:
@@ -149,13 +153,15 @@ class RepairClient:
                 data, _src = self.sock.recvfrom(2048)
             except (BlockingIOError, InterruptedError):
                 continue
-            res = decode_response(data)
-            if res is None or res[0] != nonce:
+            res = rw.decode_response(data)
+            if res is None or res[1] != nonce:
                 self.metrics["bad_response"] += 1
                 continue
-            shred = res[1]
+            shred = res[0]
             s = fs.parse(shred)
-            if s is None or s.slot != slot or s.idx != shred_idx:
+            if s is None or s.slot != slot or (
+                kind == "window_index" and s.idx != shred_idx
+            ):
                 self.metrics["bad_response"] += 1
                 continue
             self.metrics["ok"] += 1

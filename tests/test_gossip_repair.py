@@ -127,12 +127,18 @@ def test_repair_refuses_unsigned(stored_set):
     try:
         import socket as s
 
+        from firedancer_tpu.flamenco import repair_wire as rw
+
         sock = s.socket(s.AF_INET, s.SOCK_DGRAM)
         # valid-shaped but garbage-signed request
-        req = bytearray(
-            fr.encode_request(44, 2, 1, b"\x00" * 32, lambda m: b"\x00" * 64)
+        header = rw.RepairRequestHeader(
+            signature=b"\x00" * 64, sender=b"\x00" * 32,
+            recipient=b"\x00" * 32, timestamp=0, nonce=1,
         )
-        sock.sendto(bytes(req), server.addr)
+        req = rw.PROTOCOL.encode(
+            ("window_index", rw.WindowIndex(header, 44, 2))
+        )
+        sock.sendto(req, server.addr)
         for _ in range(50):
             server.poll()
         assert server.refused == 1 and server.served == 0
@@ -160,6 +166,53 @@ def test_repair_completes_fec_set(stored_set):
         done = res.add_shred(got)
         assert done is not None
         assert [bytes(b) for b in done.data_shreds] == list(st.data_shreds)
+    finally:
+        server.close()
+        client.close()
+
+
+def test_repair_wire_signing_rule():
+    """ServeRepair signature covers tag + post-signature bytes; any
+    tamper of slot/index/nonce breaks it."""
+    import hashlib
+
+    from firedancer_tpu.flamenco import repair_wire as rw
+
+    secret = hashlib.sha256(b"rw").digest()
+    header = rw.RepairRequestHeader(
+        signature=bytes(64), sender=ref.public_key(secret),
+        recipient=b"R" * 32, timestamp=123, nonce=7,
+    )
+    enc = rw.sign_request(secret, "window_index", rw.WindowIndex(header, 9, 3))
+    out = rw.verify_request(enc)
+    assert out is not None
+    name, payload = out
+    assert name == "window_index"
+    assert (payload.slot, payload.shred_index, payload.header.nonce) == (9, 3, 7)
+    bad = bytearray(enc)
+    bad[-1] ^= 1  # tamper the shred_index tail
+    assert rw.verify_request(bytes(bad)) is None
+    # responses: shred || nonce
+    r = rw.encode_response(b"shredbytes", 7)
+    assert rw.decode_response(r) == (b"shredbytes", 7)
+
+
+def test_repair_highest_and_orphan(stored_set):
+    st, store, pub = stored_set
+    server = fr.RepairServer(store)
+    client = fr.RepairClient(_secret(b"hw-req"))
+    try:
+        hi = client.request(server.addr, 44, 0, spin=server.poll,
+                            max_spins=2000, kind="highest_window_index")
+        assert hi is not None
+        import firedancer_tpu.protocol.shred as fsh2
+
+        s = fsh2.parse(hi)
+        assert s.slot == 44
+        assert s.idx == max(i for (sl, i) in store._shreds if sl == 44)
+        orph = client.request(server.addr, 44, 0, spin=server.poll,
+                              max_spins=2000, kind="orphan")
+        assert orph is not None and fsh2.parse(orph).slot == 44
     finally:
         server.close()
         client.close()

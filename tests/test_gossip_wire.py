@@ -1,0 +1,101 @@
+"""CRDS wire format: Protocol enum round-trips, CrdsValue signing
+rules, Ping/Pong token scheme, pull chunking, unknown-tag rejection."""
+
+import hashlib
+
+from firedancer_tpu.flamenco import gossip_wire as gw
+from firedancer_tpu.flamenco import types as T
+from firedancer_tpu.ops.ref import ed25519_ref as ref
+from firedancer_tpu.runtime import gossip as fg
+
+
+def _secret(tag):
+    return hashlib.sha256(tag).digest()
+
+
+def _value(tag=b"n1", wallclock=5):
+    a = ("v4", T.SockAddr(bytes([127, 0, 0, 1]), 8000))
+    return gw.contact_info_value(
+        _secret(tag), gossip=a, tvu=a, repair=a, tpu=a, wallclock=wallclock
+    )
+
+
+def test_crds_value_sign_verify_roundtrip():
+    v = _value()
+    assert v.verify()
+    enc = gw.CRDS_VALUE.encode(v)
+    out = gw.CRDS_VALUE.loads(enc)
+    assert out.verify()
+    assert out.pubkey == ref.public_key(_secret(b"n1"))
+    assert out.wallclock == 5
+    # flip a byte inside the signed region -> verify fails
+    bad = bytearray(enc)
+    bad[70] ^= 1
+    assert not gw.CRDS_VALUE.loads(bytes(bad)).verify()
+
+
+def test_protocol_messages_roundtrip():
+    v = _value()
+    for name, payload in [
+        ("push_message", (b"P" * 32, [v, _value(b"n2")])),
+        ("pull_response", (b"P" * 32, [v])),
+        ("pull_request", (gw.CrdsFilter(), v)),
+    ]:
+        enc = gw.encode_message(name, payload)
+        out = gw.decode_message(enc)
+        assert out is not None and out[0] == name
+    assert gw.decode_message(b"\x99" * 40) is None
+    assert gw.decode_message(b"") is None
+    # unknown CrdsData tag inside a push -> whole datagram rejected
+    raw = (2).to_bytes(4, "little") + bytes(32) + (1).to_bytes(8, "little")
+    raw += bytes(64) + (7).to_bytes(4, "little")  # tag 7 unknown
+    assert gw.decode_message(raw) is None
+
+
+def test_ping_pong_token_scheme():
+    token = hashlib.sha256(b"tok").digest()
+    ping = gw.ping_make(_secret(b"pinger"), token)
+    assert gw.ping_verify(ping)
+    pong = gw.pong_make(_secret(b"ponger"), token)
+    assert gw.pong_verify(pong, token)
+    assert not gw.pong_verify(pong, b"\x00" * 32)  # wrong token
+    enc = gw.encode_message("ping", ping)
+    name, out = gw.decode_message(enc)
+    assert name == "ping" and out.token == token
+
+
+def test_node_ping_pong_verifies_peer():
+    a = fg.GossipNode(_secret(b"pa"))
+    b = fg.GossipNode(_secret(b"pb"))
+    try:
+        a.ping(b.addr)
+        for _ in range(3):
+            b.poll()
+            a.poll()
+        assert b.metrics["ping_rx"] == 1
+        assert a.metrics["pong_rx"] == 1
+        assert b.pubkey in a.verified_peers
+    finally:
+        a.close()
+        b.close()
+
+
+def test_pull_response_chunks_under_mtu():
+    serving = fg.GossipNode(_secret(b"srv"))
+    try:
+        # preload the table with many third-party signed records
+        for i in range(20):
+            serving._upsert(_value(b"peer%d" % i, wallclock=10))
+        assert len(serving._signed) == 20
+        puller = fg.GossipNode(_secret(b"cli"))
+        try:
+            puller.pull(serving.addr)
+            for _ in range(5):
+                serving.poll()
+                puller.poll()
+            # puller learned every record (+ the server itself)
+            assert len(puller.table) == 21
+        finally:
+            puller.close()
+    finally:
+        serving.close()
