@@ -1,0 +1,106 @@
+"""Genesis: create/parse the cluster's slot-0 configuration.
+
+Counterpart of /root/reference/src/flamenco/genesis/fd_genesis_create.c
+(+ fd_genesis_cluster.h): the genesis blob seeds the accounts DB with
+the faucet, validator identity/vote/stake accounts and fixes the
+cluster constants (hashes-per-tick, ticks-per-slot, …).  Encoded with
+the bincode combinators; `genesis_hash` (sha256 of the blob) is the
+chain's root "blockhash" — PoH seeds from it and slot 0's bank hash
+chains from it, exactly the bootstrap the reference's fddev `dev`
+command performs (genesis + keys before the validator boots).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+
+from firedancer_tpu.flamenco import types as T
+from firedancer_tpu.flamenco.executor import acct_decode, acct_encode
+from firedancer_tpu.funk import Funk
+
+
+@dataclass
+class GenesisAccount:
+    pubkey: bytes
+    lamports: int
+    owner: bytes
+    executable: bool
+    data: bytes
+
+
+GENESIS_ACCOUNT = T.StructCodec(
+    GenesisAccount,
+    ("pubkey", T.Pubkey),
+    ("lamports", T.U64),
+    ("owner", T.Pubkey),
+    ("executable", T.Bool),
+    ("data", T.VarBytes()),
+)
+
+
+@dataclass
+class Genesis:
+    creation_time: int = 0
+    hashes_per_tick: int = 12_500
+    ticks_per_slot: int = 64
+    slots_per_epoch: int = 432_000
+    faucet_pubkey: bytes = bytes(32)
+    accounts: list = field(default_factory=list)
+
+
+GENESIS = T.StructCodec(
+    Genesis,
+    ("creation_time", T.I64),
+    ("hashes_per_tick", T.U64),
+    ("ticks_per_slot", T.U64),
+    ("slots_per_epoch", T.U64),
+    ("faucet_pubkey", T.Pubkey),
+    ("accounts", T.Vec(GENESIS_ACCOUNT, max_len=1 << 16)),
+)
+
+
+def genesis_create(
+    *,
+    faucet_pubkey: bytes,
+    faucet_lamports: int = 500_000_000_000_000,
+    validator_accounts: list[GenesisAccount] = (),
+    creation_time: int = 0,
+    hashes_per_tick: int = 12_500,
+    ticks_per_slot: int = 64,
+    slots_per_epoch: int = 432_000,
+) -> bytes:
+    g = Genesis(
+        creation_time=creation_time,
+        hashes_per_tick=hashes_per_tick,
+        ticks_per_slot=ticks_per_slot,
+        slots_per_epoch=slots_per_epoch,
+        faucet_pubkey=faucet_pubkey,
+        accounts=[
+            GenesisAccount(faucet_pubkey, faucet_lamports, bytes(32),
+                           False, b""),
+            *validator_accounts,
+        ],
+    )
+    return GENESIS.encode(g)
+
+
+def genesis_parse(blob: bytes) -> Genesis:
+    return GENESIS.loads(blob)
+
+
+def genesis_hash(blob: bytes) -> bytes:
+    return hashlib.sha256(blob).digest()
+
+
+def genesis_boot(blob: bytes, funk: Funk | None = None) -> tuple[Funk, Genesis, bytes]:
+    """Seed a funk root from genesis; -> (funk, genesis, genesis_hash).
+    The boot path fddev takes before the first leader slot."""
+    g = genesis_parse(blob)
+    funk = funk or Funk()
+    for a in g.accounts:
+        funk.rec_insert(
+            None, a.pubkey,
+            acct_encode(a.lamports, a.owner, a.executable, a.data),
+        )
+    return funk, g, genesis_hash(blob)

@@ -1,0 +1,57 @@
+"""Program log collector with Agave-compatible truncation.
+
+Counterpart of /root/reference/src/flamenco/log_collector/ (0.7k LoC):
+programs emit log lines during execution (the VM's sol_log syscalls);
+the collector buffers them per transaction with a byte budget.  The
+truncation rule is the protocol's: once the cumulative byte total would
+exceed the limit, a single "Log truncated" marker replaces everything
+further — partial lines are never emitted.
+"""
+
+from __future__ import annotations
+
+DEFAULT_BYTES_LIMIT = 10_000
+TRUNCATED_MARKER = "Log truncated"
+
+
+class LogCollector:
+    def __init__(self, bytes_limit: int | None = DEFAULT_BYTES_LIMIT):
+        self.bytes_limit = bytes_limit
+        self.lines: list[str] = []
+        self.bytes_written = 0
+        self.truncated = False
+
+    def log(self, line: str | bytes) -> None:
+        if isinstance(line, bytes):
+            line = line.decode("utf-8", "replace")
+        if self.truncated:
+            return
+        if self.bytes_limit is not None:
+            cost = len(line)
+            if self.bytes_written + cost > self.bytes_limit:
+                self.truncated = True
+                self.lines.append(TRUNCATED_MARKER)
+                return
+            self.bytes_written += cost
+        self.lines.append(line)
+
+    # the conventional wrappers programs/runtime emit
+    def program_invoke(self, program_id: bytes, depth: int) -> None:
+        self.log(f"Program {program_id.hex()} invoke [{depth}]")
+
+    def program_success(self, program_id: bytes) -> None:
+        self.log(f"Program {program_id.hex()} success")
+
+    def program_failure(self, program_id: bytes, err: str) -> None:
+        self.log(f"Program {program_id.hex()} failed: {err}")
+
+    def sink(self) -> list:
+        """A list-like adapter for the VM's log_sink parameter."""
+
+        collector = self
+
+        class _Sink(list):
+            def append(self, item):
+                collector.log(item)
+
+        return _Sink()

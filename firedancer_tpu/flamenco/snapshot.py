@@ -1,0 +1,224 @@
+"""Snapshots in the Solana container format: zstd tar + append-vecs.
+
+Counterpart of /root/reference/src/flamenco/snapshot/ (fd_snapshot.h:
+6-25 — load/restore of zstd-compressed tar streams of accounts +
+manifest into funk).  The container layout matches the protocol's:
+
+    version                      "1.2.0"
+    snapshots/<slot>/<slot>      the bank manifest (bincode)
+    accounts/<slot>.<id>         append-vec account storage files
+
+Append-vec entries use the canonical storage record layout, 8-aligned:
+
+    StoredMeta  { write_version u64 | data_len u64 | pubkey 32 }
+    AccountMeta { lamports u64 | rent_epoch u64 | owner 32 | executable u8
+                  | 7B pad }
+    hash 32     (account hash; this build stores sha256 of the fields)
+    data        data_len bytes, padded to 8
+
+The manifest here is this framework's reduced bank state (slot,
+bank_hash, parent hash, account count) encoded with the bincode
+combinators — the full Agave bank bincode (epoch stakes, ancestors,
+hard forks, …) layers onto the same container as the runtime grows.
+Incremental snapshots diff a full base: only accounts whose bytes
+changed (or appeared) since the base land in the archive, restored by
+overlaying base then incremental — the reference's two-archive scheme.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import struct
+import tarfile
+from dataclasses import dataclass
+
+import zstandard
+
+from firedancer_tpu.flamenco import types as T
+from firedancer_tpu.flamenco.executor import acct_decode, acct_encode
+from firedancer_tpu.funk import Funk
+
+SNAPSHOT_VERSION = b"1.2.0"
+
+
+class SnapshotError(RuntimeError):
+    pass
+
+
+@dataclass
+class Manifest:
+    slot: int
+    bank_hash: bytes
+    parent_hash: bytes
+    account_cnt: int
+    base_slot: int = 0  # nonzero marks an incremental snapshot
+    deleted: list = None  # incremental: accounts removed since the base
+
+    def __post_init__(self):
+        if self.deleted is None:
+            self.deleted = []
+
+
+MANIFEST = T.StructCodec(
+    Manifest,
+    ("slot", T.U64),
+    ("bank_hash", T.Hash32),
+    ("parent_hash", T.Hash32),
+    ("account_cnt", T.U64),
+    ("base_slot", T.U64),
+    ("deleted", T.Vec(T.Pubkey, max_len=1 << 24)),
+)
+
+_STORED_META = struct.Struct("<QQ32s")
+_ACCT_META = struct.Struct("<QQ32sB7x")
+
+
+def _entry_encode(pubkey: bytes, val: bytes, write_version: int) -> bytes:
+    lamports, owner, executable, data = acct_decode(val)
+    h = hashlib.sha256(
+        pubkey + lamports.to_bytes(8, "little") + owner
+        + bytes([executable]) + data
+    ).digest()
+    out = _STORED_META.pack(write_version, len(data), pubkey)
+    out += _ACCT_META.pack(lamports, 0, owner, 1 if executable else 0)
+    out += h
+    out += data
+    out += bytes((-len(out)) % 8)
+    return out
+
+
+def _entries_decode(buf: bytes):
+    """Yield (pubkey, value bytes) from an append-vec blob."""
+    off = 0
+    n = len(buf)
+    while off + _STORED_META.size + _ACCT_META.size + 32 <= n:
+        wv, data_len, pubkey = _STORED_META.unpack_from(buf, off)
+        off += _STORED_META.size
+        lamports, _rent, owner, execb = _ACCT_META.unpack_from(buf, off)
+        off += _ACCT_META.size
+        h = buf[off : off + 32]
+        off += 32
+        if off + data_len > n:
+            raise SnapshotError("append-vec entry data past end")
+        data = bytes(buf[off : off + data_len])
+        off += data_len
+        off += (-off) % 8
+        want = hashlib.sha256(
+            pubkey + lamports.to_bytes(8, "little") + owner
+            + bytes([execb & 1]) + data
+        ).digest()
+        if want != h:
+            raise SnapshotError("account hash mismatch in append-vec")
+        yield pubkey, acct_encode(lamports, owner, bool(execb & 1), data)
+
+
+def _root_accounts(funk: Funk) -> dict[bytes, bytes]:
+    """Every live record at the funk root (published state)."""
+    out = {}
+    for key in funk.rec_keys(None):
+        val = funk.rec_query(None, key)
+        if val is not None:
+            out[key] = val
+    return out
+
+
+def snapshot_write(
+    funk: Funk,
+    path: str,
+    *,
+    slot: int,
+    bank_hash: bytes = b"\x00" * 32,
+    parent_hash: bytes = b"\x00" * 32,
+    base: dict[bytes, bytes] | None = None,
+    base_slot: int = 0,
+    level: int = 3,
+) -> int:
+    """Write the funk root into a snapshot archive; returns the account
+    count written.  With `base` (pubkey -> value from a full snapshot),
+    writes an incremental: only new/changed accounts."""
+    accounts = _root_accounts(funk)
+    deleted: list[bytes] = []
+    if base is not None:
+        deleted = sorted(k for k in base if k not in accounts)
+        accounts = {
+            k: v for k, v in accounts.items() if base.get(k) != v
+        }
+    blob = bytearray()
+    for i, (k, v) in enumerate(sorted(accounts.items())):
+        blob += _entry_encode(k, v, write_version=i)
+    man = Manifest(slot, bank_hash, parent_hash, len(accounts),
+                   base_slot=base_slot, deleted=deleted)
+
+    tar_buf = io.BytesIO()
+    with tarfile.open(fileobj=tar_buf, mode="w") as tar:
+        def add(name: str, payload: bytes):
+            info = tarfile.TarInfo(name)
+            info.size = len(payload)
+            tar.addfile(info, io.BytesIO(payload))
+
+        add("version", SNAPSHOT_VERSION)
+        add(f"snapshots/{slot}/{slot}", MANIFEST.encode(man))
+        add(f"accounts/{slot}.0", bytes(blob))
+    comp = zstandard.ZstdCompressor(level=level).compress(tar_buf.getvalue())
+    with open(path, "wb") as f:
+        f.write(comp)
+    return len(accounts)
+
+
+def snapshot_read(path: str) -> tuple[Manifest, dict[bytes, bytes]]:
+    """-> (manifest, pubkey -> account value bytes)."""
+    with open(path, "rb") as f:
+        raw = zstandard.ZstdDecompressor().decompress(
+            f.read(), max_output_size=1 << 31
+        )
+    accounts: dict[bytes, bytes] = {}
+    manifest = None
+    version = None
+    with tarfile.open(fileobj=io.BytesIO(raw), mode="r") as tar:
+        for member in tar.getmembers():
+            payload = tar.extractfile(member)
+            if payload is None:
+                continue
+            body = payload.read()
+            if member.name == "version":
+                version = body
+            elif member.name.startswith("snapshots/"):
+                manifest = MANIFEST.loads(body)
+            elif member.name.startswith("accounts/"):
+                for pubkey, val in _entries_decode(body):
+                    accounts[pubkey] = val
+    if version != SNAPSHOT_VERSION:
+        raise SnapshotError(f"unsupported snapshot version {version!r}")
+    if manifest is None:
+        raise SnapshotError("snapshot has no manifest")
+    if manifest.account_cnt != len(accounts):
+        raise SnapshotError(
+            f"manifest count {manifest.account_cnt} != {len(accounts)}"
+        )
+    return manifest, accounts
+
+
+def snapshot_load(
+    path: str, funk: Funk | None = None,
+    incremental_path: str | None = None,
+) -> tuple[Funk, Manifest]:
+    """Restore a full snapshot (+ optional incremental overlay) into a
+    funk root; the blocking-loader API shape (fd_snapshot.h:6-25)."""
+    manifest, accounts = snapshot_read(path)
+    if manifest.base_slot:
+        raise SnapshotError("full snapshot required (got an incremental)")
+    if incremental_path is not None:
+        inc_man, inc_accounts = snapshot_read(incremental_path)
+        if inc_man.base_slot != manifest.slot:
+            raise SnapshotError(
+                f"incremental base {inc_man.base_slot} != full {manifest.slot}"
+            )
+        accounts.update(inc_accounts)
+        for k in inc_man.deleted:  # removals since the base must not
+            accounts.pop(k, None)  # resurrect on restore
+        manifest = inc_man
+    funk = funk or Funk()
+    for k, v in accounts.items():
+        funk.rec_insert(None, k, v)
+    return funk, manifest

@@ -1,0 +1,178 @@
+"""solcap: execution-effect capture for differential debugging.
+
+Counterpart of /root/reference/src/flamenco/capture/ (fd_solcap_writer.h:
+8-11 and fd_solcap_diff.c): record per-slot execution effects — bank
+hash inputs and every modified account's post-state — so two runtimes
+replaying the same block can be diffed account-by-account instead of
+staring at mismatched bank hashes.
+
+Format: length-framed records in one capture file (the reference uses
+protobuf; this build frames its bincode types the same way):
+
+    "SOLCAP1\\0" file magic, then per record: u32 LE length | record
+
+Record = SlotCap { slot, bank_hash, accounts_delta_hash, signature_cnt,
+fees, accounts: Vec<AccountCap { pubkey, lamports, owner, executable,
+data_hash (sha256; data itself stays out of the capture) } > }.
+
+`diff` compares two captures slot-by-slot and reports the first
+divergence with the exact accounts that differ — the fd_solcap_diff
+workflow.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+
+from firedancer_tpu.flamenco import types as T
+
+MAGIC = b"SOLCAP1\x00"
+
+
+@dataclass
+class AccountCap:
+    pubkey: bytes
+    lamports: int
+    owner: bytes
+    executable: bool
+    data_hash: bytes
+
+
+ACCOUNT_CAP = T.StructCodec(
+    AccountCap,
+    ("pubkey", T.Pubkey),
+    ("lamports", T.U64),
+    ("owner", T.Pubkey),
+    ("executable", T.Bool),
+    ("data_hash", T.Hash32),
+)
+
+
+@dataclass
+class SlotCap:
+    slot: int
+    bank_hash: bytes
+    accounts_delta_hash: bytes
+    signature_cnt: int
+    fees: int
+    accounts: list = field(default_factory=list)
+
+
+SLOT_CAP = T.StructCodec(
+    SlotCap,
+    ("slot", T.U64),
+    ("bank_hash", T.Hash32),
+    ("accounts_delta_hash", T.Hash32),
+    ("signature_cnt", T.U64),
+    ("fees", T.U64),
+    ("accounts", T.Vec(ACCOUNT_CAP, max_len=1 << 20)),
+)
+
+
+def account_cap(pubkey: bytes, value: bytes | None) -> AccountCap:
+    from firedancer_tpu.flamenco.executor import acct_decode
+
+    lamports, owner, executable, data = acct_decode(value)
+    return AccountCap(
+        pubkey, lamports, owner, executable,
+        hashlib.sha256(data).digest(),
+    )
+
+
+class SolcapWriter:
+    """Streamed writer; hook it into execute_block's caller: after each
+    block, `write_slot` with the BlockResult and the touched accounts."""
+
+    def __init__(self, fileobj):
+        self._f = fileobj
+        self._f.write(MAGIC)
+
+    def write_slot(self, cap: SlotCap) -> None:
+        rec = SLOT_CAP.encode(cap)
+        self._f.write(len(rec).to_bytes(4, "little") + rec)
+
+    def capture_block(self, funk, result, payloads_desc=None) -> SlotCap:
+        """Build + write a SlotCap from a runtime BlockResult: every
+        account any txn touched, post-state as seen from the fork."""
+        from firedancer_tpu.protocol import txn as ft
+
+        touched: set[bytes] = set()
+        if payloads_desc:
+            for payload, desc in payloads_desc:
+                touched.update(desc.acct_addrs(payload))
+        _ = ft
+
+        def query(key):
+            from firedancer_tpu.funk import FunkError
+
+            # a published block's xid is gone (merged into root): the
+            # post-state lives at the root then
+            try:
+                return funk.rec_query(result.xid, key)
+            except FunkError:
+                return funk.rec_query(None, key)
+
+        cap = SlotCap(
+            slot=result.slot,
+            bank_hash=result.bank_hash,
+            accounts_delta_hash=hashlib.sha256(
+                result.accounts_delta.tobytes()
+            ).digest(),
+            signature_cnt=result.signature_cnt,
+            fees=result.fees,
+            accounts=[
+                account_cap(a, query(a)) for a in sorted(touched)
+            ],
+        )
+        self.write_slot(cap)
+        return cap
+
+
+def read_capture(fileobj) -> list[SlotCap]:
+    if fileobj.read(len(MAGIC)) != MAGIC:
+        raise ValueError("not a solcap file")
+    out = []
+    while True:
+        hdr = fileobj.read(4)
+        if not hdr:
+            break
+        ln = int.from_bytes(hdr, "little")
+        out.append(SLOT_CAP.loads(fileobj.read(ln)))
+    return out
+
+
+def diff(a: list[SlotCap], b: list[SlotCap]) -> list[str]:
+    """First-divergence report between two captures (fd_solcap_diff's
+    output shape); empty = identical."""
+    report: list[str] = []
+    by_slot_b = {c.slot: c for c in b}
+    for ca in a:
+        cb = by_slot_b.get(ca.slot)
+        if cb is None:
+            report.append(f"slot {ca.slot}: missing from capture B")
+            break  # first divergent slot only
+        if ca.bank_hash != cb.bank_hash:
+            report.append(
+                f"slot {ca.slot}: bank hash {ca.bank_hash.hex()[:16]} != "
+                f"{cb.bank_hash.hex()[:16]}"
+            )
+        if ca.accounts_delta_hash != cb.accounts_delta_hash:
+            report.append(f"slot {ca.slot}: accounts delta hash differs")
+        accts_b = {x.pubkey: x for x in cb.accounts}
+        for x in ca.accounts:
+            y = accts_b.get(x.pubkey)
+            if y is None:
+                report.append(
+                    f"slot {ca.slot}: account {x.pubkey.hex()[:16]} only in A"
+                )
+            elif (x.lamports, x.owner, x.executable, x.data_hash) != (
+                y.lamports, y.owner, y.executable, y.data_hash
+            ):
+                report.append(
+                    f"slot {ca.slot}: account {x.pubkey.hex()[:16]} differs "
+                    f"(lamports {x.lamports} vs {y.lamports})"
+                )
+        if report:
+            break  # first divergent slot is the actionable one
+    return report

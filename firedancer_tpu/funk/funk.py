@@ -165,6 +165,21 @@ class Funk:
     def rec_cnt_root(self) -> int:
         return len(self._root)
 
+    def rec_keys(self, xid: bytes | None) -> list[bytes]:
+        """Every live record key visible from `xid` (root for None) —
+        the snapshot writer's iteration surface."""
+        if xid is None:
+            return list(self._root)
+        keys = set(self._root)
+        for t_xid in self.txn_ancestry(xid):  # oldest -> newest overlay
+            t = self._get(t_xid)
+            for k, v in t.recs.items():
+                if v is _TOMBSTONE:
+                    keys.discard(k)
+                else:
+                    keys.add(k)
+        return list(keys)
+
     # -- internals ----------------------------------------------------------
 
     def _get(self, xid: bytes) -> _Txn:

@@ -71,3 +71,50 @@ def ed25519_verify_batch(
     s_bits = fs.sc_bits(fs.sc_frombytes(s_enc))
     r_cmp = fc.double_scalar_mul_base(k_bits, fc.point_neg(a_pt), s_bits)
     return ok_s & ok_a & ok_r & fc.point_eq_z1(r_cmp, r_pt)
+
+
+# -- split-phase variant ------------------------------------------------------
+#
+# The same computation as four separately jitted programs.  Purpose:
+# compile robustness on tunneled/remote-compile backends — the fused
+# kernel is one large XLA program whose serialized executable has to
+# survive a single RPC; each phase here is a far smaller program (the
+# canary-sized ones compile reliably), at the cost of inter-phase HBM
+# round trips XLA would otherwise fuse away.  Same inputs, same mask.
+
+
+@jax.jit
+def _phase_validate(sig, pubkey):
+    r_enc = sig[:32]
+    ok_s = fs.sc_validate(sig[32:])
+    a_pt, ok_a = fc.point_decompress(pubkey)
+    r_pt, ok_r = fc.point_decompress(r_enc)
+    ok = ok_s & ok_a & ~fc.is_small_order(a_pt)
+    ok = ok & ok_r & ~fc.is_small_order(r_pt)
+    return a_pt, r_pt, ok
+
+
+@functools.partial(jax.jit, static_argnames=("max_msg_len",))
+def _phase_hash(msg, msg_len, sig, pubkey, *, max_msg_len):
+    hmsg = jnp.concatenate([sig[:32], pubkey, msg], axis=0)
+    digest = fsha.sha512_msg(hmsg, msg_len + 64, max_msg_len + 64)
+    return fs.sc_bits(fs.sc_reduce512(digest))
+
+
+@jax.jit
+def _phase_dsm(k_bits, a_pt, sig):
+    s_bits = fs.sc_bits(fs.sc_frombytes(sig[32:]))
+    return fc.double_scalar_mul_base(k_bits, fc.point_neg(a_pt), s_bits)
+
+
+@jax.jit
+def _phase_compare(r_cmp, r_pt, ok):
+    return ok & fc.point_eq_z1(r_cmp, r_pt)
+
+
+def ed25519_verify_batch_split(msg, msg_len, sig, pubkey, *, max_msg_len):
+    """Drop-in for ed25519_verify_batch using the four-phase pipeline."""
+    a_pt, r_pt, ok = _phase_validate(sig, pubkey)
+    k_bits = _phase_hash(msg, msg_len, sig, pubkey, max_msg_len=max_msg_len)
+    r_cmp = _phase_dsm(k_bits, a_pt, sig)
+    return _phase_compare(r_cmp, r_pt, ok)

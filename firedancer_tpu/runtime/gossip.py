@@ -107,11 +107,11 @@ class GossipNode:
     @staticmethod
     def _push_frame(records: list[bytes], from_pubkey: bytes = bytes(32)) -> bytes:
         """PushMessage from raw CrdsValue bytes (test hook: lets a
-        corrupted record ride a well-formed frame)."""
-        return (
-            (2).to_bytes(4, "little") + from_pubkey
-            + len(records).to_bytes(8, "little") + b"".join(records)
-        )
+        corrupt-signature record ride a well-formed frame).  Goes
+        through the wire codec — decode does not verify signatures, so
+        structurally valid corrupt records re-encode byte-identically."""
+        values = [gw.CRDS_VALUE.loads(bytes(r)) for r in records]
+        return gw.encode_message("push_message", (from_pubkey, values))
 
     # -- send --
 

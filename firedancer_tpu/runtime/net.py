@@ -204,11 +204,12 @@ class QuicIngressStage(UdpIngressStage):
         try:
             events = conn.receive(data)
         except (quic.QuicError, tls13.TlsError):
-            # a failed first datagram never occupies a conn slot: a
-            # garbage-spraying peer (or scanner) must not fill max_conns
+            # drop the bad packet only: a fresh conn that failed its
+            # first datagram never occupies a slot (garbage sprayers
+            # can't fill max_conns), and an ESTABLISHED conn must
+            # survive spoofed noise aimed at its address (RFC 9000:
+            # discard undecryptable packets, never tear down)
             self.metrics.inc("bad_packet")
-            if not fresh:
-                del self.conns[src]
             return True
         if fresh:
             self.conns[src] = conn
