@@ -47,12 +47,17 @@ def ed25519_verify_batch(
 ) -> jnp.ndarray:
     """Verify B independent (msg, sig, pubkey) triples.
 
-    msg:     (max_msg_len, B) int32 byte rows (bytes past msg_len ignored)
+    msg:     (max_msg_len, B) byte rows (uint8 or int32; bytes past
+             msg_len ignored) — ship uint8: the host->device transfer is
+             4x smaller and the widening is free on-device
     msg_len: (B,) int32
-    sig:     (64, B) int32 byte rows
-    pubkey:  (32, B) int32 byte rows
+    sig:     (64, B) byte rows
+    pubkey:  (32, B) byte rows
     Returns (B,) bool.
     """
+    msg = msg.astype(jnp.int32)
+    sig = sig.astype(jnp.int32)
+    pubkey = pubkey.astype(jnp.int32)
     r_enc = sig[:32]
     s_enc = sig[32:]
 
@@ -85,6 +90,8 @@ def ed25519_verify_batch(
 
 @jax.jit
 def _phase_validate(sig, pubkey):
+    sig = sig.astype(jnp.int32)
+    pubkey = pubkey.astype(jnp.int32)
     r_enc = sig[:32]
     ok_s = fs.sc_validate(sig[32:])
     a_pt, ok_a = fc.point_decompress(pubkey)
@@ -96,6 +103,9 @@ def _phase_validate(sig, pubkey):
 
 @functools.partial(jax.jit, static_argnames=("max_msg_len",))
 def _phase_hash(msg, msg_len, sig, pubkey, *, max_msg_len):
+    msg = msg.astype(jnp.int32)
+    sig = sig.astype(jnp.int32)
+    pubkey = pubkey.astype(jnp.int32)
     hmsg = jnp.concatenate([sig[:32], pubkey, msg], axis=0)
     digest = fsha.sha512_msg(hmsg, msg_len + 64, max_msg_len + 64)
     return fs.sc_bits(fs.sc_reduce512(digest))
@@ -103,7 +113,7 @@ def _phase_hash(msg, msg_len, sig, pubkey, *, max_msg_len):
 
 @jax.jit
 def _phase_dsm(k_bits, a_pt, sig):
-    s_bits = fs.sc_bits(fs.sc_frombytes(sig[32:]))
+    s_bits = fs.sc_bits(fs.sc_frombytes(sig[32:].astype(jnp.int32)))
     return fc.double_scalar_mul_base(k_bits, fc.point_neg(a_pt), s_bits)
 
 

@@ -1,0 +1,106 @@
+"""Multi-host distributed runtime: DCN-spanning meshes.
+
+The multi-host half of SURVEY §5.8: the reference scales across
+machines with its own wire protocols (gossip/turbine/repair over UDP);
+the TPU-native equivalent for the *compute* plane is jax.distributed —
+every host runs this same program, `initialize()` wires the hosts into
+one runtime, and meshes span all chips with XLA routing collectives
+over ICI within a pod slice and DCN between slices.
+
+Environment contract (the standard jax.distributed one):
+
+    coordinator   host:port of process 0
+    num_processes total host processes
+    process_id    this host's rank
+
+On a single host this degenerates to the local device set — the same
+code path the tests and the dryrun exercise; nothing about the mesh
+construction changes, which is the point: stages written against
+`global_mesh()` are multi-host-ready by construction.
+
+Axis convention (matches parallel/mesh.py): "verify" is the
+data-parallel fan-out axis for the sigverify pipeline; "host" is the
+outer axis when a host-sharded ingress wants host-local batches.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+
+@dataclass
+class HostTopology:
+    num_hosts: int
+    host_id: int
+    local_devices: int
+    global_devices: int
+
+
+def initialize(
+    *,
+    coordinator: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> HostTopology:
+    """Join (or degenerate to) the multi-host runtime.
+
+    Args default from JAX_COORDINATOR / JAX_NUM_PROCESSES / JAX_PROCESS_ID
+    env vars; with none set this is a single-host no-op that still
+    returns an accurate topology — callers never branch."""
+    import jax
+
+    coordinator = coordinator or os.environ.get("JAX_COORDINATOR")
+    num_processes = num_processes or int(
+        os.environ.get("JAX_NUM_PROCESSES", "0")
+    )
+    process_id = (
+        process_id
+        if process_id is not None
+        else int(os.environ.get("JAX_PROCESS_ID", "0"))
+    )
+    if coordinator and num_processes > 1:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    return HostTopology(
+        num_hosts=max(1, num_processes),
+        host_id=process_id,
+        local_devices=jax.local_device_count(),
+        global_devices=jax.device_count(),
+    )
+
+
+def global_mesh(axis: str = "verify"):
+    """One flat mesh over every device in the (possibly multi-host)
+    runtime; the verify fan-out shape."""
+    import jax
+    from jax.sharding import Mesh
+
+    return Mesh(jax.devices(), (axis,))
+
+
+def host_tiled_mesh(inner_axis: str = "verify"):
+    """(host, inner) mesh: the outer axis crosses DCN, the inner axis
+    rides ICI — shard batch by host at ingress, fan out within the
+    slice, and the only cross-host traffic is the final reduction."""
+    import numpy as np
+
+    import jax
+    from jax.sharding import Mesh
+
+    devs = np.array(jax.devices())
+    n_local = max(1, jax.local_device_count())
+    n_hosts = max(1, len(devs) // n_local)
+    grid = devs.reshape(n_hosts, n_local)
+    return Mesh(grid, ("host", inner_axis))
+
+
+def shard_counts(topology: HostTopology, batch: int) -> list[int]:
+    """Per-host batch split, remainder to the low ranks (deterministic
+    on every host: each computes the same answer from the topology)."""
+    base = batch // topology.num_hosts
+    rem = batch % topology.num_hosts
+    return [base + (1 if h < rem else 0) for h in range(topology.num_hosts)]

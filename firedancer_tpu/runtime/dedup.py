@@ -17,7 +17,18 @@ DEDUP_TCACHE_DEPTH = 1 << 16
 class DedupStage(Stage):
     def __init__(self, *args, tcache_depth: int = DEDUP_TCACHE_DEPTH, **kwargs):
         super().__init__(*args, **kwargs)
-        self.tcache = TCache(tcache_depth)
+        # the native C++ tcache is the hot path (fd_dedup.c's position is
+        # all per-frag overhead); the Python ring is the portable fallback
+        try:
+            from firedancer_tpu.tango.tcache_native import NativeTCache
+            from firedancer_tpu.utils.nativebuild import NativeUnavailable
+
+            try:
+                self.tcache = NativeTCache(tcache_depth)
+            except NativeUnavailable:
+                self.tcache = TCache(tcache_depth)
+        except ImportError:
+            self.tcache = TCache(tcache_depth)
 
     def after_frag(self, in_idx: int, meta, payload: bytes) -> None:
         from firedancer_tpu.tango.rings import MCache

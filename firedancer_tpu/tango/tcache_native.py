@@ -1,0 +1,89 @@
+"""ctypes binding for the native tcache (native/fd_tcache.cpp).
+
+Same semantics as tango/rings.py TCache (fd_tcache.h parity: tag 0 is
+null, insert-evicts-oldest); plus a bulk insert that amortizes the
+ctypes crossing over a batch of tags.  Falls back unavailable cleanly —
+callers keep the Python TCache when the toolchain is missing.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+from firedancer_tpu.utils.nativebuild import NativeUnavailable, build_so
+
+_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
+_SRC = os.path.abspath(os.path.join(_DIR, "fd_tcache.cpp"))
+_SO = os.path.abspath(os.path.join(_DIR, "fd_tcache.so"))
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    build_so(_SRC, _SO)
+    lib = ctypes.CDLL(_SO)
+    lib.tcache_new.restype = ctypes.c_void_p
+    lib.tcache_new.argtypes = [ctypes.c_uint64]
+    lib.tcache_delete.argtypes = [ctypes.c_void_p]
+    lib.tcache_query.restype = ctypes.c_int
+    lib.tcache_query.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.tcache_insert.restype = ctypes.c_int
+    lib.tcache_insert.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.tcache_insert_bulk.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_uint8),
+    ]
+    _lib = lib
+    return lib
+
+
+class NativeTCache:
+    def __init__(self, depth: int):
+        lib = _load()
+        self.depth = depth
+        self._lib = lib
+        self._h = lib.tcache_new(depth)
+        if not self._h:
+            raise NativeUnavailable("tcache_new failed")
+
+    def query(self, tag: int) -> bool:
+        return bool(self._lib.tcache_query(self._h, tag & (2**64 - 1)))
+
+    def insert(self, tag: int) -> bool:
+        return bool(self._lib.tcache_insert(self._h, tag & (2**64 - 1)))
+
+    def insert_bulk(self, tags) -> np.ndarray:
+        """tags: iterable/array of u64 -> bool array (True = duplicate).
+
+        One ctypes crossing for the whole batch (~4x the scalar path's
+        throughput).  The mux-parity stages poll one frag at a time, so
+        the per-frag path uses scalar insert; this serves bulk callers
+        (replay-side wave dedup, tests, future batched ingress)."""
+        arr = np.ascontiguousarray(np.asarray(tags, dtype=np.uint64))
+        out = np.zeros(arr.size, dtype=np.uint8)
+        self._lib.tcache_insert_bulk(
+            self._h,
+            arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            arr.size,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        )
+        return out.astype(bool)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.tcache_delete(self._h)
+            self._h = None
+
+    def __del__(self):  # belt-and-braces; close() is the real API
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,124 @@
+"""Microbench fe_mul formulations on the live backend.
+
+The production fe_mul builds the 41-term convolution from 20 shifted
+pads; if XLA materializes those in HBM the op is bandwidth-bound at
+~50 MB per multiply.  Candidates:
+
+  pad      — production formulation (limbs._conv)
+  shear    — one (20,20,B) product tensor, anti-diagonal reduction via
+             the pad/flatten/reshape shear trick (7 HLO ops)
+  unroll   — fully unrolled row sums (400 mults, no pads; big HLO)
+
+Each runs as a 64-deep dependent chain (the dsm's dependency shape) at
+the given batch; timings use real host fetches (block_until_ready lies
+on tunneled backends).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..")))
+
+import numpy as np
+
+
+def main():
+    from firedancer_tpu.utils.platform import enable_compile_cache
+
+    enable_compile_cache()
+    import jax
+    import jax.numpy as jnp
+
+    from firedancer_tpu.ops import limbs as fl
+
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 16384
+    reps = 64
+    dev = jax.devices()[0]
+    print(f"# device {dev.platform}:{dev.device_kind} batch={batch} "
+          f"chain={reps}", file=sys.stderr)
+    out = {"batch": batch, "chain": reps, "backend": dev.platform}
+
+    N = fl.NLIMB
+
+    def conv_pad(a, b):
+        return fl._conv(a, b)
+
+    def conv_shear(a, b):
+        # (20,20,B) products; shear so anti-diagonals align as columns
+        prods = a[:, None] * b[None, :]            # (N, N, B)
+        width = 2 * N + 1
+        p = jnp.pad(prods, [(0, 0), (0, width - N), (0, 0)])  # (N, 41, B)
+        flat = p.reshape((N * width,) + prods.shape[2:])
+        flat = flat[: N * width - N]               # drop N tail rows
+        sheared = flat.reshape((N, width - 1) + prods.shape[2:])
+        # sheared[i, k] = prods[i, k - i] for k-i in [0, 41); wait:
+        # dropping N then reshaping to width-1=40 shifts row i LEFT by i,
+        # so column k holds prods[i, k + i]? verified numerically below.
+        return jnp.pad(sheared.sum(0), [(0, 1)] + [(0, 0)] * (a.ndim - 1))
+
+    def conv_unroll(a, b):
+        rows_a = [a[i] for i in range(N)]
+        rows_b = [b[j] for j in range(N)]
+        c = []
+        for k in range(2 * N + 1):
+            terms = [
+                rows_a[i] * rows_b[k - i]
+                for i in range(max(0, k - N + 1), min(N, k + 1))
+            ]
+            c.append(sum(terms) if terms else jnp.zeros_like(rows_a[0]))
+        return jnp.stack(c)
+
+    rng = np.random.default_rng(0)
+    a_np = rng.integers(0, 1 << 13, (N, batch), dtype=np.int32)
+    b_np = rng.integers(0, 1 << 13, (N, batch), dtype=np.int32)
+
+    # correctness cross-check on a tiny batch first (host)
+    at, bt = a_np[:, :4].astype(np.int64), b_np[:, :4].astype(np.int64)
+    want = np.zeros((2 * N + 1, 4), dtype=np.int64)
+    for i in range(N):
+        for j in range(N):
+            want[i + j] += at[i] * bt[j]
+
+    def check(fn, name):
+        got = np.asarray(fn(jnp.asarray(a_np[:, :4]), jnp.asarray(b_np[:, :4])))
+        okmask = np.array_equal(got.astype(np.int64), want)
+        print(f"# {name} correct: {okmask}", file=sys.stderr)
+        return okmask
+
+    variants = {}
+    for name, fn in [("pad", conv_pad), ("shear", conv_shear),
+                     ("unroll", conv_unroll)]:
+        if check(fn, name):
+            variants[name] = fn
+
+    a = jax.device_put(jnp.asarray(a_np), dev)
+    b = jax.device_put(jnp.asarray(b_np), dev)
+
+    for name, fn in variants.items():
+        def chain(x, _fn=fn):
+            def body(_, acc):
+                c = _fn(acc, b)
+                return fl._conv_fold(c)
+            return jax.lax.fori_loop(0, reps, body, x)
+
+        j = jax.jit(chain)
+        t0 = time.time()
+        r = int(np.asarray(jnp.sum(j(a))))  # compile + run + fetch
+        print(f"# {name}: compile+first {time.time()-t0:.1f}s", file=sys.stderr)
+        t0 = time.time()
+        for _ in range(3):
+            r = int(np.asarray(jnp.sum(j(a))))
+        dt = (time.time() - t0) / 3
+        per_op_us = dt / reps * 1e6
+        out[name + "_ms"] = round(dt * 1e3, 2)
+        out[name + "_us_per_op"] = round(per_op_us, 1)
+        print(f"# {name}: {dt*1e3:.1f} ms chain, {per_op_us:.0f} us/op",
+              file=sys.stderr)
+    _ = r
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,90 @@
+"""TPU microbench: where do the sigverify microseconds go?
+
+Times each split-kernel phase and a raw chained fe_mul loop on the
+current default backend (the axon TPU when the tunnel is up).  Emits
+one JSON line; safe to rerun — shapes are cached after first compile.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..")))
+
+import numpy as np
+
+
+def main():
+    from firedancer_tpu.utils.platform import enable_compile_cache
+
+    enable_compile_cache()
+    import jax
+    import jax.numpy as jnp
+
+    import __graft_entry__ as ge
+    from firedancer_tpu.ops import limbs as fl
+    from firedancer_tpu.ops import sigverify as sv
+
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 16384
+    dev = jax.devices()[0]
+    print(f"# device {dev.platform}:{dev.device_kind} batch={batch}",
+          file=sys.stderr)
+
+    msg, ml, sig, pk = ge._example_batch(batch)
+    msg, ml, sig, pk = (jax.device_put(jnp.asarray(x), dev)
+                        for x in (msg, ml, sig, pk))
+    out = {"batch": batch, "backend": dev.platform}
+
+    def fetch(r):
+        """Force real execution: block_until_ready on this tunneled
+        backend confirms enqueue, not completion — a host fetch of a
+        reduction is the only trustworthy barrier."""
+        leaves = jax.tree_util.tree_leaves(r)
+        return sum(
+            float(jnp.sum(x.astype(jnp.float32) if x.dtype != jnp.bool_
+                          else x.astype(jnp.int32)))
+            for x in leaves
+        )
+
+    def timeit(name, fn, reps=4):
+        r = fn()
+        fetch(r)
+        t0 = time.time()
+        for _ in range(reps):
+            fetch(fn())
+        dt = (time.time() - t0) / reps
+        out[name + "_ms"] = round(dt * 1e3, 2)
+        print(f"# {name}: {dt*1e3:.2f} ms", file=sys.stderr)
+        return r
+
+    a_pt, r_pt, ok = timeit(
+        "phase_validate", lambda: sv._phase_validate(sig, pk))
+    k_bits = timeit(
+        "phase_hash",
+        lambda: sv._phase_hash(msg, ml, sig, pk, max_msg_len=msg.shape[0]))
+    r_cmp = timeit("phase_dsm", lambda: sv._phase_dsm(k_bits, a_pt, sig))
+    timeit("phase_compare", lambda: sv._phase_compare(r_cmp, r_pt, ok))
+
+    # raw fe_mul chain: 256 dependent multiplies at this batch
+    x = jnp.asarray(
+        np.random.default_rng(0).integers(0, 1 << 13, (fl.NLIMB, batch),
+                                          dtype=np.int32))
+
+    @jax.jit
+    def mul_chain(v):
+        return jax.lax.fori_loop(0, 256, lambda _, a: fl.fe_mul(a, v), v)
+
+    timeit("fe_mul_x256", lambda: mul_chain(x))
+
+    @jax.jit
+    def sqr_chain(v):
+        return jax.lax.fori_loop(0, 256, lambda _, a: fl.fe_sqr(a), v)
+
+    timeit("fe_sqr_x256", lambda: sqr_chain(x))
+
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -121,7 +121,10 @@ def test_duplicates_are_dropped():
         n_verify=1, pool_size=32, gen_limit=96, batch=64, max_msg_len=256
     )
     try:
-        pipe.run(until_txns=32, max_iters=200_000)
+        # until_txns would stop generation at pack==32 — a FASTER dedup
+        # then strands ungenerated dups (finish() zeroes benchg.limit).
+        # Sweep on iterations instead so all 96 frags flow before drain.
+        pipe.run(until_txns=None, max_iters=3_000)
         report = pipe.report()
         dups = report["verify0"].get("dedup_dup", 0) + report["dedup"].get(
             "dedup_dup", 0

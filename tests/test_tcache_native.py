@@ -1,0 +1,77 @@
+"""Native C++ tcache: differential parity vs the Python TCache, bulk
+path, eviction order, probe-cluster deletion correctness."""
+
+import numpy as np
+import pytest
+
+from firedancer_tpu.tango.rings import TCache
+
+from firedancer_tpu.tango import tcache_native as nat
+from firedancer_tpu.utils.nativebuild import NativeUnavailable
+
+try:
+    nat._load()
+    HAVE_NATIVE = True
+except NativeUnavailable:
+    HAVE_NATIVE = False
+
+pytestmark = pytest.mark.skipif(not HAVE_NATIVE, reason="no C++ toolchain")
+
+
+@pytest.fixture
+def pair():
+    n = nat.NativeTCache(64)
+    yield TCache(64), n
+    n.close()
+
+
+def test_differential_vs_python(pair):
+    py, cc = pair
+    rng = np.random.default_rng(11)
+    # a stream with heavy duplication stresses eviction + re-probe paths
+    tags = rng.integers(1, 200, 5000, dtype=np.uint64)
+    for t in tags:
+        assert py.insert(int(t)) == cc.insert(int(t))
+    for t in range(1, 250):
+        assert py.query(t) == cc.query(t)
+
+
+def test_null_tag_never_dedups(pair):
+    _, cc = pair
+    assert cc.insert(0) is False
+    assert cc.insert(0) is False
+    assert cc.query(0) is False
+
+
+def test_eviction_oldest_first():
+    cc = nat.NativeTCache(4)
+    try:
+        for t in (1, 2, 3, 4):
+            assert cc.insert(t) is False
+        assert cc.insert(5) is False  # evicts 1
+        assert not cc.query(1)
+        assert all(cc.query(t) for t in (2, 3, 4, 5))
+    finally:
+        cc.close()
+
+
+def test_bulk_matches_scalar():
+    scalar = nat.NativeTCache(128)
+    bulk = nat.NativeTCache(128)
+    try:
+        rng = np.random.default_rng(5)
+        tags = rng.integers(0, 300, 2000, dtype=np.uint64)
+        want = np.array([scalar.insert(int(t)) for t in tags])
+        got = bulk.insert_bulk(tags)
+        assert np.array_equal(want, got)
+    finally:
+        scalar.close()
+        bulk.close()
+
+
+def test_dedup_stage_uses_native():
+    from firedancer_tpu.runtime.dedup import DedupStage
+    from firedancer_tpu.tango.tcache_native import NativeTCache
+
+    st = DedupStage("dedup")
+    assert isinstance(st.tcache, NativeTCache)
