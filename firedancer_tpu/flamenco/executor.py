@@ -120,6 +120,7 @@ class TxnCtx:
     logs: list[bytes] = field(default_factory=list)
     stack: list[bytes] = field(default_factory=list)  # program ids
     return_data: tuple[bytes, bytes] = (bytes(32), b"")
+    sysvars: dict = field(default_factory=dict)  # name -> bincode blob
 
     def charge(self, n: int) -> None:
         self.cu_used += n
@@ -199,6 +200,9 @@ class Executor:
         blob, smap = serialize_aligned(ctx, iaccts, data, program_id)
         v = fvm.Vm(program=prog, input_data=blob,
                    budget=ctx.budget - ctx.cu_used)
+        v.sysvars = ctx.sysvars
+        v.return_data = ctx.return_data
+        v.program_id = program_id
         fvm.register_default_syscalls(v, log_sink=ctx.logs)
         register_cpi_syscall(self, v, ctx, iaccts, program_id, smap,
                              pda_signers)
@@ -213,6 +217,9 @@ class Executor:
             raise InstrError("compute budget exceeded")
         if r0 != 0:
             raise InstrError(f"program error 0x{r0:x}", custom=r0)
+        # attribution already correct (set inside the syscall); clears
+        # (empty data) propagate too
+        ctx.return_data = v.return_data
         writeback_aligned(ctx, v, smap, program_id)
 
 
@@ -428,6 +435,7 @@ def register_cpi_syscall(executor, v, ctx, caller_iaccts, caller_program_id,
         finally:
             ctx.cu_used -= vm_.cu_used
             sync_into_vm(ctx, vm_, smap)
+        vm_.return_data = ctx.return_data  # callee's return data visible
         return 0
 
     v.syscalls[fvm.SYSCALL_SOL_INVOKE_SIGNED_C] = sol_invoke_signed_c

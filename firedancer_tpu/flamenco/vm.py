@@ -91,6 +91,13 @@ class Vm:
         self.call_stack: list[tuple[int, int, int, int, int]] = []  # (ret_pc, r6..r9)
         self.heap_pos = 0  # bump cursor for sol_alloc_free_
         self.logs: list[bytes] = []
+        # sysvars the runtime exposes to the program (bincode-encoded
+        # blobs keyed "clock"/"rent"/"epoch_schedule"); return data is the
+        # (program_id, bytes) pair CPI callers read back; program_id is
+        # the executing program (sol_set_return_data attributes to it)
+        self.sysvars: dict[str, bytes] = {}
+        self.return_data: tuple[bytes, bytes] = (bytes(32), b"")
+        self.program_id: bytes = bytes(32)
 
     def charge(self, n: int) -> None:
         """Charge `n` compute units; syscalls use this for their fixed +
@@ -297,6 +304,13 @@ SYSCALL_SOL_LOG_DATA = _sid("sol_log_data")
 SYSCALL_SOL_PANIC = _sid("sol_panic_")
 SYSCALL_SOL_INVOKE_SIGNED_C = _sid("sol_invoke_signed_c")
 SYSCALL_SOL_ALT_BN128 = _sid("sol_alt_bn128_group_op")
+SYSCALL_SOL_GET_CLOCK = _sid("sol_get_clock_sysvar")
+SYSCALL_SOL_GET_RENT = _sid("sol_get_rent_sysvar")
+SYSCALL_SOL_GET_EPOCH_SCHEDULE = _sid("sol_get_epoch_schedule_sysvar")
+SYSCALL_SOL_SET_RETURN_DATA = _sid("sol_set_return_data")
+SYSCALL_SOL_GET_RETURN_DATA = _sid("sol_get_return_data")
+
+MAX_RETURN_DATA = 1024
 
 # sol_alt_bn128_group_op op selectors (Solana's ALT_BN128_* convention)
 ALT_BN128_ADD = 0
@@ -536,6 +550,47 @@ def register_default_syscalls(vm: Vm, *, log_sink: list | None = None) -> None:
         vm_._write_span(result_addr, out)
         return 0
 
+    # -- sysvars + return data ------------------------------------------------
+
+    def _sysvar_getter(name):
+        def getter(vm_, out_addr, *_):
+            vm_.charge(SYSCALL_BASE_COST)
+            blob = vm_.sysvars.get(name)
+            if blob is None:
+                return 1  # sysvar not provided by the runtime context
+            vm_._write_span(out_addr, blob)
+            return 0
+
+        return getter
+
+    def sol_set_return_data(vm_, addr, sz, *_):
+        vm_.charge(SYSCALL_BASE_COST + sz // CPI_BYTES_PER_CU)
+        if sz > MAX_RETURN_DATA:
+            raise VmError(f"return data too long ({sz})")
+        data = vm_.mem_read_bytes(addr, sz) if sz else b""
+        # attribution happens HERE (the setter's program id), so clears
+        # (sz=0) take effect and inherited data is never re-attributed
+        vm_.return_data = (vm_.program_id, data)
+        return 0
+
+    def sol_get_return_data(vm_, addr, sz, program_id_addr, *_):
+        vm_.charge(SYSCALL_BASE_COST)
+        pid, data = vm_.return_data
+        if not data:
+            return 0
+        n = min(sz, len(data))
+        if n:
+            vm_._write_span(addr, data[:n])
+            vm_._write_span(program_id_addr, pid)
+        return len(data)
+
+    vm.syscalls[SYSCALL_SOL_GET_CLOCK] = _sysvar_getter("clock")
+    vm.syscalls[SYSCALL_SOL_GET_RENT] = _sysvar_getter("rent")
+    vm.syscalls[SYSCALL_SOL_GET_EPOCH_SCHEDULE] = _sysvar_getter(
+        "epoch_schedule"
+    )
+    vm.syscalls[SYSCALL_SOL_SET_RETURN_DATA] = sol_set_return_data
+    vm.syscalls[SYSCALL_SOL_GET_RETURN_DATA] = sol_get_return_data
     vm.syscalls[SYSCALL_SOL_ALT_BN128] = sol_alt_bn128_group_op
     vm.syscalls[SYSCALL_SOL_SECP256K1_RECOVER] = sol_secp256k1_recover
     vm.syscalls[SYSCALL_SOL_CREATE_PROGRAM_ADDRESS] = sol_create_program_address

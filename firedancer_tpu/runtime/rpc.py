@@ -51,43 +51,38 @@ class PipelineView:
 
 
 class RpcServer:
+    """Serves JSON-RPC over the framework's own HTTP parser and JSON
+    lexer (protocol/http.py, protocol/jsonlex.py — the ballet http/json
+    counterparts sit on the untrusted socket, exactly like the
+    reference's rpcserver uses its own vendored parsers)."""
+
     def __init__(self, view, *, host: str = "127.0.0.1", port: int = 0):
-        import http.server
-
-        server = self
-
-        class Handler(http.server.BaseHTTPRequestHandler):
-            timeout = 10
-
-            def do_POST(self):  # noqa: N802 (http.server API)
-                try:
-                    n = int(self.headers.get("Content-Length", 0))
-                    req = json.loads(self.rfile.read(n))
-                    resp = server._dispatch(req)
-                except Exception:
-                    resp = {
-                        "jsonrpc": "2.0",
-                        "id": None,
-                        "error": {"code": -32700, "message": "parse error"},
-                    }
-                body = json.dumps(resp).encode()
-                self.send_response(200)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-
-            def log_message(self, *args):
-                pass
+        from firedancer_tpu.protocol import http as H
+        from firedancer_tpu.protocol import jsonlex as J
 
         self.view = view
-        self._httpd = http.server.ThreadingHTTPServer((host, port), Handler)
-        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
-        self._thread.start()
+
+        def handler(req, body):
+            try:
+                parsed = J.loads(body)
+                resp = self._dispatch(parsed)
+            except Exception:
+                resp = {
+                    "jsonrpc": "2.0",
+                    "id": None,
+                    "error": {"code": -32700, "message": "parse error"},
+                }
+            return H.build_response(
+                200, J.dumps(resp).encode(),
+                content_type="application/json",
+            )
+
+        self._srv = H.MiniServer(handler, host=host, port=port,
+                                 max_body=J.MAX_LEN)
 
     @property
     def addr(self):
-        return self._httpd.server_address
+        return self._srv.addr
 
     def _dispatch(self, req: dict) -> dict:
         rid = req.get("id")
@@ -125,8 +120,7 @@ class RpcServer:
             return err(-32603, f"internal error: {type(e).__name__}")
 
     def close(self):
-        self._httpd.shutdown()
-        self._httpd.server_close()
+        self._srv.close()
 
 
 def rpc_call(addr, method: str, params=None, *, rid: int = 1):

@@ -172,48 +172,31 @@ class MetricsServer:
     mutated live; every scrape renders the current registries."""
 
     def __init__(self, stages: dict[str, MetricsRegistry], *, host="127.0.0.1", port=0):
-        import http.server
-        import threading
-
-        registry = self  # closure hook
-
-        class Handler(http.server.BaseHTTPRequestHandler):
-            def do_GET(self):  # noqa: N802 (http.server API)
-                if self.path not in ("/metrics", "/"):
-                    self.send_response(404)
-                    self.end_headers()
-                    return
-                # snapshot the dict: a registrar may add stages while a
-                # scrape renders (the handler runs on its own thread)
-                body = render_prometheus(dict(registry.stages)).encode()
-                self.send_response(200)
-                self.send_header(
-                    "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
-                )
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-
-            def log_message(self, *args):  # quiet
-                pass
+        from firedancer_tpu.protocol import http as H
 
         self.stages = stages
-        # threading server: one stalled/idle client must not block every
-        # later scrape; per-request timeout bounds half-open connections
-        Handler.timeout = 10
-        self._httpd = http.server.ThreadingHTTPServer((host, port), Handler)
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever, daemon=True
-        )
-        self._thread.start()
+
+        def handler(req, _body):
+            if req.method != "GET":
+                return H.build_response(405, b"GET only\n")
+            if req.path not in ("/metrics", "/"):
+                return H.build_response(404, b"not found\n")
+            # snapshot the dict: a registrar may add stages while a
+            # scrape renders (this runs on a per-connection thread)
+            body = render_prometheus(dict(self.stages)).encode()
+            return H.build_response(
+                200, body,
+                content_type="text/plain; version=0.0.4; charset=utf-8",
+            )
+
+        self._srv = H.MiniServer(handler, host=host, port=port)
 
     @property
     def addr(self):
-        return self._httpd.server_address
+        return self._srv.addr
 
     def close(self):
-        self._httpd.shutdown()
-        self._httpd.server_close()
+        self._srv.close()
 
 
 # The stage-loop schema every pipeline stage shares (the "all tiles" block

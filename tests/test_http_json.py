@@ -1,0 +1,134 @@
+"""Own HTTP/1.1 parser + JSON lexer (ballet/http, ballet/json
+counterparts) and the VM sysvar/return-data syscalls."""
+
+import pytest
+
+from firedancer_tpu.protocol import http as H
+from firedancer_tpu.protocol import jsonlex as J
+
+
+# -- http ---------------------------------------------------------------------
+
+
+def test_request_parse_incremental():
+    raw = b"GET /metrics HTTP/1.1\r\nHost: x\r\nAccept: */*\r\n\r\nBODY"
+    assert H.parse_request(raw[:20]) is H.NEED_MORE
+    req = H.parse_request(raw)
+    assert (req.method, req.path, req.version) == ("GET", "/metrics",
+                                                   "HTTP/1.1")
+    assert req.header("host") == "x" and req.header("HOST") == "x"
+    assert raw[req.head_len :] == b"BODY"
+
+
+def test_request_malformed():
+    with pytest.raises(H.HttpError, match="request line"):
+        H.parse_request(b"GARBAGE\r\n\r\n")
+    with pytest.raises(H.HttpError, match="version"):
+        H.parse_request(b"GET / SPDY/9\r\n\r\n")
+    with pytest.raises(H.HttpError, match="header name"):
+        H.parse_request(b"GET / HTTP/1.1\r\nBad Header: x\r\n\r\n")
+    with pytest.raises(H.HttpError, match="too large"):
+        H.parse_request(b"GET / HTTP/1.1\r\nA: " + b"x" * H.MAX_HEAD)
+
+
+def test_response_and_body_framing():
+    raw = (b"HTTP/1.1 200 OK\r\ncontent-length: 5\r\n"
+           b"content-type: application/json\r\n\r\nhello")
+    res = H.parse_response(raw)
+    assert res.status == 200 and res.reason == "OK"
+    assert H.body_length(res) == 5
+    # chunked
+    res2 = H.parse_response(
+        b"HTTP/1.1 200 OK\r\ntransfer-encoding: chunked\r\n\r\n"
+    )
+    assert H.body_length(res2) == "chunked"
+    body = b"4\r\nWiki\r\n5\r\npedia\r\n0\r\n\r\n"
+    assert H.decode_chunked(body) == (b"Wikipedia", len(body))
+    assert H.decode_chunked(body[:10]) is H.NEED_MORE
+    with pytest.raises(H.HttpError, match="chunk size"):
+        H.decode_chunked(b"zz\r\n")
+
+
+def test_build_response_roundtrip():
+    out = H.build_response(200, b'{"ok":1}', content_type="application/json")
+    res = H.parse_response(out)
+    assert res.status == 200
+    assert H.body_length(res) == 8
+    assert out[res.head_len :] == b'{"ok":1}'
+
+
+# -- json ---------------------------------------------------------------------
+
+
+def test_json_roundtrip_values():
+    cases = [
+        None, True, False, 0, -1, 123456789012345678901234567890,
+        1.5, -0.25, 1e10,
+        "", "héllo\n\"quoted\"\\", {"a": [1, {"b": None}]}, [[]], {},
+    ]
+    for v in cases:
+        assert J.loads(J.dumps(v)) == v
+
+
+def test_json_strictness():
+    for bad in ["{", "[1,]", "{\"a\":}", "01", "1.", "+1", "nul",
+                '"\\x"', '"unterminated', "[1] extra", '{"a":1 "b":2}',
+                '"\\ud800"']:
+        with pytest.raises(J.JsonError):
+            J.loads(bad)
+    with pytest.raises(J.JsonError, match="deep"):
+        J.loads("[" * 100 + "]" * 100)
+    with pytest.raises(J.JsonError, match="duplicate"):
+        J.loads('{"k":1,"k":2}', reject_duplicate_keys=True)
+    assert J.loads('{"k":1,"k":2}') == {"k": 2}  # last-wins by default
+
+
+def test_json_unicode_escapes():
+    assert J.loads('"\\u00e9"') == "é"
+    assert J.loads('"\\ud83d\\ude00"') == "\U0001F600"  # surrogate pair
+    assert J.loads(J.dumps("tab\tnewline\n")) == "tab\tnewline\n"
+
+
+def test_json_matches_stdlib_on_rpc_shapes():
+    import json as stdlib
+
+    doc = ('{"jsonrpc":"2.0","id":7,"method":"getBalance",'
+           '"params":["abc",{"commitment":"finalized"}]}')
+    assert J.loads(doc) == stdlib.loads(doc)
+    enc = J.dumps(J.loads(doc), sort_keys=True)
+    assert stdlib.loads(enc) == stdlib.loads(doc)
+
+
+# -- VM sysvar + return data syscalls -----------------------------------------
+
+
+def test_vm_sysvar_and_return_data():
+    from firedancer_tpu.flamenco import types as T
+    from firedancer_tpu.flamenco import vm as fvm
+    from tests.test_executor import lddw
+    from tests.test_sbpf import ins
+    from tests.test_vm import run_text
+
+    clock = T.CLOCK.encode(T.Clock(slot=42, epoch=3))
+    # program: write clock sysvar to heap? use stack: get_clock([r10-64]);
+    # then set_return_data of the first 8 bytes (the slot)
+    text = (
+        ins(0xBF, dst=1, src=10) + ins(0x07, dst=1, imm=-64)
+        + ins(0x85, imm=fvm.SYSCALL_SOL_GET_CLOCK)
+        + ins(0xBF, dst=6, src=0)              # save rc
+        + ins(0xBF, dst=1, src=10) + ins(0x07, dst=1, imm=-64)
+        + ins(0xB7, dst=2, imm=8)
+        + ins(0x85, imm=fvm.SYSCALL_SOL_SET_RETURN_DATA)
+        + ins(0xBF, dst=0, src=6)
+        + ins(0x95)
+    )
+    m = run_text(text)
+    m.sysvars["clock"] = clock
+    fvm.register_default_syscalls(m)
+    assert m.run() == 0
+    assert m.return_data[1] == (42).to_bytes(8, "little")
+
+    # without the sysvar provided, the getter reports failure
+    m2 = run_text(text)
+    fvm.register_default_syscalls(m2)
+    assert m2.run() == 1
