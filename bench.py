@@ -233,7 +233,9 @@ def run_pipeline_bench(platform: str) -> dict:
 
     small = platform == "cpu"
     n_txn = 256 if small else 2048
-    batch = 64 if small else 512
+    # big batches: each verify dispatch costs a full tunnel round trip on
+    # remote backends, so fewer/larger batches dominate pipeline txn/s
+    batch = 64 if small else 1024
     t0 = time.time()
     pipe = build_leader_pipeline(
         n_verify=1,

@@ -119,6 +119,58 @@ def cmd_bench(args) -> int:
     return 0
 
 
+def cmd_genesis(args) -> int:
+    """fddev dev's bootstrap half: create genesis (+ faucet key) or
+    inspect an existing blob."""
+    from firedancer_tpu.flamenco import genesis as fg
+    from firedancer_tpu.ops.ref import ed25519_ref as ref
+
+    if args.action == "create":
+        import os
+        import secrets
+
+        faucet_secret = secrets.token_bytes(32)
+        blob = fg.genesis_create(
+            faucet_pubkey=ref.public_key(faucet_secret),
+            faucet_lamports=args.lamports,
+        )
+        # secret written only after the blob builds, owner-read-only
+        # (the cmd_keys discipline: a faucet key is a signing key)
+        with open(args.path + ".faucet", "wb") as f:
+            os.fchmod(f.fileno(), 0o600)
+            f.write(faucet_secret)
+        with open(args.path, "wb") as f:
+            f.write(blob)
+        print(f"genesis {args.path} hash={fg.genesis_hash(blob).hex()} "
+              f"faucet-key={args.path}.faucet")
+        return 0
+    blob = open(args.path, "rb").read()
+    g = fg.genesis_parse(blob)
+    print(f"hash:            {fg.genesis_hash(blob).hex()}")
+    print(f"hashes_per_tick: {g.hashes_per_tick}")
+    print(f"ticks_per_slot:  {g.ticks_per_slot}")
+    print(f"slots_per_epoch: {g.slots_per_epoch}")
+    print(f"accounts:        {len(g.accounts)}")
+    return 0
+
+
+def cmd_snapshot(args) -> int:
+    """Snapshot inspection (the operator-facing face of
+    flamenco/snapshot.py; creation happens via the runtime)."""
+    from firedancer_tpu.flamenco import snapshot as snap
+
+    man, accounts = snap.snapshot_read(args.path)
+    kind = f"incremental (base slot {man.base_slot})" if man.base_slot else "full"
+    print(f"slot:      {man.slot} ({kind})")
+    print(f"bank hash: {man.bank_hash.hex()}")
+    print(f"accounts:  {man.account_cnt}")
+    if man.deleted:
+        print(f"deletions: {len(man.deleted)}")
+    total = sum(int.from_bytes(v[:8], "little") for v in accounts.values())
+    print(f"lamports:  {total}")
+    return 0
+
+
 def cmd_config(args) -> int:
     import dataclasses
 
@@ -160,6 +212,14 @@ def main(argv=None) -> int:
     cfgp = sub.add_parser("config", help="print effective configuration")
     cfgp.add_argument("--config", default=None)
 
+    genp = sub.add_parser("genesis", help="create/inspect a genesis blob")
+    genp.add_argument("action", choices=["create", "show"])
+    genp.add_argument("path")
+    genp.add_argument("--lamports", type=int, default=500_000_000_000_000)
+
+    snapp = sub.add_parser("snapshot", help="inspect a snapshot archive")
+    snapp.add_argument("path")
+
     sub.add_parser("version", help="print version")
 
     args = p.parse_args(argv)
@@ -171,6 +231,10 @@ def main(argv=None) -> int:
         return cmd_bench(args)
     if args.cmd == "config":
         return cmd_config(args)
+    if args.cmd == "genesis":
+        return cmd_genesis(args)
+    if args.cmd == "snapshot":
+        return cmd_snapshot(args)
     if args.cmd == "version":
         print(f"firedancer_tpu {__version__}")
         return 0

@@ -422,8 +422,11 @@ def register_cpi_syscall(executor, v, ctx, caller_iaccts, caller_program_id,
             iaccts.append(InstrAccount(idx, m_signer, m_writable))
 
         # the program may have mutated its serialized accounts before the
-        # CPI — pull the current state into ctx first (same owner rules)
+        # CPI — pull the current state into ctx first (same owner rules);
+        # likewise its return data (a callee that never sets return data
+        # must observe — and preserve — the caller's current value)
         writeback_aligned(ctx, vm_, smap, caller_program_id)
+        ctx.return_data = vm_.return_data
         ctx.cu_used += vm_.cu_used  # budget is shared across the stack
         try:
             executor.execute_instr(

@@ -134,9 +134,25 @@ def default_executor() -> Executor:
     return _DEFAULT_EXECUTOR
 
 
+def default_sysvars(slot: int) -> dict:
+    """The sysvar blobs programs read via sol_get_*_sysvar: clock at the
+    executing slot, default rent and epoch schedule (grows alongside the
+    bank state)."""
+    from firedancer_tpu.flamenco import types as T
+
+    sched = T.EpochSchedule()
+    epoch = slot // sched.slots_per_epoch
+    return {
+        "clock": T.CLOCK.encode(T.Clock(slot=slot, epoch=epoch)),
+        "rent": T.RENT.encode(T.Rent()),
+        "epoch_schedule": T.EPOCH_SCHEDULE.encode(sched),
+    }
+
+
 def _execute_txn(
     funk: Funk, xid: bytes, payload: bytes, desc: ft.Txn,
     executor: Executor | None = None,
+    sysvars: dict | None = None,
 ) -> TxnResult:
     from firedancer_tpu.flamenco.programs import AcctError, FundsError
 
@@ -164,7 +180,8 @@ def _execute_txn(
     signer = [i < desc.signature_cnt for i in range(len(addrs))]
     writable = [desc.is_writable(i) for i in range(len(addrs))]
     baseline = [a.to_value() for a in accounts]
-    ctx = TxnCtx(accounts=accounts, signer=signer, writable=writable)
+    ctx = TxnCtx(accounts=accounts, signer=signer, writable=writable,
+                 sysvars=sysvars or {})
 
     for ins in desc.instrs:
         if ins.program_id >= len(addrs):
@@ -235,13 +252,14 @@ def execute_block(
                 before[a] = funk.rec_query(xid, a)
             touched.add(a)
 
+    sysvars = default_sysvars(slot)
     results: list[TxnResult] = [None] * len(parsed)
     for wave in waves:
         # wave txns are conflict-free: host executes in index order, a
         # tpool/device executes them concurrently — same result either way
         for i in wave:
             p, t = parsed[i]
-            results[i] = _execute_txn(funk, xid, p, t)
+            results[i] = _execute_txn(funk, xid, p, t, sysvars=sysvars)
 
     # accounts-delta lattice hash: one device reduction over +new / -old
     vals = []

@@ -215,12 +215,18 @@ class MiniServer:
 
     def _serve(self):
         import threading
+        import time
 
         while not self._closing:
             try:
                 conn, _ = self._sock.accept()
             except OSError:
-                return
+                if self._closing:
+                    return
+                # transient accept errors (ECONNABORTED, EMFILE, ...)
+                # must not kill the server for the process lifetime
+                time.sleep(0.05)
+                continue
             threading.Thread(target=self._handle, args=(conn,),
                              daemon=True).start()
 
@@ -259,7 +265,13 @@ class MiniServer:
                     pass
                 return
             body = buf[req.head_len : req.head_len + need]
-            conn.sendall(self._handler(req, body))
+            try:
+                resp = self._handler(req, body)
+            except Exception:
+                # a handler bug must answer 500, not strand the client
+                # until its timeout with a silent close
+                resp = build_response(500, b"internal error\n")
+            conn.sendall(resp)
         except OSError:
             pass
         finally:
@@ -286,6 +298,9 @@ def build_response(status: int, body: bytes = b"", *,
     head = [f"HTTP/1.1 {status} {reason}".encode()]
     head.append(b"content-type: " + content_type.encode())
     head.append(b"content-length: " + str(len(body)).encode())
+    # MiniServer serves one request per connection; say so, or HTTP/1.1
+    # keep-alive clients reuse the closed socket and flap
+    head.append(b"connection: close")
     for k, v in headers or []:
         head.append(f"{k}: {v}".encode())
     return b"\r\n".join(head) + b"\r\n\r\n" + body

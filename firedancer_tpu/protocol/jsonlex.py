@@ -17,6 +17,8 @@ from __future__ import annotations
 
 MAX_DEPTH = 64
 MAX_LEN = 16 * 1024 * 1024
+MAX_NUMBER_DIGITS = 400  # int(text) past ~4300 digits raises ValueError
+# on CPython >= 3.11; the contract here is JsonError for any bad input
 
 _WS = " \t\n\r"
 _ESC = {'"': '"', "\\": "\\", "/": "/", "b": "\b", "f": "\f",
@@ -209,6 +211,8 @@ class _Parser:
             while self.i < self.n and s[self.i].isdigit():
                 self.i += 1
         text = s[start : self.i]
+        if len(text) > MAX_NUMBER_DIGITS:
+            self.err("number too long")
         return float(text) if is_float else int(text)
 
 

@@ -66,15 +66,16 @@ class RpcServer:
             try:
                 parsed = J.loads(body)
                 resp = self._dispatch(parsed)
+                out = J.dumps(resp)  # inside the try: an unencodable
+                # result must fall back, not strand the client
             except Exception:
-                resp = {
+                out = J.dumps({
                     "jsonrpc": "2.0",
                     "id": None,
                     "error": {"code": -32700, "message": "parse error"},
-                }
+                })
             return H.build_response(
-                200, J.dumps(resp).encode(),
-                content_type="application/json",
+                200, out.encode(), content_type="application/json",
             )
 
         self._srv = H.MiniServer(handler, host=host, port=port,

@@ -28,3 +28,27 @@ def test_config_dump(tmp_path, capsys):
     out = capsys.readouterr().out
     assert "bank_stage_count = 5" in out
     assert "[poh]" in out
+
+
+def test_cli_genesis_and_snapshot(tmp_path, capsys):
+    from firedancer_tpu.__main__ import main
+    from firedancer_tpu.flamenco import runtime as rt
+    from firedancer_tpu.flamenco import snapshot as snap
+    from firedancer_tpu.funk import Funk
+
+    gpath = str(tmp_path / "genesis.bin")
+    assert main(["genesis", "create", gpath, "--lamports", "12345"]) == 0
+    out = capsys.readouterr().out
+    assert "hash=" in out and "faucet-key=" in out
+    assert main(["genesis", "show", gpath]) == 0
+    out = capsys.readouterr().out
+    assert "accounts:        1" in out
+
+    funk = Funk()
+    funk.rec_insert(None, b"A" * 32, rt.acct_build(77))
+    spath = str(tmp_path / "s.tar.zst")
+    snap.snapshot_write(funk, spath, slot=9)
+    assert main(["snapshot", spath]) == 0
+    out = capsys.readouterr().out
+    assert "slot:      9 (full)" in out
+    assert "lamports:  77" in out
