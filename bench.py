@@ -39,7 +39,10 @@ import time
 import numpy as np
 
 BASELINE_VERIFY_PER_S = 1.0e6  # wiredancer FPGA, the reference's offload path
-BATCH = int(os.environ.get("FDTPU_BENCH_BATCH", "4096"))
+# default batch 16384: measured 87.4K verify/s on TPU v5e vs 57.7K at
+# 4096 (the kernel amortizes dispatch + RTT over bigger batches;
+# docs/PERF.md) — still well under the p99 SLO at ~250 ms/batch
+BATCH = int(os.environ.get("FDTPU_BENCH_BATCH", "16384"))
 MAX_MSG_LEN = 128
 STEADY_ROUNDS = int(os.environ.get("FDTPU_BENCH_ROUNDS", "8"))
 INFLIGHT = int(os.environ.get("FDTPU_BENCH_INFLIGHT", "4"))
@@ -139,7 +142,11 @@ def run_bench(backend: str, *, rounds: int = STEADY_ROUNDS,
     print(f"# bench: device={dev.platform}:{dev.device_kind} kernel={kernel}",
           file=sys.stderr)
 
-    msg, msg_len, sig, pk = ge._example_batch(BATCH)
+    # the CPU fallback exists to record SOME number when the tunnel is
+    # down; a 16K-batch CPU compile would burn most of its timeout, so
+    # cap it at the shape the test suite already keeps warm
+    batch = BATCH if backend != "cpu" else min(BATCH, 4096)
+    msg, msg_len, sig, pk = ge._example_batch(batch)
     args = tuple(
         jax.device_put(jnp.asarray(a), dev) for a in (msg, msg_len, sig, pk)
     )
@@ -164,10 +171,10 @@ def run_bench(backend: str, *, rounds: int = STEADY_ROUNDS,
     t0 = time.time()
     n_ok = fetch(step(args))
     print(
-        f"# compile+first batch {time.time()-t0:.1f}s, {n_ok}/{BATCH} ok",
+        f"# compile+first batch {time.time()-t0:.1f}s, {n_ok}/{batch} ok",
         file=sys.stderr,
     )
-    assert n_ok == BATCH, "honest signatures must all verify"
+    assert n_ok == batch, "honest signatures must all verify"
 
     # Steady state: keep INFLIGHT batches in flight, fetch to cap the
     # queue — the async-offload shape the wiredancer path uses (requests
@@ -182,7 +189,7 @@ def run_bench(backend: str, *, rounds: int = STEADY_ROUNDS,
     for o in outs:
         fetch(o)
     elapsed = time.time() - t0
-    total = BATCH * rounds
+    total = batch * rounds
     rate = total / elapsed
 
     lat = []
@@ -195,7 +202,7 @@ def run_bench(backend: str, *, rounds: int = STEADY_ROUNDS,
     p99 = lat_ms[min(int(len(lat_ms) * 0.99), len(lat_ms) - 1)]
     print(
         f"# steady: {total} sigs in {elapsed:.3f}s; batch latency "
-        f"p50={p50:.2f}ms p99={p99:.2f}ms (batch={BATCH})",
+        f"p50={p50:.2f}ms p99={p99:.2f}ms (batch={batch})",
         file=sys.stderr,
     )
     out = {
@@ -286,6 +293,10 @@ def run_pipeline_bench(platform: str) -> dict:
             file=sys.stderr,
         )
         return {
+            # on the tunneled dev backend every verify dispatch pays a
+            # ~250 ms round trip, which bounds this number far below the
+            # host pipeline's real capacity (docs/PERF.md); the kernel
+            # verify/s above is the hardware-meaningful figure
             "pipeline_txn_per_s": round(rate, 1),
             "pipeline_vs_baseline": round(rate / PIPELINE_BASELINE_TXN_PER_S, 5),
             "pipeline_commit_p99_ms": round(p99_ms, 2),

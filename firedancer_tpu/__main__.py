@@ -166,7 +166,9 @@ def cmd_snapshot(args) -> int:
     print(f"accounts:  {man.account_cnt}")
     if man.deleted:
         print(f"deletions: {len(man.deleted)}")
-    total = sum(int.from_bytes(v[:8], "little") for v in accounts.values())
+    from firedancer_tpu.flamenco.executor import acct_decode
+
+    total = sum(acct_decode(v)[0] for v in accounts.values())
     print(f"lamports:  {total}")
     return 0
 

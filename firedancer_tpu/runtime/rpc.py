@@ -63,17 +63,28 @@ class RpcServer:
         self.view = view
 
         def handler(req, body):
+            rid = None
             try:
                 parsed = J.loads(body)
-                resp = self._dispatch(parsed)
-                out = J.dumps(resp)  # inside the try: an unencodable
-                # result must fall back, not strand the client
             except Exception:
                 out = J.dumps({
-                    "jsonrpc": "2.0",
-                    "id": None,
+                    "jsonrpc": "2.0", "id": None,
                     "error": {"code": -32700, "message": "parse error"},
                 })
+            else:
+                if isinstance(parsed, dict):
+                    rid = parsed.get("id")
+                try:
+                    out = J.dumps(self._dispatch(parsed))
+                except Exception:
+                    # server-side failure (unencodable result, non-dict
+                    # request): -32603 with the request's id — never
+                    # misattributed to the client as a parse error
+                    out = J.dumps({
+                        "jsonrpc": "2.0", "id": rid,
+                        "error": {"code": -32603,
+                                  "message": "internal error"},
+                    })
             return H.build_response(
                 200, out.encode(), content_type="application/json",
             )
