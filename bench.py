@@ -144,8 +144,12 @@ def run_bench(backend: str, *, rounds: int = STEADY_ROUNDS,
 
     # the CPU fallback exists to record SOME number when the tunnel is
     # down; a 16K-batch CPU compile would burn most of its timeout, so
-    # cap it at the shape the test suite already keeps warm
-    batch = BATCH if backend != "cpu" else min(BATCH, 4096)
+    # the DEFAULT caps at the shape the test suite keeps warm — an
+    # explicit FDTPU_BENCH_BATCH is always honored verbatim
+    if backend == "cpu" and "FDTPU_BENCH_BATCH" not in os.environ:
+        batch = min(BATCH, 4096)
+    else:
+        batch = BATCH
     msg, msg_len, sig, pk = ge._example_batch(batch)
     args = tuple(
         jax.device_put(jnp.asarray(a), dev) for a in (msg, msg_len, sig, pk)
@@ -212,6 +216,7 @@ def run_bench(backend: str, *, rounds: int = STEADY_ROUNDS,
         "vs_baseline": round(rate / BASELINE_VERIFY_PER_S, 4),
         "backend": dev.platform,
         "kernel": kernel,
+        "batch": batch,
         "batch_latency_p99_ms": round(float(p99), 3),
     }
     # Secondary headline: whole-pipeline txn/s (the bencho analog; the

@@ -161,12 +161,21 @@ class Pack:
         return True
 
     def _remove(self, o: OrdTxn) -> None:
+        # bisect to the sort-key position, then identity-match within the
+        # (tiny) equal-key run: O(log n), no value-equality pool scan —
+        # the treap-delete role of fd_pack.c at host-model scale
+        key = o.sort_key()
         for pool in (self._pending, self._pending_votes):
-            try:
-                pool.remove(o)
+            i = bisect.bisect_left(pool, key, key=OrdTxn.sort_key)
+            found = False
+            while i < len(pool) and pool[i].sort_key() == key:
+                if pool[i] is o:
+                    del pool[i]
+                    found = True
+                    break
+                i += 1
+            if found:
                 break
-            except ValueError:
-                continue
         self._sigs.discard(o.first_sig())
         self._by_sig.pop(o.first_sig(), None)
 

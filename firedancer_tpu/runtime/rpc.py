@@ -46,8 +46,9 @@ class PipelineView:
     def balance(self, pubkey: bytes) -> int:
         if self.funk is None:
             return 0
-        val = self.funk.rec_query(None, pubkey)
-        return int.from_bytes(val[:8], "little") if val else 0
+        from firedancer_tpu.flamenco.executor import acct_decode
+
+        return acct_decode(self.funk.rec_query(None, pubkey))[0]
 
 
 class RpcServer:
@@ -72,19 +73,26 @@ class RpcServer:
                     "error": {"code": -32700, "message": "parse error"},
                 })
             else:
-                if isinstance(parsed, dict):
-                    rid = parsed.get("id")
-                try:
-                    out = J.dumps(self._dispatch(parsed))
-                except Exception:
-                    # server-side failure (unencodable result, non-dict
-                    # request): -32603 with the request's id — never
-                    # misattributed to the client as a parse error
+                if not isinstance(parsed, dict):
+                    # valid JSON, wrong shape (batch arrays/scalars are
+                    # not served): the CLIENT's error, spec code -32600
                     out = J.dumps({
-                        "jsonrpc": "2.0", "id": rid,
-                        "error": {"code": -32603,
-                                  "message": "internal error"},
+                        "jsonrpc": "2.0", "id": None,
+                        "error": {"code": -32600,
+                                  "message": "invalid request"},
                     })
+                else:
+                    rid = parsed.get("id")
+                    try:
+                        out = J.dumps(self._dispatch(parsed))
+                    except Exception:
+                        # server-side failure (e.g. unencodable result):
+                        # -32603 with the request's id
+                        out = J.dumps({
+                            "jsonrpc": "2.0", "id": rid,
+                            "error": {"code": -32603,
+                                      "message": "internal error"},
+                        })
             return H.build_response(
                 200, out.encode(), content_type="application/json",
             )
