@@ -38,6 +38,7 @@ MAX_INSTR_STACK = 5  # Solana's max invoke stack height (top level = 1)
 MAX_PERMITTED_DATA_INCREASE = 10 * 1024
 MAX_CPI_INSTRUCTION_DATA_LEN = 10 * 1024
 MAX_CPI_ACCOUNT_INFOS = 128
+MAX_CPI_INSTRUCTION_ACCOUNTS = 255  # u8::MAX — metas may duplicate txn accounts
 
 # well-known loader id: accounts owned by it with executable=1 hold sBPF
 # ELFs directly (the upgradeable-loader indirection is not modeled)
@@ -340,13 +341,20 @@ def sync_into_vm(ctx: TxnCtx, v, smap: list[SerialEntry]) -> None:
         )
 
 
-# -- CPI: sol_invoke_signed_c -------------------------------------------------
+# -- CPI: sol_invoke_signed_c / sol_invoke_signed_rust ------------------------
 #
 # C ABI structs read out of VM memory (fd_vm_syscall_cpi.c's C path):
 #   SolInstruction  { u64 program_id_addr; u64 accounts_addr; u64 accounts_len;
 #                     u64 data_addr; u64 data_len; }
 #   SolAccountMeta  { u64 pubkey_addr; u8 is_writable; u8 is_signer; }
 #   SolSignerSeedsC { u64 addr; u64 len; }  of  SolSignerSeedC { addr; len; }
+#
+# Rust ABI (the StableInstruction layout fd_vm_syscall_cpi.c's rust path
+# translates): Instruction { accounts: StableVec<AccountMeta>, data:
+# StableVec<u8>, program_id: Pubkey } where StableVec = { addr u64,
+# cap u64, len u64 } and AccountMeta = { pubkey 32 | is_signer u8 |
+# is_writable u8 } (34 bytes packed).  Both paths share the translate +
+# privilege + invoke + sync core below.
 
 
 def register_cpi_syscall(executor, v, ctx, caller_iaccts, caller_program_id,
@@ -365,22 +373,8 @@ def register_cpi_syscall(executor, v, ctx, caller_iaccts, caller_program_id,
             cur.is_signer |= ia.is_signer
             cur.is_writable |= ia.is_writable
 
-    def sol_invoke_signed_c(vm_, instr_addr, _infos_addr, infos_len,
-                            seeds_addr, seeds_len):
-        vm_.charge(fvm.SYSCALL_BASE_COST * 10)
-        if infos_len > MAX_CPI_ACCOUNT_INFOS:
-            raise fvm.VmError("too many account infos")
-        prog_addr = vm_.mem_read(instr_addr, 8)
-        metas_addr = vm_.mem_read(instr_addr + 8, 8)
-        metas_len = vm_.mem_read(instr_addr + 16, 8)
-        data_addr = vm_.mem_read(instr_addr + 24, 8)
-        data_len = vm_.mem_read(instr_addr + 32, 8)
-        if data_len > MAX_CPI_INSTRUCTION_DATA_LEN:
-            raise fvm.VmError("cpi instruction data too long")
-        callee_prog = vm_.mem_read_bytes(prog_addr, 32)
-        data = vm_.mem_read_bytes(data_addr, data_len) if data_len else b""
-
-        # PDA signers: seeds sign for addresses derived from the CALLER
+    def _read_pda_signers(vm_, seeds_addr, seeds_len):
+        """Seeds sign for addresses derived from the CALLER's program."""
         pda_signers = set(caller_pda_signers)
         for i in range(seeds_len):
             arr_addr = vm_.mem_read(seeds_addr + 16 * i, 8)
@@ -400,15 +394,13 @@ def register_cpi_syscall(executor, v, ctx, caller_iaccts, caller_program_id,
                 )
             except pda.PdaError as e:
                 raise fvm.VmError(f"bad signer seeds: {e}") from e
+        return pda_signers
 
-        # translate metas -> instruction accounts with privilege checks
+    def _cpi_core(vm_, callee_prog, metas, data, pda_signers):
+        """Shared translate + privilege check + invoke + sync.
+        metas: [(pubkey, is_signer, is_writable)]."""
         iaccts: list[InstrAccount] = []
-        for i in range(metas_len):
-            m_addr = metas_addr + 10 * i  # packed C layout: u64 + u8 + u8
-            pk_addr = vm_.mem_read(m_addr, 8)
-            m_writable = vm_.mem_read(m_addr + 8, 1) != 0
-            m_signer = vm_.mem_read(m_addr + 9, 1) != 0
-            key = vm_.mem_read_bytes(pk_addr, 32)
+        for key, m_signer, m_writable in metas:
             idx = ctx.index_of(key)
             if idx is None:
                 raise fvm.VmError("cpi account not in transaction")
@@ -441,4 +433,58 @@ def register_cpi_syscall(executor, v, ctx, caller_iaccts, caller_program_id,
         vm_.return_data = ctx.return_data  # callee's return data visible
         return 0
 
+    def sol_invoke_signed_c(vm_, instr_addr, _infos_addr, infos_len,
+                            seeds_addr, seeds_len):
+        vm_.charge(fvm.SYSCALL_BASE_COST * 10)
+        if infos_len > MAX_CPI_ACCOUNT_INFOS:
+            raise fvm.VmError("too many account infos")
+        prog_addr = vm_.mem_read(instr_addr, 8)
+        metas_addr = vm_.mem_read(instr_addr + 8, 8)
+        metas_len = vm_.mem_read(instr_addr + 16, 8)
+        data_addr = vm_.mem_read(instr_addr + 24, 8)
+        data_len = vm_.mem_read(instr_addr + 32, 8)
+        if data_len > MAX_CPI_INSTRUCTION_DATA_LEN:
+            raise fvm.VmError("cpi instruction data too long")
+        if metas_len > MAX_CPI_INSTRUCTION_ACCOUNTS:
+            raise fvm.VmError("too many account metas")
+        callee_prog = vm_.mem_read_bytes(prog_addr, 32)
+        data = vm_.mem_read_bytes(data_addr, data_len) if data_len else b""
+        metas = []
+        for i in range(metas_len):
+            m_addr = metas_addr + 10 * i  # packed C layout: u64 + u8 + u8
+            pk_addr = vm_.mem_read(m_addr, 8)
+            m_writable = vm_.mem_read(m_addr + 8, 1) != 0
+            m_signer = vm_.mem_read(m_addr + 9, 1) != 0
+            metas.append((vm_.mem_read_bytes(pk_addr, 32), m_signer,
+                          m_writable))
+        pda_signers = _read_pda_signers(vm_, seeds_addr, seeds_len)
+        return _cpi_core(vm_, callee_prog, metas, data, pda_signers)
+
+    def sol_invoke_signed_rust(vm_, instr_addr, _infos_addr, infos_len,
+                               seeds_addr, seeds_len):
+        vm_.charge(fvm.SYSCALL_BASE_COST * 10)
+        if infos_len > MAX_CPI_ACCOUNT_INFOS:
+            raise fvm.VmError("too many account infos")
+        # StableInstruction: accounts StableVec | data StableVec | Pubkey
+        metas_addr = vm_.mem_read(instr_addr, 8)
+        metas_len = vm_.mem_read(instr_addr + 16, 8)  # skip cap at +8
+        data_addr = vm_.mem_read(instr_addr + 24, 8)
+        data_len = vm_.mem_read(instr_addr + 40, 8)  # skip cap at +32
+        callee_prog = vm_.mem_read_bytes(instr_addr + 48, 32)
+        if data_len > MAX_CPI_INSTRUCTION_DATA_LEN:
+            raise fvm.VmError("cpi instruction data too long")
+        if metas_len > MAX_CPI_INSTRUCTION_ACCOUNTS:
+            raise fvm.VmError("too many account metas")
+        data = vm_.mem_read_bytes(data_addr, data_len) if data_len else b""
+        metas = []
+        for i in range(metas_len):
+            m_addr = metas_addr + 34 * i  # AccountMeta: pubkey | u8 | u8
+            key = vm_.mem_read_bytes(m_addr, 32)
+            m_signer = vm_.mem_read(m_addr + 32, 1) != 0
+            m_writable = vm_.mem_read(m_addr + 33, 1) != 0
+            metas.append((key, m_signer, m_writable))
+        pda_signers = _read_pda_signers(vm_, seeds_addr, seeds_len)
+        return _cpi_core(vm_, callee_prog, metas, data, pda_signers)
+
     v.syscalls[fvm.SYSCALL_SOL_INVOKE_SIGNED_C] = sol_invoke_signed_c
+    v.syscalls[fvm.SYSCALL_SOL_INVOKE_SIGNED_RUST] = sol_invoke_signed_rust

@@ -303,6 +303,7 @@ SYSCALL_SOL_LOG_CU = _sid("sol_log_compute_units_")
 SYSCALL_SOL_LOG_DATA = _sid("sol_log_data")
 SYSCALL_SOL_PANIC = _sid("sol_panic_")
 SYSCALL_SOL_INVOKE_SIGNED_C = _sid("sol_invoke_signed_c")
+SYSCALL_SOL_INVOKE_SIGNED_RUST = _sid("sol_invoke_signed_rust")
 SYSCALL_SOL_ALT_BN128 = _sid("sol_alt_bn128_group_op")
 SYSCALL_SOL_GET_CLOCK = _sid("sol_get_clock_sysvar")
 SYSCALL_SOL_GET_RENT = _sid("sol_get_rent_sysvar")

@@ -424,3 +424,78 @@ def test_cpi_writable_escalation_rejected():
         ex.execute_instr(
             ctx, prog_key, [InstrAccount(0, False, False)], bump_id,
         )
+
+
+def test_cpi_rust_abi_invokes_callee():
+    """sol_invoke_signed_rust: StableInstruction + 34-byte AccountMetas
+    drive the same CPI core as the C path."""
+    ex, ctx, prog_key, bump_id = _cpi_fixture_rust()
+    ex.execute_instr(ctx, prog_key, [InstrAccount(0, False, True)], bump_id)
+    assert ctx.accounts[0].data[0] == 1
+
+
+def _cpi_fixture_rust():
+    bump_id = b"B" * 32
+    off = _serial_offsets(8)
+    acct_entry_sz = 8 + 32 + 32 + 8 + 8 + 8 + 10 * 1024 + 8
+    instr_data_off = 8 + acct_entry_sz
+    prog_id_addr = fvm.MM_INPUT + instr_data_off + 8
+    key_addr = fvm.MM_INPUT + off["key"]
+    # build AccountMeta (34B) at [r10-104]: pubkey | is_signer=0 | is_writable=1
+    # then StableInstruction (80B) at [r10-96..-16]:
+    #   accounts {addr, cap, len} | data {addr, cap, len} | program_id 32B
+    # program_id must be the VALUE (32 bytes), so copy it from instr data
+    # via 4 u64 loads/stores
+    text = (
+        # meta pubkey: copy 32B from the serialized account key
+        b"".join(
+            lddw(2, key_addr + 8 * k)
+            + ins(0x79, dst=3, src=2, off=0)
+            + ins(0x7B, dst=10, src=3, off=-136 + 8 * k)
+            for k in range(4)
+        )
+        + ins(0xB7, dst=3, imm=0)
+        + ins(0x73, dst=10, src=3, off=-104)    # is_signer = 0
+        + ins(0xB7, dst=3, imm=1)
+        + ins(0x73, dst=10, src=3, off=-103)    # is_writable = 1
+        # StableInstruction at [r10-96..-16] (fully below the frame top)
+        + ins(0xBF, dst=3, src=10) + ins(0x07, dst=3, imm=-136)
+        + ins(0x7B, dst=10, src=3, off=-96)     # accounts.addr
+        + ins(0xB7, dst=3, imm=1)
+        + ins(0x7B, dst=10, src=3, off=-88)     # accounts.cap = 1
+        + ins(0x7B, dst=10, src=3, off=-80)     # accounts.len = 1
+        + ins(0xB7, dst=3, imm=0)
+        + ins(0x7B, dst=10, src=3, off=-72)     # data.addr = 0
+        + ins(0x7B, dst=10, src=3, off=-64)     # data.cap = 0
+        + ins(0x7B, dst=10, src=3, off=-56)     # data.len = 0
+        # program_id value: copy 32B from instr data
+        + b"".join(
+            lddw(2, prog_id_addr + 8 * k)
+            + ins(0x79, dst=3, src=2, off=0)
+            + ins(0x7B, dst=10, src=3, off=-48 + 8 * k)
+            for k in range(4)
+        )
+        + ins(0xBF, dst=1, src=10) + ins(0x07, dst=1, imm=-96)
+        + ins(0xB7, dst=2, imm=0) + ins(0xB7, dst=3, imm=0)
+        + ins(0xB7, dst=4, imm=0) + ins(0xB7, dst=5, imm=0)
+        + ins(0x85, imm=fvm.SYSCALL_SOL_INVOKE_SIGNED_RUST)
+        + ins(0xB7, dst=0, imm=0)
+        + EXIT
+    )
+    prog_key = b"c" * 32
+    ex = Executor()
+
+    def bump(ex_, ctx_, pid, iaccts, data, *, pda_signers):
+        a = ctx_.accounts[iaccts[0].txn_idx]
+        if not iaccts[0].is_writable:
+            raise InstrError("bump needs writable")
+        a.data[0] += 1
+
+    ex.register(bump_id, bump)
+    ctx = _ctx(
+        _sys_acct(b"D" * 32, 5, bytes(8)),
+        _bpf_program_account(prog_key, text),
+        signer=[False, False],
+        writable=[True, False],
+    )
+    return ex, ctx, prog_key, bump_id
