@@ -113,6 +113,26 @@ def locked_stake(st: StakeState, epoch: int) -> int:
 # -- the stake native program -------------------------------------------------
 # instruction tags: 0 Initialize{staker,withdrawer} | 1 Delegate |
 # 2 Deactivate | 3 Withdraw{lamports} | 4 Split{lamports}
+#
+# Epochs come from the Clock sysvar (ctx.sysvars["clock"]), never from
+# instruction data — the reference's fd_stake_program reads clock.epoch the
+# same way.  An attacker-controlled epoch would let a withdrawer skip the
+# warmup/cooldown ramp entirely (pass a far-future epoch so locked_stake
+# ramps to zero) or make stake instantly effective.
+
+
+def _clock_epoch(ctx) -> int:
+    """Current epoch per the Clock sysvar.  Fails CLOSED: a context without
+    a clock cannot run time-sensitive stake instructions — defaulting to
+    epoch 0 would re-open the cooldown-skip (deactivation_epoch=0 followed
+    by a real-clock withdraw drains an actively-cooling delegation)."""
+    from firedancer_tpu.flamenco import types as T
+
+    blob = ctx.sysvars.get("clock")
+    if not blob:
+        raise AcctError("stake instruction requires the clock sysvar")
+    clock, _ = T.CLOCK.decode(blob, 0)
+    return clock.epoch
 
 
 def stake_program(executor, ctx, program_id, iaccts, data, *, pda_signers):
@@ -158,10 +178,7 @@ def stake_program(executor, ctx, program_id, iaccts, data, *, pda_signers):
             state=STATE_INIT, staker=data[4:36], withdrawer=data[36:68]
         )
         a.data[:_DATA_LEN] = st.encode()
-    elif tag == 1:  # Delegate { epoch u64 }; accounts: [stake, vote]
-        if len(data) < 12:
-            raise AcctError("malformed delegate")
-        epoch = _u64(data[4:])
+    elif tag == 1:  # Delegate; accounts: [stake, vote]
         a, vote = acct(0), acct(1, owned=False)
         need_writable(0)
         st = StakeState.decode(bytes(a.data))
@@ -169,16 +186,14 @@ def stake_program(executor, ctx, program_id, iaccts, data, *, pda_signers):
             raise AcctError("delegate of uninitialized stake")
         if not signed_by(st.staker):
             raise AcctError("delegate missing staker signature")
+        epoch = _clock_epoch(ctx)
         st.state = STATE_DELEGATED
         st.voter = vote.key
         st.stake = a.lamports  # whole balance delegates (rent exempt 0 here)
         st.activation_epoch = epoch
         st.deactivation_epoch = U64_MAX
         a.data[:_DATA_LEN] = st.encode()
-    elif tag == 2:  # Deactivate { epoch u64 }
-        if len(data) < 12:
-            raise AcctError("malformed deactivate")
-        epoch = _u64(data[4:])
+    elif tag == 2:  # Deactivate
         a = acct(0)
         need_writable(0)
         st = StakeState.decode(bytes(a.data))
@@ -186,13 +201,12 @@ def stake_program(executor, ctx, program_id, iaccts, data, *, pda_signers):
             raise AcctError("deactivate of undelegated stake")
         if not signed_by(st.staker):
             raise AcctError("deactivate missing staker signature")
-        st.deactivation_epoch = epoch
+        st.deactivation_epoch = _clock_epoch(ctx)
         a.data[:_DATA_LEN] = st.encode()
-    elif tag == 3:  # Withdraw { lamports u64, epoch u64 }; [stake, dest]
-        if len(data) < 20:
+    elif tag == 3:  # Withdraw { lamports u64 }; [stake, dest]
+        if len(data) < 12:
             raise AcctError("malformed withdraw")
         lamports = _u64(data[4:])
-        epoch = _u64(data[12:])
         a, dest = acct(0), acct(1, owned=False)
         need_writable(0)
         need_writable(1)
@@ -203,7 +217,8 @@ def stake_program(executor, ctx, program_id, iaccts, data, *, pda_signers):
                 raise AcctError("withdraw missing stake-account signature")
         elif not signed_by(st.withdrawer):
             raise AcctError("withdraw missing withdrawer signature")
-        locked = locked_stake(st, epoch)
+        locked = locked_stake(st, _clock_epoch(ctx)) \
+            if st.state == STATE_DELEGATED else 0
         if a.lamports - locked < lamports:
             raise FundsError(
                 f"withdraw {lamports} exceeds free balance "

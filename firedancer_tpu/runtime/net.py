@@ -203,12 +203,18 @@ class QuicIngressStage(UdpIngressStage):
             conn = quic.Connection.server_new(self.identity_secret)
         try:
             events = conn.receive(data)
-        except (quic.QuicError, tls13.TlsError):
+        except (quic.QuicError, tls13.TlsError, ValueError, IndexError,
+                KeyError, _struct.error):
             # drop the bad packet only: a fresh conn that failed its
             # first datagram never occupies a slot (garbage sprayers
             # can't fill max_conns), and an ESTABLISHED conn must
             # survive spoofed noise aimed at its address (RFC 9000:
-            # discard undecryptable packets, never tear down)
+            # discard undecryptable packets, never tear down).
+            # The non-Quic/Tls types matter: untrusted datagrams reach
+            # struct unpacking (truncated ClientHello -> struct.error/
+            # IndexError) and x25519 (all-zero key share -> ValueError);
+            # the stage run loop has no catch-all, so any escape here
+            # would be a remote DoS of the TPU ingress.
             self.metrics.inc("bad_packet")
             return True
         if fresh:

@@ -1,0 +1,346 @@
+"""Field-arithmetic formulation shootout on the real TPU.
+
+Measures sec/field-op net of tunnel RTT by the slope method: run the op
+chained K1 and K2 times on-device inside one jitted fori_loop, fetch a
+scalar reduction (a real completion barrier on the tunneled backend), and
+divide the time delta by (K2-K1).  The tunnel RTT and dispatch overhead are
+identical in both runs and cancel.
+
+Variants (each a (state) -> (state) step containing exactly one fe_mul of
+two rotating operands, so XLA cannot hoist anything loop-invariant):
+
+  jnp13      — production radix-2^13 x 20 int32 schoolbook (ops/limbs.py)
+  pallas13   — same math as one hand-written Pallas kernel (fori_loop inside)
+  kara13     — one-level Karatsuba (10+10 split, signed middle term)
+  f32r8      — radix-2^8 x 32 limbs, products+accumulation fully in f32
+  lazy12     — radix-2^12 x 22 int32 schoolbook with single-pass fold
+               (the radix-12 lazy-carry lever: adds/subs skip carries)
+
+Usage: python scripts/perf_fe.py [--batch 16384] [--k1 32] [--k2 128]
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from firedancer_tpu.ops import limbs as fl
+
+
+def bench_step(name, step, state, k1, k2, elems):
+    """step: state -> state; state is a pytree of device arrays."""
+
+    @jax.jit
+    def run(state, n):
+        out = jax.lax.fori_loop(0, n, lambda i, s: step(s), state)
+        leaf = jax.tree_util.tree_leaves(out)[0]
+        return jnp.sum(leaf[0].astype(jnp.float32))
+
+    # compile + warm
+    float(run(state, jnp.int32(2)))
+    t = {}
+    for k in (k1, k2):
+        best = 1e9
+        for _ in range(3):
+            t0 = time.perf_counter()
+            float(run(state, jnp.int32(k)))
+            best = min(best, time.perf_counter() - t0)
+        t[k] = best
+    per_iter = (t[k2] - t[k1]) / (k2 - k1)
+    per_elem = per_iter / elems
+    print(
+        f"{name:10s}  {per_iter*1e3:8.3f} ms/iter  "
+        f"{per_elem*1e9:8.1f} ns/elem  ({1.0/per_elem/1e6:6.2f} M fe_mul/s)"
+        f"   [t{k1}={t[k1]*1e3:.0f}ms t{k2}={t[k2]*1e3:.0f}ms]"
+    )
+    return per_elem
+
+
+# -- variant: production jnp radix-13 ----------------------------------------
+
+
+def step_jnp13(s):
+    x, y = s
+    return fl.fe_mul(x, y), x
+
+
+# -- variant: pallas radix-13 -------------------------------------------------
+
+NL = fl.NLIMB
+MASK = fl.MASK
+RADIX = fl.RADIX
+FOLD = fl.FOLD
+
+
+def _pallas_mul_body(a, b):
+    """One fe_mul written with static slicing only (no scatter-add)."""
+    rows = []
+    for k in range(2 * NL - 1):
+        lo = max(0, k - NL + 1)
+        hi = min(k, NL - 1)
+        t = a[lo] * b[k - lo]
+        for i in range(lo + 1, hi + 1):
+            t = t + a[i] * b[k - i]
+        rows.append(t)
+    rows.append(jnp.zeros_like(rows[0]))  # row 41 (carry spill)
+    c = jnp.stack(rows)  # (41, B)
+    for _ in range(3):
+        hi = c >> RADIX
+        c = (c & MASK) + jnp.concatenate(
+            [jnp.zeros_like(hi[:1]), hi[:-1]], axis=0
+        )
+    r = c[:NL] + FOLD * c[NL : 2 * NL]
+    r0 = r[0] + 369664 * c[2 * NL]
+    r = jnp.concatenate([r0[None], r[1:]], axis=0)
+    for _ in range(2):
+        hi = r >> RADIX
+        r = (r & MASK) + jnp.concatenate(
+            [jnp.zeros_like(hi[:1]), hi[:-1]], axis=0
+        )
+        r = jnp.concatenate([(r[0] + FOLD * hi[-1])[None], r[1:]], axis=0)
+    return r
+
+
+def make_pallas13(batch):
+    from jax.experimental import pallas as pl
+
+    def kernel(x_ref, y_ref, n_ref, ox_ref, oy_ref):
+        def body(i, s):
+            x, y = s
+            return _pallas_mul_body(x, y), x
+
+        x, y = jax.lax.fori_loop(
+            0, n_ref[0], body, (x_ref[...], y_ref[...])
+        )
+        ox_ref[...] = x
+        oy_ref[...] = y
+
+    def run(x, y, n):
+        return pl.pallas_call(
+            kernel,
+            out_shape=[
+                jax.ShapeDtypeStruct((NL, batch), jnp.int32),
+                jax.ShapeDtypeStruct((NL, batch), jnp.int32),
+            ],
+        )(x, y, jnp.full((1,), n, jnp.int32))
+
+    return run
+
+
+# -- variant: Karatsuba radix-13 ---------------------------------------------
+
+
+def _conv10(a, b, n=10):
+    """(n,B)x(n,B) -> (2n-1,B) schoolbook, static slices."""
+    rows = []
+    for k in range(2 * n - 1):
+        lo = max(0, k - n + 1)
+        hi = min(k, n - 1)
+        t = a[lo] * b[k - lo]
+        for i in range(lo + 1, hi + 1):
+            t = t + a[i] * b[k - i]
+        rows.append(t)
+    return jnp.stack(rows)
+
+
+def fe_mul_kara(a, b):
+    """One-level Karatsuba: 3 x (10x10) convs + recombine, then fold.
+
+    Middle term via (a0-a1)(b0-b1): diffs in [-2^13, 2^13], products
+    <= 2^26, 10-term sums <= 2^29.6 — inside int32.
+    """
+    a0, a1 = a[:10], a[10:]
+    b0, b1 = b[:10], b[10:]
+    z0 = _conv10(a0, b0)  # (19,B) weight 0
+    z2 = _conv10(a1, b1)  # weight 20
+    zm = _conv10(a0 - a1, b0 - b1)
+    z1 = z0 + z2 - zm  # weight 10
+    B = a.shape[1:]
+    c = jnp.zeros((41,) + B, jnp.int32)
+    c = c.at[0:19].add(z0)
+    c = c.at[10:29].add(z1)
+    c = c.at[20:39].add(z2)
+    return fl._conv_fold(c)
+
+
+def step_kara13(s):
+    x, y = s
+    return fe_mul_kara(x, y), x
+
+
+# -- variant: f32 radix-8 -----------------------------------------------------
+
+NL8 = 32
+MASK8 = 255.0
+
+
+def fe_mul_f32r8(a, b):
+    """radix-2^8 x 32 f32 limbs.  Strict limbs < 2^8; products < 2^16;
+    63-term max accumulation < 2^22 — exact in f32.  Carries via
+    floor-divide (f32 floor is native); fold 2^256 = 2^5*19 ... wait:
+    2^256 mod p: 2^256 = 2 * 2^255 == 2*19 = 38 (mod p).  Limb k >= 32
+    folds back with weight 38 at k-32."""
+    rows = []
+    for k in range(2 * NL8 - 1):
+        lo = max(0, k - NL8 + 1)
+        hi = min(k, NL8 - 1)
+        t = a[lo] * b[k - lo]
+        for i in range(lo + 1, hi + 1):
+            t = t + a[i] * b[k - i]
+        rows.append(t)
+    rows.append(jnp.zeros_like(rows[0]))
+    c = jnp.stack(rows)  # (64, B) values < 2^22
+    # fold top 32 rows down with weight 38 (values < 2^22*39 < 2^27.3:
+    # exact in f32 only below 2^24 -> carry first, then fold)
+    for _ in range(2):
+        hi = jnp.floor(c / 256.0)
+        c = (c - hi * 256.0) + jnp.concatenate(
+            [jnp.zeros_like(hi[:1]), hi[:-1]], axis=0
+        )
+    r = c[:NL8] + 38.0 * c[NL8:]
+    for _ in range(2):
+        hi = jnp.floor(r / 256.0)
+        r = (r - hi * 256.0) + jnp.concatenate(
+            [(38.0 * hi[-1])[None], hi[:-1]], axis=0
+        )
+    return r
+
+
+def step_f32r8(s):
+    x, y = s
+    return fe_mul_f32r8(x, y), x
+
+
+# -- variant: lazy radix-12 ---------------------------------------------------
+
+NL12 = 22
+RADIX12 = 12
+MASK12 = (1 << RADIX12) - 1
+# 2^264 mod p = 2^9 * 19 = 9728 (2^264 = 2^9 * 2^255)
+FOLD12 = 19 << 9
+
+
+def fe_mul_lazy12(a, b):
+    """radix-2^12 x 22 int32.  Inputs may be 'lazy' (<= 2^14 per limb —
+    two uncarried adds deep): 43-term conv of 2^14x2^14 products =
+    2^28 * 43 < 2^33.4 — TOO BIG; so lazy depth one (<= 2^13): products
+    2^26, 22 terms -> 2^30.5: safe.  Output: loose (<= 2^12 + eps)."""
+    rows = []
+    for k in range(2 * NL12 - 1):
+        lo = max(0, k - NL12 + 1)
+        hi = min(k, NL12 - 1)
+        t = a[lo] * b[k - lo]
+        for i in range(lo + 1, hi + 1):
+            t = t + a[i] * b[k - i]
+        rows.append(t)
+    rows.append(jnp.zeros_like(rows[0]))
+    c = jnp.stack(rows)  # (44, B)
+    for _ in range(3):
+        hi = c >> RADIX12
+        c = (c & MASK12) + jnp.concatenate(
+            [jnp.zeros_like(hi[:1]), hi[:-1]], axis=0
+        )
+    r = c[:NL12] + FOLD12 * c[NL12 : 2 * NL12]
+    for _ in range(2):
+        hi = r >> RADIX12
+        r = (r & MASK12) + jnp.concatenate(
+            [(FOLD12 * hi[-1])[None], hi[:-1]], axis=0
+        )
+    return r
+
+
+def step_lazy12(s):
+    x, y = s
+    return fe_mul_lazy12(x, y), x
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=16384)
+    ap.add_argument("--k1", type=int, default=32)
+    ap.add_argument("--k2", type=int, default=128)
+    ap.add_argument(
+        "--only", type=str, default="",
+        help="comma list: jnp13,pallas13,kara13,f32r8,lazy12",
+    )
+    args = ap.parse_args()
+    B = args.batch
+    only = set(args.only.split(",")) if args.only else None
+    print("backend:", jax.default_backend(), jax.devices())
+    rng = np.random.default_rng(7)
+
+    x13 = jnp.asarray(rng.integers(0, 1 << 13, (NL, B)), jnp.int32)
+    y13 = jnp.asarray(rng.integers(0, 1 << 13, (NL, B)), jnp.int32)
+    x12 = jnp.asarray(rng.integers(0, 1 << 12, (NL12, B)), jnp.int32)
+    y12 = jnp.asarray(rng.integers(0, 1 << 12, (NL12, B)), jnp.int32)
+    x8 = jnp.asarray(rng.integers(0, 256, (NL8, B)), jnp.float32)
+    y8 = jnp.asarray(rng.integers(0, 256, (NL8, B)), jnp.float32)
+
+    results = {}
+    if only is None or "jnp13" in only:
+        results["jnp13"] = bench_step(
+            "jnp13", step_jnp13, (x13, y13), args.k1, args.k2, B
+        )
+    if only is None or "kara13" in only:
+        results["kara13"] = bench_step(
+            "kara13", step_kara13, (x13, y13), args.k1, args.k2, B
+        )
+    if only is None or "lazy12" in only:
+        results["lazy12"] = bench_step(
+            "lazy12", step_lazy12, (x12, y12), args.k1, args.k2, B
+        )
+    if only is None or "f32r8" in only:
+        results["f32r8"] = bench_step(
+            "f32r8", step_f32r8, (x8, y8), args.k1, args.k2, B
+        )
+    if only is None or "pallas13" in only:
+        try:
+            prun = make_pallas13(B)
+
+            def bench_pallas():
+                # pallas takes n as an operand; same slope method
+                x, y = x13, y13
+
+                @jax.jit
+                def run(x, y, n):
+                    ox, oy = prun(x, y, n)
+                    return jnp.sum(ox[0].astype(jnp.float32))
+
+                float(run(x, y, jnp.int32(2)))
+                t = {}
+                for k in (args.k1, args.k2):
+                    best = 1e9
+                    for _ in range(3):
+                        t0 = time.perf_counter()
+                        float(run(x, y, jnp.int32(k)))
+                        best = min(best, time.perf_counter() - t0)
+                    t[k] = best
+                per_iter = (t[args.k2] - t[args.k1]) / (args.k2 - args.k1)
+                per_elem = per_iter / B
+                print(
+                    f"{'pallas13':10s}  {per_iter*1e3:8.3f} ms/iter  "
+                    f"{per_elem*1e9:8.1f} ns/elem  "
+                    f"({1.0/per_elem/1e6:6.2f} M fe_mul/s)"
+                    f"   [t{args.k1}={t[args.k1]*1e3:.0f}ms "
+                    f"t{args.k2}={t[args.k2]*1e3:.0f}ms]"
+                )
+                return per_elem
+
+            results["pallas13"] = bench_pallas()
+        except Exception as e:  # pallas viability is exactly what we probe
+            print("pallas13 FAILED:", repr(e))
+
+    if "jnp13" in results:
+        base = results["jnp13"]
+        for k, v in results.items():
+            print(f"  {k}: {base/v:.2f}x vs jnp13")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,57 @@
+"""Probe: does Pallas compile+run on the axon-tunneled TPU backend?
+
+Learned (round 4): yes — elementwise kernels compile and run.  Pallas TPU
+lowering has no scatter-add, so the fe_mul convolution must be written as
+per-output-row static sums (acc_k = sum_{i+j=k} a_i*b_j), not `.at[].add`.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+print("devices:", jax.devices())
+
+from jax.experimental import pallas as pl
+
+
+def add_kernel(x_ref, y_ref, o_ref):
+    o_ref[...] = x_ref[...] + y_ref[...]
+
+
+x = jnp.ones((8, 128), jnp.int32)
+y = jnp.ones((8, 128), jnp.int32)
+t0 = time.time()
+out = pl.pallas_call(
+    add_kernel,
+    out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+)(x, y)
+print("pallas add ok:", np.asarray(out)[0, :4], "t=%.2fs" % (time.time() - t0))
+
+NLIMB = 20
+
+
+def conv_kernel(a_ref, b_ref, o_ref):
+    a = a_ref[...]
+    b = b_ref[...]
+    rows = []
+    for k in range(2 * NLIMB - 1):
+        lo = max(0, k - NLIMB + 1)
+        hi = min(k, NLIMB - 1)
+        t = a[lo] * b[k - lo]
+        for i in range(lo + 1, hi + 1):
+            t = t + a[i] * b[k - i]
+        rows.append(t)
+    o_ref[...] = jnp.stack(rows)
+
+
+B = 512
+a = jnp.ones((NLIMB, B), jnp.int32) * 100
+b = jnp.ones((NLIMB, B), jnp.int32) * 200
+t0 = time.time()
+out = pl.pallas_call(
+    conv_kernel,
+    out_shape=jax.ShapeDtypeStruct((2 * NLIMB - 1, B), jnp.int32),
+)(a, b)
+np.asarray(out)
+print("pallas conv ok:", np.asarray(out)[0, :2], "t=%.2fs" % (time.time() - t0))

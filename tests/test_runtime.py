@@ -221,6 +221,33 @@ def test_vote_program_updates_vote_account():
     )
 
 
+def test_vote_forgery_rejected():
+    """Regression (advisor r3): any txn author could write into any vote
+    account.  The authority binds on the first vote; a different signer's
+    vote on the same account must fail (consensus weight is at stake)."""
+    from firedancer_tpu.flamenco.runtime import TXN_SUCCESS as OK
+
+    funk = Funk()
+    secret, voter = keypair(b"real-voter")
+    forger_secret, forger = keypair(b"forger")
+    vote_acct = hashlib.sha256(b"va-forge").digest()
+    fund(funk, voter, 1_000_000)
+    fund(funk, forger, 1_000_000)
+    funk.rec_insert(None, vote_acct, acct_build(0, owner=ft.VOTE_PROGRAM))
+    bh = hashlib.sha256(b"bh-f").digest()
+    res = execute_block(funk, slot=5, txns=[
+        ft.vote_txn(secret, vote_acct, 100, bh),        # binds authority
+        ft.vote_txn(forger_secret, vote_acct, 999, bh),  # forged
+    ])
+    assert res.results[0].status == OK
+    assert res.results[1].status != OK
+    from firedancer_tpu.flamenco.executor import acct_decode
+
+    data = acct_decode(funk.rec_query(res.xid, vote_acct))[3]
+    assert int.from_bytes(data[0:8], "little") == 100  # forged slot ignored
+    assert int.from_bytes(data[8:16], "little") == 1
+
+
 def test_readonly_accounts_reject_writes():
     """A txn marking its write target readonly must fail typed: silent
     writes through readonly flags would break wave conflict-freedom."""

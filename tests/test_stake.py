@@ -31,24 +31,28 @@ def _ctx(*accts, signer=None, writable=None):
     )
 
 
+def _set_epoch(ctx, epoch):
+    """Epochs reach the stake program only via the Clock sysvar (the
+    attacker-controlled-epoch fix): tests drive time by rewriting clock."""
+    from firedancer_tpu.flamenco import types as T
+
+    ctx.sysvars["clock"] = T.CLOCK.encode(T.Clock(epoch=epoch))
+
+
 def _ix_init():
     return (0).to_bytes(4, "little") + STAKER + WITHDRAWER
 
 
-def _ix_delegate(epoch):
-    return (1).to_bytes(4, "little") + epoch.to_bytes(8, "little")
+def _ix_delegate():
+    return (1).to_bytes(4, "little")
 
 
-def _ix_deactivate(epoch):
-    return (2).to_bytes(4, "little") + epoch.to_bytes(8, "little")
+def _ix_deactivate():
+    return (2).to_bytes(4, "little")
 
 
-def _ix_withdraw(lamports, epoch):
-    return (
-        (3).to_bytes(4, "little")
-        + lamports.to_bytes(8, "little")
-        + epoch.to_bytes(8, "little")
-    )
+def _ix_withdraw(lamports):
+    return (3).to_bytes(4, "little") + lamports.to_bytes(8, "little")
 
 
 def _delegated_ctx(ex, lamports=1_000_000):
@@ -59,7 +63,8 @@ def _delegated_ctx(ex, lamports=1_000_000):
     ia = [InstrAccount(0, False, True), InstrAccount(1, False, False),
           InstrAccount(2, True, False)]
     ex.execute_instr(ctx, fs.STAKE_PROGRAM, ia[:1], _ix_init())
-    ex.execute_instr(ctx, fs.STAKE_PROGRAM, ia, _ix_delegate(10))
+    _set_epoch(ctx, 10)
+    ex.execute_instr(ctx, fs.STAKE_PROGRAM, ia, _ix_delegate())
     return ctx, stake
 
 
@@ -84,7 +89,7 @@ def test_delegate_requires_staker_signature():
         ex.execute_instr(
             ctx, fs.STAKE_PROGRAM,
             [InstrAccount(0, False, True), InstrAccount(1, False, False)],
-            _ix_delegate(10),
+            _ix_delegate(),
         )
 
 
@@ -113,17 +118,39 @@ def test_withdraw_respects_locked_stake():
     ia = [InstrAccount(0, False, True), InstrAccount(3, False, True),
           InstrAccount(4, True, False)]
     # at epoch 14 the full 1M is effective -> nothing free
+    _set_epoch(ctx, 14)
     with pytest.raises(FundsError):
-        ex.execute_instr(ctx, fs.STAKE_PROGRAM, ia, _ix_withdraw(1, 14))
+        ex.execute_instr(ctx, fs.STAKE_PROGRAM, ia, _ix_withdraw(1))
     # deactivate at 20; by 24 all free
+    _set_epoch(ctx, 20)
     ex.execute_instr(
         ctx, fs.STAKE_PROGRAM,
         [InstrAccount(0, False, True), InstrAccount(2, True, False)],
-        _ix_deactivate(20),
+        _ix_deactivate(),
     )
-    ex.execute_instr(ctx, fs.STAKE_PROGRAM, ia, _ix_withdraw(400_000, 24))
+    _set_epoch(ctx, 24)
+    ex.execute_instr(ctx, fs.STAKE_PROGRAM, ia, _ix_withdraw(400_000))
     assert dest.lamports == 400_000
     assert stake.lamports == 600_000
+
+
+def test_withdraw_ignores_forged_epoch_in_instruction_data():
+    """Regression (advisor r3): epoch used to ride in instruction data, so a
+    withdrawer could claim a far-future epoch and drain actively delegated
+    stake.  Now only the Clock sysvar moves time: trailing forged bytes in
+    the payload must not unlock anything."""
+    ex = Executor()
+    ctx, stake = _delegated_ctx(ex)
+    dest = _auth_acct(b"d" * 32)
+    wa = _auth_acct(WITHDRAWER)
+    ctx.accounts += [dest, wa]
+    ia = [InstrAccount(0, False, True), InstrAccount(3, False, True),
+          InstrAccount(4, True, False)]
+    _set_epoch(ctx, 14)  # fully active: everything locked
+    forged = _ix_withdraw(400_000) + (10**6).to_bytes(8, "little")
+    with pytest.raises(FundsError):
+        ex.execute_instr(ctx, fs.STAKE_PROGRAM, ia, forged)
+    assert stake.lamports == 1_000_000
 
 
 def test_split():
