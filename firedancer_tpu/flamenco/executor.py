@@ -31,6 +31,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
+from firedancer_tpu.pack.cost import DEFAULT_HEAP_SIZE
 from firedancer_tpu.protocol import sbpf
 from firedancer_tpu.protocol.txn import SYSTEM_PROGRAM, VOTE_PROGRAM
 
@@ -117,6 +118,7 @@ class TxnCtx:
     signer: list[bool]
     writable: list[bool]
     budget: int = 200_000
+    heap_size: int = DEFAULT_HEAP_SIZE  # RequestHeapFrame-controlled
     cu_used: int = 0
     logs: list[bytes] = field(default_factory=list)
     stack: list[bytes] = field(default_factory=list)  # program ids
@@ -139,12 +141,15 @@ class Executor:
     """Program registry + instruction dispatch."""
 
     def __init__(self):
-        from firedancer_tpu.flamenco import programs, stake
+        from firedancer_tpu.flamenco import alt, programs, stake
+        from firedancer_tpu.pack.cost import COMPUTE_BUDGET_PROGRAM
 
         self.native = {
             SYSTEM_PROGRAM: programs.system_program,
             VOTE_PROGRAM: programs.vote_program,
             stake.STAKE_PROGRAM: stake.stake_program,
+            alt.ALT_PROGRAM: alt.alt_program,
+            COMPUTE_BUDGET_PROGRAM: programs.compute_budget_program,
         }
 
     def register(self, program_id: bytes, fn) -> None:
@@ -167,6 +172,12 @@ class Executor:
         try:
             fn = self.native.get(program_id)
             if fn is not None:
+                # builtins charge their fixed CU cost up front (the
+                # reference's DEFAULT_COMPUTE_UNITS per native program,
+                # same table pack's cost model uses)
+                from firedancer_tpu.pack.cost import BUILTIN_COST
+
+                ctx.charge(BUILTIN_COST.get(program_id, 0))
                 fn(self, ctx, program_id, iaccts, data,
                    pda_signers=pda_signers)
             else:
@@ -200,7 +211,8 @@ class Executor:
             raise InstrError(f"program load failed: {e}") from e
         blob, smap = serialize_aligned(ctx, iaccts, data, program_id)
         v = fvm.Vm(program=prog, input_data=blob,
-                   budget=ctx.budget - ctx.cu_used)
+                   budget=ctx.budget - ctx.cu_used,
+                   heap_size=ctx.heap_size)
         v.sysvars = ctx.sysvars
         v.return_data = ctx.return_data
         v.program_id = program_id

@@ -131,6 +131,18 @@ def system_program(executor, ctx, program_id, iaccts, data, *, pda_signers):
     # other tags: no-op (unimplemented surface is inert, never fatal)
 
 
+# -- compute budget program ---------------------------------------------------
+# The limits themselves are applied at txn load (pack.cost.txn_budget ->
+# TxnCtx.budget/heap_size); execution of the instruction only re-validates
+# the payload (fd_compute_budget_program.c's processor is the same no-op).
+
+
+def compute_budget_program(executor, ctx, program_id, iaccts, data,
+                           *, pda_signers):
+    if len(data) < 5 or data[0] > 3:
+        raise AcctError("malformed compute budget instruction")
+
+
 # -- vote program -------------------------------------------------------------
 # account data layout: u64 last_voted_slot | u64 vote_count | 32B authority
 #

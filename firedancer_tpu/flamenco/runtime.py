@@ -82,21 +82,36 @@ class BlockResult:
     xid: bytes
 
 
-def _rw_sets(payload: bytes, desc: ft.Txn) -> tuple[set[bytes], set[bytes]]:
+def _rw_sets(
+    payload: bytes, desc: ft.Txn,
+    extra: tuple[list[bytes], list[bytes]] | None = None,
+) -> tuple[set[bytes], set[bytes]]:
     addrs = desc.acct_addrs(payload)
     w, r = set(), set()
     for i, a in enumerate(addrs):
         (w if desc.is_writable(i) else r).add(a)
-    # ALT-loaded accounts are unresolvable without the address-resolution
-    # stage: conservatively WRITE-lock the table address itself so two
-    # txns loading from one table never share a wave (the same rule the
-    # pack scheduler applies, pack/scheduler.py acct_sets)
-    for lut in desc.addr_luts:
-        w.add(payload[lut.addr_off : lut.addr_off + 32])
+    if extra is not None:
+        # resolved ALT addresses: exact rw sets, plus a READ lock on each
+        # table so an in-block extend/close serializes against its users
+        ew, er = extra
+        w.update(ew)
+        r.update(er)
+        for lut in desc.addr_luts:
+            r.add(payload[lut.addr_off : lut.addr_off + 32])
+    else:
+        # unresolved (failed lookup or legacy caller without resolution):
+        # conservatively WRITE-lock the table address itself so two txns
+        # loading from one table never share a wave (the same rule the
+        # pack scheduler applies, pack/scheduler.py acct_sets)
+        for lut in desc.addr_luts:
+            w.add(payload[lut.addr_off : lut.addr_off + 32])
     return w, r
 
 
-def generate_waves(txns: list[tuple[bytes, ft.Txn]]) -> list[list[int]]:
+def generate_waves(
+    txns: list[tuple[bytes, ft.Txn]],
+    extras: list[tuple[list[bytes], list[bytes]] | None] | None = None,
+) -> list[list[int]]:
     """Partition txn indices into conflict-free waves, equivalent to
     serial block order: a writer lands strictly after every earlier
     reader AND writer of each of its accounts; a reader lands strictly
@@ -108,7 +123,8 @@ def generate_waves(txns: list[tuple[bytes, ft.Txn]]) -> list[list[int]]:
     last_w: dict[bytes, int] = {}  # acct -> last wave with a writer
     last_r: dict[bytes, int] = {}  # acct -> last wave with a reader
     for i, (payload, desc) in enumerate(txns):
-        w, r = _rw_sets(payload, desc)
+        w, r = _rw_sets(payload, desc,
+                        extras[i] if extras is not None else None)
         wi = 0
         for a in w:
             wi = max(wi, last_w.get(a, -1) + 1, last_r.get(a, -1) + 1)
@@ -153,11 +169,20 @@ def _execute_txn(
     funk: Funk, xid: bytes, payload: bytes, desc: ft.Txn,
     executor: Executor | None = None,
     sysvars: dict | None = None,
+    extra: tuple[list[bytes], list[bytes]] | None = None,
 ) -> TxnResult:
     from firedancer_tpu.flamenco.programs import AcctError, FundsError
 
     executor = executor or default_executor()
     addrs = desc.acct_addrs(payload)
+    if desc.addr_luts:
+        if extra is None:
+            # lookup resolution failed (missing/foreign/short table or
+            # index out of range): typed per-txn failure, block continues
+            return TxnResult(TXN_ERR_ACCT, 0)
+        # combined index space: static, then loaded-writable, then
+        # loaded-readonly — matching Txn.is_writable
+        addrs = addrs + extra[0] + extra[1]
     if len(set(addrs)) != len(addrs):
         # AccountLoadedTwice analog: duplicate addresses would load as
         # independent copies — stale reads + lamport mint/burn at commit
@@ -180,8 +205,20 @@ def _execute_txn(
     signer = [i < desc.signature_cnt for i in range(len(addrs))]
     writable = [desc.is_writable(i) for i in range(len(addrs))]
     baseline = [a.to_value() for a in accounts]
+    # the txn's requested compute budget + heap (SetComputeUnitLimit /
+    # RequestHeapFrame) drive execution — pack only *costs* them; here
+    # they are ENFORCED (the r3 gap: VM budget was fixed at 200k)
+    from firedancer_tpu.pack.cost import txn_budget
+
+    budget = txn_budget(payload, desc)
+    if budget is None:
+        # malformed compute-budget instruction: typed failure, fee stays
+        # charged (pack's cost model would have dropped it pre-block)
+        return TxnResult(TXN_ERR_PROGRAM, fee)
+    cu_limit, heap_size = budget
     ctx = TxnCtx(accounts=accounts, signer=signer, writable=writable,
-                 sysvars=sysvars or {})
+                 sysvars=sysvars or {}, budget=cu_limit,
+                 heap_size=heap_size)
 
     for ins in desc.instrs:
         if ins.program_id >= len(addrs):
@@ -201,6 +238,12 @@ def _execute_txn(
         except AcctError:
             return TxnResult(TXN_ERR_ACCT, fee)
         except InstrError:
+            return TxnResult(TXN_ERR_PROGRAM, fee)
+        except (ValueError, IndexError, KeyError, OverflowError):
+            # instruction data/accounts are ATTACKER input; a native
+            # program tripping an untyped exception is a failed txn,
+            # never a block abort (defense in depth on top of the typed
+            # errors — one crafted txn must not kill replay)
             return TxnResult(TXN_ERR_PROGRAM, fee)
 
     # commit: writes may only land on accounts the wave generator saw as
@@ -241,13 +284,34 @@ def execute_block(
         parsed.append((p, t))
     xid = b"slot:%d:%s" % (slot, (parent_xid or b"root"))
     funk.txn_prepare(parent_xid, xid)
-    waves = generate_waves(parsed)
+
+    # resolve v0 address-table lookups against the START-of-slot state
+    # (in-block table extensions become visible next slot, Agave's
+    # visibility rule) — exact rw-sets for wave generation
+    from firedancer_tpu.flamenco import alt as falt
+
+    extras: list[tuple[list[bytes], list[bytes]] | None] = []
+    table_cache: dict = {}  # decode each referenced table once per block
+    for p, t in parsed:
+        if not t.addr_luts:
+            extras.append(([], []))
+            continue
+        try:
+            extras.append(
+                falt.resolve_lookups(
+                    p, t, lambda k: funk.rec_query(xid, k),
+                    slot=slot, table_cache=table_cache,
+                )
+            )
+        except falt.LookupError_:
+            extras.append(None)
+    waves = generate_waves(parsed, extras)
 
     # track every account any txn touches, for the delta hash
     touched: set[bytes] = set()
     before: dict[bytes, bytes | None] = {}
-    for p, t in parsed:
-        for a in t.acct_addrs(p):
+    for (p, t), ex in zip(parsed, extras):
+        for a in t.acct_addrs(p) + (ex[0] + ex[1] if ex else []):
             if a not in before:
                 before[a] = funk.rec_query(xid, a)
             touched.add(a)
@@ -259,7 +323,8 @@ def execute_block(
         # tpool/device executes them concurrently — same result either way
         for i in wave:
             p, t = parsed[i]
-            results[i] = _execute_txn(funk, xid, p, t, sysvars=sysvars)
+            results[i] = _execute_txn(funk, xid, p, t, sysvars=sysvars,
+                                      extra=extras[i])
 
     # accounts-delta lattice hash: one device reduction over +new / -old
     vals = []

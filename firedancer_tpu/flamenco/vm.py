@@ -42,7 +42,8 @@ MM_INPUT = 4 << 32
 FRAME_SZ = 4096
 MAX_CALL_DEPTH = 64
 STACK_SZ = FRAME_SZ * MAX_CALL_DEPTH
-HEAP_SZ = 32 * 1024
+# single source of truth for the default heap: the cost model's constant
+from firedancer_tpu.pack.cost import DEFAULT_HEAP_SIZE as HEAP_SZ
 DEFAULT_BUDGET = 200_000
 
 _M64 = (1 << 64) - 1
@@ -74,6 +75,7 @@ class Vm:
     input_data: bytes = b""
     budget: int = DEFAULT_BUDGET
     syscalls: dict[int, object] = field(default_factory=dict)
+    heap_size: int = HEAP_SZ  # RequestHeapFrame-controlled (32K default)
 
     def __post_init__(self):
         self.regs = [0] * 11
@@ -83,7 +85,7 @@ class Vm:
         self.regions = [
             Region(MM_PROGRAM, bytearray(self.program.rodata), False),
             Region(MM_STACK, bytearray(STACK_SZ), True),
-            Region(MM_HEAP, bytearray(HEAP_SZ), True),
+            Region(MM_HEAP, bytearray(self.heap_size), True),
             Region(MM_INPUT, bytearray(self.input_data), True),
         ]
         self.regs[10] = MM_STACK + FRAME_SZ  # frame 0's top; grows UP per call
@@ -449,7 +451,7 @@ def register_default_syscalls(vm: Vm, *, log_sink: list | None = None) -> None:
             return 0
         align = 8
         pos = (vm_.heap_pos + align - 1) & ~(align - 1)
-        if pos + sz > HEAP_SZ:
+        if pos + sz > vm_.heap_size:
             return 0  # NULL: allocation failure, not a fault
         vm_.heap_pos = pos + sz
         return MM_HEAP + pos

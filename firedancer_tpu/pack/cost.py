@@ -65,6 +65,9 @@ BUILTIN_COST = {
     ED25519_SV_PROGRAM: 720,
 }
 
+DEFAULT_HEAP_SIZE = 32 * 1024
+MAX_HEAP_SIZE = 256 * 1024
+
 _FLAG_SET_CU = 1
 _FLAG_SET_FEE = 2
 _FLAG_SET_HEAP = 4
@@ -98,6 +101,11 @@ def _cbp_parse(data: bytes, st: _CbpState) -> bool:
             return False
         st.heap_size = int.from_bytes(data[1:5], "little")
         if st.heap_size % HEAP_FRAME_GRANULARITY:
+            return False
+        # range-checked HERE so pack and the runtime agree on validity
+        # (txn_budget rejects the same range; a pack-admitted txn must
+        # never fail the runtime's budget resolution)
+        if not DEFAULT_HEAP_SIZE <= st.heap_size <= MAX_HEAP_SIZE:
             return False
         st.flags |= _FLAG_SET_HEAP
     elif tag == 2:  # SetComputeUnitLimit
@@ -195,3 +203,23 @@ def compute_cost(payload: bytes, t: ft.Txn) -> TxnCost | None:
         precompile_sig_cnt=precompile_sig_cnt,
         is_simple_vote=(vote_instr_cnt == 1 and len(t.instrs) == 1),
     )
+
+
+def txn_budget(payload: bytes, t: ft.Txn) -> tuple[int, int] | None:
+    """The txn-wide (cu_limit, heap_bytes) from its compute-budget
+    instructions — the execution-side resolution the runtime feeds into
+    TxnCtx/the VM (fd_compute_budget_program's rules; the reference
+    resolves this during txn load, fd_executor.c).  None = malformed."""
+    addrs = t.acct_addrs(payload)
+    cbp = _CbpState()
+    for ins in t.instrs:
+        prog = addrs[ins.program_id] if ins.program_id < len(addrs) else None
+        if prog == COMPUTE_BUDGET_PROGRAM:
+            data = payload[ins.data_off : ins.data_off + ins.data_sz]
+            if not _cbp_parse(data, cbp):
+                return None
+    _, cu_limit = _cbp_finalize(cbp, len(t.instrs))
+    heap = cbp.heap_size if cbp.flags & _FLAG_SET_HEAP else DEFAULT_HEAP_SIZE
+    if heap < DEFAULT_HEAP_SIZE or heap > MAX_HEAP_SIZE:
+        return None
+    return cu_limit, heap

@@ -19,6 +19,9 @@ import numpy as np
 
 
 def main():
+    from firedancer_tpu.utils.platform import enable_compile_cache
+
+    enable_compile_cache()
     from firedancer_tpu.ops import sigverify as sv
     import __graft_entry__ as ge
 
