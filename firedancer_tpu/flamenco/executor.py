@@ -41,9 +41,12 @@ MAX_CPI_INSTRUCTION_DATA_LEN = 10 * 1024
 MAX_CPI_ACCOUNT_INFOS = 128
 MAX_CPI_INSTRUCTION_ACCOUNTS = 255  # u8::MAX — metas may duplicate txn accounts
 
-# well-known loader id: accounts owned by it with executable=1 hold sBPF
-# ELFs directly (the upgradeable-loader indirection is not modeled)
-BPF_LOADER_PROGRAM = b"BpfLoader2" + bytes(22)
+# loader v2: accounts owned by it with executable=1 hold sBPF ELFs
+# directly; the upgradeable loader (flamenco/bpf_loader.py) adds the
+# program -> programdata indirection resolved at invoke time
+from firedancer_tpu.protocol.base58 import b58_decode32 as _b58d
+
+BPF_LOADER_PROGRAM = _b58d("BPFLoader2111111111111111111111111111111111")
 
 ACCT_HDR = 8 + 32 + 1  # lamports | owner | executable
 
@@ -124,6 +127,9 @@ class TxnCtx:
     stack: list[bytes] = field(default_factory=list)  # program ids
     return_data: tuple[bytes, bytes] = (bytes(32), b"")
     sysvars: dict = field(default_factory=dict)  # name -> bincode blob
+    # upgradeable programs resolved at txn load: program key ->
+    # (elf bytes, deploy slot); populated by the runtime's account loader
+    program_elfs: dict = field(default_factory=dict)
 
     def charge(self, n: int) -> None:
         self.cu_used += n
@@ -144,12 +150,16 @@ class Executor:
         from firedancer_tpu.flamenco import alt, programs, stake
         from firedancer_tpu.pack.cost import COMPUTE_BUDGET_PROGRAM
 
+        from firedancer_tpu.flamenco import bpf_loader
+
         self.native = {
             SYSTEM_PROGRAM: programs.system_program,
             VOTE_PROGRAM: programs.vote_program,
             stake.STAKE_PROGRAM: stake.stake_program,
             alt.ALT_PROGRAM: alt.alt_program,
             COMPUTE_BUDGET_PROGRAM: programs.compute_budget_program,
+            bpf_loader.UPGRADEABLE_LOADER_PROGRAM:
+                bpf_loader.upgradeable_loader_program,
         }
 
     def register(self, program_id: bytes, fn) -> None:
@@ -185,8 +195,18 @@ class Executor:
                 if prog_idx is None:
                     return  # unknown program not present: no-op (pre-VM parity)
                 pacct = ctx.accounts[prog_idx]
-                if not pacct.executable or pacct.owner != BPF_LOADER_PROGRAM:
+                from firedancer_tpu.flamenco.bpf_loader import (
+                    UPGRADEABLE_LOADER_PROGRAM,
+                )
+
+                if pacct.owner not in (
+                    BPF_LOADER_PROGRAM, UPGRADEABLE_LOADER_PROGRAM
+                ):
                     return  # data account as program target: no-op
+                if not pacct.executable:
+                    # a closed/undeployed loader-owned account is not a
+                    # silent no-op (InvalidProgramForExecution parity)
+                    raise InstrError("program account is not executable")
                 self._execute_bpf(ctx, pacct, program_id, iaccts, data,
                                   pda_signers)
             # instruction-level lamport conservation over the UNIQUE
@@ -202,11 +222,41 @@ class Executor:
 
     # -- sBPF dispatch --------------------------------------------------------
 
+    def _resolve_program_elf(self, ctx, pacct) -> bytes:
+        """The ELF to run for a program account: direct bytes for loader
+        v2; the programdata indirection (+ deploy-slot visibility rule)
+        for the upgradeable loader."""
+        from firedancer_tpu.flamenco import bpf_loader as bl
+
+        if pacct.owner == BPF_LOADER_PROGRAM:
+            return bytes(pacct.data)
+        hit = ctx.program_elfs.get(pacct.key)
+        if hit is not None:
+            elf, deploy_slot = hit
+        else:
+            # fall back to a programdata account present in the txn
+            pd_addr = bl.program_programdata(bytes(pacct.data))
+            idx = ctx.index_of(pd_addr)
+            if idx is None:
+                raise InstrError("programdata account unavailable")
+            pd_data = bytes(ctx.accounts[idx].data)
+            deploy_slot, _auth = bl.programdata_meta(pd_data)
+            elf = bl.programdata_elf(pd_data)
+        blob = ctx.sysvars.get("clock")
+        if blob is not None:
+            from firedancer_tpu.flamenco import types as T
+
+            if T.CLOCK.decode(blob, 0)[0].slot == deploy_slot:
+                # LoaderV3 delay rule: a program (re)deployed in slot N
+                # is invokable from slot N+1
+                raise InstrError("program was deployed in this slot")
+        return elf
+
     def _execute_bpf(self, ctx, pacct, program_id, iaccts, data, pda_signers):
         from firedancer_tpu.flamenco import vm as fvm
 
         try:
-            prog = sbpf.load(bytes(pacct.data))
+            prog = sbpf.load(self._resolve_program_elf(ctx, pacct))
         except sbpf.SbpfError as e:
             raise InstrError(f"program load failed: {e}") from e
         blob, smap = serialize_aligned(ctx, iaccts, data, program_id)

@@ -216,9 +216,26 @@ def _execute_txn(
         # charged (pack's cost model would have dropped it pre-block)
         return TxnResult(TXN_ERR_PROGRAM, fee)
     cu_limit, heap_size = budget
+    # resolve upgradeable programs' programdata up front (the reference's
+    # account loader does the same indirection, fd_executor.c load path);
+    # a broken indirection surfaces as a typed failure at invoke time
+    from firedancer_tpu.flamenco import bpf_loader as bl
+
+    program_elfs: dict = {}
+    for a in accounts:
+        if a.executable and a.owner == bl.UPGRADEABLE_LOADER_PROGRAM:
+            try:
+                pd_addr = bl.program_programdata(bytes(a.data))
+                pd_val = funk.rec_query(xid, pd_addr)
+                _lam, _owner, _ex, pd_data = acct_decode(pd_val)
+                deploy_slot, _auth = bl.programdata_meta(pd_data)
+                program_elfs[a.key] = (bl.programdata_elf(pd_data),
+                                       deploy_slot)
+            except InstrError:
+                pass  # left unresolved: invocation fails typed
     ctx = TxnCtx(accounts=accounts, signer=signer, writable=writable,
                  sysvars=sysvars or {}, budget=cu_limit,
-                 heap_size=heap_size)
+                 heap_size=heap_size, program_elfs=program_elfs)
 
     for ins in desc.instrs:
         if ins.program_id >= len(addrs):

@@ -219,7 +219,6 @@ def txn_budget(payload: bytes, t: ft.Txn) -> tuple[int, int] | None:
             if not _cbp_parse(data, cbp):
                 return None
     _, cu_limit = _cbp_finalize(cbp, len(t.instrs))
+    # heap range was validated by _cbp_parse (pack and runtime agree)
     heap = cbp.heap_size if cbp.flags & _FLAG_SET_HEAP else DEFAULT_HEAP_SIZE
-    if heap < DEFAULT_HEAP_SIZE or heap > MAX_HEAP_SIZE:
-        return None
     return cu_limit, heap

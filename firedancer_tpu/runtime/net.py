@@ -182,7 +182,7 @@ class QuicIngressStage(UdpIngressStage):
     keeps near the socket)."""
 
     def __init__(self, *args, identity_secret: bytes, reasm_depth: int = 64,
-                 max_conns: int = 64, **kwargs):
+                 max_conns: int = 64, tx_filter=None, **kwargs):
         super().__init__(*args, **kwargs)
         from .tpu_reasm import TpuReasm
 
@@ -190,6 +190,25 @@ class QuicIngressStage(UdpIngressStage):
         self.max_conns = max_conns
         self.conns: dict = {}
         self.reasm = TpuReasm(depth=reasm_depth)
+        # tx_filter(datagram) -> bool; False drops the datagram before the
+        # socket (loss-recovery tests simulate lossy links with it)
+        self.tx_filter = tx_filter
+
+    def _send(self, dg: bytes, dst) -> None:
+        if self.tx_filter is not None and not self.tx_filter(dg):
+            self.metrics.inc("tx_dropped_by_filter")
+            return
+        self.sock.sendto(dg, dst)
+
+    def after_credit(self) -> None:
+        super().after_credit()
+        # loss-recovery housekeeping: fire PTO retransmissions even when
+        # the socket is quiet (a lost server flight must not deadlock the
+        # handshake — fd_quic's service loop runs its timers the same way)
+        for src, conn in list(self.conns.items()):
+            conn.poll_timers()
+            for dg in conn.flush():
+                self._send(dg, src)
 
     def _on_datagram(self, data: bytes, src) -> bool:
         from firedancer_tpu.waltz import quic, tls13
@@ -221,7 +240,7 @@ class QuicIngressStage(UdpIngressStage):
             self.conns[src] = conn
         self.metrics.inc("pkt_rx")
         for dg in conn.flush():
-            self.sock.sendto(dg, src)
+            self._send(dg, src)
         ok = True
         for sid, chunk, fin in conn.receive_stream_events(events):
             # every chunk feeds reassembly even under backpressure — the
@@ -254,7 +273,7 @@ class QuicTxnClient:
     the benchs-tile sender position (src/app/fddev/tiles/fd_benchs.c)."""
 
     def __init__(self, addr, *, expected_peer: bytes | None = None,
-                 timeout_s: float = 10.0):
+                 timeout_s: float = 10.0, tx_filter=None):
         from firedancer_tpu.waltz import quic
 
         self.addr = addr
@@ -262,29 +281,65 @@ class QuicTxnClient:
         self.sock.settimeout(0.05)
         self.conn = quic.Connection.client_new(expected_peer=expected_peer)
         self._next_stream = 2
-        deadline = None
+        self.tx_filter = tx_filter
         import time as _time
 
         deadline = _time.monotonic() + timeout_s
+        self._flush_out()
         while not self.conn.established:
-            for dg in self.conn.flush():
-                self.sock.sendto(dg, addr)
             try:
                 data, _ = self.sock.recvfrom(2048)
                 self.conn.receive(data)
             except socket.timeout:
                 pass
+            # PTO keeps a lossy handshake moving (lost Initial/Handshake
+            # flights retransmit; without this a single drop deadlocks)
+            self.conn.poll_timers()
+            self._flush_out()
             if _time.monotonic() > deadline:
                 raise TimeoutError("QUIC handshake timed out")
-        for dg in self.conn.flush():  # final Finished flight
-            self.sock.sendto(dg, addr)
+
+    def _flush_out(self) -> None:
+        for dg in self.conn.flush():
+            if self.tx_filter is not None and not self.tx_filter(dg):
+                continue
+            self.sock.sendto(dg, self.addr)
+
+    def _drain_rx(self) -> None:
+        """Nonblocking drain of inbound datagrams (acks, MAX_DATA window
+        updates) — restores the socket's handshake timeout after."""
+        self.sock.setblocking(False)
+        try:
+            while True:
+                try:
+                    data, _ = self.sock.recvfrom(2048)
+                except (BlockingIOError, InterruptedError, socket.timeout):
+                    break
+                self.conn.receive(data)
+        finally:
+            self.sock.settimeout(0.05)
 
     def send_txn(self, txn: bytes) -> None:
+        # learn window updates BEFORE queueing: past ~1 MiB cumulative
+        # the peer's MAX_DATA must be seen or writes park in blocked_out
+        self._drain_rx()
         sid = self._next_stream
         self._next_stream += 4
         self.conn.send_stream(sid, txn, fin=True)
-        for dg in self.conn.flush():
-            self.sock.sendto(dg, self.addr)
+        self._flush_out()
+
+    def pump(self) -> None:
+        """Process inbound datagrams (acks, window updates) and fire any
+        due retransmissions.  Call while waiting for delivery on lossy
+        links or during long send loops (flow-control windows only move
+        when inbound MAX_DATA frames are read)."""
+        self._drain_rx()
+        self.conn.poll_timers()
+        self._flush_out()
+
+    def unacked(self) -> bool:
+        """True while sent stream data is not yet fully acknowledged."""
+        return self.conn.has_unacked()
 
     def close(self) -> None:
         self.sock.close()

@@ -7,22 +7,32 @@ TLS handshake (waltz/tls13.py) rides CRYPTO frames across the initial/
 handshake levels; application data arrives on unidirectional client
 streams and feeds the TPU reassembler (runtime/tpu_reasm.py).  Like the
 reference: single-threaded, fully in-memory, no dynamic allocation
-after setup in the hot path — and the parts this build defers
-(loss recovery timers, migration, flow-control windows) are exactly the
-parts a reliable localnet link never exercises; the wire format is the
-real RFC 9000/9001 one:
+after setup in the hot path.  The wire format is the real RFC 9000/9001
+one:
 
   - Initial secrets from the client DCID with the v1 salt (§5.2)
   - AES-128-GCM packet protection, nonce = iv XOR packet-number
   - AES-ECB header protection over a 16-byte sample (§5.4)
   - long (Initial/Handshake) + short (1-RTT) headers, varint framing
-  - CRYPTO / STREAM / ACK / PING / PADDING / CONNECTION_CLOSE frames
+  - packet-number reconstruction against largest received (§A.3)
+  - CRYPTO / STREAM / multi-range ACK / flow-control / PING / PADDING /
+    CONNECTION_CLOSE / HANDSHAKE_DONE frames
+
+Reliability (the r3 gap; reference: fd_quic.c ack trees + loss recovery
+around fd_quic.c:2147): every ack-eliciting packet is tracked per level
+with its retransmittable frames; ACKs carry the full received-range set;
+packets ≥3 below the largest acked are declared lost and their CRYPTO/
+STREAM data re-queued; a PTO timer (exponential backoff) retransmits
+when acks stop arriving.  Flow control: MAX_DATA / MAX_STREAM_DATA
+windows enforced inbound and respected outbound (excess stream writes
+queue until the peer opens the window).
 """
 
 from __future__ import annotations
 
 import os
 import struct
+import time as _time
 from dataclasses import dataclass, field
 
 from firedancer_tpu.ops.aes import Aes, AesGcm
@@ -41,14 +51,37 @@ INITIAL_SALT_V1 = bytes.fromhex("38762cf7f55934b34d179ae6a4c80cadccbb7f0a")
 FT_PADDING = 0x00
 FT_PING = 0x01
 FT_ACK = 0x02
+FT_RESET_STREAM = 0x04
+FT_STOP_SENDING = 0x05
 FT_CRYPTO = 0x06
 FT_STREAM_BASE = 0x08  # 0x08..0x0f: OFF/LEN/FIN bits
+FT_MAX_DATA = 0x10
+FT_MAX_STREAM_DATA = 0x11
+FT_MAX_STREAMS_BIDI = 0x12
+FT_MAX_STREAMS_UNI = 0x13
+FT_DATA_BLOCKED = 0x14
+FT_STREAM_DATA_BLOCKED = 0x15
+FT_STREAMS_BLOCKED_BIDI = 0x16
+FT_STREAMS_BLOCKED_UNI = 0x17
+FT_NEW_CONNECTION_ID = 0x18
+FT_RETIRE_CONNECTION_ID = 0x19
 FT_CONN_CLOSE = 0x1C
+FT_HANDSHAKE_DONE = 0x1E
 
 LONG_INITIAL = 0
 LONG_HANDSHAKE = 2
 
 MAX_DATAGRAM = 1452
+MAX_FRAMES_PAYLOAD = 1200  # per-packet payload budget when packing frames
+
+# loss recovery (RFC 9002-shaped, fixed-timer profile)
+ACK_REORDER_THRESH = 3
+PTO_INITIAL_S = 0.2
+PTO_BACKOFF_CAP = 5  # doubling cap: 0.2 * 2^5 = 6.4 s
+
+# flow control windows (our receive side / assumed peer until updated)
+DEFAULT_MAX_DATA = 1 << 20
+DEFAULT_MAX_STREAM_DATA = 1 << 18
 
 
 class QuicError(RuntimeError):
@@ -122,6 +155,20 @@ def _hp_mask(hp: Aes, sample: bytes) -> bytes:
 PN_LEN = 2  # fixed 2-byte encoded packet numbers (valid per §17.1)
 
 
+def decode_pn(truncated: int, pn_nbits: int, largest: int) -> int:
+    """Reconstruct a full packet number from its truncated wire form
+    against the largest pn received so far (RFC 9000 Appendix A.3)."""
+    expected = largest + 1
+    win = 1 << pn_nbits
+    hwin = win >> 1
+    cand = (expected & ~(win - 1)) | truncated
+    if cand <= expected - hwin and cand + win < (1 << 62):
+        return cand + win
+    if cand > expected + hwin and cand >= win:
+        return cand - win
+    return cand
+
+
 def _long_header(ptype: int, dcid: bytes, scid: bytes, token: bytes,
                  payload_len: int, pn: int) -> bytes:
     first = 0xC0 | (ptype << 4) | (PN_LEN - 1)
@@ -163,11 +210,14 @@ class Packet:
 
 
 def open_packet(buf: bytes, off: int, key_for_level, *,
-                short_dcid_len: int) -> tuple[Packet | None, int]:
+                short_dcid_len: int,
+                largest_for_level=lambda lvl: -1) -> tuple[Packet | None, int]:
     """Unprotect one (possibly coalesced) packet starting at `off`.
     key_for_level(level, dcid) -> Keys | None.  Returns (packet, next
     offset); packet None when keys for that level are not ready (the
-    rest of the datagram is dropped, as the reference does)."""
+    rest of the datagram is dropped, as the reference does).
+    largest_for_level(level) -> largest pn seen, for §A.3 pn
+    reconstruction (without it any >16-bit pn derives wrong nonces)."""
     first = buf[off]
     if first & 0x80:  # long header
         if off + 7 > len(buf):
@@ -219,7 +269,8 @@ def open_packet(buf: bytes, off: int, key_for_level, *,
     pn_len = (work[0] & 0x03) + 1
     for i in range(pn_len):
         work[rel + i] ^= mask[1 + i]
-    pn = int.from_bytes(work[rel : rel + pn_len], "big")
+    truncated = int.from_bytes(work[rel : rel + pn_len], "big")
+    pn = decode_pn(truncated, 8 * pn_len, largest_for_level(level))
     hdr = bytes(work[: rel + pn_len])
     body = bytes(work[rel + pn_len :])
     if len(body) < 16:
@@ -249,11 +300,20 @@ def stream_frame(stream_id: int, offset: int, data: bytes, fin: bool) -> bytes:
     )
 
 
-def ack_frame(largest: int) -> bytes:
-    return (
+def ack_frame(ranges: list[tuple[int, int]]) -> bytes:
+    """ACK over [lo, hi] inclusive ranges (ascending order in), §19.3."""
+    rs = sorted(ranges, key=lambda r: r[1], reverse=True)
+    largest = rs[0][1]
+    out = bytearray(
         bytes([FT_ACK]) + varint_encode(largest) + varint_encode(0)
-        + varint_encode(0) + varint_encode(0)
+        + varint_encode(len(rs) - 1) + varint_encode(rs[0][1] - rs[0][0])
     )
+    prev_lo = rs[0][0]
+    for lo, hi in rs[1:]:
+        out += varint_encode(prev_lo - hi - 2)  # gap
+        out += varint_encode(hi - lo)           # range length
+        prev_lo = lo
+    return bytes(out)
 
 
 @dataclass
@@ -266,7 +326,8 @@ class StreamEvent:
 
 def parse_frames(payload: bytes):
     """Yield ('crypto', off, data) | ('stream', StreamEvent) |
-    ('ack', largest) | ('close', code) events."""
+    ('ack', ranges) | ('max_data', n) | ('max_stream_data', sid, n) |
+    ('handshake_done',) | ('close', code) events."""
     off = 0
     n = len(payload)
     while off < n:
@@ -276,15 +337,26 @@ def parse_frames(payload: bytes):
             continue
         if ft == FT_PING:
             continue
-        if ft == FT_ACK:
+        if ft in (FT_ACK, FT_ACK | 1):
             largest, off = varint_decode(payload, off)
             _delay, off = varint_decode(payload, off)
             range_cnt, off = varint_decode(payload, off)
-            _first, off = varint_decode(payload, off)
+            first, off = varint_decode(payload, off)
+            hi = largest
+            lo = largest - first
+            ranges = [(lo, hi)]
             for _ in range(range_cnt):
-                _gap, off = varint_decode(payload, off)
-                _ln, off = varint_decode(payload, off)
-            yield ("ack", largest)
+                gap, off = varint_decode(payload, off)
+                ln, off = varint_decode(payload, off)
+                hi = lo - gap - 2
+                lo = hi - ln
+                if lo < 0:
+                    raise QuicError("ACK range below zero")
+                ranges.append((lo, hi))
+            if ft & 1:  # ECN counts
+                for _ in range(3):
+                    _ecn, off = varint_decode(payload, off)
+            yield ("ack", ranges)
         elif ft == FT_CRYPTO:
             coff, off = varint_decode(payload, off)
             clen, off = varint_decode(payload, off)
@@ -309,6 +381,32 @@ def parse_frames(payload: bytes):
             yield ("stream", StreamEvent(sid, soff, payload[off : off + slen],
                                          bool(ft & 0x01)))
             off += slen
+        elif ft == FT_MAX_DATA:
+            v, off = varint_decode(payload, off)
+            yield ("max_data", v)
+        elif ft == FT_MAX_STREAM_DATA:
+            sid, off = varint_decode(payload, off)
+            v, off = varint_decode(payload, off)
+            yield ("max_stream_data", sid, v)
+        elif ft in (FT_MAX_STREAMS_BIDI, FT_MAX_STREAMS_UNI,
+                    FT_DATA_BLOCKED, FT_STREAMS_BLOCKED_BIDI,
+                    FT_STREAMS_BLOCKED_UNI, FT_RETIRE_CONNECTION_ID):
+            _v, off = varint_decode(payload, off)
+        elif ft == FT_STREAM_DATA_BLOCKED:
+            _sid, off = varint_decode(payload, off)
+            _v, off = varint_decode(payload, off)
+        elif ft in (FT_RESET_STREAM, FT_STOP_SENDING):
+            _sid, off = varint_decode(payload, off)
+            _code, off = varint_decode(payload, off)
+            if ft == FT_RESET_STREAM:
+                _final, off = varint_decode(payload, off)
+        elif ft == FT_NEW_CONNECTION_ID:
+            _seq, off = varint_decode(payload, off)
+            _retire, off = varint_decode(payload, off)
+            cid_len = payload[off]
+            off += 1 + cid_len + 16  # cid + stateless reset token
+        elif ft == FT_HANDSHAKE_DONE:
+            yield ("handshake_done",)
         elif ft in (FT_CONN_CLOSE, 0x1D):
             code, off = varint_decode(payload, off)
             if ft == FT_CONN_CLOSE:
@@ -358,6 +456,49 @@ class _OrderedStream:
         return self.fin_size is not None and self.delivered >= self.fin_size
 
 
+# -- received-pn tracking (feeds multi-range ACKs + duplicate drop) -----------
+
+
+class _RecvTracker:
+    def __init__(self):
+        self.ranges: list[list[int]] = []  # ascending, disjoint [lo, hi]
+
+    def seen(self, pn: int) -> bool:
+        return any(lo <= pn <= hi for lo, hi in self.ranges)
+
+    def add(self, pn: int) -> None:
+        rs = self.ranges
+        for i, r in enumerate(rs):
+            if r[0] - 1 <= pn <= r[1] + 1:
+                r[0] = min(r[0], pn)
+                r[1] = max(r[1], pn)
+                # merge with the next range if they now touch
+                if i + 1 < len(rs) and rs[i + 1][0] <= r[1] + 1:
+                    r[1] = max(r[1], rs[i + 1][1])
+                    del rs[i + 1]
+                return
+            if pn < r[0] - 1:
+                rs.insert(i, [pn, pn])
+                return
+        rs.append([pn, pn])
+        if len(rs) > 32:  # bound state: forget the oldest ranges
+            del rs[0 : len(rs) - 32]
+
+    @property
+    def largest(self) -> int:
+        return self.ranges[-1][1] if self.ranges else -1
+
+
+# -- sent-packet tracking (loss detection + PTO) ------------------------------
+
+
+@dataclass
+class SentPacket:
+    pn: int
+    time_sent: float
+    frames: list  # ('crypto', off, bytes) | ('stream', sid, off, bytes, fin)
+
+
 # -- connection ---------------------------------------------------------------
 
 
@@ -367,7 +508,8 @@ class Connection:
 
     Drive it: feed inbound datagrams to `receive` (returns stream
     events), pull outbound datagrams from `flush`, write app data with
-    `send_stream` once `established`."""
+    `send_stream` once `established`, and call `poll_timers` + `flush`
+    periodically so PTO retransmissions go out."""
 
     is_client: bool
     tls: tls13.Endpoint
@@ -402,19 +544,43 @@ class Connection:
         return c
 
     def _post_init(self):
-        self.pn_next = {INITIAL: 0, HANDSHAKE: 0, APPLICATION: 0}
-        self.largest_rx = {INITIAL: -1, HANDSHAKE: -1, APPLICATION: -1}
-        self.crypto_sent = {INITIAL: 0, HANDSHAKE: 0, APPLICATION: 0}
-        self.crypto_rx = {lvl: _OrderedStream() for lvl in
-                          (INITIAL, HANDSHAKE, APPLICATION)}
+        lvls = (INITIAL, HANDSHAKE, APPLICATION)
+        self.pn_next = {lvl: 0 for lvl in lvls}
+        self.crypto_sent = {lvl: 0 for lvl in lvls}
+        self.crypto_rx = {lvl: _OrderedStream() for lvl in lvls}
+        self.recv = {lvl: _RecvTracker() for lvl in lvls}
+        self.ack_pending: set[int] = set()
+        self.sent = {lvl: {} for lvl in lvls}  # pn -> SentPacket
+        self.crypto_rtx = {lvl: [] for lvl in lvls}  # [(off, bytes)]
+        self.stream_rtx: list[tuple[int, int, bytes, bool]] = []
+        self.raw_rtx: list[bytes] = []  # lost ctrl frames (MAX_DATA...)
+        self.pto_count = 0
         self.stream_rx: dict[int, _OrderedStream] = {}
         self.send_offset: dict[int, int] = {}
-        self.app_out: list[bytes] = []
+        self.app_out: list[tuple] = []  # retransmittable stream tuples
+        self.ctrl_out: list[bytes] = []  # fire-and-forget ctrl frames
         self.closed = False
+        self.handshake_done_sent = False
+        # flow control: our receive windows (advertised to the peer)
+        self.rx_max_data = DEFAULT_MAX_DATA
+        self.rx_consumed = 0
+        self.rx_data_total = 0  # sum of per-stream high-water offsets
+        self.rx_stream_high: dict[int, int] = {}
+        self.rx_stream_limit: dict[int, int] = {}
+        # peer's windows (what we may send)
+        self.tx_max_data = DEFAULT_MAX_DATA
+        self.tx_data_total = 0
+        self.tx_stream_limit: dict[int, int] = {}
+        self.blocked_out: list[tuple[int, bytes, bool]] = []
 
     @property
     def established(self) -> bool:
         return self.tls.complete
+
+    def has_unacked(self) -> bool:
+        return any(self.sent[lvl] for lvl in self.sent) or bool(
+            self.stream_rtx or self.blocked_out
+        )
 
     # -- keys --
 
@@ -432,7 +598,9 @@ class Connection:
 
     # -- inbound --
 
-    def receive(self, datagram: bytes) -> list[StreamEvent]:
+    def receive(self, datagram: bytes, now: float | None = None
+                ) -> list[StreamEvent]:
+        now = _time.monotonic() if now is None else now
         events: list[StreamEvent] = []
         off = 0
         while off < len(datagram):
@@ -447,15 +615,22 @@ class Connection:
             pkt, off = open_packet(
                 datagram, off, self._rx_keys,
                 short_dcid_len=len(self.local_cid),
+                largest_for_level=lambda lvl: self.recv[lvl].largest,
             )
             if pkt is None:
                 continue
-            self.largest_rx[pkt.level] = max(self.largest_rx[pkt.level],
-                                             pkt.pn)
+            tracker = self.recv[pkt.level]
+            if tracker.seen(pkt.pn):
+                # duplicate (e.g. a spurious retransmission): re-ack only
+                self.ack_pending.add(pkt.level)
+                continue
+            tracker.add(pkt.pn)
             if pkt.level == INITIAL and pkt.scid:
                 # both sides route subsequent packets at the peer's SCID
                 self.remote_cid = pkt.scid
             for ev in parse_frames(pkt.payload):
+                if ev[0] != "ack":
+                    self.ack_pending.add(pkt.level)
                 if ev[0] == "crypto":
                     _, coff, data = ev
                     ready = self.crypto_rx[pkt.level].insert(coff, data)
@@ -463,10 +638,37 @@ class Connection:
                         self.tls.consume(pkt.level, ready)
                         self._maybe_install_keys()
                 elif ev[0] == "stream":
+                    self._rx_flow_check(ev[1])
                     events.append(ev[1])
+                elif ev[0] == "ack":
+                    self._on_ack(pkt.level, ev[1], now)
+                elif ev[0] == "max_data":
+                    self.tx_max_data = max(self.tx_max_data, ev[1])
+                    self._drain_blocked()
+                elif ev[0] == "max_stream_data":
+                    _, sid, v = ev
+                    cur = self.tx_stream_limit.get(sid, DEFAULT_MAX_STREAM_DATA)
+                    self.tx_stream_limit[sid] = max(cur, v)
+                    self._drain_blocked()
                 elif ev[0] == "close":
                     self.closed = True
         return events
+
+    def _rx_flow_check(self, ev: StreamEvent) -> None:
+        """Enforce our advertised windows on inbound stream data."""
+        end = ev.offset + len(ev.data)
+        limit = self.rx_stream_limit.get(ev.stream_id, DEFAULT_MAX_STREAM_DATA)
+        if end > limit:
+            raise QuicError(
+                f"stream {ev.stream_id} flow control violated "
+                f"({end} > {limit})"
+            )
+        high = self.rx_stream_high.get(ev.stream_id, 0)
+        if end > high:
+            self.rx_data_total += end - high
+            self.rx_stream_high[ev.stream_id] = end
+            if self.rx_data_total > self.rx_max_data:
+                raise QuicError("connection flow control violated")
 
     def _server_adopt(self, datagram: bytes, off: int):
         if off + 6 > len(datagram):
@@ -482,50 +684,189 @@ class Connection:
     def _rx_keys(self, level: int, _dcid: bytes):
         return self.keys_rx.get(level)
 
+    # -- loss recovery --
+
+    def _on_ack(self, level: int, ranges: list[tuple[int, int]],
+                now: float) -> None:
+        sent = self.sent[level]
+        newly = [
+            pn for pn in sent
+            if any(lo <= pn <= hi for lo, hi in ranges)
+        ]
+        for pn in newly:
+            del sent[pn]
+        if newly:
+            self.pto_count = 0
+        largest_acked = max(hi for _lo, hi in ranges)
+        # packet-threshold loss: anything ACK_REORDER_THRESH below the
+        # largest acked that is still outstanding is lost
+        for pn in sorted(sent):
+            if pn <= largest_acked - ACK_REORDER_THRESH:
+                self._queue_rtx(level, sent.pop(pn))
+
+    def _queue_rtx(self, level: int, pkt: SentPacket) -> None:
+        for fr in pkt.frames:
+            if fr[0] == "crypto":
+                self.crypto_rtx[level].append((fr[1], fr[2]))
+            elif fr[0] == "stream":
+                self.stream_rtx.append((fr[1], fr[2], fr[3], fr[4]))
+            elif fr[0] == "raw":
+                # window updates / HANDSHAKE_DONE: cumulative-maximum
+                # semantics make a stale resend harmless, and a LOST
+                # MAX_DATA would otherwise deadlock the sender forever
+                self.raw_rtx.append(fr[1])
+
+    def poll_timers(self, now: float | None = None) -> None:
+        """PTO: when the oldest outstanding packet of a level has waited
+        a full timeout with no ack, re-queue everything outstanding at
+        that level (the next flush retransmits) and back off."""
+        now = _time.monotonic() if now is None else now
+        pto = PTO_INITIAL_S * (2 ** min(self.pto_count, PTO_BACKOFF_CAP))
+        fired = False
+        for lvl, sent in self.sent.items():
+            if not sent:
+                continue
+            oldest = min(p.time_sent for p in sent.values())
+            if now - oldest >= pto:
+                for pn in sorted(sent):
+                    self._queue_rtx(lvl, sent.pop(pn))
+                fired = True
+        if fired:
+            self.pto_count += 1
+
     # -- outbound --
 
     def send_stream(self, stream_id: int, data: bytes, *,
                     fin: bool = False) -> None:
         if not self.established:
             raise QuicError("stream before handshake completion")
-        off = self.send_offset.get(stream_id, 0)
-        self.app_out.append(stream_frame(stream_id, off, data, fin))
-        self.send_offset[stream_id] = off + len(data)
+        self._send_stream_inner(stream_id, data, fin)
 
-    def flush(self) -> list[bytes]:
-        """Drain pending CRYPTO/app frames into protected datagrams."""
+    def _send_stream_inner(self, stream_id: int, data: bytes,
+                           fin: bool) -> None:
+        off = self.send_offset.get(stream_id, 0)
+        slimit = self.tx_stream_limit.get(stream_id, DEFAULT_MAX_STREAM_DATA)
+        if off + len(data) > slimit or (
+            self.tx_data_total + len(data) > self.tx_max_data
+        ):
+            # peer window closed: hold the write until MAX_DATA /
+            # MAX_STREAM_DATA opens it (order within the queue preserved)
+            self.blocked_out.append((stream_id, data, fin))
+            return
+        self.app_out.append(("stream", stream_id, off, data, fin))
+        self.send_offset[stream_id] = off + len(data)
+        self.tx_data_total += len(data)
+
+    def _drain_blocked(self) -> None:
+        pending, self.blocked_out = self.blocked_out, []
+        for sid, data, fin in pending:
+            self._send_stream_inner(sid, data, fin)
+
+    def _rx_window_updates(self, dirty: set[int]) -> None:
+        """Advertise bigger windows once half the current one is used.
+        Only `dirty` streams (delivered-count changed this batch) are
+        examined — the TPU client opens a stream per txn, so scanning
+        every stream ever seen would be O(N^2) over a batch."""
+        if self.rx_consumed * 2 > self.rx_max_data:
+            self.rx_max_data = self.rx_consumed + DEFAULT_MAX_DATA
+            self.ctrl_out.append(
+                bytes([FT_MAX_DATA]) + varint_encode(self.rx_max_data)
+            )
+        for sid in dirty:
+            st = self.stream_rx.get(sid)
+            if st is None:
+                continue
+            limit = self.rx_stream_limit.get(sid, DEFAULT_MAX_STREAM_DATA)
+            if st.fin_size is None and st.delivered * 2 > limit:
+                new = st.delivered + DEFAULT_MAX_STREAM_DATA
+                self.rx_stream_limit[sid] = new
+                self.ctrl_out.append(
+                    bytes([FT_MAX_STREAM_DATA]) + varint_encode(sid)
+                    + varint_encode(new)
+                )
+
+    def flush(self, now: float | None = None) -> list[bytes]:
+        """Drain pending CRYPTO/ACK/ctrl/app frames into protected
+        datagrams, recording every retransmittable frame for loss
+        recovery."""
+        now = _time.monotonic() if now is None else now
         out: list[bytes] = []
+        if self.established and not self.is_client and (
+            not self.handshake_done_sent
+        ) and APPLICATION in self.keys_tx:
+            self.ctrl_out.append(bytes([FT_HANDSHAKE_DONE]))
+            self.handshake_done_sent = True
         for lvl in (INITIAL, HANDSHAKE, APPLICATION):
-            frames = bytearray()
-            pend = self.tls.pending[lvl]
-            if pend:
-                frames += crypto_frame(self.crypto_sent[lvl], bytes(pend))
-                self.crypto_sent[lvl] += len(pend)
-                pend.clear()
-            if self.largest_rx[lvl] >= 0:
-                frames += ack_frame(self.largest_rx[lvl])
-                self.largest_rx[lvl] = -1  # ack once
+            if self.keys_tx.get(lvl) is None:
+                continue
+            pending: list[tuple[bytes, tuple | None]] = []
+            # retransmissions first (they unblock the peer's progress)
+            for coff, data in self.crypto_rtx[lvl]:
+                pending.append((crypto_frame(coff, data),
+                                ("crypto", coff, data)))
+            self.crypto_rtx[lvl].clear()
+            tls_pend = self.tls.pending[lvl]
+            if tls_pend:
+                data = bytes(tls_pend)
+                coff = self.crypto_sent[lvl]
+                pending.append((crypto_frame(coff, data),
+                                ("crypto", coff, data)))
+                self.crypto_sent[lvl] += len(data)
+                tls_pend.clear()
+            if lvl in self.ack_pending and self.recv[lvl].ranges:
+                pending.append(
+                    (ack_frame([tuple(r) for r in self.recv[lvl].ranges]),
+                     None)
+                )
+                self.ack_pending.discard(lvl)
             if lvl == APPLICATION:
-                for f in self.app_out:
-                    frames += f
+                for wire in self.raw_rtx:
+                    pending.append((wire, ("raw", wire)))
+                self.raw_rtx.clear()
+                for wire in self.ctrl_out:
+                    pending.append((wire, ("raw", wire)))
+                self.ctrl_out.clear()
+                for sid, soff, data, fin in self.stream_rtx:
+                    pending.append((stream_frame(sid, soff, data, fin),
+                                    ("stream", sid, soff, data, fin)))
+                self.stream_rtx.clear()
+                for item in self.app_out:
+                    _, sid, soff, data, fin = item
+                    pending.append((stream_frame(sid, soff, data, fin),
+                                    ("stream", sid, soff, data, fin)))
                 self.app_out.clear()
-            if not frames:
-                continue
-            keys = self.keys_tx.get(lvl)
-            if keys is None:
-                continue
-            payload = bytes(frames)
-            if lvl == INITIAL and self.is_client and len(payload) < 1200:
-                # §14.1: the whole DATAGRAM must be >= 1200 bytes; padding
-                # the payload itself to 1200 clears that with the ~30-byte
-                # header + 16-byte tag on top
-                payload += bytes(1200 - len(payload))
-            pn = self.pn_next[lvl]
-            self.pn_next[lvl] += 1
-            out.append(seal_packet(
-                keys, level=lvl, dcid=self.remote_cid, scid=self.local_cid,
-                pn=pn, payload=payload,
-            ))
+            # pack frames greedily into <= MAX_FRAMES_PAYLOAD packets (a
+            # single frame larger than the budget still goes out alone —
+            # CRYPTO flights exceed it and the link MTU tolerates them)
+            while pending:
+                frames = bytearray()
+                record: list = []
+                while pending and (
+                    not frames
+                    or len(frames) + len(pending[0][0]) <= MAX_FRAMES_PAYLOAD
+                ):
+                    wire, rec = pending.pop(0)
+                    frames.extend(wire)
+                    if rec is not None:
+                        record.append(rec)
+                payload = bytes(frames)
+                if len(payload) < 4:
+                    # §5.4.2: the ciphertext must cover the 16-byte HP
+                    # sample at pn_off+4; PADDING frames make up the rest
+                    payload += bytes(4 - len(payload))
+                if lvl == INITIAL and self.is_client and len(payload) < 1200:
+                    # §14.1: the whole DATAGRAM must be >= 1200 bytes;
+                    # padding the payload itself to 1200 clears that with
+                    # the ~30-byte header + 16-byte tag on top
+                    payload += bytes(1200 - len(payload))
+                pn = self.pn_next[lvl]
+                self.pn_next[lvl] += 1
+                out.append(seal_packet(
+                    self.keys_tx[lvl], level=lvl, dcid=self.remote_cid,
+                    scid=self.local_cid, pn=pn, payload=payload,
+                ))
+                if record:
+                    self.sent[lvl][pn] = SentPacket(pn, now, record)
         return out
 
     def receive_stream_events(self, events: list[StreamEvent]):
@@ -534,11 +875,16 @@ class Connection:
         byte up to the FIN offset has been delivered — a FIN frame
         arriving ahead of a gap must not finalize a short stream."""
         out = []
+        dirty: set[int] = set()
         for ev in events:
             st = self.stream_rx.setdefault(ev.stream_id, _OrderedStream())
             if ev.fin:
                 st.fin_size = ev.offset + len(ev.data)
             ready = st.insert(ev.offset, ev.data)
+            if ready:
+                self.rx_consumed += len(ready)
+                dirty.add(ev.stream_id)
             if ready or st.finished:
                 out.append((ev.stream_id, ready, st.finished))
+        self._rx_window_updates(dirty)
         return out

@@ -208,7 +208,22 @@ def test_frozen_and_deactivated_rules():
     res = _run_alt_instr(funk, secret, auth, [table, auth], ext, slot=6)
     assert res.results[0].status != TXN_SUCCESS
     # a frozen (authority-less) table still RESOLVES
-    got = fa.resolve_lookups  # direct resolution check below
+    frozen = fa.TableState.decode(
+        bytes(funk.rec_query(None, table)[41:])
+    )
+    assert frozen.authority is None
+
+    class _Desc:
+        addr_luts = [type("L", (), {
+            "addr_off": 0, "writable_off": 32, "writable_cnt": 1,
+            "readonly_off": 33, "readonly_cnt": 0,
+        })()]
+
+    payload = table + bytes([0]) + b""
+    w, r = fa.resolve_lookups(
+        payload, _Desc(), lambda k: funk.rec_query(None, k), slot=7
+    )
+    assert w == [b"x" * 32] and r == []
 
 
 def test_hostile_alt_instructions_fail_txn_not_block():

@@ -1,0 +1,224 @@
+"""Upgradeable BPF loader: deploy a program THROUGH transactions, invoke
+it, upgrade it, close it (the r3 gap: no program could be deployed through
+this validator).
+
+Flow under test (all through execute_block):
+  slot 5: create buffer+program accounts, InitializeBuffer, Write x2
+  slot 6: DeployWithMaxDataLen  (program live NEXT slot)
+  slot 6: invoke -> fails (deploy-slot visibility rule)
+  slot 7: invoke -> success
+  slot 8: upgrade via a second buffer
+  slot 9: invoke -> the NEW program's behavior
+  slot 10: close programdata -> invoke fails
+"""
+
+import hashlib
+
+from firedancer_tpu.flamenco import bpf_loader as bl
+from firedancer_tpu.flamenco.runtime import (
+    TXN_ERR_PROGRAM,
+    TXN_SUCCESS,
+    acct_build,
+    execute_block,
+)
+from firedancer_tpu.funk import Funk
+from firedancer_tpu.ops.ref import ed25519_ref as ref
+from firedancer_tpu.protocol import pda
+from firedancer_tpu.protocol import txn as ft
+from tests.test_sbpf import build_elf, ins
+
+
+def keypair(tag: bytes):
+    secret = hashlib.sha256(tag).digest()
+    return secret, ref.public_key(secret)
+
+
+def _bh(tag: bytes) -> bytes:
+    return hashlib.sha256(tag).digest()
+
+
+ELF_V1 = build_elf(ins(0xB7, dst=0, imm=0) + ins(0x95))  # returns 0: success
+ELF_V2 = build_elf(ins(0xB7, dst=0, imm=7) + ins(0x95))  # returns 7: error
+
+
+def _block(funk, slot, secrets, addrs, instrs, *, ro_unsigned, luts=None):
+    msg = ft.message_build(
+        version=ft.VLEGACY, signature_cnt=len(secrets),
+        readonly_signed_cnt=0, readonly_unsigned_cnt=ro_unsigned,
+        acct_addrs=addrs, recent_blockhash=_bh(b"bl%d" % slot),
+        instrs=instrs, luts=luts,
+    )
+    txn = ft.txn_assemble([ref.sign(s, msg) for s in secrets], msg)
+    res = execute_block(funk, slot=slot, txns=[txn])
+    funk.txn_publish(res.xid)
+    return res.results[0]
+
+
+def _sys_create(funder_idx, new_idx, lamports, space, owner):
+    data = ((0).to_bytes(4, "little") + lamports.to_bytes(8, "little")
+            + space.to_bytes(8, "little") + owner)
+    return ft.InstrSpec(program_id=None, accounts=bytes([funder_idx, new_idx]),
+                        data=data)
+
+
+def _write_ix(offset, payload):
+    return ((1).to_bytes(4, "little") + offset.to_bytes(4, "little")
+            + len(payload).to_bytes(8, "little") + payload)
+
+
+def _deploy_fixture():
+    funk = Funk()
+    payer_sec, payer = keypair(b"bl-payer")
+    buf_sec, buf = keypair(b"bl-buffer")
+    prog_sec, prog = keypair(b"bl-program")
+    funk.rec_insert(None, payer, acct_build(100_000_000))
+    progdata, _ = pda.find_program_address([prog], bl.UPGRADEABLE_LOADER_PROGRAM)
+
+    # slot 5: create accounts + init buffer + write the ELF in two chunks
+    addrs = [payer, buf, prog, ft.SYSTEM_PROGRAM,
+             bl.UPGRADEABLE_LOADER_PROGRAM]
+    elf = ELF_V1
+    half = len(elf) // 2
+    create_buf = ((0).to_bytes(4, "little") + (1).to_bytes(8, "little")
+                  + (bl.BUFFER_META_SIZE + len(elf)).to_bytes(8, "little")
+                  + bl.UPGRADEABLE_LOADER_PROGRAM)
+    create_prog = ((0).to_bytes(4, "little") + (1).to_bytes(8, "little")
+                   + bl.PROGRAM_SIZE.to_bytes(8, "little")
+                   + bl.UPGRADEABLE_LOADER_PROGRAM)
+    r = _block(
+        funk, 5, [payer_sec, buf_sec, prog_sec], addrs,
+        [
+            ft.InstrSpec(program_id=3, accounts=bytes([0, 1]),
+                         data=create_buf),
+            ft.InstrSpec(program_id=3, accounts=bytes([0, 2]),
+                         data=create_prog),
+            ft.InstrSpec(program_id=4, accounts=bytes([1, 0]),
+                         data=(0).to_bytes(4, "little")),  # InitializeBuffer
+            ft.InstrSpec(program_id=4, accounts=bytes([1, 0]),
+                         data=_write_ix(0, elf[:half])),
+            ft.InstrSpec(program_id=4, accounts=bytes([1, 0]),
+                         data=_write_ix(half, elf[half:])),
+        ],
+        ro_unsigned=2,
+    )
+    assert r.status == TXN_SUCCESS, r
+    return funk, payer_sec, payer, buf, prog, progdata, buf_sec, prog_sec
+
+
+def _deploy(funk, payer_sec, payer, buf, prog, progdata, *, slot,
+            max_len=None):
+    max_len = max_len if max_len is not None else len(ELF_V1) + 64
+    addrs = [payer, progdata, prog, buf, ft.SYSTEM_PROGRAM,
+             bl.UPGRADEABLE_LOADER_PROGRAM]
+    deploy = (2).to_bytes(4, "little") + max_len.to_bytes(8, "little")
+    return _block(
+        funk, slot, [payer_sec], addrs,
+        # [payer s w, programdata w, program w, buffer w, authority s]
+        [ft.InstrSpec(program_id=5, accounts=bytes([0, 1, 2, 3, 0]),
+                      data=deploy)],
+        ro_unsigned=2,
+    )
+
+
+def _invoke(funk, payer_sec, payer, prog, progdata, *, slot):
+    addrs = [payer, prog, progdata]
+    return _block(
+        funk, slot, [payer_sec], addrs,
+        [ft.InstrSpec(program_id=1, accounts=bytes([0]), data=b"")],
+        ro_unsigned=2,
+    )
+
+
+def test_deploy_then_invoke_lifecycle():
+    funk, payer_sec, payer, buf, prog, progdata, *_ = _deploy_fixture()
+
+    r = _deploy(funk, payer_sec, payer, buf, prog, progdata, slot=6)
+    assert r.status == TXN_SUCCESS, r
+    # program account is live; buffer consumed
+    val = funk.rec_query(None, prog)
+    assert val[40] == 1  # executable flag in the account encoding
+    assert bl.program_programdata(val[41:]) == progdata
+    assert funk.rec_query(None, buf) is None or len(funk.rec_query(None, buf)) <= 41
+
+    # same-slot invoke: the deploy-slot visibility rule rejects it
+    r = _invoke(funk, payer_sec, payer, prog, progdata, slot=6)
+    assert r.status == TXN_ERR_PROGRAM
+
+    # next slot: runs (ELF_V1 returns 0)
+    r = _invoke(funk, payer_sec, payer, prog, progdata, slot=7)
+    assert r.status == TXN_SUCCESS, r
+
+
+def test_upgrade_and_close():
+    funk, payer_sec, payer, buf, prog, progdata, *_ = _deploy_fixture()
+    assert _deploy(funk, payer_sec, payer, buf, prog, progdata,
+                   slot=6).status == TXN_SUCCESS
+
+    # stage ELF_V2 in a fresh buffer
+    buf2_sec, buf2 = keypair(b"bl-buffer2")
+    addrs = [payer, buf2, ft.SYSTEM_PROGRAM, bl.UPGRADEABLE_LOADER_PROGRAM]
+    create_buf2 = ((0).to_bytes(4, "little") + (1).to_bytes(8, "little")
+                   + (bl.BUFFER_META_SIZE + len(ELF_V2)).to_bytes(8, "little")
+                   + bl.UPGRADEABLE_LOADER_PROGRAM)
+    r = _block(
+        funk, 7, [payer_sec, buf2_sec], addrs,
+        [
+            ft.InstrSpec(program_id=2, accounts=bytes([0, 1]),
+                         data=create_buf2),
+            ft.InstrSpec(program_id=3, accounts=bytes([1, 0]),
+                         data=(0).to_bytes(4, "little")),
+            ft.InstrSpec(program_id=3, accounts=bytes([1, 0]),
+                         data=_write_ix(0, ELF_V2)),
+        ],
+        ro_unsigned=2,
+    )
+    assert r.status == TXN_SUCCESS, r
+
+    # upgrade: [programdata w, program w, buffer w, spill w, authority s]
+    addrs = [payer, progdata, prog, buf2, bl.UPGRADEABLE_LOADER_PROGRAM]
+    r = _block(
+        funk, 8, [payer_sec], addrs,
+        [ft.InstrSpec(program_id=4, accounts=bytes([1, 2, 3, 0, 0]),
+                      data=(3).to_bytes(4, "little"))],
+        ro_unsigned=1,
+    )
+    assert r.status == TXN_SUCCESS, r
+
+    # the NEW program returns 7 -> typed program error
+    r = _invoke(funk, payer_sec, payer, prog, progdata, slot=9)
+    assert r.status == TXN_ERR_PROGRAM
+
+    # close programdata -> invocation dead
+    addrs = [payer, progdata, prog, bl.UPGRADEABLE_LOADER_PROGRAM]
+    r = _block(
+        funk, 10, [payer_sec], addrs,
+        # Close: [target w, recipient w, authority s, program w]
+        [ft.InstrSpec(program_id=3, accounts=bytes([1, 0, 0, 2]),
+                      data=(5).to_bytes(4, "little"))],
+        ro_unsigned=1,
+    )
+    assert r.status == TXN_SUCCESS, r
+    r = _invoke(funk, payer_sec, payer, prog, progdata, slot=11)
+    assert r.status == TXN_ERR_PROGRAM
+
+
+def test_deploy_requires_matching_buffer_authority():
+    funk, payer_sec, payer, buf, prog, progdata, *_ = _deploy_fixture()
+    intruder_sec, intruder = keypair(b"bl-intruder")
+    funk.rec_insert(None, intruder, acct_build(100_000_000))
+    r = _deploy(funk, intruder_sec, intruder, buf, prog, progdata, slot=6)
+    assert r.status != TXN_SUCCESS
+
+
+def test_write_needs_buffer_authority():
+    funk, payer_sec, payer, buf, prog, progdata, *_ = _deploy_fixture()
+    intruder_sec, intruder = keypair(b"bl-intruder2")
+    funk.rec_insert(None, intruder, acct_build(100_000_000))
+    addrs = [intruder, buf, bl.UPGRADEABLE_LOADER_PROGRAM]
+    r = _block(
+        funk, 6, [intruder_sec], addrs,
+        [ft.InstrSpec(program_id=2, accounts=bytes([1, 0]),
+                      data=_write_ix(0, b"\xcc" * 8))],
+        ro_unsigned=1,
+    )
+    assert r.status != TXN_SUCCESS

@@ -1,0 +1,84 @@
+"""QUIC ingress e2e over a lossy link: handshake + txn delivery with 10%
+of datagrams dropped in BOTH directions (the r3 verdict's 'done'
+criterion for QUIC loss recovery)."""
+
+import hashlib
+import time
+
+from firedancer_tpu.ops.ref import ed25519_ref as ref
+from firedancer_tpu.tango import shm
+
+
+def test_quic_ingress_delivers_over_10pct_loss():
+    from firedancer_tpu.runtime.net import QuicIngressStage, QuicTxnClient
+
+    uid = hashlib.sha256(b"loss-e2e").hexdigest()[:8]
+    link = shm.ShmLink.create(f"fdtpu_loss_{uid}", depth=256, mtu=2400)
+    identity = hashlib.sha256(b"loss-srv").digest()
+
+    class Dropper:
+        """Deterministic 10%: every 10th datagram vanishes."""
+
+        def __init__(self):
+            self.n = 0
+            self.dropped = 0
+
+        def __call__(self, dg: bytes) -> bool:
+            self.n += 1
+            if self.n % 10 == 0:
+                self.dropped += 1
+                return False
+            return True
+
+    srv_drop, cli_drop = Dropper(), Dropper()
+    ingress = QuicIngressStage(
+        "quic", outs=[shm.Producer(link)], rx_burst=32,
+        identity_secret=identity, tx_filter=srv_drop,
+    )
+    sink = shm.Consumer(link, lazy=8)
+    txns = [b"losstxn-%03d-" % i + bytes(range(64)) for i in range(20)]
+    client = None
+    try:
+        import threading
+
+        box = {}
+
+        def connect():
+            box["c"] = QuicTxnClient(
+                ingress.addr, expected_peer=ref.public_key(identity),
+                tx_filter=cli_drop, timeout_s=60,
+            )
+
+        t = threading.Thread(target=connect)
+        t.start()
+        deadline = time.monotonic() + 240
+        while t.is_alive() and time.monotonic() < deadline:
+            ingress.run_once()
+            time.sleep(0.001)
+        t.join(timeout=1)
+        client = box["c"]
+
+        for txn in txns:
+            client.send_txn(txn)
+        got = []
+        deadline = time.monotonic() + 240
+        while len(got) < len(txns) and time.monotonic() < deadline:
+            ingress.run_once()
+            client.pump()
+            r = sink.poll()
+            if isinstance(r, tuple):
+                got.append(bytes(r[1]))
+        assert len(got) == len(txns)
+        assert set(got) == set(txns)
+        # the lossy link actually dropped traffic in both directions
+        assert srv_drop.dropped + cli_drop.dropped > 0
+        # and retransmission eventually drains the client's sent state
+        deadline = time.monotonic() + 60
+        while client.unacked() and time.monotonic() < deadline:
+            ingress.run_once()
+            client.pump()
+        assert not client.unacked()
+    finally:
+        if client is not None:
+            client.close()
+        ingress.close()
