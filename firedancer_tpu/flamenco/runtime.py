@@ -52,6 +52,8 @@ TXN_ERR_INSUFFICIENT_FUNDS = -2  # program failed: fee charged, no effects
 TXN_ERR_ACCT = -3                # unresolvable account index (ALT accounts
                                  # need the address-resolution stage)
 TXN_ERR_PROGRAM = -4             # program/VM error: fee charged, no effects
+TXN_ERR_BLOCKHASH = -5           # recent_blockhash unknown/expired: no fee
+TXN_ERR_ALREADY_PROCESSED = -6   # signature already landed on this fork
 
 
 def acct_lamports(val: bytes | None) -> int:
@@ -289,10 +291,17 @@ def execute_block(
     poh_hash: bytes = b"\x00" * 32,
     parent_xid: bytes | None = None,
     publish: bool = False,
+    status_cache=None,
+    ancestors: set[int] | None = None,
 ) -> BlockResult:
     """Execute a block's txns on a fresh funk fork; compute the bank hash.
 
-    The fork stays in-prep (consensus decides) unless publish=True."""
+    The fork stays in-prep (consensus decides) unless publish=True.
+    status_cache (flamenco/blockstore.StatusCache) arms the two
+    consensus-critical txn gates: recent-blockhash currency (150-slot
+    age) and cross-slot duplicate-signature rejection (filtered by
+    `ancestors` when given — fork awareness).  Executed signatures are
+    recorded, and this slot's poh_hash registers as a usable blockhash."""
     parsed = []
     for p in txns:
         t = ft.txn_parse(p)
@@ -335,13 +344,29 @@ def execute_block(
 
     sysvars = default_sysvars(slot)
     results: list[TxnResult] = [None] * len(parsed)
+    # a slot is not in its own ancestor set, but ITS insertions must gate
+    # its own later txns (intra-block duplicates) — widen the filter
+    anc = None if ancestors is None else set(ancestors) | {slot}
     for wave in waves:
         # wave txns are conflict-free: host executes in index order, a
         # tpool/device executes them concurrently — same result either way
         for i in wave:
             p, t = parsed[i]
+            if status_cache is not None:
+                bh = t.recent_blockhash(p)
+                sig = t.signatures(p)[0]
+                if not status_cache.is_blockhash_valid(bh, slot):
+                    results[i] = TxnResult(TXN_ERR_BLOCKHASH, 0)
+                    continue
+                if status_cache.contains(bh, sig, anc):
+                    results[i] = TxnResult(TXN_ERR_ALREADY_PROCESSED, 0)
+                    continue
             results[i] = _execute_txn(funk, xid, p, t, sysvars=sysvars,
                                       extra=extras[i])
+            if status_cache is not None and results[i].fee > 0:
+                # any fee-charged txn occupies its signature (failed txns
+                # landed on chain too — fd_txncache records both)
+                status_cache.insert(bh, sig, slot)
 
     # accounts-delta lattice hash: one device reduction over +new / -old
     vals = []
@@ -369,6 +394,8 @@ def execute_block(
         + sig_cnt.to_bytes(8, "little")
         + poh_hash
     ).digest()
+    if status_cache is not None:
+        status_cache.register_blockhash(poh_hash, slot)
     if publish:
         funk.txn_publish(xid)
     return BlockResult(

@@ -1,23 +1,27 @@
-"""JSON-RPC server: the operator/bench query surface.
+"""JSON-RPC server: the operator/bench/wallet query surface.
 
 Counterpart of /root/reference/src/app/rpcserver (a JSON-RPC server over
-replay notifications) scoped to the methods the tooling actually drives —
-fddev's bencho polls getTransactionCount once a second to print txn/s
-(tiles/fd_bencho.c:10-26), operators poll slots/balances:
+replay notifications; method table src/app/rpcserver/keywords.txt).
+Served methods:
 
-    getTransactionCount  -> txns committed by the bank stages
-    getSlot              -> the current/last slot
-    getBalance           -> lamports from funk (base58 pubkey param)
-    getHealth            -> "ok"
+    getTransactionCount   getSlot          getBlockHeight   getHealth
+    getBalance            getAccountInfo   getVersion       getGenesisHash
+    getLatestBlockhash    isBlockhashValid getSignatureStatuses
+    sendTransaction       getEpochInfo     getFirstAvailableBlock
+    getMinimumBalanceForRentExemption      requestAirdrop (faucet-gated)
 
-The server reads live state through a provided `view` object (duck-typed:
-.transaction_count() .slot() .balance(pubkey)); the pipeline adapter
-below wires it to a LeaderPipeline + funk.  Standard JSON-RPC 2.0 over
-HTTP POST, stdlib server, threaded like the metrics endpoint.
+— the minimum a bench observer (fd_bencho polls getTransactionCount),
+a wallet (sendTransaction/getLatestBlockhash/getSignatureStatuses/
+getAccountInfo), and an operator need.
+
+The server reads live state through a provided `view` object (duck-typed;
+PipelineView wires a LeaderPipeline + funk + StatusCache + blockstore).
+Standard JSON-RPC 2.0 over HTTP POST on the framework's own HTTP parser.
 """
 
 from __future__ import annotations
 
+import base64
 import json
 import threading
 from dataclasses import dataclass
@@ -25,11 +29,16 @@ from dataclasses import dataclass
 
 @dataclass
 class PipelineView:
-    """Live view over the flagship pipeline (+ optional funk)."""
+    """Live view over the flagship pipeline (+ optional funk/caches)."""
 
     pipeline: object = None
     funk: object = None
     slot_fn: object = None
+    status_cache: object = None   # flamenco/blockstore.StatusCache
+    blockstore: object = None     # flamenco/blockstore.Blockstore
+    submit_fn: object = None      # callable(txn bytes) -> bool
+    genesis_hash_fn: object = None
+    faucet_fn: object = None      # callable(pubkey, lamports) -> bool
 
     def transaction_count(self) -> int:
         if self.pipeline is None:
@@ -44,11 +53,43 @@ class PipelineView:
         return 0
 
     def balance(self, pubkey: bytes) -> int:
-        if self.funk is None:
-            return 0
+        return self.account(pubkey)[0]
+
+    def account(self, pubkey: bytes):
+        """-> (lamports, owner, executable, data) or zeros when absent."""
         from firedancer_tpu.flamenco.executor import acct_decode
 
-        return acct_decode(self.funk.rec_query(None, pubkey))[0]
+        if self.funk is None:
+            return 0, bytes(32), False, b""
+        lam, owner, ex, data = acct_decode(self.funk.rec_query(None, pubkey))
+        return lam, owner, ex, data
+
+    def latest_blockhash(self):
+        """-> (blockhash, registered_slot) of the freshest known hash."""
+        sc = self.status_cache
+        if sc is None or not sc.blockhash_slot:
+            return bytes(32), 0
+        bh, slot = max(sc.blockhash_slot.items(), key=lambda kv: kv[1])
+        return bh, slot
+
+    def signature_status(self, sig: bytes):
+        """-> landed slot or None (any recorded blockhash)."""
+        sc = self.status_cache
+        if sc is None:
+            return None
+        return max(sc.by_sig.get(sig, ()), default=None)
+
+    def first_available_block(self):
+        bs = self.blockstore
+        if bs is None:
+            return 0
+        slots = bs.slots()
+        return slots[0] if slots else 0
+
+    def submit(self, txn: bytes) -> bool:
+        if self.submit_fn is None:
+            return False
+        return bool(self.submit_fn(txn))
 
 
 class RpcServer:
@@ -118,23 +159,141 @@ class RpcServer:
                 "error": {"code": code, "message": msg},
             }
 
+        def ctx(value):
+            return ok({"context": {"slot": self.view.slot()},
+                       "value": value})
+
+        from firedancer_tpu.protocol.base58 import (
+            b58_decode,
+            b58_decode32,
+            b58_encode,
+            b58_encode32,
+        )
+
         try:
             if method == "getTransactionCount":
                 return ok(self.view.transaction_count())
-            if method == "getSlot":
+            if method in ("getSlot", "getBlockHeight"):
+                # block height == slot here (no skipped-slot ledger gap
+                # model); served separately for client compatibility
                 return ok(self.view.slot())
             if method == "getHealth":
                 return ok("ok")
-            if method == "getBalance":
-                from firedancer_tpu.protocol.base58 import b58_decode32
+            if method == "getVersion":
+                from firedancer_tpu import __version__ as v
 
+                return ok({"solana-core": v, "firedancer-tpu": v})
+            if method == "getGenesisHash":
+                fn = getattr(self.view, "genesis_hash_fn", None)
+                return ok(b58_encode32(fn() if fn else bytes(32)))
+            if method == "getBalance":
                 if not params:
                     return err(-32602, "missing pubkey param")
-                pubkey = b58_decode32(params[0])
-                return ok(
-                    {"context": {"slot": self.view.slot()},
-                     "value": self.view.balance(pubkey)}
+                return ctx(self.view.balance(b58_decode32(params[0])))
+            if method == "getAccountInfo":
+                if not params:
+                    return err(-32602, "missing pubkey param")
+                lam, owner, ex, data = self.view.account(
+                    b58_decode32(params[0])
                 )
+                if lam == 0 and not data and owner == bytes(32):
+                    return ctx(None)
+                return ctx({
+                    "lamports": lam,
+                    "owner": b58_encode32(owner),
+                    "executable": bool(ex),
+                    "rentEpoch": 0,
+                    "data": [base64.b64encode(bytes(data)).decode(),
+                             "base64"],
+                })
+            if method == "getLatestBlockhash":
+                bh, slot = self.view.latest_blockhash()
+                from firedancer_tpu.flamenco.blockstore import (
+                    MAX_BLOCKHASH_AGE,
+                )
+
+                return ctx({
+                    "blockhash": b58_encode32(bh),
+                    "lastValidBlockHeight": slot + MAX_BLOCKHASH_AGE,
+                })
+            if method == "isBlockhashValid":
+                if not params:
+                    return err(-32602, "missing blockhash param")
+                sc = getattr(self.view, "status_cache", None)
+                valid = bool(sc) and sc.is_blockhash_valid(
+                    b58_decode32(params[0]), self.view.slot()
+                )
+                return ctx(valid)
+            if method == "getSignatureStatuses":
+                if not params or not isinstance(params[0], list):
+                    return err(-32602, "missing signatures param")
+                vals = []
+                for s in params[0]:
+                    slot = self.view.signature_status(b58_decode(s, 64))
+                    vals.append(
+                        None if slot is None else {
+                            "slot": slot,
+                            "confirmations": None,
+                            "err": None,
+                            "confirmationStatus": "processed",
+                        }
+                    )
+                return ctx(vals)
+            if method == "sendTransaction":
+                if not params:
+                    return err(-32602, "missing transaction param")
+                enc = "base58"
+                if len(params) > 1 and isinstance(params[1], dict):
+                    enc = params[1].get("encoding", "base58")
+                raw = (
+                    base64.b64decode(params[0]) if enc == "base64"
+                    else b58_decode(params[0])
+                )
+                from firedancer_tpu.protocol import txn as ft
+
+                t = ft.txn_parse(raw)
+                if t is None:
+                    return err(-32602, "malformed transaction")
+                if not self.view.submit(raw):
+                    return err(-32005, "node is not accepting transactions")
+                return ok(b58_encode(t.signatures(raw)[0]))
+            if method == "getEpochInfo":
+                from firedancer_tpu.flamenco import types as T
+
+                sched = T.EpochSchedule()
+                slot = self.view.slot()
+                return ok({
+                    "epoch": slot // sched.slots_per_epoch,
+                    "slotIndex": slot % sched.slots_per_epoch,
+                    "slotsInEpoch": sched.slots_per_epoch,
+                    "absoluteSlot": slot,
+                    "blockHeight": slot,
+                    "transactionCount": self.view.transaction_count(),
+                })
+            if method == "getFirstAvailableBlock":
+                return ok(self.view.first_available_block())
+            if method == "getMinimumBalanceForRentExemption":
+                from firedancer_tpu.flamenco import types as T
+
+                size = int(params[0]) if params else 0
+                rent = T.Rent()
+                return ok(int(
+                    (size + 128) * rent.lamports_per_byte_year
+                    * rent.exemption_threshold
+                ))
+            if method == "requestAirdrop":
+                # faucet_fn(pubkey, lamports) -> the airdrop txn's
+                # 64-byte signature (clients poll it via
+                # getSignatureStatuses) or None on refusal
+                fn = getattr(self.view, "faucet_fn", None)
+                if fn is None:
+                    return err(-32601, "faucet not enabled")
+                if len(params) < 2:
+                    return err(-32602, "need pubkey and lamports")
+                sig = fn(b58_decode32(params[0]), int(params[1]))
+                if not sig:
+                    return err(-32603, "airdrop failed")
+                return ok(b58_encode(sig))
             return err(-32601, f"method not found: {method}")
         except Exception as e:
             return err(-32603, f"internal error: {type(e).__name__}")

@@ -19,10 +19,14 @@ from .stage import Stage
 
 
 class StoreStage(Stage):
-    def __init__(self, *args, verify_sig=None, **kwargs):
+    def __init__(self, *args, verify_sig=None, blockstore=None, **kwargs):
         super().__init__(*args, **kwargs)
         self.resolver = FecResolver(verify_sig=verify_sig, max_inflight=256)
         self.sets_by_slot: dict[int, list] = {}
+        # optional persistent history (flamenco/blockstore.Blockstore):
+        # every data shred lands there, making the slot replayable after
+        # a restart (fd_store.c -> fd_blockstore insert path)
+        self.blockstore = blockstore
 
     def after_frag(self, in_idx: int, meta, payload: bytes) -> None:
         out = self.resolver.add_shred(payload)
@@ -30,6 +34,14 @@ class StoreStage(Stage):
         if out is not None:
             self.sets_by_slot.setdefault(out.slot, []).append(out)
             self.metrics.inc("sets_stored")
+            if self.blockstore is not None:
+                # persist only shreds of a RESOLVED set (FEC-complete,
+                # leader-signature-checked): raw wire shreds must never
+                # enter block history, or a forged (slot, idx) would
+                # permanently displace the genuine shred (first-writer-
+                # wins idempotency) and poison restart replay
+                for buf in out.data_shreds:
+                    self.blockstore.insert_shred(buf)
 
     def entry_batch_bytes(self, slot: int) -> bytes:
         """Reassembled data-shred payloads for `slot`, in fec_set order."""

@@ -336,6 +336,9 @@ def parse_frames(payload: bytes):
         if ft == FT_PADDING:
             continue
         if ft == FT_PING:
+            # ack-eliciting (RFC 9002): a PING-only PTO probe that never
+            # got acked would back the peer off into an idle timeout
+            yield ("ping",)
             continue
         if ft in (FT_ACK, FT_ACK | 1):
             largest, off = varint_decode(payload, off)
@@ -746,11 +749,13 @@ class Connection:
                            fin: bool) -> None:
         off = self.send_offset.get(stream_id, 0)
         slimit = self.tx_stream_limit.get(stream_id, DEFAULT_MAX_STREAM_DATA)
-        if off + len(data) > slimit or (
+        blocked_ahead = any(s == stream_id for s, _d, _f in self.blocked_out)
+        if blocked_ahead or off + len(data) > slimit or (
             self.tx_data_total + len(data) > self.tx_max_data
         ):
-            # peer window closed: hold the write until MAX_DATA /
-            # MAX_STREAM_DATA opens it (order within the queue preserved)
+            # peer window closed — or an EARLIER write on this stream is
+            # already parked: a later smaller write must never overtake
+            # it (stream bytes are ordered by offset)
             self.blocked_out.append((stream_id, data, fin))
             return
         self.app_out.append(("stream", stream_id, off, data, fin))

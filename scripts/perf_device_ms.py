@@ -1,0 +1,90 @@
+"""Device-time-per-batch of the verify kernel, NET of tunnel RTT.
+
+Method (r3 verdict ask): chain k kernel invocations inside ONE on-device
+fori_loop, fetch a scalar, and fit the slope between two trip counts —
+the tunnel RTT and dispatch overhead are identical in both runs and
+cancel.  Loop-invariant hoisting is defeated by XOR-ing the message with
+the loop parity (odd iterations verify garbage; the WORK per iteration
+is identical, which is all timing needs).
+
+Answers: device_ms_per_batch, verify/s net of tunnel, and the batch size
+whose device time closes under the 1 ms p99 SLO.
+
+Usage: python scripts/perf_device_ms.py [batch ...]
+"""
+from __future__ import annotations
+
+import functools
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def measure_device_ms(batch: int, k1: int = 4, k2: int = 12) -> dict:
+    from firedancer_tpu.ops import sigverify as sv
+    import __graft_entry__ as ge
+
+    msg, msg_len, sig, pk = ge._example_batch(batch)
+    args = tuple(jax.device_put(jnp.asarray(a)) for a in (msg, msg_len, sig, pk))
+
+    @functools.partial(jax.jit, static_argnames=("k",))
+    def chained(msg, msg_len, sig, pk, *, k):
+        def body(i, acc):
+            m = msg ^ (i & 1).astype(jnp.uint8)  # defeat hoisting
+            ok = sv.ed25519_verify_batch(
+                m, msg_len, sig, pk, max_msg_len=ge.MAX_MSG_LEN
+            )
+            return acc + jnp.sum(ok.astype(jnp.int32))
+
+        return jax.lax.fori_loop(0, k, body, jnp.int32(0))
+
+    out = {}
+    times = {}
+    for k in (k1, k2):
+        r = chained(*args, k=k)
+        int(np.asarray(r))  # compile + complete (host fetch barrier)
+        best = 1e9
+        for _ in range(3):
+            t0 = time.perf_counter()
+            int(np.asarray(chained(*args, k=k)))
+            best = min(best, time.perf_counter() - t0)
+        times[k] = best
+    per_batch_s = (times[k2] - times[k1]) / (k2 - k1)
+    out["batch"] = batch
+    out["kernel_device_ms"] = round(per_batch_s * 1e3, 3)
+    out["device_verify_per_s"] = round(batch / per_batch_s, 1)
+    out["t_k1_ms"] = round(times[k1] * 1e3, 1)
+    out["t_k2_ms"] = round(times[k2] * 1e3, 1)
+    return out
+
+
+def main():
+    from firedancer_tpu.utils.platform import enable_compile_cache
+
+    enable_compile_cache()
+    batches = [int(b) for b in sys.argv[1:]] or [1024, 4096, 16384]
+    dev = jax.devices()[0]
+    print(f"# device {dev.platform}:{dev.device_kind}", file=sys.stderr)
+    rows = []
+    for b in batches:
+        r = measure_device_ms(b)
+        rows.append(r)
+        print(json.dumps(r))
+    under_1ms = [r for r in rows if r["kernel_device_ms"] < 1.0]
+    if under_1ms:
+        best = max(under_1ms, key=lambda r: r["batch"])
+        print(f"# largest batch under 1ms device time: {best['batch']}",
+              file=sys.stderr)
+    else:
+        print("# no measured batch closes under 1ms device time",
+              file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -107,30 +107,27 @@ def _pallas_mul_body(a, b):
     return r
 
 
-def make_pallas13(batch):
+def make_pallas13(batch, k):
+    """One pallas kernel running k fe_muls chained (k static: the axon
+    lowering lacks scalar-prefetch-driven dynamic trip counts)."""
     from jax.experimental import pallas as pl
 
-    def kernel(x_ref, y_ref, n_ref, ox_ref, oy_ref):
+    def kernel(x_ref, y_ref, ox_ref, oy_ref):
         def body(i, s):
             x, y = s
             return _pallas_mul_body(x, y), x
 
-        x, y = jax.lax.fori_loop(
-            0, n_ref[0], body, (x_ref[...], y_ref[...])
-        )
+        x, y = jax.lax.fori_loop(0, k, body, (x_ref[...], y_ref[...]))
         ox_ref[...] = x
         oy_ref[...] = y
 
-    def run(x, y, n):
-        return pl.pallas_call(
-            kernel,
-            out_shape=[
-                jax.ShapeDtypeStruct((NL, batch), jnp.int32),
-                jax.ShapeDtypeStruct((NL, batch), jnp.int32),
-            ],
-        )(x, y, jnp.full((1,), n, jnp.int32))
-
-    return run
+    return pl.pallas_call(
+        kernel,
+        out_shape=[
+            jax.ShapeDtypeStruct((NL, batch), jnp.int32),
+            jax.ShapeDtypeStruct((NL, batch), jnp.int32),
+        ],
+    )
 
 
 # -- variant: Karatsuba radix-13 ---------------------------------------------
@@ -260,6 +257,82 @@ def step_lazy12(s):
     return fe_mul_lazy12(x, y), x
 
 
+# -- point-op chains: the dsm inner loop cost, per representation -------------
+#
+# The dsm is 256 sequential point_dbl + ~142 add_cached; its cost IS the
+# kernel cost.  pdbl13 uses the production curve ops (strict radix-13:
+# every add/sub carries).  pdbl12 uses radix-2^12 x 22 SIGNED-lazy limbs:
+# add = a+b, sub = a-b, NO carry pass (|limb| <= 2^13 keeps the 22-term
+# conv inside int32); only mul/sqr fold.  If pdbl12 wins, the dsm loop
+# switches representation (decompress keeps radix-13: pure sqr chains
+# don't benefit and 22 limbs cost ~21% more multiplies).
+
+
+def step_pdbl13(s):
+    from firedancer_tpu.ops import curve as fc
+
+    return (fc.point_dbl(s),)
+
+
+# 2^(12*44) mod p = (2^264)^2 mod p = (19*2^9)^2 = 361 * 2^18
+FOLD12_TOP = 361 << 18
+
+
+def _lazy12_mul(a, b):
+    rows = []
+    for k in range(2 * NL12 - 1):
+        lo = max(0, k - NL12 + 1)
+        hi = min(k, NL12 - 1)
+        t = a[lo] * b[k - lo]
+        for i in range(lo + 1, hi + 1):
+            t = t + a[i] * b[k - i]
+        rows.append(t)
+    rows.append(jnp.zeros_like(rows[0]))
+    c = jnp.stack(rows)
+    for _ in range(3):
+        hi = c >> RADIX12  # arithmetic shift: negative limbs carry right
+        c = (c & MASK12) + jnp.concatenate(
+            [jnp.zeros_like(hi[:1]), hi[:-1]], axis=0
+        )
+        # signed inputs: the top row CAN carry (negative borrows ripple
+        # to the end); its weight is 2^(12*44) == 361*2^18 (mod p)
+        c = c.at[0].add(FOLD12_TOP * hi[-1])
+    r = c[:NL12] + FOLD12 * c[NL12 : 2 * NL12]
+    # THREE passes: the last pass's fold injects <= FOLD12 into limb 0
+    # uncarried, so output bounds are limb0 <= 4095+FOLD12 (~2^13.8),
+    # limbs 1..21 <= 4096 — tight enough that every point-formula
+    # product chain stays inside int32
+    for _ in range(3):
+        hi = r >> RADIX12
+        r = (r & MASK12) + jnp.concatenate(
+            [(FOLD12 * hi[-1])[None], hi[:-1]], axis=0
+        )
+    return r
+
+
+def _lazy12_sqr(a):
+    return _lazy12_mul(a, a)
+
+
+def step_pdbl12(s):
+    # dbl-2008-hwcd a=-1 with LAZY adds/subs (no carries at all)
+    (x1, y1, z1, _t1), = (s,)
+    a = _lazy12_sqr(x1)
+    b = _lazy12_sqr(y1)
+    z2 = _lazy12_sqr(z1)
+    c = z2 + z2
+    e = _lazy12_sqr(x1 + y1) - a - b
+    g = b - a
+    f = g - c
+    h = -(a + b)
+    return ((_lazy12_mul(e, f), _lazy12_mul(g, h),
+             _lazy12_mul(f, g), _lazy12_mul(e, h)),)
+
+
+def bench_pdbl(name, step, point, k1, k2, elems):
+    return bench_step(name, lambda s: step(s[0]), (point,), k1, k2, elems)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch", type=int, default=16384)
@@ -267,7 +340,7 @@ def main():
     ap.add_argument("--k2", type=int, default=128)
     ap.add_argument(
         "--only", type=str, default="",
-        help="comma list: jnp13,pallas13,kara13,f32r8,lazy12",
+        help="comma list: jnp13,pallas13,kara13,f32r8,lazy12,pdbl13,pdbl12",
     )
     args = ap.parse_args()
     B = args.batch
@@ -283,6 +356,39 @@ def main():
     y8 = jnp.asarray(rng.integers(0, 256, (NL8, B)), jnp.float32)
 
     results = {}
+    if only is None or "pdbl13" in only or "pdbl12" in only:
+        # an honest curve point, tiled over the batch
+        from firedancer_tpu.ops import curve as fc
+        from firedancer_tpu.ops import limbs as fl2
+        from firedancer_tpu.ops.ref import ed25519_ref as eref
+
+        X, Y, Z, T = eref.point_mul(12345, eref.BASE)
+        zi = pow(Z, fl2.P - 2, fl2.P)
+        xa, ya = X * zi % fl2.P, Y * zi % fl2.P
+
+        def tile13(v):
+            return jnp.tile(
+                jnp.asarray(fl2.int_to_limbs(v)).reshape(fl2.NLIMB, 1), (1, B)
+            )
+
+        def tile12(v):
+            out = np.zeros((NL12,), np.int32)
+            x = v % fl2.P
+            for i in range(NL12):
+                out[i] = x & MASK12
+                x >>= RADIX12
+            return jnp.tile(jnp.asarray(out).reshape(NL12, 1), (1, B))
+
+        p13 = (tile13(xa), tile13(ya), tile13(1), tile13(xa * ya % fl2.P))
+        p12 = (tile12(xa), tile12(ya), tile12(1), tile12(xa * ya % fl2.P))
+        if only is None or "pdbl13" in only:
+            results["pdbl13"] = bench_pdbl(
+                "pdbl13", step_pdbl13, p13, args.k1, args.k2, B
+            )
+        if only is None or "pdbl12" in only:
+            results["pdbl12"] = bench_pdbl(
+                "pdbl12", step_pdbl12, p12, args.k1, args.k2, B
+            )
     if only is None or "jnp13" in only:
         results["jnp13"] = bench_step(
             "jnp13", step_jnp13, (x13, y13), args.k1, args.k2, B
@@ -301,38 +407,27 @@ def main():
         )
     if only is None or "pallas13" in only:
         try:
-            prun = make_pallas13(B)
-
-            def bench_pallas():
-                # pallas takes n as an operand; same slope method
-                x, y = x13, y13
-
-                @jax.jit
-                def run(x, y, n):
-                    ox, oy = prun(x, y, n)
-                    return jnp.sum(ox[0].astype(jnp.float32))
-
-                float(run(x, y, jnp.int32(2)))
-                t = {}
-                for k in (args.k1, args.k2):
-                    best = 1e9
-                    for _ in range(3):
-                        t0 = time.perf_counter()
-                        float(run(x, y, jnp.int32(k)))
-                        best = min(best, time.perf_counter() - t0)
-                    t[k] = best
-                per_iter = (t[args.k2] - t[args.k1]) / (args.k2 - args.k1)
-                per_elem = per_iter / B
-                print(
-                    f"{'pallas13':10s}  {per_iter*1e3:8.3f} ms/iter  "
-                    f"{per_elem*1e9:8.1f} ns/elem  "
-                    f"({1.0/per_elem/1e6:6.2f} M fe_mul/s)"
-                    f"   [t{args.k1}={t[args.k1]*1e3:.0f}ms "
-                    f"t{args.k2}={t[args.k2]*1e3:.0f}ms]"
-                )
-                return per_elem
-
-            results["pallas13"] = bench_pallas()
+            t = {}
+            for k in (args.k1, args.k2):
+                prun = jax.jit(make_pallas13(B, k))
+                r = prun(x13, y13)
+                np.asarray(r[0][0, :1])  # compile + completion barrier
+                best = 1e9
+                for _ in range(3):
+                    t0 = time.perf_counter()
+                    np.asarray(prun(x13, y13)[0][0, :1])
+                    best = min(best, time.perf_counter() - t0)
+                t[k] = best
+            per_iter = (t[args.k2] - t[args.k1]) / (args.k2 - args.k1)
+            per_elem = per_iter / B
+            print(
+                f"{'pallas13':10s}  {per_iter*1e3:8.3f} ms/iter  "
+                f"{per_elem*1e9:8.1f} ns/elem  "
+                f"({1.0/per_elem/1e6:6.2f} M fe_mul/s)"
+                f"   [t{args.k1}={t[args.k1]*1e3:.0f}ms "
+                f"t{args.k2}={t[args.k2]*1e3:.0f}ms]"
+            )
+            results["pallas13"] = per_elem
         except Exception as e:  # pallas viability is exactly what we probe
             print("pallas13 FAILED:", repr(e))
 

@@ -123,6 +123,50 @@ def test_pto_retransmits_without_acks():
     assert client.flush(0.7)
 
 
+def test_ping_only_packet_gets_acked():
+    """PING is ack-eliciting: a PTO probe must draw an ACK or the peer
+    backs off into an idle timeout (review finding r4)."""
+    p = LossyPair(0)
+    p.run_until(lambda: p.client.established and p.server.established)
+    p.tick(); p.tick()  # drain pending acks both ways
+    keys = p.client.keys_tx[quic.APPLICATION]
+    pn = p.client.pn_next[quic.APPLICATION]
+    p.client.pn_next[quic.APPLICATION] += 1
+    pkt = quic.seal_packet(
+        keys, level=quic.APPLICATION, dcid=p.server.local_cid,
+        scid=p.client.local_cid, pn=pn,
+        payload=bytes([quic.FT_PING]) + bytes(3),
+    )
+    p.server.receive(pkt, now=p.now)
+    assert quic.APPLICATION in p.server.ack_pending
+    assert p.server.flush(p.now)  # the ACK goes out
+
+
+def test_blocked_stream_writes_keep_order():
+    """A later small write must not overtake an earlier blocked write on
+    the same stream (review finding r4)."""
+    p = LossyPair(0)
+    p.run_until(lambda: p.client.established and p.server.established)
+    c = p.client
+    c.tx_stream_limit[2] = 100
+    c.send_stream(2, bytes(range(80)), fin=False)   # fits (offset 0..80)
+    c.send_stream(2, bytes(range(80, 160)), fin=False)  # blocked (>100)
+    c.send_stream(2, bytes(range(160, 170)), fin=True)  # would fit alone
+    assert len(c.blocked_out) == 2  # the small write queued BEHIND
+    # open the window: everything flows in offset order
+    c.tx_stream_limit[2] = 10_000
+    c._drain_blocked()
+    offs = [item[2] for item in c.app_out if item[1] == 2]
+    assert offs == sorted(offs)
+    for dg in c.flush(p.now):
+        evs = p.server.receive(dg, now=p.now)
+        p.events.extend(p.server.receive_stream_events(evs))
+    data = bytearray()
+    for _sid, chunk, _fin in p.events:
+        data.extend(chunk)
+    assert bytes(data) == bytes(range(170))
+
+
 def test_rx_flow_control_enforced():
     """A peer pushing past our advertised stream window is a conn error."""
     p = LossyPair(0)

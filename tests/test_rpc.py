@@ -73,3 +73,96 @@ def test_bencho_style_rate_poll(server):
     srv.view.pipeline.banks[0].metrics.inc("txn_exec", 30)
     c2 = rpc_call(srv.addr, "getTransactionCount")["result"]
     assert c2 - c1 == 30
+
+
+@pytest.fixture
+def wallet_server():
+    """A server wired with the wallet-facing state: status cache,
+    blockstore, faucet, submit sink."""
+    import base64
+
+    from firedancer_tpu.flamenco.blockstore import Blockstore, StatusCache
+    from firedancer_tpu.flamenco import bpf_loader as bl
+
+    funk = Funk()
+    pub = hashlib.sha256(b"rpc-w-acct").digest()
+    funk.rec_insert(
+        None, pub,
+        acct_build(777, data=b"hello-data", owner=bl.UPGRADEABLE_LOADER_PROGRAM),
+    )
+    sc = StatusCache()
+    bh = hashlib.sha256(b"rpc-bh").digest()
+    sc.register_blockhash(bh, 40)
+    sig = b"G" * 64
+    sc.insert(bh, sig, 41)
+    submitted = []
+    view = PipelineView(
+        pipeline=_FakePipe(), funk=funk, status_cache=sc,
+        submit_fn=lambda t: submitted.append(t) or True,
+        genesis_hash_fn=lambda: hashlib.sha256(b"gen").digest(),
+    )
+    srv = RpcServer(view)
+    yield srv, pub, bh, sig, submitted
+    srv.close()
+
+
+def test_wallet_methods(wallet_server):
+    import base64
+
+    from firedancer_tpu.flamenco import bpf_loader as bl
+    from firedancer_tpu.flamenco.blockstore import MAX_BLOCKHASH_AGE
+    from firedancer_tpu.protocol.base58 import b58_encode32
+
+    srv, pub, bh, sig, _ = wallet_server
+    # getAccountInfo: full account shape, base64 data
+    r = rpc_call(srv.addr, "getAccountInfo", [b58_encode(pub)])["result"]
+    assert r["value"]["lamports"] == 777
+    assert base64.b64decode(r["value"]["data"][0]) == b"hello-data"
+    assert r["value"]["owner"] == b58_encode32(bl.UPGRADEABLE_LOADER_PROGRAM)
+    # absent account -> null value
+    none = rpc_call(srv.addr, "getAccountInfo",
+                    [b58_encode(hashlib.sha256(b"absent").digest())])
+    assert none["result"]["value"] is None
+    # getLatestBlockhash + validity
+    r = rpc_call(srv.addr, "getLatestBlockhash")["result"]["value"]
+    assert r["blockhash"] == b58_encode32(bh)
+    assert r["lastValidBlockHeight"] == 40 + MAX_BLOCKHASH_AGE
+    assert rpc_call(srv.addr, "isBlockhashValid",
+                    [b58_encode32(bh)])["result"]["value"] is True
+    # getSignatureStatuses: one hit, one miss
+    r = rpc_call(
+        srv.addr, "getSignatureStatuses",
+        [[b58_encode(sig), b58_encode(b"Z" * 64)]],
+    )["result"]["value"]
+    assert r[0]["slot"] == 41 and r[1] is None
+    # getVersion / getGenesisHash / getEpochInfo / misc
+    assert "firedancer-tpu" in rpc_call(srv.addr, "getVersion")["result"]
+    assert rpc_call(srv.addr, "getGenesisHash")["result"] == b58_encode32(
+        hashlib.sha256(b"gen").digest()
+    )
+    info = rpc_call(srv.addr, "getEpochInfo")["result"]
+    assert info["absoluteSlot"] == 42 and info["transactionCount"] == 120
+    assert rpc_call(srv.addr, "getBlockHeight")["result"] == 42
+    assert rpc_call(srv.addr,
+                    "getMinimumBalanceForRentExemption", [100])["result"] > 0
+
+
+def test_send_transaction(wallet_server):
+    import base64
+
+    from firedancer_tpu.ops.ref import ed25519_ref as ref
+    from firedancer_tpu.protocol import txn as ft
+
+    srv, pub, bh, _, submitted = wallet_server
+    secret = hashlib.sha256(b"rpc-sender").digest()
+    txn = ft.transfer_txn(secret, pub, 5, bh)
+    r = rpc_call(srv.addr, "sendTransaction",
+                 [base64.b64encode(txn).decode(), {"encoding": "base64"}])
+    t = ft.txn_parse(txn)
+    assert r["result"] == b58_encode(t.signatures(txn)[0])
+    assert submitted == [txn]
+    # garbage payloads are the client's error
+    bad = rpc_call(srv.addr, "sendTransaction",
+                   [base64.b64encode(b"junk").decode(),
+                    {"encoding": "base64"}])
+    assert bad["error"]["code"] == -32602
