@@ -49,7 +49,7 @@ INFLIGHT = int(os.environ.get("FDTPU_BENCH_INFLIGHT", "4"))
 PROBE_TIMEOUT_S = 120
 PROBE_RETRIES = 3
 PROBE_WAIT_S = 15
-ACCEL_TIMEOUT_S = int(os.environ.get("FDTPU_BENCH_ACCEL_TIMEOUT", "900"))
+ACCEL_TIMEOUT_S = int(os.environ.get("FDTPU_BENCH_ACCEL_TIMEOUT", "1800"))
 ACCEL_RETRIES = 2
 CPU_TIMEOUT_S = int(os.environ.get("FDTPU_BENCH_CPU_TIMEOUT", "2400"))
 
@@ -209,6 +209,22 @@ def run_bench(backend: str, *, rounds: int = STEADY_ROUNDS,
         f"p50={p50:.2f}ms p99={p99:.2f}ms (batch={batch})",
         file=sys.stderr,
     )
+    # Tunnel RTT: median round trip of a canary-sized fetch.  The serialized
+    # batch latency above includes this per fetch (the dev tunnel adds
+    # ~50-250 ms that a production local accelerator does not); p99 net of
+    # RTT is the hardware-meaningful latency figure the r3 verdict asked
+    # for.  The precise slope-method instrument (kernel chained on-device,
+    # RTT cancels exactly) is scripts/perf_device_ms.py — this in-artifact
+    # estimate costs zero extra compiles.
+    rtts = []
+    tiny = jnp.zeros((8,), jnp.int32)
+    for _ in range(5):
+        t1 = time.time()
+        int(np.asarray(jnp.sum(tiny + 1)))
+        rtts.append(time.time() - t1)
+    rtt_ms = sorted(rtts)[len(rtts) // 2] * 1e3
+    print(f"# tunnel rtt ~{rtt_ms:.1f}ms -> p99 net of tunnel "
+          f"{max(float(p99) - rtt_ms, 0.0):.2f}ms", file=sys.stderr)
     out = {
         "metric": "ed25519_sigverify_per_s_per_chip",
         "value": round(rate, 1),
@@ -218,7 +234,25 @@ def run_bench(backend: str, *, rounds: int = STEADY_ROUNDS,
         "kernel": kernel,
         "batch": batch,
         "batch_latency_p99_ms": round(float(p99), 3),
+        "tunnel_rtt_ms": round(rtt_ms, 1),
+        "batch_p99_net_of_tunnel_ms": round(max(float(p99) - rtt_ms, 0.0), 2),
     }
+    # Repeated-signer fast path (vote-shaped traffic): pre-fill the comb
+    # bank for the batch's unique signers, then steady-state the cached
+    # kernel.  Real ingress is mostly votes from a bounded signer set, so
+    # this is the stead-state rate a validator actually sees; the generic
+    # number above is the cold/unique-signer floor.  Guarded: a comb
+    # failure must not cost the main number.
+    if kernel == "fused":
+        try:
+            out.update(run_comb_bench(args, batch, rounds, fetch))
+        except Exception as e:
+            print(
+                f"# comb bench failed (main number unaffected): "
+                f"{type(e).__name__}: {str(e)[:300]}",
+                file=sys.stderr,
+            )
+            out["comb_error"] = f"{type(e).__name__}"
     # Secondary headline: whole-pipeline txn/s (the bencho analog; the
     # reference's pure-leader figure is 270K txn/s, book/guide/tuning.md:
     # 238-254).  Guarded: a pipeline failure must not cost the kernel number.
@@ -231,10 +265,132 @@ def run_bench(backend: str, *, rounds: int = STEADY_ROUNDS,
             file=sys.stderr,
         )
         out["pipeline_error"] = f"{type(e).__name__}"
+    try:
+        out.update(run_host_pipeline_bench())
+    except Exception as e:
+        print(
+            f"# host pipeline bench failed (kernel number unaffected): "
+            f"{type(e).__name__}: {str(e)[:300]}",
+            file=sys.stderr,
+        )
+        out["host_pipeline_error"] = f"{type(e).__name__}"
     print(json.dumps(out))
 
 
 PIPELINE_BASELINE_TXN_PER_S = 270_000.0  # reference pure-leader bench
+
+
+def run_comb_bench(args, batch: int, rounds: int, fetch) -> dict:
+    """Steady-state the cached (comb-bank) kernel on the same batch."""
+    import jax.numpy as jnp
+
+    from firedancer_tpu.ops import sigverify as sv
+    import __graft_entry__ as ge
+
+    msg, msg_len, sig, pk = args
+    uniq = np.unique(np.asarray(pk), axis=1)
+    n_signers = uniq.shape[1]
+    fill = np.zeros((32, n_signers), dtype=np.uint8)
+    fill[:, :] = uniq
+    t0 = time.time()
+    tables, ok = sv.comb_fill(jnp.asarray(fill))
+    assert int(np.asarray(jnp.sum(ok.astype(jnp.int32)))) == n_signers
+    bank = sv.bank_alloc(n_signers)
+    bank = sv.bank_install(
+        bank, tables, jnp.asarray(np.arange(n_signers, dtype=np.int32))
+    )
+    # slot per element = index of its pubkey among the unique signers
+    pk_np = np.asarray(pk)
+    keys = {uniq[:, i].tobytes(): i for i in range(n_signers)}
+    slots = np.asarray(
+        [keys[pk_np[:, i].tobytes()] for i in range(batch)], dtype=np.int32
+    )
+    slots = jnp.asarray(slots)
+
+    def step():
+        return jnp.sum(
+            sv.ed25519_verify_batch_cached(
+                msg, msg_len, sig, pk, bank, slots,
+                max_msg_len=ge.MAX_MSG_LEN,
+            ).astype(jnp.int32)
+        )
+
+    n_ok = fetch(step())  # compile + first batch
+    print(
+        f"# comb: bank fill + compile + first batch {time.time()-t0:.1f}s, "
+        f"{n_ok}/{batch} ok ({n_signers} signers)",
+        file=sys.stderr,
+    )
+    assert n_ok == batch, "cached kernel must verify all honest signatures"
+    outs = []
+    t0 = time.time()
+    for r in range(rounds):
+        outs.append(step())
+        if len(outs) >= INFLIGHT:
+            fetch(outs.pop(0))
+    for o in outs:
+        fetch(o)
+    elapsed = time.time() - t0
+    rate = batch * rounds / elapsed
+    print(
+        f"# comb steady: {batch * rounds} sigs in {elapsed:.3f}s "
+        f"({rate:.0f}/s cached)",
+        file=sys.stderr,
+    )
+    return {
+        "comb_verify_per_s": round(rate, 1),
+        "comb_vs_baseline": round(rate / BASELINE_VERIFY_PER_S, 4),
+        "comb_signers": n_signers,
+    }
+
+
+def run_host_pipeline_bench() -> dict:
+    """Pipeline machinery throughput NET of accelerator round trips: the
+    verify stage runs with a precomputed all-pass mask (no device
+    dispatch), so rings/parse/dedup/pack/bank/poh/shred are what's timed.
+    This is the tunnel-independent number the r3 verdict asked for; the
+    target to beat is the reference's stock single-host bench, 63K txn/s
+    (book/guide/tuning.md:131)."""
+    from firedancer_tpu.models.leader import build_leader_pipeline
+
+    n_txn = 4096
+    t0 = time.time()
+    pipe = build_leader_pipeline(
+        n_verify=1,
+        n_bank=2,
+        pool_size=n_txn,
+        gen_limit=n_txn,
+        batch=256,
+        max_msg_len=256,
+        batch_deadline_s=0.005,
+        verify_precomputed=True,
+    )
+    print(f"# host pipeline: pool of {n_txn} signed in {time.time()-t0:.1f}s",
+          file=sys.stderr)
+    try:
+        t0 = time.time()
+        pipe.run(until_txns=n_txn, max_iters=2_000_000)
+        elapsed = time.time() - t0
+        executed = sum(b.metrics.get("txn_exec") for b in pipe.banks)
+        lats = sorted(
+            lat for b in pipe.banks for lat in b.commit_latencies_ns
+        )
+        p99_ms = (
+            lats[min(int(len(lats) * 0.99), len(lats) - 1)] / 1e6
+            if lats else -1.0
+        )
+        rate = executed / elapsed if elapsed > 0 else 0.0
+        print(
+            f"# host pipeline: {executed} txns in {elapsed:.2f}s "
+            f"({rate:.0f} txn/s, no device), commit p99 {p99_ms:.1f}ms",
+            file=sys.stderr,
+        )
+        return {
+            "pipeline_host_txn_per_s": round(rate, 1),
+            "pipeline_host_commit_p99_ms": round(p99_ms, 2),
+        }
+    finally:
+        pipe.close()
 
 
 def run_pipeline_bench(platform: str) -> dict:

@@ -46,9 +46,10 @@ class SlotMeta:
 
     @property
     def complete(self) -> bool:
-        return (
-            self.last_index is not None
-            and len(self.received) == self.last_index + 1
+        # contents check, not cardinality: a stray index above last_index
+        # (adversarial or repair-path shred) must not fake completeness
+        return self.last_index is not None and all(
+            i in self.received for i in range(self.last_index + 1)
         )
 
     def missing(self, upto: int | None = None) -> list[int]:
@@ -199,9 +200,37 @@ class StatusCache:
         # signature-keyed index for the RPC's getSignatureStatuses (a hot
         # polling endpoint must not scan the whole cache per query)
         self.by_sig: dict[bytes, list[int]] = {}
+        # speculative execution stages per-block inserts here until the
+        # fork is chosen: commit_block merges, drop_block discards — an
+        # abandoned competing block must never gate a sibling at the same
+        # slot (fd_txncache's per-fork slices serve the same isolation)
+        self._staged: dict[bytes, tuple[int, list, list[bytes]]] = {}
 
     def register_blockhash(self, blockhash: bytes, slot: int) -> None:
         self.blockhash_slot.setdefault(blockhash, slot)
+
+    # -- speculative block staging --
+
+    def begin_block(self, xid: bytes, slot: int) -> None:
+        self._staged[xid] = (slot, [], [])
+
+    def stage_insert(self, xid: bytes, blockhash: bytes, sig: bytes) -> None:
+        self._staged[xid][1].append((blockhash, sig))
+
+    def stage_blockhash(self, xid: bytes, blockhash: bytes) -> None:
+        self._staged[xid][2].append(blockhash)
+
+    def commit_block(self, xid: bytes) -> None:
+        """The fork containing this block was chosen: merge its entries."""
+        slot, inserts, hashes = self._staged.pop(xid)
+        for bh, sig in inserts:
+            self.insert(bh, sig, slot)
+        for bh in hashes:
+            self.register_blockhash(bh, slot)
+
+    def drop_block(self, xid: bytes) -> None:
+        """The block's fork was abandoned: discard its staged entries."""
+        self._staged.pop(xid, None)
 
     def is_blockhash_valid(self, blockhash: bytes, current_slot: int) -> bool:
         s = self.blockhash_slot.get(blockhash)

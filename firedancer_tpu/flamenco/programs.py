@@ -75,6 +75,10 @@ def system_program(executor, ctx, program_id, iaccts, data, *, pda_signers):
             # owner-may-debit: the system program only moves lamports out
             # of its own accounts
             raise AcctError("transfer source not system-owned")
+        if len(src.data) != 0:
+            # Agave: `from` must carry no data (conformance fixture
+            # transfer_from_data_acct; fd_system_program's transfer_verify)
+            raise AcctError("transfer source carries data")
         if src.lamports < lamports:
             raise FundsError(
                 f"transfer {lamports} from balance {src.lamports}"

@@ -26,9 +26,12 @@ errors never abort the block.
 from __future__ import annotations
 
 import hashlib
+import itertools
 from dataclasses import dataclass, field
 
 import numpy as np
+
+_xid_seq = itertools.count()
 
 from firedancer_tpu.flamenco import executor as fexec
 from firedancer_tpu.flamenco.executor import (
@@ -308,7 +311,9 @@ def execute_block(
         if t is None:
             raise ValueError("malformed txn in block")
         parsed.append((p, t))
-    xid = b"slot:%d:%s" % (slot, (parent_xid or b"root"))
+    # xid carries a nonce: competing blocks for the SAME slot off the same
+    # parent are distinct forks (consensus decides which publishes)
+    xid = b"slot:%d:%d:%s" % (slot, next(_xid_seq), (parent_xid or b"root"))
     funk.txn_prepare(parent_xid, xid)
 
     # resolve v0 address-table lookups against the START-of-slot state
@@ -344,9 +349,12 @@ def execute_block(
 
     sysvars = default_sysvars(slot)
     results: list[TxnResult] = [None] * len(parsed)
-    # a slot is not in its own ancestor set, but ITS insertions must gate
-    # its own later txns (intra-block duplicates) — widen the filter
-    anc = None if ancestors is None else set(ancestors) | {slot}
+    # intra-block duplicates are tracked locally, NOT via the cache with a
+    # widened ancestor set: cache insertions from a speculative competing
+    # block at this same slot must never gate this block's txns
+    if status_cache is not None:
+        status_cache.begin_block(xid, slot)
+    block_seen: set[tuple[bytes, bytes]] = set()
     for wave in waves:
         # wave txns are conflict-free: host executes in index order, a
         # tpool/device executes them concurrently — same result either way
@@ -358,15 +366,19 @@ def execute_block(
                 if not status_cache.is_blockhash_valid(bh, slot):
                     results[i] = TxnResult(TXN_ERR_BLOCKHASH, 0)
                     continue
-                if status_cache.contains(bh, sig, anc):
+                if (bh, sig) in block_seen or status_cache.contains(
+                    bh, sig, ancestors
+                ):
                     results[i] = TxnResult(TXN_ERR_ALREADY_PROCESSED, 0)
                     continue
             results[i] = _execute_txn(funk, xid, p, t, sysvars=sysvars,
                                       extra=extras[i])
             if status_cache is not None and results[i].fee > 0:
                 # any fee-charged txn occupies its signature (failed txns
-                # landed on chain too — fd_txncache records both)
-                status_cache.insert(bh, sig, slot)
+                # landed on chain too — fd_txncache records both); staged
+                # until the fork is chosen
+                block_seen.add((bh, sig))
+                status_cache.stage_insert(xid, bh, sig)
 
     # accounts-delta lattice hash: one device reduction over +new / -old
     vals = []
@@ -395,7 +407,11 @@ def execute_block(
         + poh_hash
     ).digest()
     if status_cache is not None:
-        status_cache.register_blockhash(poh_hash, slot)
+        status_cache.stage_blockhash(xid, poh_hash)
+        if publish:
+            status_cache.commit_block(xid)
+        # else: the caller owns the fork decision — commit_block(xid) when
+        # the fork is chosen, drop_block(xid) when it is abandoned
     if publish:
         funk.txn_publish(xid)
     return BlockResult(

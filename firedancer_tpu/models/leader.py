@@ -132,6 +132,8 @@ def build_leader_pipeline(
     batch_deadline_s: float = 0.002,
     slot: int = 1,
     leader_seed: bytes = b"leader",
+    verify_precomputed: bool = False,
+    verify_comb_slots: int = 0,
 ) -> LeaderPipeline:
     uid = f"{os.getpid()}_{int(time.monotonic_ns() % 1_000_000)}"
     links = []
@@ -169,6 +171,8 @@ def build_leader_pipeline(
             batch=batch,
             max_msg_len=max_msg_len,
             batch_deadline_s=batch_deadline_s,
+            precomputed_ok=verify_precomputed,
+            comb_slots=verify_comb_slots,
         )
         for i in range(n_verify)
     ]

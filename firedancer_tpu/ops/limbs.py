@@ -80,6 +80,17 @@ def fe_one(batch_shape) -> jnp.ndarray:
     return fe_zero(batch_shape).at[0].set(1)
 
 
+def _shift_rows(hi: jnp.ndarray, head: jnp.ndarray) -> jnp.ndarray:
+    """[head, hi[0], .., hi[-2]] along axis 0 — the carry-propagation shift.
+
+    Written as a concatenate (pure data movement XLA folds into the
+    surrounding elementwise DAG) rather than `.at[1:].add`: scatter-add
+    lowers to a real scatter op on TPU and measured ~7x slower than an
+    entire fe_mul (scripts/perf_probe.py, round 4).
+    """
+    return jnp.concatenate([head[None], hi[:-1]], axis=0)
+
+
 def _carry2(x: jnp.ndarray) -> jnp.ndarray:
     """Two parallel carry passes restoring the loose invariant.
 
@@ -88,9 +99,7 @@ def _carry2(x: jnp.ndarray) -> jnp.ndarray:
     """
     for _ in range(2):
         hi = x >> RADIX
-        x = x & MASK
-        x = x.at[1:].add(hi[:-1])
-        x = x.at[0].add(FOLD * hi[-1])
+        x = (x & MASK) + _shift_rows(hi, FOLD * hi[-1])
     return x
 
 
@@ -120,9 +129,9 @@ def _conv_fold(c: jnp.ndarray) -> jnp.ndarray:
     """
     for _ in range(3):
         hi = c >> RADIX
-        c = (c & MASK).at[1:].add(hi[:-1])
+        c = (c & MASK) + _shift_rows(hi, jnp.zeros_like(hi[-1]))
     r = c[:NLIMB] + FOLD * c[NLIMB : 2 * NLIMB]
-    r = r.at[0].add(369664 * c[2 * NLIMB])
+    r = jnp.concatenate([(r[0] + 369664 * c[2 * NLIMB])[None], r[1:]], axis=0)
     return _carry2(r)
 
 
@@ -211,14 +220,18 @@ def fe_freeze(x: jnp.ndarray) -> jnp.ndarray:
     x = _carry2(x)
     # Two rounds of top-bit split (limb 19 holds bits 247..259; bits >= 255
     # fold back as *19) with sequential carries brings the value below 2^255.
+    # Row-list form, not `.at[k].set/add` — scatters lower poorly on TPU
+    # (see _shift_rows).
+    rows = [x[k] for k in range(NLIMB)]
     for _ in range(2):
-        hi = x[NLIMB - 1] >> 8
-        x = x.at[NLIMB - 1].set(x[NLIMB - 1] & 0xFF)
-        x = x.at[0].add(19 * hi)
+        hi = rows[NLIMB - 1] >> 8
+        rows[NLIMB - 1] = rows[NLIMB - 1] & 0xFF
+        rows[0] = rows[0] + 19 * hi
         for k in range(NLIMB - 1):
-            hi = x[k] >> RADIX
-            x = x.at[k].set(x[k] & MASK)
-            x = x.at[k + 1].add(hi)
+            hi = rows[k] >> RADIX
+            rows[k] = rows[k] & MASK
+            rows[k + 1] = rows[k + 1] + hi
+    x = jnp.stack(rows)
     # Now x < 2^255 < 2p: one conditional subtract of p.
     p_l = jnp.asarray(_P_LIMBS).reshape((NLIMB,) + (1,) * (x.ndim - 1))
     t = x - p_l
@@ -263,7 +276,7 @@ def fe_frombytes(b: jnp.ndarray, mask_msb: bool = True) -> jnp.ndarray:
     """
     b = b.astype(jnp.int32)
     if mask_msb:
-        b = b.at[31].set(b[31] & 0x7F)
+        b = jnp.concatenate([b[:31], (b[31] & 0x7F)[None]], axis=0)
     rows = []
     for i in range(NLIMB):
         bit_lo = RADIX * i

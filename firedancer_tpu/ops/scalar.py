@@ -69,13 +69,17 @@ def sc_validate(b: jnp.ndarray) -> jnp.ndarray:
 
 def _carry_seq(x: jnp.ndarray) -> jnp.ndarray:
     """Sequential signed carry chain: exact for mixed-sign limbs (borrows
-    propagate fully, unlike parallel passes).  Top limb keeps any sign."""
-    n = x.shape[0]
-    for k in range(n - 1):
-        hi = x[k] >> RADIX  # arithmetic shift: floor division
-        x = x.at[k].set(x[k] & MASK)
-        x = x.at[k + 1].add(hi)
-    return x
+    propagate fully, unlike parallel passes).  Top limb keeps any sign.
+
+    Built as a python row list -> stack (pure slices/concat) rather than
+    `.at[k].set/add`: scatter ops lower poorly on TPU (see
+    ops/limbs.py:_shift_rows)."""
+    rows = [x[k] for k in range(x.shape[0])]
+    for k in range(len(rows) - 1):
+        hi = rows[k] >> RADIX  # arithmetic shift: floor division
+        rows[k] = rows[k] & MASK
+        rows[k + 1] = rows[k + 1] + hi
+    return jnp.stack(rows)
 
 
 def sc_reduce512(b: jnp.ndarray) -> jnp.ndarray:

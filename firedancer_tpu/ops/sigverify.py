@@ -78,6 +78,83 @@ def ed25519_verify_batch(
     return ok_s & ok_a & ok_r & fc.point_eq_z1(r_cmp, r_pt)
 
 
+# -- repeated-signer fast path ------------------------------------------------
+#
+# Vote-shaped traffic repeats a small signer set; with a per-pubkey comb
+# bank resident in HBM (ops/curve.py: comb cache) a cached signer's verify
+# skips A's decompress/small-order work AND all 256 dsm doublings: 128
+# cached adds + R decompress + SHA-512.  The stage partitions each batch
+# into cached/uncached elements and dispatches the matching kernel.
+
+
+@functools.partial(jax.jit, static_argnames=("max_msg_len",))
+def ed25519_verify_batch_cached(
+    msg: jnp.ndarray,
+    msg_len: jnp.ndarray,
+    sig: jnp.ndarray,
+    pubkey: jnp.ndarray,
+    bank: jnp.ndarray,
+    slots: jnp.ndarray,
+    *,
+    max_msg_len: int,
+) -> jnp.ndarray:
+    """Verify B triples whose signer combs live in `bank` at `slots`.
+
+    The pubkey byte rows are still required (k = SHA512(R||A||msg)); A's
+    point validity/small-order checks happened at bank-fill time
+    (comb_fill), so invalid pubkeys never enter the bank.
+    """
+    msg = msg.astype(jnp.int32)
+    sig = sig.astype(jnp.int32)
+    pubkey = pubkey.astype(jnp.int32)
+    r_enc = sig[:32]
+    s_enc = sig[32:]
+
+    ok_s = fs.sc_validate(s_enc)
+    r_pt, ok_r = fc.point_decompress(r_enc)
+    ok_r = ok_r & ~fc.is_small_order(r_pt)
+
+    hmsg = jnp.concatenate([r_enc, pubkey, msg], axis=0)
+    digest = fsha.sha512_msg(hmsg, msg_len + 64, max_msg_len + 64)
+    k = fs.sc_reduce512(digest)
+
+    k_bits = fs.sc_bits(k)
+    s_bits = fs.sc_bits(fs.sc_frombytes(s_enc))
+    r_cmp = fc.double_scalar_mul_comb(k_bits, s_bits, bank, slots)
+    return ok_s & ok_r & fc.point_eq_z1(r_cmp, r_pt)
+
+
+@jax.jit
+def comb_fill(pubkey: jnp.ndarray):
+    """(32, M) pubkey byte rows -> ((NWIN, 16, 4, NLIMB, M) int16, (M,) ok).
+
+    Decompresses + strict-checks each pubkey once and builds the -A comb;
+    elements with ok=False carry garbage tables and must not be installed.
+    """
+    a_pt, ok = fc.point_decompress(pubkey.astype(jnp.int32))
+    ok = ok & ~fc.is_small_order(a_pt)
+    tables = fc.comb_tables(a_pt).astype(jnp.int16)
+    return tables, ok
+
+
+@functools.partial(jax.jit, donate_argnames=("bank",))
+def bank_install(bank, tables, slots):
+    """Write `tables` (.., M) into bank slots (M,) in place (donated)."""
+    return bank.at[..., slots].set(tables)
+
+
+def bank_alloc(n_slots: int):
+    """Zeroed device comb bank for `n_slots` signers (~164 KB per slot)."""
+    import jax.numpy as jnp
+
+    from . import curve as fc
+    from . import limbs as fl
+
+    return jnp.zeros(
+        (fc.NWIN, 16, 4, fl.NLIMB, n_slots), dtype=jnp.int16
+    )
+
+
 # -- split-phase variant ------------------------------------------------------
 #
 # The same computation as four separately jitted programs.  Purpose:

@@ -170,6 +170,16 @@ class RpcServer:
             b58_encode32,
         )
 
+
+        class _ParamError(ValueError):
+            """Client-supplied parameter failed to decode."""
+
+        def dec(fn, *a):
+            try:
+                return fn(*a)
+            except Exception as e:
+                raise _ParamError(str(e)) from e
+
         try:
             if method == "getTransactionCount":
                 return ok(self.view.transaction_count())
@@ -189,12 +199,12 @@ class RpcServer:
             if method == "getBalance":
                 if not params:
                     return err(-32602, "missing pubkey param")
-                return ctx(self.view.balance(b58_decode32(params[0])))
+                return ctx(self.view.balance(dec(b58_decode32, params[0])))
             if method == "getAccountInfo":
                 if not params:
                     return err(-32602, "missing pubkey param")
                 lam, owner, ex, data = self.view.account(
-                    b58_decode32(params[0])
+                    dec(b58_decode32, params[0])
                 )
                 if lam == 0 and not data and owner == bytes(32):
                     return ctx(None)
@@ -221,7 +231,7 @@ class RpcServer:
                     return err(-32602, "missing blockhash param")
                 sc = getattr(self.view, "status_cache", None)
                 valid = bool(sc) and sc.is_blockhash_valid(
-                    b58_decode32(params[0]), self.view.slot()
+                    dec(b58_decode32, params[0]), self.view.slot()
                 )
                 return ctx(valid)
             if method == "getSignatureStatuses":
@@ -229,7 +239,7 @@ class RpcServer:
                     return err(-32602, "missing signatures param")
                 vals = []
                 for s in params[0]:
-                    slot = self.view.signature_status(b58_decode(s, 64))
+                    slot = self.view.signature_status(dec(b58_decode, s, 64))
                     vals.append(
                         None if slot is None else {
                             "slot": slot,
@@ -246,8 +256,8 @@ class RpcServer:
                 if len(params) > 1 and isinstance(params[1], dict):
                     enc = params[1].get("encoding", "base58")
                 raw = (
-                    base64.b64decode(params[0]) if enc == "base64"
-                    else b58_decode(params[0])
+                    dec(base64.b64decode, params[0]) if enc == "base64"
+                    else dec(b58_decode, params[0])
                 )
                 from firedancer_tpu.protocol import txn as ft
 
@@ -275,12 +285,9 @@ class RpcServer:
             if method == "getMinimumBalanceForRentExemption":
                 from firedancer_tpu.flamenco import types as T
 
-                size = int(params[0]) if params else 0
-                rent = T.Rent()
-                return ok(int(
-                    (size + 128) * rent.lamports_per_byte_year
-                    * rent.exemption_threshold
-                ))
+                size = dec(int, params[0]) if params else 0
+                # the same formula the runtime enforces — never a re-derivation
+                return ok(T.rent_exempt_minimum(T.Rent(), size))
             if method == "requestAirdrop":
                 # faucet_fn(pubkey, lamports) -> the airdrop txn's
                 # 64-byte signature (clients poll it via
@@ -290,11 +297,17 @@ class RpcServer:
                     return err(-32601, "faucet not enabled")
                 if len(params) < 2:
                     return err(-32602, "need pubkey and lamports")
-                sig = fn(b58_decode32(params[0]), int(params[1]))
+                sig = fn(dec(b58_decode32, params[0]), dec(int, params[1]))
                 if not sig:
                     return err(-32603, "airdrop failed")
                 return ok(b58_encode(sig))
             return err(-32601, f"method not found: {method}")
+        except _ParamError as e:
+            # malformed client parameters (bad base58/base64, wrong types)
+            # are the CLIENT's fault: -32602 invalid params, not -32603 —
+            # only the dec() decode boundary maps here, so a genuine
+            # handler bug still reports -32603 and clients retry it
+            return err(-32602, f"invalid params: {e}")
         except Exception as e:
             return err(-32603, f"internal error: {type(e).__name__}")
 

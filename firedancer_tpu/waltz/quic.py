@@ -575,6 +575,10 @@ class Connection:
         self.tx_data_total = 0
         self.tx_stream_limit: dict[int, int] = {}
         self.blocked_out: list[tuple[int, bytes, bool]] = []
+        # stream ids with a parked write — O(1) ordering check in
+        # _send_stream_inner (a linear scan there is O(n^2) under
+        # sustained backpressure on the per-txn-stream ingress path)
+        self._blocked_sids: set[int] = set()
 
     @property
     def established(self) -> bool:
@@ -749,14 +753,14 @@ class Connection:
                            fin: bool) -> None:
         off = self.send_offset.get(stream_id, 0)
         slimit = self.tx_stream_limit.get(stream_id, DEFAULT_MAX_STREAM_DATA)
-        blocked_ahead = any(s == stream_id for s, _d, _f in self.blocked_out)
-        if blocked_ahead or off + len(data) > slimit or (
+        if stream_id in self._blocked_sids or off + len(data) > slimit or (
             self.tx_data_total + len(data) > self.tx_max_data
         ):
             # peer window closed — or an EARLIER write on this stream is
             # already parked: a later smaller write must never overtake
             # it (stream bytes are ordered by offset)
             self.blocked_out.append((stream_id, data, fin))
+            self._blocked_sids.add(stream_id)
             return
         self.app_out.append(("stream", stream_id, off, data, fin))
         self.send_offset[stream_id] = off + len(data)
@@ -764,6 +768,7 @@ class Connection:
 
     def _drain_blocked(self) -> None:
         pending, self.blocked_out = self.blocked_out, []
+        self._blocked_sids.clear()
         for sid, data, fin in pending:
             self._send_stream_inner(sid, data, fin)
 

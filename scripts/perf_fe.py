@@ -88,7 +88,11 @@ def _pallas_mul_body(a, b):
         for i in range(lo + 1, hi + 1):
             t = t + a[i] * b[k - i]
         rows.append(t)
-    rows.append(jnp.zeros_like(rows[0]))  # row 41 (carry spill)
+    # TWO spill rows: 39 conv rows + rows 39,40 for carry spill, so the
+    # c[2*NL] fold term exists (r4 fix: the old single spill row made
+    # c[40] out of bounds — the "pallas failure" was this harness bug)
+    rows.append(jnp.zeros_like(rows[0]))
+    rows.append(jnp.zeros_like(rows[0]))
     c = jnp.stack(rows)  # (41, B)
     for _ in range(3):
         hi = c >> RADIX

@@ -1,0 +1,148 @@
+"""Probe WHY composed point ops run ~5x slower than raw fe_mul chains.
+
+perf_fe.py measured (TPU v5e, batch 16384):
+    jnp13 (one fe_mul chained)   0.024 ms/iter
+    pdbl13 (point_dbl chained)   0.757 ms/iter  (~6.4 fe_mul-equiv of work)
+The gap means the kernel's cost is NOT the multiply count.  Decompose:
+
+  mulchain   — one fe_mul/iter (re-measure with wide k spread)
+  mul4       — 4 independent fe_mul per iter (state of 4 fe's: does a
+               bigger loop state alone cause the slowdown?)
+  sqr4       — 4 fe_sqr per iter
+  addchain   — one fe_add (carry2) per iter: carry-pass cost
+  dblnoc     — point_dbl with NO carry passes on add/sub (raw +/-, bounds
+               be damned — timing only)
+  dblprod    — the 4 sqr + 4 mul of point_dbl with the adds replaced by
+               constants (isolates the mul DAG shape)
+  dbl        — production point_dbl
+
+Usage: python scripts/perf_probe.py [--batch 16384] [--k1 64] [--k2 256]
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from firedancer_tpu.ops import limbs as fl
+from firedancer_tpu.ops import curve as fc
+
+
+def bench_step(name, step, state, k1, k2):
+    @jax.jit
+    def run(state, n):
+        out = jax.lax.fori_loop(0, n, lambda i, s: step(s), state)
+        leaf = jax.tree_util.tree_leaves(out)[0]
+        return jnp.sum(leaf[0].astype(jnp.float32))
+
+    float(run(state, jnp.int32(2)))
+    t = {}
+    for k in (k1, k2):
+        best = 1e9
+        for _ in range(3):
+            t0 = time.perf_counter()
+            float(run(state, jnp.int32(k)))
+            best = min(best, time.perf_counter() - t0)
+        t[k] = best
+    per_iter = (t[k2] - t[k1]) / (k2 - k1)
+    print(
+        f"{name:10s}  {per_iter*1e3:8.4f} ms/iter"
+        f"   [t{k1}={t[k1]*1e3:.0f}ms t{k2}={t[k2]*1e3:.0f}ms]"
+    )
+    return per_iter
+
+
+def step_mulchain(s):
+    x, y = s
+    return fl.fe_mul(x, y), x
+
+
+def step_mul4(s):
+    a, b, c, d = s
+    return fl.fe_mul(a, b), fl.fe_mul(b, c), fl.fe_mul(c, d), fl.fe_mul(d, a)
+
+
+def step_sqr4(s):
+    a, b, c, d = s
+    return fl.fe_sqr(a), fl.fe_sqr(b), fl.fe_sqr(c), fl.fe_sqr(d)
+
+
+def step_addchain(s):
+    x, y = s
+    return fl.fe_add(x, y), x
+
+
+def _rawadd(a, b):
+    return a + b
+
+
+def _rawsub(a, b):
+    return a - b
+
+
+def step_dblnoc(s):
+    x1, y1, z1, t1 = s[0]
+    a = fl.fe_sqr(x1)
+    b = fl.fe_sqr(y1)
+    zz = fl.fe_sqr(z1)
+    c = _rawadd(zz, zz)
+    e = _rawsub(_rawsub(fl.fe_sqr(_rawadd(x1, y1)), a), b)
+    g = _rawsub(b, a)
+    f = _rawsub(g, c)
+    h = -(_rawadd(a, b))
+    return ((fl.fe_mul(e, f), fl.fe_mul(g, h), fl.fe_mul(f, g), fl.fe_mul(e, h)),)
+
+
+def step_dblprod(s):
+    x1, y1, z1, t1 = s[0]
+    a = fl.fe_sqr(x1)
+    b = fl.fe_sqr(y1)
+    zz = fl.fe_sqr(z1)
+    e = fl.fe_sqr(t1)
+    return ((fl.fe_mul(e, a), fl.fe_mul(b, zz), fl.fe_mul(a, b), fl.fe_mul(e, zz)),)
+
+
+def step_dbl(s):
+    return (fc.point_dbl(s[0]),)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=16384)
+    ap.add_argument("--k1", type=int, default=64)
+    ap.add_argument("--k2", type=int, default=256)
+    ap.add_argument("--only", type=str, default="")
+    args = ap.parse_args()
+    B = args.batch
+    only = set(args.only.split(",")) if args.only else None
+    print("backend:", jax.default_backend(), jax.devices(), "batch", B)
+    rng = np.random.default_rng(11)
+
+    def mk():
+        return jnp.asarray(rng.integers(0, 1 << 13, (fl.NLIMB, B)), jnp.int32)
+
+    x, y = mk(), mk()
+    p4 = (mk(), mk(), mk(), mk())
+
+    todo = [
+        ("mulchain", step_mulchain, (x, y)),
+        ("mul4", step_mul4, p4),
+        ("sqr4", step_sqr4, p4),
+        ("addchain", step_addchain, (x, y)),
+        ("dblprod", step_dblprod, (p4,)),
+        ("dblnoc", step_dblnoc, (p4,)),
+        ("dbl", step_dbl, (p4,)),
+    ]
+    for name, step, state in todo:
+        if only is None or name in only:
+            bench_step(name, step, state, args.k1, args.k2)
+
+
+if __name__ == "__main__":
+    main()

@@ -120,6 +120,7 @@ def test_status_cache_duplicate_across_slots():
 
     r1 = execute_block(funk, slot=5, txns=[txn], status_cache=sc)
     funk.txn_publish(r1.xid)
+    sc.commit_block(r1.xid)  # fork chosen: staged entries become visible
     assert r1.results[0].status == TXN_SUCCESS
     r2 = execute_block(funk, slot=6, txns=[txn], status_cache=sc)
     assert r2.results[0].status == TXN_ERR_ALREADY_PROCESSED
@@ -127,6 +128,38 @@ def test_status_cache_duplicate_across_slots():
     from firedancer_tpu.flamenco.runtime import acct_lamports
 
     assert acct_lamports(funk.rec_query(r2.xid, dest)) == 1000  # once
+
+
+def test_status_cache_competing_blocks_same_slot():
+    """Review finding r4: a SPECULATIVE (unchosen) block's insertions must
+    not gate a competing block for the same slot; dropping the loser keeps
+    the cache clean."""
+    funk = Funk()
+    secret, payer = keypair(b"sc-race")
+    dest = hashlib.sha256(b"sc-race-dest").digest()
+    funk.rec_insert(None, payer, acct_build(1_000_000))
+    sc = StatusCache()
+    bh = hashlib.sha256(b"sc-race-bh").digest()
+    sc.register_blockhash(bh, 4)
+    txn = _transfer(secret, dest, 700, bh)
+
+    ra = execute_block(funk, slot=5, txns=[txn], status_cache=sc,
+                       ancestors={4})
+    assert ra.results[0].status == TXN_SUCCESS
+    # competing block B at the SAME slot re-executes the same txn: block
+    # A was never chosen, so this must succeed
+    rb = execute_block(funk, slot=5, txns=[txn], status_cache=sc,
+                       ancestors={4}, parent_xid=None)
+    assert rb.results[0].status == TXN_SUCCESS
+    # choose B, drop A: descendants of B now see the signature
+    sc.commit_block(rb.xid)
+    sc.drop_block(ra.xid)
+    rc = execute_block(funk, slot=6, txns=[txn], status_cache=sc,
+                       ancestors={4, 5})
+    assert rc.results[0].status == TXN_ERR_ALREADY_PROCESSED
+    # and the RPC index answers for the committed block only
+    sig = ft.txn_parse(txn).signatures(txn)[0]
+    assert sc.by_sig.get(sig) == [5]
 
 
 def test_status_cache_blockhash_age():

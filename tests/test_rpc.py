@@ -56,8 +56,10 @@ def test_errors(server):
     assert r["error"]["code"] == -32601
     r = rpc_call(srv.addr, "getBalance")  # missing param
     assert r["error"]["code"] == -32602
+    # malformed base58 is the CLIENT's fault: invalid params, not -32603
+    # (r4 review finding — clients retry on server faults)
     r = rpc_call(srv.addr, "getBalance", ["not-base58!!"])
-    assert r["error"]["code"] == -32603
+    assert r["error"]["code"] == -32602
     # unknown account -> zero balance, not an error
     other = hashlib.sha256(b"nobody").digest()
     assert rpc_call(srv.addr, "getBalance", [b58_encode(other)])["result"][
