@@ -1,0 +1,603 @@
+"""Extended instruction-fixture corpus: parametric rule-edge sweeps.
+
+Families (each its own subdir under tests/fixtures/instr/):
+
+  system2/  transfer balance-boundary x flag-permutation sweeps, create
+            space/funding boundaries, truncated-data pins, unknown-tag
+            no-op pins
+  stake/    initialize/delegate/deactivate edges + the warmup/cooldown
+            ramp arithmetic pinned epoch by epoch (withdraw of the exact
+            free balance succeeds; one more lamport fails)
+  vote/     authority binding + signature rules
+  alt/      create derivation, extend limits, deactivate/close cooldown
+            slot boundaries
+  budget/   compute-budget payload validation
+
+EXPECTED effects are computed by rule logic written HERE from the
+reference's documented semantics (fd_system_program.c, fd_stake_program.c
+warmup/cooldown, fd_address_lookup_table_program.c cooldown, fd_vote_program
+authority) — not by running the build's programs, so divergences are
+caught.  State-layout encoders (StakeState/TableState) are imported from
+the build because the layout is build-defined; the RULES are not.
+
+Usage: python scripts/gen_fixtures_ext.py
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+import sys
+
+sys.path.insert(0, ".")
+
+from firedancer_tpu.flamenco.alt import (
+    ALT_PROGRAM, DEACTIVATE_COOLDOWN_SLOTS, MAX_ADDRESSES, TableState,
+)
+from firedancer_tpu.flamenco.solcompat import (
+    AcctState, InstrAcctRef, InstrContext, InstrEffects, InstrFixture,
+)
+from firedancer_tpu.flamenco.stake import (
+    STAKE_PROGRAM, STATE_DELEGATED, STATE_INIT, STATE_UNINIT, U64_MAX,
+    StakeState, WARMUP_DIV, _DATA_LEN as STAKE_LEN,
+)
+from firedancer_tpu.protocol import pda
+from firedancer_tpu.protocol.txn import SYSTEM_PROGRAM, VOTE_PROGRAM
+
+ROOT = "tests/fixtures/instr"
+SLOTS_PER_EPOCH = 432_000
+MAX_DATA = 10 * 1024 * 1024
+
+count = 0
+
+
+def key(name: str) -> bytes:
+    return hashlib.sha256(b"fixture:" + name.encode()).digest()
+
+
+def acct(addr, lamports, data=b"", owner=SYSTEM_PROGRAM, executable=False):
+    return AcctState(address=addr, lamports=lamports, data=bytes(data),
+                     owner=owner, executable=executable)
+
+
+def refs(*tups):
+    return [InstrAcctRef(index=i, is_signer=s, is_writable=w)
+            for (i, s, w) in tups]
+
+
+def fx(family, name, program_id, accounts, iaccts, data, *,
+       result=0, modified=(), slot=10, cu=10_000):
+    global count
+    c = InstrContext(program_id=program_id, accounts=accounts,
+                     instr_accounts=iaccts, data=bytes(data),
+                     cu_avail=cu, slot=slot)
+    e = InstrEffects(result=result, modified_accounts=list(modified))
+    d = os.path.join(ROOT, family)
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, name + ".fix"), "wb") as f:
+        f.write(InstrFixture(c, e).encode())
+    count += 1
+
+
+def u32(x):
+    return int(x).to_bytes(4, "little")
+
+
+def u64(x):
+    return int(x).to_bytes(8, "little")
+
+
+# -- system sweeps ------------------------------------------------------------
+
+
+def gen_system():
+    fam = "system2"
+    a, b, prog = key("s2:a"), key("s2:b"), key("s2:prog")
+
+    # transfer boundary sweep: for each starting balance, every interesting
+    # lamports value; rule: signer+writable src, writable dst, src
+    # system-owned + dataless, src.lamports >= lamports
+    for bal in (0, 1, 1000):
+        for lam in sorted({0, 1, bal - 1, bal, bal + 1, (1 << 64) - 1}):
+            if lam < 0:
+                continue
+            ok = lam <= bal
+            mod = [acct(a, bal - lam), acct(b, 7 + lam)] if ok else ()
+            fx(fam, f"xfer_bal{bal}_lam{lam}", SYSTEM_PROGRAM,
+               [acct(a, bal), acct(b, 7)],
+               refs((0, True, True), (1, False, True)),
+               u32(2) + u64(lam),
+               result=0 if ok else 1, modified=mod)
+
+    # flag permutations: src signer x src writable x dst writable; only
+    # (1,1,1) succeeds
+    for ss in (0, 1):
+        for sw in (0, 1):
+            for dw in (0, 1):
+                ok = ss and sw and dw
+                fx(fam, f"xfer_flags_s{ss}w{sw}d{dw}", SYSTEM_PROGRAM,
+                   [acct(a, 100), acct(b, 0)],
+                   refs((0, bool(ss), bool(sw)), (1, False, bool(dw))),
+                   u32(2) + u64(10),
+                   result=0 if ok else 1,
+                   modified=[acct(a, 90), acct(b, 10)] if ok else ())
+
+    # truncated transfer payloads (< 4+8 bytes): legacy no-op success,
+    # nothing moves
+    full = u32(2) + u64(10)
+    for n in (0, 1, 3, 4, 5, 11):
+        fx(fam, f"xfer_trunc{n}", SYSTEM_PROGRAM,
+           [acct(a, 100), acct(b, 0)],
+           refs((0, True, True), (1, False, True)),
+           full[:n], result=0,
+           modified=[acct(a, 100), acct(b, 0)])
+
+    # unknown tags are inert no-ops
+    for tag in (3, 4, 5, 6, 7, 9, 10, 11, 12, 255, 2**31):
+        fx(fam, f"unknown_tag{tag}", SYSTEM_PROGRAM,
+           [acct(a, 100)], refs((0, True, True)),
+           u32(tag) + bytes(40), result=0, modified=[acct(a, 100)])
+
+    # create: space boundaries; rule: both sign, both writable, space <=
+    # MAX_DATA, funder system-owned, target must not exist, funding covers
+    for space in (0, 1, 16, MAX_DATA, MAX_DATA + 1):
+        ok = space <= MAX_DATA
+        mod = ([acct(a, 8000),
+                acct(b, 2000, data=bytes(space), owner=prog)] if ok else ())
+        fx(fam, f"create_space{space}", SYSTEM_PROGRAM,
+           [acct(a, 10_000), acct(b, 0)],
+           refs((0, True, True), (1, True, True)),
+           u32(0) + u64(2000) + u64(space) + prog,
+           result=0 if ok else 1, modified=mod)
+
+    # create funding boundary: lamports == balance ok, +1 fails
+    for lam, ok in ((10_000, True), (10_001, False)):
+        mod = ([acct(a, 0), acct(b, lam, data=bytes(8), owner=prog)]
+               if ok else ())
+        fx(fam, f"create_fund{lam}", SYSTEM_PROGRAM,
+           [acct(a, 10_000), acct(b, 0)],
+           refs((0, True, True), (1, True, True)),
+           u32(0) + u64(lam) + u64(8) + prog,
+           result=0 if ok else 1, modified=mod)
+
+    # create onto an account with data / lamports / program owner: in use
+    for variant, target in (
+        ("data", acct(b, 0, data=b"\x01")),
+        ("lamports", acct(b, 3)),
+        ("owner", acct(b, 0, owner=prog)),
+    ):
+        fx(fam, f"create_exists_{variant}", SYSTEM_PROGRAM,
+           [acct(a, 10_000), target],
+           refs((0, True, True), (1, True, True)),
+           u32(0) + u64(2000) + u64(8) + prog, result=1)
+
+    # create signature permutations: funder and new must both sign
+    for fs in (0, 1):
+        for ns in (0, 1):
+            ok = fs and ns
+            mod = ([acct(a, 9000), acct(b, 1000, data=bytes(4), owner=prog)]
+                   if ok else ())
+            fx(fam, f"create_sig_f{fs}n{ns}", SYSTEM_PROGRAM,
+               [acct(a, 10_000), acct(b, 0)],
+               refs((0, bool(fs), True), (1, bool(ns), True)),
+               u32(0) + u64(1000) + u64(4) + prog,
+               result=0 if ok else 1, modified=mod)
+
+    # assign: to self-owner (system) is a legal no-op-shaped success
+    fx(fam, "assign_to_system", SYSTEM_PROGRAM,
+       [acct(a, 5)], refs((0, True, True)),
+       u32(1) + SYSTEM_PROGRAM,
+       result=0, modified=[acct(a, 5)])
+    # assign truncated owner fails (malformed)
+    fx(fam, "assign_trunc", SYSTEM_PROGRAM,
+       [acct(a, 5)], refs((0, True, True)),
+       (u32(1) + prog)[:20], result=1)
+    # allocate boundaries
+    for space in (0, 1, MAX_DATA, MAX_DATA + 1):
+        ok = space <= MAX_DATA
+        fx(fam, f"alloc_space{space}", SYSTEM_PROGRAM,
+           [acct(a, 5)], refs((0, True, True)),
+           u32(8) + u64(space),
+           result=0 if ok else 1,
+           modified=[acct(a, 5, data=bytes(space))] if ok else ())
+    # allocate on program-owned account fails
+    fx(fam, "alloc_foreign", SYSTEM_PROGRAM,
+       [acct(a, 5, owner=prog)], refs((0, True, True)),
+       u32(8) + u64(8), result=1)
+    # allocate unsigned fails
+    fx(fam, "alloc_unsigned", SYSTEM_PROGRAM,
+       [acct(a, 5)], refs((0, False, True)),
+       u32(8) + u64(8), result=1)
+
+
+# -- stake sweeps -------------------------------------------------------------
+
+
+def stake_acct(addr, lamports, st: StakeState):
+    return acct(addr, lamports, data=st.encode(), owner=STAKE_PROGRAM)
+
+
+def gen_stake():
+    fam = "stake"
+    s, d, v = key("st:stake"), key("st:dest"), key("st:vote")
+    staker, wd = key("st:staker"), key("st:withdrawer")
+
+    init = StakeState(state=STATE_INIT, staker=staker, withdrawer=wd)
+
+    # initialize: ok / data one byte short / already initialized
+    fx(fam, "init_ok", STAKE_PROGRAM,
+       [acct(s, 100, data=bytes(STAKE_LEN), owner=STAKE_PROGRAM)],
+       refs((0, True, True)), u32(0) + staker + wd,
+       modified=[stake_acct(s, 100, init)])
+    fx(fam, "init_short_acct", STAKE_PROGRAM,
+       [acct(s, 100, data=bytes(STAKE_LEN - 1), owner=STAKE_PROGRAM)],
+       refs((0, True, True)), u32(0) + staker + wd, result=1)
+    fx(fam, "init_twice", STAKE_PROGRAM,
+       [stake_acct(s, 100, init)],
+       refs((0, True, True)), u32(0) + staker + wd, result=1)
+    fx(fam, "init_foreign_owner", STAKE_PROGRAM,
+       [acct(s, 100, data=bytes(STAKE_LEN))],  # system-owned
+       refs((0, True, True)), u32(0) + staker + wd, result=1)
+    fx(fam, "init_trunc_payload", STAKE_PROGRAM,
+       [acct(s, 100, data=bytes(STAKE_LEN), owner=STAKE_PROGRAM)],
+       refs((0, True, True)), (u32(0) + staker + wd)[:40], result=1)
+
+    # delegate at epoch 3 (slot = 3 epochs): whole balance delegates
+    ep3 = 3 * SLOTS_PER_EPOCH
+    delegated3 = StakeState(
+        state=STATE_DELEGATED, staker=staker, withdrawer=wd, voter=v,
+        stake=500, activation_epoch=3)
+    fx(fam, "delegate_ok", STAKE_PROGRAM,
+       [stake_acct(s, 500, init), acct(v, 1, owner=VOTE_PROGRAM),
+        acct(staker, 0)],
+       refs((0, False, True), (1, False, False), (2, True, False)),
+       u32(1), slot=ep3,
+       modified=[stake_acct(s, 500, delegated3)])
+    # wrong staker signature
+    fx(fam, "delegate_wrong_signer", STAKE_PROGRAM,
+       [stake_acct(s, 500, init), acct(v, 1, owner=VOTE_PROGRAM),
+        acct(key("st:other"), 0)],
+       refs((0, False, True), (1, False, False), (2, True, False)),
+       u32(1), slot=ep3, result=1)
+    fx(fam, "delegate_uninit", STAKE_PROGRAM,
+       [acct(s, 500, data=bytes(STAKE_LEN), owner=STAKE_PROGRAM),
+        acct(v, 1, owner=VOTE_PROGRAM), acct(staker, 0)],
+       refs((0, False, True), (1, False, False), (2, True, False)),
+       u32(1), slot=ep3, result=1)
+
+    # deactivate at epoch 5
+    deact5 = StakeState(
+        state=STATE_DELEGATED, staker=staker, withdrawer=wd, voter=v,
+        stake=500, activation_epoch=3, deactivation_epoch=5)
+    fx(fam, "deactivate_ok", STAKE_PROGRAM,
+       [stake_acct(s, 500, delegated3), acct(staker, 0)],
+       refs((0, False, True), (1, True, False)),
+       u32(2), slot=5 * SLOTS_PER_EPOCH,
+       modified=[stake_acct(s, 500, deact5)])
+    fx(fam, "deactivate_undelegated", STAKE_PROGRAM,
+       [stake_acct(s, 500, init), acct(staker, 0)],
+       refs((0, False, True), (1, True, False)),
+       u32(2), slot=5 * SLOTS_PER_EPOCH, result=1)
+
+    # THE RAMP: deactivated at epoch 5, stake 400, extra 100 free
+    # lamports.  At clock epoch e the locked part is
+    # max(0, 400 - 400*(e-5)//4); withdrawing the exact free balance
+    # succeeds and one more lamport fails.
+    base = StakeState(
+        state=STATE_DELEGATED, staker=staker, withdrawer=wd, voter=v,
+        stake=400, activation_epoch=1, deactivation_epoch=5)
+    for e in (5, 6, 7, 8, 9, 12):
+        locked = max(0, 400 - 400 * (e - 5) // WARMUP_DIV)
+        free = 500 - locked
+        slot = e * SLOTS_PER_EPOCH
+        if free > 0:
+            fx(fam, f"withdraw_ramp_e{e}_exact", STAKE_PROGRAM,
+               [stake_acct(s, 500, base), acct(d, 0), acct(wd, 0)],
+               refs((0, False, True), (1, False, True), (2, True, False)),
+               u32(3) + u64(free), slot=slot,
+               modified=[stake_acct(s, 500 - free, base), acct(d, free)])
+        fx(fam, f"withdraw_ramp_e{e}_over", STAKE_PROGRAM,
+           [stake_acct(s, 500, base), acct(d, 0), acct(wd, 0)],
+           refs((0, False, True), (1, False, True), (2, True, False)),
+           u32(3) + u64(free + 1), slot=slot, result=1)
+
+    # active (never deactivated) stake of 400 on a 500 balance: only the
+    # free 100 moves; the whole active delegation stays locked
+    active400 = StakeState(
+        state=STATE_DELEGATED, staker=staker, withdrawer=wd, voter=v,
+        stake=400, activation_epoch=3)
+    fx(fam, "withdraw_active_free", STAKE_PROGRAM,
+       [stake_acct(s, 500, active400), acct(d, 0), acct(wd, 0)],
+       refs((0, False, True), (1, False, True), (2, True, False)),
+       u32(3) + u64(100), slot=9 * SLOTS_PER_EPOCH,
+       modified=[stake_acct(s, 400, active400), acct(d, 100)])
+    fx(fam, "withdraw_active_locked", STAKE_PROGRAM,
+       [stake_acct(s, 500, active400), acct(d, 0), acct(wd, 0)],
+       refs((0, False, True), (1, False, True), (2, True, False)),
+       u32(3) + u64(101), slot=9 * SLOTS_PER_EPOCH, result=1)
+    # wrong authority: the staker cannot withdraw
+    fx(fam, "withdraw_wrong_authority", STAKE_PROGRAM,
+       [stake_acct(s, 500, delegated3), acct(d, 0), acct(staker, 0)],
+       refs((0, False, True), (1, False, True), (2, True, False)),
+       u32(3) + u64(1), slot=9 * SLOTS_PER_EPOCH, result=1)
+    # uninitialized account withdraws under its own key
+    fx(fam, "withdraw_uninit_own_key", STAKE_PROGRAM,
+       [acct(s, 500, data=bytes(STAKE_LEN), owner=STAKE_PROGRAM),
+        acct(d, 0)],
+       refs((0, True, True), (1, False, True)),
+       u32(3) + u64(500),
+       modified=[acct(s, 0, data=bytes(STAKE_LEN), owner=STAKE_PROGRAM),
+                 acct(d, 500)])
+    fx(fam, "withdraw_uninit_unsigned", STAKE_PROGRAM,
+       [acct(s, 500, data=bytes(STAKE_LEN), owner=STAKE_PROGRAM),
+        acct(d, 0)],
+       refs((0, False, True), (1, False, True)),
+       u32(3) + u64(500), result=1)
+
+    # split sweep: delegation 400, balance 500; lamports 0/1/399/400 legal,
+    # 401 (> stake) and 501 (> balance) fail
+    n = key("st:new")
+    for lam in (0, 1, 399, 400, 401, 501):
+        ok = lam <= 400 and lam <= 500
+        if ok:
+            st_after = StakeState(
+                state=STATE_DELEGATED, staker=staker, withdrawer=wd,
+                voter=v, stake=400 - lam, activation_epoch=1,
+                deactivation_epoch=5)
+            nst = StakeState(
+                state=STATE_DELEGATED, staker=staker, withdrawer=wd,
+                voter=v, stake=lam, activation_epoch=1,
+                deactivation_epoch=5)
+            mod = [stake_acct(s, 500 - lam, st_after),
+                   acct(n, lam, data=nst.encode(), owner=STAKE_PROGRAM)]
+        else:
+            mod = ()
+        fx(fam, f"split_lam{lam}", STAKE_PROGRAM,
+           [stake_acct(s, 500, base),
+            acct(n, 0, data=bytes(STAKE_LEN), owner=STAKE_PROGRAM),
+            acct(staker, 0)],
+           refs((0, False, True), (1, False, True), (2, True, False)),
+           u32(4) + u64(lam), slot=5 * SLOTS_PER_EPOCH,
+           result=0 if ok else 1, modified=mod)
+    fx(fam, "split_target_in_use", STAKE_PROGRAM,
+       [stake_acct(s, 500, base), stake_acct(n, 10, init), acct(staker, 0)],
+       refs((0, False, True), (1, False, True), (2, True, False)),
+       u32(4) + u64(10), slot=5 * SLOTS_PER_EPOCH, result=1)
+    fx(fam, "split_target_short", STAKE_PROGRAM,
+       [stake_acct(s, 500, base),
+        acct(n, 0, data=bytes(STAKE_LEN - 1), owner=STAKE_PROGRAM),
+        acct(staker, 0)],
+       refs((0, False, True), (1, False, True), (2, True, False)),
+       u32(4) + u64(10), slot=5 * SLOTS_PER_EPOCH, result=1)
+
+
+# -- vote ----------------------------------------------------------------------
+
+
+def vote_state(last_slot, cnt, authority):
+    return last_slot.to_bytes(8, "little") + cnt.to_bytes(8, "little") + \
+        authority
+
+
+def gen_vote():
+    fam = "vote"
+    va, auth = key("vt:acct"), key("vt:auth")
+
+    # fresh account: first signer becomes the authority
+    fx(fam, "vote_binds_authority", VOTE_PROGRAM,
+       [acct(va, 10, data=bytes(48), owner=VOTE_PROGRAM), acct(auth, 0)],
+       refs((0, False, True), (1, True, False)),
+       u32(1) + u64(77),
+       modified=[acct(va, 10, data=vote_state(77, 1, auth),
+                      owner=VOTE_PROGRAM)])
+    # established authority signs: ok
+    fx(fam, "vote_ok", VOTE_PROGRAM,
+       [acct(va, 10, data=vote_state(77, 1, auth), owner=VOTE_PROGRAM),
+        acct(auth, 0)],
+       refs((0, False, True), (1, True, False)),
+       u32(1) + u64(99),
+       modified=[acct(va, 10, data=vote_state(99, 2, auth),
+                      owner=VOTE_PROGRAM)])
+    # no signature: forgery rejected
+    fx(fam, "vote_forged", VOTE_PROGRAM,
+       [acct(va, 10, data=vote_state(77, 1, auth), owner=VOTE_PROGRAM),
+        acct(auth, 0)],
+       refs((0, False, True), (1, False, False)),
+       u32(1) + u64(99), result=1)
+    # wrong signer
+    fx(fam, "vote_wrong_signer", VOTE_PROGRAM,
+       [acct(va, 10, data=vote_state(77, 1, auth), owner=VOTE_PROGRAM),
+        acct(key("vt:mallory"), 0)],
+       refs((0, False, True), (1, True, False)),
+       u32(1) + u64(99), result=1)
+    # history but zero authority: unhijackable
+    fx(fam, "vote_history_no_authority", VOTE_PROGRAM,
+       [acct(va, 10, data=vote_state(77, 5, bytes(32)), owner=VOTE_PROGRAM),
+        acct(auth, 0)],
+       refs((0, False, True), (1, True, False)),
+       u32(1) + u64(99), result=1)
+    # foreign owner untouchable
+    fx(fam, "vote_foreign_owner", VOTE_PROGRAM,
+       [acct(va, 10, data=vote_state(77, 1, auth)), acct(auth, 0)],
+       refs((0, False, True), (1, True, False)),
+       u32(1) + u64(99), result=1)
+    # not writable
+    fx(fam, "vote_readonly", VOTE_PROGRAM,
+       [acct(va, 10, data=vote_state(77, 1, auth), owner=VOTE_PROGRAM),
+        acct(auth, 0)],
+       refs((0, False, False), (1, True, False)),
+       u32(1) + u64(99), result=1)
+    # short payload / non-vote tag: inert no-op
+    for name, data in (("short", u32(1) + bytes(4)), ("othertag", u32(9))):
+        fx(fam, f"vote_noop_{name}", VOTE_PROGRAM,
+           [acct(va, 10, data=vote_state(77, 1, auth), owner=VOTE_PROGRAM),
+            acct(auth, 0)],
+           refs((0, False, True), (1, True, False)),
+           data, result=0,
+           modified=[acct(va, 10, data=vote_state(77, 1, auth),
+                          owner=VOTE_PROGRAM)])
+
+
+# -- address lookup table ------------------------------------------------------
+
+
+def find_table_pda(authority: bytes, recent_slot: int):
+    for bump in range(255, -1, -1):
+        try:
+            return bump, pda.create_program_address(
+                [authority, recent_slot.to_bytes(8, "little"), bytes([bump])],
+                ALT_PROGRAM)
+        except pda.PdaError:
+            continue
+    raise RuntimeError("no bump found")
+
+
+def table_acct(addr, lamports, st: TableState):
+    return acct(addr, lamports, data=st.encode(), owner=ALT_PROGRAM)
+
+
+def gen_alt():
+    fam = "alt"
+    auth, payer = key("alt:auth"), key("alt:payer")
+    recent = 100
+    bump, taddr = find_table_pda(auth, recent)
+
+    created = TableState(authority=auth)
+    # create: ok at slot >= recent
+    fx(fam, "create_ok", ALT_PROGRAM,
+       [acct(taddr, 0), acct(auth, 0), acct(payer, 10)],
+       refs((0, False, True), (1, False, False), (2, True, False)),
+       u32(0) + u64(recent) + bytes([bump]), slot=200,
+       modified=[table_acct(taddr, 0, created)])
+    # create with a future recent_slot fails
+    fx(fam, "create_future_slot", ALT_PROGRAM,
+       [acct(taddr, 0), acct(auth, 0), acct(payer, 10)],
+       refs((0, False, True), (1, False, False), (2, True, False)),
+       u32(0) + u64(300) + bytes([bump]), slot=200, result=1)
+    # wrong bump: derivation mismatch (or off-curve failure) — error either way
+    fx(fam, "create_wrong_bump", ALT_PROGRAM,
+       [acct(taddr, 0), acct(auth, 0), acct(payer, 10)],
+       refs((0, False, True), (1, False, False), (2, True, False)),
+       u32(0) + u64(recent) + bytes([(bump + 1) % 256]), slot=200, result=1)
+    # payer must sign
+    fx(fam, "create_unsigned_payer", ALT_PROGRAM,
+       [acct(taddr, 0), acct(auth, 0), acct(payer, 10)],
+       refs((0, False, True), (1, False, False), (2, False, False)),
+       u32(0) + u64(recent) + bytes([bump]), slot=200, result=1)
+
+    # extend sweep: existing 3 addresses; n in {1, 252, 253, 254} against the
+    # 256-address cap (3 + 253 = 256 is legal; 3 + 254 overflows)
+    seed3 = [key(f"alt:addr{i}") for i in range(3)]
+    have3 = TableState(authority=auth, addresses=list(seed3))
+    for n in (1, 252, 253, 254):
+        new = [key(f"alt:new{i}") for i in range(n)]
+        ok = 3 + n <= MAX_ADDRESSES
+        after = TableState(authority=auth, addresses=seed3 + new,
+                           last_extended_slot=200, last_extended_start=3)
+        fx(fam, f"extend_n{n}", ALT_PROGRAM,
+           [table_acct(taddr, 5, have3), acct(auth, 0), acct(payer, 10)],
+           refs((0, False, True), (1, True, False), (2, True, False)),
+           u32(2) + u64(n) + b"".join(new), slot=200,
+           result=0 if ok else 1,
+           modified=[table_acct(taddr, 5, after)] if ok else ())
+    # extend with zero addresses fails; short payload fails
+    fx(fam, "extend_zero", ALT_PROGRAM,
+       [table_acct(taddr, 5, have3), acct(auth, 0), acct(payer, 10)],
+       refs((0, False, True), (1, True, False), (2, True, False)),
+       u32(2) + u64(0), slot=200, result=1)
+    fx(fam, "extend_short", ALT_PROGRAM,
+       [table_acct(taddr, 5, have3), acct(auth, 0), acct(payer, 10)],
+       refs((0, False, True), (1, True, False), (2, True, False)),
+       u32(2) + u64(2) + key("alt:only_one"), slot=200, result=1)
+    # wrong authority; frozen table
+    fx(fam, "extend_wrong_authority", ALT_PROGRAM,
+       [table_acct(taddr, 5, have3), acct(payer, 0), acct(payer, 10)],
+       refs((0, False, True), (1, True, False), (2, True, False)),
+       u32(2) + u64(1) + key("alt:x"), slot=200, result=1)
+    frozen = TableState(authority=None, addresses=list(seed3))
+    fx(fam, "extend_frozen", ALT_PROGRAM,
+       [table_acct(taddr, 5, frozen), acct(auth, 0), acct(payer, 10)],
+       refs((0, False, True), (1, True, False), (2, True, False)),
+       u32(2) + u64(1) + key("alt:x"), slot=200, result=1)
+
+    # freeze: ok / empty table cannot freeze
+    fx(fam, "freeze_ok", ALT_PROGRAM,
+       [table_acct(taddr, 5, have3), acct(auth, 0)],
+       refs((0, False, True), (1, True, False)),
+       u32(1), slot=200,
+       modified=[table_acct(taddr, 5, frozen)])
+    fx(fam, "freeze_empty", ALT_PROGRAM,
+       [table_acct(taddr, 5, created), acct(auth, 0)],
+       refs((0, False, True), (1, True, False)),
+       u32(1), slot=200, result=1)
+
+    # deactivate then close: cooldown boundary.  deactivated at slot 1000;
+    # close legal strictly after 1000 + COOLDOWN
+    deact = TableState(authority=auth, addresses=list(seed3),
+                       deactivation_slot=1000)
+    fx(fam, "deactivate_ok", ALT_PROGRAM,
+       [table_acct(taddr, 5, have3), acct(auth, 0)],
+       refs((0, False, True), (1, True, False)),
+       u32(3), slot=1000,
+       modified=[table_acct(taddr, 5, deact)])
+    fx(fam, "deactivate_twice", ALT_PROGRAM,
+       [table_acct(taddr, 5, deact), acct(auth, 0)],
+       refs((0, False, True), (1, True, False)),
+       u32(3), slot=1001, result=1)
+    for off, ok in ((0, False), (DEACTIVATE_COOLDOWN_SLOTS, False),
+                    (DEACTIVATE_COOLDOWN_SLOTS + 1, True)):
+        mod = ([acct(taddr, 0), acct(auth, 0), acct(payer, 15)]
+               if ok else ())
+        fx(fam, f"close_cooldown_off{off}", ALT_PROGRAM,
+           [table_acct(taddr, 5, deact), acct(auth, 0), acct(payer, 10)],
+           refs((0, False, True), (1, True, False), (2, False, True)),
+           u32(4), slot=1000 + off,
+           result=0 if ok else 1, modified=mod)
+    fx(fam, "close_active", ALT_PROGRAM,
+       [table_acct(taddr, 5, have3), acct(auth, 0), acct(payer, 10)],
+       refs((0, False, True), (1, True, False), (2, False, True)),
+       u32(4), slot=5000, result=1)
+    # unknown tag
+    fx(fam, "unknown_tag", ALT_PROGRAM,
+       [table_acct(taddr, 5, have3), acct(auth, 0)],
+       refs((0, False, True), (1, True, False)),
+       u32(9), slot=200, result=1)
+
+
+# -- compute budget ------------------------------------------------------------
+
+
+def gen_budget():
+    from firedancer_tpu.pack.cost import COMPUTE_BUDGET_PROGRAM
+
+    fam = "budget"
+    a = key("cb:payer")
+    # valid payloads: tag byte 0..3 with >= 4 payload bytes following
+    for tag in (0, 1, 2, 3):
+        fx(fam, f"valid_tag{tag}", COMPUTE_BUDGET_PROGRAM,
+           [acct(a, 10)], refs((0, True, False)),
+           bytes([tag]) + u32(100_000),
+           modified=[acct(a, 10)])
+    # short payload and unknown tag fail
+    fx(fam, "short", COMPUTE_BUDGET_PROGRAM,
+       [acct(a, 10)], refs((0, True, False)), bytes([2]), result=1)
+    fx(fam, "empty", COMPUTE_BUDGET_PROGRAM,
+       [acct(a, 10)], refs((0, True, False)), b"", result=1)
+    fx(fam, "unknown_tag", COMPUTE_BUDGET_PROGRAM,
+       [acct(a, 10)], refs((0, True, False)),
+       bytes([4]) + u32(1), result=1)
+
+
+def main():
+    for fam in ("system2", "stake", "vote", "alt", "budget"):
+        shutil.rmtree(os.path.join(ROOT, fam), ignore_errors=True)
+    gen_system()
+    gen_stake()
+    gen_vote()
+    gen_alt()
+    gen_budget()
+    print(f"{count} fixtures written")
+
+
+if __name__ == "__main__":
+    main()
