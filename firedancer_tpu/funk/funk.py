@@ -112,11 +112,10 @@ class Funk:
             )
             for sib in [s for s in siblings if s != step]:
                 self.txn_cancel(sib)
-            for key, val in t.recs.items():
-                if val is _TOMBSTONE:
-                    self._root.pop(key, None)
-                else:
-                    self._root[key] = val
+            self._root_merge(
+                [(key, None if val is _TOMBSTONE else val)
+                 for key, val in t.recs.items()]
+            )
             # step's children become children of root
             for child in t.children:
                 self._txns[child].parent = None
@@ -130,7 +129,7 @@ class Funk:
     def rec_insert(self, xid: bytes | None, key: bytes, val: bytes) -> None:
         """Insert-or-modify `key` in txn `xid` (None = straight to root)."""
         if xid is None:
-            self._root[key] = bytes(val)
+            self._root_merge([(key, bytes(val))])
             return
         t = self._get(xid)
         if t.children:
@@ -142,7 +141,7 @@ class Funk:
         if xid is None:
             if key not in self._root:
                 raise FunkError(ERR_KEY, f"unknown key {key!r}")
-            del self._root[key]
+            self._root_merge([(key, None)])
             return
         t = self._get(xid)
         if t.children:
@@ -181,6 +180,17 @@ class Funk:
         return list(keys)
 
     # -- internals ----------------------------------------------------------
+
+    def _root_merge(self, items: list[tuple[bytes, bytes | None]]) -> None:
+        """Apply one atomic batch of root mutations (None value = delete).
+        The single funnel for all root writes — the persistence layer
+        (funk/persist.py) overrides it to journal the batch first."""
+        for key, val in items:
+            if val is None:
+                self._root.pop(key, None)
+            else:
+                self._root[key] = val
+
 
     def _get(self, xid: bytes) -> _Txn:
         t = self._txns.get(xid)

@@ -65,6 +65,14 @@ class NetConfig:
 
 
 @dataclass
+class LedgerConfig:
+    # empty = in-memory funk; a directory enables the write-ahead
+    # journal + snapshot persistence (funk/persist.py)
+    funk_dir: str = ""
+    blockstore_dir: str = ""
+
+
+@dataclass
 class LogConfig:
     path: str = ""
     level_stderr: str = "NOTICE"
@@ -79,6 +87,7 @@ class Config:
     poh: PohConfig = field(default_factory=PohConfig)
     shred: ShredConfig = field(default_factory=ShredConfig)
     net: NetConfig = field(default_factory=NetConfig)
+    ledger: LedgerConfig = field(default_factory=LedgerConfig)
     log: LogConfig = field(default_factory=LogConfig)
 
 

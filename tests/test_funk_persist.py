@@ -1,0 +1,134 @@
+"""PersistentFunk: journal recovery, torn-tail truncation, compaction,
+publish atomicity across restart."""
+
+import os
+import struct
+import zlib
+
+from firedancer_tpu.funk.persist import PersistentFunk, _FRAME_HDR, _MAGIC
+
+
+def reopen(d):
+    return PersistentFunk(str(d))
+
+
+def test_restart_replays_journal(tmp_path):
+    d = tmp_path / "db"
+    with PersistentFunk(str(d)) as f:
+        f.rec_insert(None, b"k1", b"v1")
+        f.rec_insert(None, b"k2", b"v2")
+        f.rec_remove(None, b"k1")
+    with reopen(d) as f:
+        assert f.rec_query(None, b"k1") is None
+        assert f.rec_query(None, b"k2") == b"v2"
+        assert f.recovered_frames == 3
+
+
+def test_publish_is_one_frame_and_survives(tmp_path):
+    d = tmp_path / "db"
+    with PersistentFunk(str(d)) as f:
+        f.rec_insert(None, b"base", b"0")
+        x = f.txn_prepare(None, b"x1")
+        f.rec_insert(x, b"a", b"1")
+        f.rec_insert(x, b"b", b"2")
+        f.rec_remove(x, b"base")
+        frames_before = f.recovered_frames  # 0 on first open
+        f.txn_publish(x)
+        assert frames_before == 0
+    with reopen(d) as f:
+        # the publish is 1 frame (plus the base insert)
+        assert f.recovered_frames == 2
+        assert f.rec_query(None, b"a") == b"1"
+        assert f.rec_query(None, b"b") == b"2"
+        assert f.rec_query(None, b"base") is None
+
+
+def test_torn_tail_truncated(tmp_path):
+    d = tmp_path / "db"
+    with PersistentFunk(str(d)) as f:
+        f.rec_insert(None, b"good", b"yes")
+    wal = os.path.join(str(d), "funk.wal")
+    with open(wal, "ab") as fh:
+        # half a frame: valid header, truncated payload
+        fh.write(_FRAME_HDR.pack(100, zlib.crc32(b"x")))
+        fh.write(b"partial")
+    with reopen(d) as f:
+        assert f.rec_query(None, b"good") == b"yes"
+        assert f.recovered_frames == 1
+    # tail was truncated: the journal ends exactly after the good frame
+    with reopen(d) as f:
+        assert f.recovered_frames == 1
+
+
+def test_corrupt_crc_stops_replay(tmp_path):
+    d = tmp_path / "db"
+    with PersistentFunk(str(d)) as f:
+        f.rec_insert(None, b"k1", b"v1")
+        f.rec_insert(None, b"k2", b"v2")
+    wal = os.path.join(str(d), "funk.wal")
+    blob = bytearray(open(wal, "rb").read())
+    blob[-1] ^= 0xFF  # corrupt the LAST frame's payload
+    open(wal, "wb").write(bytes(blob))
+    with reopen(d) as f:
+        assert f.rec_query(None, b"k1") == b"v1"
+        assert f.rec_query(None, b"k2") is None  # dropped with the bad frame
+
+
+def test_compaction_resets_journal_and_preserves_state(tmp_path):
+    d = tmp_path / "db"
+    with PersistentFunk(str(d), min_compact_bytes=2048) as f:
+        for i in range(200):
+            f.rec_insert(None, b"key%03d" % (i % 10), os.urandom(64))
+        # journal far exceeds 10 live keys x 64B -> compaction happened
+        assert os.path.getsize(os.path.join(str(d), "funk.wal")) < 64 * 200
+        assert os.path.exists(os.path.join(str(d), "funk.snap"))
+        live = {k: f.rec_query(None, k) for k in f.rec_keys(None)}
+        assert len(live) == 10
+    with reopen(d) as f:
+        for k, v in live.items():
+            assert f.rec_query(None, k) == v
+
+
+def test_explicit_compact_then_more_writes(tmp_path):
+    d = tmp_path / "db"
+    with PersistentFunk(str(d)) as f:
+        f.rec_insert(None, b"a", b"1")
+        f.compact()
+        f.rec_insert(None, b"b", b"2")
+    with reopen(d) as f:
+        assert f.rec_query(None, b"a") == b"1"
+        assert f.rec_query(None, b"b") == b"2"
+        assert f.recovered_frames == 1  # only the post-compact write
+
+
+def test_empty_dir_starts_clean(tmp_path):
+    with PersistentFunk(str(tmp_path / "fresh")) as f:
+        assert f.rec_cnt_root() == 0
+        assert f.recovered_frames == 0
+
+
+def test_fork_semantics_untouched(tmp_path):
+    """The fork tree still behaves exactly like in-memory Funk."""
+    with PersistentFunk(str(tmp_path / "db")) as f:
+        a = f.txn_prepare(None, b"a")
+        b = f.txn_prepare(a, b"b")
+        f.rec_insert(b, b"k", b"deep")
+        c = f.txn_prepare(None, b"c")  # competing fork
+        f.rec_insert(c, b"k", b"loser")
+        f.txn_publish(b)
+        assert f.rec_query(None, b"k") == b"deep"
+        assert f.txn_cnt() == 0  # competitor cancelled
+
+
+def test_funk_from_config(tmp_path):
+    from firedancer_tpu.funk.persist import funk_from_config
+    from firedancer_tpu.utils.config import Config
+
+    cfg = Config()
+    f = funk_from_config(cfg)
+    assert type(f).__name__ == "Funk"
+    cfg.ledger.funk_dir = str(tmp_path / "db")
+    with funk_from_config(cfg) as f2:
+        f2.rec_insert(None, b"k", b"v")
+    with funk_from_config(cfg) as f3:
+        assert f3.rec_query(None, b"k") == b"v"
