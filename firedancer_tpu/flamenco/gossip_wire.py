@@ -189,6 +189,149 @@ CRDS_FILTER = T.StructCodec(
 )
 
 
+
+# -- value hashing + bloom filters --------------------------------------------
+# A CrdsValue's identity in the pull protocol is the sha256 of its
+# serialized bytes.  Bloom bit positions use the FNV-1a-shaped fold the
+# protocol specifies (fd_gossip.c:802-810 documents the same rule); the
+# filter set partitions the hash space by the TOP mask_bits of the
+# hash's first 8 bytes read little-endian, one filter per partition
+# (fd_gossip.c:920, 1565-1570 — behavior mirrored, no code shared).
+
+BLOOM_NUM_BITS = 512 * 8  # bits per outgoing filter packet
+BLOOM_MAX_KEYS = 32
+BLOOM_MAX_PACKETS = 32
+
+
+def value_hash(value_bytes: bytes) -> bytes:
+    import hashlib
+
+    return hashlib.sha256(value_bytes).digest()
+
+
+def bloom_pos(hash32: bytes, key: int, nbits: int) -> int:
+    for b in hash32:
+        key ^= b
+        key = (key * 1099511628211) & ((1 << 64) - 1)
+    return key % nbits
+
+
+def _hash_u64(hash32: bytes) -> int:
+    return int.from_bytes(hash32[:8], "little")
+
+
+def build_filters(hashes: list[bytes], *, rng=None,
+                  num_bits: int = BLOOM_NUM_BITS) -> list[CrdsFilter]:
+    """Bloom-filter packets covering `hashes` (everything I already
+    hold).  Scales packets/keys like the protocol: ~n/packets items per
+    filter, k = (m/n) ln 2 keys, doubling packets until the false-pos
+    rate clears 0.1%."""
+    import math
+    import os as _os
+
+    rand = rng or (lambda: int.from_bytes(_os.urandom(8), "little"))
+    nitems = len(hashes)
+    nkeys, npackets, nmaskbits = 1, 1, 0
+    if nitems > 0:
+        while True:
+            n = nitems / npackets
+            m = float(num_bits)
+            nkeys = max(1, min(int((m / max(n, 1e-9)) * math.log(2)),
+                               BLOOM_MAX_KEYS))
+            if npackets == BLOOM_MAX_PACKETS:
+                break
+            e = (1.0 - math.exp(-nkeys * n / m)) ** nkeys
+            if e < 0.001:
+                break
+            nmaskbits += 1
+            npackets = 1 << nmaskbits
+    keys = [rand() & ((1 << 64) - 1) for _ in range(nkeys)]
+    words = num_bits // 64
+    bits = [[0] * words for _ in range(npackets)]
+    nset = [0] * npackets
+    for h in hashes:
+        idx = 0 if nmaskbits == 0 else _hash_u64(h) >> (64 - nmaskbits)
+        chunk = bits[idx]
+        for k in keys:
+            pos = bloom_pos(h, k, num_bits)
+            w, bit = pos >> 6, 1 << (pos & 63)
+            if not chunk[w] & bit:
+                chunk[w] |= bit
+                nset[idx] += 1
+    out = []
+    ones = ((1 << 64) - 1) >> nmaskbits if nmaskbits else (1 << 64) - 1
+    for i in range(npackets):
+        mask = (i << (64 - nmaskbits)) | ones if nmaskbits else ones
+        out.append(CrdsFilter(
+            bloom=(keys, bits[i], nset[i]), mask=mask,
+            mask_bits=nmaskbits,
+        ))
+    return out
+
+
+def filter_contains(filt: CrdsFilter, hash32: bytes) -> bool | None:
+    """True = the requester already holds this value; False = send it;
+    None = outside this filter's mask partition (skip)."""
+    keys, bits, _nset = filt.bloom
+    if filt.mask_bits:
+        ones = ((1 << 64) - 1) >> filt.mask_bits
+        if (_hash_u64(hash32) | ones) != filt.mask:
+            return None
+    if bits is None or not keys:
+        return False
+    nbits = len(bits) * 64
+    for k in keys:
+        pos = bloom_pos(hash32, k, nbits)
+        if not (bits[pos >> 6] >> (pos & 63)) & 1:
+            return False
+    return True
+
+
+# -- PruneMessage -------------------------------------------------------------
+# Protocol tag 3: PruneMsg(Pubkey, PruneData { pubkey, prunes Vec<Pubkey>,
+# signature, destination, wallclock }).  The signature covers the bincode
+# of (pubkey, prunes, destination, wallclock) — the serialized payload
+# minus the signature field (fd_gossip.c:1322-1329 verifies the same
+# region).  A verified prune from peer P for origins O tells the push
+# side: stop forwarding O's values to P.
+
+
+@dataclass
+class PruneData:
+    pubkey: bytes
+    prunes: list
+    signature: bytes
+    destination: bytes
+    wallclock: int
+
+    def signable(self) -> bytes:
+        return (T.Pubkey.encode(self.pubkey)
+                + T.Vec(T.Pubkey).encode(self.prunes)
+                + T.Pubkey.encode(self.destination)
+                + T.U64.encode(self.wallclock))
+
+    def verify(self) -> bool:
+        return ref.verify(self.signable(), self.signature, self.pubkey)
+
+
+PRUNE_DATA = T.StructCodec(
+    PruneData,
+    ("pubkey", T.Pubkey),
+    ("prunes", T.Vec(T.Pubkey, max_len=8192)),
+    ("signature", T.Signature),
+    ("destination", T.Pubkey),
+    ("wallclock", T.U64),
+)
+
+
+def prune_make(secret: bytes, prunes: list, destination: bytes,
+               wallclock: int) -> PruneData:
+    me = ref.public_key(secret)
+    pd = PruneData(me, list(prunes), bytes(64), destination, wallclock)
+    pd.signature = ref.sign(secret, pd.signable())
+    return pd
+
+
 # -- the Protocol enum --------------------------------------------------------
 
 
@@ -209,6 +352,7 @@ PROTOCOL = T.Enum(
     (0, "pull_request", _Pair(CRDS_FILTER, CRDS_VALUE)),
     (1, "pull_response", _Pair(T.Pubkey, T.Vec(CRDS_VALUE, max_len=4096))),
     (2, "push_message", _Pair(T.Pubkey, T.Vec(CRDS_VALUE, max_len=4096))),
+    (3, "prune_message", _Pair(T.Pubkey, PRUNE_DATA)),
     (4, "ping", PING),
     (5, "pong", PONG),
 )

@@ -13,6 +13,20 @@ them (exactly CRDS's rule).
 
 The rest of the framework — Turbine destination lists, repair peer
 selection — consumes the table view (`ContactInfo`), not the wire.
+
+Round-4 upgrades (mirroring fd_gossip.c's active-set/prune/bloom
+machinery, no code shared):
+
+  - PUSH goes to a bounded stake-weighted ACTIVE SET (refresh_active_set
+    samples pong-verified peers via the protocol's chacha wsample);
+    fresh upserts queue and propagate with push_round(), giving real
+    epidemic spread instead of manual record sends;
+  - PRUNE: a peer that keeps pushing me records I already have gets a
+    signed PruneMessage naming those origins; on receipt (signature +
+    destination checked) the push side stops forwarding the pruned
+    origins to that peer;
+  - PULL carries real bloom filters over everything I hold (mask-
+    partitioned packets); serving a pull sends only the misses.
 """
 
 from __future__ import annotations
@@ -79,11 +93,22 @@ class GossipNode:
         self.clock = clock or (lambda: int(time.time() * 1000))
         self.table: dict[bytes, ContactInfo] = {}
         self._signed: dict[bytes, gw.CrdsValue] = {}  # pubkey -> signed value
+        self._hash: dict[bytes, bytes] = {}  # pubkey -> sha256(value bytes)
         self._ping_tokens_by_addr: dict = {}  # peer addr -> pending token
         self.verified_peers: set[bytes] = set()  # pong-verified pubkeys
+        self.stakes: dict[bytes, int] = {}
+        # push state: peer pubkey -> (addr, pruned origin set)
+        self.active_set: dict[bytes, tuple[tuple, set[bytes]]] = {}
+        self.active_size = 6
+        self._need_push: list[bytes] = []  # origin pubkeys to propagate
+        # (pusher pubkey, addr) -> {origin: duplicate count} for pruning
+        self._dup_pushes: dict[tuple, dict[bytes, int]] = {}
+        self.prune_threshold = 3
         self.metrics = {"push_rx": 0, "pull_rx": 0, "rec_rejected": 0,
                         "rec_upserted": 0, "rec_stale": 0,
-                        "ping_rx": 0, "pong_rx": 0}
+                        "ping_rx": 0, "pong_rx": 0, "prune_rx": 0,
+                        "prune_tx": 0, "push_tx": 0, "push_dropped": 0,
+                        "pull_served": 0, "pull_skipped": 0}
 
     @property
     def addr(self):
@@ -123,12 +148,87 @@ class GossipNode:
             self.sock.sendto(frame, p)
 
     def pull(self, peer: tuple[str, int]) -> None:
-        """Ask a peer for its table (match-all filter; response arrives
-        via poll as PullResponse frames)."""
-        frame = gw.encode_message(
-            "pull_request", (gw.CrdsFilter(), self._self_value())
+        """Ask a peer for what I am MISSING: the request carries bloom
+        filters over every value I hold, so the peer sends only misses
+        (response arrives via poll as PullResponse frames)."""
+        me = self._self_value()
+        for filt in gw.build_filters(list(self._hash.values())):
+            frame = gw.encode_message("pull_request", (filt, me))
+            self.sock.sendto(frame, peer)
+
+    # -- stake-weighted push + prune --
+
+    def set_stakes(self, stakes: dict[bytes, int]) -> None:
+        self.stakes = dict(stakes)
+
+    def refresh_active_set(self, seed: bytes = b"") -> None:
+        """Rebuild the push active set: a stake-weighted sample of known
+        peers (pong-verified preferred), via the protocol's chacha
+        wsample.  Existing prune state survives for peers that stay."""
+        from firedancer_tpu.ops.chacha20 import ChaCha20Rng
+        from firedancer_tpu.protocol.wsample import WSample
+
+        candidates = [
+            info for pk, info in self.table.items()
+            if not self.verified_peers or pk in self.verified_peers
+            or pk in self.stakes
+        ]
+        if not candidates:
+            return
+        weights = [max(self.stakes.get(c.pubkey, 0), 1) for c in candidates]
+        rng = ChaCha20Rng((seed + self.pubkey + bytes(32))[:32])
+        picks = WSample(rng, weights).sample_and_remove_many(
+            min(self.active_size, len(candidates))
         )
-        self.sock.sendto(frame, peer)
+        chosen = {candidates[i].pubkey for i in picks}
+        new_set = {}
+        for c in candidates:
+            if c.pubkey not in chosen:
+                continue
+            addr = (socket.inet_ntoa(c.ip4.to_bytes(4, "big")),
+                    c.gossip_port)
+            prev = self.active_set.get(c.pubkey)
+            new_set[c.pubkey] = (addr, prev[1] if prev else set())
+        self.active_set = new_set
+
+    def push_round(self) -> None:
+        """Propagate queued fresh values (and my own record) to the
+        active set, honoring per-peer prune state."""
+        origins = {o for o in self._need_push if o in self._signed}
+        self._need_push.clear()
+        values_by_origin = {o: self._signed[o] for o in origins}
+        me = self._self_value()
+        for peer_pk, (addr, pruned) in self.active_set.items():
+            values = [me] + [
+                v for o, v in values_by_origin.items()
+                if o not in pruned and o != peer_pk
+            ]
+            dropped = (len(values_by_origin) + 1) - len(values)
+            if dropped:
+                self.metrics["push_dropped"] += dropped
+            frame = gw.encode_message("push_message", (self.pubkey, values))
+            if len(frame) <= 65536:
+                self.sock.sendto(frame, addr)
+                self.metrics["push_tx"] += 1
+
+    def _note_duplicate(self, pusher: bytes, src, origin: bytes) -> None:
+        """A peer pushed a record I already had: count it, and past the
+        threshold prune that origin at the pusher."""
+        if pusher == bytes(32) or origin == self.pubkey:
+            return
+        key = (pusher, src)
+        cnt = self._dup_pushes.setdefault(key, {})
+        cnt[origin] = cnt.get(origin, 0) + 1
+        ripe = [o for o, n in cnt.items() if n >= self.prune_threshold]
+        if not ripe:
+            return
+        for o in ripe:
+            del cnt[o]
+        pd = gw.prune_make(self._secret, ripe, pusher, self.clock())
+        self.sock.sendto(
+            gw.encode_message("prune_message", (self.pubkey, pd)), src
+        )
+        self.metrics["prune_tx"] += 1
 
     def ping(self, peer: tuple[str, int]) -> None:
         token = os.urandom(32)
@@ -152,23 +252,32 @@ class GossipNode:
             name, payload = msg
             if name == "push_message":
                 self.metrics["push_rx"] += 1
-                _from, values = payload
+                from_pk, values = payload
                 for v in values:
-                    self._upsert(v)
+                    if not self._upsert(v):
+                        self._note_duplicate(from_pk, src, v.pubkey)
             elif name == "pull_response":
                 _from, values = payload
                 for v in values:
                     self._upsert(v)
             elif name == "pull_request":
                 self.metrics["pull_rx"] += 1
-                _filter, caller = payload
+                filt, caller = payload
                 self._upsert(caller)
-                self._serve_pull(src)
+                self._serve_pull(src, filt)
             elif name == "ping":
                 self.metrics["ping_rx"] += 1
                 if gw.ping_verify(payload):
                     pong = gw.pong_make(self._secret, payload.token)
                     self.sock.sendto(gw.encode_message("pong", pong), src)
+            elif name == "prune_message":
+                self.metrics["prune_rx"] += 1
+                _from, pd = payload
+                if pd.destination != self.pubkey or not pd.verify():
+                    continue
+                st = self.active_set.get(pd.pubkey)
+                if st is not None:
+                    st[1].update(pd.prunes)
             elif name == "pong":
                 self.metrics["pong_rx"] += 1
                 token = self._ping_tokens_by_addr.get(src)
@@ -176,12 +285,22 @@ class GossipNode:
                     self.verified_peers.add(payload.from_)
                     del self._ping_tokens_by_addr[src]
 
-    def _serve_pull(self, src) -> None:
-        """Respond with my record + every cached signed record, chunked
-        under the datagram MTU (one giant datagram would EMSGSIZE).
-        Frames go through gossip_wire's codec — re-encoding a decoded
-        CrdsValue is byte-identical, so cached signatures survive."""
-        values = [self._self_value()] + list(self._signed.values())
+    def _serve_pull(self, src, filt: "gw.CrdsFilter | None" = None) -> None:
+        """Respond with what the caller is MISSING: my record + cached
+        signed records that miss the request's bloom filter (contained
+        or out-of-partition values are skipped), chunked under the
+        datagram MTU.  Frames go through gossip_wire's codec —
+        re-encoding a decoded CrdsValue is byte-identical, so cached
+        signatures survive."""
+        values = [self._self_value()]
+        for pk, v in self._signed.items():
+            if filt is not None:
+                c = gw.filter_contains(filt, self._hash[pk])
+                if c is True or c is None:
+                    self.metrics["pull_skipped"] += 1
+                    continue
+            values.append(v)
+            self.metrics["pull_served"] += 1
         per = max(1, MAX_DATAGRAM // max(len(gw.CRDS_VALUE.encode(values[0])), 1))
         for off in range(0, len(values), per):
             frame = gw.encode_message(
@@ -189,26 +308,31 @@ class GossipNode:
             )
             self.sock.sendto(frame, src)
 
-    def _upsert(self, value) -> None:
+    def _upsert(self, value) -> bool:
+        """Returns True when the record was FRESH (upserted); False for
+        stale/duplicate/rejected — the push path prunes on Falses."""
         if isinstance(value, (bytes, bytearray)):
             try:
                 value = gw.CRDS_VALUE.loads(bytes(value))
             except Exception:
                 self.metrics["rec_rejected"] += 1
-                return
+                return False
         if not value.verify():
             self.metrics["rec_rejected"] += 1
-            return
+            return False
         if value.pubkey == self.pubkey:
-            return  # my own record reflected back
+            return True  # my own record reflected back: not prunable
         info = ContactInfo.from_crds(value.data[1])
         cur = self.table.get(info.pubkey)
         if cur is not None and cur.wallclock >= info.wallclock:
             self.metrics["rec_stale"] += 1
-            return
+            return False
         self.table[info.pubkey] = info
         self._signed[info.pubkey] = value
+        self._hash[info.pubkey] = gw.value_hash(gw.CRDS_VALUE.encode(value))
+        self._need_push.append(info.pubkey)
         self.metrics["rec_upserted"] += 1
+        return True
 
     def peers(self) -> list[ContactInfo]:
         return list(self.table.values())

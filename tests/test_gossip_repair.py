@@ -216,3 +216,142 @@ def test_repair_highest_and_orphan(stored_set):
     finally:
         server.close()
         client.close()
+
+
+# -- round-4 gossip machinery: bloom pulls, prune, stake-weighted push --------
+
+
+def _mk_node(name, **kw):
+    import hashlib
+
+    from firedancer_tpu.runtime.gossip import GossipNode
+
+    return GossipNode(hashlib.sha256(b"gn:" + name).digest(), **kw)
+
+
+def _settle(nodes, rounds=20):
+    import time as _t
+
+    for _ in range(rounds):
+        for n in nodes:
+            n.poll()
+        _t.sleep(0.002)
+
+
+def test_bloom_pull_sends_only_misses():
+    """B holds records A already has plus new ones; A's filtered pull
+    must transfer the new ones while B skips what A holds."""
+    a, b = _mk_node(b"A"), _mk_node(b"B")
+    seeds = [_mk_node(b"peer%d" % i) for i in range(8)]
+    try:
+        # both learn peers 0-3; only B learns 4-7
+        for i, p in enumerate(seeds):
+            p.push([b.addr] if i >= 4 else [a.addr, b.addr])
+        _settle([a, b] + seeds)
+        assert len(a.table) == 4 and len(b.table) == 8
+        served_before = b.metrics["pull_served"]
+        a.pull(b.addr)
+        _settle([a, b])
+        assert len(a.table) == 9  # 8 peers + B itself
+        # B served the missing records, not A's whole view again
+        assert b.metrics["pull_skipped"] >= 4
+        assert b.metrics["pull_served"] - served_before <= 5
+    finally:
+        for n in [a, b] + seeds:
+            n.close()
+
+
+def test_duplicate_pushes_draw_prune_and_stop_forwarding():
+    origin = _mk_node(b"origin")
+    a, b = _mk_node(b"A"), _mk_node(b"B")
+    try:
+        # B knows A (needed to address pushes) and the origin's record
+        a.push([b.addr])
+        origin.push([b.addr])
+        _settle([a, b])
+        b.refresh_active_set()
+        assert a.pubkey in b.active_set
+        # B pushes the same origin record to A repeatedly -> A prunes
+        for _ in range(b.prune_threshold + 2):
+            b._need_push.append(origin.pubkey)
+            b.push_round()
+            _settle([a, b])
+        assert a.metrics["prune_tx"] >= 1
+        assert b.metrics["prune_rx"] >= 1
+        assert origin.pubkey in b.active_set[a.pubkey][1]
+        # next push round drops the pruned origin for A
+        before = b.metrics["push_dropped"]
+        b._need_push.append(origin.pubkey)
+        b.push_round()
+        assert b.metrics["push_dropped"] > before
+    finally:
+        for n in [origin, a, b]:
+            n.close()
+
+
+def test_forged_prune_ignored():
+    import hashlib
+
+    from firedancer_tpu.flamenco import gossip_wire as gw
+
+    a, b = _mk_node(b"A2"), _mk_node(b"B2")
+    victim = _mk_node(b"victim")
+    try:
+        a.push([b.addr])
+        victim.push([b.addr])
+        _settle([a, b])
+        b.refresh_active_set()
+        # mallory forges a prune "from A" without A's key
+        mal_secret = hashlib.sha256(b"mallory").digest()
+        pd = gw.prune_make(mal_secret, [victim.pubkey], b.pubkey, 1)
+        pd.pubkey = a.pubkey  # claim it is A's prune; signature now wrong
+        b.sock.sendto(
+            gw.encode_message("prune_message", (a.pubkey, pd)), b.addr
+        )
+        _settle([b])
+        assert b.metrics["prune_rx"] >= 1
+        assert not b.active_set.get(a.pubkey, (None, set()))[1]
+    finally:
+        for n in [a, b, victim]:
+            n.close()
+
+
+def test_stake_weighted_active_set():
+    """With a dominant-stake peer, the bounded active set must include
+    it (wsample puts the heavy key in essentially every sample)."""
+    hub = _mk_node(b"hub")
+    peers = [_mk_node(b"w%d" % i) for i in range(10)]
+    try:
+        for p in peers:
+            p.push([hub.addr])
+        _settle([hub] + peers)
+        assert len(hub.table) == 10
+        whale = peers[7].pubkey
+        hub.set_stakes({whale: 10_000_000, **{
+            p.pubkey: 1 for p in peers if p.pubkey != whale
+        }})
+        hub.active_size = 3
+        hub.refresh_active_set(seed=b"round1")
+        assert len(hub.active_set) == 3
+        assert whale in hub.active_set
+    finally:
+        for n in [hub] + peers:
+            n.close()
+
+
+def test_push_round_propagates_fresh_records():
+    """Epidemic spread: origin -> A -> (push_round) -> B without B ever
+    talking to the origin."""
+    origin, a, b = _mk_node(b"o3"), _mk_node(b"A3"), _mk_node(b"B3")
+    try:
+        b.push([a.addr])  # A knows B
+        _settle([a, b])
+        a.refresh_active_set()
+        origin.push([a.addr])  # A learns origin's record...
+        _settle([a, b])
+        a.push_round()  # ...and propagates it
+        _settle([a, b])
+        assert origin.pubkey in b.table
+    finally:
+        for n in [origin, a, b]:
+            n.close()
