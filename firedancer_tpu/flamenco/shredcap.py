@@ -1,0 +1,65 @@
+"""shredcap: record and replay shred streams.
+
+Capability parity with the reference's shred-capture subsystem
+(/root/reference/src/flamenco/shredcap/ — records the incoming shred
+stream to disk so a validator's ingest can be reproduced offline; no
+code shared).  Container: pcap with UDP encapsulation (utils/pcap.py),
+so standard tooling opens captures and the pipeline's pcap replay
+harness drives them; shreds ride as the UDP payloads on a marker port.
+
+Use: a `ShredCapWriter` tees the store/retransmit path's shreds to disk;
+`replay` later drives them into any sink — a FecResolver, the store
+stage, or a blockstore — at full speed or paced by the recorded
+timestamps.  `replay_into_resolver` is the common offline-ingest recipe:
+captured shreds -> FEC set completion -> recovered entry batches.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from firedancer_tpu.utils import pcap
+
+SHREDCAP_PORT = 8001  # marker dst port inside the capture
+
+
+class ShredCapWriter:
+    def __init__(self, path: str):
+        self._w = pcap.PcapWriter(path)
+        self.count = 0
+
+    def write(self, shred: bytes, ts: float | None = None) -> None:
+        self._w.write_udp(shred, dst=("127.0.0.1", SHREDCAP_PORT), ts=ts)
+        self.count += 1
+
+    def close(self) -> None:
+        self._w.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def replay(path: str, sink: Callable[[bytes], None], *,
+           pace: bool = False) -> int:
+    """Feed every captured shred to `sink(shred_bytes)`; returns count."""
+    return pcap.replay_udp(
+        path, lambda payload, _src: sink(payload),
+        pace=pace, port=SHREDCAP_PORT,
+    )
+
+
+def replay_into_resolver(path: str, resolver) -> list:
+    """Offline ingest: drive a capture through a FecResolver; returns the
+    completed FEC sets in arrival order."""
+    done = []
+
+    def sink(buf: bytes) -> None:
+        s = resolver.add_shred(buf)
+        if s is not None:
+            done.append(s)
+
+    replay(path, sink)
+    return done

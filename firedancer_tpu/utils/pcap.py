@@ -1,0 +1,166 @@
+"""pcap capture read/write + UDP encapsulation.
+
+Capability parity with the reference's packet-capture utilities
+(/root/reference/src/util/net/fd_pcap.h reader/writer over
+Ethernet/IP4/UDP header structs in src/util/net/; no code shared): the
+classic libpcap container (magic 0xa1b2c3d4, LINKTYPE_ETHERNET),
+microsecond timestamps, and helpers that wrap/unwrap UDP datagrams in
+Ethernet+IPv4+UDP headers so captures interoperate with tcpdump/wireshark
+and the reference's own pcap tooling.
+
+The replay harness position (SURVEY §4.7/§6: synthetic or captured
+traffic driven through the pipeline without a live cluster) is
+`replay_udp`, which iterates a capture and hands each UDP payload to a
+sink callback at full speed or paced by the recorded timestamps.
+"""
+
+from __future__ import annotations
+
+import struct
+import time
+from typing import Callable, Iterator
+
+PCAP_MAGIC = 0xA1B2C3D4
+LINKTYPE_ETHERNET = 1
+
+_GLOBAL = struct.Struct("<IHHiIII")
+_PKT = struct.Struct("<IIII")
+_ETH = struct.Struct("!6s6sH")
+_IP4 = struct.Struct("!BBHHHBBH4s4s")
+_UDP = struct.Struct("!HHHH")
+
+ETH_IP4 = 0x0800
+PROTO_UDP = 17
+
+
+class PcapError(ValueError):
+    pass
+
+
+class PcapWriter:
+    def __init__(self, path: str, *, snaplen: int = 65535):
+        self._f = open(path, "wb")
+        self._f.write(_GLOBAL.pack(PCAP_MAGIC, 2, 4, 0, 0, snaplen,
+                                   LINKTYPE_ETHERNET))
+
+    def write_pkt(self, frame: bytes, ts: float | None = None) -> None:
+        t = time.time() if ts is None else ts
+        sec = int(t)
+        usec = int((t - sec) * 1e6)
+        self._f.write(_PKT.pack(sec, usec, len(frame), len(frame)))
+        self._f.write(frame)
+
+    def write_udp(self, payload: bytes, *, src=("127.0.0.1", 1),
+                  dst=("127.0.0.1", 2), ts: float | None = None) -> None:
+        self.write_pkt(encap_udp(payload, src=src, dst=dst), ts=ts)
+
+    def close(self) -> None:
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def _ip_cksum(hdr: bytes) -> int:
+    s = 0
+    for i in range(0, len(hdr), 2):
+        s += (hdr[i] << 8) | hdr[i + 1]
+    while s >> 16:
+        s = (s & 0xFFFF) + (s >> 16)
+    return (~s) & 0xFFFF
+
+
+def _aton(host: str) -> bytes:
+    import socket
+
+    return socket.inet_aton(host)
+
+
+def encap_udp(payload: bytes, *, src=("127.0.0.1", 1),
+              dst=("127.0.0.1", 2)) -> bytes:
+    """Ethernet+IPv4+UDP frame around `payload` (checksummed IP header,
+    zero UDP checksum — legal for IPv4)."""
+    udp = _UDP.pack(src[1], dst[1], 8 + len(payload), 0)
+    total = 20 + 8 + len(payload)
+    ip_wo = _IP4.pack(0x45, 0, total, 0, 0, 64, PROTO_UDP, 0,
+                      _aton(src[0]), _aton(dst[0]))
+    ip = ip_wo[:10] + _ip_cksum(ip_wo).to_bytes(2, "big") + ip_wo[12:]
+    eth = _ETH.pack(b"\x02" + bytes(5), b"\x02" + bytes(4) + b"\x01",
+                    ETH_IP4)
+    return eth + ip + udp + payload
+
+
+def decap_udp(frame: bytes):
+    """-> (payload, (src_ip, src_port), (dst_ip, dst_port)) or None for
+    non-UDP or truncated frames."""
+    import socket
+
+    if len(frame) < 14 + 20 + 8:
+        return None
+    _dst, _src, etype = _ETH.unpack_from(frame, 0)
+    if etype != ETH_IP4:
+        return None
+    vihl = frame[14]
+    if vihl >> 4 != 4:
+        return None
+    ihl = (vihl & 0xF) * 4
+    fields = _IP4.unpack_from(frame[:14 + 20], 14)
+    if fields[6] != PROTO_UDP or len(frame) < 14 + ihl + 8:
+        return None
+    sport, dport, ulen, _ck = _UDP.unpack_from(frame, 14 + ihl)
+    payload = frame[14 + ihl + 8 : 14 + ihl + max(ulen, 8)]
+    return (payload,
+            (socket.inet_ntoa(fields[8]), sport),
+            (socket.inet_ntoa(fields[9]), dport))
+
+
+def iter_pcap(path: str) -> Iterator[tuple[float, bytes]]:
+    """Yield (timestamp, frame) for every packet; rejects bad magic,
+    tolerates a truncated final record (captures get cut mid-write)."""
+    with open(path, "rb") as f:
+        head = f.read(_GLOBAL.size)
+        if len(head) < _GLOBAL.size:
+            raise PcapError("truncated pcap global header")
+        magic = struct.unpack_from("<I", head)[0]
+        if magic == PCAP_MAGIC:
+            endian = "<"
+        elif magic == struct.unpack(">I", struct.pack("<I", PCAP_MAGIC))[0]:
+            endian = ">"
+        else:
+            raise PcapError(f"bad pcap magic 0x{magic:08x}")
+        pkt = struct.Struct(endian + "IIII")
+        while True:
+            ph = f.read(pkt.size)
+            if len(ph) < pkt.size:
+                return
+            sec, usec, incl, _orig = pkt.unpack(ph)
+            data = f.read(incl)
+            if len(data) < incl:
+                return
+            yield sec + usec / 1e6, data
+
+
+def replay_udp(path: str, sink: Callable[[bytes, tuple], None], *,
+               pace: bool = False, port: int | None = None) -> int:
+    """Drive every captured UDP payload into `sink(payload, src_addr)`;
+    `port` filters on the destination port (a capture interleaves
+    gossip/repair/tpu traffic; each stage replays its own port).  pace=True
+    sleeps to reproduce recorded inter-packet gaps.  Returns #delivered."""
+    n = 0
+    prev_ts = None
+    for ts, frame in iter_pcap(path):
+        d = decap_udp(frame)
+        if d is None:
+            continue
+        payload, src, dst = d
+        if port is not None and dst[1] != port:
+            continue
+        if pace and prev_ts is not None and ts > prev_ts:
+            time.sleep(min(ts - prev_ts, 1.0))
+        prev_ts = ts
+        sink(payload, src)
+        n += 1
+    return n
