@@ -191,6 +191,41 @@ def cmd_config(args) -> int:
     return 0
 
 
+def cmd_monitor(args) -> int:
+    """fdctl monitor parity: attach to a live run's cnc regions and
+    redraw per-stage rates in place (runtime/monitor.py)."""
+    from firedancer_tpu.runtime.monitor import MonitorSession
+
+    try:
+        ses = MonitorSession.attach(args.descriptor)
+    except (RuntimeError, OSError) as e:
+        print(f"monitor: {e}", file=sys.stderr)
+        return 1
+    try:
+        ses.run(interval_s=args.interval, iterations=args.iterations)
+    finally:
+        ses.close()
+    return 0
+
+
+def cmd_ready(args) -> int:
+    """fdctl ready parity: exit 0 once every stage is RUN, 1 on timeout
+    or failure."""
+    from firedancer_tpu.runtime.monitor import MonitorSession
+
+    try:
+        ses = MonitorSession.attach(args.descriptor)
+    except (RuntimeError, OSError) as e:
+        print(f"ready: {e}", file=sys.stderr)
+        return 1
+    try:
+        ok = ses.wait_ready(timeout_s=args.timeout)
+    finally:
+        ses.close()
+    print("ready" if ok else "not ready")
+    return 0 if ok else 1
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="firedancer_tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -222,6 +257,21 @@ def main(argv=None) -> int:
     snapp = sub.add_parser("snapshot", help="inspect a snapshot archive")
     snapp.add_argument("path")
 
+    monp = sub.add_parser(
+        "monitor", help="live per-stage TUI of a running topology"
+    )
+    monp.add_argument("--descriptor", default=None,
+                      help="run descriptor path (default: newest live run)")
+    monp.add_argument("--interval", type=float, default=1.0)
+    monp.add_argument("--iterations", type=int, default=None,
+                      help="sample count (default: until ^C)")
+
+    readyp = sub.add_parser(
+        "ready", help="block until every stage heartbeats in RUN"
+    )
+    readyp.add_argument("--descriptor", default=None)
+    readyp.add_argument("--timeout", type=float, default=60.0)
+
     sub.add_parser("version", help="print version")
 
     args = p.parse_args(argv)
@@ -237,6 +287,10 @@ def main(argv=None) -> int:
         return cmd_genesis(args)
     if args.cmd == "snapshot":
         return cmd_snapshot(args)
+    if args.cmd == "monitor":
+        return cmd_monitor(args)
+    if args.cmd == "ready":
+        return cmd_ready(args)
     if args.cmd == "version":
         print(f"firedancer_tpu {__version__}")
         return 0

@@ -1,0 +1,209 @@
+"""Operator surface: live monitor TUI + readiness gate.
+
+Parity targets (no code shared): `fdctl monitor` — a terminal sampler
+of every tile's cnc heartbeat, in/out sequence deltas and diag counters
+(/root/reference/src/app/fdctl/monitor/monitor.c, workflow in
+book/guide/tuning.md:212-238) — and `fdctl ready`, which blocks until
+every tile heartbeats in the RUN state
+(/root/reference/src/app/fdctl/ready.c).
+
+A running topology advertises itself in a run descriptor
+(`/tmp/fdtpu_run_<uid>.json`, written by runtime/topo.launch): stage
+names + cnc shared-memory names.  `attach()` joins those cnc regions
+READ-ONLY from any process, so the monitor and `ready` work exactly
+like the reference's: against a live validator they did not start.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from dataclasses import dataclass
+from multiprocessing import shared_memory
+
+import numpy as np
+
+from firedancer_tpu.tango import rings
+from firedancer_tpu.tango.rings import CNC_SIG_FAIL, CNC_SIG_RUN, Cnc
+
+RUN_DIR = os.environ.get("FDTPU_RUN_DIR", "/tmp")
+_SIG_NAMES = {0: "BOOT", 1: "RUN", 2: "HALT", 3: "FAIL"}
+
+
+def descriptor_path(uid: str) -> str:
+    return os.path.join(RUN_DIR, f"fdtpu_run_{uid}.json")
+
+
+def write_descriptor(uid: str, stages: dict[str, str]) -> str:
+    """stages: name -> cnc shm name.  Returns the descriptor path."""
+    path = descriptor_path(uid)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"uid": uid, "pid": os.getpid(), "stages": stages}, f)
+    os.replace(tmp, path)
+    return path
+
+
+def remove_descriptor(uid: str) -> None:
+    try:
+        os.remove(descriptor_path(uid))
+    except OSError:
+        pass
+
+
+def list_runs() -> list[str]:
+    """Run descriptor paths, newest first, dead owners pruned."""
+    out = []
+    for fn in os.listdir(RUN_DIR):
+        if not (fn.startswith("fdtpu_run_") and fn.endswith(".json")):
+            continue
+        p = os.path.join(RUN_DIR, fn)
+        try:
+            with open(p) as f:
+                d = json.load(f)
+            os.kill(int(d["pid"]), 0)  # owner alive?
+        except (OSError, ValueError, KeyError):
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+            continue
+        out.append(p)
+    return sorted(out, key=os.path.getmtime, reverse=True)
+
+
+@dataclass
+class _Joined:
+    name: str
+    cnc: Cnc
+    shm: shared_memory.SharedMemory
+
+
+class MonitorSession:
+    """Read-only join of a running topology's cnc regions."""
+
+    def __init__(self, joined: list[_Joined]):
+        self._joined = joined
+
+    @classmethod
+    def attach(cls, descriptor: str | None = None) -> "MonitorSession":
+        """Join the given descriptor (path), or the newest live run."""
+        if descriptor is None:
+            runs = list_runs()
+            if not runs:
+                raise RuntimeError("no running fdtpu topology found")
+            descriptor = runs[0]
+        with open(descriptor) as f:
+            d = json.load(f)
+        joined = []
+        for name, shm_name in d["stages"].items():
+            s = shared_memory.SharedMemory(name=shm_name)
+            cnc = Cnc(np.frombuffer(s.buf, dtype=rings.U64,
+                                    count=2 + Cnc.NDIAG))
+            joined.append(_Joined(name, cnc, s))
+        return cls(joined)
+
+    def close(self) -> None:
+        for j in self._joined:
+            # drop the numpy view before closing the mapping
+            j.cnc.cells = np.zeros(2 + Cnc.NDIAG, dtype=rings.U64)
+            j.shm.close()
+
+    # -- sampling -----------------------------------------------------------
+
+    def sample(self) -> list[dict]:
+        from firedancer_tpu.runtime.stage import Stage
+
+        now = time.monotonic_ns()
+        out = []
+        for j in self._joined:
+            hb = j.cnc.last_heartbeat
+            out.append({
+                "stage": j.name,
+                "signal": j.cnc.signal,
+                "heartbeat_age_ms": (now - hb) / 1e6 if hb else None,
+                "in": j.cnc.diag(Stage.DIAG_FRAGS_IN),
+                "out": j.cnc.diag(Stage.DIAG_FRAGS_OUT),
+                "overrun": j.cnc.diag(Stage.DIAG_OVERRUN),
+                "backpressure": j.cnc.diag(Stage.DIAG_BACKPRESSURE),
+                "iters": j.cnc.diag(Stage.DIAG_ITER),
+            })
+        return out
+
+    def all_running(self, *, max_heartbeat_age_s: float = 5.0) -> bool:
+        for r in self.sample():
+            if r["signal"] != CNC_SIG_RUN:
+                return False
+            age = r["heartbeat_age_ms"]
+            if age is None or age > max_heartbeat_age_s * 1e3:
+                return False
+        return True
+
+    def any_failed(self) -> bool:
+        return any(r["signal"] == CNC_SIG_FAIL for r in self.sample())
+
+    def wait_ready(self, *, timeout_s: float = 60.0,
+                   poll_s: float = 0.05) -> bool:
+        """Block until every stage heartbeats in RUN (the `ready`
+        command).  False on timeout or any FAIL."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self.any_failed():
+                return False
+            if self.all_running():
+                return True
+            time.sleep(poll_s)
+        return False
+
+    # -- rendering ----------------------------------------------------------
+
+    @staticmethod
+    def render(rows: list[dict], prev: list[dict] | None,
+               dt_s: float) -> str:
+        hdr = (f"{'stage':<14}{'state':<6}{'hb_ms':>8}{'in/s':>11}"
+               f"{'out/s':>11}{'busy%':>7}{'ovrn':>7}{'bkp':>7}")
+        lines = [hdr, "-" * len(hdr)]
+        prev_by = {r["stage"]: r for r in prev or []}
+        for r in rows:
+            p = prev_by.get(r["stage"])
+            in_rate = out_rate = busy = float("nan")
+            if p and dt_s > 0:
+                in_rate = (r["in"] - p["in"]) / dt_s
+                out_rate = (r["out"] - p["out"]) / dt_s
+                diters = r["iters"] - p["iters"]
+                dwork = r["in"] - p["in"] + r["out"] - p["out"]
+                busy = 100.0 * dwork / diters if diters > 0 else 0.0
+            hb = (f"{r['heartbeat_age_ms']:.1f}"
+                  if r["heartbeat_age_ms"] is not None else "-")
+            fmt = lambda v: "-" if v != v else f"{v:,.0f}"  # noqa: E731
+            lines.append(
+                f"{r['stage']:<14}{_SIG_NAMES.get(r['signal'], '?'):<6}"
+                f"{hb:>8}{fmt(in_rate):>11}{fmt(out_rate):>11}"
+                f"{fmt(busy):>7}{r['overrun']:>7}{r['backpressure']:>7}"
+            )
+        return "\n".join(lines)
+
+    def run(self, *, interval_s: float = 1.0, iterations: int | None = None,
+            out=sys.stdout) -> None:
+        """The live TUI loop: redraw-in-place sampler (^C exits)."""
+        prev, prev_t = None, time.monotonic()
+        first = True
+        n = 0
+        try:
+            while iterations is None or n < iterations:
+                rows = self.sample()
+                now = time.monotonic()
+                text = self.render(rows, prev, now - prev_t)
+                if not first:
+                    # move cursor up over the previous frame
+                    out.write(f"\x1b[{text.count(chr(10)) + 1}A")
+                out.write("\x1b[J" + text + "\n")
+                out.flush()
+                prev, prev_t, first = rows, now, False
+                n += 1
+                if iterations is None or n < iterations:
+                    time.sleep(interval_s)
+        except KeyboardInterrupt:
+            pass

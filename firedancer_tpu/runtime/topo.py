@@ -160,6 +160,9 @@ class TopologyHandle:
             p.join(timeout=5)
 
     def close(self) -> None:
+        from firedancer_tpu.runtime import monitor as mon
+
+        mon.remove_descriptor(self.uid)
         self.kill()
         for link in self.links.values():
             link.close()
@@ -247,4 +250,11 @@ def launch(topo: Topology) -> TopologyHandle:
         p.start()
         procs[spec.name] = p
         _log.info(f"spawned stage '{spec.name}' pid={p.pid}")
+    # advertise the run so `fdtpu monitor` / `fdtpu ready` can attach
+    # from another process (runtime/monitor.py)
+    from firedancer_tpu.runtime import monitor as mon
+
+    mon.write_descriptor(
+        uid, {s.name: _cnc_shm_name(uid, s.name) for s in topo.stages}
+    )
     return TopologyHandle(topo, uid, links, cncs, cnc_shms, procs)
