@@ -52,11 +52,18 @@ class LinkSpec:
 
 @dataclass
 class StageSpec:
-    """builder(links: dict[str, ShmLink], cnc: Cnc) -> Stage; runs in child."""
+    """builder(links: dict[str, ShmLink], cnc: Cnc) -> Stage; runs in child.
+
+    sandbox: optional utils/sandbox.enter kwargs — the per-stage jail
+    (rlimits/namespaces/seccomp) applied in the CHILD after the builder
+    ran (privileged_init analog: open sockets/keys first, then drop) and
+    before the run loop, mirroring fd_topo_run's boot ordering
+    (src/disco/topo/fd_topo_run.c:50-190)."""
 
     name: str
     builder: object
     kwargs: dict = field(default_factory=dict)
+    sandbox: dict | None = None
 
 
 @dataclass
@@ -69,8 +76,9 @@ class Topology:
         self.links.append(spec)
         return spec
 
-    def stage(self, name: str, builder, **kwargs) -> "StageSpec":
-        spec = StageSpec(name, builder, kwargs)
+    def stage(self, name: str, builder, *, sandbox: dict | None = None,
+              **kwargs) -> "StageSpec":
+        spec = StageSpec(name, builder, kwargs, sandbox)
         self.stages.append(spec)
         return spec
 
@@ -86,6 +94,10 @@ def _stage_main(spec: StageSpec, link_names: dict, uid: str) -> None:
     links = {n: shm.ShmLink.join(sn) for n, sn in link_names.items()}
     try:
         stage = spec.builder(links, cnc, **spec.kwargs)
+        if spec.sandbox is not None:
+            from firedancer_tpu.utils import sandbox as sb
+
+            sb.enter(**spec.sandbox)
         stage.run()
     except Exception:
         cnc.signal = CNC_SIG_FAIL
