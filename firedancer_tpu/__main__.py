@@ -272,6 +272,18 @@ def main(argv=None) -> int:
     readyp.add_argument("--descriptor", default=None)
     readyp.add_argument("--timeout", type=float, default=60.0)
 
+    ledp = sub.add_parser("ledger", help="ingest/inspect/replay a ledger")
+    ledp.add_argument("action", choices=["show", "ingest", "replay"])
+    ledp.add_argument("store", help="blockstore directory")
+    ledp.add_argument("capture", nargs="?", default=None,
+                      help="shredcap/pcap for ingest")
+    ledp.add_argument("--funk-dir", default=None)
+    ledp.add_argument("--poh-seed", default=None, help="hex 32B")
+    ledp.add_argument("--record", default=None,
+                      help="write per-slot bank hashes to this JSON")
+    ledp.add_argument("--check", default=None,
+                      help="diff bank hashes against this JSON")
+
     sub.add_parser("version", help="print version")
 
     args = p.parse_args(argv)
@@ -287,6 +299,10 @@ def main(argv=None) -> int:
         return cmd_genesis(args)
     if args.cmd == "snapshot":
         return cmd_snapshot(args)
+    if args.cmd == "ledger":
+        from firedancer_tpu import ledger as _ledger
+
+        return _ledger.main(args)
     if args.cmd == "monitor":
         return cmd_monitor(args)
     if args.cmd == "ready":

@@ -1,0 +1,181 @@
+"""Ledger tool: ingest shred captures, inspect, and replay a stored
+ledger through the full runtime.
+
+Capability parity with the reference's ledger binary
+(/root/reference/src/app/ledger/ — drives the runtime against stored
+ledgers, verifying bank hashes slot by slot; its test harness
+run_ledger_test.sh compares replay results against recorded expected
+hashes; no code shared).  The TPU build's ledger lives in the
+file-backed Blockstore (flamenco/blockstore.py); captures come from
+shredcap (flamenco/shredcap.py).
+
+Replay walks complete slots in ascending order: deshred the slot's
+entry batch, re-verify the PoH chain, execute every transaction on a
+funk fork, chain bank hashes parent-to-child.  `--record` writes the
+per-slot bank hashes to a JSON expectation file; `--check` replays and
+diffs against one — the regression harness shape.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+
+from firedancer_tpu.flamenco.blockstore import Blockstore
+from firedancer_tpu.funk.funk import Funk
+
+
+@dataclass
+class SlotReplay:
+    slot: int
+    ok: bool
+    bank_hash: bytes | None
+    txn_cnt: int
+    err: str = ""
+
+
+def ingest_capture(store_dir: str, capture: str) -> int:
+    """shredcap/pcap -> blockstore; returns shreds inserted."""
+    from firedancer_tpu.flamenco import shredcap
+
+    bs = Blockstore(store_dir)
+    try:
+        n = shredcap.replay(capture, bs.insert_shred)
+    finally:
+        bs.close()
+    return n
+
+
+def inventory(store_dir: str) -> list[dict]:
+    bs = Blockstore(store_dir)
+    try:
+        out = []
+        for slot in bs.slots():
+            m = bs.slot_meta(slot)
+            out.append({
+                "slot": slot,
+                "complete": m.complete,
+                "received": len(m.received),
+                "last_index": m.last_index,
+                "missing": m.missing()[:8],
+            })
+        return out
+    finally:
+        bs.close()
+
+
+def replay_ledger(
+    store_dir: str,
+    *,
+    funk: Funk | None = None,
+    poh_seed: bytes = b"\x00" * 32,
+    publish: bool = True,
+    stop_on_error: bool = False,
+) -> list[SlotReplay]:
+    """Replay every complete slot ascending; chain PoH seed and bank
+    hash across slots (the replay-tile walk, offline)."""
+    from firedancer_tpu.flamenco import runtime as rt
+    from firedancer_tpu.runtime.poh_stage import parse_entry
+    from firedancer_tpu.runtime.shred_stage import deshred_entry_batch
+
+    funk = funk if funk is not None else Funk()
+    bs = Blockstore(store_dir)
+    results: list[SlotReplay] = []
+    parent_hash = b"\x00" * 32
+    seed = poh_seed
+    try:
+        for slot in bs.slots():
+            if not bs.is_complete(slot):
+                continue
+            try:
+                frames = deshred_entry_batch(bs.entry_batch_bytes(slot))
+                entries = [parse_entry(f) for f in frames]
+            except Exception as e:
+                results.append(SlotReplay(slot, False, None, 0,
+                                          f"deshred: {type(e).__name__}"))
+                if stop_on_error:
+                    break
+                continue
+            n_txn = sum(len(t) for _n, _h, t in entries)
+            res = rt.replay_block(
+                funk, slot=slot, entries=entries, poh_seed=seed,
+                parent_bank_hash=parent_hash, publish=publish,
+            )
+            if res is None:
+                results.append(SlotReplay(slot, False, None, n_txn,
+                                          "poh chain invalid"))
+                if stop_on_error:
+                    break
+                continue
+            results.append(SlotReplay(slot, True, res.bank_hash, n_txn))
+            parent_hash = res.bank_hash
+            if entries:
+                seed = entries[-1][1]
+    finally:
+        bs.close()
+    return results
+
+
+def record_expectations(results: list[SlotReplay], path: str) -> None:
+    with open(path, "w") as f:
+        json.dump(
+            {str(r.slot): r.bank_hash.hex() for r in results if r.ok}, f,
+            indent=0, sort_keys=True,
+        )
+
+
+def check_expectations(results: list[SlotReplay], path: str) -> list[str]:
+    """-> list of mismatch descriptions (empty = pass)."""
+    with open(path) as f:
+        want = json.load(f)
+    got = {str(r.slot): r.bank_hash.hex() if r.ok else f"ERR:{r.err}"
+           for r in results}
+    problems = []
+    for slot, h in sorted(want.items(), key=lambda kv: int(kv[0])):
+        g = got.get(slot)
+        if g is None:
+            problems.append(f"slot {slot}: missing from replay")
+        elif g != h:
+            problems.append(f"slot {slot}: bank hash {g[:16]} != {h[:16]}")
+    return problems
+
+
+def main(args) -> int:
+    if args.action == "show":
+        for row in inventory(args.store):
+            state = "complete" if row["complete"] else (
+                f"missing {row['missing']}")
+            print(f"slot {row['slot']}: {row['received']} shreds, "
+                  f"last_index={row['last_index']}, {state}")
+        return 0
+    if args.action == "ingest":
+        n = ingest_capture(args.store, args.capture)
+        print(f"ingested {n} shreds into {args.store}")
+        return 0
+    if args.action == "replay":
+        funk = None
+        if args.funk_dir:
+            from firedancer_tpu.funk.persist import PersistentFunk
+
+            funk = PersistentFunk(args.funk_dir)
+        seed = bytes.fromhex(args.poh_seed) if args.poh_seed else b"\x00" * 32
+        results = replay_ledger(
+            args.store, funk=funk, poh_seed=seed,
+            stop_on_error=args.check is not None,
+        )
+        for r in results:
+            tag = r.bank_hash.hex()[:16] if r.ok else f"FAILED ({r.err})"
+            print(f"slot {r.slot}: {r.txn_cnt} txns, bank hash {tag}")
+        if args.record:
+            record_expectations(results, args.record)
+            print(f"recorded {sum(r.ok for r in results)} expectations")
+        rc = 0 if all(r.ok for r in results) else 1
+        if args.check:
+            problems = check_expectations(results, args.check)
+            for pr in problems:
+                print(f"MISMATCH {pr}")
+            rc = rc or (1 if problems else 0)
+            if not problems:
+                print(f"all {len(results)} slots match expectations")
+        return rc
+    return 2
