@@ -9,6 +9,10 @@ Served methods:
     getLatestBlockhash    isBlockhashValid getSignatureStatuses
     sendTransaction       getEpochInfo     getFirstAvailableBlock
     getMinimumBalanceForRentExemption      requestAirdrop (faucet-gated)
+    getIdentity           getSlotLeader    getLeaderSchedule
+    getVoteAccounts       getEpochSchedule getClusterNodes
+    getMultipleAccounts   getFeeForMessage minimumLedgerSlot
+    getHighestSnapshotSlot                 getRecentPerformanceSamples
 
 — the minimum a bench observer (fd_bencho polls getTransactionCount),
 a wallet (sendTransaction/getLatestBlockhash/getSignatureStatuses/
@@ -39,6 +43,12 @@ class PipelineView:
     submit_fn: object = None      # callable(txn bytes) -> bool
     genesis_hash_fn: object = None
     faucet_fn: object = None      # callable(pubkey, lamports) -> bool
+    identity_fn: object = None    # callable() -> 32B identity pubkey
+    leaders: object = None        # protocol/wsample.EpochLeaders
+    gossip: object = None         # runtime/gossip.GossipNode
+    stakes_fn: object = None      # callable() -> {vote pubkey: stake}
+    snapshot_slot_fn: object = None
+    perf_samples: list = None     # [{"slot","numTransactions","samplePeriodSecs"}]
 
     def transaction_count(self) -> int:
         if self.pipeline is None:
@@ -90,6 +100,11 @@ class PipelineView:
         if self.submit_fn is None:
             return False
         return bool(self.submit_fn(txn))
+
+    def slot_leader(self, slot: int):
+        if self.leaders is None:
+            return None
+        return self.leaders.leader_for_slot(slot)
 
 
 class RpcServer:
@@ -301,6 +316,106 @@ class RpcServer:
                 if not sig:
                     return err(-32603, "airdrop failed")
                 return ok(b58_encode(sig))
+            if method == "getIdentity":
+                fn = self.view.identity_fn
+                return ok({"identity":
+                           b58_encode32(fn() if fn else bytes(32))})
+            if method == "getSlotLeader":
+                slot = dec(int, params[0]) if params else self.view.slot()
+                leader = self.view.slot_leader(slot)
+                return ok(b58_encode32(leader) if leader else None)
+            if method == "getLeaderSchedule":
+                ld = self.view.leaders
+                if ld is None:
+                    return ok(None)
+                sched: dict[str, list[int]] = {}
+                for i in range(ld.slot_cnt):
+                    who = ld.leader_for_slot(ld.slot0 + i)
+                    if who is not None:
+                        sched.setdefault(b58_encode32(who), []).append(i)
+                return ok(sched)
+            if method == "getVoteAccounts":
+                stakes = self.view.stakes_fn() if self.view.stakes_fn \
+                    else {}
+                cur = [{
+                    "votePubkey": b58_encode32(pk),
+                    "activatedStake": int(st),
+                    "commission": 0,
+                    "epochVoteAccount": True,
+                } for pk, st in sorted(stakes.items())]
+                return ok({"current": cur, "delinquent": []})
+            if method == "getEpochSchedule":
+                from firedancer_tpu.flamenco import types as T
+
+                s = T.EpochSchedule()
+                return ok({
+                    "slotsPerEpoch": s.slots_per_epoch,
+                    "leaderScheduleSlotOffset":
+                        s.leader_schedule_slot_offset,
+                    "warmup": bool(s.warmup),
+                    "firstNormalEpoch": s.first_normal_epoch,
+                    "firstNormalSlot": s.first_normal_slot,
+                })
+            if method == "getClusterNodes":
+                g = self.view.gossip
+                if g is None:
+                    return ok([])
+                import socket as _socket
+
+                nodes = []
+                for ci in g.peers():
+                    ip = _socket.inet_ntoa(ci.ip4.to_bytes(4, "big"))
+                    nodes.append({
+                        "pubkey": b58_encode32(ci.pubkey),
+                        "gossip": f"{ip}:{ci.gossip_port}",
+                        "tvu": f"{ip}:{ci.tvu_port}",
+                        "shredVersion": ci.shred_version,
+                    })
+                return ok(nodes)
+            if method == "getMultipleAccounts":
+                if not params or not isinstance(params[0], list):
+                    return err(-32602, "missing pubkeys param")
+                vals = []
+                for s in params[0][:100]:
+                    lam, owner, ex, data = self.view.account(
+                        dec(b58_decode32, s)
+                    )
+                    if lam == 0 and not data and owner == bytes(32):
+                        vals.append(None)
+                    else:
+                        vals.append({
+                            "lamports": lam,
+                            "owner": b58_encode32(owner),
+                            "executable": bool(ex),
+                            "rentEpoch": 0,
+                            "data": [base64.b64encode(
+                                bytes(data)).decode(), "base64"],
+                        })
+                return ctx(vals)
+            if method == "getFeeForMessage":
+                # fee = signatures x LAMPORTS_PER_SIGNATURE (the model the
+                # bank charges, flamenco/runtime.py)
+                from firedancer_tpu.flamenco.runtime import (
+                    LAMPORTS_PER_SIGNATURE,
+                )
+
+                if not params:
+                    return err(-32602, "missing message param")
+                msg = dec(base64.b64decode, params[0])
+                nsig = msg[0] if msg else 0
+                return ctx(int(nsig) * LAMPORTS_PER_SIGNATURE)
+            if method == "minimumLedgerSlot":
+                return ok(self.view.first_available_block())
+            if method == "getHighestSnapshotSlot":
+                fn = self.view.snapshot_slot_fn
+                full = fn() if fn else None
+                if full is None:
+                    return err(-32008, "no snapshot")
+                return ok({"full": full, "incremental": None})
+            if method == "getRecentPerformanceSamples":
+                samples = self.view.perf_samples or []
+                n = dec(int, params[0]) if params else len(samples)
+                return ok(list(samples)[-n:][::-1])
             return err(-32601, f"method not found: {method}")
         except _ParamError as e:
             # malformed client parameters (bad base58/base64, wrong types)

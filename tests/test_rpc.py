@@ -168,3 +168,69 @@ def test_send_transaction(wallet_server):
                    [base64.b64encode(b"junk").decode(),
                     {"encoding": "base64"}])
     assert bad["error"]["code"] == -32602
+
+
+def test_wallet_surface_extended(server):
+    """Round-4 methods: identity/leaders/votes/cluster/epoch/fees."""
+    import base64
+
+    from firedancer_tpu.flamenco.runtime import LAMPORTS_PER_SIGNATURE
+    from firedancer_tpu.protocol.base58 import b58_encode32
+    from firedancer_tpu.protocol import wsample
+
+    srv, pub = server
+    me = hashlib.sha256(b"identity").digest()
+    voter = hashlib.sha256(b"voter").digest()
+    srv.view.identity_fn = lambda: me
+    srv.view.stakes_fn = lambda: {voter: 7_000}
+    srv.view.leaders = wsample.epoch_leaders(
+        0, 0, 64, [(voter, 7_000)]
+    )
+    srv.view.snapshot_slot_fn = lambda: 40
+    srv.view.perf_samples = [
+        {"slot": 41, "numTransactions": 100, "samplePeriodSecs": 60},
+        {"slot": 42, "numTransactions": 120, "samplePeriodSecs": 60},
+    ]
+
+    assert rpc_call(srv.addr, "getIdentity")["result"]["identity"] == \
+        b58_encode32(me)
+    assert rpc_call(srv.addr, "getSlotLeader", [3])["result"] == \
+        b58_encode32(voter)
+    sched = rpc_call(srv.addr, "getLeaderSchedule")["result"]
+    assert sched == {b58_encode32(voter): list(range(64))}
+    votes = rpc_call(srv.addr, "getVoteAccounts")["result"]
+    assert votes["current"][0]["votePubkey"] == b58_encode32(voter)
+    assert votes["current"][0]["activatedStake"] == 7_000
+    es = rpc_call(srv.addr, "getEpochSchedule")["result"]
+    assert es["slotsPerEpoch"] == 432_000
+    assert rpc_call(srv.addr, "getClusterNodes")["result"] == []
+    multi = rpc_call(srv.addr, "getMultipleAccounts",
+                     [[b58_encode(pub), b58_encode(bytes(32))]])
+    vals = multi["result"]["value"]
+    assert vals[0]["lamports"] == 123_456 and vals[1] is None
+    msg = bytes([2]) + bytes(40)  # 2-signature message prefix
+    fee = rpc_call(srv.addr, "getFeeForMessage",
+                   [base64.b64encode(msg).decode()])
+    assert fee["result"]["value"] == 2 * LAMPORTS_PER_SIGNATURE
+    assert rpc_call(srv.addr, "minimumLedgerSlot")["result"] == 0
+    snap = rpc_call(srv.addr, "getHighestSnapshotSlot")["result"]
+    assert snap["full"] == 40
+    perf = rpc_call(srv.addr, "getRecentPerformanceSamples", [1])["result"]
+    assert perf == [{"slot": 42, "numTransactions": 120,
+                     "samplePeriodSecs": 60}]
+
+
+def test_server_fault_is_not_invalid_params(server):
+    """A handler bug must report -32603 (retryable server fault), not
+    -32602 — only the parameter-decode boundary maps to -32602."""
+    srv, _pub = server
+
+    def boom():
+        raise KeyError("internal state bug")
+
+    srv.view.identity_fn = boom
+    r = rpc_call(srv.addr, "getIdentity")
+    assert r["error"]["code"] == -32603
+    # while an actually-bad param still maps to -32602
+    r2 = rpc_call(srv.addr, "getBalance", ["!!not-base58!!"])
+    assert r2["error"]["code"] == -32602
