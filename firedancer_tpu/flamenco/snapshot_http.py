@@ -1,0 +1,188 @@
+"""Snapshot distribution over HTTP: serve + download + boot.
+
+Capability parity with the reference's snapshot HTTP client
+(/root/reference/src/flamenco/snapshot/fd_snapshot_http.c — a validator
+bootstraps by downloading `/snapshot.tar.bz2`-style archives from a
+serving peer, then restoring; no code shared).  Both sides run on this
+framework's own HTTP stack (protocol/http.py):
+
+  - `SnapshotServer` exposes a snapshot directory at the cluster's
+    conventional paths: `/snapshot.tar.zst` (latest full),
+    `/incremental-snapshot.tar.zst` (latest incremental for that full),
+    plus exact `/snapshot-<slot>.tar.zst` names;
+  - `download_snapshot` is a streaming GET client with a size cap and
+    atomic rename-into-place — a half-downloaded archive can never be
+    mistaken for a snapshot;
+  - `bootstrap_from_peer` = download full (+ incremental when offered)
+    then `snapshot_load` into a funk: the cold-boot recipe.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import socket
+
+from firedancer_tpu.protocol import http as H
+
+MAX_SNAPSHOT_BYTES = 64 << 30
+_NAME_RE = re.compile(r"^(incremental-)?snapshot-(\d+)(?:-(\d+))?\.tar\.zst$")
+
+
+class SnapshotHttpError(RuntimeError):
+    pass
+
+
+def _scan(directory: str):
+    """-> (fulls {slot: name}, incrementals {base_slot: (slot, name)})."""
+    fulls: dict[int, str] = {}
+    incs: dict[int, tuple[int, str]] = {}
+    for fn in os.listdir(directory):
+        m = _NAME_RE.match(fn)
+        if not m:
+            continue
+        if m.group(1):  # incremental-snapshot-<base>-<slot>.tar.zst
+            base, slot = int(m.group(2)), int(m.group(3) or 0)
+            if base not in incs or slot > incs[base][0]:
+                incs[base] = (slot, fn)
+        else:
+            fulls[int(m.group(2))] = fn
+    return fulls, incs
+
+
+def full_snapshot_name(slot: int) -> str:
+    return f"snapshot-{slot}.tar.zst"
+
+
+def incremental_snapshot_name(base_slot: int, slot: int) -> str:
+    return f"incremental-snapshot-{base_slot}-{slot}.tar.zst"
+
+
+class SnapshotServer:
+    """Serves a directory of snapshot archives (the peer a bootstrapping
+    validator downloads from)."""
+
+    def __init__(self, directory: str, *, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.directory = directory
+
+        def handler(req, _body):
+            if req.method != "GET":
+                return H.build_response(405, b"GET only\n")
+            name = req.path.lstrip("/")
+            fulls, incs = _scan(self.directory)
+            if name == "snapshot.tar.zst":
+                if not fulls:
+                    return H.build_response(404, b"no snapshot\n")
+                name = fulls[max(fulls)]
+            elif name == "incremental-snapshot.tar.zst":
+                if not fulls or max(fulls) not in incs:
+                    return H.build_response(404, b"no incremental\n")
+                name = incs[max(fulls)][1]
+            if "/" in name or not _NAME_RE.match(name):
+                return H.build_response(404, b"not found\n")
+            path = os.path.join(self.directory, name)
+            if not os.path.exists(path):
+                return H.build_response(404, b"not found\n")
+            with open(path, "rb") as f:
+                blob = f.read()
+            return H.build_response(
+                200, blob, content_type="application/octet-stream",
+            )
+
+        self._srv = H.MiniServer(handler, host=host, port=port)
+
+    @property
+    def addr(self):
+        return self._srv.addr
+
+    def close(self):
+        self._srv.close()
+
+
+def download_snapshot(addr: tuple[str, int], name: str, dest_dir: str, *,
+                      max_bytes: int = MAX_SNAPSHOT_BYTES,
+                      timeout_s: float = 60.0) -> str:
+    """GET /<name> from a peer into dest_dir; returns the final path.
+    Streams to `<name>.partial` and renames only on a complete body, so
+    an interrupted transfer never poses as a snapshot."""
+    os.makedirs(dest_dir, exist_ok=True)
+    sock = socket.create_connection(addr, timeout=timeout_s)
+    try:
+        sock.sendall(
+            f"GET /{name} HTTP/1.1\r\nHost: {addr[0]}\r\n"
+            f"Connection: close\r\n\r\n".encode()
+        )
+        buf = b""
+        resp = None
+        while resp is None or resp is H.NEED_MORE:
+            chunk = sock.recv(65536)
+            if not chunk:
+                raise SnapshotHttpError("peer closed during headers")
+            buf += chunk
+            if len(buf) > 1 << 20:
+                raise SnapshotHttpError("oversized response head")
+            resp = H.parse_response(buf)
+        if resp.status != 200:
+            raise SnapshotHttpError(f"peer answered {resp.status}")
+        need = H.body_length(resp)
+        if not isinstance(need, int) or need <= 0:
+            raise SnapshotHttpError("peer sent no content length")
+        if need > max_bytes:
+            raise SnapshotHttpError(f"snapshot {need} bytes > cap")
+        final = os.path.join(dest_dir, name.rsplit("/", 1)[-1])
+        tmp = final + ".partial"
+        got = len(buf) - resp.head_len
+        with open(tmp, "wb") as f:
+            f.write(buf[resp.head_len:])
+            while got < need:
+                chunk = sock.recv(65536)
+                if not chunk:
+                    raise SnapshotHttpError(
+                        f"peer closed at {got}/{need} bytes"
+                    )
+                got += len(chunk)
+                if got > need:
+                    raise SnapshotHttpError("peer sent excess bytes")
+                f.write(chunk)
+        os.replace(tmp, final)
+        return final
+    finally:
+        sock.close()
+        try:
+            os.remove(os.path.join(dest_dir,
+                                   name.rsplit("/", 1)[-1] + ".partial"))
+        except OSError:
+            pass
+
+
+def bootstrap_from_peer(addr: tuple[str, int], dest_dir: str, *,
+                        funk=None):
+    """Cold boot: download the peer's latest full snapshot (+ its
+    incremental when offered), restore into a funk.  Returns
+    (funk, manifest, paths)."""
+    from firedancer_tpu.flamenco.snapshot import snapshot_load, snapshot_read
+
+    full = download_snapshot(addr, "snapshot.tar.zst", dest_dir)
+    man, _ = snapshot_read(full)
+    # rename to the slot-exact convention for re-serving
+    exact = os.path.join(dest_dir, full_snapshot_name(man.slot))
+    os.replace(full, exact)
+    inc_path = None
+    try:
+        inc = download_snapshot(addr, "incremental-snapshot.tar.zst",
+                                dest_dir)
+        inc_man, _ = snapshot_read(inc)
+        if inc_man.base_slot == man.slot:
+            inc_path = os.path.join(
+                dest_dir,
+                incremental_snapshot_name(inc_man.base_slot, inc_man.slot),
+            )
+            os.replace(inc, inc_path)
+        else:
+            os.remove(inc)
+    except SnapshotHttpError:
+        pass  # peer offers no incremental: full alone is a valid boot
+    funk, manifest = snapshot_load(exact, funk,
+                                   incremental_path=inc_path)
+    return funk, manifest, (exact, inc_path)

@@ -1,0 +1,116 @@
+"""Snapshot-over-HTTP: serve a snapshot dir, download with integrity
+guards, cold-boot a funk from a peer (full + incremental)."""
+
+import hashlib
+import os
+
+import pytest
+
+from firedancer_tpu.flamenco import snapshot as snap
+from firedancer_tpu.flamenco import snapshot_http as sh
+from firedancer_tpu.flamenco.runtime import acct_build
+from firedancer_tpu.funk.funk import Funk
+
+
+def _funk_with(n, salt=b"a"):
+    f = Funk()
+    for i in range(n):
+        f.rec_insert(None, hashlib.sha256(salt + bytes([i])).digest(),
+                     acct_build(1000 + i))
+    return f
+
+
+@pytest.fixture
+def peer(tmp_path):
+    d = str(tmp_path / "snaps")
+    os.makedirs(d)
+    funk = _funk_with(20)
+    snap.snapshot_write(
+        funk, os.path.join(d, sh.full_snapshot_name(100)), slot=100
+    )
+    # incremental on top: one account changed, one added, one removed
+    base = {k: funk.rec_query(None, k) for k in funk.rec_keys(None)}
+    keys = sorted(base)
+    funk.rec_insert(None, keys[0], acct_build(9_999))
+    funk.rec_insert(None, hashlib.sha256(b"new").digest(), acct_build(5))
+    funk.rec_remove(None, keys[1])
+    snap.snapshot_write(
+        funk, os.path.join(d, sh.incremental_snapshot_name(100, 140)),
+        slot=140, base=base, base_slot=100,
+    )
+    srv = sh.SnapshotServer(d)
+    yield srv, funk
+    srv.close()
+
+
+def test_bootstrap_from_peer(peer, tmp_path):
+    srv, src_funk = peer
+    dest = str(tmp_path / "boot")
+    funk, man, (full, inc) = sh.bootstrap_from_peer(srv.addr, dest)
+    assert man.slot == 140 and man.base_slot == 100
+    assert inc is not None and os.path.exists(inc)
+    # booted state == the peer's live state, removals included
+    want = {k: src_funk.rec_query(None, k)
+            for k in src_funk.rec_keys(None)}
+    got = {k: funk.rec_query(None, k) for k in funk.rec_keys(None)}
+    assert got == want
+
+
+def test_download_rejects_truncated(peer, tmp_path):
+    """A peer that closes mid-body must not leave a usable file."""
+    import socket
+    import threading
+
+    srv, _ = peer
+    # a fake peer that sends a bigger Content-Length than it delivers
+    lsock = socket.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(1)
+
+    def fake_peer():
+        conn, _a = lsock.accept()
+        conn.recv(4096)
+        conn.sendall(b"HTTP/1.1 200 OK\r\nContent-Length: 1000\r\n\r\n")
+        conn.sendall(b"x" * 100)
+        conn.close()
+
+    t = threading.Thread(target=fake_peer, daemon=True)
+    t.start()
+    dest = str(tmp_path / "dl")
+    with pytest.raises(sh.SnapshotHttpError, match="closed at"):
+        sh.download_snapshot(lsock.getsockname(), "snapshot.tar.zst", dest)
+    t.join()
+    lsock.close()
+    assert os.listdir(dest) == []  # no partial file survives
+
+
+def test_server_path_rules(peer, tmp_path):
+    srv, _ = peer
+    # traversal / junk names 404
+    for bad in ("../etc/passwd", "snapshot.tar.gz", "x.tar.zst"):
+        with pytest.raises(sh.SnapshotHttpError, match="404"):
+            sh.download_snapshot(srv.addr, bad, str(tmp_path / "x"))
+    # exact name works
+    p = sh.download_snapshot(srv.addr, sh.full_snapshot_name(100),
+                             str(tmp_path / "y"))
+    man, accounts = snap.snapshot_read(p)
+    assert man.slot == 100 and len(accounts) == 20
+
+
+def test_full_only_peer(tmp_path):
+    """A peer without incrementals still boots (404 tolerated)."""
+    d = str(tmp_path / "only_full")
+    os.makedirs(d)
+    funk = _funk_with(5, salt=b"b")
+    snap.snapshot_write(
+        funk, os.path.join(d, sh.full_snapshot_name(7)), slot=7
+    )
+    srv = sh.SnapshotServer(d)
+    try:
+        got, man, (_full, inc) = sh.bootstrap_from_peer(
+            srv.addr, str(tmp_path / "boot2")
+        )
+        assert man.slot == 7 and inc is None
+        assert len(got.rec_keys(None)) == 5
+    finally:
+        srv.close()
