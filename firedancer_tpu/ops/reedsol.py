@@ -92,17 +92,29 @@ def recover(shreds, present, d: int):
     than d survive and the extras are inconsistent with the rebuild from the
     first d — a present-but-corrupted shred (rebuilt is None).
     """
-    shreds = jnp.asarray(shreds, dtype=jnp.uint8)
+    shreds_np = np.asarray(shreds, dtype=np.uint8)
     present = np.asarray(present, dtype=bool)
-    n, _ = shreds.shape
+    n, sz = shreds_np.shape
     if int(present.sum()) < d:
         return ERR_PARTIAL, None
     bbits, present_idx = _recover_bits(d, n, tuple(bool(x) for x in present))
-    surv = shreds[jnp.asarray(present_idx)]
-    out = g2.pack_bits(g2._gf2_matmul_bits(bbits, g2.unpack_bits(surv)))
+    # pad the bit-matmul to power-of-two row/col buckets: zero rows and
+    # columns are inert in GF(2) linear algebra, so the result is exact
+    # while the compile count stays O(log^2) instead of one program per
+    # (n, d) FEC shape — a streaming resolver sees a fresh shape per set
+    # and was recompiling on nearly every recover
+    n_pad = 1 << max(3, (n - 1).bit_length())
+    d_pad = 1 << max(3, (d - 1).bit_length())
+    bb = np.zeros((8 * n_pad, 8 * d_pad), dtype=np.asarray(bbits).dtype)
+    bb[: 8 * n, : 8 * d] = np.asarray(bbits)
+    surv = np.zeros((d_pad, sz), dtype=np.uint8)
+    surv[:d] = shreds_np[present_idx]
+    out = g2.pack_bits(
+        g2._gf2_matmul_bits(jnp.asarray(bb), g2.unpack_bits(jnp.asarray(surv)))
+    )[:n]
     extra = np.flatnonzero(present)[d:]
     if len(extra) and not np.array_equal(
-        np.asarray(out)[extra], np.asarray(shreds)[extra]
+        np.asarray(out)[extra], shreds_np[extra]
     ):
         return ERR_CORRUPT, None
     return SUCCESS, out

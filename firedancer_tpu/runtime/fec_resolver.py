@@ -156,6 +156,24 @@ class FecResolver:
         if have < d:
             return None
         slot, fec_set_idx = key
+        # no-loss fast path: every present shred already proved membership
+        # against the set's signed root in add_shred, so a full set needs
+        # neither the RS solve nor a tree rebuild (profiled: recover was
+        # ~40% of the leader store path, and every call on a fresh shape
+        # recompiles)
+        if len(data_have) == d and len(ctx.code) == p:
+            del self._sets[key]
+            self._done[key] = None
+            while len(self._done) > self.done_depth:
+                self._done.popitem(last=False)
+            self.metrics["sets_completed"] += 1
+            return FecSet(
+                data_shreds=[bytes(data_have[pos]) for pos in range(d)],
+                parity_shreds=[bytes(ctx.code[c]) for c in range(p)],
+                merkle_root=ctx.merkle_root,
+                slot=slot,
+                fec_set_idx=fec_set_idx,
+            )
         elt_sz = fs.code_payload_sz(ctx.depth)
         n = d + p
         shreds = np.zeros((n, elt_sz), dtype=np.uint8)

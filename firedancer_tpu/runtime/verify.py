@@ -301,6 +301,33 @@ class VerifyStage(Stage):
             self.metrics.inc("comb_elems", n)
         acc.clear()
 
+    def _assemble(self, acc: _Acc):
+        """elems -> device-shaped uint8 byte-row arrays.
+
+        Batched assembly: one bytes-join + frombuffer + reshape per
+        field instead of 4 numpy calls per ELEMENT — the per-element
+        loop measured ~100K elems/s on one core (scripts/
+        perf_verify_host.py), an order of magnitude under the 2M/s
+        target; the joined form is C-speed throughout.
+        """
+        n = len(acc.elems)
+        b = self.batch
+        mm = self.max_msg_len
+        msgs, sigs, pks = zip(*acc.elems)
+        ln = np.zeros((b,), dtype=np.int32)
+        ln[:n] = np.fromiter(map(len, msgs), dtype=np.int32, count=n)
+        msg = np.zeros((b, mm), dtype=np.uint8)
+        joined = b"".join(m if len(m) == mm else m.ljust(mm, b"\x00")
+                          for m in msgs)
+        msg[:n] = np.frombuffer(joined, dtype=np.uint8).reshape(n, mm)
+        sig = np.zeros((b, 64), dtype=np.uint8)
+        sig[:n] = np.frombuffer(b"".join(sigs), dtype=np.uint8
+                                ).reshape(n, 64)
+        pk = np.zeros((b, 32), dtype=np.uint8)
+        pk[:n] = np.frombuffer(b"".join(pks), dtype=np.uint8).reshape(n, 32)
+        # kernels take byte ROWS (len, batch): transpose the packed form
+        return msg.T, ln, sig.T, pk.T
+
     def _dispatch(self, acc: _Acc, cached: bool):
         import jax.numpy as jnp
 
@@ -310,15 +337,7 @@ class VerifyStage(Stage):
         b = self.batch
         # uint8 byte rows: 4x less host->device transfer; the kernel
         # widens to int32 on-device
-        msg = np.zeros((self.max_msg_len, b), dtype=np.uint8)
-        ln = np.zeros((b,), dtype=np.int32)
-        sig = np.zeros((64, b), dtype=np.uint8)
-        pk = np.zeros((32, b), dtype=np.uint8)
-        for i, (m, s, p) in enumerate(acc.elems):
-            msg[: len(m), i] = np.frombuffer(m, dtype=np.uint8)
-            ln[i] = len(m)
-            sig[:, i] = np.frombuffer(s, dtype=np.uint8)
-            pk[:, i] = np.frombuffer(p, dtype=np.uint8)
+        msg, ln, sig, pk = self._assemble(acc)
         if cached:
             slots = np.zeros((b,), dtype=np.int32)
             slots[:n] = acc.slots
