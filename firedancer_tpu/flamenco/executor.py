@@ -130,6 +130,9 @@ class TxnCtx:
     # upgradeable programs resolved at txn load: program key ->
     # (elf bytes, deploy slot); populated by the runtime's account loader
     program_elfs: dict = field(default_factory=dict)
+    # every top-level instruction's data, in txn order — the precompile
+    # programs' offset tables reference across instructions
+    instr_datas: list = field(default_factory=list)
 
     def charge(self, n: int) -> None:
         self.cu_used += n
@@ -152,8 +155,13 @@ class Executor:
 
         from firedancer_tpu.flamenco import bpf_loader
 
+        from firedancer_tpu.flamenco import config_program, precompiles
+
         self.native = {
             SYSTEM_PROGRAM: programs.system_program,
+            config_program.CONFIG_PROGRAM: config_program.config_program,
+            precompiles.ED25519_PROGRAM: precompiles.ed25519_program,
+            precompiles.SECP256K1_PROGRAM: precompiles.secp256k1_program,
             VOTE_PROGRAM: programs.vote_program,
             stake.STAKE_PROGRAM: stake.stake_program,
             alt.ALT_PROGRAM: alt.alt_program,

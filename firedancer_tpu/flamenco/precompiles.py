@@ -1,0 +1,103 @@
+"""Precompile programs: ed25519 and secp256k1 signature-verification
+instructions.
+
+Capability parity with the reference's precompiles
+(/root/reference/src/flamenco/runtime/fd_precompiles.c; no code
+shared): these programs carry OFFSET TABLES, not payloads — each entry
+points at a signature, a pubkey, and a message that live in some
+instruction's data within the SAME transaction (instruction index
+u16::MAX = "this instruction").  The program verifies every entry and
+fails the whole instruction on the first bad signature; programs
+downstream in the txn can then trust the verified relationship.
+
+Wire format (Agave layout):
+
+  ed25519:   u8 count | u8 pad | count x {
+                 sig_off u16, sig_ix u16, pk_off u16, pk_ix u16,
+                 msg_off u16, msg_sz u16, msg_ix u16 }
+  secp256k1: u8 count | count x {
+                 sig_off u16, sig_ix u8, eth_off u16, eth_ix u8,
+                 msg_off u16, msg_sz u16, msg_ix u8 }
+             where sig is 64B+recovery_id and eth is the 20-byte
+             keccak address the recovered key must hash to.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from firedancer_tpu.flamenco.programs import AcctError
+from firedancer_tpu.protocol.base58 import b58_decode32
+
+ED25519_PROGRAM = b58_decode32("Ed25519SigVerify111111111111111111111111111")
+SECP256K1_PROGRAM = b58_decode32("KeccakSecp256k11111111111111111111111111111")
+
+_SELF_IX16 = 0xFFFF
+_SELF_IX8 = 0xFF
+
+_ED_ENTRY = struct.Struct("<HHHHHHH")
+_SECP_ENTRY = struct.Struct("<HBHBHHB")
+
+
+def _ref(ctx, data: bytes, ix: int, off: int, ln: int,
+         self_marker: int) -> bytes:
+    """Fetch `ln` bytes at `off` of instruction `ix`'s data (the current
+    instruction's own data for the self marker)."""
+    if ix == self_marker:
+        src = data
+    else:
+        if ix >= len(ctx.instr_datas):
+            raise AcctError(f"precompile references instruction {ix}")
+        src = ctx.instr_datas[ix]
+    if off + ln > len(src):
+        raise AcctError("precompile offset out of range")
+    return bytes(src[off : off + ln])
+
+
+def ed25519_program(executor, ctx, program_id, iaccts, data, *,
+                    pda_signers):
+    from firedancer_tpu.ops.ref import ed25519_ref as ref
+
+    if len(data) < 2:
+        raise AcctError("short ed25519 precompile data")
+    count = data[0]
+    need = 2 + count * _ED_ENTRY.size
+    if len(data) < need:
+        raise AcctError("truncated ed25519 precompile entries")
+    for k in range(count):
+        (sig_off, sig_ix, pk_off, pk_ix, msg_off, msg_sz, msg_ix) = (
+            _ED_ENTRY.unpack_from(data, 2 + k * _ED_ENTRY.size)
+        )
+        sig = _ref(ctx, data, sig_ix, sig_off, 64, _SELF_IX16)
+        pk = _ref(ctx, data, pk_ix, pk_off, 32, _SELF_IX16)
+        msg = _ref(ctx, data, msg_ix, msg_off, msg_sz, _SELF_IX16)
+        if not ref.verify(msg, sig, pk):
+            raise AcctError(f"ed25519 precompile entry {k} invalid")
+
+
+def secp256k1_program(executor, ctx, program_id, iaccts, data, *,
+                      pda_signers):
+    from firedancer_tpu.ops import keccak256, secp256k1 as secp
+
+    if len(data) < 1:
+        raise AcctError("short secp256k1 precompile data")
+    count = data[0]
+    need = 1 + count * _SECP_ENTRY.size
+    if len(data) < need:
+        raise AcctError("truncated secp256k1 precompile entries")
+    for k in range(count):
+        (sig_off, sig_ix, eth_off, eth_ix, msg_off, msg_sz, msg_ix) = (
+            _SECP_ENTRY.unpack_from(data, 1 + k * _SECP_ENTRY.size)
+        )
+        sig_rec = _ref(ctx, data, sig_ix, sig_off, 65, _SELF_IX8)
+        eth = _ref(ctx, data, eth_ix, eth_off, 20, _SELF_IX8)
+        msg = _ref(ctx, data, msg_ix, msg_off, msg_sz, _SELF_IX8)
+        digest = keccak256.keccak256_host(msg)
+        try:
+            pub = secp.recover(digest, sig_rec[64], sig_rec[:64])
+        except secp.RecoverError as e:
+            raise AcctError(
+                f"secp256k1 precompile entry {k}: {e}"
+            ) from e
+        if keccak256.keccak256_host(pub)[-20:] != eth:
+            raise AcctError(f"secp256k1 precompile entry {k} wrong address")

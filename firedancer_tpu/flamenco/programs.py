@@ -40,7 +40,8 @@ def _u64(b: bytes) -> int:
 
 
 # -- system program -----------------------------------------------------------
-# tags (SystemInstruction): 0 CreateAccount, 1 Assign, 2 Transfer, 8 Allocate
+# tags (SystemInstruction): 0 CreateAccount, 1 Assign, 2 Transfer,
+# 4-7 nonce family (flamenco/nonce.py), 8 Allocate
 
 
 def system_program(executor, ctx, program_id, iaccts, data, *, pda_signers):
@@ -120,6 +121,11 @@ def system_program(executor, ctx, program_id, iaccts, data, *, pda_signers):
         if a.owner != SYSTEM_PROGRAM:
             raise AcctError("assign target not system-owned")
         a.owner = data[4:36]
+    elif tag in (4, 5, 6, 7):  # durable-nonce family (flamenco/nonce.py)
+        from firedancer_tpu.flamenco import nonce as _nonce
+
+        _nonce.handle(executor, ctx, tag, iaccts, data,
+                      pda_signers=pda_signers)
     elif tag == 8:  # Allocate { space }
         if len(data) < 12 or len(iaccts) < 1:
             raise AcctError("malformed allocate")

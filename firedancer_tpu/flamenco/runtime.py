@@ -161,12 +161,19 @@ def default_sysvars(slot: int) -> dict:
     bank state)."""
     from firedancer_tpu.flamenco import types as T
 
+    import hashlib as _hl
+
     sched = T.EpochSchedule()
     epoch = slot // sched.slots_per_epoch
     return {
         "clock": T.CLOCK.encode(T.Clock(slot=slot, epoch=epoch)),
         "rent": T.RENT.encode(T.Rent()),
         "epoch_schedule": T.EPOCH_SCHEDULE.encode(sched),
+        # the slot's blockhash view for the nonce family; execute_block
+        # overrides with the real parent bank hash
+        "recent_blockhash": _hl.sha256(
+            b"fdtpu:rbh:" + slot.to_bytes(8, "little")
+        ).digest(),
     }
 
 
@@ -240,7 +247,11 @@ def _execute_txn(
                 pass  # left unresolved: invocation fails typed
     ctx = TxnCtx(accounts=accounts, signer=signer, writable=writable,
                  sysvars=sysvars or {}, budget=cu_limit,
-                 heap_size=heap_size, program_elfs=program_elfs)
+                 heap_size=heap_size, program_elfs=program_elfs,
+                 instr_datas=[
+                     payload[i.data_off : i.data_off + i.data_sz]
+                     for i in desc.instrs
+                 ])
 
     for ins in desc.instrs:
         if ins.program_id >= len(addrs):
@@ -348,6 +359,9 @@ def execute_block(
             touched.add(a)
 
     sysvars = default_sysvars(slot)
+    # durable nonces advance against the PARENT's bank hash: fresh,
+    # deterministic, and fixed before any txn in this block runs
+    sysvars["recent_blockhash"] = parent_bank_hash
     results: list[TxnResult] = [None] * len(parsed)
     # intra-block duplicates are tracked locally, NOT via the cache with a
     # widened ancestor set: cache insertions from a speculative competing
@@ -364,8 +378,11 @@ def execute_block(
                 bh = t.recent_blockhash(p)
                 sig = t.signatures(p)[0]
                 if not status_cache.is_blockhash_valid(bh, slot):
-                    results[i] = TxnResult(TXN_ERR_BLOCKHASH, 0)
-                    continue
+                    from firedancer_tpu.flamenco import nonce as _nonce
+
+                    if not _nonce.durable_nonce_ok(funk, xid, p, t):
+                        results[i] = TxnResult(TXN_ERR_BLOCKHASH, 0)
+                        continue
                 if (bh, sig) in block_seen or status_cache.contains(
                     bh, sig, ancestors
                 ):

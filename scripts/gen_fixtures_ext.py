@@ -132,8 +132,9 @@ def gen_system():
            full[:n], result=0,
            modified=[acct(a, 100), acct(b, 0)])
 
-    # unknown tags are inert no-ops
-    for tag in (3, 4, 5, 6, 7, 9, 10, 11, 12, 255, 2**31):
+    # unknown tags are inert no-ops (4-7 are the nonce family, real
+    # since round 4 — they get their own fixture family below)
+    for tag in (3, 9, 10, 11, 12, 255, 2**31):
         fx(fam, f"unknown_tag{tag}", SYSTEM_PROGRAM,
            [acct(a, 100)], refs((0, True, True)),
            u32(tag) + bytes(40), result=0, modified=[acct(a, 100)])
@@ -564,6 +565,122 @@ def gen_alt():
        u32(9), slot=200, result=1)
 
 
+# -- durable nonce family ------------------------------------------------------
+
+
+def gen_nonce():
+    from firedancer_tpu.flamenco import nonce as N
+
+    fam = "nonce"
+    na, auth, dest = key("nc:acct"), key("nc:auth"), key("nc:dest")
+    # runner sysvars: default_sysvars(slot=10)["recent_blockhash"]
+    import hashlib as _hl
+
+    rbh = _hl.sha256(b"fdtpu:rbh:" + (10).to_bytes(8, "little")).digest()
+    fresh_nonce = N.next_nonce(rbh, na)
+
+    init_state = N.encode_state(N.STATE_INIT, auth, fresh_nonce)
+
+    # initialize: ok / too small / twice
+    fx(fam, "init_ok", SYSTEM_PROGRAM,
+       [acct(na, 50, data=bytes(N.DATA_LEN))],
+       refs((0, True, True)), u32(6) + auth,
+       modified=[acct(na, 50, data=init_state)])
+    fx(fam, "init_small", SYSTEM_PROGRAM,
+       [acct(na, 50, data=bytes(N.DATA_LEN - 1))],
+       refs((0, True, True)), u32(6) + auth, result=1)
+    fx(fam, "init_twice", SYSTEM_PROGRAM,
+       [acct(na, 50, data=init_state)],
+       refs((0, True, True)), u32(6) + auth, result=1)
+
+    # advance against the SAME blockhash fails (hash must move); the
+    # stale-state advance succeeds
+    stale = N.encode_state(N.STATE_INIT, auth, b"\x07" * 32)
+    fx(fam, "advance_ok", SYSTEM_PROGRAM,
+       [acct(na, 50, data=stale), acct(auth, 0)],
+       refs((0, False, True), (1, True, False)), u32(4),
+       modified=[acct(na, 50, data=init_state)])
+    fx(fam, "advance_same_hash", SYSTEM_PROGRAM,
+       [acct(na, 50, data=init_state), acct(auth, 0)],
+       refs((0, False, True), (1, True, False)), u32(4), result=1)
+    fx(fam, "advance_wrong_authority", SYSTEM_PROGRAM,
+       [acct(na, 50, data=stale), acct(dest, 0)],
+       refs((0, False, True), (1, True, False)), u32(4), result=1)
+    fx(fam, "advance_uninit", SYSTEM_PROGRAM,
+       [acct(na, 50, data=bytes(N.DATA_LEN)), acct(auth, 0)],
+       refs((0, False, True), (1, True, False)), u32(4), result=1)
+
+    # withdraw: authority moves lamports; overdraft fails
+    fx(fam, "withdraw_ok", SYSTEM_PROGRAM,
+       [acct(na, 50, data=init_state), acct(dest, 5), acct(auth, 0)],
+       refs((0, False, True), (1, False, True), (2, True, False)),
+       u32(5) + u64(20),
+       modified=[acct(na, 30, data=init_state), acct(dest, 25)])
+    fx(fam, "withdraw_overdraft", SYSTEM_PROGRAM,
+       [acct(na, 50, data=init_state), acct(dest, 5), acct(auth, 0)],
+       refs((0, False, True), (1, False, True), (2, True, False)),
+       u32(5) + u64(51), result=1)
+    fx(fam, "withdraw_unsigned", SYSTEM_PROGRAM,
+       [acct(na, 50, data=init_state), acct(dest, 5), acct(auth, 0)],
+       refs((0, False, True), (1, False, True), (2, False, False)),
+       u32(5) + u64(1), result=1)
+
+    # authorize rotates the authority, nonce value untouched
+    new_auth = key("nc:auth2")
+    fx(fam, "authorize_ok", SYSTEM_PROGRAM,
+       [acct(na, 50, data=init_state), acct(auth, 0)],
+       refs((0, False, True), (1, True, False)), u32(7) + new_auth,
+       modified=[acct(na, 50,
+                      data=N.encode_state(N.STATE_INIT, new_auth,
+                                          fresh_nonce))])
+    fx(fam, "authorize_wrong_signer", SYSTEM_PROGRAM,
+       [acct(na, 50, data=init_state), acct(dest, 0)],
+       refs((0, False, True), (1, True, False)), u32(7) + new_auth,
+       result=1)
+
+
+# -- config program ------------------------------------------------------------
+
+
+def gen_config():
+    from firedancer_tpu.flamenco.config_program import (
+        CONFIG_PROGRAM, build_keys,
+    )
+
+    fam = "config"
+    ca, s1, s2 = key("cf:acct"), key("cf:signer1"), key("cf:signer2")
+
+    def cacct(data, lamports=10):
+        return acct(ca, lamports, data=data, owner=CONFIG_PROGRAM)
+
+    store1 = build_keys([(s1, True)], b"hello")
+    # fresh account signs its own first store
+    fx(fam, "first_store_ok", CONFIG_PROGRAM,
+       [cacct(bytes(64))], refs((0, True, True)), store1,
+       modified=[cacct(store1.ljust(64, b"\x00"))])
+    fx(fam, "first_store_unsigned", CONFIG_PROGRAM,
+       [cacct(bytes(64))], refs((0, False, True)), store1, result=1)
+    # established: current signer set must sign
+    cur = store1.ljust(64, b"\x00")
+    store2 = build_keys([(s2, True)], b"rotated")
+    fx(fam, "rotate_ok", CONFIG_PROGRAM,
+       [cacct(cur), acct(s1, 0)],
+       refs((0, False, True), (1, True, False)), store2,
+       modified=[cacct(store2.ljust(64, b"\x00"))])
+    fx(fam, "rotate_missing_signer", CONFIG_PROGRAM,
+       [cacct(cur), acct(s2, 0)],
+       refs((0, False, True), (1, True, False)), store2, result=1)
+    # oversized store fails
+    fx(fam, "store_too_big", CONFIG_PROGRAM,
+       [cacct(cur), acct(s1, 0)],
+       refs((0, False, True), (1, True, False)),
+       build_keys([(s1, True)], b"x" * 100), result=1)
+    # foreign-owned account untouchable
+    fx(fam, "foreign_owner", CONFIG_PROGRAM,
+       [acct(ca, 10, data=bytes(64)), acct(s1, 0)],
+       refs((0, True, True), (1, True, False)), store1, result=1)
+
+
 # -- compute budget ------------------------------------------------------------
 
 
@@ -589,13 +706,16 @@ def gen_budget():
 
 
 def main():
-    for fam in ("system2", "stake", "vote", "alt", "budget"):
+    for fam in ("system2", "stake", "vote", "alt", "budget", "nonce",
+                "config"):
         shutil.rmtree(os.path.join(ROOT, fam), ignore_errors=True)
     gen_system()
     gen_stake()
     gen_vote()
     gen_alt()
     gen_budget()
+    gen_nonce()
+    gen_config()
     print(f"{count} fixtures written")
 
 
