@@ -1,0 +1,455 @@
+"""TOML parser — the framework's own, serving the config surface.
+
+Capability parity with the reference's vendored TOML implementation
+(/root/reference/src/ballet/toml/ — it ships its own parser rather than
+depending on a system library, because the config file is operator
+input parsed before anything else is up; no code shared).  Implements
+the TOML 1.0 subset a validator config uses:
+
+  - bare/quoted keys, dotted keys, [table] and [[array-of-table]]
+    headers;
+  - strings (basic + literal, single and multi-line, full escape set
+    incl. \\uXXXX/\\UXXXXXXXX), integers (dec/hex/oct/bin, underscores),
+    floats (incl. inf/nan), booleans;
+  - arrays (nested, heterogeneous per TOML 1.1-draft tolerance is NOT
+    accepted — values must parse, but mixed types are allowed as Python
+    does not care), inline tables;
+  - comments, \\r\\n, duplicate-definition rejection.
+
+Dates are not implemented (no config key uses them) and raise a typed
+error.  `loads` is differentially tested against stdlib tomllib in
+tests/test_toml.py and fuzzed in tests/test_fuzz.py.
+"""
+
+from __future__ import annotations
+
+
+class TomlError(ValueError):
+    def __init__(self, msg: str, line: int):
+        super().__init__(f"line {line}: {msg}")
+        self.line = line
+
+
+_WS = frozenset(" \t")
+_BARE = set(
+    "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789_-"
+)
+
+
+class _P:
+    def __init__(self, text: str):
+        self.s = text
+        self.i = 0
+        self.line = 1
+        self.root: dict = {}
+        # paths defined as [table] headers or assignment targets — for
+        # duplicate rejection; array-of-table paths may repeat
+        self.defined: set[tuple] = set()
+        self.aot_paths: set[tuple] = set()
+
+    # -- low-level ----------------------------------------------------------
+
+    def err(self, msg):
+        raise TomlError(msg, self.line)
+
+    def peek(self):
+        return self.s[self.i] if self.i < len(self.s) else ""
+
+    def adv(self, n=1):
+        for _ in range(n):
+            if self.i < len(self.s) and self.s[self.i] == "\n":
+                self.line += 1
+            self.i += 1
+
+    def skip_ws(self):
+        while self.peek() in _WS:
+            self.adv()
+
+    def skip_comment(self):
+        if self.peek() == "#":
+            while self.peek() and self.peek() != "\n":
+                if ord(self.peek()) < 0x20 and self.peek() != "\t":
+                    self.err("control character in comment")
+                self.adv()
+
+    def expect_eol(self):
+        self.skip_ws()
+        self.skip_comment()
+        c = self.peek()
+        if c == "\r":
+            self.adv()
+            c = self.peek()
+            if c != "\n":
+                self.err("bare carriage return")
+        if c == "\n":
+            self.adv()
+        elif c:
+            self.err(f"expected end of line, got {c!r}")
+
+    # -- keys ---------------------------------------------------------------
+
+    def key_part(self) -> str:
+        c = self.peek()
+        if c == '"':
+            return self.basic_string()
+        if c == "'":
+            return self.literal_string()
+        out = []
+        while self.peek() in _BARE:
+            out.append(self.peek())
+            self.adv()
+        if not out:
+            self.err("expected a key")
+        return "".join(out)
+
+    def dotted_key(self) -> list[str]:
+        parts = [self.key_part()]
+        while True:
+            self.skip_ws()
+            if self.peek() != ".":
+                return parts
+            self.adv()
+            self.skip_ws()
+            parts.append(self.key_part())
+
+    # -- strings ------------------------------------------------------------
+
+    def _escape(self) -> str:
+        c = self.peek()
+        self.adv()
+        table = {"b": "\b", "t": "\t", "n": "\n", "f": "\f", "r": "\r",
+                 '"': '"', "\\": "\\"}
+        if c in table:
+            return table[c]
+        if c == "u" or c == "U":
+            n = 4 if c == "u" else 8
+            hexs = self.s[self.i : self.i + n]
+            if len(hexs) != n or any(h not in "0123456789abcdefABCDEF"
+                                     for h in hexs):
+                self.err("bad unicode escape")
+            self.adv(n)
+            cp = int(hexs, 16)
+            if 0xD800 <= cp <= 0xDFFF or cp > 0x10FFFF:
+                self.err("invalid unicode scalar")
+            return chr(cp)
+        self.err(f"unknown escape \\{c}")
+
+    def basic_string(self) -> str:
+        if self.s[self.i : self.i + 3] == '"""':
+            return self._ml_basic()
+        self.adv()
+        out = []
+        while True:
+            c = self.peek()
+            if not c or c == "\n":
+                self.err("unterminated string")
+            self.adv()
+            if c == '"':
+                return "".join(out)
+            if c == "\\":
+                out.append(self._escape())
+            elif ord(c) < 0x20 and c != "\t":
+                self.err("control character in string")
+            else:
+                out.append(c)
+
+    def _ml_basic(self) -> str:
+        self.adv(3)
+        if self.peek() == "\n":
+            self.adv()
+        out = []
+        while True:
+            if self.s[self.i : self.i + 3] == '"""':
+                # up to two extra quotes belong to the content
+                extra = 0
+                while self.s[self.i + 3 + extra : self.i + 4 + extra] == '"' \
+                        and extra < 2:
+                    extra += 1
+                out.append('"' * extra)
+                self.adv(3 + extra)
+                return "".join(out)
+            c = self.peek()
+            if not c:
+                self.err("unterminated multi-line string")
+            if c == "\\":
+                self.adv()
+                if self.peek() in _WS or self.peek() in ("\n", "\r"):
+                    # line-ending backslash eats whitespace
+                    while self.peek() and (self.peek() in _WS
+                                           or self.peek() in "\r\n"):
+                        self.adv()
+                    continue
+                out.append(self._escape())
+                continue
+            if ord(c) < 0x20 and c not in "\t\n\r":
+                self.err("control character in string")
+            out.append(c)
+            self.adv()
+
+    def literal_string(self) -> str:
+        if self.s[self.i : self.i + 3] == "'''":
+            self.adv(3)
+            if self.peek() == "\n":
+                self.adv()
+            start = self.i
+            end = self.s.find("'''", self.i)
+            if end < 0:
+                self.err("unterminated multi-line literal")
+            # trailing quotes may extend the content by up to two
+            while self.s[end + 3 : end + 4] == "'" and end + 3 - start >= 0 \
+                    and self.s[end + 1 : end + 3] != "''":
+                end += 1
+            content = self.s[start:end]
+            self.adv(end - start + 3)
+            return content
+        self.adv()
+        end = self.s.find("'", self.i)
+        nl = self.s.find("\n", self.i)
+        if end < 0 or (0 <= nl < end):
+            self.err("unterminated literal string")
+        content = self.s[self.i : end]
+        for ch in content:
+            if ord(ch) < 0x20 and ch != "\t":
+                self.err("control character in literal string")
+        self.adv(end - self.i + 1)
+        return content
+
+    # -- values -------------------------------------------------------------
+
+    def value(self):
+        c = self.peek()
+        if c == '"':
+            return self.basic_string()
+        if c == "'":
+            return self.literal_string()
+        if c == "[":
+            return self.array()
+        if c == "{":
+            return self.inline_table()
+        if c == "t" and self.s[self.i : self.i + 4] == "true":
+            self.adv(4)
+            return True
+        if c == "f" and self.s[self.i : self.i + 5] == "false":
+            self.adv(5)
+            return False
+        return self.number()
+
+    def number(self):
+        start = self.i
+        while self.peek() and self.peek() not in set(" \t\n\r,]}#"):
+            self.adv()
+        tok = self.s[start : self.i]
+        if not tok:
+            self.err("expected a value")
+        try:
+            return _parse_number(tok)
+        except ValueError:
+            if any(ch in tok for ch in ":-") and tok[0].isdigit():
+                self.err("dates are not supported")
+            self.err(f"bad value {tok!r}")
+
+    def array(self):
+        self.adv()
+        out = []
+        while True:
+            self._skip_ws_nl()
+            if self.peek() == "]":
+                self.adv()
+                return out
+            out.append(self.value())
+            self._skip_ws_nl()
+            if self.peek() == ",":
+                self.adv()
+            elif self.peek() != "]":
+                self.err("expected , or ] in array")
+
+    def inline_table(self):
+        self.adv()
+        out: dict = {}
+        self.skip_ws()
+        if self.peek() == "}":
+            self.adv()
+            return out
+        while True:
+            self.skip_ws()
+            parts = self.dotted_key()
+            self.skip_ws()
+            if self.peek() != "=":
+                self.err("expected = in inline table")
+            self.adv()
+            self.skip_ws()
+            v = self.value()
+            tgt = out
+            for p in parts[:-1]:
+                tgt = tgt.setdefault(p, {})
+                if not isinstance(tgt, dict):
+                    self.err("dotted key collides in inline table")
+            if parts[-1] in tgt:
+                self.err(f"duplicate key {parts[-1]!r} in inline table")
+            tgt[parts[-1]] = v
+            self.skip_ws()
+            if self.peek() == ",":
+                self.adv()
+            elif self.peek() == "}":
+                self.adv()
+                return out
+            else:
+                self.err("expected , or } in inline table")
+
+    def _skip_ws_nl(self):
+        while True:
+            self.skip_ws()
+            self.skip_comment()
+            if self.peek() and self.peek() in "\r\n":
+                self.adv()
+            else:
+                return
+
+    # -- document -----------------------------------------------------------
+
+    def _navigate(self, parts: list[tuple], *, create_aot: bool):
+        """Walk/create the table path for a header."""
+        cur = self.root
+        walked: tuple = ()
+        for k in parts[:-1]:
+            walked += (k,)
+            nxt = cur.get(k)
+            if nxt is None:
+                nxt = cur[k] = {}
+            if isinstance(nxt, list):
+                nxt = nxt[-1]
+            if not isinstance(nxt, dict):
+                self.err(f"key {k!r} is not a table")
+            cur = nxt
+        last = parts[-1]
+        walked += (last,)
+        if create_aot:
+            arr = cur.get(last)
+            if arr is None:
+                arr = cur[last] = []
+                self.aot_paths.add(walked)
+            if not isinstance(arr, list) or walked not in self.aot_paths:
+                self.err(f"{last!r} is not an array of tables")
+            fresh: dict = {}
+            arr.append(fresh)
+            # instance-discriminated path: each [[element]] is a fresh
+            # namespace for duplicate tracking
+            return fresh, walked + (len(arr) - 1,)
+        nxt = cur.get(last)
+        if walked in self.defined:
+            self.err(f"table {last!r} already defined")
+        self.defined.add(walked)
+        if nxt is None:
+            nxt = cur[last] = {}
+        if isinstance(nxt, list):
+            self.err(f"{last!r} is an array of tables")
+        if not isinstance(nxt, dict):
+            self.err(f"key {last!r} already holds a value")
+        return nxt, walked
+
+    def parse(self) -> dict:
+        target = self.root
+        prefix: tuple = ()
+        while self.i < len(self.s):
+            self.skip_ws()
+            self.skip_comment()
+            c = self.peek()
+            if not c:
+                break
+            if c in ("\r", "\n"):
+                self.expect_eol()
+                continue
+            if c == "[":
+                aot = self.s[self.i : self.i + 2] == "[["
+                self.adv(2 if aot else 1)
+                self.skip_ws()
+                parts = self.dotted_key()
+                self.skip_ws()
+                closer = "]]" if aot else "]"
+                if self.s[self.i : self.i + len(closer)] != closer:
+                    self.err(f"expected {closer}")
+                self.adv(len(closer))
+                target, prefix = self._navigate(parts, create_aot=aot)
+                self.expect_eol()
+                continue
+            parts = self.dotted_key()
+            self.skip_ws()
+            if self.peek() != "=":
+                self.err("expected = after key")
+            self.adv()
+            self.skip_ws()
+            v = self.value()
+            tgt = target
+            walked = prefix
+            for p in parts[:-1]:
+                walked += (p,)
+                nxt = tgt.get(p)
+                if nxt is None:
+                    nxt = tgt[p] = {}
+                if not isinstance(nxt, dict) or walked in self.defined:
+                    self.err(f"dotted key {p!r} collides")
+                tgt = nxt
+            walked += (parts[-1],)
+            if parts[-1] in tgt or walked in self.defined:
+                self.err(f"duplicate key {parts[-1]!r}")
+            self.defined.add(walked)
+            tgt[parts[-1]] = v
+            self.expect_eol()
+        return self.root
+
+
+def _parse_number(tok: str):
+    t = tok.replace("_", "") if _underscores_ok(tok) else None
+    if t is None:
+        raise ValueError(tok)
+    low = t.lower()
+    sign = 1
+    body = low
+    if body and body[0] in "+-":
+        sign = -1 if body[0] == "-" else 1
+        body = body[1:]
+    if body in ("inf",):
+        return sign * float("inf")
+    if body in ("nan",):
+        return float("nan")
+    if body.startswith("0x"):
+        return sign * int(body[2:], 16)
+    if body.startswith("0o"):
+        return sign * int(body[2:], 8)
+    if body.startswith("0b"):
+        return sign * int(body[2:], 2)
+    if any(ch in body for ch in ".e"):
+        if body.startswith(".") or body.endswith("."):
+            raise ValueError(tok)
+        if "." in body:
+            frac = body.split(".", 1)[1]
+            if not frac or not frac[0].isdigit():
+                raise ValueError(tok)
+        return float(t)
+    if not body.isdigit():
+        raise ValueError(tok)
+    if len(body) > 1 and body[0] == "0":
+        raise ValueError(tok)  # no leading zeros
+    return sign * int(body)
+
+
+def _underscores_ok(tok: str) -> bool:
+    if "_" not in tok:
+        return True
+    if tok.startswith("_") or tok.endswith("_") or "__" in tok:
+        return False
+    for i, ch in enumerate(tok):
+        if ch == "_":
+            if not (tok[i - 1].isalnum() and tok[i + 1].isalnum()):
+                return False
+    return True
+
+
+def loads(text: str | bytes) -> dict:
+    if isinstance(text, (bytes, bytearray)):
+        text = text.decode("utf-8")
+    return _P(text).parse()
+
+
+def load(f) -> dict:
+    return loads(f.read())

@@ -17,7 +17,6 @@ reference's split between config_parse and topos/fd_frankendancer.c.
 from __future__ import annotations
 
 import dataclasses
-import tomllib
 from dataclasses import dataclass, field
 
 
@@ -125,7 +124,12 @@ def load_config(
     cfg = Config()
     if path is not None:
         with open(path, "rb") as f:
-            data = tomllib.load(f)
+            # the framework's own TOML parser (protocol/toml.py) — the
+            # config file is operator input parsed before anything else
+            # is up, matching the reference's vendored-parser stance
+            from firedancer_tpu.protocol import toml as _toml
+
+            data = _toml.load(f)
         _merge_into(cfg, data, "")
     if overrides:
         _merge_into(cfg, overrides, "")

