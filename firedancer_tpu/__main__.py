@@ -257,6 +257,14 @@ def main(argv=None) -> int:
     snapp = sub.add_parser("snapshot", help="inspect a snapshot archive")
     snapp.add_argument("path")
 
+    btp = sub.add_parser(
+        "backtest", help="replay a consensus scenario through ghost/tower"
+    )
+    btp.add_argument("--scenario", default=None,
+                     help="scenario JSON (default: synthetic partition)")
+    btp.add_argument("--seed", default=None)
+    btp.add_argument("--total-stake", type=int, default=None)
+
     monp = sub.add_parser(
         "monitor", help="live per-stage TUI of a running topology"
     )
@@ -303,6 +311,10 @@ def main(argv=None) -> int:
         from firedancer_tpu import ledger as _ledger
 
         return _ledger.main(args)
+    if args.cmd == "backtest":
+        from firedancer_tpu.choreo import backtest as _bt
+
+        return _bt.main(args)
     if args.cmd == "monitor":
         return cmd_monitor(args)
     if args.cmd == "ready":

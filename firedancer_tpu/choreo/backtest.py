@@ -1,0 +1,175 @@
+"""Consensus backtesting: replay recorded fork/vote scenarios through
+ghost + tower and report every decision.
+
+Capability parity with the reference's backtest tooling
+(/root/reference/src/app/backtest/fd_backtest_ctl.c — recovers
+blockstore/funk state from a live run so consensus can be re-driven
+offline; no code shared).  State recovery exists here already
+(funk/persist.py journals, utils/checkpt.py, the file-backed
+blockstore); this module adds the DRIVER: a deterministic event replay
+through the real fork-choice (choreo/ghost.py), voting rules
+(choreo/tower.py) and vote constructor (choreo/voter.py), recording
+what the node would have done at every step — the tool for
+investigating "why did we vote there?" after the fact.
+
+Scenario = ordered events:
+    {"t": "block", "slot": S, "parent": P}
+    {"t": "vote",  "voter": hex, "slot": S, "stake": N}   cluster votes
+    {"t": "tick"}                                         decision point
+
+At every tick the backtester computes the ghost head, runs the tower's
+lockout + threshold checks, and records vote/abstain with the reason.
+Scenarios load from JSON (a live node can dump its observed stream) or
+come from the synthetic partition generator.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import dataclass, field
+
+from firedancer_tpu.choreo.ghost import Ghost
+from firedancer_tpu.choreo.tower import Tower
+
+
+@dataclass
+class Decision:
+    step: int
+    head: int
+    action: str          # "vote" | "abstain"
+    slot: int | None
+    reason: str
+    tower_depth: int
+
+
+@dataclass
+class BacktestResult:
+    decisions: list[Decision] = field(default_factory=list)
+    blocks: int = 0
+    cluster_votes: int = 0
+    own_votes: int = 0
+
+    def summary(self) -> dict:
+        return {
+            "blocks": self.blocks,
+            "cluster_votes": self.cluster_votes,
+            "decision_points": len(self.decisions),
+            "own_votes": self.own_votes,
+            "final_head": self.decisions[-1].head if self.decisions else None,
+            "final_tower_depth": (
+                self.decisions[-1].tower_depth if self.decisions else 0
+            ),
+        }
+
+
+def run_scenario(events: list[dict], *, root_slot: int = 0,
+                 total_stake: int = 0) -> BacktestResult:
+    ghost = Ghost(root_slot)
+    tower = Tower()
+    res = BacktestResult()
+    out = res.decisions
+    step = 0
+    for ev in events:
+        step += 1
+        t = ev.get("t")
+        if t == "block":
+            ghost.insert(int(ev["slot"]), int(ev["parent"]))
+            res.blocks += 1
+        elif t == "vote":
+            ghost.vote(bytes.fromhex(ev["voter"]), int(ev["slot"]),
+                       int(ev["stake"]))
+            res.cluster_votes += 1
+        elif t == "tick":
+            head = ghost.head()
+            last = tower.last_vote()
+            if last is not None and head <= last:
+                out.append(Decision(step, head, "abstain", None,
+                                    "head not past last vote",
+                                    len(tower.votes)))
+                continue
+            if not tower.lockout_check(head, ghost.is_ancestor):
+                out.append(Decision(step, head, "abstain", None,
+                                    "lockout: head forks from a locked vote",
+                                    len(tower.votes)))
+                continue
+            if total_stake > 0 and not tower.threshold_check(
+                head, ghost.weight, total_stake
+            ):
+                out.append(Decision(step, head, "abstain", None,
+                                    "threshold: fork lacks cluster weight",
+                                    len(tower.votes)))
+                continue
+            tower.vote(head)
+            res.own_votes += 1
+            out.append(Decision(step, head, "vote", head, "ok",
+                                len(tower.votes)))
+        else:
+            raise ValueError(f"unknown event type {t!r}")
+    return res
+
+
+def load_scenario(path: str) -> tuple[list[dict], dict]:
+    """-> (events, meta) from a scenario JSON file."""
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, list):
+        return doc, {}
+    return doc["events"], {k: v for k, v in doc.items() if k != "events"}
+
+
+def synth_partition_scenario(*, seed: bytes = b"backtest",
+                             n_voters: int = 10,
+                             majority: int = 7,
+                             fork_at: int = 4,
+                             heal_at: int = 12,
+                             slots: int = 20) -> tuple[list[dict], int]:
+    """A deterministic network partition: the cluster splits at
+    `fork_at` (majority extends chain A, minority chain B), heals at
+    `heal_at` (everyone converges on A).  -> (events, total_stake)."""
+    voters = [hashlib.sha256(seed + bytes([i])).digest()
+              for i in range(n_voters)]
+    stake = {v: 100 for v in voters}
+    events: list[dict] = []
+    a_tip = b_tip = 0
+    for s in range(1, slots + 1):
+        slot_a = s * 2          # even slots: chain A
+        slot_b = s * 2 + 1      # odd slots: chain B
+        if s < fork_at:
+            events.append({"t": "block", "slot": slot_a, "parent": a_tip})
+            a_tip = b_tip = slot_a
+            group_a, group_b = voters, []
+        elif s < heal_at:
+            events.append({"t": "block", "slot": slot_a, "parent": a_tip})
+            events.append({"t": "block", "slot": slot_b, "parent": b_tip})
+            a_tip, b_tip = slot_a, slot_b
+            group_a, group_b = voters[:majority], voters[majority:]
+        else:
+            events.append({"t": "block", "slot": slot_a, "parent": a_tip})
+            a_tip = b_tip = slot_a
+            group_a, group_b = voters, []
+        for v in group_a:
+            events.append({"t": "vote", "voter": v.hex(),
+                           "slot": a_tip, "stake": stake[v]})
+        for v in group_b:
+            events.append({"t": "vote", "voter": v.hex(),
+                           "slot": b_tip, "stake": stake[v]})
+        events.append({"t": "tick"})
+    return events, sum(stake.values())
+
+
+def main(args) -> int:
+    if args.scenario:
+        events, meta = load_scenario(args.scenario)
+        total = int(meta.get("total_stake", args.total_stake or 0))
+    else:
+        events, total = synth_partition_scenario(
+            seed=(args.seed or "backtest").encode()
+        )
+    res = run_scenario(events, total_stake=total)
+    for d in res.decisions:
+        what = f"vote {d.slot}" if d.action == "vote" else "abstain"
+        print(f"step {d.step:4d}: head {d.head:5d} -> {what:>12}  "
+              f"[{d.reason}] depth={d.tower_depth}")
+    print(json.dumps(res.summary()))
+    return 0

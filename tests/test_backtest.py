@@ -1,0 +1,63 @@
+"""Consensus backtester: deterministic decision traces through the real
+ghost/tower over partition scenarios."""
+
+import json
+
+from firedancer_tpu.choreo import backtest as bt
+
+
+def test_partition_scenario_votes_majority_and_heals():
+    events, total = bt.synth_partition_scenario()
+    res = bt.run_scenario(events, total_stake=total)
+    assert res.blocks > 20 and res.cluster_votes > 100
+    # every vote landed on chain A (even slots): the majority fork
+    voted = [d.slot for d in res.decisions if d.action == "vote"]
+    assert voted and all(s % 2 == 0 for s in voted)
+    # votes are monotonically increasing (tower can never re-vote back)
+    assert voted == sorted(voted)
+    # after healing the tower keeps deepening on the converged chain
+    assert res.decisions[-1].action == "vote"
+    assert res.summary()["final_head"] == max(voted)
+
+
+def test_determinism():
+    events, total = bt.synth_partition_scenario()
+    a = bt.run_scenario(events, total_stake=total)
+    b = bt.run_scenario(events, total_stake=total)
+    assert [(d.step, d.action, d.slot) for d in a.decisions] == \
+        [(d.step, d.action, d.slot) for d in b.decisions]
+
+
+def test_lockout_abstain_on_fork_flip():
+    """A head flip to a non-descendant fork while locked out must
+    abstain with the lockout reason."""
+    v = "aa" * 32
+    w = "bb" * 32
+    events = [
+        {"t": "block", "slot": 1, "parent": 0},
+        {"t": "block", "slot": 2, "parent": 1},
+        {"t": "vote", "voter": v, "slot": 2, "stake": 60},
+        {"t": "tick"},                      # vote 2
+        {"t": "block", "slot": 3, "parent": 1},  # competing fork
+        {"t": "vote", "voter": w, "slot": 3, "stake": 100},
+        {"t": "tick"},                      # head flips to 3: locked out
+    ]
+    res = bt.run_scenario(events)
+    assert [d.action for d in res.decisions] == ["vote", "abstain"]
+    assert "lockout" in res.decisions[1].reason
+
+
+def test_scenario_file_roundtrip(tmp_path):
+    events, total = bt.synth_partition_scenario(slots=6)
+    p = tmp_path / "s.json"
+    p.write_text(json.dumps({"total_stake": total, "events": events}))
+    loaded, meta = bt.load_scenario(str(p))
+    assert loaded == events and meta["total_stake"] == total
+
+
+def test_backtest_cli(capsys):
+    from firedancer_tpu.__main__ import main
+
+    assert main(["backtest"]) == 0
+    out = capsys.readouterr().out
+    assert "vote" in out and '"final_head"' in out
