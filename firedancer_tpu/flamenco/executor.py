@@ -361,9 +361,24 @@ def writeback_aligned(ctx: TxnCtx, v, smap: list[SerialEntry],
     changing data, or reassigning the owner requires owning it."""
     region = v.regions[3].data  # input region backing store
     for e in smap:
-        if not e.writable:
-            continue
         a = ctx.accounts[e.txn_idx]
+        if not e.writable:
+            # a read-only account's serialized image must come back
+            # byte-identical — silently dropping a program's writes
+            # would let it "succeed" while its effects vanish
+            # (ReadonlyDataModified parity; caught by the vm conformance
+            # fixture store_readonly_faults)
+            if (
+                int.from_bytes(region[e.lamports_off : e.lamports_off + 8],
+                               "little") != a.lamports
+                or bytes(region[e.owner_off : e.owner_off + 32]) != a.owner
+                or region[e.data_off : e.data_off + e.orig_data_len]
+                != bytes(a.data)
+            ):
+                raise InstrError(
+                    "program modified a read-only account's image"
+                )
+            continue
         owns = a.owner == program_id
         new_lam = int.from_bytes(region[e.lamports_off : e.lamports_off + 8],
                                  "little")

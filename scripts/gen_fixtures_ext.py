@@ -707,7 +707,7 @@ def gen_budget():
 
 def main():
     for fam in ("system2", "stake", "vote", "alt", "budget", "nonce",
-                "config"):
+                "config", "vm"):
         shutil.rmtree(os.path.join(ROOT, fam), ignore_errors=True)
     gen_system()
     gen_stake()
@@ -716,7 +716,133 @@ def main():
     gen_budget()
     gen_nonce()
     gen_config()
+    gen_vm()
     print(f"{count} fixtures written")
+
+
+
+
+# -- sBPF VM fixtures ----------------------------------------------------------
+# Expectations derive from the VM's documented rules: 1 CU per executed
+# instruction (fd_vm's per-insn consume), nonzero r0 = custom error,
+# budget exhaustion aborts, sol_set_return_data lands in effects, and a
+# store through the input region writes back to the account.
+
+
+def _vm_ins(opcode, dst=0, src=0, off=0, imm=0):
+    import struct as _struct
+
+    return bytes([opcode, (src << 4) | dst]) + _struct.pack(
+        "<h", off
+    ) + (imm & 0xFFFFFFFF).to_bytes(4, "little")
+
+
+def _vm_lddw(dst, value):
+    lo = value & 0xFFFFFFFF
+    hi = (value >> 32) & 0xFFFFFFFF
+    return (bytes([0x18, dst]) + bytes(2) + lo.to_bytes(4, "little")
+            + bytes(4) + hi.to_bytes(4, "little"))
+
+
+def _vm_elf(text: bytes) -> bytes:
+    """Minimal ELF64 wrapping `text` (layout mirrors the loader's
+    expectations; standalone copy of the test builder's shape)."""
+    import struct as _struct
+
+    shstr = b"\x00.text\x00.shstrtab\x00"
+    ehsz = 64
+    text_off = ehsz
+    str_off = text_off + len(text)
+    shoff = str_off + len(shstr)
+
+    def shdr(name, type_, flags, addr, off, size):
+        return _struct.pack("<IIQQQQIIQQ", name, type_, flags, addr, off,
+                            size, 0, 0, 0, 0)
+
+    shdrs = [shdr(0, 0, 0, 0, 0, 0),
+             shdr(1, 1, 0x6, 0x100, text_off, len(text)),
+             shdr(7, 3, 0, 0, str_off, len(shstr))]
+    ehdr = _struct.pack(
+        "<16sHHIQQQIHHHHHH",
+        b"\x7fELF" + bytes([2, 1, 1]) + bytes(9),
+        3, 247, 1, 0x100, 0, shoff, 0, ehsz, 0, 0,
+        _struct.calcsize("<IIQQQQIIQQ"), len(shdrs), 2,
+    )
+    return ehdr + text + shstr + b"".join(shdrs)
+
+
+def gen_vm():
+    from firedancer_tpu.flamenco.executor import BPF_LOADER_PROGRAM
+    from firedancer_tpu.ops.smallhash import syscall_id
+
+    fam = "vm"
+    prog_key = key("vm:prog")
+    MM_INPUT = 4 << 32
+    EXIT = _vm_ins(0x95)
+
+    def prog_acct(text):
+        return AcctState(address=prog_key, lamports=1,
+                         data=_vm_elf(text), executable=True,
+                         owner=BPF_LOADER_PROGRAM)
+
+    def vmfx(name, text, *, data=b"", result=0, modified=(), cu_in=10_000,
+             cu_out=0, ret=b"", accounts=(), iaccts=()):
+        global count
+        c = InstrContext(
+            program_id=prog_key,
+            accounts=[prog_acct(text)] + list(accounts),
+            instr_accounts=list(iaccts),
+            data=bytes(data), cu_avail=cu_in,
+        )
+        e = InstrEffects(result=result, modified_accounts=list(modified),
+                         cu_avail=cu_out, return_data=ret)
+        d = os.path.join(ROOT, fam)
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, name + ".fix"), "wb") as f:
+            f.write(InstrFixture(c, e).encode())
+        count += 1
+
+    # 1. mov r0,0; exit -> success, exactly 2 CUs consumed
+    vmfx("exit_ok", _vm_ins(0xB7, dst=0, imm=0) + EXIT,
+         cu_in=10_000, cu_out=9_998)
+    # 2. nonzero r0 -> custom program error (zero/nonzero + exact custom)
+    vmfx("custom_error", _vm_ins(0xB7, dst=0, imm=7) + EXIT, result=1)
+    # 3. infinite loop at budget 50 -> exhausted, all CUs gone
+    vmfx("cu_exhausted", _vm_ins(0x05, off=-1) + EXIT,
+         cu_in=50, result=1)
+    # 4. sol_set_return_data over the instruction data (input region:
+    #    8B count + 8B len prefix with no accounts -> data at +16)
+    payload = b"returned!"
+    text4 = (
+        _vm_lddw(1, MM_INPUT + 16)
+        + _vm_ins(0xB7, dst=2, imm=len(payload))
+        + _vm_ins(0x85, imm=syscall_id("sol_set_return_data"))
+        + _vm_ins(0xB7, dst=0, imm=0)
+        + EXIT
+    )
+    vmfx("return_data", text4, data=payload, ret=payload)
+    # 5. store through the input region writes the account back:
+    #    1 account -> its data begins at 8 + 8 + 32 + 32 + 8 + 8 = 96
+    target = key("vm:target")
+    acc = AcctState(address=target, lamports=5, data=bytes(4),
+                    owner=prog_key)
+    text5 = (
+        _vm_lddw(1, MM_INPUT + 96)
+        + _vm_ins(0x72, dst=1, off=0, imm=0x5A)
+        + _vm_ins(0xB7, dst=0, imm=0)
+        + EXIT
+    )
+    after = AcctState(address=target, lamports=5,
+                      data=b"\x5a\x00\x00\x00", owner=prog_key)
+    vmfx("store_account_data", text5,
+         accounts=[acc],
+         iaccts=[InstrAcctRef(index=1, is_writable=True)],
+         modified=[after])
+    # 6. store to a READ-ONLY account faults the VM
+    vmfx("store_readonly_faults", text5,
+         accounts=[acc],
+         iaccts=[InstrAcctRef(index=1, is_writable=False)],
+         result=1)
 
 
 if __name__ == "__main__":

@@ -301,8 +301,11 @@ def test_bpf_program_nonzero_return_is_error():
         ex.execute_instr(ctx, prog_key, [InstrAccount(0, False, True)], b"")
 
 
-def test_bpf_readonly_account_writeback_skipped():
-    # program writes its view of a READONLY account; effects must not land
+def test_bpf_readonly_account_write_fails_instruction():
+    # program writes its view of a READONLY account: the instruction
+    # FAILS (ReadonlyDataModified parity — silently dropping the write
+    # would let a program "succeed" while its effects vanish; r4 vm
+    # conformance fixture store_readonly_faults pinned this)
     off = _serial_offsets(8)
     text = (
         lddw(1, fvm.MM_INPUT + off["lamports"])
@@ -318,7 +321,9 @@ def test_bpf_readonly_account_writeback_skipped():
         _bpf_program_account(prog_key, text),
         writable=[False, False],
     )
-    ex.execute_instr(ctx, prog_key, [InstrAccount(0, False, False)], b"")
+    with pytest.raises(InstrError, match="read-only"):
+        ex.execute_instr(ctx, prog_key, [InstrAccount(0, False, False)],
+                         b"")
     assert ctx.accounts[0].lamports == 5  # unchanged
 
 
