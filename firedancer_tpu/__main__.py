@@ -257,6 +257,12 @@ def main(argv=None) -> int:
     snapp = sub.add_parser("snapshot", help="inspect a snapshot archive")
     snapp.add_argument("path")
 
+    cfgst = sub.add_parser(
+        "configure", help="host setup stages: check or apply"
+    )
+    cfgst.add_argument("action", choices=["check", "init"])
+    cfgst.add_argument("--config", default=None)
+
     btp = sub.add_parser(
         "backtest", help="replay a consensus scenario through ghost/tower"
     )
@@ -311,6 +317,11 @@ def main(argv=None) -> int:
         from firedancer_tpu import ledger as _ledger
 
         return _ledger.main(args)
+    if args.cmd == "configure":
+        from firedancer_tpu.utils import hostcfg
+        from firedancer_tpu.utils.config import load_config
+
+        return hostcfg.main(args, load_config(args.config))
     if args.cmd == "backtest":
         from firedancer_tpu.choreo import backtest as _bt
 

@@ -1,0 +1,162 @@
+"""Host configuration stages: `configure check|init` (fdctl parity).
+
+Capability parity with the reference's idempotent privileged setup
+stages (/root/reference/src/app/fdctl/configure/ — hugetlbfs mounts,
+sysctl tuning, NIC channels; each stage knows how to check, init and
+undo itself; no code shared).  A Python/XLA validator needs a different
+host surface: POSIX shared memory capacity for the tango links, file
+descriptor headroom, core count vs the configured stage layout, THP
+and clocksource for latency stability.  Same contract though: every
+stage is idempotent, `check` never mutates, `init` applies what the
+current privilege allows and prints the exact remedy for what it
+cannot.
+"""
+
+from __future__ import annotations
+
+import os
+import resource
+from dataclasses import dataclass
+
+OK, WARN, FAIL = "OK", "WARN", "FAIL"
+
+
+@dataclass
+class StageResult:
+    stage: str
+    status: str
+    detail: str
+    remedy: str = ""
+
+
+def _read(path: str) -> str:
+    try:
+        with open(path) as f:
+            return f.read().strip()
+    except OSError:
+        return ""
+
+
+def check_shm(cfg=None) -> StageResult:
+    """POSIX shm backs every mcache/dcache link + cnc region."""
+    st = os.statvfs("/dev/shm") if os.path.isdir("/dev/shm") else None
+    if st is None:
+        return StageResult("shm", FAIL, "/dev/shm not mounted",
+                           "mount -t tmpfs tmpfs /dev/shm")
+    free = st.f_bavail * st.f_frsize
+    need = 256 << 20  # a full leader topology's links + slack
+    if free < need:
+        return StageResult(
+            "shm", WARN,
+            f"/dev/shm free {free >> 20} MiB < {need >> 20} MiB",
+            "mount -o remount,size=1G /dev/shm",
+        )
+    return StageResult("shm", OK, f"/dev/shm free {free >> 20} MiB")
+
+
+def check_nofile(cfg=None) -> StageResult:
+    soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    if soft >= 4096:
+        return StageResult("nofile", OK, f"soft limit {soft}")
+    if hard >= 4096:
+        return StageResult(
+            "nofile", WARN, f"soft {soft} < 4096 (hard {hard} suffices)",
+            "raised automatically by `configure init`",
+        )
+    return StageResult("nofile", FAIL, f"hard limit {hard} < 4096",
+                       "ulimit -n 4096 (as root / limits.conf)")
+
+
+def init_nofile(cfg=None) -> StageResult:
+    soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    want = min(max(soft, 4096), hard if hard > 0 else 4096)
+    if want > soft:
+        resource.setrlimit(resource.RLIMIT_NOFILE, (want, hard))
+        return StageResult("nofile", OK, f"raised soft {soft} -> {want}")
+    return StageResult("nofile", OK, f"soft limit {soft} already fine")
+
+
+def check_cpus(cfg=None) -> StageResult:
+    n = os.cpu_count() or 1
+    stages = 9  # the leader topology's stage count
+    if cfg is not None:
+        stages = 7 + cfg.layout.verify_stage_count + cfg.layout.bank_stage_count
+    if n >= stages:
+        return StageResult("cpus", OK, f"{n} cores for {stages} stages")
+    return StageResult(
+        "cpus", WARN,
+        f"{n} cores < {stages} stages (cooperative scheduling engages)",
+        "reduce [layout] counts or use a larger host",
+    )
+
+
+def check_thp(cfg=None) -> StageResult:
+    """Transparent hugepages in `always` mode causes latency spikes from
+    background compaction under big XLA allocations (the reference's
+    hugetlbfs stage manages explicit hugepages for the same reason)."""
+    raw = _read("/sys/kernel/mm/transparent_hugepage/enabled")
+    if not raw:
+        return StageResult("thp", OK, "THP interface not exposed")
+    if "[always]" in raw:
+        return StageResult(
+            "thp", WARN, "THP 'always' — compaction stalls under load",
+            "echo madvise > /sys/kernel/mm/transparent_hugepage/enabled",
+        )
+    return StageResult("thp", OK, f"THP {raw}")
+
+
+def check_clocksource(cfg=None) -> StageResult:
+    cur = _read("/sys/devices/system/clocksource/clocksource0/"
+                "current_clocksource")
+    if not cur:
+        return StageResult("clocksource", OK, "interface not exposed")
+    if cur != "tsc":
+        return StageResult(
+            "clocksource", WARN,
+            f"clocksource {cur} (timestamping is syscall-priced)",
+            "echo tsc > /sys/devices/system/clocksource/clocksource0/"
+            "current_clocksource",
+        )
+    return StageResult("clocksource", OK, "tsc")
+
+
+def check_swap(cfg=None) -> StageResult:
+    raw = _read("/proc/swaps")
+    lines = [ln for ln in raw.splitlines()[1:] if ln.strip()]
+    if lines:
+        return StageResult(
+            "swap", WARN, f"{len(lines)} active swap device(s)",
+            "swapoff -a (paging a validator is a liveness failure)",
+        )
+    return StageResult("swap", OK, "no swap")
+
+
+CHECKS = [check_shm, check_nofile, check_cpus, check_thp,
+          check_clocksource, check_swap]
+INITS = {"nofile": init_nofile}
+
+
+def run(action: str, cfg=None) -> list[StageResult]:
+    out = []
+    for chk in CHECKS:
+        r = chk(cfg)
+        if action == "init" and r.status != OK and r.stage in INITS:
+            try:
+                r = INITS[r.stage](cfg)
+            except (OSError, ValueError) as e:
+                r = StageResult(r.stage, FAIL, f"init failed: {e}", r.remedy)
+        out.append(r)
+    return out
+
+
+def main(args, cfg=None) -> int:
+    results = run(args.action, cfg)
+    worst = OK
+    for r in results:
+        line = f"[{r.status:4}] {r.stage:<12} {r.detail}"
+        if r.remedy and r.status != OK:
+            line += f"\n       remedy: {r.remedy}"
+        print(line)
+        if r.status == FAIL or (worst == OK and r.status == WARN):
+            worst = r.status
+    return 0 if worst != FAIL else 1

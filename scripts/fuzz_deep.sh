@@ -1,0 +1,15 @@
+#!/bin/bash
+# Deep fuzz runs: every parser target at N examples (default 100k),
+# one target per pytest invocation so a crash names its target.
+# Usage: scripts/fuzz_deep.sh [examples]
+set -u
+N="${1:-100000}"
+cd "$(dirname "$0")/.."
+targets=$(JAX_PLATFORMS=cpu python -m pytest tests/test_fuzz.py --collect-only -q 2>/dev/null | grep :: | sed 's/.*:://')
+rc=0
+for t in $targets; do
+  echo "== $t x $N"
+  FDTPU_FUZZ_EXAMPLES="$N" JAX_PLATFORMS=cpu \
+    python -m pytest "tests/test_fuzz.py::$t" -q || rc=1
+done
+exit $rc
