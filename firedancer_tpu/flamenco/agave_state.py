@@ -1,0 +1,340 @@
+"""Agave on-chain account-state layouts: VoteState and StakeStateV2.
+
+Capability parity target: the reference generates ~42k lines of bincode
+(de)serializers for Solana's on-chain types
+(/root/reference/src/flamenco/types/ from fd_types.json; no code
+shared).  This module hand-builds the two layouts that gate reading a
+REAL cluster's accounts — vote accounts (consensus weight, leader
+schedule) and stake accounts (delegations, rewards) — in the exact
+bincode wire format Agave stores, plus converters into this framework's
+internal runtime views (flamenco/stake.StakeState; the vote program's
+compact record).
+
+Layouts are the public protocol's (solana-sdk vote_state/stake_state
+definitions, stable on mainnet):
+
+  VoteStateVersions  = enum { 0: V0_23_5, 1: V1_14_11, 2: Current }
+  VoteState(Current) = node_pubkey | authorized_withdrawer | commission
+      u8 | votes VecDeque<LandedVote{latency u8, Lockout{slot u64,
+      conf u32}}> | root Option<u64> | authorized_voters BTreeMap<u64,
+      Pubkey> | prior_voters CircBuf{[(Pubkey,u64,u64); 32], idx u64,
+      is_empty bool} | epoch_credits Vec<(u64,u64,u64)> |
+      last_timestamp {slot u64, ts i64}
+
+  StakeStateV2 = enum { 0: Uninitialized, 1: Initialized(Meta),
+      2: Stake(Meta, Stake, StakeFlags u8), 3: RewardsPool }
+  Meta  = rent_exempt_reserve u64 | Authorized{staker, withdrawer} |
+      Lockup{unix_timestamp i64, epoch u64, custodian}
+  Stake = Delegation{voter, stake u64, activation_epoch u64,
+      deactivation_epoch u64, warmup_cooldown_rate f64} |
+      credits_observed u64
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from firedancer_tpu.flamenco import types as T
+
+U64_MAX = (1 << 64) - 1
+
+
+# -- vote state ----------------------------------------------------------------
+
+
+@dataclass
+class Lockout:
+    slot: int = 0
+    confirmation_count: int = 0
+
+
+LOCKOUT = T.StructCodec(
+    Lockout, ("slot", T.U64), ("confirmation_count", T.U32),
+)
+
+
+@dataclass
+class LandedVote:
+    latency: int = 0
+    lockout: Lockout = field(default_factory=Lockout)
+
+
+LANDED_VOTE = T.StructCodec(
+    LandedVote, ("latency", T.U8), ("lockout", LOCKOUT),
+)
+
+
+class _BTreeMapU64Pubkey(T.Codec):
+    """BTreeMap<u64, Pubkey>: u64 count + sorted (u64, 32B) pairs."""
+
+    def encode(self, v: dict) -> bytes:
+        out = T.U64.encode(len(v))
+        for k in sorted(v):
+            out += T.U64.encode(k) + bytes(v[k])
+        return out
+
+    def decode(self, buf, off=0):
+        n, off = T.U64.decode(buf, off)
+        if n > 1024:
+            raise T.CodecError(f"authorized_voters map too large ({n})")
+        out = {}
+        for _ in range(n):
+            k, off = T.U64.decode(buf, off)
+            pk, off = T.Pubkey.decode(buf, off)
+            out[k] = pk
+        return out, off
+
+
+@dataclass
+class PriorVoters:
+    buf: list = field(default_factory=lambda: [(bytes(32), 0, 0)] * 32)
+    idx: int = 31
+    is_empty: bool = True
+
+
+class _PriorVotersCodec(T.Codec):
+    def encode(self, v: PriorVoters) -> bytes:
+        out = b""
+        for pk, start, end in v.buf:
+            out += bytes(pk) + T.U64.encode(start) + T.U64.encode(end)
+        return out + T.U64.encode(v.idx) + T.Bool.encode(v.is_empty)
+
+    def decode(self, buf, off=0):
+        entries = []
+        for _ in range(32):
+            pk, off = T.Pubkey.decode(buf, off)
+            a, off = T.U64.decode(buf, off)
+            b, off = T.U64.decode(buf, off)
+            entries.append((pk, a, b))
+        idx, off = T.U64.decode(buf, off)
+        empty, off = T.Bool.decode(buf, off)
+        return PriorVoters(entries, idx, empty), off
+
+
+@dataclass
+class BlockTimestamp:
+    slot: int = 0
+    timestamp: int = 0
+
+
+BLOCK_TIMESTAMP = T.StructCodec(
+    BlockTimestamp, ("slot", T.U64), ("timestamp", T.I64),
+)
+
+
+class _EpochCredits(T.Codec):
+    """Vec<(epoch u64, credits u64, prev_credits u64)>."""
+
+    def encode(self, v: list) -> bytes:
+        out = T.U64.encode(len(v))
+        for epoch, credits, prev in v:
+            out += T.U64.encode(epoch) + T.U64.encode(credits) \
+                + T.U64.encode(prev)
+        return out
+
+    def decode(self, buf, off=0):
+        n, off = T.U64.decode(buf, off)
+        if n > 4096:
+            raise T.CodecError(f"epoch_credits too large ({n})")
+        out = []
+        for _ in range(n):
+            e, off = T.U64.decode(buf, off)
+            c, off = T.U64.decode(buf, off)
+            p, off = T.U64.decode(buf, off)
+            out.append((e, c, p))
+        return out, off
+
+
+@dataclass
+class VoteState:
+    node_pubkey: bytes = bytes(32)
+    authorized_withdrawer: bytes = bytes(32)
+    commission: int = 0
+    votes: list = field(default_factory=list)  # [LandedVote]
+    root_slot: int | None = None
+    authorized_voters: dict = field(default_factory=dict)  # epoch -> pk
+    prior_voters: PriorVoters = field(default_factory=PriorVoters)
+    epoch_credits: list = field(default_factory=list)
+    last_timestamp: BlockTimestamp = field(default_factory=BlockTimestamp)
+
+    def authorized_voter_for(self, epoch: int) -> bytes | None:
+        """The voter authorized at `epoch`: the entry with the greatest
+        key <= epoch (Agave's AuthorizedVoters::get_authorized_voter)."""
+        best = None
+        for e in sorted(self.authorized_voters):
+            if e <= epoch:
+                best = self.authorized_voters[e]
+        return best
+
+    def credits(self) -> int:
+        return self.epoch_credits[-1][1] if self.epoch_credits else 0
+
+
+_VOTE_STATE_BODY = T.StructCodec(
+    VoteState,
+    ("node_pubkey", T.Pubkey),
+    ("authorized_withdrawer", T.Pubkey),
+    ("commission", T.U8),
+    ("votes", T.Vec(LANDED_VOTE, max_len=64)),
+    ("root_slot", T.Option(T.U64)),
+    ("authorized_voters", _BTreeMapU64Pubkey()),
+    ("prior_voters", _PriorVotersCodec()),
+    ("epoch_credits", _EpochCredits()),
+    ("last_timestamp", BLOCK_TIMESTAMP),
+)
+
+
+def vote_state_encode(vs: VoteState) -> bytes:
+    """Current-version envelope (enum tag 2)."""
+    return T.U32.encode(2) + _VOTE_STATE_BODY.encode(vs)
+
+
+def vote_state_decode(data: bytes) -> VoteState:
+    tag, off = T.U32.decode(data, 0)
+    if tag != 2:
+        raise T.CodecError(f"unsupported VoteState version {tag}")
+    vs, _ = _VOTE_STATE_BODY.decode(data, off)
+    return vs
+
+
+# -- stake state ---------------------------------------------------------------
+
+
+@dataclass
+class Authorized:
+    staker: bytes = bytes(32)
+    withdrawer: bytes = bytes(32)
+
+
+AUTHORIZED = T.StructCodec(
+    Authorized, ("staker", T.Pubkey), ("withdrawer", T.Pubkey),
+)
+
+
+@dataclass
+class Lockup:
+    unix_timestamp: int = 0
+    epoch: int = 0
+    custodian: bytes = bytes(32)
+
+
+LOCKUP = T.StructCodec(
+    Lockup, ("unix_timestamp", T.I64), ("epoch", T.U64),
+    ("custodian", T.Pubkey),
+)
+
+
+@dataclass
+class Meta:
+    rent_exempt_reserve: int = 0
+    authorized: Authorized = field(default_factory=Authorized)
+    lockup: Lockup = field(default_factory=Lockup)
+
+
+META = T.StructCodec(
+    Meta, ("rent_exempt_reserve", T.U64), ("authorized", AUTHORIZED),
+    ("lockup", LOCKUP),
+)
+
+
+@dataclass
+class Delegation:
+    voter_pubkey: bytes = bytes(32)
+    stake: int = 0
+    activation_epoch: int = 0
+    deactivation_epoch: int = U64_MAX
+    warmup_cooldown_rate: float = 0.25
+
+
+DELEGATION = T.StructCodec(
+    Delegation,
+    ("voter_pubkey", T.Pubkey),
+    ("stake", T.U64),
+    ("activation_epoch", T.U64),
+    ("deactivation_epoch", T.U64),
+    ("warmup_cooldown_rate", T.F64),
+)
+
+
+@dataclass
+class StakeV2:
+    delegation: Delegation = field(default_factory=Delegation)
+    credits_observed: int = 0
+
+
+STAKE_V2 = T.StructCodec(
+    StakeV2, ("delegation", DELEGATION), ("credits_observed", T.U64),
+)
+
+
+@dataclass
+class StakeMetaPair:
+    meta: Meta = field(default_factory=Meta)
+    stake: StakeV2 = field(default_factory=StakeV2)
+    flags: int = 0
+
+
+class _StakePairCodec(T.Codec):
+    def encode(self, v: StakeMetaPair) -> bytes:
+        return META.encode(v.meta) + STAKE_V2.encode(v.stake) \
+            + T.U8.encode(v.flags)
+
+    def decode(self, buf, off=0):
+        meta, off = META.decode(buf, off)
+        stake, off = STAKE_V2.decode(buf, off)
+        flags, off = T.U8.decode(buf, off)
+        return StakeMetaPair(meta, stake, flags), off
+
+
+STAKE_STATE_V2 = T.Enum(
+    (0, "uninitialized", None),
+    (1, "initialized", META),
+    (2, "stake", _StakePairCodec()),
+    (3, "rewards_pool", None),
+)
+
+
+# -- converters into the runtime's internal views ------------------------------
+
+
+def to_internal_stake(data: bytes):
+    """Agave StakeStateV2 account bytes -> flamenco/stake.StakeState
+    (the runtime's compact view); None for uninitialized/rewards-pool."""
+    from firedancer_tpu.flamenco import stake as S
+
+    (kind, payload), _ = STAKE_STATE_V2.decode(data, 0)
+    if kind == "initialized":
+        return S.StakeState(
+            state=S.STATE_INIT,
+            staker=payload.authorized.staker,
+            withdrawer=payload.authorized.withdrawer,
+        )
+    if kind == "stake":
+        d = payload.stake.delegation
+        return S.StakeState(
+            state=S.STATE_DELEGATED,
+            staker=payload.meta.authorized.staker,
+            withdrawer=payload.meta.authorized.withdrawer,
+            voter=d.voter_pubkey,
+            stake=d.stake,
+            activation_epoch=d.activation_epoch,
+            deactivation_epoch=d.deactivation_epoch,
+        )
+    return None
+
+
+def vote_account_summary(data: bytes, *, epoch: int) -> dict:
+    """The fields consensus consumes from a real vote account: node
+    identity, the epoch's authorized voter, credits, last vote."""
+    vs = vote_state_decode(data)
+    return {
+        "node_pubkey": vs.node_pubkey,
+        "authorized_voter": vs.authorized_voter_for(epoch),
+        "authorized_withdrawer": vs.authorized_withdrawer,
+        "commission": vs.commission,
+        "credits": vs.credits(),
+        "last_voted_slot": (
+            vs.votes[-1].lockout.slot if vs.votes else None
+        ),
+        "root_slot": vs.root_slot,
+    }

@@ -1,0 +1,131 @@
+"""Agave on-chain state layouts: byte-exact round trips, hand-built
+wire vectors, internal-view conversion."""
+
+import struct
+
+import pytest
+
+from firedancer_tpu.flamenco import agave_state as A
+from firedancer_tpu.flamenco import stake as S
+from firedancer_tpu.flamenco import types as T
+
+
+def _vote_state():
+    return A.VoteState(
+        node_pubkey=b"\x01" * 32,
+        authorized_withdrawer=b"\x02" * 32,
+        commission=5,
+        votes=[
+            A.LandedVote(latency=1, lockout=A.Lockout(100, 31)),
+            A.LandedVote(latency=0, lockout=A.Lockout(101, 30)),
+        ],
+        root_slot=99,
+        authorized_voters={3: b"\x04" * 32, 7: b"\x05" * 32},
+        epoch_credits=[(5, 1000, 900), (6, 1100, 1000)],
+        last_timestamp=A.BlockTimestamp(slot=101, timestamp=1_700_000_000),
+    )
+
+
+def test_vote_state_roundtrip():
+    vs = _vote_state()
+    blob = A.vote_state_encode(vs)
+    out = A.vote_state_decode(blob)
+    assert out.node_pubkey == vs.node_pubkey
+    assert out.commission == 5
+    assert [v.lockout.slot for v in out.votes] == [100, 101]
+    assert out.root_slot == 99
+    assert out.authorized_voters == vs.authorized_voters
+    assert out.epoch_credits == vs.epoch_credits
+    assert out.last_timestamp.timestamp == 1_700_000_000
+
+
+def test_vote_state_wire_layout_is_bincode_exact():
+    """Hand-check the byte layout: version tag, pubkeys, vec prefix."""
+    vs = _vote_state()
+    blob = A.vote_state_encode(vs)
+    assert blob[:4] == (2).to_bytes(4, "little")       # Current version
+    assert blob[4:36] == b"\x01" * 32                   # node_pubkey
+    assert blob[36:68] == b"\x02" * 32                  # withdrawer
+    assert blob[68] == 5                                # commission
+    assert blob[69:77] == (2).to_bytes(8, "little")     # votes len u64
+    # first LandedVote: latency u8 | slot u64 | conf u32
+    assert blob[77] == 1
+    assert blob[78:86] == (100).to_bytes(8, "little")
+    assert blob[86:90] == (31).to_bytes(4, "little")
+    # root Option<u64>: 1-byte Some tag then value
+    off = 77 + 2 * 13
+    assert blob[off] == 1
+    assert blob[off + 1 : off + 9] == (99).to_bytes(8, "little")
+
+
+def test_authorized_voter_epoch_rule():
+    vs = _vote_state()
+    assert vs.authorized_voter_for(2) is None
+    assert vs.authorized_voter_for(3) == b"\x04" * 32
+    assert vs.authorized_voter_for(6) == b"\x04" * 32
+    assert vs.authorized_voter_for(7) == b"\x05" * 32
+    assert vs.authorized_voter_for(100) == b"\x05" * 32
+
+
+def test_vote_state_unknown_version_rejected():
+    with pytest.raises(T.CodecError):
+        A.vote_state_decode((7).to_bytes(4, "little") + bytes(128))
+
+
+def test_stake_state_v2_roundtrip_and_layout():
+    pair = A.StakeMetaPair(
+        meta=A.Meta(
+            rent_exempt_reserve=2_282_880,
+            authorized=A.Authorized(b"\x0a" * 32, b"\x0b" * 32),
+            lockup=A.Lockup(0, 0, b"\x0c" * 32),
+        ),
+        stake=A.StakeV2(
+            delegation=A.Delegation(
+                voter_pubkey=b"\x0d" * 32,
+                stake=5_000_000_000,
+                activation_epoch=11,
+                deactivation_epoch=A.U64_MAX,
+                warmup_cooldown_rate=0.25,
+            ),
+            credits_observed=12345,
+        ),
+        flags=0,
+    )
+    blob = A.STAKE_STATE_V2.encode(("stake", pair))
+    assert blob[:4] == (2).to_bytes(4, "little")       # enum tag
+    assert blob[4:12] == (2_282_880).to_bytes(8, "little")
+    assert blob[12:44] == b"\x0a" * 32                 # staker
+    # delegation voter sits after meta (8 + 64 + 48 = 120) + tag 4
+    assert blob[124:156] == b"\x0d" * 32
+    assert struct.unpack_from("<d", blob, 180)[0] == 0.25
+    (kind, out), _ = A.STAKE_STATE_V2.decode(blob, 0)
+    assert kind == "stake"
+    assert out.stake.delegation.stake == 5_000_000_000
+    assert out.stake.credits_observed == 12345
+
+    # internal conversion feeds the runtime's warmup/cooldown machinery
+    st = A.to_internal_stake(blob)
+    assert st.state == S.STATE_DELEGATED
+    assert st.voter == b"\x0d" * 32 and st.stake == 5_000_000_000
+    assert st.activation_epoch == 11
+    assert S.effective_stake(st, 11 + 4) == 5_000_000_000
+
+
+def test_stake_state_uninitialized_and_initialized():
+    blob = A.STAKE_STATE_V2.encode(("uninitialized", None))
+    assert blob == (0).to_bytes(4, "little")
+    assert A.to_internal_stake(blob) is None
+
+    meta = A.Meta(authorized=A.Authorized(b"\x01" * 32, b"\x02" * 32))
+    blob2 = A.STAKE_STATE_V2.encode(("initialized", meta))
+    st = A.to_internal_stake(blob2)
+    assert st.state == S.STATE_INIT and st.withdrawer == b"\x02" * 32
+
+
+def test_vote_account_summary():
+    vs = _vote_state()
+    s = A.vote_account_summary(A.vote_state_encode(vs), epoch=7)
+    assert s["authorized_voter"] == b"\x05" * 32
+    assert s["credits"] == 1100
+    assert s["last_voted_slot"] == 101
+    assert s["root_slot"] == 99
