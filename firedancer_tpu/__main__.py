@@ -3,13 +3,21 @@
 Mirrors the reference's action table (/root/reference/src/app/fdctl/
 main1.c: run / monitor / keys / configure / version, and fddev's bench):
 
-    run      build the leader pipeline from a TOML config and drive it;
-             prints a monitor table + txn/s on exit
-    keys     new <path> | pubkey <path> — identity keypair management
-    bench    quick pipeline throughput measurement (bench.py has the
-             full headline benchmark)
-    config   print the effective layered configuration
-    version  print the framework version
+    run        build the leader pipeline from a TOML config and drive it
+               (--processes: one supervised OS process per stage;
+               --sandbox: seccomp jail each stage); monitor table on exit
+    monitor    live per-stage TUI attached to a running topology
+    ready      block until every stage of a running topology is RUN
+    configure  host setup stages: check | init (shm, fds, cpus, THP...)
+    keys       new <path> | pubkey <path> — identity keypair management
+    bench      quick pipeline throughput measurement (bench.py has the
+               full headline benchmark)
+    genesis    create | show a genesis blob (+ faucet key)
+    snapshot   inspect a snapshot archive
+    ledger     show | ingest | replay a stored ledger (bank-hash checks)
+    backtest   replay a consensus scenario through ghost/tower
+    config     print the effective layered configuration
+    version    print the framework version
 
 Every action takes --config <file.toml> where relevant (layered over the
 embedded defaults, utils/config.py).
@@ -39,6 +47,8 @@ def cmd_run(args) -> int:
     if args.cpu:
         force_cpu_backend()
     enable_compile_cache()
+    if getattr(args, "processes", False):
+        return _run_processes(args)
     from firedancer_tpu.models.leader import build_leader_pipeline_from_config
 
     cfg = _load_cfg(args)
@@ -81,6 +91,34 @@ def cmd_run(args) -> int:
         if rpc_srv is not None:
             rpc_srv.close()
         pipe.close()
+
+
+def _run_processes(args) -> int:
+    """The fdctl-run model: every stage its own supervised OS process
+    over shm links, optional per-stage jail, monitor table at exit."""
+    from firedancer_tpu.models.leader_topo import build_leader_topology
+    from firedancer_tpu.runtime import topo as ft
+    from firedancer_tpu.runtime.stage import Stage
+
+    sandbox = {"rlimits": {"nofile": 512}} if args.sandbox else None
+    topo = build_leader_topology(
+        n_txns=args.txns, pool_size=args.txns, batch=16, sandbox=sandbox,
+    )
+    h = ft.launch(topo)
+    try:
+        print(f"# {len(h.procs)} stage processes; descriptor "
+              f"fdtpu_run_{h.uid}.json"
+              + (" (sandboxed)" if sandbox else ""), file=sys.stderr)
+        ok = h.supervise(
+            until=lambda h: h.cncs["store"].diag(Stage.DIAG_FRAGS_IN) > 0,
+            timeout_s=600,
+            heartbeat_timeout_s=300,
+        )
+        print(h.format_monitor())
+        h.halt()
+        return 0 if ok else 1
+    finally:
+        h.close()
 
 
 def cmd_keys(args) -> int:
@@ -237,6 +275,16 @@ def main(argv=None) -> int:
     runp.add_argument(
         "--rpc-port", type=int, default=None,
         help="serve JSON-RPC (getTransactionCount/getSlot/...) during the run",
+    )
+    runp.add_argument(
+        "--processes", action="store_true",
+        help="run every stage as its own supervised OS process "
+             "(the fdctl run model); implies --cpu in the children",
+    )
+    runp.add_argument(
+        "--sandbox", action="store_true",
+        help="with --processes: jail each stage (seccomp deny of "
+             "spawn/exec/priv syscalls + rlimits)",
     )
 
     keysp = sub.add_parser("keys", help="identity keypair management")

@@ -150,7 +150,12 @@ def build_leader_topology(
     n_bank: int = 2,
     leader_seed: bytes = b"leader",
     slot: int = 1,
+    sandbox: dict | None = None,
 ) -> ft.Topology:
+    """sandbox: utils/sandbox.enter kwargs applied to EVERY stage child
+    (the per-tile jail; fd_topo_run's seccomp step).  The default policy
+    shape: {"rlimits": {"nofile": 512}} + the spawn/exec/priv deny list,
+    with thread-creating clones allowed for XLA."""
     from firedancer_tpu.ops.ref import ed25519_ref as ref
 
     topo = ft.Topology()
@@ -167,13 +172,15 @@ def build_leader_topology(
     secret = hashlib.sha256(leader_seed).digest()
     leader_pub = ref.public_key(secret)
 
-    topo.stage("benchg", build_benchg, pool_size=pool_size, n_txns=n_txns)
-    topo.stage("verify0", build_verify, batch=batch)
-    topo.stage("dedup", build_dedup)
-    topo.stage("pack", build_pack, n_bank=n_bank)
+    sb = sandbox
+    topo.stage("benchg", build_benchg, pool_size=pool_size, n_txns=n_txns,
+               sandbox=sb)
+    topo.stage("verify0", build_verify, batch=batch, sandbox=sb)
+    topo.stage("dedup", build_dedup, sandbox=sb)
+    topo.stage("pack", build_pack, n_bank=n_bank, sandbox=sb)
     for b in range(n_bank):
-        topo.stage(f"bank{b}", build_bank, bank_idx=b)
-    topo.stage("poh", build_poh, n_bank=n_bank)
-    topo.stage("shred", build_shred, secret=secret, slot=slot)
-    topo.stage("store", build_store, leader_pub=leader_pub)
+        topo.stage(f"bank{b}", build_bank, bank_idx=b, sandbox=sb)
+    topo.stage("poh", build_poh, n_bank=n_bank, sandbox=sb)
+    topo.stage("shred", build_shred, secret=secret, slot=slot, sandbox=sb)
+    topo.stage("store", build_store, leader_pub=leader_pub, sandbox=sb)
     return topo

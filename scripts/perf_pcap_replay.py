@@ -1,0 +1,111 @@
+"""Measured config 4 (BASELINE.md): pcap replay -> verify -> dedup ->
+pack, no live network.
+
+Builds a capture of signed transfer txns (stand-in for a mainnet TPU
+capture; the container format is tcpdump-compatible so a real capture
+drops in), replays it through the ingress chain, and reports txn/s at
+pack admission.
+
+    python scripts/perf_pcap_replay.py [n_txns] [--device]
+
+Default runs the verify stage with the precomputed mask (host machinery
+figure); --device dispatches the real kernels on the current backend.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    n = 4096
+    device = False
+    for a in sys.argv[1:]:
+        if a == "--device":
+            device = True
+        else:
+            n = int(a)
+    if not device:
+        from firedancer_tpu.utils.platform import force_cpu_backend
+
+        force_cpu_backend(device_count=1)
+
+    import tempfile
+
+    from firedancer_tpu.pack.scheduler import Pack
+    from firedancer_tpu.runtime.benchg import gen_transfer_pool
+    from firedancer_tpu.runtime.dedup import DedupStage
+    from firedancer_tpu.runtime.verify import VerifyStage, decode_verified
+    from firedancer_tpu.tango import shm
+    from firedancer_tpu.utils import pcap
+
+    t0 = time.time()
+    pool = gen_transfer_pool(min(n, 4096), seed=b"pcap-bench")
+    cap = os.path.join(tempfile.mkdtemp(), "tpu.pcap")
+    with pcap.PcapWriter(cap) as w:
+        for i in range(n):
+            w.write_udp(pool[i % len(pool)], dst=("127.0.0.1", 9001))
+    print(f"# capture: {n} txns in {time.time()-t0:.1f}s", file=sys.stderr)
+
+    uid = f"{os.getpid()}_{int(time.monotonic_ns() % 1_000_000)}"
+    nv = shm.ShmLink.create(f"fdtpu_pr_nv_{uid}", depth=8192, mtu=1232)
+    vd = shm.ShmLink.create(f"fdtpu_pr_vd_{uid}", depth=8192, mtu=4096)
+    dp = shm.ShmLink.create(f"fdtpu_pr_dp_{uid}", depth=8192, mtu=4096)
+    try:
+        verify = VerifyStage(
+            "verify0", ins=[shm.Consumer(nv, lazy=64)],
+            outs=[shm.Producer(vd)], batch=512, max_msg_len=256,
+            precomputed_ok=not device, batch_deadline_s=0.005,
+        )
+        dedup = DedupStage("dedup", ins=[shm.Consumer(vd, lazy=64)],
+                           outs=[shm.Producer(dp)])
+        sink = shm.Consumer(dp, lazy=64)
+        prod = shm.Producer(nv)
+        pack = Pack()
+
+        pending = []
+
+        def ingest(payload, _src):
+            pending.append(payload)
+
+        admitted = 0
+        t0 = time.time()
+        n_replayed = pcap.replay_udp(cap, ingest, port=9001)
+        i = 0
+        spins = 0
+        while admitted < len(pool) and spins < 2_000_000:
+            progressed = False
+            while i < len(pending) and prod.try_publish(pending[i]):
+                i += 1
+                progressed = True
+            verify.run_once()
+            dedup.run_once()
+            res = sink.poll()
+            while isinstance(res, tuple):
+                payload, desc = decode_verified(res[1])
+                if pack.insert(payload, desc):
+                    admitted += 1
+                progressed = True
+                res = sink.poll()
+            if i >= len(pending) and not progressed:
+                verify.flush()
+                spins += 1
+        dt = time.time() - t0
+        print(
+            f"# pcap replay: {n_replayed} datagrams; {admitted} unique "
+            f"txns admitted to pack in {dt:.2f}s = {n_replayed/dt:,.0f} "
+            f"txn/s through the chain "
+            f"({'device kernels' if device else 'precomputed mask'})",
+            file=sys.stderr,
+        )
+        dd = dedup.metrics.get("dedup_dup")
+        print(f"# dedup dropped {dd} replayed duplicates", file=sys.stderr)
+    finally:
+        for l in (nv, vd, dp):
+            l.close()
+            l.unlink()
+
+
+if __name__ == "__main__":
+    main()
