@@ -45,13 +45,39 @@ def force_cpu_backend(device_count: int | None = None) -> None:
         pass
 
 
+def _config_fingerprint() -> str:
+    """Discriminator for persistent-cache partitioning: AOT entries are
+    only valid for the exact target configuration that compiled them.
+    Mixing configurations in one directory SEGFAULTS — XLA:CPU AOT
+    deserialization trusts the entry's machine-feature list, and entries
+    written under a different XLA_FLAGS/device-count carry pseudo
+    features (prefer-no-scatter/gather) this process's target config
+    lacks (observed: SIGSEGV inside compilation_cache
+    get_executable_and_time during the CPU test suite)."""
+    import hashlib
+
+    import jaxlib
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    plat = os.environ.get("JAX_PLATFORMS", "any")
+    h = hashlib.sha256(
+        f"{jaxlib.__version__}|{plat}|{flags}".encode()
+    ).hexdigest()[:12]
+    return h
+
+
 def default_cache_dir() -> str:
-    """The repo-wide persistent compile-cache dir (single source of truth:
-    bench.py, __graft_entry__.py and tests/conftest.py all share one cache,
-    so no path drift can silently split it)."""
+    """The persistent compile-cache dir for THIS target configuration.
+
+    One subdirectory per (jaxlib, platform, XLA_FLAGS) fingerprint:
+    bench.py, __graft_entry__.py and tests/conftest.py still share a
+    cache whenever their configuration genuinely matches, while
+    incompatible AOT entries can never collide (see
+    _config_fingerprint)."""
     return os.path.join(
         os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
         ".jax_cache",
+        _config_fingerprint(),
     )
 
 
@@ -64,5 +90,16 @@ def enable_compile_cache(path: str | None = None, min_compile_secs: float = 1.0)
     """
     import jax
 
-    jax.config.update("jax_compilation_cache_dir", path or default_cache_dir())
+    # explicit paths get the same per-configuration partitioning as the
+    # default: mixed-configuration AOT entries in one directory can
+    # segfault at cache-load time (see _config_fingerprint)
+    base = path or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))),
+        ".jax_cache",
+    )
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(base, _config_fingerprint()),
+    )
     jax.config.update("jax_persistent_cache_min_compile_time_secs", min_compile_secs)
