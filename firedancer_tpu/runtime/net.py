@@ -17,6 +17,7 @@ and counted, mirroring fd_quic's MTU policy.
 from __future__ import annotations
 
 import errno
+import os
 import socket
 
 from firedancer_tpu.protocol.txn import TXN_MTU
@@ -189,6 +190,8 @@ class QuicIngressStage(UdpIngressStage):
         self.identity_secret = identity_secret
         self.max_conns = max_conns
         self.conns: dict = {}
+        self._addr_by_cid: dict = {}   # server CID -> current peer addr
+        self._migrations: dict = {}    # CID -> (candidate addr, token)
         self.reasm = TpuReasm(depth=reasm_depth)
         # tx_filter(datagram) -> bool; False drops the datagram before the
         # socket (loss-recovery tests simulate lossy links with it)
@@ -215,6 +218,19 @@ class QuicIngressStage(UdpIngressStage):
 
         conn = self.conns.get(src)
         fresh = conn is None
+        migrating_cid = None
+        if fresh:
+            # connection migration (RFC 9000 §9): an unknown address
+            # whose packet carries a KNOWN connection id belongs to an
+            # established peer that changed path — look the conn up by
+            # CID, process normally, and validate the new path with a
+            # PATH_CHALLENGE before replies move there
+            cid = quic.peek_dcid(data, short_dcid_len=8)
+            home = self._addr_by_cid.get(cid) if cid else None
+            if home is not None and home in self.conns:
+                conn = self.conns[home]
+                fresh = False
+                migrating_cid = cid
         if fresh:
             if len(self.conns) >= self.max_conns and not self._evict():
                 self.metrics.inc("conn_drop")
@@ -238,9 +254,35 @@ class QuicIngressStage(UdpIngressStage):
             return True
         if fresh:
             self.conns[src] = conn
+            self._addr_by_cid[bytes(conn.local_cid)] = src
         self.metrics.inc("pkt_rx")
+        home = (self._addr_by_cid.get(migrating_cid, src)
+                if migrating_cid else src)
+        if migrating_cid is not None:
+            # complete or advance path validation for the new address
+            pend = self._migrations.get(migrating_cid)
+            if pend is not None and any(
+                r == pend[1] for r in conn.path_responses
+            ):
+                conn.path_responses.clear()
+                del self._migrations[migrating_cid]
+                old = self._addr_by_cid[migrating_cid]
+                self.conns.pop(old, None)
+                self.conns[src] = conn
+                self._addr_by_cid[migrating_cid] = src
+                home = src
+                self.metrics.inc("migrated")
+            elif pend is None or pend[0] != src:
+                token = os.urandom(8)
+                self._migrations[migrating_cid] = (src, token)
+                probe = conn.probe_datagram(
+                    bytes([quic.FT_PATH_CHALLENGE]) + token
+                )
+                if probe is not None:
+                    self._send(probe, src)
+                    self.metrics.inc("path_challenge_tx")
         for dg in conn.flush():
-            self._send(dg, src)
+            self._send(dg, home)
         ok = True
         for sid, chunk, fin in conn.receive_stream_events(events):
             # every chunk feeds reassembly even under backpressure — the

@@ -65,6 +65,8 @@ FT_STREAMS_BLOCKED_BIDI = 0x16
 FT_STREAMS_BLOCKED_UNI = 0x17
 FT_NEW_CONNECTION_ID = 0x18
 FT_RETIRE_CONNECTION_ID = 0x19
+FT_PATH_CHALLENGE = 0x1A
+FT_PATH_RESPONSE = 0x1B
 FT_CONN_CLOSE = 0x1C
 FT_HANDSHAKE_DONE = 0x1E
 
@@ -324,6 +326,25 @@ class StreamEvent:
     fin: bool
 
 
+def peek_dcid(datagram: bytes, *, short_dcid_len: int) -> bytes | None:
+    """Destination CID of the first packet without unprotecting it —
+    the connection-lookup key (a migrating peer keeps its CID while its
+    address changes, RFC 9000 §9)."""
+    if not datagram:
+        return None
+    first = datagram[0]
+    if first & 0x80:  # long header
+        if len(datagram) < 7:
+            return None
+        dlen = datagram[5]
+        if len(datagram) < 6 + dlen:
+            return None
+        return bytes(datagram[6 : 6 + dlen])
+    if len(datagram) < 1 + short_dcid_len:
+        return None
+    return bytes(datagram[1 : 1 + short_dcid_len])
+
+
 def parse_frames(payload: bytes):
     """Yield ('crypto', off, data) | ('stream', StreamEvent) |
     ('ack', ranges) | ('max_data', n) | ('max_stream_data', sid, n) |
@@ -334,6 +355,14 @@ def parse_frames(payload: bytes):
         ft = payload[off]
         off += 1
         if ft == FT_PADDING:
+            continue
+        if ft in (FT_PATH_CHALLENGE, FT_PATH_RESPONSE):
+            if off + 8 > n:
+                raise QuicError("truncated path frame")
+            kind = ("path_challenge" if ft == FT_PATH_CHALLENGE
+                    else "path_response")
+            yield (kind, payload[off : off + 8])
+            off += 8
             continue
         if ft == FT_PING:
             # ack-eliciting (RFC 9002): a PING-only PTO probe that never
@@ -564,6 +593,10 @@ class Connection:
         self.ctrl_out: list[bytes] = []  # fire-and-forget ctrl frames
         self.closed = False
         self.handshake_done_sent = False
+        # path validation (RFC 9000 §8.2/§9): responses we owe ride the
+        # next flush; responses we RECEIVED surface for the transport
+        # owner (the ingress stage) to complete a migration
+        self.path_responses: list[bytes] = []
         # flow control: our receive windows (advertised to the peer)
         self.rx_max_data = DEFAULT_MAX_DATA
         self.rx_consumed = 0
@@ -657,6 +690,13 @@ class Connection:
                     cur = self.tx_stream_limit.get(sid, DEFAULT_MAX_STREAM_DATA)
                     self.tx_stream_limit[sid] = max(cur, v)
                     self._drain_blocked()
+                elif ev[0] == "path_challenge":
+                    # §8.2.2: echo the 8 bytes in a PATH_RESPONSE
+                    self.ctrl_out.append(
+                        bytes([FT_PATH_RESPONSE]) + ev[1]
+                    )
+                elif ev[0] == "path_response":
+                    self.path_responses.append(ev[1])
                 elif ev[0] == "close":
                     self.closed = True
         return events
@@ -878,6 +918,25 @@ class Connection:
                 if record:
                     self.sent[lvl][pn] = SentPacket(pn, now, record)
         return out
+
+    def probe_datagram(self, frames: bytes) -> bytes | None:
+        """Seal ONE application packet carrying `frames` for an
+        off-path probe (PATH_CHALLENGE to a migrating peer's new
+        address).  Untracked: a lost probe is re-issued by the caller on
+        the next datagram from that address, never retransmitted onto
+        the wrong path by flush()."""
+        if APPLICATION not in self.keys_tx:
+            return None
+        payload = frames if len(frames) >= 4 else frames + bytes(
+            4 - len(frames)
+        )
+        pn = self.pn_next[APPLICATION]
+        self.pn_next[APPLICATION] += 1
+        return seal_packet(
+            self.keys_tx[APPLICATION], level=APPLICATION,
+            dcid=self.remote_cid, scid=self.local_cid, pn=pn,
+            payload=payload,
+        )
 
     def receive_stream_events(self, events: list[StreamEvent]):
         """Reassemble stream events into (stream_id, bytes, fin) chunks
