@@ -843,6 +843,32 @@ def gen_vm():
          accounts=[acc],
          iaccts=[InstrAcctRef(index=1, is_writable=False)],
          result=1)
+    # 7. sol_sha256 over the instruction data via a stack slice
+    #    descriptor, result returned through sol_set_return_data —
+    #    expectation = sha256(payload), derived here, never from the VM
+    import hashlib as _hl
+
+    payload7 = b"hash me through the vm"
+    text7 = (
+        _vm_lddw(6, MM_INPUT + 16)                     # data va
+        + _vm_ins(0x7B, dst=10, src=6, off=-16)        # [r10-16] = addr
+        + _vm_ins(0xB7, dst=7, imm=len(payload7))
+        + _vm_ins(0x7B, dst=10, src=7, off=-8)         # [r10-8] = len
+        + _vm_ins(0xBF, dst=1, src=10)
+        + _vm_ins(0x07, dst=1, imm=-16)                # r1 = &slices
+        + _vm_ins(0xB7, dst=2, imm=1)                  # one slice
+        + _vm_ins(0xBF, dst=3, src=10)
+        + _vm_ins(0x07, dst=3, imm=-48)                # r3 = &result
+        + _vm_ins(0x85, imm=syscall_id("sol_sha256"))
+        + _vm_ins(0xBF, dst=1, src=10)
+        + _vm_ins(0x07, dst=1, imm=-48)
+        + _vm_ins(0xB7, dst=2, imm=32)
+        + _vm_ins(0x85, imm=syscall_id("sol_set_return_data"))
+        + _vm_ins(0xB7, dst=0, imm=0)
+        + EXIT
+    )
+    vmfx("sha256_syscall", text7, data=payload7,
+         ret=_hl.sha256(payload7).digest())
 
 
 if __name__ == "__main__":
