@@ -90,6 +90,18 @@ def enable_compile_cache(path: str | None = None, min_compile_secs: float = 1.0)
     """
     import jax
 
+    # CPU AOT persistence is UNSOUND in this jaxlib: serializing or
+    # deserializing the big sigverify executables segfaults
+    # nondeterministically (observed in both compilation_cache
+    # put_executable_and_time and get_executable_and_time during the
+    # test suite).  The TPU path serializes through a different backend
+    # and has been stable, so the persistent cache stays enabled there;
+    # CPU processes run with in-memory caching only.
+    # FDTPU_FORCE_COMPILE_CACHE=1 overrides for debugging.
+    if "cpu" in os.environ.get("JAX_PLATFORMS", "") and not os.environ.get(
+        "FDTPU_FORCE_COMPILE_CACHE"
+    ):
+        return
     # explicit paths get the same per-configuration partitioning as the
     # default: mixed-configuration AOT entries in one directory can
     # segfault at cache-load time (see _config_fingerprint)
