@@ -707,7 +707,7 @@ def gen_budget():
 
 def main():
     for fam in ("system2", "stake", "vote", "alt", "budget", "nonce",
-                "config", "vm"):
+                "config", "vm", "loader"):
         shutil.rmtree(os.path.join(ROOT, fam), ignore_errors=True)
     gen_system()
     gen_stake()
@@ -717,6 +717,7 @@ def main():
     gen_nonce()
     gen_config()
     gen_vm()
+    gen_loader()
     print(f"{count} fixtures written")
 
 
@@ -869,6 +870,172 @@ def gen_vm():
     )
     vmfx("sha256_syscall", text7, data=payload7,
          ret=_hl.sha256(payload7).digest())
+
+
+
+
+# -- upgradeable BPF loader lifecycle ------------------------------------------
+
+
+def gen_loader():
+    from firedancer_tpu.flamenco import bpf_loader as bl
+    from firedancer_tpu.protocol import pda as _pda
+
+    fam = "loader"
+    LD = bl.UPGRADEABLE_LOADER_PROGRAM
+    payer, auth, other = key("ld:payer"), key("ld:auth"), key("ld:other")
+    program = key("ld:program")
+    progdata, _bump = _pda.find_program_address([program], LD)
+    elf = _vm_elf(_vm_ins(0xB7, dst=0, imm=0) + _vm_ins(0x95))
+
+    def lacct(addr, lamports, data=b"", owner=LD, executable=False):
+        return AcctState(address=addr, lamports=lamports, data=bytes(data),
+                         owner=owner, executable=executable)
+
+    # initialize buffer
+    buf_key = key("ld:buffer")
+    raw_buf = lacct(buf_key, 30, data=bytes(bl.BUFFER_META_SIZE + len(elf)))
+    init_data = u32(0)
+    fx(fam, "init_buffer_ok", LD,
+       [raw_buf, acct(auth, 0)],
+       refs((0, False, True), (1, False, False)), init_data,
+       modified=[lacct(buf_key, 30,
+                       data=bl.buffer_encode(auth)
+                       + bytes(len(elf)))])
+    fx(fam, "init_buffer_small", LD,
+       [lacct(buf_key, 30, data=bytes(bl.BUFFER_META_SIZE - 1)),
+        acct(auth, 0)],
+       refs((0, False, True), (1, False, False)), init_data, result=1)
+    inited = lacct(buf_key, 30,
+                   data=bl.buffer_encode(auth) + bytes(len(elf)))
+    fx(fam, "init_buffer_twice", LD,
+       [inited, acct(auth, 0)],
+       refs((0, False, True), (1, False, False)), init_data, result=1)
+
+    # write into the buffer
+    def wdata(offset, payload):
+        return (u32(1) + u32(offset) + u64(len(payload)) + payload)
+
+    full_buf = lacct(buf_key, 30, data=bl.buffer_encode(auth) + elf)
+    fx(fam, "write_ok", LD,
+       [inited, acct(auth, 0)],
+       refs((0, False, True), (1, True, False)), wdata(0, elf),
+       modified=[full_buf])
+    fx(fam, "write_wrong_authority", LD,
+       [inited, acct(other, 0)],
+       refs((0, False, True), (1, True, False)), wdata(0, elf), result=1)
+    fx(fam, "write_past_end", LD,
+       [inited, acct(auth, 0)],
+       refs((0, False, True), (1, True, False)),
+       wdata(1, elf), result=1)
+
+    # deploy
+    deploy_accounts = [
+        acct(payer, 100),
+        acct(progdata, 5),
+        lacct(program, 7, data=bytes(bl.PROGRAM_SIZE)),
+        full_buf,
+        acct(auth, 0),
+    ]
+    deploy_refs = refs((0, True, True), (1, False, True), (2, False, True),
+                       (3, False, True), (4, True, False))
+    deployed_pd = lacct(
+        progdata, 5,
+        data=bl.programdata_encode(10, auth, elf) + bytes(len(elf)),
+    )
+    fx(fam, "deploy_ok", LD, deploy_accounts, deploy_refs,
+       u32(2) + u64(2 * len(elf)),
+       modified=[
+           acct(payer, 130),                       # buffer lamports spill
+           deployed_pd,
+           lacct(program, 7, data=bl.program_encode(progdata),
+                 executable=True),
+           acct(buf_key, 0),                       # consumed
+       ])
+    fx(fam, "deploy_max_too_small", LD, deploy_accounts, deploy_refs,
+       u32(2) + u64(len(elf) - 1), result=1)
+    fx(fam, "deploy_wrong_authority", LD,
+       [acct(payer, 100), acct(progdata, 5),
+        lacct(program, 7, data=bytes(bl.PROGRAM_SIZE)), full_buf,
+        acct(other, 0)],
+       deploy_refs, u32(2) + u64(2 * len(elf)), result=1)
+    fx(fam, "deploy_wrong_pda", LD,
+       [acct(payer, 100), acct(key("ld:notpda"), 5),
+        lacct(program, 7, data=bytes(bl.PROGRAM_SIZE)), full_buf,
+        acct(auth, 0)],
+       deploy_refs, u32(2) + u64(2 * len(elf)), result=1)
+    bad_elf_buf = lacct(buf_key, 30,
+                        data=bl.buffer_encode(auth) + b"\x7fNOT-ELF" * 8)
+    fx(fam, "deploy_invalid_elf", LD,
+       [acct(payer, 100), acct(progdata, 5),
+        lacct(program, 7, data=bytes(bl.PROGRAM_SIZE)), bad_elf_buf,
+        acct(auth, 0)],
+       deploy_refs, u32(2) + u64(1024), result=1)
+
+    # upgrade
+    elf2 = _vm_elf(_vm_ins(0xB7, dst=0, imm=1) + _vm_ins(0x95))
+    buf2 = lacct(key("ld:buf2"), 11, data=bl.buffer_encode(auth) + elf2)
+    deployed_prog = lacct(program, 7, data=bl.program_encode(progdata),
+                          executable=True)
+    spill = key("ld:spill")
+    up_accounts = [deployed_pd, deployed_prog, buf2, acct(spill, 1),
+                   acct(auth, 0)]
+    up_refs = refs((0, False, True), (1, False, True), (2, False, True),
+                   (3, False, True), (4, True, False))
+    cap = len(deployed_pd.data) - bl.PROGRAMDATA_META_SIZE
+    fx(fam, "upgrade_ok", LD, up_accounts, up_refs, u32(3),
+       modified=[
+           lacct(progdata, 5,
+                 data=bl.programdata_encode(10, auth, elf2)
+                 + bytes(cap - len(elf2))),
+           acct(spill, 12),
+           acct(key("ld:buf2"), 0),
+       ])
+    final_pd = lacct(progdata, 5,
+                     data=bl.programdata_encode(10, None, elf)
+                     + bytes(len(elf)))
+    fx(fam, "upgrade_final_program", LD,
+       [final_pd, deployed_prog, buf2, acct(spill, 1), acct(auth, 0)],
+       up_refs, u32(3), result=1)
+
+    # set authority
+    fx(fam, "set_authority_programdata", LD,
+       [deployed_pd, acct(auth, 0), acct(other, 0)],
+       refs((0, False, True), (1, True, False), (2, False, False)),
+       u32(4),
+       modified=[lacct(progdata, 5,
+                       data=bl.programdata_encode(10, other, elf)
+                       + bytes(len(elf)))])
+    fx(fam, "set_authority_wrong_signer", LD,
+       [deployed_pd, acct(other, 0), acct(payer, 0)],
+       refs((0, False, True), (1, True, False), (2, False, False)),
+       u32(4), result=1)
+    fx(fam, "buffer_cannot_drop_authority", LD,
+       [full_buf, acct(auth, 0)],
+       refs((0, False, True), (1, True, False)),
+       u32(4), result=1)
+
+    # close
+    fx(fam, "close_buffer", LD,
+       [full_buf, acct(payer, 100), acct(auth, 0)],
+       refs((0, False, True), (1, False, True), (2, True, False)),
+       u32(5),
+       modified=[acct(buf_key, 0), acct(payer, 130)])
+    fx(fam, "close_programdata_kills_program", LD,
+       [deployed_pd, acct(payer, 100), acct(auth, 0), deployed_prog],
+       refs((0, False, True), (1, False, True), (2, True, False),
+            (3, False, True)),
+       u32(5),
+       modified=[
+           acct(progdata, 0),
+           acct(payer, 105),
+           lacct(program, 7, data=bl.program_encode(progdata),
+                 executable=False),
+       ])
+    fx(fam, "close_into_itself", LD,
+       [full_buf, full_buf, acct(auth, 0)],
+       refs((0, False, True), (0, False, True), (2, True, False)),
+       u32(5), result=1)
 
 
 if __name__ == "__main__":
