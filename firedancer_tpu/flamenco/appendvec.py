@@ -1,0 +1,125 @@
+"""Agave append-vec account storage format.
+
+Capability parity target: the accounts/*.* files inside a real cluster
+snapshot are AppendVecs — Agave's memory-mapped account store pages,
+which the reference parses natively during snapshot restore
+(/root/reference/src/flamenco/snapshot/ restore path; no code shared).
+Together with the VoteState/StakeStateV2 codecs (agave_state.py) this
+covers the account-data plane of real-snapshot ingestion; the remaining
+piece is the bank manifest.
+
+Entry layout (solana accounts-db StoredAccountMeta, stable):
+
+    StoredMeta     write_version u64 | data_len u64 | pubkey 32B
+    AccountMeta    lamports u64 | rent_epoch u64 | owner 32B |
+                   executable u8 | 7B pad
+    hash           32B (account hash; readers may ignore)
+    data           data_len bytes
+    -> next entry aligned to 8 bytes
+
+A file is a sequence of entries; iteration stops at the first entry
+whose pubkey region is all zeros past `current_len` (mmap slack) or at
+end of file.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+from typing import Iterator
+
+_STORED = struct.Struct("<QQ32s")
+_ACCOUNT = struct.Struct("<QQ32sB7x")
+_HASH_SZ = 32
+ENTRY_HDR = _STORED.size + _ACCOUNT.size + _HASH_SZ
+
+
+class AppendVecError(ValueError):
+    pass
+
+
+@dataclass
+class StoredAccount:
+    pubkey: bytes
+    lamports: int
+    owner: bytes
+    executable: bool
+    rent_epoch: int
+    data: bytes
+    write_version: int = 0
+    hash: bytes = b"\x00" * 32
+
+    def to_value(self) -> bytes:
+        """This framework's funk account encoding (runtime.acct_encode)."""
+        from firedancer_tpu.flamenco.runtime import acct_encode
+
+        return acct_encode(self.lamports, self.owner, self.executable,
+                           self.data)
+
+
+def _align8(n: int) -> int:
+    return (n + 7) & ~7
+
+
+def append_entry(out: bytearray, acc: StoredAccount) -> None:
+    out += _STORED.pack(acc.write_version, len(acc.data), acc.pubkey)
+    out += _ACCOUNT.pack(acc.lamports, acc.rent_epoch, acc.owner,
+                         1 if acc.executable else 0)
+    out += acc.hash
+    out += acc.data
+    pad = _align8(len(out)) - len(out)
+    out += bytes(pad)
+
+
+def write_appendvec(accounts: list[StoredAccount]) -> bytes:
+    out = bytearray()
+    for acc in accounts:
+        append_entry(out, acc)
+    return bytes(out)
+
+
+def iter_appendvec(blob: bytes, *,
+                   current_len: int | None = None,
+                   max_data_len: int = 10 << 20) -> Iterator[StoredAccount]:
+    """Yield every stored account; tolerant of trailing mmap slack
+    (files are page-padded), strict inside the live region."""
+    end = len(blob) if current_len is None else min(current_len, len(blob))
+    off = 0
+    while off + ENTRY_HDR <= end:
+        wv, dlen, pubkey = _STORED.unpack_from(blob, off)
+        if pubkey == b"\x00" * 32 and dlen == 0 and wv == 0:
+            return  # zeroed slack tail
+        if dlen > max_data_len:
+            raise AppendVecError(f"entry data_len {dlen} over cap")
+        lam, rent, owner, execu = _ACCOUNT.unpack_from(
+            blob, off + _STORED.size
+        )
+        doff = off + ENTRY_HDR
+        if doff + dlen > end:
+            raise AppendVecError("entry data runs past the live region")
+        h = blob[off + _STORED.size + _ACCOUNT.size : doff]
+        yield StoredAccount(
+            pubkey=pubkey, lamports=lam, owner=owner,
+            executable=bool(execu & 1), rent_epoch=rent,
+            data=bytes(blob[doff : doff + dlen]), write_version=wv,
+            hash=bytes(h),
+        )
+        off = _align8(doff + dlen)
+
+
+def load_into_funk(blob: bytes, funk, *, xid: bytes | None = None,
+                   current_len: int | None = None) -> int:
+    """Replay an append-vec into funk; LAST write (highest offset) wins
+    for duplicate pubkeys, matching the store's append semantics.
+    Zero-lamport entries are tombstones.  Returns entries applied."""
+    n = 0
+    for acc in iter_appendvec(blob, current_len=current_len):
+        if acc.lamports == 0:
+            try:
+                funk.rec_remove(xid, acc.pubkey)
+            except Exception:
+                pass
+        else:
+            funk.rec_insert(xid, acc.pubkey, acc.to_value())
+        n += 1
+    return n

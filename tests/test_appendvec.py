@@ -1,0 +1,101 @@
+"""Append-vec account storage: byte-exact layout, slack tolerance,
+duplicate/tombstone semantics into funk, and composition with the
+Agave state codecs."""
+
+import hashlib
+import struct
+
+import pytest
+
+from firedancer_tpu.flamenco import appendvec as av
+from firedancer_tpu.funk.funk import Funk
+
+
+def _acc(name, lamports=10, data=b"", executable=False, wv=0):
+    return av.StoredAccount(
+        pubkey=hashlib.sha256(b"av:" + name).digest(),
+        lamports=lamports,
+        owner=hashlib.sha256(b"av:owner").digest(),
+        executable=executable,
+        rent_epoch=0,
+        data=data,
+        write_version=wv,
+    )
+
+
+def test_roundtrip_and_alignment():
+    accs = [_acc(b"a", data=b"xyz"), _acc(b"b", data=b"1234567890"),
+            _acc(b"c", data=b"", executable=True)]
+    blob = av.write_appendvec(accs)
+    assert len(blob) % 8 == 0
+    out = list(av.iter_appendvec(blob))
+    assert [(o.pubkey, o.lamports, o.data, o.executable) for o in out] == \
+        [(a.pubkey, a.lamports, a.data, a.executable) for a in accs]
+
+
+def test_wire_layout_exact():
+    a = _acc(b"w", lamports=777, data=b"DATA", wv=3)
+    blob = av.write_appendvec([a])
+    # StoredMeta: write_version | data_len | pubkey
+    assert blob[0:8] == (3).to_bytes(8, "little")
+    assert blob[8:16] == (4).to_bytes(8, "little")
+    assert blob[16:48] == a.pubkey
+    # AccountMeta: lamports | rent_epoch | owner | executable | 7B pad
+    assert blob[48:56] == (777).to_bytes(8, "little")
+    assert blob[64:96] == a.owner
+    assert blob[96] == 0
+    # hash(32) then data, padded to 8
+    assert blob[136:140] == b"DATA"
+    assert len(blob) == 144
+
+
+def test_mmap_slack_tolerated():
+    blob = av.write_appendvec([_acc(b"s", data=b"hi")])
+    padded = blob + bytes(4096 - len(blob))  # page slack
+    out = list(av.iter_appendvec(padded))
+    assert len(out) == 1
+    # explicit current_len also works
+    out2 = list(av.iter_appendvec(padded, current_len=len(blob)))
+    assert len(out2) == 1
+
+
+def test_truncated_live_region_rejected():
+    blob = av.write_appendvec([_acc(b"t", data=b"0123456789")])
+    with pytest.raises(av.AppendVecError):
+        list(av.iter_appendvec(blob[:-8], current_len=len(blob) - 8))
+
+
+def test_load_into_funk_last_write_wins_and_tombstones():
+    a = _acc(b"dup", lamports=5, data=b"old", wv=1)
+    b = _acc(b"dup", lamports=9, data=b"new", wv=2)
+    gone = _acc(b"dup", lamports=0, wv=3)  # tombstone
+    keep = _acc(b"keep", lamports=3, data=b"k")
+    f = Funk()
+    n = av.load_into_funk(av.write_appendvec([a, b, keep]), f)
+    assert n == 3
+    from firedancer_tpu.flamenco.runtime import acct_decode
+
+    lam, _o, _e, data = acct_decode(f.rec_query(None, a.pubkey))
+    assert (lam, bytes(data)) == (9, b"new")
+    n2 = av.load_into_funk(av.write_appendvec([gone]), f)
+    assert n2 == 1 and f.rec_query(None, a.pubkey) is None
+    assert f.rec_query(None, keep.pubkey) is not None
+
+
+def test_composes_with_agave_state_codecs():
+    """A vote account stored in an append-vec decodes through the
+    VoteState codec — the real-snapshot ingestion path end to end."""
+    from firedancer_tpu.flamenco import agave_state as A
+
+    vs = A.VoteState(node_pubkey=b"\x11" * 32,
+                     authorized_voters={0: b"\x22" * 32},
+                     epoch_credits=[(0, 42, 0)])
+    acc = _acc(b"vote", lamports=100, data=A.vote_state_encode(vs))
+    f = Funk()
+    av.load_into_funk(av.write_appendvec([acc]), f)
+    from firedancer_tpu.flamenco.runtime import acct_decode
+
+    _l, _o, _e, data = acct_decode(f.rec_query(None, acc.pubkey))
+    out = A.vote_state_decode(bytes(data))
+    assert out.node_pubkey == b"\x11" * 32
+    assert out.credits() == 42
