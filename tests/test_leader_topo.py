@@ -11,28 +11,11 @@ from firedancer_tpu.runtime.stage import Stage
 N_TXNS = 32
 
 
-def _warm_verify_kernel(batch, max_msg_len=256):
-    """Compile the verify kernel in the PARENT first: the persistent
-    compile cache is shared, so forked children load it in seconds and
-    the heartbeat watchdog stays meaningfully tight."""
-    import jax.numpy as jnp
-
-    import __graft_entry__ as ge
-    from firedancer_tpu.ops import sigverify as sv
-    import numpy as np
-
-    m, ln, s, p = ge._example_batch(batch)
-    m2 = np.zeros((max_msg_len, batch), dtype=np.int32)
-    m2[: m.shape[0]] = m
-    sv.ed25519_verify_batch(
-        jnp.asarray(m2), jnp.asarray(ln), jnp.asarray(s), jnp.asarray(p),
-        max_msg_len=max_msg_len,
-    ).block_until_ready()
-
-
 @pytest.mark.timeout(600)
 def test_leader_pipeline_as_processes():
-    _warm_verify_kernel(16)
+    # no parent warm-up: CPU compile-cache persistence is disabled
+    # (AOT serialization segfaults — utils/platform.py), so children
+    # compile their own kernels; the supervision windows below allow it
     topo = build_leader_topology(n_txns=N_TXNS, pool_size=N_TXNS, batch=16)
     h = ft.launch(topo)
     try:

@@ -43,10 +43,13 @@ def test_shard_counts_deterministic():
     assert sum(shard_counts(topo, 1001)) == 1001
 
 
-def test_sharded_verify_on_global_mesh():
-    """The verify kernel jitted over the multihost-shaped mesh (the
-    single-host 8-device CPU mesh here) — the path that must survive a
-    real multi-host deployment unchanged."""
+def _sharded_verify_child() -> None:
+    # a spawned child runs no conftest: strip the axon tunnel backend
+    # BEFORE any device use or this child hangs on a dead relay
+    from firedancer_tpu.utils.platform import force_cpu_backend
+
+    force_cpu_backend(device_count=8)
+
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as PS
@@ -55,8 +58,6 @@ def test_sharded_verify_on_global_mesh():
     from firedancer_tpu.ops import sigverify as sv
     from firedancer_tpu.parallel import multihost as mh
 
-    if jax.device_count() < 2:
-        pytest.skip("needs the virtual multi-device mesh")
     mesh = mh.global_mesh()
     n = jax.device_count()
     msg, ml, sig, pk = ge._example_batch(2 * n)
@@ -75,6 +76,35 @@ def test_sharded_verify_on_global_mesh():
 
     ok = np.asarray(step(*args))
     assert ok.all()
+    os._exit(0)
+
+
+def test_sharded_verify_on_global_mesh():
+    """The verify kernel jitted over the multihost-shaped mesh (the
+    single-host 8-device CPU mesh here) — the path that must survive a
+    real multi-host deployment unchanged.
+
+    Runs in a SPAWNED subprocess: XLA:CPU intermittently segfaults when
+    this large sharded program compiles late in a long session that has
+    already built hundreds of executables (observed at three different
+    points of the compile/serialize path); a fresh interpreter is the
+    reliable environment, and it also matches how the driver's
+    dryrun_multichip invokes the same path."""
+    import multiprocessing as mp
+
+    import jax
+
+    if jax.device_count() < 2:
+        pytest.skip("needs the virtual multi-device mesh")
+    ctx = mp.get_context("spawn")
+    proc = ctx.Process(target=_sharded_verify_child)
+    proc.start()
+    proc.join(600)
+    alive = proc.is_alive()
+    if alive:
+        proc.terminate()
+    assert not alive, "sharded verify child timed out"
+    assert proc.exitcode == 0, f"child exited {proc.exitcode}"
 
 
 # -- shm ring race stress ------------------------------------------------------
