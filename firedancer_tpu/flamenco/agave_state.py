@@ -184,17 +184,78 @@ _VOTE_STATE_BODY = T.StructCodec(
 )
 
 
+# VoteState1_14_11: identical body except votes is VecDeque<Lockout>
+# (no latency byte).  Still present in real cluster snapshots, so the
+# decoder must accept it (vote_state_versions converters in the
+# reference do the same upgrade-on-read).
+_VOTE_STATE_BODY_1_14_11 = T.StructCodec(
+    VoteState,
+    ("node_pubkey", T.Pubkey),
+    ("authorized_withdrawer", T.Pubkey),
+    ("commission", T.U8),
+    ("votes", T.Vec(LOCKOUT, max_len=64)),
+    ("root_slot", T.Option(T.U64)),
+    ("authorized_voters", _BTreeMapU64Pubkey()),
+    ("prior_voters", _PriorVotersCodec()),
+    ("epoch_credits", _EpochCredits()),
+    ("last_timestamp", BLOCK_TIMESTAMP),
+)
+
+
+def _decode_v0_23_5(data: bytes, off: int) -> VoteState:
+    """VoteState0_23_5: single (voter, epoch) pair instead of the
+    authorized_voters map; prior_voters entries are 4-tuples and the
+    CircBuf has no is_empty flag."""
+    node, off = T.Pubkey.decode(data, off)
+    voter, off = T.Pubkey.decode(data, off)
+    voter_epoch, off = T.U64.decode(data, off)
+    prior = []
+    for _ in range(32):
+        pk, off = T.Pubkey.decode(data, off)
+        a, off = T.U64.decode(data, off)
+        b, off = T.U64.decode(data, off)
+        _slot, off = T.U64.decode(data, off)
+        prior.append((pk, a, b))
+    idx, off = T.U64.decode(data, off)
+    withdrawer, off = T.Pubkey.decode(data, off)
+    commission, off = T.U8.decode(data, off)
+    votes, off = T.Vec(LOCKOUT, max_len=64).decode(data, off)
+    root, off = T.Option(T.U64).decode(data, off)
+    credits, off = _EpochCredits().decode(data, off)
+    ts, off = BLOCK_TIMESTAMP.decode(data, off)
+    return VoteState(
+        node_pubkey=node,
+        authorized_withdrawer=withdrawer,
+        commission=commission,
+        votes=[LandedVote(0, lk) for lk in votes],
+        root_slot=root,
+        authorized_voters={voter_epoch: voter},
+        prior_voters=PriorVoters(prior, idx,
+                                 all(pk == bytes(32) for pk, _, _ in prior)),
+        epoch_credits=credits,
+        last_timestamp=ts,
+    )
+
+
 def vote_state_encode(vs: VoteState) -> bytes:
     """Current-version envelope (enum tag 2)."""
     return T.U32.encode(2) + _VOTE_STATE_BODY.encode(vs)
 
 
 def vote_state_decode(data: bytes) -> VoteState:
+    """Decode ANY VoteStateVersions envelope, upgrading old layouts to
+    the current view (the reference's vote_state_versions convert)."""
     tag, off = T.U32.decode(data, 0)
-    if tag != 2:
-        raise T.CodecError(f"unsupported VoteState version {tag}")
-    vs, _ = _VOTE_STATE_BODY.decode(data, off)
-    return vs
+    if tag == 2:
+        vs, _ = _VOTE_STATE_BODY.decode(data, off)
+        return vs
+    if tag == 1:
+        vs, _ = _VOTE_STATE_BODY_1_14_11.decode(data, off)
+        vs.votes = [LandedVote(0, lk) for lk in vs.votes]
+        return vs
+    if tag == 0:
+        return _decode_v0_23_5(data, off)
+    raise T.CodecError(f"unsupported VoteState version {tag}")
 
 
 # -- stake state ---------------------------------------------------------------

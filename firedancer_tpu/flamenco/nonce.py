@@ -131,6 +131,27 @@ def handle(executor, ctx, tag, iaccts, data, *, pda_signers):
             raise AcctError("withdraw missing authority signature")
         if a.lamports < lamports:
             raise FundsError("nonce withdraw exceeds balance")
+        if state == STATE_INIT:
+            if lamports == a.lamports:
+                # full drain: refuse while the stored nonce is still the
+                # CURRENT durable hash (Agave's NonceBlockhashNotExpired)
+                # — a drained-but-initialized account must never keep
+                # satisfying durable_nonce_ok, so the state clears too
+                if nonce == next_nonce(_recent_blockhash(ctx), a.key):
+                    raise AcctError("nonce blockhash not expired")
+                a.data[:DATA_LEN] = encode_state(
+                    STATE_UNINIT, bytes(32), bytes(32)
+                )
+            else:
+                # partial: the remainder must stay rent-exempt
+                from firedancer_tpu.flamenco import types as T
+
+                rent_blob = ctx.sysvars.get("rent")
+                rent = (T.RENT.decode(rent_blob, 0)[0] if rent_blob
+                        else T.Rent())
+                floor = T.rent_exempt_minimum(rent, len(a.data))
+                if a.lamports - lamports < floor:
+                    raise FundsError("nonce withdraw below rent floor")
         if a.key == dest.key:
             return
         a.lamports -= lamports
@@ -154,9 +175,15 @@ def durable_nonce_ok(funk, xid, payload: bytes, desc) -> bool:
     """May this stale-blockhash txn run as a durable-nonce txn?
 
     First instruction must be system AdvanceNonceAccount, its nonce
-    account (first instruction account) must be an initialized nonce
-    whose stored hash equals the txn's recent_blockhash (the reference's
-    check_transaction_age durable path)."""
+    account (first instruction account) must be a WRITABLE initialized
+    nonce whose stored hash equals the txn's recent_blockhash, and the
+    nonce AUTHORITY must be a txn signer (the reference's
+    check_transaction_age / load_message_nonce_account path).  The
+    authority + writability checks live HERE — not just in the advance
+    instruction — because a failed durable txn still rotates the nonce:
+    without them, any fee-payer could rotate a victim's nonce account
+    (invalidating their outstanding offline-signed txns) by submitting
+    a txn whose advance instruction fails."""
     from firedancer_tpu.flamenco.runtime import acct_decode
 
     if not desc.instrs:
@@ -171,12 +198,15 @@ def durable_nonce_ok(funk, xid, payload: bytes, desc) -> bool:
     if len(data) < 4 or _u32(data) != TAG_ADVANCE or ins.acct_cnt < 1:
         return False
     idx = payload[ins.acct_off]
-    if idx >= len(addrs):
+    if idx >= len(addrs) or not desc.is_writable(idx):
         return False
     _lam, owner, _ex, acc_data = acct_decode(
         funk.rec_query(xid, addrs[idx])
     )
     if owner != SYSTEM_PROGRAM:
         return False
-    state, _auth, nonce = decode_state(acc_data)
-    return state == STATE_INIT and nonce == desc.recent_blockhash(payload)
+    state, auth, nonce = decode_state(acc_data)
+    if state != STATE_INIT or nonce != desc.recent_blockhash(payload):
+        return False
+    signers = set(addrs[: desc.signature_cnt])
+    return auth in signers

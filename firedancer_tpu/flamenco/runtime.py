@@ -177,11 +177,36 @@ def default_sysvars(slot: int) -> dict:
     }
 
 
+def _advance_nonce_account(funk, xid, payload, desc, addrs, sysvars) -> None:
+    """A FAILED durable-nonce txn still advances its nonce account: the
+    fee debit and the rotated nonce are the txn's on-chain footprint
+    (fd_runtime.c saves the advanced nonce for failed txns too) — else,
+    once StatusCache.purge_below prunes the signature, the identical
+    signed txn passes durable_nonce_ok again and re-lands."""
+    from firedancer_tpu.flamenco import nonce as _n
+
+    ins = desc.instrs[0]
+    key = addrs[payload[ins.acct_off]]
+    lam, owner, ex, data = acct_decode(funk.rec_query(xid, key))
+    state, auth, _cur = _n.decode_state(data)
+    if state != _n.STATE_INIT:
+        return
+    bh = (sysvars or {}).get("recent_blockhash")
+    if not bh:
+        return
+    data = bytearray(data)
+    data[: _n.DATA_LEN] = _n.encode_state(
+        _n.STATE_INIT, auth, _n.next_nonce(bh, key)
+    )
+    funk.rec_insert(xid, key, acct_encode(lam, owner, ex, bytes(data)))
+
+
 def _execute_txn(
     funk: Funk, xid: bytes, payload: bytes, desc: ft.Txn,
     executor: Executor | None = None,
     sysvars: dict | None = None,
     extra: tuple[list[bytes], list[bytes]] | None = None,
+    durable_nonce: bool = False,
 ) -> TxnResult:
     from firedancer_tpu.flamenco.programs import AcctError, FundsError
 
@@ -209,6 +234,13 @@ def _execute_txn(
     plam, powner, pex, pdata = acct_decode(payer_val)
     funk.rec_insert(xid, payer, acct_encode(plam - fee, powner, pex, pdata))
 
+    def _fail(status: int) -> TxnResult:
+        # fee-charged failure: a durable-nonce txn's nonce must rotate
+        # even though every other program effect is discarded
+        if durable_nonce:
+            _advance_nonce_account(funk, xid, payload, desc, addrs, sysvars)
+        return TxnResult(status, fee)
+
     # load the unique account set into host objects; program effects land
     # in funk only at commit, so failure = skip the writeback (fee stays)
     accounts = [
@@ -226,7 +258,7 @@ def _execute_txn(
     if budget is None:
         # malformed compute-budget instruction: typed failure, fee stays
         # charged (pack's cost model would have dropped it pre-block)
-        return TxnResult(TXN_ERR_PROGRAM, fee)
+        return _fail(TXN_ERR_PROGRAM)
     cu_limit, heap_size = budget
     # resolve upgradeable programs' programdata up front (the reference's
     # account loader does the same indirection, fd_executor.c load path);
@@ -255,29 +287,29 @@ def _execute_txn(
 
     for ins in desc.instrs:
         if ins.program_id >= len(addrs):
-            return TxnResult(TXN_ERR_ACCT, fee)
+            return _fail(TXN_ERR_ACCT)
         prog = addrs[ins.program_id]
         data = payload[ins.data_off : ins.data_off + ins.data_sz]
         idx = payload[ins.acct_off : ins.acct_off + ins.acct_cnt]
         if any(i >= len(addrs) for i in idx):
             # ALT-loaded index: unresolvable until the address-resolution
             # stage exists — a typed failure, never an abort of the block
-            return TxnResult(TXN_ERR_ACCT, fee)
+            return _fail(TXN_ERR_ACCT)
         iaccts = [InstrAccount(i, signer[i], writable[i]) for i in idx]
         try:
             executor.execute_instr(ctx, prog, iaccts, data)
         except FundsError:
-            return TxnResult(TXN_ERR_INSUFFICIENT_FUNDS, fee)
+            return _fail(TXN_ERR_INSUFFICIENT_FUNDS)
         except AcctError:
-            return TxnResult(TXN_ERR_ACCT, fee)
+            return _fail(TXN_ERR_ACCT)
         except InstrError:
-            return TxnResult(TXN_ERR_PROGRAM, fee)
+            return _fail(TXN_ERR_PROGRAM)
         except (ValueError, IndexError, KeyError, OverflowError):
             # instruction data/accounts are ATTACKER input; a native
             # program tripping an untyped exception is a failed txn,
             # never a block abort (defense in depth on top of the typed
             # errors — one crafted txn must not kill replay)
-            return TxnResult(TXN_ERR_PROGRAM, fee)
+            return _fail(TXN_ERR_PROGRAM)
 
     # commit: writes may only land on accounts the wave generator saw as
     # writable, or concurrent wave execution diverges from serial order.
@@ -289,7 +321,7 @@ def _execute_txn(
         if val == baseline[i]:
             continue
         if not writable[i]:
-            return TxnResult(TXN_ERR_ACCT, fee)
+            return _fail(TXN_ERR_ACCT)
         changed.append((a.key, val))
     for key, val in changed:
         funk.rec_insert(xid, key, val)
@@ -374,6 +406,7 @@ def execute_block(
         # tpool/device executes them concurrently — same result either way
         for i in wave:
             p, t = parsed[i]
+            durable = False
             if status_cache is not None:
                 bh = t.recent_blockhash(p)
                 sig = t.signatures(p)[0]
@@ -383,13 +416,15 @@ def execute_block(
                     if not _nonce.durable_nonce_ok(funk, xid, p, t):
                         results[i] = TxnResult(TXN_ERR_BLOCKHASH, 0)
                         continue
+                    durable = True
                 if (bh, sig) in block_seen or status_cache.contains(
                     bh, sig, ancestors
                 ):
                     results[i] = TxnResult(TXN_ERR_ALREADY_PROCESSED, 0)
                     continue
             results[i] = _execute_txn(funk, xid, p, t, sysvars=sysvars,
-                                      extra=extras[i])
+                                      extra=extras[i],
+                                      durable_nonce=durable)
             if status_cache is not None and results[i].fee > 0:
                 # any fee-charged txn occupies its signature (failed txns
                 # landed on chain too — fd_txncache records both); staged

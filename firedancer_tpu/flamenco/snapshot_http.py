@@ -64,7 +64,12 @@ class SnapshotServer:
 
     def __init__(self, directory: str, *, host: str = "127.0.0.1",
                  port: int = 0):
+        import threading
+
         self.directory = directory
+        self._hash_cache: dict = {}  # (name, mtime_ns, size) -> hex sha256
+        self._hash_lock = threading.Lock()
+        self._hash_inflight: dict = {}  # key -> Event while being hashed
 
         def handler(req, _body):
             if req.method != "GET":
@@ -84,13 +89,71 @@ class SnapshotServer:
             path = os.path.join(self.directory, name)
             if not os.path.exists(path):
                 return H.build_response(404, b"not found\n")
-            with open(path, "rb") as f:
-                blob = f.read()
-            return H.build_response(
-                200, blob, content_type="application/octet-stream",
+            st = os.stat(path)
+            digest = self._content_sha256(path, name, st)
+            head = H.build_stream_head(
+                200, st.st_size,
+                content_type="application/octet-stream",
+                headers=[("x-snapshot-name", name),
+                         ("x-snapshot-sha256", digest)],
             )
 
+            def chunks(path=path, size=st.st_size):
+                # stream in bounded chunks: a 64 GB archive must never
+                # be materialized per request (the old f.read() did).
+                # Reads cap at size - sent: if the file GREW between
+                # stat and open, the response still matches its
+                # declared content-length
+                sent = 0
+                with open(path, "rb") as f:
+                    while sent < size:
+                        blob = f.read(min(1 << 20, size - sent))
+                        if not blob:
+                            break
+                        sent += len(blob)
+                        yield blob
+
+            return head, chunks()
+
         self._srv = H.MiniServer(handler, host=host, port=port)
+
+    def _content_sha256(self, path: str, name: str, st) -> str:
+        """Hex sha256 of the archive, cached by (name, mtime, size) so a
+        steady-state serving loop hashes each archive once."""
+        import hashlib
+        import threading
+
+        key = (name, st.st_mtime_ns, st.st_size)
+        # one hash pass per archive, WITHOUT holding a global lock for
+        # the (potentially minutes-long) pass: the lock only guards the
+        # cache + in-flight map; concurrent cold requests for the same
+        # key wait on the owner's event, other keys proceed freely
+        while True:
+            with self._hash_lock:
+                got = self._hash_cache.get(key)
+                if got is not None:
+                    return got
+                ev = self._hash_inflight.get(key)
+                if ev is None:
+                    ev = threading.Event()
+                    self._hash_inflight[key] = ev
+                    break  # this thread owns the computation
+            ev.wait()  # owner finished (or failed): re-check the cache
+        try:
+            h = hashlib.sha256()
+            with open(path, "rb") as f:
+                for blob in iter(lambda: f.read(1 << 20), b""):
+                    h.update(blob)
+            digest = h.hexdigest()
+            with self._hash_lock:
+                if len(self._hash_cache) > 16:  # stale (name,mtime) keys
+                    self._hash_cache.clear()
+                self._hash_cache[key] = digest
+            return digest
+        finally:
+            with self._hash_lock:
+                self._hash_inflight.pop(key, None)
+            ev.set()
 
     @property
     def addr(self):
@@ -105,8 +168,15 @@ def download_snapshot(addr: tuple[str, int], name: str, dest_dir: str, *,
                       timeout_s: float = 60.0) -> str:
     """GET /<name> from a peer into dest_dir; returns the final path.
     Streams to `<name>.partial` and renames only on a complete body, so
-    an interrupted transfer never poses as a snapshot."""
+    an interrupted transfer never poses as a snapshot.  When the peer
+    advertises `x-snapshot-sha256`, the streamed bytes are hashed on the
+    way down and a mismatch (transfer corruption, truncating middlebox)
+    rejects the archive; an advertised `x-snapshot-name` renames alias
+    downloads (snapshot.tar.zst) to their canonical slot-exact name."""
+    import hashlib
+
     os.makedirs(dest_dir, exist_ok=True)
+    adv_name = None
     sock = socket.create_connection(addr, timeout=timeout_s)
     try:
         sock.sendall(
@@ -130,9 +200,33 @@ def download_snapshot(addr: tuple[str, int], name: str, dest_dir: str, *,
             raise SnapshotHttpError("peer sent no content length")
         if need > max_bytes:
             raise SnapshotHttpError(f"snapshot {need} bytes > cap")
-        final = os.path.join(dest_dir, name.rsplit("/", 1)[-1])
+        want_sha = resp.header("x-snapshot-sha256")
+        adv_name = resp.header("x-snapshot-name")
+        if adv_name:
+            # the advertised name is PEER INPUT: it may only rename an
+            # alias request to a canonical name of the SAME kind —
+            # answering the incremental alias with a full-snapshot name
+            # (or vice versa) would let a lying peer clobber the other
+            # archive in dest_dir
+            m = _NAME_RE.match(adv_name)
+            base = name.rsplit("/", 1)[-1]
+            if base == "snapshot.tar.zst":
+                ok = bool(m) and not m.group(1)
+            elif base == "incremental-snapshot.tar.zst":
+                ok = bool(m) and bool(m.group(1))
+            else:
+                ok = adv_name == base
+            if "/" in adv_name or not ok:
+                raise SnapshotHttpError(
+                    f"peer advertised bad name {adv_name!r}")
+        final = os.path.join(dest_dir, (adv_name or name).rsplit("/", 1)[-1])
         tmp = final + ".partial"
         got = len(buf) - resp.head_len
+        if got > need:
+            # excess arriving WITH the head must hit the same guard as
+            # excess arriving later
+            raise SnapshotHttpError("peer sent excess bytes")
+        hasher = hashlib.sha256(buf[resp.head_len:])
         with open(tmp, "wb") as f:
             f.write(buf[resp.head_len:])
             while got < need:
@@ -144,16 +238,21 @@ def download_snapshot(addr: tuple[str, int], name: str, dest_dir: str, *,
                 got += len(chunk)
                 if got > need:
                     raise SnapshotHttpError("peer sent excess bytes")
+                hasher.update(chunk)
                 f.write(chunk)
+        if want_sha and hasher.hexdigest() != want_sha.lower():
+            os.remove(tmp)
+            raise SnapshotHttpError("snapshot content hash mismatch")
         os.replace(tmp, final)
         return final
     finally:
         sock.close()
-        try:
-            os.remove(os.path.join(dest_dir,
-                                   name.rsplit("/", 1)[-1] + ".partial"))
-        except OSError:
-            pass
+        for leftover in {name, adv_name or name}:
+            try:
+                os.remove(os.path.join(
+                    dest_dir, leftover.rsplit("/", 1)[-1] + ".partial"))
+            except OSError:
+                pass
 
 
 def bootstrap_from_peer(addr: tuple[str, int], dest_dir: str, *,
@@ -165,6 +264,16 @@ def bootstrap_from_peer(addr: tuple[str, int], dest_dir: str, *,
 
     full = download_snapshot(addr, "snapshot.tar.zst", dest_dir)
     man, _ = snapshot_read(full)
+    # a canonically-named download (peer advertised x-snapshot-name)
+    # must AGREE with the manifest inside it — name/content divergence
+    # means a confused or lying peer, not a bootable archive
+    m = _NAME_RE.match(os.path.basename(full))
+    if m and not m.group(1) and int(m.group(2)) != man.slot:
+        os.remove(full)
+        raise SnapshotHttpError(
+            f"snapshot name says slot {m.group(2)}, manifest says "
+            f"{man.slot}"
+        )
     # rename to the slot-exact convention for re-serving
     exact = os.path.join(dest_dir, full_snapshot_name(man.slot))
     os.replace(full, exact)

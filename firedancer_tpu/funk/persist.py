@@ -110,6 +110,11 @@ class PersistentFunk(Funk):
             with open(self._wal_path, "rb") as f:
                 blob = f.read()
             if blob[: len(_MAGIC)] != _MAGIC:
+                # torn/garbage header: the whole journal is untrusted.
+                # Truncate to ZERO (not just skip) — __init__ reopens in
+                # append mode and only writes the magic at tell()==0, so
+                # leaving the garbage in place would append frames after
+                # it and every later recovery would drop them all.
                 blob = b""
                 valid_end = 0
             off = len(_MAGIC)
@@ -126,7 +131,7 @@ class PersistentFunk(Funk):
                 off += _FRAME_HDR.size + ln
                 valid_end = off
                 replayed += 1
-            if valid_end < len(blob):
+            if valid_end < os.path.getsize(self._wal_path):
                 with open(self._wal_path, "r+b") as f:
                     f.truncate(valid_end)
         self._root_bytes = sum(

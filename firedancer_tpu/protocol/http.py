@@ -271,7 +271,15 @@ class MiniServer:
                 # a handler bug must answer 500, not strand the client
                 # until its timeout with a silent close
                 resp = build_response(500, b"internal error\n")
-            conn.sendall(resp)
+            if isinstance(resp, tuple):
+                # streaming response: (head bytes, chunk iterable) —
+                # large bodies (snapshots) never materialize in memory
+                head, chunks = resp
+                conn.sendall(head)
+                for chunk in chunks:
+                    conn.sendall(chunk)
+            else:
+                conn.sendall(resp)
         except OSError:
             pass
         finally:
@@ -289,18 +297,28 @@ class MiniServer:
             pass
 
 
-def build_response(status: int, body: bytes = b"", *,
-                   content_type: str = "text/plain",
-                   headers: list | None = None) -> bytes:
+def build_stream_head(status: int, body_len: int, *,
+                      content_type: str = "text/plain",
+                      headers: list | None = None) -> bytes:
+    """Response head only, for MiniServer's (head, chunks) streaming
+    form: content-length is declared up front, the body follows from an
+    iterator so it never lives in memory whole."""
     reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
               405: "Method Not Allowed", 500: "Internal Server Error"}.get(
         status, "")
     head = [f"HTTP/1.1 {status} {reason}".encode()]
     head.append(b"content-type: " + content_type.encode())
-    head.append(b"content-length: " + str(len(body)).encode())
+    head.append(b"content-length: " + str(body_len).encode())
     # MiniServer serves one request per connection; say so, or HTTP/1.1
     # keep-alive clients reuse the closed socket and flap
     head.append(b"connection: close")
     for k, v in headers or []:
         head.append(f"{k}: {v}".encode())
-    return b"\r\n".join(head) + b"\r\n\r\n" + body
+    return b"\r\n".join(head) + b"\r\n\r\n"
+
+
+def build_response(status: int, body: bytes = b"", *,
+                   content_type: str = "text/plain",
+                   headers: list | None = None) -> bytes:
+    return build_stream_head(status, len(body), content_type=content_type,
+                             headers=headers) + body

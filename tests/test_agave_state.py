@@ -129,3 +129,54 @@ def test_vote_account_summary():
     assert s["credits"] == 1100
     assert s["last_voted_slot"] == 101
     assert s["root_slot"] == 99
+
+
+def test_vote_state_old_versions_decode():
+    """Tags 0 (V0_23_5) and 1 (V1_14_11) still appear in real cluster
+    snapshots; the decoder upgrades them to the current view."""
+    from firedancer_tpu.flamenco import agave_state as A
+    from firedancer_tpu.flamenco import types as T
+
+    # V1_14_11: current body but votes are bare Lockouts (no latency)
+    vs = A.VoteState(
+        node_pubkey=b"\x01" * 32,
+        authorized_withdrawer=b"\x02" * 32,
+        commission=7,
+        votes=[A.Lockout(100, 3), A.Lockout(101, 2)],
+        root_slot=99,
+        authorized_voters={4: b"\x03" * 32},
+        epoch_credits=[(3, 50, 40)],
+        last_timestamp=A.BlockTimestamp(101, 1234),
+    )
+    blob = T.U32.encode(1) + A._VOTE_STATE_BODY_1_14_11.encode(vs)
+    got = A.vote_state_decode(blob)
+    assert got.node_pubkey == b"\x01" * 32
+    assert got.commission == 7
+    assert [ (v.lockout.slot, v.lockout.confirmation_count)
+             for v in got.votes ] == [(100, 3), (101, 2)]
+    assert all(v.latency == 0 for v in got.votes)
+    assert got.authorized_voter_for(5) == b"\x03" * 32
+    assert got.root_slot == 99
+
+    # V0_23_5: single (voter, epoch) pair, 4-tuple prior_voters circbuf
+    body = b"\x0a" * 32                     # node_pubkey
+    body += b"\x0b" * 32                    # authorized_voter
+    body += (6).to_bytes(8, "little")       # authorized_voter_epoch
+    body += (bytes(32) + bytes(24)) * 32    # prior_voters buf (4-tuples)
+    body += (31).to_bytes(8, "little")      # idx
+    body += b"\x0c" * 32                    # authorized_withdrawer
+    body += bytes([5])                      # commission
+    body += (1).to_bytes(8, "little")       # votes len
+    body += (200).to_bytes(8, "little") + (1).to_bytes(4, "little")
+    body += b"\x01" + (150).to_bytes(8, "little")  # root Some(150)
+    body += (0).to_bytes(8, "little")       # epoch_credits len
+    body += (200).to_bytes(8, "little") + (777).to_bytes(8, "little")
+    got0 = A.vote_state_decode(T.U32.encode(0) + body)
+    assert got0.node_pubkey == b"\x0a" * 32
+    assert got0.authorized_withdrawer == b"\x0c" * 32
+    assert got0.commission == 5
+    assert got0.authorized_voter_for(6) == b"\x0b" * 32
+    assert got0.authorized_voter_for(5) is None
+    assert got0.votes[0].lockout.slot == 200
+    assert got0.root_slot == 150
+    assert got0.last_timestamp.timestamp == 777

@@ -132,3 +132,20 @@ def test_funk_from_config(tmp_path):
         f2.rec_insert(None, b"k", b"v")
     with funk_from_config(cfg) as f3:
         assert f3.rec_query(None, b"k") == b"v"
+
+
+def test_garbage_magic_truncates_whole_wal(tmp_path):
+    """A WAL whose magic header is torn/garbage must be truncated to
+    zero — otherwise new frames append AFTER the garbage and every later
+    recovery silently drops them all (r4 advisor finding)."""
+    d = tmp_path / "db"
+    os.makedirs(str(d), exist_ok=True)
+    with open(os.path.join(str(d), "funk.wal"), "wb") as fh:
+        fh.write(b"NOTMAGIC" + b"\xde\xad\xbe\xef" * 8)
+    with PersistentFunk(str(d)) as f:
+        assert f.recovered_frames == 0
+        f.rec_insert(None, b"after", b"garbage")
+    # the batch written after recovery MUST survive the next restart
+    with reopen(d) as f:
+        assert f.rec_query(None, b"after") == b"garbage"
+        assert f.recovered_frames == 1

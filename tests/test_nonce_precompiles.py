@@ -169,3 +169,163 @@ def test_secp256k1_precompile_roundtrip():
     wrong[head + 65] ^= 1  # perturb the expected address
     with pytest.raises(InstrError):
         _run_instr(SECP256K1_PROGRAM, bytes(wrong))
+
+
+def test_failed_durable_nonce_still_advances():
+    """A durable-nonce txn whose program FAILS must still rotate the
+    nonce (and keep the fee): the reference saves the advanced nonce for
+    failed txns too — else the identical signed txn re-lands once the
+    status cache prunes its signature."""
+    payer_secret = _secret(b"fp")
+    payer = ref.public_key(payer_secret)
+    nonce_key = hashlib.sha256(b"np:fnonce").digest()
+    dest = hashlib.sha256(b"np:fdest").digest()
+    stored = b"\x42" * 32
+
+    funk = Funk()
+    funk.rec_insert(None, payer, rt.acct_build(1_000_000))
+    funk.rec_insert(
+        None, nonce_key,
+        rt.acct_build(100, data=N.encode_state(N.STATE_INIT, payer, stored)),
+    )
+    sc = StatusCache()
+    sc.register_blockhash(b"\x99" * 32, 5)
+
+    # transfer far beyond the payer's balance: fee charged, txn fails
+    txn = _durable_txn(payer_secret, nonce_key, dest, 10_000_000, stored)
+    res = rt.execute_block(
+        funk, slot=6, txns=[txn], parent_bank_hash=b"\x55" * 32,
+        publish=True, status_cache=sc, ancestors=set(),
+    )
+    assert res.results[0].status == rt.TXN_ERR_INSUFFICIENT_FUNDS
+    assert res.results[0].fee == 5000
+
+    from firedancer_tpu.flamenco.runtime import acct_decode
+
+    _l, _o, _e, data = acct_decode(funk.rec_query(None, nonce_key))
+    state, _auth, new_nonce = N.decode_state(data)
+    assert state == N.STATE_INIT
+    assert new_nonce == N.next_nonce(b"\x55" * 32, nonce_key)
+    plam, *_ = acct_decode(funk.rec_query(None, payer))
+    assert plam == 1_000_000 - 5000  # fee kept, transfer rolled back
+
+    # the SAME signed txn can never land again — even with the
+    # signature gone from the cache, the stored nonce moved
+    res2 = rt.execute_block(
+        funk, slot=7, txns=[txn], parent_bank_hash=b"\x56" * 32,
+        publish=True, status_cache=sc, ancestors=set(),
+    )
+    assert res2.results[0].status == rt.TXN_ERR_BLOCKHASH
+
+
+def _withdraw_txn(payer_secret, nonce_key, dest, lamports, blockhash):
+    payer = ref.public_key(payer_secret)
+    wd = (5).to_bytes(4, "little") + lamports.to_bytes(8, "little")
+    addrs = [payer, nonce_key, dest, SYS]
+    msg = ft.message_build(
+        version=ft.VLEGACY,
+        signature_cnt=1,
+        readonly_signed_cnt=0,
+        readonly_unsigned_cnt=1,
+        acct_addrs=addrs,
+        recent_blockhash=blockhash,
+        instrs=[ft.InstrSpec(program_id=3,
+                             accounts=bytes([1, 2, 0]), data=wd)],
+    )
+    return ft.txn_assemble([ref.sign(payer_secret, msg)], msg)
+
+
+def test_nonce_withdraw_guards():
+    from firedancer_tpu.flamenco import types as T
+    from firedancer_tpu.flamenco.runtime import acct_decode
+
+    payer_secret = _secret(b"wp")
+    payer = ref.public_key(payer_secret)
+    nonce_key = hashlib.sha256(b"np:wnonce").digest()
+    dest = hashlib.sha256(b"np:wdest").digest()
+    parent_bh = b"\x77" * 32
+    floor = T.rent_exempt_minimum(T.Rent(), N.DATA_LEN)
+
+    def fresh_funk(stored):
+        funk = Funk()
+        funk.rec_insert(None, payer, rt.acct_build(1_000_000))
+        funk.rec_insert(
+            None, nonce_key,
+            rt.acct_build(floor + 100_000,
+                          data=N.encode_state(N.STATE_INIT, payer, stored)),
+        )
+        return funk
+
+    # 1) partial withdraw dipping below the rent-exempt floor: rejected
+    funk = fresh_funk(b"\x11" * 32)
+    txn = _withdraw_txn(payer_secret, nonce_key, dest, 200_000, parent_bh)
+    res = rt.execute_block(funk, slot=6, txns=[txn],
+                           parent_bank_hash=parent_bh, publish=True)
+    assert res.results[0].status == rt.TXN_ERR_INSUFFICIENT_FUNDS
+
+    # 2) partial withdraw staying above the floor: fine
+    funk = fresh_funk(b"\x11" * 32)
+    txn = _withdraw_txn(payer_secret, nonce_key, dest, 50_000, parent_bh)
+    res = rt.execute_block(funk, slot=6, txns=[txn],
+                           parent_bank_hash=parent_bh, publish=True)
+    assert res.results[0].status == 0
+    dlam, *_ = acct_decode(funk.rec_query(None, dest))
+    assert dlam == 50_000
+
+    # 3) full drain while the stored nonce is STILL the current durable
+    #    hash (advanced this blockhash): NonceBlockhashNotExpired analog
+    current = N.next_nonce(parent_bh, nonce_key)
+    funk = fresh_funk(current)
+    txn = _withdraw_txn(payer_secret, nonce_key, dest,
+                        floor + 100_000, parent_bh)
+    res = rt.execute_block(funk, slot=6, txns=[txn],
+                           parent_bank_hash=parent_bh, publish=True)
+    assert res.results[0].status == rt.TXN_ERR_ACCT
+
+    # 4) full drain with an EXPIRED stored nonce: succeeds AND the
+    #    account uninitializes, so it can't satisfy durable_nonce_ok
+    funk = fresh_funk(b"\x11" * 32)
+    txn = _withdraw_txn(payer_secret, nonce_key, dest,
+                        floor + 100_000, parent_bh)
+    res = rt.execute_block(funk, slot=6, txns=[txn],
+                           parent_bank_hash=parent_bh, publish=True)
+    assert res.results[0].status == 0
+    _l, _o, _e, data = acct_decode(funk.rec_query(None, nonce_key))
+    state, _a, _n = N.decode_state(data)
+    assert state == N.STATE_UNINIT
+
+
+def test_third_party_cannot_rotate_victims_nonce():
+    """The durable gate requires the nonce AUTHORITY's signature and a
+    writable nonce account — else any fee-payer could rotate a victim's
+    nonce (invalidating their offline-signed txns) via a deliberately
+    failing advance instruction."""
+    victim = hashlib.sha256(b"np:victim-auth").digest()
+    attacker_secret = _secret(b"attacker")
+    nonce_key = hashlib.sha256(b"np:victim-nonce").digest()
+    dest = hashlib.sha256(b"np:adest").digest()
+    stored = b"\x66" * 32
+
+    funk = Funk()
+    funk.rec_insert(None, ref.public_key(attacker_secret),
+                    rt.acct_build(1_000_000))
+    funk.rec_insert(
+        None, nonce_key,
+        rt.acct_build(100, data=N.encode_state(N.STATE_INIT, victim, stored)),
+    )
+    sc = StatusCache()
+    sc.register_blockhash(b"\x99" * 32, 5)
+
+    # attacker signs; victim (the authority) does NOT
+    txn = _durable_txn(attacker_secret, nonce_key, dest, 1, stored)
+    res = rt.execute_block(
+        funk, slot=6, txns=[txn], parent_bank_hash=b"\x55" * 32,
+        publish=True, status_cache=sc, ancestors=set(),
+    )
+    # fails the durable gate outright: no fee, and the nonce DID NOT move
+    assert res.results[0].status == rt.TXN_ERR_BLOCKHASH
+    from firedancer_tpu.flamenco.runtime import acct_decode
+
+    _l, _o, _e, data = acct_decode(funk.rec_query(None, nonce_key))
+    _state, _auth, nonce_now = N.decode_state(data)
+    assert nonce_now == stored

@@ -114,3 +114,87 @@ def test_full_only_peer(tmp_path):
         assert len(got.rec_keys(None)) == 5
     finally:
         srv.close()
+
+
+def test_download_rejects_hash_mismatch(tmp_path):
+    """A peer advertising a sha256 that doesn't match the bytes it sends
+    (corruption, truncating middlebox) must be rejected."""
+    import pytest
+
+    from firedancer_tpu.flamenco.snapshot_http import (
+        SnapshotHttpError, download_snapshot,
+    )
+    from firedancer_tpu.protocol import http as H
+
+    blob = b"not really a snapshot" * 100
+
+    def lying_handler(req, _body):
+        return H.build_response(
+            200, blob, content_type="application/octet-stream",
+            headers=[("x-snapshot-sha256", "00" * 32),
+                     ("x-snapshot-name", "snapshot-5.tar.zst")],
+        )
+
+    srv = H.MiniServer(lying_handler)
+    try:
+        with pytest.raises(SnapshotHttpError, match="hash mismatch"):
+            download_snapshot(srv.addr, "snapshot.tar.zst",
+                              str(tmp_path / "dl"))
+        import os
+        assert not os.listdir(tmp_path / "dl")  # nothing left behind
+    finally:
+        srv.close()
+
+
+def test_server_streams_with_hash_and_name(tmp_path):
+    """The server streams archives (never whole-file reads) and
+    advertises canonical name + content hash; the client verifies and
+    renames alias downloads to the canonical name."""
+    import hashlib
+    import os
+
+    from firedancer_tpu.flamenco.snapshot_http import (
+        SnapshotServer, download_snapshot,
+    )
+
+    sdir = tmp_path / "srv"
+    os.makedirs(sdir)
+    blob = os.urandom(3 << 20)  # > one 1 MiB stream chunk
+    with open(sdir / "snapshot-42.tar.zst", "wb") as f:
+        f.write(blob)
+    srv = SnapshotServer(str(sdir))
+    try:
+        got = download_snapshot(srv.addr, "snapshot.tar.zst",
+                                str(tmp_path / "dl"))
+        assert os.path.basename(got) == "snapshot-42.tar.zst"
+        with open(got, "rb") as f:
+            data = f.read()
+        assert hashlib.sha256(data).digest() == hashlib.sha256(blob).digest()
+    finally:
+        srv.close()
+
+
+def test_download_rejects_cross_kind_advertised_name(tmp_path):
+    """A peer answering the incremental alias with a FULL snapshot name
+    (or any mismatched name) must be rejected — the advertised name is
+    peer input and must not choose arbitrary destination filenames."""
+    import pytest
+
+    from firedancer_tpu.flamenco.snapshot_http import (
+        SnapshotHttpError, download_snapshot,
+    )
+    from firedancer_tpu.protocol import http as H
+
+    def evil_handler(req, _body):
+        return H.build_response(
+            200, b"x" * 64, content_type="application/octet-stream",
+            headers=[("x-snapshot-name", "snapshot-42.tar.zst")],
+        )
+
+    srv = H.MiniServer(evil_handler)
+    try:
+        with pytest.raises(SnapshotHttpError, match="bad name"):
+            download_snapshot(srv.addr, "incremental-snapshot.tar.zst",
+                              str(tmp_path / "dl"))
+    finally:
+        srv.close()
