@@ -328,6 +328,181 @@ def _execute_txn(
     return TxnResult(TXN_SUCCESS, fee)
 
 
+class SlotExecution:
+    """Incremental slot execution: the per-txn gate + execute + seal
+    machinery shared by `execute_block` (the batch/replay path) and the
+    pipeline's bank stages (the streaming leader path — the reference's
+    bank tile commits into one live bank the same way,
+    /root/reference/src/app/fdctl/run/tiles/fd_bank.c:186-241).
+
+    Lifecycle: construct (prepares a funk fork), `execute()` txns as they
+    arrive, `seal(poh_hash)` to finalize the bank hash, then `publish()`
+    or `abandon()` once consensus picks the fork."""
+
+    def __init__(
+        self,
+        funk: Funk,
+        *,
+        slot: int,
+        parent_bank_hash: bytes = b"\x00" * 32,
+        parent_xid: bytes | None = None,
+        executor: Executor | None = None,
+        status_cache=None,
+        ancestors: set[int] | None = None,
+    ):
+        self.funk = funk
+        self.slot = slot
+        self.parent_bank_hash = parent_bank_hash
+        self.parent_xid = parent_xid
+        self.executor = executor
+        self.status_cache = status_cache
+        self.ancestors = ancestors
+        # xid carries a nonce: competing blocks for the SAME slot off the
+        # same parent are distinct forks (consensus decides which publishes)
+        self.xid = b"slot:%d:%d:%s" % (slot, next(_xid_seq),
+                                       (parent_xid or b"root"))
+        funk.txn_prepare(parent_xid, self.xid)
+        self.sysvars = default_sysvars(slot)
+        # durable nonces advance against the PARENT's bank hash: fresh,
+        # deterministic, and fixed before any txn in this block runs
+        self.sysvars["recent_blockhash"] = parent_bank_hash
+        if status_cache is not None:
+            status_cache.begin_block(self.xid, slot)
+        # intra-block duplicates are tracked locally, NOT via the cache
+        # with a widened ancestor set: cache insertions from a speculative
+        # competing block at this same slot must never gate this block
+        self._block_seen: set[tuple[bytes, bytes]] = set()
+        self._table_cache: dict = {}  # ALT decode, once per block
+        self._before: dict[bytes, bytes | None] = {}  # start-of-slot view
+        self.results: list[TxnResult] = []
+        self.signature_cnt = 0
+        self.sealed: BlockResult | None = None
+
+    def resolve(self, payload: bytes, desc: ft.Txn):
+        """Resolve v0 address-table lookups against the START-of-slot
+        state (in-block table extensions become visible next slot —
+        Agave's visibility rule).  None = typed lookup failure."""
+        if not desc.addr_luts:
+            return ([], [])
+        from firedancer_tpu.flamenco import alt as falt
+
+        try:
+            return falt.resolve_lookups(
+                payload, desc,
+                lambda k: self.funk.rec_query(self.parent_xid, k),
+                slot=self.slot, table_cache=self._table_cache,
+            )
+        except falt.LookupError_:
+            return None
+
+    def execute(
+        self, payload: bytes, desc: ft.Txn,
+        extra: tuple[list[bytes], list[bytes]] | None | bool = False,
+    ) -> TxnResult:
+        """Gate + execute one txn on this slot's fork.  `extra` is the
+        pre-resolved ALT addresses (pass the default to resolve here)."""
+        if extra is False:
+            extra = self.resolve(payload, desc)
+        # snapshot the start-of-slot value of every account this txn can
+        # touch, for the accounts-delta hash (query the PARENT view: an
+        # earlier in-block writer must not shift this txn's "before")
+        for a in desc.acct_addrs(payload) + (
+            extra[0] + extra[1] if extra else []
+        ):
+            if a not in self._before:
+                self._before[a] = self.funk.rec_query(self.parent_xid, a)
+        durable = False
+        bh = sig = None
+        if self.status_cache is not None:
+            bh = desc.recent_blockhash(payload)
+            sig = desc.signatures(payload)[0]
+            if not self.status_cache.is_blockhash_valid(bh, self.slot):
+                from firedancer_tpu.flamenco import nonce as _nonce
+
+                if not _nonce.durable_nonce_ok(self.funk, self.xid,
+                                               payload, desc):
+                    r = TxnResult(TXN_ERR_BLOCKHASH, 0)
+                    self.results.append(r)
+                    return r
+                durable = True
+            if (bh, sig) in self._block_seen or self.status_cache.contains(
+                bh, sig, self.ancestors
+            ):
+                r = TxnResult(TXN_ERR_ALREADY_PROCESSED, 0)
+                self.results.append(r)
+                return r
+        r = _execute_txn(self.funk, self.xid, payload, desc,
+                         executor=self.executor, sysvars=self.sysvars,
+                         extra=extra, durable_nonce=durable)
+        if r.fee > 0:
+            # the bank hash's signature count covers txns that LANDED
+            # (fee-charged; dropped/gated txns leave no on-chain
+            # footprint) — so a streaming leader and a replayer counting
+            # only the recorded txns agree on the hash
+            self.signature_cnt += desc.signature_cnt
+        if self.status_cache is not None and r.fee > 0:
+            # any fee-charged txn occupies its signature (failed txns
+            # landed on chain too — fd_txncache records both); staged
+            # until the fork is chosen
+            self._block_seen.add((bh, sig))
+            self.status_cache.stage_insert(self.xid, bh, sig)
+        self.results.append(r)
+        return r
+
+    def seal(self, poh_hash: bytes = b"\x00" * 32,
+             waves: list[list[int]] | None = None) -> BlockResult:
+        """Finalize: accounts-delta lattice hash (one device reduction
+        over +new / -old) chained into the bank hash."""
+        vals = []
+        signs = []
+        for a in sorted(self._before):
+            after = self.funk.rec_query(self.xid, a)
+            if after == self._before[a]:
+                continue
+            if self._before[a] is not None:
+                vals.append(lt.lthash_of(a + self._before[a]))
+                signs.append(-1)
+            if after is not None:
+                vals.append(lt.lthash_of(a + after))
+                signs.append(1)
+        if vals:
+            delta = np.asarray(
+                lt.combine_device(np.stack(vals), np.asarray(signs))
+            )
+        else:
+            delta = lt.lthash_zero()
+        bank_hash = hashlib.sha256(
+            self.parent_bank_hash
+            + hashlib.sha256(delta.tobytes()).digest()
+            + self.signature_cnt.to_bytes(8, "little")
+            + poh_hash
+        ).digest()
+        if self.status_cache is not None:
+            self.status_cache.stage_blockhash(self.xid, poh_hash)
+        self.sealed = BlockResult(
+            slot=self.slot,
+            bank_hash=bank_hash,
+            accounts_delta=delta,
+            signature_cnt=self.signature_cnt,
+            fees=sum(r.fee for r in self.results),
+            results=list(self.results),
+            waves=waves if waves is not None else [],
+            xid=self.xid,
+        )
+        return self.sealed
+
+    def publish(self) -> None:
+        """Consensus chose this fork: fold it into funk's root."""
+        if self.status_cache is not None:
+            self.status_cache.commit_block(self.xid)
+        self.funk.txn_publish(self.xid)
+
+    def abandon(self) -> None:
+        if self.status_cache is not None:
+            self.status_cache.drop_block(self.xid)
+        self.funk.txn_cancel(self.xid)
+
+
 def execute_block(
     funk: Funk,
     *,
@@ -354,128 +529,30 @@ def execute_block(
         if t is None:
             raise ValueError("malformed txn in block")
         parsed.append((p, t))
-    # xid carries a nonce: competing blocks for the SAME slot off the same
-    # parent are distinct forks (consensus decides which publishes)
-    xid = b"slot:%d:%d:%s" % (slot, next(_xid_seq), (parent_xid or b"root"))
-    funk.txn_prepare(parent_xid, xid)
-
-    # resolve v0 address-table lookups against the START-of-slot state
-    # (in-block table extensions become visible next slot, Agave's
-    # visibility rule) — exact rw-sets for wave generation
-    from firedancer_tpu.flamenco import alt as falt
-
-    extras: list[tuple[list[bytes], list[bytes]] | None] = []
-    table_cache: dict = {}  # decode each referenced table once per block
-    for p, t in parsed:
-        if not t.addr_luts:
-            extras.append(([], []))
-            continue
-        try:
-            extras.append(
-                falt.resolve_lookups(
-                    p, t, lambda k: funk.rec_query(xid, k),
-                    slot=slot, table_cache=table_cache,
-                )
-            )
-        except falt.LookupError_:
-            extras.append(None)
-    waves = generate_waves(parsed, extras)
-
-    # track every account any txn touches, for the delta hash
-    touched: set[bytes] = set()
-    before: dict[bytes, bytes | None] = {}
-    for (p, t), ex in zip(parsed, extras):
-        for a in t.acct_addrs(p) + (ex[0] + ex[1] if ex else []):
-            if a not in before:
-                before[a] = funk.rec_query(xid, a)
-            touched.add(a)
-
-    sysvars = default_sysvars(slot)
-    # durable nonces advance against the PARENT's bank hash: fresh,
-    # deterministic, and fixed before any txn in this block runs
-    sysvars["recent_blockhash"] = parent_bank_hash
-    results: list[TxnResult] = [None] * len(parsed)
-    # intra-block duplicates are tracked locally, NOT via the cache with a
-    # widened ancestor set: cache insertions from a speculative competing
-    # block at this same slot must never gate this block's txns
-    if status_cache is not None:
-        status_cache.begin_block(xid, slot)
-    block_seen: set[tuple[bytes, bytes]] = set()
-    for wave in waves:
-        # wave txns are conflict-free: host executes in index order, a
-        # tpool/device executes them concurrently — same result either way
-        for i in wave:
-            p, t = parsed[i]
-            durable = False
-            if status_cache is not None:
-                bh = t.recent_blockhash(p)
-                sig = t.signatures(p)[0]
-                if not status_cache.is_blockhash_valid(bh, slot):
-                    from firedancer_tpu.flamenco import nonce as _nonce
-
-                    if not _nonce.durable_nonce_ok(funk, xid, p, t):
-                        results[i] = TxnResult(TXN_ERR_BLOCKHASH, 0)
-                        continue
-                    durable = True
-                if (bh, sig) in block_seen or status_cache.contains(
-                    bh, sig, ancestors
-                ):
-                    results[i] = TxnResult(TXN_ERR_ALREADY_PROCESSED, 0)
-                    continue
-            results[i] = _execute_txn(funk, xid, p, t, sysvars=sysvars,
-                                      extra=extras[i],
-                                      durable_nonce=durable)
-            if status_cache is not None and results[i].fee > 0:
-                # any fee-charged txn occupies its signature (failed txns
-                # landed on chain too — fd_txncache records both); staged
-                # until the fork is chosen
-                block_seen.add((bh, sig))
-                status_cache.stage_insert(xid, bh, sig)
-
-    # accounts-delta lattice hash: one device reduction over +new / -old
-    vals = []
-    signs = []
-    for a in sorted(touched):
-        after = funk.rec_query(xid, a)
-        if after == before[a]:
-            continue
-        if before[a] is not None:
-            vals.append(lt.lthash_of(a + before[a]))
-            signs.append(-1)
-        if after is not None:
-            vals.append(lt.lthash_of(a + after))
-            signs.append(1)
-    if vals:
-        delta = np.asarray(lt.combine_device(np.stack(vals), np.asarray(signs)))
-    else:
-        delta = lt.lthash_zero()
-
-    sig_cnt = sum(t.signature_cnt for _, t in parsed)
-    fees = sum(r.fee for r in results)
-    bank_hash = hashlib.sha256(
-        parent_bank_hash
-        + hashlib.sha256(delta.tobytes()).digest()
-        + sig_cnt.to_bytes(8, "little")
-        + poh_hash
-    ).digest()
-    if status_cache is not None:
-        status_cache.stage_blockhash(xid, poh_hash)
-        if publish:
-            status_cache.commit_block(xid)
-        # else: the caller owns the fork decision — commit_block(xid) when
-        # the fork is chosen, drop_block(xid) when it is abandoned
-    if publish:
-        funk.txn_publish(xid)
-    return BlockResult(
-        slot=slot,
-        bank_hash=bank_hash,
-        accounts_delta=delta,
-        signature_cnt=sig_cnt,
-        fees=fees,
-        results=results,
-        waves=waves,
-        xid=xid,
+    sx = SlotExecution(
+        funk, slot=slot, parent_bank_hash=parent_bank_hash,
+        parent_xid=parent_xid, status_cache=status_cache,
+        ancestors=ancestors,
     )
+    extras = [sx.resolve(p, t) for p, t in parsed]
+    waves = generate_waves(parsed, extras)
+    order = [i for wave in waves for i in wave]
+    # wave txns are conflict-free: host executes in index order, a
+    # tpool/device executes them concurrently — same result either way
+    for i in order:
+        p, t = parsed[i]
+        sx.execute(p, t, extra=extras[i])
+    # sx.results is in execution order; BlockResult keeps block order
+    by_block_order = [None] * len(parsed)
+    for pos, i in enumerate(order):
+        by_block_order[i] = sx.results[pos]
+    sx.results = by_block_order
+    result = sx.seal(poh_hash, waves=waves)
+    if publish:
+        sx.publish()
+    # else: the caller owns the fork decision — commit_block(xid) when
+    # the fork is chosen, drop_block(xid) when it is abandoned
+    return result
 
 
 def replay_block(

@@ -141,9 +141,17 @@ class SnapshotServer:
             ev.wait()  # owner finished (or failed): re-check the cache
         try:
             h = hashlib.sha256()
+            # hash EXACTLY the st_size bytes the stream path serves: a
+            # file growing mid-pass must not advertise a digest over
+            # bytes the response never carries
+            remaining = st.st_size
             with open(path, "rb") as f:
-                for blob in iter(lambda: f.read(1 << 20), b""):
+                while remaining > 0:
+                    blob = f.read(min(1 << 20, remaining))
+                    if not blob:
+                        break
                     h.update(blob)
+                    remaining -= len(blob)
             digest = h.hexdigest()
             with self._hash_lock:
                 if len(self._hash_cache) > 16:  # stale (name,mtime) keys

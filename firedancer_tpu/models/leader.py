@@ -31,7 +31,7 @@ import time
 from dataclasses import dataclass
 
 from firedancer_tpu.ops.ref import ed25519_ref as ref
-from firedancer_tpu.runtime.bank import BankStage
+from firedancer_tpu.runtime.bank import BankCtx, BankStage, default_bank_ctx
 from firedancer_tpu.runtime.benchg import BenchGStage, gen_transfer_pool
 from firedancer_tpu.runtime.dedup import DedupStage
 from firedancer_tpu.runtime.pack_stage import PackStage
@@ -55,6 +55,7 @@ class LeaderPipeline:
     shred: ShredStage
     store: StoreStage
     leader_pub: bytes
+    bank_ctx: BankCtx = None
 
     def run(self, *, max_iters: int = 200_000, until_txns: int | None = None,
             finish: bool = True):
@@ -99,6 +100,12 @@ class LeaderPipeline:
             if not progressed and not self.pack.pack.pending_cnt():
                 break
 
+    def seal(self):
+        """End of slot: bank hash over the state every bank committed,
+        chaining the final PoH entry hash (what replay_block reproduces
+        from the wire entries alone)."""
+        return self.bank_ctx.seal(self.poh.last_entry_hash)
+
     def close(self):
         for link in self.links:
             link.close()
@@ -137,6 +144,8 @@ def build_leader_pipeline(
     leader_seed: bytes = b"leader",
     verify_precomputed: bool = False,
     verify_comb_slots: int = 0,
+    bank_ctx: BankCtx | None = None,
+    keep_entries: bool = False,
 ) -> LeaderPipeline:
     uid = f"{os.getpid()}_{int(time.monotonic_ns() % 1_000_000)}"
     links = []
@@ -191,12 +200,17 @@ def build_leader_pipeline(
         outs=[shm.Producer(l) for l in pack_bank],
         bank_cnt=n_bank,
     )
+    # ONE live bank shared by every bank stage (the Frankendancer shape:
+    # all bank tiles commit into the same Agave bank over the FFI)
+    if bank_ctx is None:
+        bank_ctx = default_bank_ctx(slot=slot)
     banks = [
         BankStage(
             f"bank{b}",
             ins=[shm.Consumer(pack_bank[b], lazy=8)],
             outs=[shm.Producer(bank_poh[b]), shm.Producer(bank_done[b])],
             bank_idx=b,
+            ctx=bank_ctx,
         )
         for b in range(n_bank)
     ]
@@ -208,6 +222,8 @@ def build_leader_pipeline(
         outs=[shm.Producer(poh_shred)],
     )
     poh.require_credit = True
+    if keep_entries:
+        poh.entries = []
     shred = ShredStage(
         "shred",
         ins=[shm.Consumer(poh_shred, lazy=8)],
@@ -239,4 +255,5 @@ def build_leader_pipeline(
         shred=shred,
         store=store,
         leader_pub=leader_pub,
+        bank_ctx=bank_ctx,
     )

@@ -83,8 +83,14 @@ def build_pack(links, cnc, *, n_bank):
     )
 
 
-def build_bank(links, cnc, *, bank_idx):
-    from firedancer_tpu.runtime.bank import BankStage
+def build_bank(links, cnc, *, bank_idx, slot=1):
+    # the bank process OWNS the live bank (its own funk + SlotExecution,
+    # default_bank_ctx): the process topology therefore runs n_bank=1 —
+    # multiple real-execution banks need the funk state shared, which the
+    # cooperative pipeline gets in-process (models/leader.py) and a
+    # multi-process topology would need a cross-process funk backend for
+    # (the reference shares fd_funk in a wksp across tiles the same way)
+    from firedancer_tpu.runtime.bank import BankStage, default_bank_ctx
 
     stage = BankStage(
         f"bank{bank_idx}",
@@ -95,6 +101,7 @@ def build_bank(links, cnc, *, bank_idx):
         ],
         cnc=cnc,
         bank_idx=bank_idx,
+        ctx=default_bank_ctx(slot=slot),
     )
     stage.require_credit = True
     return stage
@@ -147,7 +154,7 @@ def build_leader_topology(
     n_txns: int = 64,
     pool_size: int = 64,
     batch: int = 32,
-    n_bank: int = 2,
+    n_bank: int = 1,
     leader_seed: bytes = b"leader",
     slot: int = 1,
     sandbox: dict | None = None,
@@ -179,7 +186,7 @@ def build_leader_topology(
     topo.stage("dedup", build_dedup, sandbox=sb)
     topo.stage("pack", build_pack, n_bank=n_bank, sandbox=sb)
     for b in range(n_bank):
-        topo.stage(f"bank{b}", build_bank, bank_idx=b, sandbox=sb)
+        topo.stage(f"bank{b}", build_bank, bank_idx=b, slot=slot, sandbox=sb)
     topo.stage("poh", build_poh, n_bank=n_bank, sandbox=sb)
     topo.stage("shred", build_shred, secret=secret, slot=slot, sandbox=sb)
     topo.stage("store", build_store, leader_pub=leader_pub, sandbox=sb)

@@ -1,18 +1,30 @@
-"""Bank stage: executes pack's microblocks, feeds PoH, releases locks.
+"""Bank stage: executes pack's microblocks for real, feeds PoH, releases locks.
 
 Pipeline position mirrors the reference's bank tile
 (/root/reference/src/app/fdctl/run/tiles/fd_bank.c): consume a microblock
-from pack, execute + commit it, hand the executed microblock to poh for
-mixin, and signal pack that this bank is idle again (the bank_busy
-release that lets pack schedule conflicting txns).
+from pack, execute + commit it against the LIVE bank, hand the executed
+microblock to poh for mixin, and signal pack that this bank is idle again
+(the bank_busy release that lets pack schedule conflicting txns).
 
-Execution here is the *Frankendancer* shape — the reference bank tile is
-itself a thin wrapper that ships txns across an FFI to Agave's runtime
-(fd_bank.c:99-104); the native runtime (flamenco analog) is its own
-milestone.  The stub executes a system transfer ledger over an in-memory
-lamport map so tests can assert real state transitions, and computes the
-microblock mixin hash = sha256 over the txns' first signatures (the entry
-hash the poh stage mixes in).
+Execution is the real flamenco runtime: every bank stage commits into ONE
+shared `SlotExecution` (flamenco/runtime.py) over funk — fees, status
+cache, durable nonces, writability enforcement, native programs, the sBPF
+VM with CPI.  That is the reference's shape too: all of Frankendancer's
+bank tiles commit into the same live Agave bank through the FFI
+(fd_bank.c:186-241); here the shared bank is the in-process `BankCtx`.
+Pack guarantees concurrently-scheduled microblocks touch disjoint
+accounts, so interleaved commits equal some serial order of the block.
+
+A txn that fails to land (unfunded fee payer, stale blockhash, duplicate
+signature) is DROPPED from the emitted entry — the recorded block carries
+exactly the txns with an on-chain footprint, so a replayer
+(flamenco/runtime.replay_block) reproduces the bank hash from the wire
+entries alone.  Executed-but-failed txns landed (fee charged) and stay.
+
+Process-runner note: the topo runner spawns each stage in its own
+interpreter, so there the bank count must be 1 (one process owns the
+bank) until funk grows a cross-process shm backend; the cooperative
+scheduler runs any bank count against the shared ctx.
 
 Inputs:  ins[0] = pack->bank microblocks.
 Outputs: outs[0] = bank->poh executed microblocks; outs[1] = done->pack.
@@ -45,56 +57,133 @@ def parse_microblock(frame: bytes) -> tuple[int, list[bytes]]:
     return mb_seq, frags
 
 
+class BankCtx:
+    """The pipeline's live bank: one funk fork + SlotExecution shared by
+    every bank stage (and by the pipeline's seal/publish at end of slot)."""
+
+    def __init__(
+        self,
+        funk=None,
+        *,
+        slot: int = 1,
+        parent_bank_hash: bytes = b"\x00" * 32,
+        parent_xid: bytes | None = None,
+        status_cache=None,
+        blockhashes: tuple[bytes, ...] = (),
+        executor=None,
+    ):
+        from firedancer_tpu.funk import Funk
+
+        self.funk = funk if funk is not None else Funk()
+        self.slot = slot
+        self.status_cache = status_cache
+        if status_cache is not None:
+            for bh in blockhashes:
+                # recent enough to pass the 150-slot currency gate
+                status_cache.register_blockhash(bh, max(0, slot - 1))
+        self._parent_bank_hash = parent_bank_hash
+        self._parent_xid = parent_xid
+        self._executor = executor
+        self._sx = None
+
+    def fund(self, pubkey: bytes, lamports: int) -> None:
+        """Genesis-style funding on the funk root (before the slot runs)."""
+        from firedancer_tpu.flamenco.runtime import acct_build
+
+        self.funk.rec_insert(None, pubkey, acct_build(lamports))
+
+    @property
+    def sx(self):
+        from firedancer_tpu.flamenco.runtime import SlotExecution
+
+        if self._sx is None:
+            self._sx = SlotExecution(
+                self.funk,
+                slot=self.slot,
+                parent_bank_hash=self._parent_bank_hash,
+                parent_xid=self._parent_xid,
+                executor=self._executor,
+                status_cache=self.status_cache,
+            )
+        return self._sx
+
+    def execute(self, payload: bytes, desc: ft.Txn):
+        return self.sx.execute(payload, desc)
+
+    def seal(self, poh_hash: bytes):
+        """End of slot: bank hash over the committed state."""
+        return self.sx.seal(poh_hash)
+
+    def publish(self) -> None:
+        self.sx.publish()
+
+
+def default_bank_ctx(
+    *,
+    slot: int = 1,
+    seed: bytes = b"benchg",
+    n_payers: int = 8,
+    payer_lamports: int = 10**12,
+    with_status_cache: bool = True,
+) -> BankCtx:
+    """A ctx pre-funded for the synthetic benchg load: the generator's
+    payer accounts exist with lamports (fees + transfers clear) and the
+    pool's blockhash passes the status-cache currency gate."""
+    from firedancer_tpu.flamenco.blockstore import StatusCache
+    from .benchg import pool_blockhash, pool_payers
+
+    ctx = BankCtx(
+        slot=slot,
+        status_cache=StatusCache() if with_status_cache else None,
+        blockhashes=(pool_blockhash(seed),),
+    )
+    for _, pub in pool_payers(seed, n_payers):
+        ctx.fund(pub, payer_lamports)
+    return ctx
+
+
 class BankStage(Stage):
-    def __init__(self, *args, bank_idx: int = 0, **kwargs):
+    def __init__(self, *args, bank_idx: int = 0, ctx: BankCtx | None = None,
+                 **kwargs):
         super().__init__(*args, **kwargs)
         self.bank_idx = bank_idx
-        self.lamports: dict[bytes, int] = {}  # account -> balance (stub state)
+        self.ctx = ctx if ctx is not None else default_bank_ctx()
         # per-microblock commit latency vs the oldest txn's origin stamp
         # (the bencho measurement point: txn acknowledged by the runtime)
         self.commit_latencies_ns: list[int] = []
 
     def after_frag(self, in_idx: int, meta, payload: bytes) -> None:
+        from firedancer_tpu.flamenco.runtime import TXN_SUCCESS
+
         mb_seq, frags = parse_microblock(payload)
         sigs = []
-        out = bytearray()
         txns = []
         for frag in frags:
             p, desc = decode_verified(frag)
-            self._execute(p, desc)
-            sigs.append(desc.signatures(p)[0])
-            txns.append(p)
-            self.metrics.inc("txn_exec")
-        mixin = hashlib.sha256(b"".join(sigs)).digest()
-        out += mixin
-        out += len(txns).to_bytes(2, "little")
-        for p in txns:
-            out += len(p).to_bytes(2, "little")
-            out += p
+            r = self.ctx.execute(p, desc)
+            if r.fee > 0 or r.status == TXN_SUCCESS:
+                # landed (fee-charged, possibly failed): part of the block
+                sigs.append(desc.signatures(p)[0])
+                txns.append(p)
+                self.metrics.inc("txn_exec")
+                if r.status != TXN_SUCCESS:
+                    self.metrics.inc("txn_exec_failed")
+            else:
+                # no on-chain footprint: never recorded in an entry
+                self.metrics.inc("txn_rejected")
         self.metrics.inc("microblocks")
         tsorig = int(meta[MCache.COL_TSORIG])
         if tsorig and len(self.commit_latencies_ns) < 100_000:
             from firedancer_tpu.tango.shm import now_ns
 
             self.commit_latencies_ns.append(now_ns() - tsorig)
-        self.publish(0, bytes(out), sig=mb_seq, tsorig=tsorig)  # -> poh
+        if txns:
+            mixin = hashlib.sha256(b"".join(sigs)).digest()
+            out = bytearray()
+            out += mixin
+            out += len(txns).to_bytes(2, "little")
+            for p in txns:
+                out += len(p).to_bytes(2, "little")
+                out += p
+            self.publish(0, bytes(out), sig=mb_seq, tsorig=tsorig)  # -> poh
         self.publish(1, b"", sig=self.bank_idx)  # -> pack (lock release)
-
-    def _execute(self, payload: bytes, desc: ft.Txn) -> None:
-        """System-transfer interpreter over the lamport map (the stub
-        runtime; enough to observe state transitions in tests)."""
-        addrs = desc.acct_addrs(payload)
-        for ins in desc.instrs:
-            prog = addrs[ins.program_id]
-            if prog != ft.SYSTEM_PROGRAM or ins.data_sz < 12:
-                continue
-            data = payload[ins.data_off : ins.data_off + ins.data_sz]
-            if int.from_bytes(data[:4], "little") != 2:  # transfer tag
-                continue
-            lamports = int.from_bytes(data[4:12], "little")
-            acct_idx = payload[ins.acct_off : ins.acct_off + ins.acct_cnt]
-            if len(acct_idx) < 2:
-                continue
-            src, dst = addrs[acct_idx[0]], addrs[acct_idx[1]]
-            self.lamports[src] = self.lamports.get(src, 0) - lamports
-            self.lamports[dst] = self.lamports.get(dst, 0) + lamports

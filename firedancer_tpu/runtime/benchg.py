@@ -17,20 +17,32 @@ from firedancer_tpu.protocol import txn as ft
 from .stage import Stage
 
 
+def pool_payers(seed: bytes = b"benchg", n_payers: int = 8) -> list[tuple[bytes, bytes]]:
+    """The pool's payer keypairs [(secret, pubkey)] — deterministic from
+    the seed so a bank ctx can pre-fund them (genesis for the synthetic
+    load)."""
+    from firedancer_tpu.ops.ref import ed25519_ref as ref
+
+    payers = []
+    for k in range(n_payers):
+        secret = hashlib.sha256(seed + b"payer%d" % k).digest()
+        payers.append((secret, ref.public_key(secret)))
+    return payers
+
+
+def pool_blockhash(seed: bytes = b"benchg") -> bytes:
+    return hashlib.sha256(seed + b"bh").digest()
+
+
 def gen_transfer_pool(
     n: int, seed: bytes = b"benchg", n_payers: int = 8, n_dests: int = 64
 ) -> list[bytes]:
     """Pool of signed transfers rotating over `n_payers` payer keypairs and
     `n_dests` destinations (fd_benchg.c rotates accounts the same way so
     pack sees schedulable parallelism, not one serializing hot account)."""
-    from firedancer_tpu.ops.ref import ed25519_ref as ref
-
     n_payers = max(1, min(n_payers, n))
-    payers = []
-    for k in range(n_payers):
-        secret = hashlib.sha256(seed + b"payer%d" % k).digest()
-        payers.append((secret, ref.public_key(secret)))
-    blockhash = hashlib.sha256(seed + b"bh").digest()
+    payers = pool_payers(seed, n_payers)
+    blockhash = pool_blockhash(seed)
     return [
         ft.transfer_txn(
             payers[i % n_payers][0],

@@ -67,6 +67,10 @@ class PohStage(Stage):
         self._hashes_since_entry = 0
         self._tick_cnt = 0
         self.entries_out = 0
+        # the slot's final entry hash (the poh_hash the bank hash chains);
+        # entries is an optional in-memory record for replay tests
+        self.last_entry_hash = seed
+        self.entries: list[tuple[int, bytes, list[bytes]]] | None = None
 
     # -- callbacks ----------------------------------------------------------
 
@@ -100,6 +104,9 @@ class PohStage(Stage):
         self._hashes_since_entry = 0
         self.metrics.inc("mixins")
         self.entries_out += 1
+        self.last_entry_hash = self.chain.hash
+        if self.entries is not None:
+            self.entries.append((num_hashes, self.chain.hash, txns))
         self.publish(
             0,
             build_entry(num_hashes, self.chain.hash, txns),
@@ -116,6 +123,9 @@ class PohStage(Stage):
         self._hashes_since_entry = 0
         self.metrics.inc("ticks")
         self.entries_out += 1
+        self.last_entry_hash = self.chain.hash
+        if self.entries is not None:
+            self.entries.append((num_hashes, self.chain.hash, []))
         self.publish(
             0, build_entry(num_hashes, self.chain.hash, []), sig=self.chain.hashcnt
         )

@@ -21,10 +21,7 @@ def test_leader_pipeline_as_processes():
     try:
         ok = h.supervise(
             until=lambda h: h.cncs["store"].diag(Stage.DIAG_FRAGS_IN) > 0
-            and sum(
-                h.cncs[f"bank{b}"].diag(Stage.DIAG_FRAGS_IN) for b in range(2)
-            )
-            > 0,
+            and h.cncs["bank0"].diag(Stage.DIAG_FRAGS_IN) > 0,
             timeout_s=1200,
             heartbeat_timeout_s=900,  # children COLD-compile their kernels now
         )
