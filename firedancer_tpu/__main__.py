@@ -194,10 +194,33 @@ def cmd_genesis(args) -> int:
 
 def cmd_snapshot(args) -> int:
     """Snapshot inspection (the operator-facing face of
-    flamenco/snapshot.py; creation happens via the runtime)."""
+    flamenco/snapshot.py; creation happens via the runtime).  Falls back
+    to the REAL Agave manifest dialect when the archive is a genuine
+    cluster snapshot."""
     from firedancer_tpu.flamenco import snapshot as snap
+    from firedancer_tpu.flamenco.types import CodecError
 
-    man, accounts = snap.snapshot_read(args.path)
+    try:
+        man, accounts = snap.snapshot_read(args.path)
+    except (snap.SnapshotError, CodecError) as internal_err:
+        # not the internal dialect -> try the real Agave manifest; if
+        # that fails too, surface BOTH causes, not a misleading second
+        # error alone
+        try:
+            funk, m, summary = snap.agave_snapshot_load(args.path)
+        except Exception as agave_err:
+            raise SystemExit(
+                f"not an internal-dialect archive ({internal_err}) and "
+                f"not an Agave archive ({agave_err})"
+            )
+        print(f"dialect:   agave")
+        print(f"slot:      {summary['slot']} (epoch {summary['epoch']})")
+        print(f"bank hash: {summary['bank_hash'].hex()}")
+        print(f"accounts:  {summary['accounts']}")
+        print(f"cap:       {summary['capitalization']}")
+        print(f"votes:     {summary['vote_accounts']} vote accounts, "
+              f"{summary['stake_delegations']} delegations")
+        return 0
     kind = f"incremental (base slot {man.base_slot})" if man.base_slot else "full"
     print(f"slot:      {man.slot} ({kind})")
     print(f"bank hash: {man.bank_hash.hex()}")

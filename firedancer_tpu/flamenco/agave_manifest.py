@@ -1,0 +1,690 @@
+"""The real Agave bank manifest: full bincode decode/encode + restore.
+
+Capability parity target: the reference decodes the Solana snapshot
+manifest with generated bincode (`fd_solana_manifest_decode`, schema
+/root/reference/src/flamenco/types/fd_types.json `solana_manifest`) and
+restores it into funk (/root/reference/src/flamenco/snapshot/
+fd_snapshot_restore.c).  No code shared: here every type is a dataclass
+bound to the bincode combinators in flamenco/types.py, mirroring the
+WIRE layout (which is fixed by the Solana protocol) rather than the
+reference's generated-struct machinery.
+
+What this covers (the `snapshots/<slot>/<slot>` file inside a cluster
+snapshot archive):
+
+    SolanaManifest
+      bank: VersionedBank          blockhash queue, ancestors, hashes,
+                                   fee/rent params, epoch schedule,
+                                   inflation, stakes (vote accounts +
+                                   delegations + stake history),
+                                   epoch stakes per epoch, ...
+      accounts_db                  append-vec index: slot -> [(id, sz)],
+                                   bank hash info
+      lamports_per_signature
+      + trailing optional fields (incremental persistence, epoch account
+        hash, versioned epoch stakes) which older manifests simply omit
+        — decoded tolerantly the way the reference marks them
+        `ignore_underflow`.
+
+`restore_manifest` walks the accounts_db storages and loads every
+append-vec (flamenco/appendvec.py) into funk, newest slot winning a
+pubkey, matching the snapshot restore dedup rule.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dfield
+
+from firedancer_tpu.flamenco import types as T
+
+# -- leaf types ---------------------------------------------------------------
+
+
+@dataclass
+class FeeCalculator:
+    lamports_per_signature: int = 0
+
+
+FEE_CALCULATOR = T.StructCodec(
+    FeeCalculator, ("lamports_per_signature", T.U64)
+)
+
+
+@dataclass
+class HashAge:
+    fee_calculator: FeeCalculator
+    hash_index: int
+    timestamp: int
+
+
+HASH_AGE = T.StructCodec(
+    HashAge,
+    ("fee_calculator", FEE_CALCULATOR),
+    ("hash_index", T.U64),
+    ("timestamp", T.U64),
+)
+
+
+@dataclass
+class HashAgePair:
+    key: bytes
+    val: HashAge
+
+
+HASH_AGE_PAIR = T.StructCodec(
+    HashAgePair, ("key", T.Hash32), ("val", HASH_AGE)
+)
+
+
+@dataclass
+class BlockhashQueue:
+    last_hash_index: int = 0
+    last_hash: bytes | None = None
+    ages: list = dfield(default_factory=list)
+    max_age: int = 300
+
+
+BLOCKHASH_QUEUE = T.StructCodec(
+    BlockhashQueue,
+    ("last_hash_index", T.U64),
+    ("last_hash", T.Option(T.Hash32)),
+    ("ages", T.Vec(HASH_AGE_PAIR, max_len=1 << 16)),
+    ("max_age", T.U64),
+)
+
+
+@dataclass
+class SlotPair:
+    slot: int
+    val: int
+
+
+SLOT_PAIR = T.StructCodec(SlotPair, ("slot", T.U64), ("val", T.U64))
+
+
+@dataclass
+class HardForks:
+    hard_forks: list = dfield(default_factory=list)
+
+
+HARD_FORKS = T.StructCodec(
+    HardForks, ("hard_forks", T.Vec(SLOT_PAIR, max_len=1 << 16))
+)
+
+
+@dataclass
+class FeeRateGovernor:
+    target_lamports_per_signature: int = 10_000
+    target_signatures_per_slot: int = 20_000
+    min_lamports_per_signature: int = 5_000
+    max_lamports_per_signature: int = 100_000
+    burn_percent: int = 50
+
+
+FEE_RATE_GOVERNOR = T.StructCodec(
+    FeeRateGovernor,
+    ("target_lamports_per_signature", T.U64),
+    ("target_signatures_per_slot", T.U64),
+    ("min_lamports_per_signature", T.U64),
+    ("max_lamports_per_signature", T.U64),
+    ("burn_percent", T.U8),
+)
+
+
+@dataclass
+class RentCollector:
+    epoch: int = 0
+    epoch_schedule: T.EpochSchedule = dfield(default_factory=T.EpochSchedule)
+    slots_per_year: float = 78892314.984
+    rent: T.Rent = dfield(default_factory=T.Rent)
+
+
+RENT_COLLECTOR = T.StructCodec(
+    RentCollector,
+    ("epoch", T.U64),
+    ("epoch_schedule", T.EPOCH_SCHEDULE),
+    ("slots_per_year", T.F64),
+    ("rent", T.RENT),
+)
+
+
+@dataclass
+class Inflation:
+    initial: float = 0.08
+    terminal: float = 0.015
+    taper: float = 0.15
+    foundation: float = 0.05
+    foundation_term: float = 7.0
+    unused: float = 0.0
+
+
+INFLATION = T.StructCodec(
+    Inflation,
+    ("initial", T.F64),
+    ("terminal", T.F64),
+    ("taper", T.F64),
+    ("foundation", T.F64),
+    ("foundation_term", T.F64),
+    ("unused", T.F64),
+)
+
+
+# -- stakes -------------------------------------------------------------------
+
+
+@dataclass
+class SolanaAccount:
+    lamports: int = 0
+    data: bytes = b""
+    owner: bytes = b"\x00" * 32
+    executable: bool = False
+    rent_epoch: int = 0
+
+    def to_value(self) -> bytes:
+        from firedancer_tpu.flamenco.runtime import acct_build
+
+        return acct_build(self.lamports, self.data, self.owner,
+                          self.executable)
+
+
+SOLANA_ACCOUNT = T.StructCodec(
+    SolanaAccount,
+    ("lamports", T.U64),
+    ("data", T.VarBytes(max_len=1 << 27)),
+    ("owner", T.Pubkey),
+    ("executable", T.Bool),
+    ("rent_epoch", T.U64),
+)
+
+
+@dataclass
+class VoteAccountsPair:
+    key: bytes
+    stake: int
+    value: SolanaAccount
+
+
+VOTE_ACCOUNTS_PAIR = T.StructCodec(
+    VoteAccountsPair,
+    ("key", T.Pubkey),
+    ("stake", T.U64),
+    ("value", SOLANA_ACCOUNT),
+)
+
+
+@dataclass
+class Delegation:
+    voter_pubkey: bytes = b"\x00" * 32
+    stake: int = 0
+    activation_epoch: int = 0
+    deactivation_epoch: int = (1 << 64) - 1
+    warmup_cooldown_rate: float = 0.25
+
+
+DELEGATION = T.StructCodec(
+    Delegation,
+    ("voter_pubkey", T.Pubkey),
+    ("stake", T.U64),
+    ("activation_epoch", T.U64),
+    ("deactivation_epoch", T.U64),
+    ("warmup_cooldown_rate", T.F64),
+)
+
+
+@dataclass
+class DelegationPair:
+    account: bytes
+    delegation: Delegation
+
+
+DELEGATION_PAIR = T.StructCodec(
+    DelegationPair, ("account", T.Pubkey), ("delegation", DELEGATION)
+)
+
+
+@dataclass
+class StakeHistoryEntry:
+    epoch: int
+    effective: int
+    activating: int
+    deactivating: int
+
+
+STAKE_HISTORY_ENTRY = T.StructCodec(
+    StakeHistoryEntry,
+    ("epoch", T.U64),
+    ("effective", T.U64),
+    ("activating", T.U64),
+    ("deactivating", T.U64),
+)
+
+
+@dataclass
+class Stakes:
+    """stakes with Delegation values (the manifest's `bank.stakes`)."""
+
+    vote_accounts: list = dfield(default_factory=list)  # [VoteAccountsPair]
+    stake_delegations: list = dfield(default_factory=list)  # [DelegationPair]
+    unused: int = 0
+    epoch: int = 0
+    stake_history: list = dfield(default_factory=list)  # [StakeHistoryEntry]
+
+
+STAKES = T.StructCodec(
+    Stakes,
+    ("vote_accounts", T.Vec(VOTE_ACCOUNTS_PAIR, max_len=1 << 20)),
+    ("stake_delegations", T.Vec(DELEGATION_PAIR, max_len=1 << 22)),
+    ("unused", T.U64),
+    ("epoch", T.U64),
+    ("stake_history", T.Vec(STAKE_HISTORY_ENTRY, max_len=1 << 12)),
+)
+
+
+@dataclass
+class NodeVoteAccounts:
+    vote_accounts: list = dfield(default_factory=list)  # [pubkey]
+    total_stake: int = 0
+
+
+NODE_VOTE_ACCOUNTS = T.StructCodec(
+    NodeVoteAccounts,
+    ("vote_accounts", T.Vec(T.Pubkey, max_len=1 << 16)),
+    ("total_stake", T.U64),
+)
+
+
+@dataclass
+class PubkeyNodeVoteAccountsPair:
+    key: bytes
+    value: NodeVoteAccounts
+
+
+PUBKEY_NODE_VOTE_ACCOUNTS_PAIR = T.StructCodec(
+    PubkeyNodeVoteAccountsPair,
+    ("key", T.Pubkey),
+    ("value", NODE_VOTE_ACCOUNTS),
+)
+
+
+@dataclass
+class PubkeyPubkeyPair:
+    key: bytes
+    value: bytes
+
+
+PUBKEY_PUBKEY_PAIR = T.StructCodec(
+    PubkeyPubkeyPair, ("key", T.Pubkey), ("value", T.Pubkey)
+)
+
+
+@dataclass
+class EpochStakes:
+    stakes: Stakes
+    total_stake: int = 0
+    node_id_to_vote_accounts: list = dfield(default_factory=list)
+    epoch_authorized_voters: list = dfield(default_factory=list)
+
+
+EPOCH_STAKES = T.StructCodec(
+    EpochStakes,
+    ("stakes", STAKES),
+    ("total_stake", T.U64),
+    ("node_id_to_vote_accounts",
+     T.Vec(PUBKEY_NODE_VOTE_ACCOUNTS_PAIR, max_len=1 << 16)),
+    ("epoch_authorized_voters", T.Vec(PUBKEY_PUBKEY_PAIR, max_len=1 << 16)),
+)
+
+
+@dataclass
+class EpochEpochStakesPair:
+    key: int
+    value: EpochStakes
+
+
+EPOCH_EPOCH_STAKES_PAIR = T.StructCodec(
+    EpochEpochStakesPair, ("key", T.U64), ("value", EPOCH_STAKES)
+)
+
+
+@dataclass
+class UnusedAccounts:
+    unused1: list = dfield(default_factory=list)
+    unused2: list = dfield(default_factory=list)
+    unused3: list = dfield(default_factory=list)  # [(pubkey, u64)]
+
+
+class _PubkeyU64(T.Codec):
+    def encode(self, v):
+        return T.Pubkey.encode(v[0]) + T.U64.encode(v[1])
+
+    def decode(self, buf, off=0):
+        k, off = T.Pubkey.decode(buf, off)
+        n, off = T.U64.decode(buf, off)
+        return (k, n), off
+
+
+UNUSED_ACCOUNTS = T.StructCodec(
+    UnusedAccounts,
+    ("unused1", T.Vec(T.Pubkey, max_len=1 << 16)),
+    ("unused2", T.Vec(T.Pubkey, max_len=1 << 16)),
+    ("unused3", T.Vec(_PubkeyU64(), max_len=1 << 16)),
+)
+
+
+# -- the versioned bank -------------------------------------------------------
+
+
+@dataclass
+class VersionedBank:
+    blockhash_queue: BlockhashQueue = dfield(default_factory=BlockhashQueue)
+    ancestors: list = dfield(default_factory=list)  # [SlotPair]
+    hash: bytes = b"\x00" * 32
+    parent_hash: bytes = b"\x00" * 32
+    parent_slot: int = 0
+    hard_forks: HardForks = dfield(default_factory=HardForks)
+    transaction_count: int = 0
+    tick_height: int = 0
+    signature_count: int = 0
+    capitalization: int = 0
+    max_tick_height: int = 0
+    hashes_per_tick: int | None = 12500
+    ticks_per_slot: int = 64
+    ns_per_slot: int = 400_000_000
+    genesis_creation_time: int = 0
+    slots_per_year: float = 78892314.984
+    accounts_data_len: int = 0
+    slot: int = 0
+    epoch: int = 0
+    block_height: int = 0
+    collector_id: bytes = b"\x00" * 32
+    collector_fees: int = 0
+    fee_calculator: FeeCalculator = dfield(default_factory=FeeCalculator)
+    fee_rate_governor: FeeRateGovernor = dfield(
+        default_factory=FeeRateGovernor)
+    collected_rent: int = 0
+    rent_collector: RentCollector = dfield(default_factory=RentCollector)
+    epoch_schedule: T.EpochSchedule = dfield(default_factory=T.EpochSchedule)
+    inflation: Inflation = dfield(default_factory=Inflation)
+    stakes: Stakes = dfield(default_factory=Stakes)
+    unused_accounts: UnusedAccounts = dfield(default_factory=UnusedAccounts)
+    epoch_stakes: list = dfield(default_factory=list)
+    is_delta: bool = False
+
+
+VERSIONED_BANK = T.StructCodec(
+    VersionedBank,
+    ("blockhash_queue", BLOCKHASH_QUEUE),
+    ("ancestors", T.Vec(SLOT_PAIR, max_len=1 << 20)),
+    ("hash", T.Hash32),
+    ("parent_hash", T.Hash32),
+    ("parent_slot", T.U64),
+    ("hard_forks", HARD_FORKS),
+    ("transaction_count", T.U64),
+    ("tick_height", T.U64),
+    ("signature_count", T.U64),
+    ("capitalization", T.U64),
+    ("max_tick_height", T.U64),
+    ("hashes_per_tick", T.Option(T.U64)),
+    ("ticks_per_slot", T.U64),
+    ("ns_per_slot", T.U128),
+    ("genesis_creation_time", T.U64),
+    ("slots_per_year", T.F64),
+    ("accounts_data_len", T.U64),
+    ("slot", T.U64),
+    ("epoch", T.U64),
+    ("block_height", T.U64),
+    ("collector_id", T.Pubkey),
+    ("collector_fees", T.U64),
+    ("fee_calculator", FEE_CALCULATOR),
+    ("fee_rate_governor", FEE_RATE_GOVERNOR),
+    ("collected_rent", T.U64),
+    ("rent_collector", RENT_COLLECTOR),
+    ("epoch_schedule", T.EPOCH_SCHEDULE),
+    ("inflation", INFLATION),
+    ("stakes", STAKES),
+    ("unused_accounts", UNUSED_ACCOUNTS),
+    ("epoch_stakes", T.Vec(EPOCH_EPOCH_STAKES_PAIR, max_len=1 << 8)),
+    ("is_delta", T.Bool),
+)
+
+
+# -- accounts-db fields -------------------------------------------------------
+
+
+@dataclass
+class SnapshotAccVec:
+    id: int
+    file_sz: int
+
+
+SNAPSHOT_ACC_VEC = T.StructCodec(
+    SnapshotAccVec, ("id", T.U64), ("file_sz", T.U64)
+)
+
+
+@dataclass
+class SnapshotSlotAccVecs:
+    slot: int
+    account_vecs: list
+
+
+SNAPSHOT_SLOT_ACC_VECS = T.StructCodec(
+    SnapshotSlotAccVecs,
+    ("slot", T.U64),
+    ("account_vecs", T.Vec(SNAPSHOT_ACC_VEC, max_len=1 << 16)),
+)
+
+
+@dataclass
+class BankHashStats:
+    num_updated_accounts: int = 0
+    num_removed_accounts: int = 0
+    num_lamports_stored: int = 0
+    total_data_len: int = 0
+    num_executable_accounts: int = 0
+
+
+BANK_HASH_STATS = T.StructCodec(
+    BankHashStats,
+    ("num_updated_accounts", T.U64),
+    ("num_removed_accounts", T.U64),
+    ("num_lamports_stored", T.U64),
+    ("total_data_len", T.U64),
+    ("num_executable_accounts", T.U64),
+)
+
+
+@dataclass
+class BankHashInfo:
+    hash: bytes = b"\x00" * 32
+    snapshot_hash: bytes = b"\x00" * 32
+    stats: BankHashStats = dfield(default_factory=BankHashStats)
+
+
+BANK_HASH_INFO = T.StructCodec(
+    BankHashInfo,
+    ("hash", T.Hash32),
+    ("snapshot_hash", T.Hash32),
+    ("stats", BANK_HASH_STATS),
+)
+
+
+@dataclass
+class SlotMapPair:
+    slot: int
+    hash: bytes
+
+
+SLOT_MAP_PAIR = T.StructCodec(
+    SlotMapPair, ("slot", T.U64), ("hash", T.Hash32)
+)
+
+
+@dataclass
+class AccountsDbFields:
+    storages: list = dfield(default_factory=list)  # [SnapshotSlotAccVecs]
+    version: int = 1
+    slot: int = 0
+    bank_hash_info: BankHashInfo = dfield(default_factory=BankHashInfo)
+    historical_roots: list = dfield(default_factory=list)
+    historical_roots_with_hash: list = dfield(default_factory=list)
+
+
+ACCOUNTS_DB_FIELDS = T.StructCodec(
+    AccountsDbFields,
+    ("storages", T.Vec(SNAPSHOT_SLOT_ACC_VECS, max_len=1 << 20)),
+    ("version", T.U64),
+    ("slot", T.U64),
+    ("bank_hash_info", BANK_HASH_INFO),
+    ("historical_roots", T.Vec(T.U64, max_len=1 << 20)),
+    ("historical_roots_with_hash", T.Vec(SLOT_MAP_PAIR, max_len=1 << 20)),
+)
+
+
+# -- incremental persistence + the manifest -----------------------------------
+
+
+@dataclass
+class BankIncrementalSnapshotPersistence:
+    full_slot: int = 0
+    full_hash: bytes = b"\x00" * 32
+    full_capitalization: int = 0
+    incremental_hash: bytes = b"\x00" * 32
+    incremental_capitalization: int = 0
+
+
+BANK_INCREMENTAL = T.StructCodec(
+    BankIncrementalSnapshotPersistence,
+    ("full_slot", T.U64),
+    ("full_hash", T.Hash32),
+    ("full_capitalization", T.U64),
+    ("incremental_hash", T.Hash32),
+    ("incremental_capitalization", T.U64),
+)
+
+
+@dataclass
+class SolanaManifest:
+    bank: VersionedBank = dfield(default_factory=VersionedBank)
+    accounts_db: AccountsDbFields = dfield(default_factory=AccountsDbFields)
+    lamports_per_signature: int = 5000
+    bank_incremental_snapshot_persistence: (
+        BankIncrementalSnapshotPersistence | None) = None
+    epoch_account_hash: bytes | None = None
+    # [(epoch, ("Current", EpochStakes-with-stake-values))] — decoded but
+    # not interpreted further; current epoch stakes come from bank.stakes
+    versioned_epoch_stakes: list = dfield(default_factory=list)
+
+
+def manifest_encode(m: SolanaManifest) -> bytes:
+    out = VERSIONED_BANK.encode(m.bank)
+    out += ACCOUNTS_DB_FIELDS.encode(m.accounts_db)
+    out += T.U64.encode(m.lamports_per_signature)
+    out += T.Option(BANK_INCREMENTAL).encode(
+        m.bank_incremental_snapshot_persistence)
+    out += T.Option(T.Hash32).encode(m.epoch_account_hash)
+    out += T.U64.encode(len(m.versioned_epoch_stakes))
+    for epoch, (variant, payload) in m.versioned_epoch_stakes:
+        out += T.U64.encode(epoch)
+        out += T.U32.encode(0)  # Current
+        out += EPOCH_STAKES.encode(payload)
+    return out
+
+
+def manifest_decode(blob: bytes) -> SolanaManifest:
+    """Decode a manifest; the three trailing fields are `ignore_underflow`
+    (absent in older snapshot versions — a clean end-of-buffer there is
+    an older manifest, not corruption)."""
+    bank, off = VERSIONED_BANK.decode(blob, 0)
+    adb, off = ACCOUNTS_DB_FIELDS.decode(blob, off)
+    lps, off = T.U64.decode(blob, off)
+    m = SolanaManifest(bank=bank, accounts_db=adb,
+                       lamports_per_signature=lps)
+    if off == len(blob):
+        return m
+    m.bank_incremental_snapshot_persistence, off = T.Option(
+        BANK_INCREMENTAL).decode(blob, off)
+    if off == len(blob):
+        return m
+    m.epoch_account_hash, off = T.Option(T.Hash32).decode(blob, off)
+    if off == len(blob):
+        return m
+    n, off = T.U64.decode(blob, off)
+    if n > 1 << 8:
+        raise T.CodecError("oversized versioned_epoch_stakes")
+    ves = []
+    for _ in range(n):
+        epoch, off = T.U64.decode(blob, off)
+        tag, off = T.U32.decode(blob, off)
+        if tag != 0:
+            raise T.CodecError(f"unknown versioned_epoch_stakes tag {tag}")
+        payload, off = EPOCH_STAKES.decode(blob, off)
+        ves.append((epoch, ("Current", payload)))
+    m.versioned_epoch_stakes = ves
+    if off != len(blob):
+        raise T.CodecError(f"{len(blob) - off} trailing manifest bytes")
+    return m
+
+
+# -- restore ------------------------------------------------------------------
+
+
+def restore_accounts(
+    funk, storages: list, open_vec,
+) -> int:
+    """Load every append-vec into funk's root.  `open_vec(slot, id)` ->
+    append-vec file bytes.  A pubkey stored in several slots resolves to
+    the HIGHEST slot's version (the snapshot restore dedup rule); within
+    one slot, the later entry (higher write_version) wins.  A
+    zero-lamport store is a tombstone and REMOVES the key (an overlay
+    restore onto a pre-populated funk must not resurrect deletions).
+    Returns the number of distinct live accounts restored."""
+    from firedancer_tpu.flamenco.appendvec import iter_appendvec
+
+    best: dict[bytes, tuple[int, int, bytes | None]] = {}
+    for store in sorted(storages, key=lambda s: s.slot):
+        for av in store.account_vecs:
+            blob = open_vec(store.slot, av.id)
+            for ent in iter_appendvec(blob, current_len=av.file_sz):
+                prev = best.get(ent.pubkey)
+                key = (store.slot, ent.write_version)
+                if prev is not None and prev[:2] > key:
+                    continue
+                if ent.lamports == 0:
+                    # a zero-lamport store is a tombstone: the account
+                    # was deleted in that slot
+                    best[ent.pubkey] = (*key, None)
+                else:
+                    best[ent.pubkey] = (*key, ent.to_value())
+    n = 0
+    for pubkey, (_s, _wv, val) in best.items():
+        if val is None:
+            # tombstone: delete if present (overlay restore); a cold
+            # boot simply never materializes the key
+            if funk.rec_query(None, pubkey) is not None:
+                funk.rec_remove(None, pubkey)
+            continue
+        funk.rec_insert(None, pubkey, val)
+        n += 1
+    return n
+
+
+def restore_manifest(funk, m: SolanaManifest, open_vec) -> dict:
+    """Restore accounts + the consensus-relevant bank state.  Returns a
+    summary the caller (snapshot boot / CLI) reports: slot, bank hash,
+    account count, registered blockhashes, stake/vote surface sizes."""
+    n = restore_accounts(funk, m.accounts_db.storages, open_vec)
+    return {
+        "slot": m.bank.slot,
+        "bank_hash": m.bank.hash,
+        "parent_hash": m.bank.parent_hash,
+        "accounts": n,
+        "capitalization": m.bank.capitalization,
+        "blockhashes": [
+            (p.key, p.val.hash_index) for p in m.bank.blockhash_queue.ages
+        ],
+        "vote_accounts": len(m.bank.stakes.vote_accounts),
+        "stake_delegations": len(m.bank.stakes.stake_delegations),
+        "epoch": m.bank.epoch,
+        "lamports_per_signature": m.lamports_per_signature,
+    }

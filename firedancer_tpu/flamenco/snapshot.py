@@ -16,10 +16,16 @@ Append-vec entries use the canonical storage record layout, 8-aligned:
     hash 32     (account hash; this build stores sha256 of the fields)
     data        data_len bytes, padded to 8
 
-The manifest here is this framework's reduced bank state (slot,
-bank_hash, parent hash, account count) encoded with the bincode
-combinators — the full Agave bank bincode (epoch stakes, ancestors,
-hard forks, …) layers onto the same container as the runtime grows.
+Two manifest dialects share the container:
+
+  - this framework's reduced manifest (slot, bank_hash, parent hash,
+    account count) via `snapshot_write`/`snapshot_load` — the compact
+    internal checkpoint format; and
+  - the REAL Agave bank manifest (flamenco/agave_manifest.py: versioned
+    bank, stakes, epoch stakes, blockhash queue, accounts-db index) via
+    `agave_snapshot_write`/`agave_snapshot_load` — genuine cluster
+    snapshot ingestion, the fd_snapshot_restore.c capability.
+
 Incremental snapshots diff a full base: only accounts whose bytes
 changed (or appeared) since the base land in the archive, restored by
 overlaying base then incremental — the reference's two-archive scheme.
@@ -222,3 +228,114 @@ def snapshot_load(
     for k, v in accounts.items():
         funk.rec_insert(None, k, v)
     return funk, manifest
+
+
+# -- real Agave-format archives ----------------------------------------------
+
+
+def agave_snapshot_write(
+    path: str,
+    manifest,
+    vecs: dict[tuple[int, int], bytes],
+    *,
+    level: int = 3,
+) -> None:
+    """Write an Agave-format archive: the full bank manifest bincode +
+    append-vec files laid out exactly as a cluster snapshot
+    (`snapshots/<slot>/<slot>`, `accounts/<slot>.<id>`).  `manifest` is
+    an agave_manifest.SolanaManifest whose accounts_db.storages index
+    the `vecs` {(slot, id): appendvec bytes}."""
+    from firedancer_tpu.flamenco.agave_manifest import manifest_encode
+
+    tar_buf = io.BytesIO()
+    with tarfile.open(fileobj=tar_buf, mode="w") as tar:
+        def add(name: str, payload: bytes):
+            info = tarfile.TarInfo(name)
+            info.size = len(payload)
+            tar.addfile(info, io.BytesIO(payload))
+
+        add("version", SNAPSHOT_VERSION)
+        slot = manifest.bank.slot
+        add(f"snapshots/{slot}/{slot}", manifest_encode(manifest))
+        for (vslot, vid), blob in sorted(vecs.items()):
+            add(f"accounts/{vslot}.{vid}", blob)
+    comp = zstandard.ZstdCompressor(level=level).compress(tar_buf.getvalue())
+    with open(path, "wb") as f:
+        f.write(comp)
+
+
+def _is_bank_manifest_member(name: str) -> bool:
+    """`snapshots/<slot>/<slot>` only — genuine archives also carry
+    `snapshots/status_cache` (and possibly other metadata), which must
+    not be fed to the bank-manifest decoder."""
+    parts = name.split("/")
+    return (
+        len(parts) == 3
+        and parts[0] == "snapshots"
+        and parts[1].isdigit()
+        and parts[2] == parts[1]
+    )
+
+
+def agave_snapshot_load(
+    path: str, funk: Funk | None = None,
+) -> tuple[Funk, "object", dict]:
+    """Boot from a REAL Agave-format snapshot archive: decode the full
+    bank manifest, then restore every append-vec the accounts-db index
+    names into the funk root (newest slot wins a pubkey; zero-lamport
+    stores tombstone).  Returns (funk, SolanaManifest, restore summary)
+    — the capability fd_snapshot_restore.c provides the reference.
+
+    The archive is processed as a STREAM (zstd stream_reader + pipe-mode
+    tar): cluster snapshots decompress to tens of GiB, so nothing holds
+    the whole image in memory — account vecs spill to a temp dir one
+    member at a time and are consumed after the manifest arrives."""
+    import os
+    import shutil
+    import tempfile
+
+    from firedancer_tpu.flamenco.agave_manifest import (
+        manifest_decode,
+        restore_manifest,
+    )
+
+    manifest = None
+    spill = tempfile.mkdtemp(prefix="fdtpu_snapload_")
+    try:
+        with open(path, "rb") as f, zstandard.ZstdDecompressor().stream_reader(
+            f
+        ) as zr, tarfile.open(fileobj=zr, mode="r|") as tar:
+            for member in tar:
+                payload = tar.extractfile(member)
+                if payload is None:
+                    continue
+                if _is_bank_manifest_member(member.name):
+                    manifest = manifest_decode(payload.read())
+                elif member.name.startswith("accounts/"):
+                    stem = member.name.rsplit("/", 1)[-1]
+                    try:
+                        vslot, vid = (int(x) for x in stem.split(".", 1))
+                    except ValueError:
+                        raise SnapshotError(
+                            f"bad accounts member name {member.name!r}"
+                        )
+                    with open(os.path.join(spill, f"{vslot}.{vid}"),
+                              "wb") as out:
+                        shutil.copyfileobj(payload, out)
+        if manifest is None:
+            raise SnapshotError("archive has no bank manifest")
+
+        def open_vec(slot: int, vid: int) -> bytes:
+            try:
+                with open(os.path.join(spill, f"{slot}.{vid}"), "rb") as f:
+                    return f.read()
+            except FileNotFoundError:
+                raise SnapshotError(
+                    f"manifest names missing vec {slot}.{vid}"
+                )
+
+        funk = funk or Funk()
+        summary = restore_manifest(funk, manifest, open_vec)
+        return funk, manifest, summary
+    finally:
+        shutil.rmtree(spill, ignore_errors=True)

@@ -57,6 +57,7 @@ class _Int(Codec):
 
 
 U8, U16, U32, U64 = _Int(1), _Int(2), _Int(4), _Int(8)
+U128 = _Int(16)
 I64 = _Int(8, signed=True)
 
 

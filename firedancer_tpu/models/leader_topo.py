@@ -165,6 +165,16 @@ def build_leader_topology(
     with thread-creating clones allowed for XLA."""
     from firedancer_tpu.ops.ref import ed25519_ref as ref
 
+    if n_bank != 1:
+        # each bank process owns its own funk: two real-execution banks
+        # in separate processes would commit into divergent state
+        # machines (see build_bank) — refuse rather than diverge
+        raise ValueError(
+            "process topology supports exactly one bank stage until funk "
+            "has a cross-process backend; the cooperative pipeline "
+            "(models/leader.py) runs any bank count over the shared ctx"
+        )
+
     topo = ft.Topology()
     topo.link("gv", depth=1024, mtu=1232)
     topo.link("vd", depth=1024, mtu=4096)

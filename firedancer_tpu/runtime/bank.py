@@ -161,7 +161,10 @@ class BankStage(Stage):
         for frag in frags:
             p, desc = decode_verified(frag)
             r = self.ctx.execute(p, desc)
-            if r.fee > 0 or r.status == TXN_SUCCESS:
+            # landed == fee charged: the SAME predicate SlotExecution
+            # uses for signature_cnt and status-cache staging — the two
+            # must never disagree or replay diverges from the sealed hash
+            if r.fee > 0:
                 # landed (fee-charged, possibly failed): part of the block
                 sigs.append(desc.signatures(p)[0])
                 txns.append(p)
