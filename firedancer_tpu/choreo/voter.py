@@ -33,6 +33,7 @@ class Voter:
         is_ancestor,
         ghost_weight=None,
         total_stake: int = 0,
+        bank_hash: bytes = b"\x00" * 32,
     ) -> bytes | None:
         """Run the tower's safety checks for `slot`; on approval record
         the vote and return the signed vote txn (None = abstain).
@@ -41,6 +42,8 @@ class Voter:
         or Ghost.is_ancestor).  ghost_weight+total_stake feed the
         threshold check when provided (fd_tower's threshold rule needs
         cluster stake context; without it only lockout safety runs).
+        bank_hash: the voted slot's bank hash — the vote program checks
+        it against the SlotHashes sysvar (fork-identity binding).
         """
         if self.last_sent is not None and slot <= self.last_sent:
             return None
@@ -53,11 +56,16 @@ class Voter:
                 return None
         self.tower.vote(slot)
         self.last_sent = slot
-        payload = self._build(slot, recent_blockhash)
+        payload = self._build(slot, recent_blockhash, bank_hash)
         return payload
 
-    def _build(self, slot: int, recent_blockhash: bytes) -> bytes:
-        data = (1).to_bytes(4, "little") + slot.to_bytes(8, "little")
+    def _build(self, slot: int, recent_blockhash: bytes,
+               bank_hash: bytes) -> bytes:
+        """A real VoteInstruction::Vote txn (the wire the vote program
+        executes: flamenco/vote_program.py)."""
+        from firedancer_tpu.flamenco.vote_program import encode_vote_ix
+
+        data = encode_vote_ix([slot], bank_hash)
         msg = ft.message_build(
             version=ft.VLEGACY,
             signature_cnt=1,

@@ -150,7 +150,7 @@ class Executor:
     """Program registry + instruction dispatch."""
 
     def __init__(self):
-        from firedancer_tpu.flamenco import alt, programs, stake
+        from firedancer_tpu.flamenco import alt, programs, stake, vote_program
         from firedancer_tpu.pack.cost import COMPUTE_BUDGET_PROGRAM
 
         from firedancer_tpu.flamenco import bpf_loader
@@ -162,7 +162,7 @@ class Executor:
             config_program.CONFIG_PROGRAM: config_program.config_program,
             precompiles.ED25519_PROGRAM: precompiles.ed25519_program,
             precompiles.SECP256K1_PROGRAM: precompiles.secp256k1_program,
-            VOTE_PROGRAM: programs.vote_program,
+            VOTE_PROGRAM: vote_program.vote_program,
             stake.STAKE_PROGRAM: stake.stake_program,
             alt.ALT_PROGRAM: alt.alt_program,
             COMPUTE_BUDGET_PROGRAM: programs.compute_budget_program,

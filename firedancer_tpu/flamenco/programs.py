@@ -153,50 +153,6 @@ def compute_budget_program(executor, ctx, program_id, iaccts, data,
         raise AcctError("malformed compute budget instruction")
 
 
-# -- vote program -------------------------------------------------------------
-# account data layout: u64 last_voted_slot | u64 vote_count | 32B authority
-#
-# Votes feed tower/ghost fork choice, so vote forgery manipulates consensus
-# weight; the reference's fd_vote_program requires the authorized voter's
-# signature on every vote.  Here the authority binds on the first vote into
-# a fresh account (the first signing instruction account becomes the
-# authorized voter) and every later vote must carry that authority's
-# signature.
-
-
-def vote_program(executor, ctx, program_id, iaccts, data, *, pda_signers):
-    from firedancer_tpu.protocol.txn import VOTE_PROGRAM
-
-    if len(data) < 12 or _u32(data) != 1 or len(iaccts) < 1:
-        return  # non-vote instruction: no-op
-    if not iaccts[0].is_writable:
-        raise AcctError("vote account not writable")
-    vote_slot = _u64(data[4:])
-    a = ctx.accounts[iaccts[0].txn_idx]
-    if a.owner != VOTE_PROGRAM:
-        # owner-may-modify: a foreign account's data is untouchable;
-        # vote accounts are created/assigned to the vote program first
-        raise AcctError("vote account not owned by the vote program")
-    signers = [
-        ctx.accounts[ia.txn_idx].key
-        for ia in iaccts
-        if ia.is_signer or ctx.accounts[ia.txn_idx].key in pda_signers
-    ]
-    if len(a.data) < 48:
-        a.data = bytearray(bytes(a.data).ljust(48, b"\x00"))
-    authority = bytes(a.data[16:48])
-    cnt = _u64(bytes(a.data[8:16]))
-    if authority == bytes(32):
-        # Authority binds only on a FRESH account (no vote history).  An
-        # account with votes but a zero authority is a legacy/corrupt
-        # state that must not be hijackable by whoever votes next.
-        if cnt != 0:
-            raise AcctError("vote account has history but no authority")
-        if not signers:
-            raise AcctError("vote missing authorized-voter signature")
-        authority = signers[0]
-        a.data[16:48] = authority
-    elif authority not in signers:
-        raise AcctError("vote missing authorized-voter signature")
-    a.data[0:8] = vote_slot.to_bytes(8, "little")
-    a.data[8:16] = (cnt + 1).to_bytes(8, "little")
+# The vote program lives in flamenco/vote_program.py: the REAL VoteState
+# machine over the agave_state codec (lockout doubling, voter rotation,
+# tower sync) — fd_vote_program.c parity, registered by the executor.

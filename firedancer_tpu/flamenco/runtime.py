@@ -169,6 +169,10 @@ def default_sysvars(slot: int) -> dict:
         "clock": T.CLOCK.encode(T.Clock(slot=slot, epoch=epoch)),
         "rent": T.RENT.encode(T.Rent()),
         "epoch_schedule": T.EPOCH_SCHEDULE.encode(sched),
+        # recent bank hashes the vote program validates against; the
+        # caller (replay/consensus) supplies real entries via
+        # execute_block(slot_hashes=...) — empty means votes reject
+        "slot_hashes": T.SLOT_HASHES.encode([]),
         # the slot's blockhash view for the nonce family; execute_block
         # overrides with the real parent bank hash
         "recent_blockhash": _hl.sha256(
@@ -349,6 +353,7 @@ class SlotExecution:
         executor: Executor | None = None,
         status_cache=None,
         ancestors: set[int] | None = None,
+        slot_hashes: list[tuple[int, bytes]] | None = None,
     ):
         self.funk = funk
         self.slot = slot
@@ -366,6 +371,12 @@ class SlotExecution:
         # durable nonces advance against the PARENT's bank hash: fresh,
         # deterministic, and fixed before any txn in this block runs
         self.sysvars["recent_blockhash"] = parent_bank_hash
+        if slot_hashes is not None:
+            from firedancer_tpu.flamenco import types as T
+
+            self.sysvars["slot_hashes"] = T.SLOT_HASHES.encode(
+                [T.SlotHash(s, h) for s, h in slot_hashes]
+            )
         if status_cache is not None:
             status_cache.begin_block(self.xid, slot)
         # intra-block duplicates are tracked locally, NOT via the cache
@@ -514,6 +525,7 @@ def execute_block(
     publish: bool = False,
     status_cache=None,
     ancestors: set[int] | None = None,
+    slot_hashes: list[tuple[int, bytes]] | None = None,
 ) -> BlockResult:
     """Execute a block's txns on a fresh funk fork; compute the bank hash.
 
@@ -532,7 +544,7 @@ def execute_block(
     sx = SlotExecution(
         funk, slot=slot, parent_bank_hash=parent_bank_hash,
         parent_xid=parent_xid, status_cache=status_cache,
-        ancestors=ancestors,
+        ancestors=ancestors, slot_hashes=slot_hashes,
     )
     extras = [sx.resolve(p, t) for p, t in parsed]
     waves = generate_waves(parsed, extras)

@@ -590,16 +590,22 @@ def vote_txn(
     recent_blockhash: bytes,
     *,
     voter_pubkey: bytes | None = None,
+    bank_hash: bytes = b"\x00" * 32,
 ) -> bytes:
     """A simple vote (the shape pack routes to its vote lane and the
-    runtime's vote program consumes: one instr to the vote program,
-    data = u32 tag 1 | u64 slot)."""
+    runtime's vote program consumes): one VoteInstruction::Vote instr —
+    data = u32 tag 2 | Vec<u64> slots | 32B bank hash | Option<i64> ts
+    (the real wire; flamenco/vote_program.py executes it)."""
     from firedancer_tpu.ops.ref import ed25519_ref as ref
 
     voter = voter_pubkey if voter_pubkey is not None else ref.public_key(
         voter_secret
     )
-    data = (1).to_bytes(4, "little") + slot.to_bytes(8, "little")
+    # the program's own encoder (function-scoped import: flamenco sits
+    # above protocol, but a txn BUILDER legitimately speaks its wire)
+    from firedancer_tpu.flamenco.vote_program import encode_vote_ix
+
+    data = encode_vote_ix([slot], bank_hash)
     msg = message_build(
         version=VLEGACY,
         signature_cnt=1,

@@ -375,68 +375,332 @@ def gen_stake():
 # -- vote ----------------------------------------------------------------------
 
 
-def vote_state(last_slot, cnt, authority):
-    return last_slot.to_bytes(8, "little") + cnt.to_bytes(8, "little") + \
-        authority
+# -- the real vote program: state built with the protocol codec
+# (agave_state — layout is protocol-defined), RULES simulated here
+# independently from fd_vote_program.c's documented semantics: lockout
+# expiry (slot + 2^conf), root promotion at 31 deep with a latency-graded
+# credit, and lockout DOUBLING (conf += 1 for every vote deeper in the
+# stack than its confirmation count).
+
+
+def _sim_vote(tower, slot):
+    """tower: [(slot, conf)] -> new tower after voting `slot` (pop
+    expired, push conf=1, double)."""
+    t = [list(x) for x in tower]
+    while t and t[-1][0] + (2 ** t[-1][1]) < slot:
+        t.pop()
+    rooted = None
+    if len(t) == 31:
+        rooted = t.pop(0)
+    t.append([slot, 1])
+    for i, (s, c) in enumerate(t):
+        if len(t) > i + c:
+            t[i][1] = c + 1
+    return [tuple(x) for x in t], rooted
+
+
+def _vs_bytes(tower, *, authority, withdrawer, root=None, credits=(),
+              node=None, commission=0, epoch=0):
+    """tower entries: (slot, conf) with latency 0, or (slot, conf,
+    latency)."""
+    from firedancer_tpu.flamenco import agave_state as ast
+    from firedancer_tpu.flamenco.vote_program import VOTE_STATE_SIZE
+
+    vs = ast.VoteState(
+        node_pubkey=node or key("vt:node"),
+        authorized_withdrawer=withdrawer,
+        commission=commission,
+        votes=[ast.LandedVote(t[2] if len(t) > 2 else 0,
+                              ast.Lockout(t[0], t[1]))
+               for t in tower],
+        root_slot=root,
+        authorized_voters={epoch: authority},
+        epoch_credits=list(credits),
+    )
+    return ast.vote_state_encode(vs).ljust(VOTE_STATE_SIZE, b"\x00")
+
+
+def _clock_acct(slot, epoch=0):
+    from firedancer_tpu.flamenco import types as T
+    from firedancer_tpu.protocol.base58 import b58_decode32
+
+    addr = b58_decode32("SysvarC1ock11111111111111111111111111111111")
+    return acct(addr, 1,
+                data=T.CLOCK.encode(T.Clock(slot=slot, epoch=epoch)))
+
+
+def _slot_hashes_acct(entries):
+    from firedancer_tpu.flamenco import types as T
+    from firedancer_tpu.protocol.base58 import b58_decode32
+
+    addr = b58_decode32("SysvarS1otHashes111111111111111111111111111")
+    return acct(addr, 1, data=T.SLOT_HASHES.encode(
+        [T.SlotHash(s, h) for s, h in entries]))
 
 
 def gen_vote():
-    fam = "vote"
-    va, auth = key("vt:acct"), key("vt:auth")
+    from firedancer_tpu.flamenco.vote_program import (
+        VOTE_STATE_SIZE,
+        encode_initialize_ix,
+        encode_tower_sync_ix,
+        encode_vote_ix,
+    )
 
-    # fresh account: first signer becomes the authority
-    fx(fam, "vote_binds_authority", VOTE_PROGRAM,
-       [acct(va, 10, data=bytes(48), owner=VOTE_PROGRAM), acct(auth, 0)],
+    fam = "vote"
+    va, auth, wd = key("vt:acct"), key("vt:auth"), key("vt:wd")
+    node = key("vt:node")
+
+    def bh(slot):
+        return hashlib.sha256(b"vt:bankhash:%d" % slot).digest()
+
+    def vote_fix(name, tower, vote_slots, *, slot=100, sh_slots=None,
+                 signer=True, signer_key=None, result=0, root=None,
+                 credits=(), expect=None, expect_root=None,
+                 expect_credits=None, hash_override=None, writable=True,
+                 owner=VOTE_PROGRAM):
+        sh = [(s, bh(s)) for s in (sh_slots if sh_slots is not None
+                                   else vote_slots)]
+        data = encode_vote_ix(
+            list(vote_slots),
+            hash_override if hash_override is not None
+            else (sh[-1][1] if sh else bytes(32)),
+        )
+        state = _vs_bytes(tower, authority=auth, withdrawer=wd, root=root,
+                          credits=credits)
+        accounts = [
+            acct(va, 10**9, data=state, owner=owner),
+            acct(signer_key or auth, 0),
+            _clock_acct(slot),
+            _slot_hashes_acct(sh),
+        ]
+        mod = ()
+        if result == 0:
+            # carried votes keep their recorded latency; NEW slots land
+            # with latency = clock.slot - voted_slot (timely-vote rule)
+            init_lat = {t[0]: (t[2] if len(t) > 2 else 0) for t in tower}
+            expect3 = [
+                (s, c, init_lat.get(s, max(0, slot - s)))
+                for s, c in (expect or [])
+            ]
+            mod = [acct(va, 10**9,
+                        data=_vs_bytes(
+                            expect3, authority=auth, withdrawer=wd,
+                            root=expect_root if expect_root is not None
+                            else root,
+                            credits=(expect_credits if expect_credits
+                                     is not None else credits),
+                        ) if expect is not None else state,
+                        owner=owner)]
+        fx(fam, name, VOTE_PROGRAM, accounts,
+           refs((0, False, writable), (1, signer, False)),
+           data, slot=slot, result=result, modified=mod)
+
+    # simple vote onto an empty tower
+    t1, _ = _sim_vote([], 99)
+    vote_fix("vote_ok_fresh", [], [99], slot=100, expect=t1)
+    # lockout doubling: three ascending votes, confs [3,2,1]
+    tower = []
+    for s in (10, 20, 30):
+        tower, _ = _sim_vote(tower, s)
+    v4, _ = _sim_vote(tower, 40)
+    # state after 10,20,30 voted at their own slots (latency 0 here)
+    vote_fix("vote_lockout_doubling",
+             tower, [40], slot=41, expect=v4)
+    # expiry: tower [(10,2),(12,1)]; vote 50 expires both (12+2<50, 10+4<50)
+    texp, _ = _sim_vote([(10, 2), (12, 1)], 50)
+    assert texp == [(50, 1)]
+    vote_fix("vote_expires_lockouts", [(10, 2), (12, 1)], [50], slot=51,
+             expect=texp)
+    # a vote for a slot not in SlotHashes: rejected
+    vote_fix("vote_slot_not_in_hashes", [], [99], sh_slots=[98],
+             slot=100, result=1)
+    # hash mismatch for the voted slot: rejected
+    vote_fix("vote_hash_mismatch", [], [99], hash_override=b"\xee" * 32,
+             slot=100, result=1)
+    # old slots all filtered: rejected
+    vote_fix("vote_all_too_old", [(99, 1)], [98], sh_slots=[98],
+             slot=100, result=1)
+    # forged (no signature): rejected
+    vote_fix("vote_forged", [], [99], signer=False, result=1)
+    # wrong signer: rejected
+    vote_fix("vote_wrong_signer", [], [99], signer_key=key("vt:mallory"),
+             result=1)
+    # foreign owner / readonly: rejected
+    vote_fix("vote_foreign_owner", [], [99], owner=SYSTEM_PROGRAM, result=1)
+    vote_fix("vote_readonly", [], [99], writable=False, result=1)
+
+    # root promotion at 31 deep: credit awarded to the rooted vote.  The
+    # new slot (32) sits INSIDE the last lockout (31 + 2^1 >= 32) so no
+    # expiry fires — the stack overflows instead, rooting slot 1
+    deep = [(s, 31 - i) for i, s in enumerate(range(1, 32))]
+    rooted_slot = deep[0][0]
+    after = [list(x) for x in deep[1:]]
+    after.append([32, 1])
+    for i, (s, c) in enumerate(after):
+        if len(after) > i + c:
+            after[i][1] = c + 1
+    vote_fix("vote_root_at_31_deep", deep, [32], slot=33,
+             sh_slots=[32],
+             expect=[tuple(x) for x in after],
+             expect_root=rooted_slot,
+             expect_credits=[(0, 1, 0)])
+
+    # initialize: ok on a zeroed right-sized account, node signs
+    init_data = encode_initialize_ix(node, auth, wd, commission=5)
+    fx(fam, "init_ok", VOTE_PROGRAM,
+       [acct(va, 10**9, data=bytes(VOTE_STATE_SIZE), owner=VOTE_PROGRAM),
+        acct(node, 0), _clock_acct(100)],
        refs((0, False, True), (1, True, False)),
-       u32(1) + u64(77),
-       modified=[acct(va, 10, data=vote_state(77, 1, auth),
+       init_data, slot=100,
+       modified=[acct(va, 10**9,
+                      data=_vs_bytes([], authority=auth, withdrawer=wd,
+                                     node=node, commission=5),
                       owner=VOTE_PROGRAM)])
-    # established authority signs: ok
-    fx(fam, "vote_ok", VOTE_PROGRAM,
-       [acct(va, 10, data=vote_state(77, 1, auth), owner=VOTE_PROGRAM),
-        acct(auth, 0)],
+    fx(fam, "init_wrong_size", VOTE_PROGRAM,
+       [acct(va, 10**9, data=bytes(VOTE_STATE_SIZE - 1),
+             owner=VOTE_PROGRAM),
+        acct(node, 0), _clock_acct(100)],
        refs((0, False, True), (1, True, False)),
-       u32(1) + u64(99),
-       modified=[acct(va, 10, data=vote_state(99, 2, auth),
-                      owner=VOTE_PROGRAM)])
-    # no signature: forgery rejected
-    fx(fam, "vote_forged", VOTE_PROGRAM,
-       [acct(va, 10, data=vote_state(77, 1, auth), owner=VOTE_PROGRAM),
-        acct(auth, 0)],
+       init_data, slot=100, result=1)
+    fx(fam, "init_twice", VOTE_PROGRAM,
+       [acct(va, 10**9, data=_vs_bytes([], authority=auth, withdrawer=wd),
+             owner=VOTE_PROGRAM),
+        acct(node, 0), _clock_acct(100)],
+       refs((0, False, True), (1, True, False)),
+       init_data, slot=100, result=1)
+    fx(fam, "init_node_must_sign", VOTE_PROGRAM,
+       [acct(va, 10**9, data=bytes(VOTE_STATE_SIZE), owner=VOTE_PROGRAM),
+        acct(node, 0), _clock_acct(100)],
        refs((0, False, True), (1, False, False)),
-       u32(1) + u64(99), result=1)
-    # wrong signer
-    fx(fam, "vote_wrong_signer", VOTE_PROGRAM,
-       [acct(va, 10, data=vote_state(77, 1, auth), owner=VOTE_PROGRAM),
-        acct(key("vt:mallory"), 0)],
+       init_data, slot=100, result=1)
+
+    # authorize: withdrawer rotates the voter; lands NEXT epoch
+    new_voter = key("vt:newvoter")
+    base = _vs_bytes([], authority=auth, withdrawer=wd)
+    from firedancer_tpu.flamenco import agave_state as ast
+
+    vs_after = ast.vote_state_decode(base)
+    vs_after.authorized_voters[1] = new_voter
+    pv = vs_after.prior_voters
+    pv.idx = (pv.idx + 1) % 32
+    pv.buf[pv.idx] = (auth, 0, 1)
+    pv.is_empty = False
+    fx(fam, "authorize_voter_by_withdrawer", VOTE_PROGRAM,
+       [acct(va, 10**9, data=base, owner=VOTE_PROGRAM), acct(wd, 0),
+        _clock_acct(100)],
        refs((0, False, True), (1, True, False)),
-       u32(1) + u64(99), result=1)
-    # history but zero authority: unhijackable
-    fx(fam, "vote_history_no_authority", VOTE_PROGRAM,
-       [acct(va, 10, data=vote_state(77, 5, bytes(32)), owner=VOTE_PROGRAM),
-        acct(auth, 0)],
+       u32(1) + new_voter + u32(0),
+       modified=[acct(va, 10**9,
+                      data=ast.vote_state_encode(vs_after).ljust(
+                          VOTE_STATE_SIZE, b"\x00"),
+                      owner=VOTE_PROGRAM)])
+    fx(fam, "authorize_voter_wrong_signer", VOTE_PROGRAM,
+       [acct(va, 10**9, data=base, owner=VOTE_PROGRAM),
+        acct(key("vt:mallory"), 0), _clock_acct(100)],
        refs((0, False, True), (1, True, False)),
-       u32(1) + u64(99), result=1)
-    # foreign owner untouchable
-    fx(fam, "vote_foreign_owner", VOTE_PROGRAM,
-       [acct(va, 10, data=vote_state(77, 1, auth)), acct(auth, 0)],
+       u32(1) + new_voter + u32(0), result=1)
+    vs_wd = ast.vote_state_decode(base)
+    vs_wd.authorized_withdrawer = new_voter
+    fx(fam, "authorize_withdrawer_ok", VOTE_PROGRAM,
+       [acct(va, 10**9, data=base, owner=VOTE_PROGRAM), acct(wd, 0),
+        _clock_acct(100)],
        refs((0, False, True), (1, True, False)),
-       u32(1) + u64(99), result=1)
-    # not writable
-    fx(fam, "vote_readonly", VOTE_PROGRAM,
-       [acct(va, 10, data=vote_state(77, 1, auth), owner=VOTE_PROGRAM),
-        acct(auth, 0)],
-       refs((0, False, False), (1, True, False)),
-       u32(1) + u64(99), result=1)
-    # short payload / non-vote tag: inert no-op
-    for name, data in (("short", u32(1) + bytes(4)), ("othertag", u32(9))):
-        fx(fam, f"vote_noop_{name}", VOTE_PROGRAM,
-           [acct(va, 10, data=vote_state(77, 1, auth), owner=VOTE_PROGRAM),
-            acct(auth, 0)],
-           refs((0, False, True), (1, True, False)),
-           data, result=0,
-           modified=[acct(va, 10, data=vote_state(77, 1, auth),
-                          owner=VOTE_PROGRAM)])
+       u32(1) + new_voter + u32(1),
+       modified=[acct(va, 10**9,
+                      data=ast.vote_state_encode(vs_wd).ljust(
+                          VOTE_STATE_SIZE, b"\x00"),
+                      owner=VOTE_PROGRAM)])
+
+    # withdraw rules.  rent floor for 3762 bytes (default Rent):
+    # (128 + 3762) * 3480 * 2
+    floor = (128 + VOTE_STATE_SIZE) * 3480 * 2
+    dest = key("vt:dest")
+    fx(fam, "withdraw_partial_ok", VOTE_PROGRAM,
+       [acct(va, floor + 500, data=base, owner=VOTE_PROGRAM),
+        acct(dest, 7), acct(wd, 0), _clock_acct(100)],
+       refs((0, False, True), (1, False, True), (2, True, False)),
+       u32(3) + u64(500),
+       modified=[acct(va, floor, data=base, owner=VOTE_PROGRAM),
+                 acct(dest, 507)])
+    fx(fam, "withdraw_below_rent_floor", VOTE_PROGRAM,
+       [acct(va, floor + 500, data=base, owner=VOTE_PROGRAM),
+        acct(dest, 7), acct(wd, 0), _clock_acct(100)],
+       refs((0, False, True), (1, False, True), (2, True, False)),
+       u32(3) + u64(501), result=1)
+    # full drain with recent credits: ActiveVoteAccountClose
+    active = _vs_bytes([], authority=auth, withdrawer=wd,
+                       credits=[(0, 5, 0)])
+    fx(fam, "withdraw_close_active", VOTE_PROGRAM,
+       [acct(va, 1000, data=active, owner=VOTE_PROGRAM),
+        acct(dest, 0), acct(wd, 0), _clock_acct(100, epoch=0)],
+       refs((0, False, True), (1, False, True), (2, True, False)),
+       u32(3) + u64(1000), result=1)
+    # full drain of an idle account: state deinitializes
+    idle = _vs_bytes([], authority=auth, withdrawer=wd,
+                     credits=[(0, 5, 0)])
+    fx(fam, "withdraw_close_idle", VOTE_PROGRAM,
+       [acct(va, 1000, data=idle, owner=VOTE_PROGRAM),
+        acct(dest, 0), acct(wd, 0), _clock_acct(10 * SLOTS_PER_EPOCH,
+                                                epoch=10)],
+       refs((0, False, True), (1, False, True), (2, True, False)),
+       u32(3) + u64(1000),
+       modified=[acct(va, 0, data=bytes(VOTE_STATE_SIZE),
+                      owner=VOTE_PROGRAM),
+                 acct(dest, 1000)])
+
+    # commission: decrease anytime; increase only in epoch's first half
+    com10 = _vs_bytes([], authority=auth, withdrawer=wd, commission=10)
+    vs_c5 = ast.vote_state_decode(com10)
+    vs_c5.commission = 5
+    fx(fam, "commission_decrease_ok", VOTE_PROGRAM,
+       [acct(va, 10**9, data=com10, owner=VOTE_PROGRAM), acct(wd, 0),
+        _clock_acct(SLOTS_PER_EPOCH - 10)],  # late in the epoch
+       refs((0, False, True), (1, True, False)),
+       u32(5) + bytes([5]),
+       modified=[acct(va, 10**9,
+                      data=ast.vote_state_encode(vs_c5).ljust(
+                          VOTE_STATE_SIZE, b"\x00"),
+                      owner=VOTE_PROGRAM)])
+    fx(fam, "commission_increase_late_rejected", VOTE_PROGRAM,
+       [acct(va, 10**9, data=com10, owner=VOTE_PROGRAM), acct(wd, 0),
+        _clock_acct(SLOTS_PER_EPOCH - 10)],
+       refs((0, False, True), (1, True, False)),
+       u32(5) + bytes([20]), result=1)
+
+    # tower sync: wholesale replacement with structural validation
+    cur = _vs_bytes([(10, 3), (20, 2), (30, 1)], authority=auth,
+                    withdrawer=wd)
+    new_lk = [(20, 3), (30, 2), (40, 1)]
+    ts_data = encode_tower_sync_ix(new_lk, 10, bh(40))
+    vs_ts = ast.vote_state_decode(cur)
+    # 20/30 carry their recorded latency (0); 40 is new at clock 41 -> 1
+    vs_ts.votes = [ast.LandedVote({40: 1}.get(s, 0), ast.Lockout(s, c))
+                   for s, c in new_lk]
+    vs_ts.root_slot = 10
+    vs_ts.epoch_credits = [(0, 1, 0)]  # slot 10 newly rooted, latency 0
+    fx(fam, "tower_sync_ok", VOTE_PROGRAM,
+       [acct(va, 10**9, data=cur, owner=VOTE_PROGRAM), acct(auth, 0),
+        _clock_acct(41), _slot_hashes_acct([(40, bh(40))])],
+       refs((0, False, True), (1, True, False)),
+       ts_data,
+       modified=[acct(va, 10**9,
+                      data=ast.vote_state_encode(vs_ts).ljust(
+                          VOTE_STATE_SIZE, b"\x00"),
+                      owner=VOTE_PROGRAM)])
+    # root rollback rejected
+    rooted = _vs_bytes([(20, 2), (30, 1)], authority=auth, withdrawer=wd,
+                       root=15)
+    fx(fam, "tower_sync_root_rollback", VOTE_PROGRAM,
+       [acct(va, 10**9, data=rooted, owner=VOTE_PROGRAM), acct(auth, 0),
+        _clock_acct(41), _slot_hashes_acct([(40, bh(40))])],
+       refs((0, False, True), (1, True, False)),
+       encode_tower_sync_ix([(30, 2), (40, 1)], 5, bh(40)), result=1)
+    # disordered confirmations rejected
+    fx(fam, "tower_sync_confs_not_descending", VOTE_PROGRAM,
+       [acct(va, 10**9, data=base, owner=VOTE_PROGRAM), acct(auth, 0),
+        _clock_acct(41), _slot_hashes_acct([(40, bh(40))])],
+       refs((0, False, True), (1, True, False)),
+       encode_tower_sync_ix([(30, 1), (40, 1)], None, bh(40)), result=1)
 
 
 # -- address lookup table ------------------------------------------------------
@@ -611,11 +875,20 @@ def gen_nonce():
        refs((0, False, True), (1, True, False)), u32(4), result=1)
 
     # withdraw: authority moves lamports; overdraft fails
+    # partial withdraw must leave the rent-exempt floor intact (r4
+    # hardening): fund well above it
+    nfloor = (128 + N.DATA_LEN) * 3480 * 2
     fx(fam, "withdraw_ok", SYSTEM_PROGRAM,
-       [acct(na, 50, data=init_state), acct(dest, 5), acct(auth, 0)],
+       [acct(na, nfloor + 50, data=init_state), acct(dest, 5),
+        acct(auth, 0)],
        refs((0, False, True), (1, False, True), (2, True, False)),
        u32(5) + u64(20),
-       modified=[acct(na, 30, data=init_state), acct(dest, 25)])
+       modified=[acct(na, nfloor + 30, data=init_state), acct(dest, 25)])
+    fx(fam, "withdraw_partial_below_floor", SYSTEM_PROGRAM,
+       [acct(na, nfloor + 50, data=init_state), acct(dest, 5),
+        acct(auth, 0)],
+       refs((0, False, True), (1, False, True), (2, True, False)),
+       u32(5) + u64(51), result=1)
     fx(fam, "withdraw_overdraft", SYSTEM_PROGRAM,
        [acct(na, 50, data=init_state), acct(dest, 5), acct(auth, 0)],
        refs((0, False, True), (1, False, True), (2, True, False)),

@@ -82,42 +82,64 @@ def test_voter_emits_and_respects_lockout():
 def test_consensus_loop_end_to_end():
     """Votes flow: voter txn -> runtime vote program -> ghost weights ->
     head selection -> forks.publish at the tower root."""
+    from firedancer_tpu.flamenco import agave_state as ast
+    from firedancer_tpu.flamenco import vote_program as vp
+
     secret = bytes(range(32))
     pub = ref.public_key(secret)
     vote_acct = b"V" * 32
 
     funk = Funk()
     funk.rec_insert(None, pub, rt.acct_build(10_000_000))
-    funk.rec_insert(None, vote_acct, rt.acct_build(0, owner=ft.VOTE_PROGRAM))
+    init = ast.VoteState(node_pubkey=pub, authorized_withdrawer=pub,
+                         authorized_voters={0: pub})
+    funk.rec_insert(None, vote_acct, rt.acct_build(
+        0,
+        data=ast.vote_state_encode(init).ljust(vp.VOTE_STATE_SIZE, b"\x00"),
+        owner=ft.VOTE_PROGRAM,
+    ))
 
     ghost = Ghost(0)
     forks = Forks(0, root_xid=None)
     voter = Voter(vote_account=vote_acct, voter_pubkey=pub,
                   sign=lambda m: ref.sign(secret, m))
 
+    # a vote for slot N is validated against SlotHashes (N's bank hash)
+    # and lands in slot N+1 — the real one-slot lag
     parent_hash = b"\x00" * 32
     parent_xid = None
-    for slot in (1, 2):
+    slot_hashes = []
+    pending_vote = None
+    for slot in (1, 2, 3):
         ghost.insert(slot, slot - 1)
         forks.insert(slot, slot - 1)
-        vt = voter.maybe_vote(slot, b"B" * 32, is_ancestor=forks.is_ancestor)
-        assert vt is not None
         res = rt.execute_block(
-            funk, slot=slot, txns=[vt], parent_bank_hash=parent_hash,
-            parent_xid=parent_xid,
+            funk, slot=slot,
+            txns=[pending_vote] if pending_vote is not None else [],
+            parent_bank_hash=parent_hash, parent_xid=parent_xid,
+            slot_hashes=list(slot_hashes),
         )
-        assert res.results[0].status == rt.TXN_SUCCESS
+        if pending_vote is not None:
+            assert res.results[0].status == rt.TXN_SUCCESS
         forks.freeze(slot, xid=res.xid, bank_hash=res.bank_hash,
                      poh_hash=b"p" * 32)
+        slot_hashes.append((slot, res.bank_hash))
+        pending_vote = voter.maybe_vote(
+            slot, b"B" * 32, is_ancestor=forks.is_ancestor,
+            bank_hash=res.bank_hash,
+        )
+        assert pending_vote is not None
         ghost.vote(pub, slot, 1_000)
         parent_hash, parent_xid = res.bank_hash, res.xid
 
-    assert ghost.head() == 2
+    assert ghost.head() == 3
     from firedancer_tpu.flamenco.executor import acct_decode
 
     vote_data = acct_decode(funk.rec_query(parent_xid, vote_acct))[3]
-    assert int.from_bytes(vote_data[0:8], "little") == 2  # last voted slot
-    assert int.from_bytes(vote_data[8:16], "little") == 2  # two votes landed
+    vs = ast.vote_state_decode(vote_data)
+    # votes for slots 1 and 2 landed (slot 3's vote is still pending)
+    assert [(v.lockout.slot, v.lockout.confirmation_count)
+            for v in vs.votes] == [(1, 2), (2, 1)]
 
     pruned = forks.publish(1)
     assert 0 in pruned and forks.root_slot == 1

@@ -186,35 +186,49 @@ def test_replay_block_checks_poh():
 
 
 def test_vote_program_updates_vote_account():
-    """The vote native program: simple votes execute in the vote lane,
-    recording (last slot, count) on the vote account — the state tower
-    and ghost consume."""
+    """The REAL vote program in the runtime: simple votes execute in the
+    vote lane, pushing lockouts onto the VoteState tower (validated
+    against the SlotHashes sysvar) — the state tower and ghost consume."""
+    from firedancer_tpu.flamenco import agave_state as ast
+    from firedancer_tpu.flamenco import vote_program as vp
     from firedancer_tpu.flamenco.runtime import LAMPORTS_PER_SIGNATURE
 
     funk = Funk()
     secret, voter = keypair(b"voter")
     vote_acct = hashlib.sha256(b"vote-acct").digest()
     fund(funk, voter, 1_000_000)
-    # vote accounts are vote-program-owned (owner-may-modify rule)
-    funk.rec_insert(None, vote_acct, acct_build(0, owner=ft.VOTE_PROGRAM))
-    bh = hashlib.sha256(b"bh-v").digest()
-    t1 = ft.vote_txn(secret, vote_acct, 100, bh)
-    bh2 = hashlib.sha256(b"bh-v2").digest()
-    t2 = ft.vote_txn(secret, vote_acct, 101, bh2)
+    # an initialized vote account (voter is the authorized voter)
+    init = ast.VoteState(
+        node_pubkey=voter, authorized_withdrawer=voter,
+        authorized_voters={0: voter},
+    )
+    funk.rec_insert(None, vote_acct, acct_build(
+        0,
+        data=ast.vote_state_encode(init).ljust(vp.VOTE_STATE_SIZE, b"\x00"),
+        owner=ft.VOTE_PROGRAM,
+    ))
+    bh100 = hashlib.sha256(b"bankhash-100").digest()
+    bh101 = hashlib.sha256(b"bankhash-101").digest()
+    t1 = ft.vote_txn(secret, vote_acct, 100, hashlib.sha256(b"bh-v").digest(),
+                     bank_hash=bh100)
+    t2 = ft.vote_txn(secret, vote_acct, 101,
+                     hashlib.sha256(b"bh-v2").digest(), bank_hash=bh101)
     # cost model must classify them as simple votes (the pack vote lane)
     from firedancer_tpu.pack import cost as fc
 
     c = fc.compute_cost(t1, ft.txn_parse(t1))
     assert c is not None and c.is_simple_vote
-    res = execute_block(funk, slot=5, txns=[t1, t2])
+    res = execute_block(funk, slot=105, txns=[t1, t2],
+                        slot_hashes=[(100, bh100), (101, bh101)])
     assert [r.status for r in res.results] == [TXN_SUCCESS, TXN_SUCCESS]
     # votes on the same account serialize into separate waves
     assert len(res.waves) == 2
     from firedancer_tpu.flamenco.executor import acct_decode
 
     data = acct_decode(funk.rec_query(res.xid, vote_acct))[3]
-    assert int.from_bytes(data[0:8], "little") == 101   # last voted slot
-    assert int.from_bytes(data[8:16], "little") == 2    # vote count
+    vs = ast.vote_state_decode(data)
+    assert [(v.lockout.slot, v.lockout.confirmation_count)
+            for v in vs.votes] == [(100, 2), (101, 1)]
     # fees charged to the voter
     assert acct_lamports(funk.rec_query(res.xid, voter)) == (
         1_000_000 - 2 * LAMPORTS_PER_SIGNATURE
@@ -223,8 +237,11 @@ def test_vote_program_updates_vote_account():
 
 def test_vote_forgery_rejected():
     """Regression (advisor r3): any txn author could write into any vote
-    account.  The authority binds on the first vote; a different signer's
-    vote on the same account must fail (consensus weight is at stake)."""
+    account.  With the REAL vote program, only the authorized voter's
+    signature moves the tower; a different signer's vote must fail
+    (consensus weight is at stake)."""
+    from firedancer_tpu.flamenco import agave_state as ast
+    from firedancer_tpu.flamenco import vote_program as vp
     from firedancer_tpu.flamenco.runtime import TXN_SUCCESS as OK
 
     funk = Funk()
@@ -233,19 +250,29 @@ def test_vote_forgery_rejected():
     vote_acct = hashlib.sha256(b"va-forge").digest()
     fund(funk, voter, 1_000_000)
     fund(funk, forger, 1_000_000)
-    funk.rec_insert(None, vote_acct, acct_build(0, owner=ft.VOTE_PROGRAM))
+    init = ast.VoteState(node_pubkey=voter, authorized_withdrawer=voter,
+                         authorized_voters={0: voter})
+    funk.rec_insert(None, vote_acct, acct_build(
+        0,
+        data=ast.vote_state_encode(init).ljust(vp.VOTE_STATE_SIZE, b"\x00"),
+        owner=ft.VOTE_PROGRAM,
+    ))
     bh = hashlib.sha256(b"bh-f").digest()
-    res = execute_block(funk, slot=5, txns=[
-        ft.vote_txn(secret, vote_acct, 100, bh),        # binds authority
-        ft.vote_txn(forger_secret, vote_acct, 999, bh),  # forged
-    ])
+    bh100 = hashlib.sha256(b"bankhash-f100").digest()
+    bh999 = hashlib.sha256(b"bankhash-f999").digest()
+    res = execute_block(funk, slot=1000, txns=[
+        ft.vote_txn(secret, vote_acct, 100, bh, bank_hash=bh100),
+        ft.vote_txn(forger_secret, vote_acct, 999, bh,  # forged
+                    bank_hash=bh999),
+    ], slot_hashes=[(100, bh100), (999, bh999)])
     assert res.results[0].status == OK
     assert res.results[1].status != OK
     from firedancer_tpu.flamenco.executor import acct_decode
 
     data = acct_decode(funk.rec_query(res.xid, vote_acct))[3]
-    assert int.from_bytes(data[0:8], "little") == 100  # forged slot ignored
-    assert int.from_bytes(data[8:16], "little") == 1
+    vs = ast.vote_state_decode(data)
+    # the forged slot never landed on the tower
+    assert [v.lockout.slot for v in vs.votes] == [100]
 
 
 def test_readonly_accounts_reject_writes():

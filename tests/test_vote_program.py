@@ -1,0 +1,163 @@
+"""The real vote program's state machine: worked examples of the rules
+fd_vote_program.c implements (lockout doubling, expiry, root promotion,
+timely-vote credits, voter rotation, tower sync validation)."""
+
+import pytest
+
+from firedancer_tpu.flamenco import agave_state as ast
+from firedancer_tpu.flamenco import vote_program as vp
+
+
+def tower(vs):
+    return [(v.lockout.slot, v.lockout.confirmation_count)
+            for v in vs.votes]
+
+
+def mk(votes=(), root=None, epoch=0, voter=b"v" * 32):
+    return ast.VoteState(
+        node_pubkey=b"n" * 32,
+        authorized_withdrawer=b"w" * 32,
+        votes=[ast.LandedVote(0, ast.Lockout(s, c)) for s, c in votes],
+        root_slot=root,
+        authorized_voters={epoch: voter},
+    )
+
+
+def test_lockout_doubling_worked_example():
+    """Consecutive votes deepen confirmations: the canonical 1,2,3,4
+    ladder from the tower spec."""
+    vs = mk()
+    for s in (1, 2, 3, 4):
+        vp.process_next_vote_slot(vs, s, 0, s)
+    assert tower(vs) == [(1, 4), (2, 3), (3, 2), (4, 1)]
+
+
+def test_lockout_expiry_pops_unconfirmed():
+    """A vote beyond a lockout's expiry (slot + 2^conf) pops it."""
+    vs = mk()
+    for s in (1, 2):
+        vp.process_next_vote_slot(vs, s, 0, s)
+    # (2,1) expires at 2+2=4 < 5; (1,2) expires at 1+4=5 < 5? no: 5 == 5
+    vp.process_next_vote_slot(vs, 5, 0, 5)
+    assert tower(vs) == [(1, 2), (5, 1)]
+
+
+def test_root_promotion_at_31_and_credit():
+    vs = mk()
+    for s in range(1, 33):  # 32 votes: the 32nd roots slot 1
+        vp.process_next_vote_slot(vs, s, 0, s)
+    assert vs.root_slot == 1
+    assert len(vs.votes) == 31
+    assert vs.epoch_credits and vs.epoch_credits[-1][1] == 1
+
+
+def test_timely_vote_credit_grading():
+    assert vp.credits_for_latency(0) == 1     # legacy
+    assert vp.credits_for_latency(1) == 16
+    assert vp.credits_for_latency(2) == 16    # grace edge
+    assert vp.credits_for_latency(3) == 15
+    assert vp.credits_for_latency(17) == 1
+    assert vp.credits_for_latency(200) == 1   # floor
+
+
+def test_vote_requires_slot_hashes_entry():
+    vs = mk()
+    with pytest.raises(vp.VoteError):
+        vp.process_vote(vs, vp.VoteIx([10], b"h" * 32, None),
+                        [(9, b"x" * 32)], 0, 11)
+
+
+def test_vote_hash_must_match():
+    vs = mk()
+    with pytest.raises(vp.VoteError):
+        vp.process_vote(vs, vp.VoteIx([10], b"h" * 32, None),
+                        [(10, b"x" * 32)], 0, 11)
+    # correct hash passes
+    vp.process_vote(vs, vp.VoteIx([10], b"x" * 32, None),
+                    [(10, b"x" * 32)], 0, 11)
+    assert tower(vs) == [(10, 1)]
+
+
+def test_authorize_rotation_lands_next_epoch():
+    vs = mk(voter=b"A" * 32)
+    vp.set_new_authorized_voter(vs, b"B" * 32, current_epoch=0,
+                                target_epoch=1)
+    assert vs.authorized_voter_for(0) == b"A" * 32  # still current
+    assert vs.authorized_voter_for(1) == b"B" * 32  # next epoch
+    assert not vs.prior_voters.is_empty
+    # only one pending rotation at a time
+    with pytest.raises(vp.VoteError):
+        vp.set_new_authorized_voter(vs, b"C" * 32, 0, 1)
+
+
+def test_tower_sync_validation():
+    vs = mk(votes=[(10, 3), (20, 2), (30, 1)])
+    sh = [(40, b"h" * 32)]
+    # root rollback
+    vs.root_slot = 15
+    with pytest.raises(vp.VoteError):
+        vp.process_new_vote_state(
+            vs, [ast.Lockout(40, 1)], 5, b"h" * 32, sh, 0, 41)
+    # dropping the root entirely is also a rollback
+    with pytest.raises(vp.VoteError):
+        vp.process_new_vote_state(
+            vs, [ast.Lockout(40, 1)], None, b"h" * 32, sh, 0, 41)
+    # disordered slots / confirmations
+    with pytest.raises(vp.VoteError):
+        vp.process_new_vote_state(
+            vs, [ast.Lockout(40, 2), ast.Lockout(35, 1)], 20,
+            b"h" * 32, sh, 0, 41)
+    with pytest.raises(vp.VoteError):
+        vp.process_new_vote_state(
+            vs, [ast.Lockout(35, 1), ast.Lockout(40, 1)], 20,
+            b"h" * 32, sh, 0, 41)
+    # a valid replacement roots 20: only the NEWLY rooted slot (20 —
+    # slot 10 sits at/below the existing root 15) earns its credit
+    vp.process_new_vote_state(
+        vs, [ast.Lockout(30, 2), ast.Lockout(40, 1)], 20, b"h" * 32,
+        sh, 0, 41)
+    assert vs.root_slot == 20
+    assert tower(vs) == [(30, 2), (40, 1)]
+    assert vs.epoch_credits[-1][1] == 1
+
+
+def test_tower_sync_cannot_rewind_last_vote():
+    """A new state whose last slot <= the current last voted slot is
+    VoteTooOld — shrinking the tower to re-vote on another fork is the
+    lockout-safety break the check exists for."""
+    vs = mk(votes=[(10, 3), (20, 2), (30, 1)])
+    with pytest.raises(vp.VoteError):
+        vp.process_new_vote_state(
+            vs, [ast.Lockout(15, 1)], None, b"h" * 32,
+            [(15, b"h" * 32)], 0, 41)
+
+
+def test_timestamp_same_slot_reassert_allowed():
+    vs = mk()
+    vp._check_and_set_timestamp(vs, 10, 1000)
+    vp._check_and_set_timestamp(vs, 10, 1000)  # identical: allowed
+    with pytest.raises(vp.VoteError):
+        vp._check_and_set_timestamp(vs, 10, 1001)  # same slot, new ts
+    with pytest.raises(vp.VoteError):
+        vp._check_and_set_timestamp(vs, 9, 1002)   # slot rewind
+    vp._check_and_set_timestamp(vs, 11, 1002)
+
+
+def test_epoch_credit_gap_replaces_zero_entry():
+    """Epochs that earned nothing leave NO row behind (byte-parity with
+    Agave's epoch_credits encoding)."""
+    vs = mk()
+    vp.increment_credits(vs, 0, 3)
+    vp.increment_credits(vs, 1, 0)   # zero-credit epoch
+    vp.increment_credits(vs, 3, 2)   # gap: epochs 1-2 earned nothing
+    assert vs.epoch_credits == [(0, 3, 0), (3, 5, 3)]
+
+
+def test_vote_state_roundtrips_through_account_encoding():
+    vs = mk(votes=[(5, 2), (6, 1)], root=1)
+    vs.epoch_credits = [(0, 7, 3)]
+    blob = ast.vote_state_encode(vs).ljust(vp.VOTE_STATE_SIZE, b"\x00")
+    vs2 = ast.vote_state_decode(blob)
+    assert tower(vs2) == [(5, 2), (6, 1)]
+    assert vs2.root_slot == 1
+    assert vs2.epoch_credits == [(0, 7, 3)]
