@@ -133,6 +133,10 @@ class TxnCtx:
     # every top-level instruction's data, in txn order — the precompile
     # programs' offset tables reference across instructions
     instr_datas: list = field(default_factory=list)
+    # processed-instruction trace: (stack_height, program_id,
+    # [(pubkey, signer, writable)], data) per completed instruction —
+    # sol_get_processed_sibling_instruction's source
+    instr_trace: list = field(default_factory=list)
 
     def charge(self, n: int) -> None:
         self.cu_used += n
@@ -225,6 +229,14 @@ class Executor:
                 raise InstrError(
                     f"lamport sum changed {lam_before} -> {lam_after}"
                 )
+            # record the PROCESSED instruction for sibling introspection
+            # (sol_get_processed_sibling_instruction reads this trace)
+            ctx.instr_trace.append((
+                len(ctx.stack), program_id,
+                [(ctx.accounts[ia.txn_idx].key, ia.is_signer,
+                  ia.is_writable) for ia in iaccts],
+                bytes(data),
+            ))
         finally:
             ctx.stack.pop()
 
@@ -274,6 +286,8 @@ class Executor:
         v.sysvars = ctx.sysvars
         v.return_data = ctx.return_data
         v.program_id = program_id
+        v.stack_height = len(ctx.stack)
+        v.instr_trace = ctx.instr_trace
         fvm.register_default_syscalls(v, log_sink=ctx.logs)
         register_cpi_syscall(self, v, ctx, iaccts, program_id, smap,
                              pda_signers)

@@ -173,6 +173,14 @@ def default_sysvars(slot: int) -> dict:
         # caller (replay/consensus) supplies real entries via
         # execute_block(slot_hashes=...) — empty means votes reject
         "slot_hashes": T.SLOT_HASHES.encode([]),
+        # Fees { fee_calculator: { lamports_per_signature } }
+        "fees": LAMPORTS_PER_SIGNATURE.to_bytes(8, "little"),
+        # EpochRewards: distribution_starting_block_height u64 |
+        # num_partitions u64 | parent_blockhash 32 | total_points u128 |
+        # total_rewards u64 | distributed_rewards u64 | active bool —
+        # inactive outside the distribution window
+        "epoch_rewards": bytes(8 + 8 + 32 + 16 + 8 + 8 + 1),
+        "last_restart_slot": (0).to_bytes(8, "little"),
         # the slot's blockhash view for the nonce family; execute_block
         # overrides with the real parent bank hash
         "recent_blockhash": _hl.sha256(
@@ -576,6 +584,9 @@ def replay_block(
     parent_bank_hash: bytes = b"\x00" * 32,
     parent_xid: bytes | None = None,
     publish: bool = False,
+    status_cache=None,
+    ancestors: set[int] | None = None,
+    slot_hashes: list[tuple[int, bytes]] | None = None,
 ) -> BlockResult | None:
     """The non-leader path: verify the PoH chain over wire entries, then
     execute the block (fd_replay's after_frag shape).  None = PoH fraud."""
@@ -594,4 +605,9 @@ def replay_block(
         poh_hash=poh_hash,
         parent_xid=parent_xid,
         publish=publish,
+        status_cache=status_cache,
+        ancestors=ancestors,
+        # the replayer's view of recent bank hashes — votes in this
+        # block validate against it (empty would reject every vote)
+        slot_hashes=slot_hashes,
     )

@@ -100,6 +100,12 @@ class Vm:
         self.sysvars: dict[str, bytes] = {}
         self.return_data: tuple[bytes, bytes] = (bytes(32), b"")
         self.program_id: bytes = bytes(32)
+        # invoke-stack height of the executing instruction (top level = 1)
+        # and the txn's processed-instruction trace
+        # [(stack_height, program_id, [(pubkey, signer, writable)], data)]
+        # — sol_get_stack_height / sol_get_processed_sibling_instruction
+        self.stack_height: int = 1
+        self.instr_trace: list = []
 
     def charge(self, n: int) -> None:
         """Charge `n` compute units; syscalls use this for their fixed +
@@ -312,6 +318,42 @@ SYSCALL_SOL_GET_RENT = _sid("sol_get_rent_sysvar")
 SYSCALL_SOL_GET_EPOCH_SCHEDULE = _sid("sol_get_epoch_schedule_sysvar")
 SYSCALL_SOL_SET_RETURN_DATA = _sid("sol_set_return_data")
 SYSCALL_SOL_GET_RETURN_DATA = _sid("sol_get_return_data")
+SYSCALL_SOL_BLAKE3 = _sid("sol_blake3")
+SYSCALL_SOL_POSEIDON = _sid("sol_poseidon")
+SYSCALL_SOL_BIG_MOD_EXP = _sid("sol_big_mod_exp")
+SYSCALL_SOL_ALT_BN128_COMPRESSION = _sid("sol_alt_bn128_compression")
+SYSCALL_SOL_CURVE_VALIDATE_POINT = _sid("sol_curve_validate_point")
+SYSCALL_SOL_CURVE_GROUP_OP = _sid("sol_curve_group_op")
+SYSCALL_SOL_CURVE_MULTISCALAR_MUL = _sid("sol_curve_multiscalar_mul")
+SYSCALL_SOL_GET_STACK_HEIGHT = _sid("sol_get_stack_height")
+SYSCALL_SOL_REMAINING_CU = _sid("sol_remaining_compute_units")
+SYSCALL_SOL_GET_SIBLING_INSTR = _sid("sol_get_processed_sibling_instruction")
+SYSCALL_SOL_GET_FEES = _sid("sol_get_fees_sysvar")
+SYSCALL_SOL_GET_EPOCH_REWARDS = _sid("sol_get_epoch_rewards_sysvar")
+SYSCALL_SOL_GET_LAST_RESTART_SLOT = _sid("sol_get_last_restart_slot")
+
+# curve25519 syscall selectors (fd_vm_syscall_curve.c's convention)
+CURVE25519_EDWARDS = 0
+CURVE25519_RISTRETTO = 1
+CURVE_OP_ADD = 0
+CURVE_OP_SUB = 1
+CURVE_OP_MUL = 2
+CURVE_MSM_MAX_POINTS = 512
+# per-op CU costs (the reference/Agave cost table shape)
+CURVE_COSTS = {
+    (CURVE25519_EDWARDS, "validate"): 159,
+    (CURVE25519_RISTRETTO, "validate"): 169,
+    (CURVE25519_EDWARDS, CURVE_OP_ADD): 473,
+    (CURVE25519_EDWARDS, CURVE_OP_SUB): 475,
+    (CURVE25519_EDWARDS, CURVE_OP_MUL): 2177,
+    (CURVE25519_RISTRETTO, CURVE_OP_ADD): 521,
+    (CURVE25519_RISTRETTO, CURVE_OP_SUB): 519,
+    (CURVE25519_RISTRETTO, CURVE_OP_MUL): 2208,
+}
+CURVE_MSM_BASE = {CURVE25519_EDWARDS: 2273, CURVE25519_RISTRETTO: 2303}
+CURVE_MSM_INCR = {CURVE25519_EDWARDS: 758, CURVE25519_RISTRETTO: 788}
+BIG_MOD_EXP_MAX_LEN = 512
+ALT_BN128_COMPRESSION_COSTS = {0: 30, 1: 398, 2: 86, 3: 13610}
 
 MAX_RETURN_DATA = 1024
 
@@ -587,10 +629,222 @@ def register_default_syscalls(vm: Vm, *, log_sink: list | None = None) -> None:
             vm_._write_span(program_id_addr, pid)
         return len(data)
 
+    # -- blake3 / poseidon / big_mod_exp / bn254 compression ------------------
+    # (fd_vm_syscall_hash.c sol_blake3; fd_vm_syscall_crypto.c the rest)
+
+    def sol_blake3(vm_, vals_addr, vals_len, result_addr, *_):
+        from firedancer_tpu.ops import blake3 as b3
+
+        data = _gather(vm_, vals_addr, vals_len)
+        vm_.charge(HASH_BASE_COST + len(data) // HASH_BYTE_COST_DIV)
+        _write_bytes(vm_, result_addr, b3.blake3_host(data))
+        return 0
+
+    def sol_poseidon(vm_, params, endianness, vals_addr, vals_len,
+                     result_addr):
+        from firedancer_tpu.ops import poseidon as pos
+
+        if params != 0:  # only Bn254X5 exists
+            return 1
+        if not 1 <= vals_len <= pos.MAX_INPUTS:
+            return 1
+        # Agave's cost curve is superlinear in the input count
+        vm_.charge(SYSCALL_BASE_COST + 61 * vals_len * vals_len + 542)
+        try:
+            inputs = []
+            for i in range(vals_len):
+                addr = vm_.mem_read(vals_addr + 16 * i, 8)
+                sz = vm_.mem_read(vals_addr + 16 * i + 8, 8)
+                inputs.append(vm_.mem_read_bytes(addr, sz))
+            # endianness selector: 0 = big endian, 1 = little endian
+            out = pos.poseidon_hash(inputs, big_endian=(endianness == 0))
+        except pos.PoseidonError:
+            return 1
+        _write_bytes(vm_, result_addr, out)
+        return 0
+
+    def sol_big_mod_exp(vm_, params_addr, return_addr, *_):
+        # BigModExpParams: 3 x (u64 addr, u64 len) for base/exponent/mod
+        fields = [vm_.mem_read(params_addr + 8 * i, 8) for i in range(6)]
+        base_addr, base_len, exp_addr, exp_len, mod_addr, mod_len = fields
+        if max(base_len, exp_len, mod_len) > BIG_MOD_EXP_MAX_LEN:
+            return 1
+        vm_.charge(SYSCALL_BASE_COST + 33 * max(base_len, exp_len, mod_len))
+        base = int.from_bytes(vm_.mem_read_bytes(base_addr, base_len), "big")
+        exp = int.from_bytes(vm_.mem_read_bytes(exp_addr, exp_len), "big")
+        mod = int.from_bytes(vm_.mem_read_bytes(mod_addr, mod_len), "big")
+        if mod == 0:
+            return 1
+        out = pow(base, exp, mod).to_bytes(mod_len, "big")
+        _write_bytes(vm_, return_addr, out)
+        return 0
+
+    def sol_alt_bn128_compression(vm_, op, input_addr, input_len,
+                                  result_addr, *_):
+        from firedancer_tpu.ops import bn254 as bn
+
+        cost = ALT_BN128_COMPRESSION_COSTS.get(op)
+        if cost is None:
+            return 1
+        vm_.charge(cost)
+        data = vm_.mem_read_bytes(input_addr, input_len) if input_len else b""
+        try:
+            if op == 0:
+                out = bn.g1_compress(data)
+            elif op == 1:
+                out = bn.g1_decompress(data)
+            elif op == 2:
+                out = bn.g2_compress(data)
+            else:
+                out = bn.g2_decompress(data)
+        except bn.Bn254Error:
+            return 1
+        vm_._write_span(result_addr, out)
+        return 0
+
+    # -- curve25519 group syscalls (fd_vm_syscall_curve.c) --------------------
+
+    def _ed_decode(data):
+        from firedancer_tpu.ops.ref import ed25519_ref as ed
+
+        return ed.point_decompress(data)
+
+    def _curve_decode(curve_id, data):
+        from firedancer_tpu.ops import ristretto as ri
+
+        if curve_id == CURVE25519_EDWARDS:
+            return _ed_decode(data)
+        try:
+            return ri.decode(data)
+        except ri.RistrettoError:
+            return None
+
+    def _curve_encode(curve_id, p):
+        from firedancer_tpu.ops import ristretto as ri
+        from firedancer_tpu.ops.ref import ed25519_ref as ed
+
+        if curve_id == CURVE25519_EDWARDS:
+            return ed.point_compress(p)
+        return ri.encode(p)
+
+    def sol_curve_validate_point(vm_, curve_id, point_addr, *_):
+        cost = CURVE_COSTS.get((curve_id, "validate"))
+        if cost is None:
+            return 1
+        vm_.charge(cost)
+        data = vm_.mem_read_bytes(point_addr, 32)
+        return 0 if _curve_decode(curve_id, data) is not None else 1
+
+    def sol_curve_group_op(vm_, curve_id, group_op, left_addr, right_addr,
+                           result_addr):
+        from firedancer_tpu.ops.ref import ed25519_ref as ed
+
+        cost = CURVE_COSTS.get((curve_id, group_op))
+        if cost is None:
+            return 1
+        vm_.charge(cost)
+        if group_op == CURVE_OP_MUL:
+            # left = 32-byte scalar (LE, reduced mod L), right = point
+            s = int.from_bytes(vm_.mem_read_bytes(left_addr, 32), "little")
+            if s >= ed.L:
+                return 1
+            p = _curve_decode(curve_id, vm_.mem_read_bytes(right_addr, 32))
+            if p is None:
+                return 1
+            out = ed.point_mul(s, p)
+        else:
+            p = _curve_decode(curve_id, vm_.mem_read_bytes(left_addr, 32))
+            q = _curve_decode(curve_id, vm_.mem_read_bytes(right_addr, 32))
+            if p is None or q is None:
+                return 1
+            if group_op == CURVE_OP_SUB:
+                q = ed.point_neg(q)
+            out = ed.point_add(p, q)
+        _write_bytes(vm_, result_addr, _curve_encode(curve_id, out))
+        return 0
+
+    def sol_curve_multiscalar_mul(vm_, curve_id, scalars_addr, points_addr,
+                                  points_len, result_addr):
+        from firedancer_tpu.ops.ref import ed25519_ref as ed
+
+        if curve_id not in (CURVE25519_EDWARDS, CURVE25519_RISTRETTO):
+            return 1
+        if not 1 <= points_len <= CURVE_MSM_MAX_POINTS:
+            return 1
+        vm_.charge(CURVE_MSM_BASE[curve_id]
+                   + CURVE_MSM_INCR[curve_id] * (points_len - 1))
+        acc = ed.IDENT
+        for i in range(points_len):
+            s = int.from_bytes(
+                vm_.mem_read_bytes(scalars_addr + 32 * i, 32), "little")
+            if s >= ed.L:
+                return 1
+            p = _curve_decode(
+                curve_id, vm_.mem_read_bytes(points_addr + 32 * i, 32))
+            if p is None:
+                return 1
+            acc = ed.point_add(acc, ed.point_mul(s, p))
+        _write_bytes(vm_, result_addr, _curve_encode(curve_id, acc))
+        return 0
+
+    # -- introspection (fd_vm_syscall.c) --------------------------------------
+
+    def sol_get_stack_height(vm_, *_):
+        vm_.charge(SYSCALL_BASE_COST)
+        return vm_.stack_height
+
+    def sol_remaining_compute_units(vm_, *_):
+        vm_.charge(SYSCALL_BASE_COST)
+        return max(0, vm_.budget - vm_.cu_used)
+
+    def sol_get_processed_sibling_instruction(
+        vm_, index, meta_addr, program_id_addr, data_addr, accounts_addr
+    ):
+        vm_.charge(SYSCALL_BASE_COST)
+        # siblings: walk the trace BACKWARDS collecting entries at THIS
+        # instruction's stack height, STOPPING at the first entry below
+        # it — a shallower entry is a different parent's boundary, and
+        # its children must stay invisible (the reference breaks there
+        # too, fd_vm_syscall_runtime.c sibling walk)
+        sibs = []
+        for e in reversed(vm_.instr_trace):
+            if e[0] < vm_.stack_height:
+                break
+            if e[0] == vm_.stack_height:
+                sibs.append(e)
+        if index >= len(sibs):
+            return 0  # not found
+        _h, pid, metas, data = sibs[index]
+        # meta in/out: u64 data_len | u64 accounts_len; the payload is
+        # copied ONLY when the caller's lengths EXACTLY match (Agave's
+        # equality gate) — otherwise just the true lengths write back
+        # so the caller can re-issue with right-sized buffers
+        cap_data = vm_.mem_read(meta_addr, 8)
+        cap_accts = vm_.mem_read(meta_addr + 8, 8)
+        if cap_data == len(data) and cap_accts == len(metas):
+            vm_._write_span(program_id_addr, pid)
+            if data:
+                vm_._write_span(data_addr, data)
+            for i, (pk, signer, writable) in enumerate(metas):
+                off = accounts_addr + 34 * i
+                vm_._write_span(off, pk)
+                vm_.mem_write(off + 32, 1, 1 if signer else 0)
+                vm_.mem_write(off + 33, 1, 1 if writable else 0)
+        vm_.mem_write(meta_addr, 8, len(data))
+        vm_.mem_write(meta_addr + 8, 8, len(metas))
+        return 1
+
     vm.syscalls[SYSCALL_SOL_GET_CLOCK] = _sysvar_getter("clock")
     vm.syscalls[SYSCALL_SOL_GET_RENT] = _sysvar_getter("rent")
     vm.syscalls[SYSCALL_SOL_GET_EPOCH_SCHEDULE] = _sysvar_getter(
         "epoch_schedule"
+    )
+    vm.syscalls[SYSCALL_SOL_GET_FEES] = _sysvar_getter("fees")
+    vm.syscalls[SYSCALL_SOL_GET_EPOCH_REWARDS] = _sysvar_getter(
+        "epoch_rewards"
+    )
+    vm.syscalls[SYSCALL_SOL_GET_LAST_RESTART_SLOT] = _sysvar_getter(
+        "last_restart_slot"
     )
     vm.syscalls[SYSCALL_SOL_SET_RETURN_DATA] = sol_set_return_data
     vm.syscalls[SYSCALL_SOL_GET_RETURN_DATA] = sol_get_return_data
@@ -598,3 +852,15 @@ def register_default_syscalls(vm: Vm, *, log_sink: list | None = None) -> None:
     vm.syscalls[SYSCALL_SOL_SECP256K1_RECOVER] = sol_secp256k1_recover
     vm.syscalls[SYSCALL_SOL_CREATE_PROGRAM_ADDRESS] = sol_create_program_address
     vm.syscalls[SYSCALL_SOL_TRY_FIND_PROGRAM_ADDRESS] = sol_try_find_program_address
+    vm.syscalls[SYSCALL_SOL_BLAKE3] = sol_blake3
+    vm.syscalls[SYSCALL_SOL_POSEIDON] = sol_poseidon
+    vm.syscalls[SYSCALL_SOL_BIG_MOD_EXP] = sol_big_mod_exp
+    vm.syscalls[SYSCALL_SOL_ALT_BN128_COMPRESSION] = sol_alt_bn128_compression
+    vm.syscalls[SYSCALL_SOL_CURVE_VALIDATE_POINT] = sol_curve_validate_point
+    vm.syscalls[SYSCALL_SOL_CURVE_GROUP_OP] = sol_curve_group_op
+    vm.syscalls[SYSCALL_SOL_CURVE_MULTISCALAR_MUL] = sol_curve_multiscalar_mul
+    vm.syscalls[SYSCALL_SOL_GET_STACK_HEIGHT] = sol_get_stack_height
+    vm.syscalls[SYSCALL_SOL_REMAINING_CU] = sol_remaining_compute_units
+    vm.syscalls[SYSCALL_SOL_GET_SIBLING_INSTR] = (
+        sol_get_processed_sibling_instruction
+    )

@@ -380,3 +380,166 @@ def alt_bn128_pairing(data: bytes) -> bytes:
         pairs.append((g1, g2))
     ok = pairing_check(pairs)
     return (1 if ok else 0).to_bytes(32, "big")
+
+
+# -- point compression (sol_alt_bn128_compression) ----------------------------
+# arkworks-style flag bits riding the top byte of the BIG-ENDIAN x (or y
+# for the uncompressed infinity flag): bit7 = negative-y, bit6 = infinity
+# (capability target: the reference's fd_bn254_g{1,2}_{,de}compress,
+# src/ballet/bn254/fd_bn254.c — no code shared).
+
+FLAG_INF = 0x40
+FLAG_NEG = 0x80
+FLAG_MASK = 0x3F
+
+_P_HALF = (P - 1) // 2
+
+
+def _fp_is_neg(x: int) -> bool:
+    return x > _P_HALF
+
+
+def _fe_flags(b32: bytes) -> tuple[int, bool, bool]:
+    """-> (value with flags masked, is_inf, is_neg); value must be < p."""
+    is_inf = bool(b32[0] & FLAG_INF)
+    is_neg = bool(b32[0] & FLAG_NEG)
+    v = int.from_bytes(bytes([b32[0] & FLAG_MASK]) + b32[1:], "big")
+    if v >= P:
+        raise Bn254Error("field element out of range")
+    if is_inf and is_neg:
+        raise Bn254Error("invalid flag combination")
+    return v, is_inf, is_neg
+
+
+def _fp_sqrt(a: int) -> int | None:
+    r = pow(a, (P + 1) // 4, P)  # p = 3 mod 4
+    return r if r * r % P == a % P else None
+
+
+def g1_compress(data: bytes) -> bytes:
+    if len(data) != 64:
+        raise Bn254Error("G1 uncompressed must be 64 bytes")
+    if data == bytes(64):
+        return bytes(32)
+    x = int.from_bytes(data[:32], "big")
+    if x >= P:
+        raise Bn254Error("x out of range")
+    y, is_inf, _neg = _fe_flags(data[32:])
+    if is_inf:
+        return bytes([FLAG_INF]) + bytes(31)
+    out = bytearray(data[:32])
+    if _fp_is_neg(y):
+        out[0] |= FLAG_NEG
+    return bytes(out)
+
+
+def g1_decompress(data: bytes) -> bytes:
+    if len(data) != 32:
+        raise Bn254Error("G1 compressed must be 32 bytes")
+    if data == bytes(32):
+        return bytes(64)
+    x, is_inf, is_neg = _fe_flags(data)
+    if is_inf:
+        return bytes(64)
+    y = _fp_sqrt((x * x % P * x + B1) % P)
+    if y is None:
+        raise Bn254Error("not on curve")
+    if _fp_is_neg(y) != is_neg:
+        y = (P - y) % P
+    return bytes([data[0] & FLAG_MASK]) + data[1:] + y.to_bytes(32, "big")
+
+
+# Fp2 helpers for G2 compression: elements (imag, real) to match the
+# wire component order; negativity follows the reference (sign of the
+# IMAGINARY part).
+
+
+def _fp2_mul(a, b):
+    ai, ar = a
+    bi, br = b
+    return ((ar * bi + ai * br) % P, (ar * br - ai * bi) % P)
+
+
+def _fp2_sqr(a):
+    return _fp2_mul(a, a)
+
+
+def _fp2_pow(a, e: int):
+    r = (0, 1)
+    while e:
+        if e & 1:
+            r = _fp2_mul(r, a)
+        a = _fp2_sqr(a)
+        e >>= 1
+    return r
+
+
+def _fp2_sqrt(a):
+    """Alg. 9 of eprint 2012/685 for p = 3 mod 4 (either root)."""
+    if a == (0, 0):
+        return (0, 0)
+    a1 = _fp2_pow(a, (P - 3) // 4)
+    alpha = _fp2_mul(_fp2_sqr(a1), a)
+    a0 = _fp2_mul(((-alpha[0]) % P, alpha[1]), alpha)  # conj(alpha)*alpha
+    if a0 == (0, (P - 1) % P):
+        return None
+    x0 = _fp2_mul(a1, a)
+    if alpha == (0, (P - 1) % P):
+        return _fp2_mul((1, 0), x0)  # i * x0
+    b = _fp2_pow(((alpha[0]) % P, (alpha[1] + 1) % P), (P - 1) // 2)
+    return _fp2_mul(b, x0)
+
+
+def _fp2_inv(a):
+    """1/(re + im*u) = (re - im*u) / (re^2 + im^2) — NOT Fermat with
+    p-2 (the Fp2 multiplicative group has order p^2 - 1)."""
+    ai, ar = a
+    norm_inv = pow((ar * ar + ai * ai) % P, P - 2, P)
+    return ((P - ai) * norm_inv % P, ar * norm_inv % P)
+
+
+B2 = _fp2_mul((0, 3), _fp2_inv((1, 9)))  # b' = 3/(9+u), D-twist
+
+
+def g2_compress(data: bytes) -> bytes:
+    if len(data) != 128:
+        raise Bn254Error("G2 uncompressed must be 128 bytes")
+    if data == bytes(128):
+        return bytes(64)
+    xi = int.from_bytes(data[:32], "big")
+    xr = int.from_bytes(data[32:64], "big")
+    if xi >= P or xr >= P:
+        raise Bn254Error("x out of range")
+    yi, is_inf, _neg = _fe_flags(data[64:96])
+    yr = int.from_bytes(data[96:], "big")
+    if yr >= P:
+        raise Bn254Error("y out of range")
+    if is_inf:
+        return bytes([FLAG_INF]) + bytes(63)
+    out = bytearray(data[:64])
+    if _fp_is_neg(yi):
+        out[0] |= FLAG_NEG
+    return bytes(out)
+
+
+def g2_decompress(data: bytes) -> bytes:
+    if len(data) != 64:
+        raise Bn254Error("G2 compressed must be 64 bytes")
+    if data == bytes(64):
+        return bytes(128)
+    xi, is_inf, is_neg = _fe_flags(data[:32])
+    xr = int.from_bytes(data[32:], "big")
+    if xr >= P:
+        raise Bn254Error("x out of range")
+    if is_inf:
+        return bytes(128)
+    x = (xi, xr)
+    y = _fp2_sqrt(tuple(
+        (u + v) % P for u, v in zip(_fp2_mul(_fp2_sqr(x), x), B2)
+    ))
+    if y is None:
+        raise Bn254Error("not on curve")
+    if _fp_is_neg(y[0]) != is_neg:
+        y = ((P - y[0]) % P, (P - y[1]) % P)
+    return (bytes([data[0] & FLAG_MASK]) + data[1:]
+            + y[0].to_bytes(32, "big") + y[1].to_bytes(32, "big"))

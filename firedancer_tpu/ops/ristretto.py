@@ -1,0 +1,147 @@
+"""ristretto255 (RFC 9496) over the ed25519 reference arithmetic.
+
+Capability parity target: the reference's ristretto layer
+(/root/reference/src/ballet/ed25519/fd_ristretto255.h and
+fd_curve25519's ristretto entry points) serving the VM's curve25519
+syscalls (fd_vm_syscall_curve.c, CURVE25519_RISTRETTO) and the
+zk-elgamal proof program's group.  No code shared: encode/decode and
+SQRT_RATIO_M1 are implemented from RFC 9496's pseudocode over the
+big-int field ops in ops/ref/ed25519_ref.py.
+
+Points are the same extended-coordinate tuples ed25519_ref uses, so
+add/sub/mul/multiscalar are the edwards ops; only the WIRE format
+(canonical 32-byte ristretto encodings, cosets collapsed) differs.
+"""
+
+from __future__ import annotations
+
+from firedancer_tpu.ops.ref.ed25519_ref import (
+    BASE,
+    D,
+    IDENT,
+    L,
+    P,
+    SQRT_M1,
+    point_add,
+    point_eq,
+    point_mul,
+    point_neg,
+)
+
+# sqrt(a*d - 1) and 1/sqrt(a - d) with a = -1 (RFC 9496 constants,
+# derived rather than pasted so they can't drift from the field code)
+
+
+def _is_neg(x: int) -> bool:
+    return (x % P) & 1 == 1
+
+
+def _abs(x: int) -> int:
+    x %= P
+    return P - x if _is_neg(x) else x
+
+
+def sqrt_ratio_m1(u: int, v: int) -> tuple[bool, int]:
+    """(was_square, sqrt(u/v)) — RFC 9496 §4.2."""
+    v3 = v * v % P * v % P
+    v7 = v3 * v3 % P * v % P
+    r = u * v3 % P * pow(u * v7 % P, (P - 5) // 8, P) % P
+    check = v * r % P * r % P
+    u = u % P
+    correct = check == u
+    flipped = check == (P - u) % P
+    flipped_i = check == (P - u) * SQRT_M1 % P
+    if flipped or flipped_i:
+        r = r * SQRT_M1 % P
+    return correct or flipped, _abs(r)
+
+
+_, INVSQRT_A_MINUS_D = sqrt_ratio_m1(1, (-1 - D) % P)
+
+
+class RistrettoError(ValueError):
+    pass
+
+
+def decode(data: bytes):
+    """32-byte canonical encoding -> extended point (RFC 9496 §4.3.1)."""
+    if len(data) != 32:
+        raise RistrettoError("ristretto encoding must be 32 bytes")
+    s = int.from_bytes(data, "little")
+    if s >= P or _is_neg(s):
+        raise RistrettoError("non-canonical ristretto encoding")
+    ss = s * s % P
+    u1 = (1 - ss) % P
+    u2 = (1 + ss) % P
+    u2_sqr = u2 * u2 % P
+    v = (-(D * u1 % P * u1) - u2_sqr) % P
+    was_square, invsqrt = sqrt_ratio_m1(1, v * u2_sqr % P)
+    den_x = invsqrt * u2 % P
+    den_y = invsqrt * den_x % P * v % P
+    x = _abs(2 * s % P * den_x)
+    y = u1 * den_y % P
+    t = x * y % P
+    if not was_square or _is_neg(t) or y == 0:
+        raise RistrettoError("invalid ristretto encoding")
+    return (x, y, 1, t)
+
+
+def encode(p) -> bytes:
+    """Extended point -> canonical 32-byte encoding (RFC 9496 §4.3.2)."""
+    x0, y0, z0, t0 = p
+    u1 = (z0 + y0) * (z0 - y0) % P
+    u2 = x0 * y0 % P
+    _, invsqrt = sqrt_ratio_m1(1, u1 * u2 % P * u2 % P)
+    den1 = invsqrt * u1 % P
+    den2 = invsqrt * u2 % P
+    z_inv = den1 * den2 % P * t0 % P
+    if _is_neg(t0 * z_inv % P):
+        x = y0 * SQRT_M1 % P
+        y = x0 * SQRT_M1 % P
+        den_inv = den1 * INVSQRT_A_MINUS_D % P
+    else:
+        x, y = x0, y0
+        den_inv = den2
+    if _is_neg(x * z_inv % P):
+        y = (P - y) % P
+    s = _abs(den_inv * ((z0 - y) % P) % P)
+    return s.to_bytes(32, "little")
+
+
+def validate(data: bytes) -> bool:
+    try:
+        decode(data)
+        return True
+    except RistrettoError:
+        return False
+
+
+def eq(p, q) -> bool:
+    """Ristretto equality: x1 y2 == y1 x2 or y1 y2 == x1 x2 (RFC 9496
+    §4.5 — collapses the 4-torsion cosets)."""
+    x1, y1, _, _ = p
+    x2, y2, _, _ = q
+    return (x1 * y2 - y1 * x2) % P == 0 or (y1 * y2 - x1 * x2) % P == 0
+
+
+def add(p, q):
+    return point_add(p, q)
+
+
+def sub(p, q):
+    return point_add(p, point_neg(q))
+
+
+def mul(s: int, p):
+    return point_mul(s % L, p)
+
+
+def multiscalar_mul(scalars: list[int], points: list):
+    acc = IDENT
+    for s, p in zip(scalars, points):
+        acc = point_add(acc, point_mul(s % L, p))
+    return acc
+
+
+BASE_POINT = BASE  # the ristretto basepoint is the ed25519 basepoint
+BASE_BYTES = encode(BASE)

@@ -1,0 +1,71 @@
+"""Extract the PUBLIC Poseidon-BN254 parameters into a compact data blob.
+
+The constants are the light-poseidon v0.2.0 / circomlib v2.0.5 public
+parameters (Apache/MIT spec data — the same class as AES S-boxes or
+Wycheproof vectors, not code).  The reference embeds them as Montgomery
+-form limb tables (src/ballet/bn254/fd_poseidon_params.c); this script
+parses that table AS DATA, converts out of Montgomery form to canonical
+integers, and writes `firedancer_tpu/ops/data/poseidon_bn254.bin.gz`:
+
+    header:  u8 count = 12 (widths 2..13)
+    per width: u8 width | u32 n_ark | u32 n_mds
+    then all values: 32-byte little-endian scalars, ark tables first
+    (width order), then mds tables (width order); zlib-compressed.
+
+Usage: python scripts/gen_poseidon_params.py
+"""
+
+import re
+import struct
+import sys
+import zlib
+
+P = 21888242871839275222246405745257275088548364400416034343698204186575808495617
+R_INV = pow(1 << 256, P - 2, P)
+
+SRC = "/root/reference/src/ballet/bn254/fd_poseidon_params.c"
+OUT = "firedancer_tpu/ops/data/poseidon_bn254.bin.gz"
+
+
+def parse_tables(text):
+    tables = {}
+    for m in re.finditer(
+        r"fd_poseidon_(ark|mds)_(\d+)\[\]\s*=\s*\{(.*?)\n\};", text, re.S
+    ):
+        kind, w, body = m.group(1), int(m.group(2)), m.group(3)
+        vals = []
+        for limbs in re.finditer(
+            r"\{\{\s*0x([0-9a-fA-F]+),\s*0x([0-9a-fA-F]+),\s*"
+            r"0x([0-9a-fA-F]+),\s*0x([0-9a-fA-F]+),\s*\}\}", body
+        ):
+            l0, l1, l2, l3 = (int(x, 16) for x in limbs.groups())
+            mont = l0 | (l1 << 64) | (l2 << 128) | (l3 << 192)
+            vals.append((mont * R_INV) % P)
+        tables[(kind, w)] = vals
+    return tables
+
+
+def main():
+    text = open(SRC, encoding="latin1").read()
+    tables = parse_tables(text)
+    widths = sorted({w for _k, w in tables})
+    assert widths == list(range(2, 14)), widths
+    hdr = struct.pack("<B", len(widths))
+    body = b""
+    for w in widths:
+        ark, mds = tables[("ark", w)], tables[("mds", w)]
+        assert len(mds) == w * w, (w, len(mds))
+        hdr += struct.pack("<BII", w, len(ark), len(mds))
+        for v in ark + mds:
+            body += v.to_bytes(32, "little")
+    import os
+
+    os.makedirs("firedancer_tpu/ops/data", exist_ok=True)
+    with open(OUT, "wb") as f:
+        f.write(zlib.compress(hdr + body, 9))
+    print(f"{OUT}: {len(widths)} widths, "
+          f"{sum(len(v) for v in tables.values())} scalars")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -328,3 +328,35 @@ def test_duplicate_account_addresses_rejected():
 
     assert res.results[0].status == TXN_ERR_ACCT
     assert acct_lamports(funk.rec_query(res.xid, pub)) == 1_000_000
+
+
+def test_replay_block_threads_slot_hashes_for_votes():
+    """The non-leader replay path must hand the replayer's SlotHashes
+    view to the vote program — an empty sysvar would reject every vote
+    in the block (regression: review r5)."""
+    from firedancer_tpu.flamenco import agave_state as ast
+    from firedancer_tpu.flamenco import vote_program as vp
+    from firedancer_tpu.flamenco.runtime import replay_block
+    from firedancer_tpu.runtime.poh import poh_mixin
+
+    funk = Funk()
+    secret, voter = keypair(b"replay-voter")
+    vote_acct = hashlib.sha256(b"replay-va").digest()
+    fund(funk, voter, 1_000_000)
+    init = ast.VoteState(node_pubkey=voter, authorized_withdrawer=voter,
+                         authorized_voters={0: voter})
+    funk.rec_insert(None, vote_acct, acct_build(
+        0,
+        data=ast.vote_state_encode(init).ljust(vp.VOTE_STATE_SIZE, b"\x00"),
+        owner=ft.VOTE_PROGRAM,
+    ))
+    bh50 = hashlib.sha256(b"replay-bank-50").digest()
+    vt = ft.vote_txn(secret, vote_acct, 50, b"rb" * 16, bank_hash=bh50)
+    seed = b"\x00" * 32
+    sig = ft.txn_parse(vt).signatures(vt)[0]
+    entry_hash = poh_mixin(seed, hashlib.sha256(sig).digest())
+    entries = [(1, entry_hash, [vt])]
+    res = replay_block(funk, slot=51, entries=entries, poh_seed=seed,
+                       slot_hashes=[(50, bh50)])
+    assert res is not None
+    assert res.results[0].status == TXN_SUCCESS

@@ -1,0 +1,264 @@
+"""The round-5 syscall completions: blake3/poseidon/big_mod_exp,
+bn254 compression, curve25519 group ops (edwards + ristretto),
+introspection (stack height, remaining CUs, sibling instructions), and
+the fees/epoch-rewards/last-restart-slot sysvar getters — the
+fd_vm_syscall_{hash,crypto,curve}.c / fd_vm_syscall.c surface."""
+
+import hashlib
+
+from firedancer_tpu.flamenco import vm as fvm
+from firedancer_tpu.protocol import sbpf
+from tests.test_sbpf import build_elf, ins
+
+EXIT = ins(0x95)
+INP = fvm.MM_INPUT
+
+
+def mkvm(input_data=b"\x00" * 4096, budget=2_000_000):
+    prog = sbpf.load(build_elf(EXIT))
+    m = fvm.Vm(prog, input_data=input_data, budget=budget)
+    fvm.register_default_syscalls(m)
+    return m
+
+
+def call(vm, sid, *args):
+    a = list(args) + [0] * (5 - len(args))
+    return vm.syscalls[sid](vm, *a)
+
+
+def put(vm, off, data):
+    vm._write_span(INP + off, data)
+    return INP + off
+
+
+def get(vm, off, n):
+    return vm.mem_read_bytes(INP + off, n)
+
+
+def test_sol_blake3():
+    from firedancer_tpu.ops.blake3 import blake3_host
+
+    vm = mkvm()
+    msg = b"blake3 syscall"
+    data_addr = put(vm, 0, msg)
+    # one (addr, len) slice descriptor at offset 100
+    put(vm, 100, data_addr.to_bytes(8, "little")
+        + len(msg).to_bytes(8, "little"))
+    assert call(vm, fvm.SYSCALL_SOL_BLAKE3, INP + 100, 1, INP + 200) == 0
+    assert get(vm, 200, 32) == blake3_host(msg)
+
+
+def test_sol_poseidon_kat():
+    vm = mkvm()
+    data_addr = put(vm, 0, bytes([1]) * 32)
+    put(vm, 100, data_addr.to_bytes(8, "little") + (32).to_bytes(8, "little"))
+    # endianness selector 1 = little endian (the KAT's byte order)
+    assert call(vm, fvm.SYSCALL_SOL_POSEIDON, 0, 1, INP + 100, 1,
+                INP + 200) == 0
+    gold = bytes([230, 117, 27, 127, 210, 224, 145, 185, 157, 99, 172, 7,
+                  132, 30, 241, 130, 136, 166, 99, 99, 197, 198, 25, 204,
+                  119, 97, 238, 129, 229, 172, 191, 5])
+    assert get(vm, 200, 32) == gold
+    # unknown parameter set rejected
+    assert call(vm, fvm.SYSCALL_SOL_POSEIDON, 9, 1, INP + 100, 1,
+                INP + 200) == 1
+
+
+def test_sol_big_mod_exp():
+    vm = mkvm()
+    base = put(vm, 0, (7).to_bytes(8, "big"))
+    exp = put(vm, 16, (5).to_bytes(8, "big"))
+    mod = put(vm, 32, (13).to_bytes(8, "big"))
+    params = put(vm, 64, b"".join(
+        v.to_bytes(8, "little")
+        for v in (base, 8, exp, 8, mod, 8)
+    ))
+    assert call(vm, fvm.SYSCALL_SOL_BIG_MOD_EXP, params, INP + 300) == 0
+    assert int.from_bytes(get(vm, 300, 8), "big") == pow(7, 5, 13)
+    # zero modulus rejected
+    put(vm, 32, bytes(8))
+    assert call(vm, fvm.SYSCALL_SOL_BIG_MOD_EXP, params, INP + 300) == 1
+
+
+def test_sol_alt_bn128_compression_roundtrip():
+    from firedancer_tpu.ops import bn254 as bn
+
+    vm = mkvm()
+    enc = bn.g1_encode(bn.g1_mul(bn.G1_GEN, 9))
+    put(vm, 0, enc)
+    assert call(vm, fvm.SYSCALL_SOL_ALT_BN128_COMPRESSION, 0, INP, 64,
+                INP + 100) == 0
+    comp = get(vm, 100, 32)
+    assert comp == bn.g1_compress(enc)
+    assert call(vm, fvm.SYSCALL_SOL_ALT_BN128_COMPRESSION, 1, INP + 100,
+                32, INP + 200) == 0
+    assert get(vm, 200, 64) == enc
+
+
+def test_curve_validate_point():
+    from firedancer_tpu.ops import ristretto as ri
+    from firedancer_tpu.ops.ref import ed25519_ref as ed
+
+    vm = mkvm()
+    put(vm, 0, ed.point_compress(ed.BASE))
+    assert call(vm, fvm.SYSCALL_SOL_CURVE_VALIDATE_POINT,
+                fvm.CURVE25519_EDWARDS, INP) == 0
+    put(vm, 0, ri.BASE_BYTES)
+    assert call(vm, fvm.SYSCALL_SOL_CURVE_VALIDATE_POINT,
+                fvm.CURVE25519_RISTRETTO, INP) == 0
+    # a negative-s ristretto encoding is invalid
+    put(vm, 0, (2**255 - 20).to_bytes(32, "little"))
+    assert call(vm, fvm.SYSCALL_SOL_CURVE_VALIDATE_POINT,
+                fvm.CURVE25519_RISTRETTO, INP) == 1
+
+
+def test_curve_group_ops_ristretto():
+    """B + B == 2*B through the syscalls, matching RFC 9496's table."""
+    from firedancer_tpu.ops import ristretto as ri
+
+    two_b = bytes.fromhex(
+        "6a493210f7499cd17fecb510ae0cea23a110e8d5b901f8acadd3095c73a3b919"
+    )
+    vm = mkvm()
+    put(vm, 0, ri.BASE_BYTES)
+    put(vm, 32, ri.BASE_BYTES)
+    assert call(vm, fvm.SYSCALL_SOL_CURVE_GROUP_OP,
+                fvm.CURVE25519_RISTRETTO, fvm.CURVE_OP_ADD,
+                INP, INP + 32, INP + 100) == 0
+    assert get(vm, 100, 32) == two_b
+    # 2*B via scalar mul
+    put(vm, 200, (2).to_bytes(32, "little"))
+    assert call(vm, fvm.SYSCALL_SOL_CURVE_GROUP_OP,
+                fvm.CURVE25519_RISTRETTO, fvm.CURVE_OP_MUL,
+                INP + 200, INP, INP + 300) == 0
+    assert get(vm, 300, 32) == two_b
+    # 2B - B == B
+    put(vm, 400, two_b)
+    assert call(vm, fvm.SYSCALL_SOL_CURVE_GROUP_OP,
+                fvm.CURVE25519_RISTRETTO, fvm.CURVE_OP_SUB,
+                INP + 400, INP, INP + 500) == 0
+    assert get(vm, 500, 32) == ri.BASE_BYTES
+
+
+def test_curve_multiscalar_mul():
+    """1*B + 2*B == 3*B (RFC 9496 multiple)."""
+    from firedancer_tpu.ops import ristretto as ri
+
+    three_b = bytes.fromhex(
+        "94741f5d5d52755ece4f23f044ee27d5d1ea1e2bd196b462166b16152a9d0259"
+    )
+    vm = mkvm()
+    put(vm, 0, (1).to_bytes(32, "little") + (2).to_bytes(32, "little"))
+    put(vm, 100, ri.BASE_BYTES + ri.BASE_BYTES)
+    assert call(vm, fvm.SYSCALL_SOL_CURVE_MULTISCALAR_MUL,
+                fvm.CURVE25519_RISTRETTO, INP, INP + 100, 2,
+                INP + 200) == 0
+    assert get(vm, 200, 32) == three_b
+    # non-canonical scalar (>= L) rejected
+    from firedancer_tpu.ops.ref.ed25519_ref import L
+
+    put(vm, 0, L.to_bytes(32, "little") + (2).to_bytes(32, "little"))
+    assert call(vm, fvm.SYSCALL_SOL_CURVE_MULTISCALAR_MUL,
+                fvm.CURVE25519_RISTRETTO, INP, INP + 100, 2,
+                INP + 200) == 1
+
+
+def test_introspection_syscalls():
+    vm = mkvm()
+    vm.stack_height = 3
+    assert call(vm, fvm.SYSCALL_SOL_GET_STACK_HEIGHT) == 3
+    used = vm.cu_used
+    rem = call(vm, fvm.SYSCALL_SOL_REMAINING_CU)
+    assert rem == vm.budget - used - fvm.SYSCALL_BASE_COST
+
+
+def test_sibling_instruction():
+    vm = mkvm()
+    vm.stack_height = 1
+    pid = b"P" * 32
+    vm.instr_trace = [
+        (1, pid, [(b"A" * 32, True, False)], b"\x01\x02"),
+        (2, b"X" * 32, [], b"inner"),  # deeper: not a sibling
+        (1, b"Q" * 32, [(b"B" * 32, False, True)], b"\x09"),
+    ]
+    # index 0 = most recent sibling at height 1 -> the Q instruction;
+    # copy happens only with EXACT lengths (data 1, accounts 1)
+    put(vm, 0, (1).to_bytes(8, "little") + (1).to_bytes(8, "little"))
+    assert call(vm, fvm.SYSCALL_SOL_GET_SIBLING_INSTR, 0, INP, INP + 100,
+                INP + 200, INP + 300) == 1
+    assert get(vm, 100, 32) == b"Q" * 32
+    assert get(vm, 200, 1) == b"\x09"
+    acct = get(vm, 300, 34)
+    assert acct[:32] == b"B" * 32 and acct[32] == 0 and acct[33] == 1
+    # index 1 -> the P instruction; oversized lengths write back the
+    # true sizes WITHOUT copying the payload (Agave's equality gate)
+    put(vm, 0, (16).to_bytes(8, "little") + (8).to_bytes(8, "little"))
+    put(vm, 100, bytes(32))
+    assert call(vm, fvm.SYSCALL_SOL_GET_SIBLING_INSTR, 1, INP, INP + 100,
+                INP + 200, INP + 300) == 1
+    assert get(vm, 100, 32) == bytes(32)  # untouched
+    assert int.from_bytes(get(vm, 0, 8), "little") == 2  # true data len
+    # exact lengths now copy
+    put(vm, 0, (2).to_bytes(8, "little") + (1).to_bytes(8, "little"))
+    assert call(vm, fvm.SYSCALL_SOL_GET_SIBLING_INSTR, 1, INP, INP + 100,
+                INP + 200, INP + 300) == 1
+    assert get(vm, 100, 32) == b"P" * 32
+    # index 2: no more siblings
+    assert call(vm, fvm.SYSCALL_SOL_GET_SIBLING_INSTR, 2, INP, INP + 100,
+                INP + 200, INP + 300) == 0
+
+
+def test_sibling_search_stops_at_parent_boundary():
+    """A deeper instruction must not see another parent's children: the
+    backward walk breaks at the first entry shallower than the caller."""
+    vm = mkvm()
+    vm.stack_height = 2
+    vm.instr_trace = [
+        (1, b"A" * 32, [], b""),
+        (2, b"X" * 32, [], b"childA"),   # A's child
+        (1, b"B" * 32, [], b""),         # boundary: B's top-level entry
+    ]
+    # caller is B's child at height 2: X (A's child) must be INVISIBLE
+    put(vm, 0, bytes(16))
+    assert call(vm, fvm.SYSCALL_SOL_GET_SIBLING_INSTR, 0, INP, INP + 100,
+                INP + 200, INP + 300) == 0
+
+
+def test_new_sysvar_getters():
+    from firedancer_tpu.flamenco.runtime import default_sysvars
+
+    vm = mkvm()
+    vm.sysvars = default_sysvars(7)
+    assert call(vm, fvm.SYSCALL_SOL_GET_FEES, INP) == 0
+    assert int.from_bytes(get(vm, 0, 8), "little") == 5000
+    assert call(vm, fvm.SYSCALL_SOL_GET_LAST_RESTART_SLOT, INP + 50) == 0
+    assert int.from_bytes(get(vm, 50, 8), "little") == 0
+    assert call(vm, fvm.SYSCALL_SOL_GET_EPOCH_REWARDS, INP + 100) == 0
+    assert get(vm, 100, 73)[-1] == 0  # active = false
+
+
+def test_executor_records_instr_trace():
+    """The executor's trace feeds sibling introspection: two top-level
+    instructions leave two height-1 entries."""
+    import hashlib as hl
+
+    from firedancer_tpu.flamenco.executor import (
+        Account, Executor, InstrAccount, TxnCtx,
+    )
+    from firedancer_tpu.protocol.txn import SYSTEM_PROGRAM
+
+    a = Account(key=hl.sha256(b"ta").digest(), lamports=1000,
+                owner=SYSTEM_PROGRAM, executable=False, data=bytearray())
+    b = Account(key=hl.sha256(b"tb").digest(), lamports=0,
+                owner=SYSTEM_PROGRAM, executable=False, data=bytearray())
+    ctx = TxnCtx(accounts=[a, b], signer=[True, False],
+                 writable=[True, True])
+    ex = Executor()
+    data = (2).to_bytes(4, "little") + (5).to_bytes(8, "little")
+    for _ in range(2):
+        ex.execute_instr(ctx, SYSTEM_PROGRAM,
+                         [InstrAccount(0, True, True),
+                          InstrAccount(1, False, True)], data)
+    assert len(ctx.instr_trace) == 2
+    assert all(h == 1 for h, *_ in ctx.instr_trace)
+    assert ctx.instr_trace[0][1] == SYSTEM_PROGRAM
