@@ -160,6 +160,7 @@ class Executor:
         from firedancer_tpu.flamenco import bpf_loader
 
         from firedancer_tpu.flamenco import config_program, precompiles
+        from firedancer_tpu.flamenco import zk_elgamal
 
         self.native = {
             SYSTEM_PROGRAM: programs.system_program,
@@ -172,6 +173,8 @@ class Executor:
             COMPUTE_BUDGET_PROGRAM: programs.compute_budget_program,
             bpf_loader.UPGRADEABLE_LOADER_PROGRAM:
                 bpf_loader.upgradeable_loader_program,
+            zk_elgamal.ZK_ELGAMAL_PROOF_PROGRAM:
+                zk_elgamal.zk_elgamal_program,
         }
 
     def register(self, program_id: bytes, fn) -> None:

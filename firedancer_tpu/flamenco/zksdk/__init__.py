@@ -1,0 +1,7 @@
+"""zk-sdk: the ZK ElGamal proof program's cryptographic core.
+
+Counterpart of /root/reference/src/flamenco/runtime/program/zksdk/
+(merlin transcript, twisted-ElGamal encryption, sigma proofs, bulletproof
+range proofs) — no code shared; each module cites the spec or protocol it
+implements from.
+"""

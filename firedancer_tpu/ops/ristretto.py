@@ -145,3 +145,43 @@ def multiscalar_mul(scalars: list[int], points: list):
 
 BASE_POINT = BASE  # the ristretto basepoint is the ed25519 basepoint
 BASE_BYTES = encode(BASE)
+
+
+# -- the one-way map (RFC 9496 §4.3.4) ---------------------------------------
+
+_ONE_MINUS_D_SQ = (1 - D * D) % P
+_D_MINUS_ONE_SQ = (D - 1) * (D - 1) % P
+# RFC 9496's constant is the ODD square root of a*d - 1 (the abs
+# convention would pick the even one and flip the map's output sign)
+_SQRT_AD_MINUS_ONE = (
+    25063068953384623474111414158702152701244531502492656460079210482610430750235
+)
+assert _SQRT_AD_MINUS_ONE * _SQRT_AD_MINUS_ONE % P == (-D - 1) % P
+
+
+def _map(t: int):
+    r = SQRT_M1 * t % P * t % P
+    u = (r + 1) % P * _ONE_MINUS_D_SQ % P
+    v = (-1 - r * D) % P * ((r + D) % P) % P
+    was_square, s = sqrt_ratio_m1(u, v)
+    if not was_square:
+        s = (P - _abs(s * t % P)) % P
+        c = r
+    else:
+        c = P - 1  # c = -1 when u/v was square
+    n = (c * ((r - 1) % P) % P * _D_MINUS_ONE_SQ - v) % P
+    w0 = 2 * s % P * v % P
+    w1 = n * _SQRT_AD_MINUS_ONE % P
+    w2 = (1 - s * s) % P
+    w3 = (1 + s * s) % P
+    return (w0 * w3 % P, w2 * w1 % P, w1 * w3 % P, w0 * w2 % P)
+
+
+def from_uniform_bytes(data: bytes):
+    """64 uniform bytes -> a ristretto point (hash-to-group): MAP each
+    half, add — RFC 9496's element derivation."""
+    if len(data) != 64:
+        raise RistrettoError("need 64 uniform bytes")
+    t0 = int.from_bytes(data[:32], "little") & ((1 << 255) - 1)
+    t1 = int.from_bytes(data[32:], "little") & ((1 << 255) - 1)
+    return point_add(_map(t0 % P), _map(t1 % P))

@@ -234,7 +234,8 @@ def test_new_sysvar_getters():
     assert call(vm, fvm.SYSCALL_SOL_GET_LAST_RESTART_SLOT, INP + 50) == 0
     assert int.from_bytes(get(vm, 50, 8), "little") == 0
     assert call(vm, fvm.SYSCALL_SOL_GET_EPOCH_REWARDS, INP + 100) == 0
-    assert get(vm, 100, 73)[-1] == 0  # active = false
+    # the 81-byte EpochRewards blob: active is the LAST byte (offset 80)
+    assert get(vm, 100, 81)[80] == 0  # active = false
 
 
 def test_executor_records_instr_trace():

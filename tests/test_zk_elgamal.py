@@ -1,0 +1,333 @@
+"""ZK ElGamal proof program: merlin/strobe, twisted ElGamal, every sigma
+proof (round-tripped against provers written from the protocol), the
+bulletproof range family, the reference's embedded REAL-transaction
+pubkey-validity fixture, and the program's context-state lifecycle."""
+
+import hashlib
+
+import pytest
+
+from firedancer_tpu.flamenco import zk_elgamal as zk
+from firedancer_tpu.flamenco.zksdk import elgamal as eg
+from firedancer_tpu.flamenco.zksdk import rangeproof as rp
+from firedancer_tpu.flamenco.zksdk import sigma
+from firedancer_tpu.flamenco.zksdk.merlin import Transcript
+from firedancer_tpu.ops import ristretto as ri
+from firedancer_tpu.ops.ref.ed25519_ref import L, point_add, point_mul
+
+
+def rnd(tag: bytes) -> int:
+    return int.from_bytes(hashlib.sha512(b"t:" + tag).digest(),
+                          "little") % L
+
+
+# -- merlin + elgamal primitives ----------------------------------------------
+
+
+def test_merlin_vector():
+    t = Transcript(b"test protocol")
+    t.append_message(b"some label", b"some data")
+    assert t.challenge_bytes(b"challenge", 32).hex() == (
+        "d5a21972d0d5fe320c0d263fac7fffb8145aa640af6e9bca177c03c7efcf0615"
+    )
+
+
+def test_elgamal_roundtrip():
+    s, pub = eg.keygen(b"alice")
+    ct = eg.encrypt(pub, 42, rnd(b"r1"))
+    assert eg.decrypt_to_point(s, ct) == point_mul(42, eg.G) or ri.eq(
+        eg.decrypt_to_point(s, ct), point_mul(42, eg.G))
+
+
+# -- sigma proofs -------------------------------------------------------------
+
+
+def test_pubkey_validity_reference_fixture():
+    """The REAL transaction embedded in the reference's test suite
+    (zksdk/instructions/test_fd_zksdk_pubkey_validity.h)."""
+    ctx = bytes.fromhex(
+        "fa89ae0c8312aba69e727036a794b5add351b020e43c65ea94cdda8d8f8c2037")
+    proof = bytes.fromhex(
+        "80395515497f92fa09ebdb5f14b7f6b32ab8abc3bf7349394b538fb3959c8c4b"
+        "0e5cdb1f8f9aeb2fd374b89beafaf2f47a0b83558a7ef94629b07101f50b0007")
+    sigma.verify_pubkey_validity(ctx, proof)
+    with pytest.raises(sigma.ZkError):
+        sigma.verify_pubkey_validity(
+            ctx, proof[:-1] + bytes([proof[-1] ^ 1]))
+
+
+def test_pubkey_validity_roundtrip():
+    s, pub = eg.keygen(b"pkv")
+    proof = sigma.prove_pubkey_validity(s, pub, b"n1")
+    sigma.verify_pubkey_validity(pub, proof)
+    _s2, pub2 = eg.keygen(b"other")
+    with pytest.raises(sigma.ZkError):
+        sigma.verify_pubkey_validity(pub2, proof)
+
+
+def test_zero_ciphertext_roundtrip():
+    s, pub = eg.keygen(b"zc")
+    ct0 = eg.encrypt(pub, 0, rnd(b"rz"))
+    proof = sigma.prove_zero_ciphertext(s, pub, ct0, b"n2")
+    sigma.verify_zero_ciphertext(pub + ct0, proof)
+    # a ciphertext of a NONZERO amount must not verify
+    ct1 = eg.encrypt(pub, 5, rnd(b"rz"))
+    with pytest.raises(sigma.ZkError):
+        sigma.verify_zero_ciphertext(pub + ct1, proof)
+
+
+def _prove_ciph_comm_eq(s, pub, x, r_ct, r_comm, seed):
+    """Prover for ciphertext-commitment equality (from the verification
+    equations: Y_0 = y_s P, Y_1 = y_x G + y_s D, Y_2 = y_x G + y_r H)."""
+    ct = eg.encrypt(pub, x, r_ct)
+    comm = eg.commit(x, r_comm)
+    p = ri.decode(pub)
+    d = ri.decode(ct[32:])
+    y_s, y_x, y_r = rnd(seed + b"s"), rnd(seed + b"x"), rnd(seed + b"r")
+    y0 = ri.encode(point_mul(y_s, p))
+    y1 = ri.encode(point_add(point_mul(y_x, eg.G), point_mul(y_s, d)))
+    y2 = ri.encode(point_add(point_mul(y_x, eg.G), point_mul(y_r, eg.H)))
+    t = Transcript(b"ciphertext-commitment-equality-instruction")
+    t.append_message(b"pubkey", pub)
+    t.append_message(b"ciphertext", ct)
+    t.append_message(b"commitment", comm)
+    t.append_message(b"dom-sep", b"ciphertext-commitment-equality-proof")
+    for lbl, y in ((b"Y_0", y0), (b"Y_1", y1), (b"Y_2", y2)):
+        sigma.validate_and_append_point(t, lbl, y)
+    c = sigma.challenge_scalar(t, b"c")
+    z_s = (c * s + y_s) % L
+    z_x = (c * x + y_x) % L
+    z_r = (c * r_comm + y_r) % L
+    proof = (y0 + y1 + y2 + z_s.to_bytes(32, "little")
+             + z_x.to_bytes(32, "little") + z_r.to_bytes(32, "little"))
+    return pub + ct + comm, proof
+
+
+def test_ciphertext_commitment_equality_roundtrip():
+    s, pub = eg.keygen(b"cce")
+    context, proof = _prove_ciph_comm_eq(
+        s, pub, 777, rnd(b"rc"), rnd(b"rm"), b"cce1")
+    sigma.verify_ciphertext_commitment_equality(context, proof)
+    # commitment to a different amount: reject
+    bad_ctx = context[:96] + eg.commit(778, rnd(b"rm"))
+    with pytest.raises(sigma.ZkError):
+        sigma.verify_ciphertext_commitment_equality(bad_ctx, proof)
+
+
+def _prove_ciph_ciph_eq(s1, pub1, pub2, x, r2, seed):
+    """Y_0 = y_s P1, Y_1 = y_x G + y_s D1, Y_2 = y_x G + y_r H,
+    Y_3 = y_r P2."""
+    ct1 = eg.encrypt(pub1, x, rnd(seed + b"r1"))
+    ct2 = eg.encrypt(pub2, x, r2)
+    p1, p2 = ri.decode(pub1), ri.decode(pub2)
+    d1 = ri.decode(ct1[32:])
+    y_s, y_x, y_r = rnd(seed + b"s"), rnd(seed + b"x"), rnd(seed + b"r")
+    y0 = ri.encode(point_mul(y_s, p1))
+    y1 = ri.encode(point_add(point_mul(y_x, eg.G), point_mul(y_s, d1)))
+    y2 = ri.encode(point_add(point_mul(y_x, eg.G), point_mul(y_r, eg.H)))
+    y3 = ri.encode(point_mul(y_r, p2))
+    t = Transcript(b"ciphertext-ciphertext-equality-instruction")
+    t.append_message(b"first-pubkey", pub1)
+    t.append_message(b"second-pubkey", pub2)
+    t.append_message(b"first-ciphertext", ct1)
+    t.append_message(b"second-ciphertext", ct2)
+    t.append_message(b"dom-sep", b"ciphertext-ciphertext-equality-proof")
+    for i, y in enumerate((y0, y1, y2, y3)):
+        sigma.validate_and_append_point(t, b"Y_%d" % i, y)
+    c = sigma.challenge_scalar(t, b"c")
+    z_s = (c * s1 + y_s) % L
+    z_x = (c * x + y_x) % L
+    z_r = (c * r2 + y_r) % L
+    proof = (y0 + y1 + y2 + y3 + z_s.to_bytes(32, "little")
+             + z_x.to_bytes(32, "little") + z_r.to_bytes(32, "little"))
+    return pub1 + pub2 + ct1 + ct2, proof
+
+
+def test_ciphertext_ciphertext_equality_roundtrip():
+    s1, pub1 = eg.keygen(b"cc1")
+    _s2, pub2 = eg.keygen(b"cc2")
+    context, proof = _prove_ciph_ciph_eq(s1, pub1, pub2, 123,
+                                         rnd(b"r2x"), b"cceq")
+    sigma.verify_ciphertext_ciphertext_equality(context, proof)
+    # swap in a second ciphertext of a DIFFERENT amount
+    bad = context[:128] + eg.encrypt(pub2, 124, rnd(b"r2x"))
+    with pytest.raises(sigma.ZkError):
+        sigma.verify_ciphertext_ciphertext_equality(bad, proof)
+
+
+def _prove_grouped_2h(pub1, pub2, x, r, seed):
+    """Y_0 = y_r H + y_x G, Y_i = y_r P_i."""
+    p1, p2 = ri.decode(pub1), ri.decode(pub2)
+    comm = eg.commit(x, r)
+    h1 = ri.encode(point_mul(r, p1))
+    h2 = ri.encode(point_mul(r, p2))
+    gc = comm + h1 + h2
+    y_r, y_x = rnd(seed + b"r"), rnd(seed + b"x")
+    y0 = ri.encode(point_add(point_mul(y_r, eg.H), point_mul(y_x, eg.G)))
+    y1 = ri.encode(point_mul(y_r, p1))
+    y2 = ri.encode(point_mul(y_r, p2))
+    t = Transcript(b"grouped-ciphertext-validity-2-handles-instruction")
+    t.append_message(b"first-pubkey", pub1)
+    t.append_message(b"second-pubkey", pub2)
+    t.append_message(b"grouped-ciphertext", gc)
+    t.append_message(b"dom-sep", b"validity-proof")
+    t.append_u64(b"handles", 2)
+    sigma.validate_and_append_point(t, b"Y_0", y0)
+    sigma.validate_and_append_point(t, b"Y_1", y1)
+    t.append_message(b"Y_2", y2)
+    c = sigma.challenge_scalar(t, b"c")
+    z_r = (c * r + y_r) % L
+    z_x = (c * x + y_x) % L
+    proof = (y0 + y1 + y2 + z_r.to_bytes(32, "little")
+             + z_x.to_bytes(32, "little"))
+    return pub1 + pub2 + gc, proof
+
+
+def test_grouped_2h_validity_roundtrip():
+    _s1, pub1 = eg.keygen(b"g1")
+    _s2, pub2 = eg.keygen(b"g2")
+    context, proof = _prove_grouped_2h(pub1, pub2, 55, rnd(b"gr"), b"g2h")
+    sigma.verify_grouped_ciphertext_2_handles_validity(context, proof)
+    # corrupt a handle
+    bad = context[:128] + context[96:128] + context[160:]
+    bad = context[:96] + context[96:128] + context[96:128]  # h2 := h1
+    with pytest.raises(sigma.ZkError):
+        sigma.verify_grouped_ciphertext_2_handles_validity(bad, proof)
+
+
+# -- range proofs -------------------------------------------------------------
+
+
+def _range_context(amounts, bits, blinds):
+    comms = [eg.commit(a, r) for a, r in zip(amounts, blinds)]
+    blob = b"".join(comms).ljust(8 * 32, b"\x00")
+    return comms, blob + bytes(bits).ljust(8, b"\x00")
+
+
+def _range_transcript(context):
+    t = Transcript(b"batched-range-proof-instruction")
+    t.append_message(b"commitments", context[: 8 * 32])
+    t.append_message(b"bit-lengths", context[8 * 32 :])
+    return t
+
+
+def test_range_proof_u64_roundtrip():
+    amounts, bits, blinds = [9, 300, 7, 1], [16, 16, 16, 16], \
+        [rnd(b"b%d" % i) for i in range(4)]
+    comms, context = _range_context(amounts, bits, blinds)
+    proof = rp.prove_range(amounts, blinds, bits,
+                           _range_transcript(context), b"rp64")
+    zk._verify_range(6)(context, proof)
+    with pytest.raises(sigma.ZkError):
+        zk._verify_range(6)(context,
+                            proof[:40] + bytes([proof[40] ^ 1]) + proof[41:])
+
+
+def test_range_proof_u128_roundtrip():
+    amounts, bits = [2**63 - 1, 88], [64, 64]
+    blinds = [rnd(b"c1"), rnd(b"c2")]
+    comms, context = _range_context(amounts, bits, blinds)
+    proof = rp.prove_range(amounts, blinds, bits,
+                           _range_transcript(context), b"rp128")
+    zk._verify_range(7)(context, proof)
+
+
+# -- the program --------------------------------------------------------------
+
+
+def _run_instr(accounts, iaccts, data):
+    from firedancer_tpu.flamenco.executor import (
+        Account, Executor, InstrAccount, TxnCtx,
+    )
+
+    ctx = TxnCtx(
+        accounts=[
+            Account(key=k, lamports=lam, owner=owner, executable=False,
+                    data=bytearray(d))
+            for k, lam, owner, d in accounts
+        ],
+        signer=[ia[1] for ia in iaccts] + [False] * (
+            len(accounts) - len(iaccts)),
+        writable=[ia[2] for ia in iaccts] + [False] * (
+            len(accounts) - len(iaccts)),
+        budget=2_000_000,
+    )
+    ex = Executor()
+    ex.execute_instr(
+        ctx, zk.ZK_ELGAMAL_PROOF_PROGRAM,
+        [__import__("firedancer_tpu.flamenco.executor",
+                    fromlist=["InstrAccount"]).InstrAccount(
+            ia[0], ia[1], ia[2]) for ia in iaccts],
+        data)
+    return ctx
+
+
+def test_program_verify_inline_and_context_state():
+    from firedancer_tpu.flamenco.executor import InstrError
+    from firedancer_tpu.protocol.txn import SYSTEM_PROGRAM
+
+    s, pub = eg.keygen(b"prog")
+    proof = sigma.prove_pubkey_validity(s, pub, b"pn")
+    data = bytes([4]) + pub + proof
+    state_key = hashlib.sha256(b"ctxstate").digest()
+    auth_key = hashlib.sha256(b"auth").digest()
+    accounts = [
+        (state_key, 1000, zk.ZK_ELGAMAL_PROOF_PROGRAM,
+         bytes(zk.CTX_HEAD_SZ + 32)),
+        (auth_key, 0, SYSTEM_PROGRAM, b""),
+    ]
+    ctx = _run_instr(accounts, [(0, False, True), (1, False, False)], data)
+    state = bytes(ctx.accounts[0].data)
+    assert state[:32] == auth_key
+    assert state[32] == 4
+    assert state[33:] == pub
+
+    # double-init rejected
+    with pytest.raises(InstrError):
+        _run_instr(
+            [(state_key, 1000, zk.ZK_ELGAMAL_PROOF_PROGRAM, state),
+             (auth_key, 0, SYSTEM_PROGRAM, b"")],
+            [(0, False, True), (1, False, False)], data)
+
+    # close: lamports move, account clears
+    dest_key = hashlib.sha256(b"dest").digest()
+    ctx2 = _run_instr(
+        [(state_key, 1000, zk.ZK_ELGAMAL_PROOF_PROGRAM, state),
+         (dest_key, 5, SYSTEM_PROGRAM, b""),
+         (auth_key, 0, SYSTEM_PROGRAM, b"")],
+        [(0, False, True), (1, False, True), (2, True, False)],
+        bytes([0]))
+    assert ctx2.accounts[0].lamports == 0
+    assert len(ctx2.accounts[0].data) == 0
+    assert ctx2.accounts[1].lamports == 1005
+
+    # wrong authority can't close
+    with pytest.raises(InstrError):
+        _run_instr(
+            [(state_key, 1000, zk.ZK_ELGAMAL_PROOF_PROGRAM, state),
+             (dest_key, 5, SYSTEM_PROGRAM, b""),
+             (dest_key, 0, SYSTEM_PROGRAM, b"")],
+            [(0, False, True), (1, False, True), (2, True, False)],
+            bytes([0]))
+
+
+def test_program_proof_from_account_data():
+    s, pub = eg.keygen(b"acctsrc")
+    proof = sigma.prove_pubkey_validity(s, pub, b"pa")
+    holder_key = hashlib.sha256(b"holder").digest()
+    blob = b"\xaa" * 7 + pub + proof  # proof data at offset 7
+    data = bytes([4]) + (7).to_bytes(4, "little")
+    from firedancer_tpu.protocol.txn import SYSTEM_PROGRAM
+
+    _run_instr([(holder_key, 0, SYSTEM_PROGRAM, blob)],
+               [(0, False, False)], data)
+
+
+def test_program_rejects_invalid_proof():
+    from firedancer_tpu.flamenco.executor import InstrError
+
+    s, pub = eg.keygen(b"bad")
+    proof = sigma.prove_pubkey_validity(s, pub, b"pb")
+    _s2, pub2 = eg.keygen(b"bad2")
+    with pytest.raises(InstrError):
+        _run_instr([], [], bytes([4]) + pub2 + proof)
