@@ -198,11 +198,16 @@ class MiniServer:
     once."""
 
     def __init__(self, handler, *, host: str = "127.0.0.1", port: int = 0,
-                 timeout_s: float = 10.0, max_body: int = MAX_BODY):
+                 timeout_s: float = 10.0, max_body: int = MAX_BODY,
+                 ws_handler=None):
         import socket
         import threading
 
         self._handler = handler
+        # ws_handler(request, socket): invoked after a successful RFC
+        # 6455 upgrade handshake; owns the socket for the connection's
+        # lifetime (the pubsub surface)
+        self._ws_handler = ws_handler
         self._max_body = max_body
         self._timeout = timeout_s
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -243,6 +248,25 @@ class MiniServer:
                     if not chunk:
                         return
                     buf += chunk
+                if self._ws_handler is not None:
+                    hdrs = {k.lower(): v for k, v in req.headers}
+                    if hdrs.get("upgrade", "").lower() == "websocket":
+                        from firedancer_tpu.protocol.websocket import (
+                            handshake_response,
+                        )
+
+                        key = hdrs.get("sec-websocket-key")
+                        if not key:
+                            conn.sendall(build_response(
+                                400, b"missing sec-websocket-key\n"))
+                            return
+                        conn.sendall(handshake_response(key))
+                        conn.settimeout(None)  # long-lived subscription
+                        # bytes pipelined behind the handshake are the
+                        # client's first frames — hand them over too
+                        self._ws_handler(req, conn,
+                                         bytes(buf[req.head_len :]))
+                        return
                 need = body_length(req)
                 if need == "chunked":
                     conn.sendall(build_response(400, b"no chunked bodies\n"))

@@ -1,0 +1,159 @@
+"""WebSocket (RFC 6455) server-side framing for the RPC pubsub surface.
+
+Counterpart of the reference rpcserver's websocket layer
+(/root/reference/src/app/rpcserver serves account/slot subscriptions
+over ws).  No code shared: handshake and framing are implemented from
+RFC 6455 — Sec-WebSocket-Accept = b64(sha1(key || GUID)), client
+frames masked, server frames unmasked, opcodes text/binary/close/ping.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import struct
+
+GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+OP_CONT = 0x0
+OP_TEXT = 0x1
+OP_BINARY = 0x2
+OP_CLOSE = 0x8
+OP_PING = 0x9
+OP_PONG = 0xA
+
+MAX_FRAME = 1 << 20
+
+
+class WsError(ValueError):
+    pass
+
+
+def accept_key(sec_websocket_key: str) -> str:
+    digest = hashlib.sha1((sec_websocket_key + GUID).encode()).digest()
+    return base64.b64encode(digest).decode()
+
+
+def handshake_response(sec_websocket_key: str) -> bytes:
+    return (
+        b"HTTP/1.1 101 Switching Protocols\r\n"
+        b"upgrade: websocket\r\n"
+        b"connection: Upgrade\r\n"
+        b"sec-websocket-accept: " + accept_key(sec_websocket_key).encode()
+        + b"\r\n\r\n"
+    )
+
+
+def encode_frame(payload: bytes, opcode: int = OP_TEXT) -> bytes:
+    """Server frame: FIN set, unmasked."""
+    head = bytes([0x80 | opcode])
+    n = len(payload)
+    if n < 126:
+        head += bytes([n])
+    elif n < (1 << 16):
+        head += bytes([126]) + struct.pack(">H", n)
+    else:
+        head += bytes([127]) + struct.pack(">Q", n)
+    return head + payload
+
+
+def decode_frame(buf: bytes) -> tuple[int, bytes, int] | None:
+    """-> (opcode, payload, consumed) or None when `buf` is short.
+    Client frames MUST be masked (RFC 6455 §5.1)."""
+    if len(buf) < 2:
+        return None
+    b0, b1 = buf[0], buf[1]
+    opcode = b0 & 0x0F
+    masked = bool(b1 & 0x80)
+    n = b1 & 0x7F
+    off = 2
+    if n == 126:
+        if len(buf) < 4:
+            return None
+        n = struct.unpack_from(">H", buf, 2)[0]
+        off = 4
+    elif n == 127:
+        if len(buf) < 10:
+            return None
+        n = struct.unpack_from(">Q", buf, 2)[0]
+        off = 10
+    if n > MAX_FRAME:
+        raise WsError(f"frame too large ({n})")
+    if not masked:
+        raise WsError("client frame not masked")
+    if len(buf) < off + 4 + n:
+        return None
+    mask = buf[off : off + 4]
+    off += 4
+    payload = bytes(b ^ mask[i % 4] for i, b in enumerate(
+        buf[off : off + n]))
+    return opcode, payload, off + n
+
+
+class WsConn:
+    """A handshaken connection: text in/out with ping/close handling.
+    `initial` carries bytes the client pipelined behind its handshake
+    request (they are the first frames, not discardable)."""
+
+    def __init__(self, sock, initial: bytes = b""):
+        import threading
+
+        self.sock = sock
+        self._buf = initial
+        self.open = True
+        # writes come from BOTH the per-connection handler thread and
+        # notifier threads: interleaved partial sendalls would corrupt
+        # the frame stream permanently
+        self._wlock = threading.Lock()
+
+    def send_text(self, text: str) -> None:
+        try:
+            with self._wlock:
+                self.sock.sendall(encode_frame(text.encode()))
+        except OSError:
+            self.open = False
+
+    def recv_text(self) -> str | None:
+        """Blocking read of the next text frame; None on close."""
+        while self.open:
+            got = decode_frame(self._buf)
+            if got is None:
+                try:
+                    chunk = self.sock.recv(65536)
+                except OSError:
+                    self.open = False
+                    return None
+                if not chunk:
+                    self.open = False
+                    return None
+                self._buf += chunk
+                continue
+            opcode, payload, consumed = got
+            self._buf = self._buf[consumed:]
+            if opcode == OP_CLOSE:
+                try:
+                    self.sock.sendall(encode_frame(b"", OP_CLOSE))
+                except OSError:
+                    pass
+                self.open = False
+                return None
+            if opcode == OP_PING:
+                try:
+                    self.sock.sendall(encode_frame(payload, OP_PONG))
+                except OSError:
+                    self.open = False
+                continue
+            if opcode in (OP_TEXT, OP_BINARY):
+                return payload.decode("utf-8", "replace")
+        return None
+
+    def close(self) -> None:
+        self.open = False
+        try:
+            self.sock.sendall(encode_frame(b"", OP_CLOSE))
+        except OSError:
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass

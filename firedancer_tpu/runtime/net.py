@@ -183,8 +183,12 @@ class QuicIngressStage(UdpIngressStage):
     keeps near the socket)."""
 
     def __init__(self, *args, identity_secret: bytes, reasm_depth: int = 64,
-                 max_conns: int = 64, tx_filter=None, **kwargs):
+                 max_conns: int = 64, tx_filter=None, retry: bool = False,
+                 **kwargs):
         super().__init__(*args, **kwargs)
+        import hashlib
+
+        from firedancer_tpu.waltz import quic
         from .tpu_reasm import TpuReasm
 
         self.identity_secret = identity_secret
@@ -196,11 +200,32 @@ class QuicIngressStage(UdpIngressStage):
         # tx_filter(datagram) -> bool; False drops the datagram before the
         # socket (loss-recovery tests simulate lossy links with it)
         self.tx_filter = tx_filter
+        # address validation (fd_quic's retry path): with retry=True an
+        # unvalidated Initial costs us a STATELESS Retry, never a conn
+        # slot or a crypto handshake — the amplification defense on the
+        # public TPU port
+        static = hashlib.sha256(b"quic-static:" + identity_secret).digest()
+        self.retry_required = retry
+        self.retry_gate = quic.RetryGate(static)
+        self._reset_key = static
+        # §8: until an address is validated, send at most 3x what it
+        # sent us (tracked only pre-handshake; validated addrs drop out)
+        self._addr_budget: dict = {}   # src -> [rx_bytes, tx_bytes]
 
     def _send(self, dg: bytes, dst) -> None:
         if self.tx_filter is not None and not self.tx_filter(dg):
             self.metrics.inc("tx_dropped_by_filter")
             return
+        budget = self._addr_budget.get(dst)
+        if budget is not None:
+            # §8.1 anti-amplification: an unvalidated path gets at most
+            # 3x the bytes it sent; the surplus waits for more from the
+            # peer (PTO resends it) — a spoofed victim address can never
+            # be used as an amplifier
+            if budget[1] + len(dg) > 3 * budget[0]:
+                self.metrics.inc("tx_amplification_capped")
+                return
+            budget[1] += len(dg)
         self.sock.sendto(dg, dst)
 
     def after_credit(self) -> None:
@@ -232,10 +257,73 @@ class QuicIngressStage(UdpIngressStage):
                 fresh = False
                 migrating_cid = cid
         if fresh:
+            ver = quic.packet_version(data)
+            if ver is None:
+                # short header from an unknown address with an unknown
+                # CID: stateless reset keyed to that CID (§10.3) so a
+                # rebooted peer's connection dies fast, not by timeout
+                cid = quic.peek_dcid(data, short_dcid_len=8)
+                if cid and len(data) >= 43:
+                    self._send(quic.build_stateless_reset(
+                        quic.stateless_reset_token(self._reset_key, cid)
+                    ), src)
+                    self.metrics.inc("stateless_reset_tx")
+                return True
+            if ver == 0:
+                return True  # §6.1: never answer VN with VN
+            if ver != quic.QUIC_V1:
+                # §6: a long header in a version we don't speak gets a
+                # Version Negotiation response — for big-enough
+                # datagrams only (tiny spoofed probes get nothing)
+                if len(data) >= 1200 and len(data) > 6:
+                    dlen = data[5]
+                    dcid = data[6 : 6 + dlen]
+                    so = 6 + dlen
+                    scid = data[so + 1 : so + 1 + data[so]] \
+                        if len(data) > so else b""
+                    self._send(
+                        quic.build_version_negotiation(scid, dcid), src)
+                    self.metrics.inc("version_negotiation_tx")
+                return True
+            if len(data) < 1200:
+                # §14.1: servers MUST discard Initials in datagrams
+                # smaller than 1200 bytes — and never answer them (a
+                # tiny spoofed Initial must not amplify via Retry)
+                self.metrics.inc("small_initial_dropped")
+                return True
+            if self.retry_required:
+                peek = quic.peek_initial_token(data)
+                if peek is None:
+                    self.metrics.inc("bad_packet")
+                    return True
+                dcid, scid, token = peek
+                odcid = self.retry_gate.validate(src, token) if token \
+                    else None
+                if odcid is None:
+                    # STATELESS: no conn, no TLS, just a Retry carrying
+                    # a token bound to (src, original dcid)
+                    new_scid = os.urandom(8)
+                    self._send(quic.build_retry(
+                        odcid=dcid, dcid=scid, scid=new_scid,
+                        token=self.retry_gate.make_token(src, dcid),
+                    ), src)
+                    self.metrics.inc("retry_tx")
+                    return True
             if len(self.conns) >= self.max_conns and not self._evict():
                 self.metrics.inc("conn_drop")
                 return True
             conn = quic.Connection.server_new(self.identity_secret)
+            if not self.retry_required:
+                # no token validation: the 3x budget guards this address
+                # until its handshake completes.  Bounded: spoofed-source
+                # sprays must not grow this dict without limit
+                if len(self._addr_budget) >= 4 * self.max_conns:
+                    self._addr_budget.pop(next(iter(self._addr_budget)))
+                self._addr_budget.setdefault(src, [0, 0])
+        if src in self._addr_budget:
+            self._addr_budget[src][0] += len(data)
+            if conn is not None and conn.established:
+                del self._addr_budget[src]  # address validated
         try:
             events = conn.receive(data)
         except (quic.QuicError, tls13.TlsError, ValueError, IndexError,

@@ -13,10 +13,18 @@ Served methods:
     getVoteAccounts       getEpochSchedule getClusterNodes
     getMultipleAccounts   getFeeForMessage minimumLedgerSlot
     getHighestSnapshotSlot                 getRecentPerformanceSamples
+    getBlock              getBlocks        getBlocksWithLimit
+    getTransaction        getSignaturesForAddress
+
+plus the websocket pubsub surface on the SAME port (RFC 6455 upgrade):
+slotSubscribe / accountSubscribe / signatureSubscribe and their
+unsubscribes — notifications pushed via notify_slot/notify_account/
+notify_signature (the reference rpcserver's ws_method family).
 
 — the minimum a bench observer (fd_bencho polls getTransactionCount),
 a wallet (sendTransaction/getLatestBlockhash/getSignatureStatuses/
-getAccountInfo), and an operator need.
+getAccountInfo), an explorer (getBlock/getTransaction), and an
+operator need.
 
 The server reads live state through a provided `view` object (duck-typed;
 PipelineView wires a LeaderPipeline + funk + StatusCache + blockstore).
@@ -106,6 +114,29 @@ class PipelineView:
             return None
         return self.leaders.leader_for_slot(slot)
 
+    def block(self, slot: int):
+        """-> (blockhash, [txn payload bytes]) or None when the slot's
+        shreds are absent/incomplete — the getBlock/getTransaction data
+        plane over the blockstore."""
+        bs = self.blockstore
+        if bs is None or not bs.is_complete(slot):
+            return None
+        from firedancer_tpu.runtime.poh_stage import parse_entry
+        from firedancer_tpu.runtime.shred_stage import deshred_entry_batch
+
+        try:
+            batch = bs.entry_batch_bytes(slot)
+            entries = [parse_entry(e) for e in deshred_entry_batch(batch)]
+        except Exception:
+            return None
+        txns = [p for _n, _h, ts in entries for p in ts]
+        blockhash = entries[-1][1] if entries else bytes(32)
+        return blockhash, txns
+
+    def block_slots(self) -> list[int]:
+        bs = self.blockstore
+        return bs.slots() if bs is not None else []
+
 
 class RpcServer:
     """Serves JSON-RPC over the framework's own HTTP parser and JSON
@@ -153,8 +184,13 @@ class RpcServer:
                 200, out.encode(), content_type="application/json",
             )
 
+        # pubsub registry: sub_id -> (kind, match-key, WsConn)
+        self._subs: dict[int, tuple] = {}
+        self._subs_lock = threading.Lock()
+        self._next_sub = 1
         self._srv = H.MiniServer(handler, host=host, port=port,
-                                 max_body=J.MAX_LEN)
+                                 max_body=J.MAX_LEN,
+                                 ws_handler=self._ws_handler)
 
     @property
     def addr(self):
@@ -416,6 +452,76 @@ class RpcServer:
                 samples = self.view.perf_samples or []
                 n = dec(int, params[0]) if params else len(samples)
                 return ok(list(samples)[-n:][::-1])
+            if method == "getBlock":
+                slot = dec(int, params[0])
+                got = self.view.block(slot)
+                if got is None:
+                    return err(-32007, f"slot {slot} was skipped or "
+                                       "missing in long-term storage")
+                blockhash, txns = got
+                return ok({
+                    "blockhash": b58_encode32(blockhash),
+                    "previousBlockhash": b58_encode32(bytes(32)),
+                    "parentSlot": max(slot - 1, 0),
+                    "blockHeight": None,
+                    "blockTime": None,
+                    "transactions": [self._txn_json(p) for p in txns],
+                })
+            if method == "getBlocks":
+                start = dec(int, params[0])
+                end = dec(int, params[1]) if len(params) > 1 and \
+                    params[1] is not None else None
+                slots = [s for s in sorted(self.view.block_slots())
+                         if s >= start and (end is None or s <= end)]
+                return ok(slots[:500_000])
+            if method == "getBlocksWithLimit":
+                start = dec(int, params[0])
+                limit = dec(int, params[1])
+                slots = [s for s in sorted(self.view.block_slots())
+                         if s >= start]
+                return ok(slots[:limit])
+            if method == "getTransaction":
+                sig = dec(b58_decode, params[0])
+                found = self._find_txn(sig)
+                if found is None:
+                    return ok(None)
+                slot, payload = found
+                out = self._txn_json(payload)
+                out["slot"] = slot
+                out["blockTime"] = None
+                return ok(out)
+            if method == "getSignaturesForAddress":
+                addr = dec(b58_decode32, params[0])
+                cfg = params[1] if len(params) > 1 and isinstance(
+                    params[1], dict) else {}
+                limit = int(cfg.get("limit", 1000))
+                out = []
+                from firedancer_tpu.protocol import txn as _ft
+
+                for slot in sorted(self.view.block_slots(), reverse=True):
+                    got = self.view.block(slot)
+                    if got is None:
+                        continue
+                    for p in got[1]:
+                        t = _ft.txn_parse(p)
+                        if t is None or addr not in t.acct_addrs(p):
+                            continue
+                        out.append({
+                            "signature": b58_encode(t.signatures(p)[0]),
+                            "slot": slot,
+                            "err": None,
+                            "memo": None,
+                            "blockTime": None,
+                            "confirmationStatus": "finalized",
+                        })
+                        if len(out) >= limit:
+                            return ok(out)
+                return ok(out)
+            if method in ("slotSubscribe", "accountSubscribe",
+                          "signatureSubscribe", "slotUnsubscribe",
+                          "accountUnsubscribe", "signatureUnsubscribe"):
+                return err(-32601,
+                           f"{method} is served on the websocket port")
             return err(-32601, f"method not found: {method}")
         except _ParamError as e:
             # malformed client parameters (bad base58/base64, wrong types)
@@ -425,6 +531,176 @@ class RpcServer:
             return err(-32602, f"invalid params: {e}")
         except Exception as e:
             return err(-32603, f"internal error: {type(e).__name__}")
+
+    # -- block/txn helpers ----------------------------------------------------
+
+    def _txn_json(self, payload: bytes) -> dict:
+        import base64 as b64
+
+        from firedancer_tpu.flamenco.runtime import LAMPORTS_PER_SIGNATURE
+        from firedancer_tpu.protocol import txn as _ft
+
+        t = _ft.txn_parse(payload)
+        sigs = t.signatures(payload) if t else []
+        from firedancer_tpu.protocol.base58 import b58_encode
+
+        return {
+            "transaction": [b64.b64encode(payload).decode(), "base64"],
+            "meta": {
+                "err": None,
+                "status": {"Ok": None},
+                "fee": LAMPORTS_PER_SIGNATURE * len(sigs),
+                "preBalances": [],
+                "postBalances": [],
+                "logMessages": None,
+            },
+            "signatures": [b58_encode(s) for s in sigs],
+        }
+
+    def _find_txn(self, sig: bytes):
+        """-> (slot, payload) via the status cache's signature index,
+        falling back to a bounded blockstore scan."""
+        from firedancer_tpu.protocol import txn as _ft
+
+        slots = None
+        sc = self.view.status_cache
+        if sc is not None and sig in getattr(sc, "by_sig", {}):
+            slots = sorted(sc.by_sig[sig])
+        if slots is None:
+            slots = sorted(self.view.block_slots())
+        for slot in slots:
+            got = self.view.block(slot)
+            if got is None:
+                continue
+            for p in got[1]:
+                t = _ft.txn_parse(p)
+                if t is not None and sig in t.signatures(p):
+                    return slot, p
+        return None
+
+    # -- websocket pubsub (slot/account/signature subscriptions) --------------
+
+    def _ws_handler(self, req, conn, initial: bytes = b"") -> None:
+        """Per-connection subscription loop (the reference rpcserver's
+        ws_method_* family)."""
+        from firedancer_tpu.protocol import jsonlex as J
+        from firedancer_tpu.protocol.base58 import b58_decode, b58_decode32
+        from firedancer_tpu.protocol.websocket import WsConn
+
+        ws = WsConn(conn, initial)
+        local_ids: list[int] = []
+        try:
+            while ws.open:
+                text = ws.recv_text()
+                if text is None:
+                    break
+                try:
+                    reqj = J.loads(text)
+                    method = reqj.get("method")
+                    rid = reqj.get("id")
+                    params = reqj.get("params") or []
+                except Exception:
+                    ws.send_text(json.dumps({
+                        "jsonrpc": "2.0", "id": None,
+                        "error": {"code": -32700, "message": "parse error"},
+                    }))
+                    continue
+                if method in ("slotSubscribe", "accountSubscribe",
+                              "signatureSubscribe"):
+                    key = None
+                    try:
+                        if method == "accountSubscribe":
+                            key = b58_decode32(params[0])
+                        elif method == "signatureSubscribe":
+                            key = b58_decode(params[0])
+                    except Exception:
+                        ws.send_text(json.dumps({
+                            "jsonrpc": "2.0", "id": rid,
+                            "error": {"code": -32602,
+                                      "message": "invalid params"},
+                        }))
+                        continue
+                    with self._subs_lock:
+                        sub_id = self._next_sub
+                        self._next_sub += 1
+                        self._subs[sub_id] = (method[:-9], key, ws)
+                    local_ids.append(sub_id)
+                    ws.send_text(json.dumps({
+                        "jsonrpc": "2.0", "id": rid, "result": sub_id}))
+                elif method in ("slotUnsubscribe", "accountUnsubscribe",
+                                "signatureUnsubscribe"):
+                    sub_id = params[0] if params else -1
+                    with self._subs_lock:
+                        # scoped to THIS connection: a client must not
+                        # cancel another client's subscription by id
+                        entry = self._subs.get(sub_id)
+                        removed = entry is not None and entry[2] is ws
+                        if removed:
+                            del self._subs[sub_id]
+                    ws.send_text(json.dumps({
+                        "jsonrpc": "2.0", "id": rid, "result": removed}))
+                else:
+                    # plain request/response methods work over ws too —
+                    # with the HTTP path's -32603 guard, not a torn conn
+                    try:
+                        out = json.dumps(self._dispatch(reqj))
+                    except Exception:
+                        out = json.dumps({
+                            "jsonrpc": "2.0", "id": rid,
+                            "error": {"code": -32603,
+                                      "message": "internal error"},
+                        })
+                    ws.send_text(out)
+        finally:
+            with self._subs_lock:
+                for sub_id in local_ids:
+                    self._subs.pop(sub_id, None)
+            ws.close()
+
+    def _notify(self, kind: str, match, result) -> None:
+        with self._subs_lock:
+            targets = [
+                (sub_id, ws) for sub_id, (k, key, ws) in self._subs.items()
+                if k == kind and (key is None or key == match)
+            ]
+        for sub_id, ws in targets:
+            ws.send_text(json.dumps({
+                "jsonrpc": "2.0",
+                "method": f"{kind}Notification",
+                "params": {"result": result, "subscription": sub_id},
+            }))
+
+    def notify_slot(self, slot: int, parent: int | None = None,
+                    root: int | None = None) -> None:
+        self._notify("slot", None, {
+            "slot": slot,
+            "parent": parent if parent is not None else max(slot - 1, 0),
+            "root": root if root is not None else 0,
+        })
+
+    def notify_account(self, pubkey: bytes) -> None:
+        import base64 as b64
+
+        lam, owner, ex, data = self.view.account(pubkey)
+        from firedancer_tpu.protocol.base58 import b58_encode32
+
+        self._notify("account", pubkey, {
+            "context": {"slot": self.view.slot()},
+            "value": {
+                "lamports": lam,
+                "owner": b58_encode32(owner),
+                "executable": ex,
+                "rentEpoch": 0,
+                "data": [b64.b64encode(data).decode(), "base64"],
+            },
+        })
+
+    def notify_signature(self, sig: bytes, slot: int,
+                         err_val=None) -> None:
+        self._notify("signature", sig, {
+            "context": {"slot": slot},
+            "value": {"err": err_val},
+        })
 
     def close(self):
         self._srv.close()

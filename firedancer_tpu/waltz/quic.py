@@ -72,6 +72,11 @@ FT_HANDSHAKE_DONE = 0x1E
 
 LONG_INITIAL = 0
 LONG_HANDSHAKE = 2
+LONG_RETRY = 3
+
+# Retry Integrity Tag key/nonce for v1 (RFC 9001 §5.8 protocol constants)
+RETRY_KEY_V1 = bytes.fromhex("be0c690b9f66575a1d766b54e368c84e")
+RETRY_NONCE_V1 = bytes.fromhex("461599d35d632bf2239825bb")
 
 MAX_DATAGRAM = 1452
 MAX_FRAMES_PAYLOAD = 1200  # per-packet payload budget when packing frames
@@ -200,6 +205,175 @@ def seal_packet(keys: Keys, *, level: int, dcid: bytes, scid: bytes,
     for i in range(PN_LEN):
         pkt[pn_off + i] ^= mask[1 + i]
     return bytes(pkt)
+
+
+# -- Retry / version negotiation / stateless reset (RFC 9000 §17.2.5,
+#    §6, §10.3 — the fd_quic.c retry path's counterpart) ----------------------
+
+
+def retry_integrity_tag(odcid: bytes, retry_without_tag: bytes) -> bytes:
+    """AES-128-GCM tag over the Retry pseudo-packet (RFC 9001 §5.8)."""
+    pseudo = bytes([len(odcid)]) + odcid + retry_without_tag
+    ct, tag = AesGcm(RETRY_KEY_V1).seal(RETRY_NONCE_V1, b"", aad=pseudo)
+    assert ct == b""
+    return tag
+
+
+def build_retry(*, odcid: bytes, dcid: bytes, scid: bytes,
+                token: bytes) -> bytes:
+    """Server->client Retry: address validation before any state is
+    allocated (the amplification defense)."""
+    pkt = bytes([0xC0 | (LONG_RETRY << 4)])
+    pkt += struct.pack(">I", QUIC_V1)
+    pkt += bytes([len(dcid)]) + dcid
+    pkt += bytes([len(scid)]) + scid
+    pkt += token
+    return pkt + retry_integrity_tag(odcid, pkt)
+
+
+def parse_retry(buf: bytes) -> tuple[bytes, bytes, bytes, bytes] | None:
+    """-> (dcid, scid, token, tag) for a well-formed Retry, else None."""
+    if len(buf) < 7 + 16 or not buf[0] & 0x80:
+        return None
+    if (buf[0] >> 4) & 3 != LONG_RETRY:
+        return None
+    if struct.unpack_from(">I", buf, 1)[0] != QUIC_V1:
+        return None
+    p = 5
+    dlen = buf[p]
+    dcid = buf[p + 1 : p + 1 + dlen]
+    p += 1 + dlen
+    if p >= len(buf):
+        return None
+    slen = buf[p]
+    scid = buf[p + 1 : p + 1 + slen]
+    p += 1 + slen
+    if len(buf) - p < 16:
+        return None
+    return dcid, scid, buf[p:-16], buf[-16:]
+
+
+def peek_initial_token(buf: bytes) -> tuple[bytes, bytes, bytes] | None:
+    """Cleartext header fields of an Initial: (dcid, scid, token) —
+    the server's pre-handshake address-validation peek (no keys)."""
+    if len(buf) < 7 or not buf[0] & 0x80:
+        return None
+    if (buf[0] >> 4) & 3 != LONG_INITIAL:
+        return None
+    try:
+        p = 5
+        dlen = buf[p]
+        dcid = buf[p + 1 : p + 1 + dlen]
+        p += 1 + dlen
+        slen = buf[p]
+        scid = buf[p + 1 : p + 1 + slen]
+        p += 1 + slen
+        tlen, p = varint_decode(buf, p)
+        return dcid, scid, buf[p : p + tlen]
+    except (IndexError, QuicError):
+        return None
+
+
+def packet_version(buf: bytes) -> int | None:
+    """The long-header version field (None for short headers)."""
+    if len(buf) < 5 or not buf[0] & 0x80:
+        return None
+    return struct.unpack_from(">I", buf, 1)[0]
+
+
+def build_version_negotiation(dcid: bytes, scid: bytes,
+                              versions=(QUIC_V1,)) -> bytes:
+    """Version 0 long header listing what we speak (RFC 9000 §6)."""
+    pkt = bytes([0x80 | (os.urandom(1)[0] & 0x7F)])
+    pkt += struct.pack(">I", 0)
+    pkt += bytes([len(dcid)]) + dcid
+    pkt += bytes([len(scid)]) + scid
+    for v in versions:
+        pkt += struct.pack(">I", v)
+    return pkt
+
+
+def is_version_negotiation(buf: bytes) -> bool:
+    return packet_version(buf) == 0
+
+
+class RetryGate:
+    """Stateless address-validation tokens: HMAC over (peer address,
+    original DCID, expiry) — nothing allocated for unvalidated peers,
+    the property the reference's retry path exists for."""
+
+    def __init__(self, static_key: bytes, *, lifetime_s: float = 30.0):
+        self.key = static_key
+        self.lifetime_s = lifetime_s
+
+    def _mac(self, addr_blob: bytes, odcid: bytes, expiry: int) -> bytes:
+        import hashlib
+        import hmac as _hmac
+
+        return _hmac.new(
+            self.key,
+            b"retry:" + addr_blob + bytes([len(odcid)]) + odcid
+            + expiry.to_bytes(8, "little"),
+            hashlib.sha256,
+        ).digest()[:16]
+
+    @staticmethod
+    def _addr_blob(addr) -> bytes:
+        return repr(addr).encode()
+
+    def make_token(self, addr, odcid: bytes,
+                   now: float | None = None) -> bytes:
+        now = _time.time() if now is None else now
+        expiry = int(now + self.lifetime_s)
+        blob = self._addr_blob(addr)
+        return (bytes([len(odcid)]) + odcid + expiry.to_bytes(8, "little")
+                + self._mac(blob, odcid, expiry))
+
+    def validate(self, addr, token: bytes,
+                 now: float | None = None) -> bytes | None:
+        """-> the original DCID when the token is genuine and fresh."""
+        import hmac as _hmac
+
+        now = _time.time() if now is None else now
+        if len(token) < 1 + 8 + 16:
+            return None
+        n = token[0]
+        if len(token) != 1 + n + 8 + 16:
+            return None
+        odcid = token[1 : 1 + n]
+        expiry = int.from_bytes(token[1 + n : 1 + n + 8], "little")
+        mac = token[1 + n + 8 :]
+        if now > expiry:
+            return None
+        good = self._mac(self._addr_blob(addr), odcid, expiry)
+        if not _hmac.compare_digest(mac, good):
+            return None
+        return odcid
+
+
+def stateless_reset_token(static_key: bytes, cid: bytes) -> bytes:
+    """The 16-byte token a server commits to for each CID (§10.3.2)."""
+    import hashlib
+    import hmac as _hmac
+
+    return _hmac.new(static_key, b"sreset:" + cid,
+                     hashlib.sha256).digest()[:16]
+
+
+def build_stateless_reset(token: bytes, rng=None) -> bytes:
+    """Indistinguishable-from-short-header datagram ending in the token."""
+    rnd = rng or os.urandom
+    pad = rnd(20)
+    first = bytes([0x40 | (pad[0] & 0x3F)])
+    return first + pad[1:] + token
+
+
+def looks_like_stateless_reset(buf: bytes, tokens) -> bool:
+    """§10.3.1: short-header-shaped datagram whose last 16 bytes match a
+    known peer reset token."""
+    if len(buf) < 21 or buf[0] & 0x80:
+        return False
+    return bytes(buf[-16:]) in tokens
 
 
 @dataclass
@@ -593,6 +767,15 @@ class Connection:
         self.ctrl_out: list[bytes] = []  # fire-and-forget ctrl frames
         self.closed = False
         self.handshake_done_sent = False
+        # address validation: the token a Retry handed us rides every
+        # subsequent Initial; a client accepts at most ONE Retry (§17.2.5)
+        self.initial_token = b""
+        self.retry_seen = False
+        self.original_dcid = self.remote_cid if self.is_client else b""
+        # peer stateless-reset tokens we recognize (§10.3.1)
+        self.peer_reset_tokens: set[bytes] = set()
+        # §6.2: VN is only valid before the first processed packet
+        self._processed_any = False
         # path validation (RFC 9000 §8.2/§9): responses we owe ride the
         # next flush; responses we RECEIVED surface for the transport
         # owner (the ingress stage) to complete a migration
@@ -642,6 +825,33 @@ class Connection:
                 ) -> list[StreamEvent]:
         now = _time.monotonic() if now is None else now
         events: list[StreamEvent] = []
+        if looks_like_stateless_reset(datagram, self.peer_reset_tokens):
+            # §10.3.1: the peer lost state for this connection — enter
+            # the draining state, nothing more goes out
+            self.closed = True
+            return events
+        if self.is_client and is_version_negotiation(datagram):
+            # §6.2: VN is honored only BEFORE any packet of this
+            # connection has been processed — a spoofed unauthenticated
+            # VN datagram must never kill an in-progress/live connection
+            if self._processed_any:
+                return events
+            try:
+                vstart = 7 + datagram[5] + datagram[6 + datagram[5]]
+                vers = {struct.unpack_from(">I", datagram, p)[0]
+                        for p in range(vstart, len(datagram) - 3, 4)}
+            except (IndexError, struct.error):
+                return events  # malformed VN: ignore (untrusted input)
+            # we only speak v1; a VN LISTING v1 is a MITM replay (§6.2)
+            if QUIC_V1 not in vers:
+                self.closed = True
+            return events
+        if self.is_client and not self.established and \
+                len(datagram) > 5 and datagram[0] & 0x80 and \
+                (datagram[0] >> 4) & 3 == LONG_RETRY and \
+                packet_version(datagram) == QUIC_V1:
+            self._handle_retry(datagram, now)
+            return events
         off = 0
         while off < len(datagram):
             if datagram[off] == 0:  # trailing padding bytes
@@ -659,6 +869,7 @@ class Connection:
             )
             if pkt is None:
                 continue
+            self._processed_any = True
             tracker = self.recv[pkt.level]
             if tracker.seen(pkt.pn):
                 # duplicate (e.g. a spurious retransmission): re-ack only
@@ -716,6 +927,31 @@ class Connection:
             self.rx_stream_high[ev.stream_id] = end
             if self.rx_data_total > self.rx_max_data:
                 raise QuicError("connection flow control violated")
+
+    def _handle_retry(self, datagram: bytes, now: float) -> None:
+        """§17.2.5 client side: verify the integrity tag against the
+        ORIGINAL DCID, adopt the server's new CID, re-derive initial
+        keys from it, and resend the first flight carrying the token."""
+        if self.retry_seen or self.initial_token:
+            return  # at most one Retry per attempt; later ones ignored
+        got = parse_retry(datagram)
+        if got is None:
+            return
+        _dcid, scid, token, _tag = got
+        expect = retry_integrity_tag(self.original_dcid, datagram[:-16])
+        if expect != datagram[-16:] or not token:
+            return  # forged/corrupt Retry: drop silently (§17.2.5)
+        self.retry_seen = True
+        self.initial_token = token
+        self.remote_cid = scid
+        csec, ssec = initial_secrets(scid)
+        self.keys_tx[INITIAL] = Keys.from_secret(csec)
+        self.keys_rx[INITIAL] = Keys.from_secret(ssec)
+        # the first flight was discarded by the server: re-queue every
+        # in-flight INITIAL frame (pn sequence continues, §17.2.5.3)
+        for pn, pkt in sorted(self.sent[INITIAL].items()):
+            self._queue_rtx(INITIAL, pkt)
+        self.sent[INITIAL].clear()
 
     def _server_adopt(self, datagram: bytes, off: int):
         if off + 6 > len(datagram):
@@ -914,6 +1150,7 @@ class Connection:
                 out.append(seal_packet(
                     self.keys_tx[lvl], level=lvl, dcid=self.remote_cid,
                     scid=self.local_cid, pn=pn, payload=payload,
+                    token=self.initial_token if lvl == INITIAL else b"",
                 ))
                 if record:
                     self.sent[lvl][pn] = SentPacket(pn, now, record)

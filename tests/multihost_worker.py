@@ -1,0 +1,69 @@
+"""Worker for the two-process jax.distributed smoke test (SURVEY §5.8's
+DCN story run for real: coordinator handshake, Gloo cross-process
+collectives on the CPU backend).  Launched by test_multihost_2proc."""
+
+import sys
+
+sys.path.insert(0, ".")
+
+from firedancer_tpu.utils.platform import force_cpu_backend
+
+force_cpu_backend(device_count=4)
+
+import numpy as np
+
+from firedancer_tpu.parallel import multihost as mh
+
+
+def main(coordinator: str, rank: int) -> None:
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    topo = mh.initialize(coordinator=coordinator, num_processes=2,
+                         process_id=rank)
+    assert topo.num_hosts == 2 and topo.host_id == rank
+    assert jax.process_count() == 2
+    assert jax.device_count() == 8
+    assert jax.local_device_count() == 4
+
+    # flat mesh: a cross-host psum over all 8 devices
+    mesh = mh.global_mesh()
+    f = jax.jit(
+        jax.shard_map(lambda x: jax.lax.psum(x, "verify"), mesh=mesh,
+                      in_specs=P("verify"), out_specs=P()),
+        in_shardings=NamedSharding(mesh, P("verify")),
+    )
+    xs = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("verify")),
+        np.full((8,), rank + 1, np.float32),
+    )
+    # local halves are [1,1,..] and [2,2,..] -> psum = 4*1 + 4*2 = 12
+    out = np.asarray(f(xs))
+    assert np.all(out == 12.0), out
+
+    # host-tiled mesh: reduce within the host (ICI axis), then across
+    # hosts (DCN axis) — the sharded-verify reduction shape
+    tiled = mh.host_tiled_mesh()
+    assert tiled.devices.shape == (2, 4)
+    g = jax.jit(
+        jax.shard_map(
+            lambda x: jax.lax.psum(jax.lax.psum(x, "verify"), "host"),
+            mesh=tiled, in_specs=P("host", "verify"), out_specs=P(),
+        ),
+        in_shardings=NamedSharding(tiled, P("host", "verify")),
+    )
+    ys = jax.make_array_from_process_local_data(
+        NamedSharding(tiled, P("host", "verify")),
+        np.ones((1, 8), np.float32),
+    )
+    # 8 one-filled device blocks reduce elementwise: 4 (verify) x 2 (host)
+    assert np.all(np.asarray(g(ys)) == 8.0)
+
+    # every host derives the SAME shard split from the topology
+    assert mh.shard_counts(topo, 16387) == [8194, 8193]
+    print(f"RANK{rank} OK", flush=True)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1], int(sys.argv[2]))

@@ -5,6 +5,8 @@ import hashlib
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # XLA-compile-heavy tier (see conftest)
+
 import jax.numpy as jnp
 
 from firedancer_tpu.ops import sigverify as sv

@@ -4,6 +4,8 @@ fdctl-run operational model end to end."""
 
 import pytest
 
+pytestmark = pytest.mark.slow  # XLA-compile-heavy tier (see conftest)
+
 from firedancer_tpu.models.leader_topo import build_leader_topology
 from firedancer_tpu.runtime import topo as ft
 from firedancer_tpu.runtime.stage import Stage

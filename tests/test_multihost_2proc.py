@@ -1,0 +1,50 @@
+"""TWO real processes through parallel/multihost: the jax.distributed
+coordinator handshake and cross-process (DCN-analog) collectives on the
+CPU backend — the §5.8 gap the r4 verdict named (multihost had only ever
+run num_processes=1)."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.timeout(300)
+def test_two_process_coordinator_and_collectives():
+    coordinator = f"127.0.0.1:{_free_port()}"
+    worker = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
+    env = dict(os.environ)
+    # the worker forces its OWN backend (4 virtual devices per process);
+    # the pytest parent's 8-device XLA_FLAGS must not leak in
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, coordinator, str(rank)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            cwd="/root/repo", env=env,
+        )
+        for rank in range(2)
+    ]
+    outs = []
+    for rank, p in enumerate(procs):
+        try:
+            out, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append((rank, p.returncode, out))
+    for rank, rc, out in outs:
+        assert rc == 0, f"rank {rank} failed:\n{out[-2000:]}"
+        assert f"RANK{rank} OK" in out

@@ -9,6 +9,8 @@ fan-out (fd_verify.c:46) and SURVEY §5.7/§5.8.
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # XLA-compile-heavy tier (see conftest)
+
 import __graft_entry__ as ge
 from firedancer_tpu.parallel import make_mesh, pad_to_multiple, sharded_verify
 

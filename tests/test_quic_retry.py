@@ -1,0 +1,223 @@
+"""QUIC hardening: Retry address validation (RFC 9000 §8.1/§17.2.5),
+version negotiation (§6), stateless reset (§10.3), and the 3x
+anti-amplification budget — the fd_quic.c retry-path capabilities."""
+
+import hashlib
+import os
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+from firedancer_tpu.ops.ref import ed25519_ref as ref
+from firedancer_tpu.tango import shm
+from firedancer_tpu.waltz import quic
+
+IDENTITY = hashlib.sha256(b"quic-retry-id").digest()
+
+
+def test_retry_integrity_tag_rfc9001_a4():
+    odcid = bytes.fromhex("8394c8f03e515708")
+    pkt = bytes.fromhex("ff000000010008f067a5502a4262b5746f6b656e")
+    assert quic.retry_integrity_tag(odcid, pkt).hex() == (
+        "04a265ba2eff4d829058fb3f0f2496ba")
+    dcid, scid, token, _tag = quic.parse_retry(
+        pkt + quic.retry_integrity_tag(odcid, pkt))
+    assert scid.hex() == "f067a5502a4262b5"
+    assert token == b"token"
+
+
+def test_retry_gate_tokens():
+    gate = quic.RetryGate(b"k" * 32, lifetime_s=5)
+    tok = gate.make_token(("1.2.3.4", 55), b"ODCID678")
+    assert gate.validate(("1.2.3.4", 55), tok) == b"ODCID678"
+    # wrong address, tampered token, expiry
+    assert gate.validate(("9.9.9.9", 55), tok) is None
+    assert gate.validate(("1.2.3.4", 55),
+                         tok[:-1] + bytes([tok[-1] ^ 1])) is None
+    assert gate.validate(("1.2.3.4", 55), tok,
+                         now=time.time() + 10) is None
+
+
+def test_client_accepts_one_valid_retry_only():
+    c = quic.Connection.client_new()
+    first_flight = c.flush()
+    assert first_flight
+    odcid = c.original_dcid
+    new_scid = b"S" * 8
+    retry = quic.build_retry(odcid=odcid, dcid=c.local_cid,
+                             scid=new_scid, token=b"tok-1")
+    c.receive(retry)
+    assert c.initial_token == b"tok-1"
+    assert c.remote_cid == new_scid
+    # the re-sent Initial carries the token on the wire
+    resent = c.flush()
+    assert resent
+    peek = quic.peek_initial_token(resent[0])
+    assert peek is not None and peek[2] == b"tok-1"
+    # a second retry is ignored (§17.2.5)
+    retry2 = quic.build_retry(odcid=odcid, dcid=c.local_cid,
+                              scid=b"X" * 8, token=b"tok-2")
+    c.receive(retry2)
+    assert c.initial_token == b"tok-1"
+    # a FORGED retry (bad tag) against a fresh client is dropped
+    c2 = quic.Connection.client_new()
+    c2.flush()
+    bad = quic.build_retry(odcid=b"WRONGCID", dcid=c2.local_cid,
+                           scid=b"Y" * 8, token=b"evil")
+    c2.receive(bad)
+    assert c2.initial_token == b""
+
+
+def test_version_negotiation_closes_client():
+    c = quic.Connection.client_new()
+    c.flush()
+    vn = quic.build_version_negotiation(c.local_cid, c.remote_cid,
+                                        versions=(0xBABABABA,))
+    assert quic.is_version_negotiation(vn)
+    c.receive(vn)
+    assert c.closed
+
+
+def test_stateless_reset_recognized_by_client():
+    c = quic.Connection.client_new()
+    token = quic.stateless_reset_token(b"srv-static", b"C" * 8)
+    c.peer_reset_tokens.add(token)
+    c.receive(quic.build_stateless_reset(token))
+    assert c.closed
+
+
+class _FakeSock:
+    def __init__(self):
+        self.sent = []
+
+    def sendto(self, dg, dst):
+        self.sent.append((dg, dst))
+
+
+def _mk_ingress(**kw):
+    from firedancer_tpu.runtime.net import QuicIngressStage
+
+    link = shm.ShmLink.create(
+        f"fdtpu_qr_{os.getpid()}_{int(time.monotonic_ns() % 1_000_000)}",
+        depth=256, mtu=2048)
+    stage = QuicIngressStage("quic", outs=[shm.Producer(link)],
+                             identity_secret=IDENTITY, **kw)
+    return stage, link
+
+
+def test_amplification_budget_caps_unvalidated_path():
+    stage, link = _mk_ingress()
+    try:
+        stage.sock.close()
+        stage.sock = _FakeSock()
+        addr = ("10.0.0.9", 1234)
+        stage._addr_budget[addr] = [100, 0]  # peer sent us 100 bytes
+        stage._send(b"x" * 250, addr)   # 250 <= 300: goes out
+        stage._send(b"y" * 100, addr)   # would exceed 3x: capped
+        assert len(stage.sock.sent) == 1
+        assert stage.metrics.get("tx_amplification_capped") == 1
+        # more bytes from the peer reopen the budget
+        stage._addr_budget[addr][0] += 200
+        stage._send(b"z" * 100, addr)
+        assert len(stage.sock.sent) == 2
+    finally:
+        link.close()
+        link.unlink()
+
+
+def test_version_negotiation_and_stateless_reset_on_socket():
+    stage, link = _mk_ingress()
+    cli = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    cli.settimeout(5)
+    try:
+        # long header, unknown version, padded to 1200
+        pkt = bytearray([0xC0]) + struct.pack(">I", 5)
+        pkt += bytes([8]) + b"D" * 8 + bytes([8]) + b"S" * 8
+        pkt += bytes(1200 - len(pkt))
+        cli.sendto(bytes(pkt), stage.addr)
+        for _ in range(100):
+            stage.run_once()
+            try:
+                resp, _ = cli.recvfrom(2048)
+                break
+            except socket.timeout:
+                continue
+        assert quic.is_version_negotiation(resp)
+        versions = {struct.unpack_from(">I", resp, p)[0]
+                    for p in range(7 + resp[5] + resp[6 + resp[5]],
+                                   len(resp) - 3, 4)}
+        assert quic.QUIC_V1 in versions
+        # a tiny unknown-version probe gets NOTHING (anti-amplification)
+        cli.sendto(bytes(pkt[:50]), stage.addr)
+        for _ in range(20):
+            stage.run_once()
+        assert stage.metrics.get("version_negotiation_tx") == 1
+
+        # short-header datagram with an unknown CID -> stateless reset
+        sr_probe = bytes([0x41]) + b"Q" * 8 + os.urandom(60)
+        cli.sendto(sr_probe, stage.addr)
+        resp2 = None
+        for _ in range(100):
+            stage.run_once()
+            try:
+                resp2, _ = cli.recvfrom(2048)
+                break
+            except socket.timeout:
+                continue
+        assert resp2 is not None
+        expect = quic.stateless_reset_token(stage._reset_key, b"Q" * 8)
+        assert resp2[-16:] == expect
+        assert (resp2[0] & 0xC0) == 0x40
+    finally:
+        cli.close()
+        stage.sock.close()
+        link.close()
+        link.unlink()
+
+
+@pytest.mark.timeout(300)
+def test_handshake_through_retry_gate():
+    """With retry=True the first Initial costs only a stateless Retry;
+    the tokened re-attempt completes the handshake and ships a txn."""
+    from firedancer_tpu.runtime.net import QuicTxnClient
+
+    stage, link = _mk_ingress(retry=True)
+    consumer = shm.Consumer(link, lazy=8)
+    try:
+        box = {}
+
+        def connect():
+            box["c"] = QuicTxnClient(
+                stage.addr, expected_peer=ref.public_key(IDENTITY),
+                timeout_s=60,
+            )
+
+        t = threading.Thread(target=connect)
+        t.start()
+        deadline = time.monotonic() + 120
+        while t.is_alive() and time.monotonic() < deadline:
+            stage.run_once()
+            time.sleep(0.001)
+        t.join(timeout=1)
+        assert "c" in box, "handshake failed through the retry gate"
+        assert stage.metrics.get("retry_tx") >= 1
+        assert len(stage.conns) == 1
+        # a txn flows end to end
+        txn = b"\xabtxn-bytes" * 10
+        box["c"].send_txn(txn)
+        got = None
+        for _ in range(2000):
+            stage.run_once()
+            frag = consumer.poll()
+            if isinstance(frag, tuple):
+                got = bytes(frag[1])
+                break
+            time.sleep(0.001)
+        assert got == txn
+    finally:
+        stage.sock.close()
+        link.close()
+        link.unlink()

@@ -234,3 +234,206 @@ def test_server_fault_is_not_invalid_params(server):
     # while an actually-bad param still maps to -32602
     r2 = rpc_call(srv.addr, "getBalance", ["!!not-base58!!"])
     assert r2["error"]["code"] == -32602
+
+
+# -- block surface + pubsub (round-5: getBlock family, websockets) -----------
+
+
+def _entry_frame(num_hashes, poh_hash, txns):
+    from firedancer_tpu.runtime.poh_stage import build_entry
+
+    return build_entry(num_hashes, poh_hash, txns)
+
+
+@pytest.fixture
+def block_server(tmp_path):
+    """A server over a REAL blockstore holding slot 9: two transfers in
+    one entry plus a tick."""
+    from firedancer_tpu.flamenco.blockstore import Blockstore, StatusCache
+    from firedancer_tpu.ops.ref import ed25519_ref as ref
+    from firedancer_tpu.protocol import txn as ft
+    from firedancer_tpu.runtime import shredder as fsh
+
+    secret = hashlib.sha256(b"rpc-payer").digest()
+    payer = ref.public_key(secret)
+    bh = hashlib.sha256(b"rpc-blockhash").digest()
+    t1 = ft.transfer_txn(secret, b"d1" * 16, 5, bh, from_pubkey=payer)
+    t2 = ft.transfer_txn(secret, b"d2" * 16, 6, bh, from_pubkey=payer)
+    e1 = _entry_frame(1, hashlib.sha256(b"e1").digest(), [t1, t2])
+    e2 = _entry_frame(3, hashlib.sha256(b"e2").digest(), [])
+    batch = b"".join(
+        len(e).to_bytes(4, "little") + e for e in (e1, e2)
+    )
+    leader_secret = hashlib.sha256(b"rpc-leader").digest()
+    sh = fsh.Shredder(signer=lambda root: ref.sign(leader_secret, root))
+    sets = sh.entry_batch_to_fec_sets(
+        batch, slot=9, meta=fsh.EntryBatchMeta(block_complete=True))
+    bs = Blockstore(str(tmp_path / "bs.log"))
+    for st in sets:
+        for buf in st.data_shreds:
+            bs.insert_shred(buf)
+    sc = StatusCache()
+    sig1 = ft.txn_parse(t1).signatures(t1)[0]
+    sc.insert(bh, sig1, 9)
+    view = PipelineView(pipeline=_FakePipe(), blockstore=bs,
+                        status_cache=sc)
+    srv = RpcServer(view)
+    yield srv, payer, t1, t2, sig1
+    srv.close()
+    bs.close()
+
+
+def test_get_block_and_blocks(block_server):
+    import base64
+
+    srv, payer, t1, t2, _sig = block_server
+    blk = rpc_call(srv.addr, "getBlock", [9])["result"]
+    assert blk["parentSlot"] == 8
+    got = [base64.b64decode(tx["transaction"][0])
+           for tx in blk["transactions"]]
+    assert got == [t1, t2]
+    assert blk["transactions"][0]["meta"]["fee"] == 5000
+    assert rpc_call(srv.addr, "getBlocks", [0])["result"] == [9]
+    assert rpc_call(srv.addr, "getBlocks", [10])["result"] == []
+    assert rpc_call(srv.addr, "getBlocksWithLimit", [0, 1])["result"] == [9]
+    # a missing slot is the typed -32007 error
+    err = rpc_call(srv.addr, "getBlock", [1234])["error"]
+    assert err["code"] == -32007
+
+
+def test_get_transaction_and_signatures_for_address(block_server):
+    import base64
+
+    srv, payer, t1, _t2, sig1 = block_server
+    got = rpc_call(srv.addr, "getTransaction", [b58_encode(sig1)])["result"]
+    assert got["slot"] == 9
+    assert base64.b64decode(got["transaction"][0]) == t1
+    # unknown signature -> null
+    assert rpc_call(srv.addr, "getTransaction",
+                    [b58_encode(b"Z" * 64)])["result"] is None
+    sigs = rpc_call(srv.addr, "getSignaturesForAddress",
+                    [b58_encode(payer)])["result"]
+    assert len(sigs) == 2  # both transfers touch the payer
+    assert sigs[0]["slot"] == 9
+    lim = rpc_call(srv.addr, "getSignaturesForAddress",
+                   [b58_encode(payer), {"limit": 1}])["result"]
+    assert len(lim) == 1
+
+
+class _WsClient:
+    """Minimal RFC 6455 client for tests (client frames MASKED)."""
+
+    def __init__(self, addr):
+        import base64
+        import socket
+
+        self.sock = socket.create_connection(addr, timeout=10)
+        key = base64.b64encode(b"0123456789abcdef").decode()
+        self.sock.sendall(
+            (f"GET / HTTP/1.1\r\nhost: x\r\nupgrade: websocket\r\n"
+             f"connection: Upgrade\r\nsec-websocket-key: {key}\r\n"
+             f"sec-websocket-version: 13\r\n\r\n").encode())
+        head = b""
+        while b"\r\n\r\n" not in head:
+            head += self.sock.recv(4096)
+        assert b"101" in head.split(b"\r\n", 1)[0]
+        self._buf = head.split(b"\r\n\r\n", 1)[1]
+
+    def send(self, obj):
+        import json as _json
+        import os as _os
+        import struct
+
+        payload = _json.dumps(obj).encode()
+        mask = _os.urandom(4)
+        n = len(payload)
+        head = bytes([0x81])
+        if n < 126:
+            head += bytes([0x80 | n])
+        else:
+            head += bytes([0x80 | 126]) + struct.pack(">H", n)
+        body = bytes(b ^ mask[i % 4] for i, b in enumerate(payload))
+        self.sock.sendall(head + mask + body)
+
+    def recv(self):
+        import json as _json
+
+        from firedancer_tpu.protocol.websocket import decode_frame
+
+        while True:
+            # server frames are unmasked: parse directly
+            if len(self._buf) >= 2:
+                n = self._buf[1] & 0x7F
+                off = 2
+                if n == 126:
+                    n = int.from_bytes(self._buf[2:4], "big")
+                    off = 4
+                if len(self._buf) >= off + n:
+                    payload = self._buf[off : off + n]
+                    self._buf = self._buf[off + n :]
+                    return _json.loads(payload)
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                return None
+            self._buf += chunk
+
+    def close(self):
+        self.sock.close()
+
+
+def test_ws_slot_and_signature_subscriptions(block_server):
+    import time
+
+    srv, _payer, _t1, _t2, sig1 = block_server
+    c = _WsClient(srv.addr)
+    try:
+        c.send({"jsonrpc": "2.0", "id": 1, "method": "slotSubscribe"})
+        sub = c.recv()
+        assert isinstance(sub["result"], int)
+        c.send({"jsonrpc": "2.0", "id": 2, "method": "signatureSubscribe",
+                "params": [b58_encode(sig1)]})
+        sub2 = c.recv()
+        assert isinstance(sub2["result"], int)
+        # ordinary request/response also works over the socket
+        c.send({"jsonrpc": "2.0", "id": 3, "method": "getSlot"})
+        assert c.recv()["result"] == 42
+        # push notifications arrive
+        for _ in range(20):
+            if srv._subs:
+                break
+            time.sleep(0.05)
+        srv.notify_slot(43, parent=42, root=40)
+        note = c.recv()
+        assert note["method"] == "slotNotification"
+        assert note["params"]["result"]["slot"] == 43
+        srv.notify_signature(sig1, 43)
+        note2 = c.recv()
+        assert note2["method"] == "signatureNotification"
+        assert note2["params"]["result"]["context"]["slot"] == 43
+        # unsubscribe works
+        c.send({"jsonrpc": "2.0", "id": 4, "method": "slotUnsubscribe",
+                "params": [sub["result"]]})
+        assert c.recv()["result"] is True
+    finally:
+        c.close()
+
+
+def test_ws_account_subscription(block_server):
+    from firedancer_tpu.flamenco.runtime import acct_build
+    from firedancer_tpu.funk import Funk
+
+    srv, payer, *_ = block_server
+    funk = Funk()
+    funk.rec_insert(None, payer, acct_build(909))
+    srv.view.funk = funk
+    c = _WsClient(srv.addr)
+    try:
+        c.send({"jsonrpc": "2.0", "id": 1, "method": "accountSubscribe",
+                "params": [b58_encode(payer)]})
+        assert isinstance(c.recv()["result"], int)
+        srv.notify_account(payer)
+        note = c.recv()
+        assert note["method"] == "accountNotification"
+        assert note["params"]["result"]["value"]["lamports"] == 909
+    finally:
+        c.close()
