@@ -35,12 +35,18 @@ class OrdTxn:
     cost: fc.TxnCost
     rewards: int
     _sets: tuple | None = field(default=None, repr=False, compare=False)
+    _key: object = field(default=None, repr=False, compare=False)
 
     def sort_key(self):
         # descending by rewards/cost; bisect needs ascending, so negate via
         # ratio inversion: store (-rewards/cost) as exact fraction tuple.
-        # Compare r1/c1 > r2/c2 as r1*c2 > r2*c1 -> key = Fraction-free:
-        return _RatioKey(self.rewards, self.cost.total)
+        # Compare r1/c1 > r2/c2 as r1*c2 > r2*c1 -> key = Fraction-free.
+        # CACHED: bisect probes call this O(log n) times per insert and
+        # the scheduler once per scanned entry — building a fresh key
+        # object each time dominated the host-path profile.
+        if self._key is None:
+            self._key = _RatioKey(self.rewards, self.cost.total)
+        return self._key
 
     def first_sig(self) -> bytes:
         return self.desc.signatures(self.payload)[0]
@@ -104,6 +110,7 @@ class Pack:
         depth: int = 4096,
         limits: BlockLimits | None = None,
         max_txn_per_microblock: int = 31,
+        max_schedule_search: int = 256,
     ):
         if bank_cnt > fc.MAX_BANK_TILES:
             raise ValueError(f"bank_cnt > {fc.MAX_BANK_TILES}")
@@ -111,6 +118,11 @@ class Pack:
         self.depth = depth
         self.limits = limits or BlockLimits()
         self.max_txn_per_microblock = max_txn_per_microblock
+        # bounded scheduling lookahead: scan at most this many pool
+        # entries per microblock (the reference bounds its treap walk the
+        # same way) — an all-conflicting deep pool must not make every
+        # schedule call O(pool)
+        self.max_schedule_search = max_schedule_search
         self._pending: list[OrdTxn] = []  # sorted by _RatioKey
         self._pending_votes: list[OrdTxn] = []
         self._sigs: set[bytes] = set()
@@ -253,13 +265,25 @@ class Pack:
         chosen: list[OrdTxn] = []
         taken_w: set[bytes] = set()
         taken_r: set[bytes] = set()
-        skipped: list[OrdTxn] = []
         mb_cost = 0
         mb_vote_cost = 0
         mb_data = 0
         mb_write_cost: dict[bytes, int] = {}
-        while pool and len(chosen) < self.max_txn_per_microblock:
-            o = pool[0]
+        # scan IN PLACE: skipped entries never move (so they keep their
+        # priority order for free), chosen indices are deleted after the
+        # scan — the pop(0)+re-insort shape was O(pool^2) whenever the
+        # pool ran deep with conflicting txns
+        chosen_idx: list[int] = []
+        i = 0
+        limit = min(len(pool), self.max_schedule_search)
+        while i < len(pool) and len(chosen) < self.max_txn_per_microblock:
+            if i >= limit and chosen:
+                # bounded lookahead only once something was chosen: an
+                # all-unschedulable WINDOW must not starve schedulable
+                # txns sitting past it (the empty case falls through to
+                # a full scan — the pre-bound behavior)
+                break
+            o = pool[i]
             sw, lr, lw = o.acct_sets()
             # conflicts within this microblock too: serial execution inside
             # a microblock is NOT a thing — the bank executes it as one
@@ -272,12 +296,13 @@ class Pack:
                     o, votes, sw, mb_cost, mb_vote_cost, mb_data, mb_write_cost
                 )
             ):
-                skipped.append(pool.pop(0))
+                i += 1
                 continue
-            pool.pop(0)
             self._sigs.discard(o.first_sig())
             self._by_sig.pop(o.first_sig(), None)
             chosen.append(o)
+            chosen_idx.append(i)
+            i += 1
             taken_w |= lw
             taken_r |= lr
             mb_cost += o.cost.total
@@ -286,10 +311,8 @@ class Pack:
             mb_data += len(o.payload)
             for a in sw:
                 mb_write_cost[a] = mb_write_cost.get(a, 0) + o.cost.total
-        # skipped txns go back in order
-        for o in skipped:
-            bisect.insort(pool, o, key=OrdTxn.sort_key)
-            # note: sigs for skipped txns were never discarded
+        for j in reversed(chosen_idx):
+            pool.pop(j)
         if not chosen:
             return []
         # commit locks + block accounting

@@ -57,13 +57,14 @@ def encode_frame(payload: bytes, opcode: int = OP_TEXT) -> bytes:
     return head + payload
 
 
-def decode_frame(buf: bytes) -> tuple[int, bytes, int] | None:
-    """-> (opcode, payload, consumed) or None when `buf` is short.
+def decode_frame(buf: bytes) -> tuple[int, bytes, int, bool] | None:
+    """-> (opcode, payload, consumed, fin) or None when `buf` is short.
     Client frames MUST be masked (RFC 6455 §5.1)."""
     if len(buf) < 2:
         return None
     b0, b1 = buf[0], buf[1]
     opcode = b0 & 0x0F
+    fin = bool(b0 & 0x80)
     masked = bool(b1 & 0x80)
     n = b1 & 0x7F
     off = 2
@@ -87,7 +88,7 @@ def decode_frame(buf: bytes) -> tuple[int, bytes, int] | None:
     off += 4
     payload = bytes(b ^ mask[i % 4] for i, b in enumerate(
         buf[off : off + n]))
-    return opcode, payload, off + n
+    return opcode, payload, off + n, fin
 
 
 class WsConn:
@@ -114,9 +115,17 @@ class WsConn:
             self.open = False
 
     def recv_text(self) -> str | None:
-        """Blocking read of the next text frame; None on close."""
+        """Blocking read of the next complete text MESSAGE (fragmented
+        frames reassembled per §5.4); None on close or protocol error."""
+        fragments: list[bytes] = []
         while self.open:
-            got = decode_frame(self._buf)
+            try:
+                got = decode_frame(self._buf)
+            except WsError:
+                # protocol violation (unmasked/oversized): fail the
+                # connection, never leak the exception to the caller
+                self.close()
+                return None
             if got is None:
                 try:
                     chunk = self.sock.recv(65536)
@@ -128,7 +137,7 @@ class WsConn:
                     return None
                 self._buf += chunk
                 continue
-            opcode, payload, consumed = got
+            opcode, payload, consumed, fin = got
             self._buf = self._buf[consumed:]
             if opcode == OP_CLOSE:
                 try:
@@ -143,8 +152,16 @@ class WsConn:
                 except OSError:
                     self.open = False
                 continue
-            if opcode in (OP_TEXT, OP_BINARY):
-                return payload.decode("utf-8", "replace")
+            if opcode in (OP_TEXT, OP_BINARY) or (
+                opcode == OP_CONT and fragments
+            ):
+                if opcode != OP_CONT and fragments:
+                    self.close()  # new message inside a fragment train
+                    return None
+                fragments.append(payload)
+                if fin:
+                    return b"".join(fragments).decode("utf-8", "replace")
+                # FIN clear: keep collecting continuations
         return None
 
     def close(self) -> None:

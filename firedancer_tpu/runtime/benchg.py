@@ -65,8 +65,13 @@ class BenchGStage(Stage):
         self._i = 0
 
     def after_credit(self) -> None:
-        if self.limit is not None and self._i >= self.limit:
-            return
-        if self.publish(0, self.pool[self._i % len(self.pool)], sig=self._i):
+        # burst-publish: one txn per sweep starves the burst-draining
+        # consumers downstream (stage.py run_once)
+        for _ in range(max(1, self.burst)):
+            if self.limit is not None and self._i >= self.limit:
+                return
+            if not self.publish(0, self.pool[self._i % len(self.pool)],
+                                sig=self._i):
+                return
             self._i += 1
             self.metrics.inc("txn_gen")

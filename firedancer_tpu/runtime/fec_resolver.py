@@ -160,8 +160,12 @@ class FecResolver:
         # against the set's signed root in add_shred, so a full set needs
         # neither the RS solve nor a tree rebuild (profiled: recover was
         # ~40% of the leader store path, and every call on a fresh shape
-        # recompiles)
-        if len(data_have) == d and len(ctx.code) == p:
+        # recompiles).  ALL DATA present is enough — the entry batch is
+        # whole and any parity still in flight arrives as duplicates; an
+        # RS solve with zero missing data would only re-derive parity the
+        # wire already carries (the leader's own store hits this path on
+        # every set, since data shreds are emitted before parity)
+        if len(data_have) == d:
             del self._sets[key]
             self._done[key] = None
             while len(self._done) > self.done_depth:
@@ -169,7 +173,8 @@ class FecResolver:
             self.metrics["sets_completed"] += 1
             return FecSet(
                 data_shreds=[bytes(data_have[pos]) for pos in range(d)],
-                parity_shreds=[bytes(ctx.code[c]) for c in range(p)],
+                parity_shreds=[bytes(ctx.code[c])
+                               for c in sorted(ctx.code) if c < p],
                 merkle_root=ctx.merkle_root,
                 slot=slot,
                 fec_set_idx=fec_set_idx,

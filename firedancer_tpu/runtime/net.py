@@ -312,14 +312,17 @@ class QuicIngressStage(UdpIngressStage):
             if len(self.conns) >= self.max_conns and not self._evict():
                 self.metrics.inc("conn_drop")
                 return True
-            conn = quic.Connection.server_new(self.identity_secret)
-            if not self.retry_required:
+            if not self.retry_required and src not in self._addr_budget:
                 # no token validation: the 3x budget guards this address
-                # until its handshake completes.  Bounded: spoofed-source
-                # sprays must not grow this dict without limit
+                # until its handshake completes.  FAIL CLOSED when the
+                # tracking table is full — evicting an unvalidated entry
+                # would exempt that path from the cap (the amplification
+                # hole), so the NEW address is refused service instead
                 if len(self._addr_budget) >= 4 * self.max_conns:
-                    self._addr_budget.pop(next(iter(self._addr_budget)))
-                self._addr_budget.setdefault(src, [0, 0])
+                    self.metrics.inc("addr_budget_full_drop")
+                    return True
+                self._addr_budget[src] = [0, 0]
+            conn = quic.Connection.server_new(self.identity_secret)
         if src in self._addr_budget:
             self._addr_budget[src][0] += len(data)
             if conn is not None and conn.established:

@@ -188,6 +188,8 @@ class RpcServer:
         self._subs: dict[int, tuple] = {}
         self._subs_lock = threading.Lock()
         self._next_sub = 1
+        # slot -> parsed (blockhash, txns) LRU for the block surface
+        self._block_cache: dict = {}
         self._srv = H.MiniServer(handler, host=host, port=port,
                                  max_body=J.MAX_LEN,
                                  ws_handler=self._ws_handler)
@@ -498,8 +500,9 @@ class RpcServer:
                 out = []
                 from firedancer_tpu.protocol import txn as _ft
 
-                for slot in sorted(self.view.block_slots(), reverse=True):
-                    got = self.view.block(slot)
+                for slot in sorted(self.view.block_slots(),
+                                   reverse=True)[: self.FIND_TXN_SCAN_SLOTS]:
+                    got = self._cached_block(slot)
                     if got is None:
                         continue
                     for p in got[1]:
@@ -557,19 +560,34 @@ class RpcServer:
             "signatures": [b58_encode(s) for s in sigs],
         }
 
+    FIND_TXN_SCAN_SLOTS = 128  # fallback scan bound (newest first)
+
+    def _cached_block(self, slot: int):
+        """view.block() behind a small LRU: getTransaction/
+        getSignaturesForAddress must not deshred + reparse a block per
+        request (an O(ledger) request would saturate the server)."""
+        got = self._block_cache.get(slot)
+        if got is None and slot not in self._block_cache:
+            got = self.view.block(slot)
+            self._block_cache[slot] = got
+            while len(self._block_cache) > 64:
+                self._block_cache.pop(next(iter(self._block_cache)))
+        return got
+
     def _find_txn(self, sig: bytes):
-        """-> (slot, payload) via the status cache's signature index,
-        falling back to a bounded blockstore scan."""
+        """-> (slot, payload) via the status cache's signature index;
+        the index-miss fallback scans only the newest
+        FIND_TXN_SCAN_SLOTS blocks."""
         from firedancer_tpu.protocol import txn as _ft
 
-        slots = None
         sc = self.view.status_cache
         if sc is not None and sig in getattr(sc, "by_sig", {}):
             slots = sorted(sc.by_sig[sig])
-        if slots is None:
-            slots = sorted(self.view.block_slots())
+        else:
+            slots = sorted(self.view.block_slots(),
+                           reverse=True)[: self.FIND_TXN_SCAN_SLOTS]
         for slot in slots:
-            got = self.view.block(slot)
+            got = self._cached_block(slot)
             if got is None:
                 continue
             for p in got[1]:

@@ -847,9 +847,13 @@ class Connection:
                 self.closed = True
             return events
         if self.is_client and not self.established and \
+                not self._processed_any and \
                 len(datagram) > 5 and datagram[0] & 0x80 and \
                 (datagram[0] >> 4) & 3 == LONG_RETRY and \
                 packet_version(datagram) == QUIC_V1:
+            # §17.2.5.2: a Retry is honored only before ANY packet has
+            # been processed — Initial keys are wire-derivable, so a
+            # later forged Retry could otherwise wedge the handshake
             self._handle_retry(datagram, now)
             return events
         off = 0
