@@ -159,6 +159,12 @@ class WsConn:
                     self.close()  # new message inside a fragment train
                     return None
                 fragments.append(payload)
+                if sum(len(f) for f in fragments) > MAX_FRAME:
+                    # the per-frame cap must also bound the reassembled
+                    # MESSAGE, or an endless non-FIN train OOMs the
+                    # per-connection thread
+                    self.close()
+                    return None
                 if fin:
                     return b"".join(fragments).decode("utf-8", "replace")
                 # FIN clear: keep collecting continuations

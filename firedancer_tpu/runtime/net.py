@@ -315,13 +315,22 @@ class QuicIngressStage(UdpIngressStage):
             if not self.retry_required and src not in self._addr_budget:
                 # no token validation: the 3x budget guards this address
                 # until its handshake completes.  FAIL CLOSED when the
-                # tracking table is full — evicting an unvalidated entry
-                # would exempt that path from the cap (the amplification
-                # hole), so the NEW address is refused service instead
+                # tracking table is full — evicting a LIVE unvalidated
+                # entry would exempt that path from the cap (the
+                # amplification hole) — but entries past the handshake
+                # deadline are dead weight and reclaimable, else a spray
+                # of spoofed Initials locks out new clients forever
+                import time as _t
+
+                now = _t.monotonic()
+                if len(self._addr_budget) >= 4 * self.max_conns:
+                    for a in [a for a, b in self._addr_budget.items()
+                              if now - b[2] > 30.0]:
+                        del self._addr_budget[a]
                 if len(self._addr_budget) >= 4 * self.max_conns:
                     self.metrics.inc("addr_budget_full_drop")
                     return True
-                self._addr_budget[src] = [0, 0]
+                self._addr_budget[src] = [0, 0, now]
             conn = quic.Connection.server_new(self.identity_secret)
         if src in self._addr_budget:
             self._addr_budget[src][0] += len(data)

@@ -520,6 +520,56 @@ class RpcServer:
                         if len(out) >= limit:
                             return ok(out)
                 return ok(out)
+            if method == "getProgramAccounts":
+                owner = dec(b58_decode32, params[0])
+                import base64 as b64
+
+                funk = self.view.funk
+                if funk is None:
+                    return ok([])
+                from firedancer_tpu.flamenco.executor import acct_decode
+
+                out = []
+                for key in funk.rec_keys(None):
+                    val = funk.rec_query(None, key)
+                    lam, own, ex, dat = acct_decode(val)
+                    if own != owner or lam == 0:
+                        continue
+                    out.append({
+                        "pubkey": b58_encode32(key),
+                        "account": {
+                            "lamports": lam,
+                            "owner": b58_encode32(own),
+                            "executable": ex,
+                            "rentEpoch": 0,
+                            "data": [b64.b64encode(dat).decode(), "base64"],
+                        },
+                    })
+                    if len(out) >= 10_000:
+                        break  # bounded response (the reference caps too)
+                return ok(out)
+            if method == "getInflationGovernor":
+                # the protocol's default inflation schedule parameters
+                return ok({
+                    "initial": 0.08, "terminal": 0.015, "taper": 0.15,
+                    "foundation": 0.05, "foundationTerm": 7.0,
+                })
+            if method == "getInflationRate":
+                from firedancer_tpu.flamenco.types import EpochSchedule
+
+                sched = EpochSchedule()
+                epoch = self.view.slot() // max(sched.slots_per_epoch, 1)
+                # years elapsed at ~2 epochs/day default schedule; the
+                # taper formula: rate = initial * (1-taper)^years,
+                # floored at terminal
+                years = epoch * sched.slots_per_epoch / 78892314.984
+                total = max(0.08 * ((1 - 0.15) ** years), 0.015)
+                return ok({
+                    "total": total,
+                    "validator": total * 0.95,
+                    "foundation": total * 0.05,
+                    "epoch": epoch,
+                })
             if method in ("slotSubscribe", "accountSubscribe",
                           "signatureSubscribe", "slotUnsubscribe",
                           "accountUnsubscribe", "signatureUnsubscribe"):
@@ -567,11 +617,17 @@ class RpcServer:
         getSignaturesForAddress must not deshred + reparse a block per
         request (an O(ledger) request would saturate the server)."""
         got = self._block_cache.get(slot)
-        if got is None and slot not in self._block_cache:
+        if got is None:
             got = self.view.block(slot)
-            self._block_cache[slot] = got
-            while len(self._block_cache) > 64:
-                self._block_cache.pop(next(iter(self._block_cache)))
+            if got is not None:
+                # NEVER cache a miss: a slot still in the store window
+                # completes later, and a cached None would make
+                # getTransaction return null for a landed txn forever
+                self._block_cache[slot] = got
+                while len(self._block_cache) > 64:
+                    # threads race here: pop defensively
+                    self._block_cache.pop(
+                        next(iter(self._block_cache)), None)
         return got
 
     def _find_txn(self, sig: bytes):

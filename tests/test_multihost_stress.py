@@ -1,3 +1,7 @@
+import pytest
+
+pytestmark = pytest.mark.slow  # multichip shard compiles (see conftest)
+
 """Multi-host topology helpers (single-host degenerate mode) + shm ring
 race stress (threads hammering the BUSY-bit publish/poll protocol)."""
 

@@ -6,6 +6,8 @@ import time
 
 import pytest
 
+pytestmark = pytest.mark.slow  # XLA-compile/socket-heavy tier (see conftest)
+
 from firedancer_tpu.runtime.benchg import gen_transfer_pool
 from firedancer_tpu.runtime.net import UdpIngressStage, send_txns
 from firedancer_tpu.runtime.verify import VerifyStage, decode_verified

@@ -8,6 +8,10 @@ import time
 from firedancer_tpu.ops.ref import ed25519_ref as ref
 from firedancer_tpu.tango import shm
 
+import pytest
+
+pytestmark = pytest.mark.slow  # XLA-compile/socket-heavy tier (see conftest)
+
 
 def test_quic_ingress_delivers_over_10pct_loss():
     from firedancer_tpu.runtime.net import QuicIngressStage, QuicTxnClient

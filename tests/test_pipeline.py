@@ -170,6 +170,7 @@ def test_duplicates_are_dropped():
         pipe.close()
 
 
+@pytest.mark.slow  # second kernel shape (batch=32) = a second compile
 def test_two_way_verify_fanout():
     pipe = build_leader_pipeline(
         n_verify=2, pool_size=64, gen_limit=64, batch=32, max_msg_len=256

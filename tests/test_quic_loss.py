@@ -10,6 +10,8 @@ import hashlib
 
 import pytest
 
+pytestmark = pytest.mark.slow  # XLA-compile/socket-heavy tier (see conftest)
+
 from firedancer_tpu.waltz import quic
 from firedancer_tpu.ops.ref import ed25519_ref as ref
 

@@ -437,3 +437,24 @@ def test_ws_account_subscription(block_server):
         assert note["params"]["result"]["value"]["lamports"] == 909
     finally:
         c.close()
+
+
+def test_get_program_accounts_and_inflation(server):
+    from firedancer_tpu.flamenco import bpf_loader as bl
+    from firedancer_tpu.flamenco.runtime import acct_build
+
+    srv, pub = server
+    owner = bl.UPGRADEABLE_LOADER_PROGRAM
+    k1 = hashlib.sha256(b"gpa1").digest()
+    k2 = hashlib.sha256(b"gpa2").digest()
+    srv.view.funk.rec_insert(None, k1, acct_build(5, data=b"x", owner=owner))
+    srv.view.funk.rec_insert(None, k2, acct_build(6, data=b"y", owner=owner))
+    got = rpc_call(srv.addr, "getProgramAccounts",
+                   [b58_encode(owner)])["result"]
+    assert {a["pubkey"] for a in got} == {b58_encode(k1), b58_encode(k2)}
+    assert all(a["account"]["lamports"] in (5, 6) for a in got)
+    gov = rpc_call(srv.addr, "getInflationGovernor")["result"]
+    assert gov["initial"] == 0.08
+    rate = rpc_call(srv.addr, "getInflationRate")["result"]
+    assert 0.015 <= rate["total"] <= 0.08
+    assert abs(rate["validator"] + rate["foundation"] - rate["total"]) < 1e-9

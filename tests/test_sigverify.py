@@ -10,6 +10,10 @@ import numpy as np
 from firedancer_tpu.ops import sigverify as sv
 from firedancer_tpu.ops.ref import ed25519_ref as ref
 
+import pytest
+
+pytestmark = pytest.mark.slow  # XLA-compile/socket-heavy tier (see conftest)
+
 MAX_MSG = 128
 
 

@@ -24,6 +24,7 @@ import re
 import numpy as np
 import pytest
 
+
 from firedancer_tpu.ops.ref import ed25519_ref as ref
 
 REF_DIR = "/root/reference/src/ballet/ed25519"
@@ -34,7 +35,8 @@ MALLEABILITY = {
 }
 
 pytestmark = pytest.mark.skipif(
-    not os.path.exists(WYCHEPROOF_C), reason="reference fixture tree not mounted"
+    not os.path.exists(WYCHEPROOF_C),
+    reason="reference fixture tree not mounted",
 )
 
 
@@ -130,6 +132,7 @@ def _kernel_verdicts(cases, max_msg_len=64):
     return np.asarray(out).astype(bool)
 
 
+@pytest.mark.slow  # fresh sigverify compile (see conftest)
 def test_wycheproof_tpu_kernel():
     vecs = [v for v in load_wycheproof() if len(v[1]) <= 64]
     verdicts = _kernel_verdicts([(m, s, p) for _, m, s, p, _ in vecs])
@@ -141,6 +144,7 @@ def test_wycheproof_tpu_kernel():
     assert not bad, f"TPU kernel diverges from Wycheproof on tc_ids {bad}"
 
 
+@pytest.mark.slow  # fresh sigverify compile (see conftest)
 def test_malleability_tpu_kernel():
     msg = b"Zcash"
     cases = []
