@@ -315,3 +315,97 @@ def apply_rewards(accounts: dict[bytes, "object"], rewards: dict[bytes, int]):
         if st.state == STATE_DELEGATED:
             st.stake += amount
             a.data[:_DATA_LEN] = st.encode()
+
+
+# -- partitioned rewards distribution -----------------------------------------
+# The reference distributes epoch rewards over the first slots of the new
+# epoch instead of one giant slot-boundary write burst
+# (/root/reference/src/flamenco/runtime/sysvar/fd_sysvar_epoch_rewards.h +
+# fd_rewards.c partitioned path; Agave's epoch_rewards partitioning).
+# Accounts hash into partitions; partition i pays out in slot
+# epoch_start + 1 + i; the EpochRewards sysvar stays `active` until the
+# last partition lands.
+
+PARTITION_TARGET_ACCOUNTS = 4096  # Agave's per-partition sizing target
+
+
+def reward_partition_count(n_accounts: int) -> int:
+    return max(1, (n_accounts + PARTITION_TARGET_ACCOUNTS - 1)
+               // PARTITION_TARGET_ACCOUNTS)
+
+
+def reward_partition_of(stake_key: bytes, n_partitions: int,
+                        parent_blockhash: bytes) -> int:
+    """Deterministic partition assignment: hash(address, seed) — every
+    validator derives the same schedule from the epoch-boundary state."""
+    import hashlib as _hl
+
+    digest = _hl.sha256(b"epoch-rewards-partition:" + parent_blockhash
+                        + stake_key).digest()
+    return int.from_bytes(digest[:8], "little") % n_partitions
+
+
+def partition_rewards(
+    rewards: dict[bytes, int],
+    parent_blockhash: bytes,
+) -> list[dict[bytes, int]]:
+    """Split a computed reward set into per-slot payout partitions."""
+    n = reward_partition_count(len(rewards))
+    parts: list[dict[bytes, int]] = [{} for _ in range(n)]
+    for key, amount in rewards.items():
+        parts[reward_partition_of(key, n, parent_blockhash)][key] = amount
+    return parts
+
+
+def epoch_rewards_sysvar(
+    *,
+    distribution_starting_block_height: int,
+    num_partitions: int,
+    parent_blockhash: bytes,
+    total_points: int,
+    total_rewards: int,
+    distributed_rewards: int,
+    active: bool,
+) -> bytes:
+    """The EpochRewards sysvar blob (the layout runtime.default_sysvars
+    zero-fills when no distribution is in flight)."""
+    return (
+        distribution_starting_block_height.to_bytes(8, "little")
+        + num_partitions.to_bytes(8, "little")
+        + parent_blockhash
+        + total_points.to_bytes(16, "little")
+        + total_rewards.to_bytes(8, "little")
+        + distributed_rewards.to_bytes(8, "little")
+        + (b"\x01" if active else b"\x00")
+    )
+
+
+def distribute_reward_partition(
+    funk,
+    xid: bytes | None,
+    partition: dict[bytes, int],
+) -> int:
+    """Pay out ONE partition onto funk accounts with the compounding
+    rule — slot epoch_start+1+i pays exactly partitions[i], so calling
+    once per slot can never double-pay.  Accounts that vanished between
+    reward computation and payout are SKIPPED (paying a missing record
+    would mint lamports into a fresh system account).  Returns lamports
+    paid."""
+    from firedancer_tpu.flamenco.executor import acct_decode, acct_encode
+
+    paid = 0
+    for key, amount in partition.items():
+        val = funk.rec_query(xid, key)
+        if val is None:
+            continue  # closed since the epoch boundary: no destination
+        lam, owner, ex, data = acct_decode(val)
+        data = bytearray(data)
+        if len(data) >= _DATA_LEN:
+            st = StakeState.decode(bytes(data))
+            if st.state == STATE_DELEGATED:
+                st.stake += amount
+                data[:_DATA_LEN] = st.encode()
+        funk.rec_insert(xid, key,
+                        acct_encode(lam + amount, owner, ex, bytes(data)))
+        paid += amount
+    return paid

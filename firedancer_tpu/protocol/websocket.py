@@ -118,6 +118,7 @@ class WsConn:
         """Blocking read of the next complete text MESSAGE (fragmented
         frames reassembled per §5.4); None on close or protocol error."""
         fragments: list[bytes] = []
+        frag_total = 0
         while self.open:
             try:
                 got = decode_frame(self._buf)
@@ -159,10 +160,11 @@ class WsConn:
                     self.close()  # new message inside a fragment train
                     return None
                 fragments.append(payload)
-                if sum(len(f) for f in fragments) > MAX_FRAME:
-                    # the per-frame cap must also bound the reassembled
-                    # MESSAGE, or an endless non-FIN train OOMs the
-                    # per-connection thread
+                frag_total += len(payload)
+                # bound BOTH bytes and fragment count: an endless train
+                # of zero-length non-FIN continuations must not grow the
+                # list (memory) or re-sum it (CPU) forever
+                if frag_total > MAX_FRAME or len(fragments) > 1024:
                     self.close()
                     return None
                 if fin:

@@ -210,7 +210,8 @@ class QuicIngressStage(UdpIngressStage):
         self._reset_key = static
         # §8: until an address is validated, send at most 3x what it
         # sent us (tracked only pre-handshake; validated addrs drop out)
-        self._addr_budget: dict = {}   # src -> [rx_bytes, tx_bytes]
+        # src -> [rx_bytes, tx_bytes, created_monotonic_s]
+        self._addr_budget: dict = {}
 
     def _send(self, dg: bytes, dst) -> None:
         if self.tx_filter is not None and not self.tx_filter(dg):
@@ -324,8 +325,13 @@ class QuicIngressStage(UdpIngressStage):
 
                 now = _t.monotonic()
                 if len(self._addr_budget) >= 4 * self.max_conns:
+                    # reclaim only DEAD weight: entries past the
+                    # handshake deadline with no live conn — purging a
+                    # tracked conn's entry would lift its cap while PTO
+                    # keeps retransmitting to that (possibly spoofed)
+                    # address
                     for a in [a for a, b in self._addr_budget.items()
-                              if now - b[2] > 30.0]:
+                              if now - b[2] > 30.0 and a not in self.conns]:
                         del self._addr_budget[a]
                 if len(self._addr_budget) >= 4 * self.max_conns:
                     self.metrics.inc("addr_budget_full_drop")

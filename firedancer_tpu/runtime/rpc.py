@@ -522,8 +522,6 @@ class RpcServer:
                 return ok(out)
             if method == "getProgramAccounts":
                 owner = dec(b58_decode32, params[0])
-                import base64 as b64
-
                 funk = self.view.funk
                 if funk is None:
                     return ok([])
@@ -542,7 +540,7 @@ class RpcServer:
                             "owner": b58_encode32(own),
                             "executable": ex,
                             "rentEpoch": 0,
-                            "data": [b64.b64encode(dat).decode(), "base64"],
+                            "data": [base64.b64encode(dat).decode(), "base64"],
                         },
                     })
                     if len(out) >= 10_000:
