@@ -1,0 +1,44 @@
+"""fdlint — the framework's pre-boot static analyzer.
+
+The reference validates its topology at CONFIGURATION time: fd_topob
+(/root/reference/src/disco/topo/fd_topob.c) checks every link's wiring —
+one producer, known consumers, sane depths — before a single tile boots,
+and the hot-loop discipline of the tiles (no syscalls, no allocation in
+the frag path) is enforced by construction in C.  This reproduction
+encodes the same invariants in Python, where nothing enforces them: a
+stray `.item()` in a frag callback silently serializes the pipeline
+against the device, and a mis-wired link only fails at runtime deep
+inside a spawned child.
+
+fdlint closes that gap with two halves sharing one rule framework:
+
+  - the **topology checker** (`topo_check.check_topology`) validates a
+    `Topology` object's declarative link graph without launching it —
+    run from `runtime/topo.launch()` before any shm is created, and
+    from the CLI against an imported topology factory;
+  - the **AST lint pass** (`ast_rules.lint_path`) walks the package
+    source for repo-specific hot-path violations (host syncs in frag
+    callbacks, unseeded randomness, un-picklable stage builders).
+
+CLI:  python -m firedancer_tpu.analysis firedancer_tpu/
+      python -m firedancer_tpu.analysis --list-rules
+
+Findings carry stable rule IDs (FD1xx topology, FD2xx AST).  Deliberate
+violations are suppressed inline (`# fdlint: disable=FDxxx -- reason`);
+pre-existing ones are grandfathered in `analysis/baseline.toml`.  See
+docs/ANALYSIS.md for every rule's rationale.
+"""
+
+from __future__ import annotations
+
+from .framework import Finding, Rule, all_rules, get_rule
+from .topo_check import TopologyError, check_topology
+
+__all__ = [
+    "Finding",
+    "Rule",
+    "TopologyError",
+    "all_rules",
+    "check_topology",
+    "get_rule",
+]

@@ -1,0 +1,322 @@
+"""AST lint pass: the hot-loop + spawn discipline rules (FD2xx).
+
+The frag callbacks (`before_frag` / `during_frag` / `after_frag`) are the
+per-frag hot path of every stage (runtime/stage.py run_once): anything
+per-frag that blocks on the device or enters the kernel is multiplied by
+ingress rate.  The reference gets this discipline for free — its tiles
+are C loops with no allocator and no syscalls in the frag path — so the
+linter is where this codebase encodes the same rule.
+
+Scope notes (deliberate):
+  - FD201/FD202 look at the DIRECT bodies of functions named like frag
+    callbacks (any class; nested calls are not traced — keep helpers
+    called from frag paths clean by keeping the callbacks thin);
+  - `float(...)` only counts as a host sync when its argument is not a
+    literal/constant expression (e.g. `float(mask[i])` on a device array
+    blocks; `float("inf")` does not);
+  - suppression is per-line: `# fdlint: disable=FD204 -- reason`, with
+    multiple IDs comma-separated.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from .framework import Finding
+
+FRAG_CALLBACKS = frozenset({"before_frag", "during_frag", "after_frag"})
+
+# FD201: attribute calls that force a device->host sync on jax arrays
+_SYNC_ATTRS = frozenset({"item", "block_until_ready"})
+# FD201: module-level calls that materialize a device array on host
+# (canonical module names; import aliasing is resolved before matching)
+_SYNC_CALLS = frozenset({
+    ("jax", "device_get"),
+    ("np", "asarray"),
+    ("np", "array"),
+    ("jnp", "asarray"),  # per-frag host->device transfer: same cost class
+})
+# FD202: wall-clock reads
+_CLOCK_CALLS = frozenset({
+    "time", "monotonic", "monotonic_ns", "perf_counter", "perf_counter_ns",
+    "time_ns", "clock_gettime",
+})
+# FD203: process-global random module entry points (instances are fine)
+_RANDOM_GLOBALS = frozenset({
+    "random", "randrange", "randint", "uniform", "choice", "choices",
+    "shuffle", "sample", "getrandbits", "randbytes", "gauss", "betavariate",
+    "expovariate", "normalvariate", "seed",
+})
+
+_DISABLE_RE = re.compile(r"#\s*fdlint:\s*disable=([A-Z0-9, ]+)")
+
+
+def _disabled_lines(source: str) -> dict[int, set[str]]:
+    """line -> rule IDs inline-suppressed on that line."""
+    out: dict[int, set[str]] = {}
+    for i, text in enumerate(source.splitlines(), start=1):
+        m = _DISABLE_RE.search(text)
+        if m:
+            out[i] = {t.strip() for t in m.group(1).split(",") if t.strip()}
+    return out
+
+
+def _dotted(node: ast.AST) -> tuple[str, ...] | None:
+    """`a.b.c` -> ("a","b","c"); None for non-name chains."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return None
+
+
+# canonical short names the rule tables are written against
+_MOD_CANON = {
+    "numpy": "np", "np": "np",
+    "jax.numpy": "jnp", "jnp": "jnp",
+    "jax": "jax", "time": "time", "random": "random",
+}
+
+
+def _import_aliases(tree: ast.Module):
+    """Resolve import aliasing so `import numpy as xp` / `from time
+    import monotonic as mono` cannot evade the module-call rules.
+
+    Returns (mod_alias -> canonical short name,
+             bare name -> (canonical module, original func name))."""
+    mods: dict[str, str] = {}
+    funcs: dict[str, tuple[str, str]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                canon = _MOD_CANON.get(a.name)
+                if canon:
+                    mods[a.asname or a.name.split(".")[0]] = canon
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            canon = _MOD_CANON.get(node.module)
+            if canon:
+                for a in node.names:
+                    funcs[a.asname or a.name] = (canon, a.name)
+    return mods, funcs
+
+
+def _local_defs(fn: ast.AST) -> set[str]:
+    """Function names bound in fn's OWN scope: descend into compound
+    statements (if/for/try/with) but not into nested class or function
+    bodies, whose defs are not visible as fn-locals."""
+    out: set[str] = set()
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.add(node.name)  # the binding is local; its body is not
+        elif isinstance(node, (ast.ClassDef, ast.Lambda)):
+            pass  # opaque inner scope
+        else:
+            stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+class _Linter(ast.NodeVisitor):
+    def __init__(self, path: str, mods=None, funcs=None):
+        self.path = path
+        self.findings: list[Finding] = []
+        self._frag_depth = 0  # >0 while inside a frag-callback body
+        self._func_stack: list[ast.FunctionDef] = []
+        self._mods = mods or {}  # import alias -> canonical module
+        self._funcs = funcs or {}  # from-imported name -> (module, func)
+
+    def _resolve(self, node: ast.Call) -> tuple[str, str] | None:
+        """Canonical (module, func) for a call, seeing through `import
+        numpy as xp` and `from time import monotonic as mono`."""
+        dq = _dotted(node.func)
+        if dq is None:
+            return None
+        if len(dq) == 1:
+            return self._funcs.get(dq[0])
+        if len(dq) == 3 and dq[:2] == ("jax", "numpy"):
+            return ("jnp", dq[2])
+        if len(dq) == 2:
+            canon = self._mods.get(dq[0]) or _MOD_CANON.get(dq[0])
+            if canon:
+                return (canon, dq[1])
+        return None
+
+    def hit(self, rule: str, node: ast.AST, msg: str) -> None:
+        self.findings.append(Finding(
+            rule=rule, path=self.path,
+            line=getattr(node, "lineno", 0), msg=msg,
+        ))
+
+    # -- scope tracking -----------------------------------------------------
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        is_frag = node.name in FRAG_CALLBACKS and self._in_class()
+        self._func_stack.append(node)
+        if is_frag:
+            self._frag_depth += 1
+        self.generic_visit(node)
+        if is_frag:
+            self._frag_depth -= 1
+        self._func_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _in_class(self) -> bool:
+        # frag callbacks are methods; a free function named after_frag is
+        # someone's helper, not the hot path
+        return bool(self._class_depth)
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._class_depth += 1
+        self.generic_visit(node)
+        self._class_depth -= 1
+
+    _class_depth = 0
+
+    # -- rules --------------------------------------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        mf = self._resolve(node)
+        if self._frag_depth:
+            self._check_frag_call(node, mf)
+        if mf and mf[0] == "random" and mf[1] in _RANDOM_GLOBALS:
+            self.hit("FD203", node,
+                     f"process-global random.{mf[1]}() — use a seeded"
+                     " utils/rng.Rng or random.Random instance")
+        if isinstance(node.func, ast.Name) and node.func.id == "hash" \
+                and len(node.args) == 1:
+            self.hit("FD204", node,
+                     "builtin hash() is salted per process"
+                     " (PYTHONHASHSEED); use zlib.crc32/hashlib for"
+                     " stable values")
+        self._check_builder_arg(node)
+        self.generic_visit(node)
+
+    def _check_frag_call(self, node: ast.Call,
+                         mf: tuple[str, str] | None) -> None:
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _SYNC_ATTRS:
+            self.hit("FD201", node,
+                     f".{node.func.attr}() in a frag callback blocks the"
+                     " stage on the device per frag")
+        if mf and mf in _SYNC_CALLS:
+            self.hit("FD201", node,
+                     f"{'.'.join(mf)}() in a frag callback forces a"
+                     " device->host transfer per frag")
+        if isinstance(node.func, ast.Name) and node.func.id == "float" \
+                and node.args \
+                and not isinstance(node.args[0], ast.Constant):
+            self.hit("FD201", node,
+                     "float(x) on a non-constant in a frag callback: if x"
+                     " is a device scalar this is a blocking sync")
+        if mf and mf[0] == "time" and mf[1] in _CLOCK_CALLS:
+            self.hit("FD202", node,
+                     f"time.{mf[1]}() in a frag callback; stamp deadlines"
+                     " in before_credit/during_housekeeping instead"
+                     " (after_credit is skipped under backpressure)")
+
+    def _check_builder_arg(self, node: ast.Call) -> None:
+        """FD205: `<topo>.stage(name, builder, ...)` / `StageSpec(name,
+        builder, ...)` with a builder that cannot pickle under spawn."""
+        is_stage_call = (
+            isinstance(node.func, ast.Attribute) and node.func.attr == "stage"
+        ) or (isinstance(node.func, ast.Name) and node.func.id == "StageSpec")
+        if not is_stage_call:
+            return
+        builder = None
+        if len(node.args) >= 2:
+            builder = node.args[1]
+        else:
+            for kw in node.keywords:
+                if kw.arg == "builder":
+                    builder = kw.value
+        if builder is None:
+            return
+        if isinstance(builder, ast.Lambda):
+            self.hit("FD205", builder,
+                     "lambda stage builder will not pickle under spawn;"
+                     " use a module-level function + StageSpec.kwargs")
+            return
+        bq = _dotted(builder)
+        if bq and bq[-1] == "partial" or (
+            isinstance(builder, ast.Call)
+            and (_dotted(builder.func) or ("",))[-1] == "partial"
+        ):
+            self.hit("FD205", builder,
+                     "functools.partial builder may not pickle under"
+                     " spawn; use a module-level function + kwargs")
+            return
+        if isinstance(builder, ast.Name):
+            # a name bound to a def in an enclosing function's LOCAL
+            # scope is a closure: flag it.  Only local bindings count —
+            # defs inside nested classes/functions don't shadow the
+            # module-level builder the Name actually resolves to.
+            for fn in self._func_stack:
+                if builder.id in _local_defs(fn):
+                    self.hit("FD205", builder,
+                             f"builder '{builder.id}' is defined inside"
+                             f" '{fn.name}' and will not pickle under"
+                             " spawn")
+                    return
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        bare = node.type is None or (
+            isinstance(node.type, ast.Name)
+            and node.type.id == "BaseException"
+        )
+        if bare:
+            reraises = any(
+                isinstance(n, ast.Raise) and n.exc is None
+                for n in ast.walk(node)
+            )
+            if not reraises:
+                self.hit("FD206", node,
+                         "bare except without re-raise swallows"
+                         " KeyboardInterrupt/SystemExit (the topology"
+                         " teardown path)")
+        self.generic_visit(node)
+
+
+def lint_source(source: str, path: str) -> list[Finding]:
+    """All findings for one file; inline suppressions are MARKED (not
+    dropped) so reports can show what a disable comment ate."""
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding(rule="FD200", path=path, line=e.lineno or 0,
+                        msg=f"file does not parse: {e.msg}")]
+    mods, funcs = _import_aliases(tree)
+    linter = _Linter(path, mods, funcs)
+    linter.visit(tree)
+    disabled = _disabled_lines(source)
+    for f in linter.findings:
+        ids = disabled.get(f.line)
+        if ids and f.rule in ids:
+            f.suppressed = "inline"
+    return linter.findings
+
+
+def lint_file(path: str) -> list[Finding]:
+    with open(path, encoding="utf-8") as fh:
+        return lint_source(fh.read(), path)
+
+
+def lint_path(root: str) -> list[Finding]:
+    """Lint a file or a package tree (every .py under root)."""
+    if os.path.isfile(root):
+        return lint_file(root)
+    findings: list[Finding] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(
+            d for d in dirnames if d not in {"__pycache__", ".git"}
+        )
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                findings.extend(lint_file(os.path.join(dirpath, fn)))
+    return findings

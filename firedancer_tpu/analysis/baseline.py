@@ -1,0 +1,108 @@
+"""Baseline suppression: grandfather pre-existing findings.
+
+The baseline file (analysis/baseline.toml) holds per-(path, rule)
+violation COUNTS, not line numbers — lines churn on every edit, counts
+only change when violations are added or removed.  Semantics match the
+usual ratchet: up to `count` findings of `rule` in `path` are marked
+suppressed="baseline"; the (count+1)-th is a NEW violation and fails the
+run.  Fixing a grandfathered violation without shrinking the baseline is
+fine (stale entries are reported by `--write-baseline`, which emits the
+minimal current file).
+
+Parsed with the framework's own TOML parser (protocol/toml.py) — the
+analyzer must run on machines with nothing installed, same constraint
+that made the reference vendor its TOML reader.
+
+Schema:
+
+    [[suppress]]
+    path = "firedancer_tpu/runtime/foo.py"
+    rule = "FD202"
+    count = 1
+    reason = "why this is deliberate or deferred"
+"""
+
+from __future__ import annotations
+
+import os
+
+from .framework import Finding
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.toml")
+
+
+def load_baseline(path: str | None = None) -> dict[tuple[str, str], int]:
+    """(path, rule) -> allowed count.  Missing file = empty baseline."""
+    path = path or DEFAULT_BASELINE
+    if not os.path.exists(path):
+        return {}
+    from firedancer_tpu.protocol import toml
+
+    with open(path, encoding="utf-8") as fh:
+        data = toml.loads(fh.read())
+    out: dict[tuple[str, str], int] = {}
+    for ent in data.get("suppress", []):
+        key = (_norm(ent["path"]), str(ent["rule"]))
+        out[key] = out.get(key, 0) + int(ent.get("count", 1))
+    return out
+
+
+def _norm(p: str) -> str:
+    """Match baseline entries regardless of how the linter was invoked:
+    forward slashes, and absolute paths rewritten relative to the repo
+    root (the package's parent) when they live under it."""
+    if os.path.isabs(p):
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        try:
+            rel = os.path.relpath(p, root)
+        except ValueError:  # pragma: no cover - windows drive mismatch
+            rel = p
+        if not rel.startswith(".."):
+            p = rel
+    return p.replace(os.sep, "/")
+
+
+def apply_baseline(
+    findings: list[Finding], baseline: dict[tuple[str, str], int]
+) -> None:
+    """Mark up to baseline[key] not-already-suppressed findings per key
+    as suppressed='baseline' (stable order: findings come sorted by
+    path/line from the checkers, so the grandfathered ones are the
+    earliest in the file)."""
+    budget = dict(baseline)
+    for f in findings:
+        if f.suppressed:
+            continue
+        key = (_norm(f.path), f.rule)
+        left = budget.get(key, 0)
+        if left > 0:
+            budget[key] = left - 1
+            f.suppressed = "baseline"
+
+
+def format_baseline(findings: list[Finding]) -> str:
+    """The minimal baseline TOML covering every unsuppressed finding
+    (what --write-baseline emits)."""
+    counts: dict[tuple[str, str], int] = {}
+    for f in findings:
+        if f.suppressed == "inline":
+            continue  # inline disables carry their own reason in-source
+        key = (_norm(f.path), f.rule)
+        counts[key] = counts.get(key, 0) + 1
+    lines = [
+        "# fdlint baseline: grandfathered findings (see docs/ANALYSIS.md).",
+        "# Regenerate with: python -m firedancer_tpu.analysis"
+        " --write-baseline",
+        "",
+    ]
+    for (path, rule), count in sorted(counts.items()):
+        lines += [
+            "[[suppress]]",
+            f'path = "{path}"',
+            f'rule = "{rule}"',
+            f"count = {count}",
+            'reason = "grandfathered at baseline creation"',
+            "",
+        ]
+    return "\n".join(lines)

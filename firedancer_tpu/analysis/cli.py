@@ -1,0 +1,115 @@
+"""fdlint CLI: `python -m firedancer_tpu.analysis [paths...]`.
+
+Default run = AST lint over the given paths (default: the installed
+firedancer_tpu package) + topology check of the flagship process
+topology (models/leader_topo.build_leader_topology), with the shipped
+baseline applied.  Exit status 0 iff no unsuppressed findings — the
+contract scripts/fdlint.sh and tests/test_fdlint.py enforce in tier-1.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import os
+import sys
+
+from . import ast_rules, baseline as bl, report, topo_check
+from .framework import Finding
+
+DEFAULT_TOPO = "firedancer_tpu.models.leader_topo:build_leader_topology"
+
+
+def _resolve_topo(spec: str):
+    """'pkg.mod:factory' -> Topology (factory called with no args), or
+    'pkg.mod:name' where name is already a Topology instance."""
+    modname, _, attr = spec.partition(":")
+    obj = getattr(importlib.import_module(modname), attr)
+    return obj() if callable(obj) else obj
+
+
+def check_paths(
+    paths: list[str],
+    *,
+    topo_specs: list[str] | None = None,
+    baseline_path: str | None = None,
+    use_baseline: bool = True,
+) -> list[Finding]:
+    """The full analyzer pass as a library call (tests use this)."""
+    findings: list[Finding] = []
+    for p in paths:
+        findings.extend(ast_rules.lint_path(p))
+    for spec in topo_specs or ():
+        topo = _resolve_topo(spec)
+        findings.extend(topo_check.check_topology(topo, label=spec))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    if use_baseline:
+        bl.apply_baseline(findings, bl.load_baseline(baseline_path))
+    return findings
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m firedancer_tpu.analysis",
+        description="fdlint: topology + hot-path static analysis "
+        "(docs/ANALYSIS.md)",
+    )
+    ap.add_argument("paths", nargs="*",
+                    help="files or package roots to lint (default: the"
+                    " firedancer_tpu package)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print every rule ID and exit")
+    ap.add_argument("--topo", action="append", default=None,
+                    metavar="MOD:FACTORY",
+                    help="also check this topology (module:factory);"
+                    f" default {DEFAULT_TOPO}")
+    ap.add_argument("--no-topo", action="store_true",
+                    help="skip the topology check")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline file (default {bl.DEFAULT_BASELINE})")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline (show grandfathered"
+                    " findings)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write the minimal baseline covering current"
+                    " findings and exit 0")
+    ap.add_argument("--json", action="store_true", help="JSON output")
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="also show suppressed findings")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        print(report.render_rules())
+        return 0
+
+    paths = args.paths
+    if not paths:
+        paths = [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+    topo_specs = [] if args.no_topo else (args.topo or [DEFAULT_TOPO])
+
+    if args.write_baseline:
+        findings = check_paths(paths, topo_specs=topo_specs,
+                               use_baseline=False)
+        out = bl.format_baseline(findings)
+        path = args.baseline or bl.DEFAULT_BASELINE
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write(out)
+        print(f"fdlint: wrote baseline covering "
+              f"{len(report.active(findings))} finding(s) to {path}")
+        return 0
+
+    findings = check_paths(
+        paths,
+        topo_specs=topo_specs,
+        baseline_path=args.baseline,
+        use_baseline=not args.no_baseline,
+    )
+    if args.json:
+        print(report.render_json(findings))
+    else:
+        print(report.render_text(findings, verbose=args.verbose))
+    return 1 if report.active(findings) else 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,148 @@
+"""Rule registry + Finding type shared by both fdlint halves.
+
+A Rule is identity + documentation: the checkers (topo_check, ast_rules)
+emit Findings tagged with a registered rule ID, and the CLI / baseline /
+suppression machinery works purely on those IDs, so rule logic and rule
+policy never entangle (the shape of the reference's per-check error
+paths in fd_topob.c, which FD_LOG_ERR a stable message per invariant).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+SEV_ERROR = "error"
+SEV_WARNING = "warning"
+
+
+@dataclass(frozen=True)
+class Rule:
+    id: str  # stable: FD1xx topology, FD2xx AST
+    name: str  # short kebab-case handle
+    severity: str  # SEV_ERROR | SEV_WARNING
+    summary: str  # one line, shown by --list-rules
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str  # source file, or "topo:<label>" for topology findings
+    line: int  # 1-based; 0 for topology findings
+    msg: str
+    suppressed: str | None = None  # None, "inline", or "baseline"
+
+    def format(self) -> str:
+        loc = f"{self.path}:{self.line}" if self.line else self.path
+        sev = get_rule(self.rule).severity
+        sup = f" [suppressed: {self.suppressed}]" if self.suppressed else ""
+        return f"{loc}: {self.rule} [{sev}] {self.msg}{sup}"
+
+
+_RULES: dict[str, Rule] = {}
+
+
+def _rule(id: str, name: str, severity: str, summary: str) -> Rule:
+    r = Rule(id, name, severity, summary)
+    assert id not in _RULES, f"duplicate rule id {id}"
+    _RULES[id] = r
+    return r
+
+
+def get_rule(id: str) -> Rule:
+    return _RULES[id]
+
+
+def all_rules() -> list[Rule]:
+    return [_RULES[k] for k in sorted(_RULES)]
+
+
+# -- topology rules (FD1xx): the fd_topob pre-boot invariants ---------------
+
+FD101 = _rule(
+    "FD101", "topo-multi-producer", SEV_ERROR,
+    "link has more than one producing stage (mcache is single-producer)",
+)
+FD102 = _rule(
+    "FD102", "topo-no-producer", SEV_ERROR,
+    "stage consumes a link no stage produces (orphan consumer)",
+)
+FD103 = _rule(
+    "FD103", "topo-no-consumer", SEV_ERROR,
+    "link is produced but no stage consumes it (producer stalls at depth)",
+)
+FD104 = _rule(
+    "FD104", "topo-depth-pow2", SEV_ERROR,
+    "link depth is not a power of two (mcache line index is seq & (depth-1))",
+)
+FD105 = _rule(
+    "FD105", "topo-dcache-small", SEV_ERROR,
+    "dcache_sz override below DCache.footprint(mtu, depth): frags in flight"
+    " would be overwritten before consumers read them",
+)
+FD106 = _rule(
+    "FD106", "topo-fseq-underprovision", SEV_ERROR,
+    "link declares fewer fseq slots (n_consumers) than consuming stages:"
+    " credit flow cannot see the extra consumers and will overrun them",
+)
+FD107 = _rule(
+    "FD107", "topo-credit-deadlock", SEV_ERROR,
+    "cycle of credit-gated stages: every stage on the loop stops consuming"
+    " when backpressured, so the loop can wedge permanently",
+)
+FD108 = _rule(
+    "FD108", "topo-dup-name", SEV_ERROR,
+    "duplicate stage or link name (shm segment names would collide)",
+)
+FD109 = _rule(
+    "FD109", "topo-unknown-link", SEV_ERROR,
+    "stage wiring references a link the topology never declared",
+)
+FD110 = _rule(
+    "FD110", "topo-unpicklable-builder", SEV_ERROR,
+    "stage builder is not a module-level callable: it cannot pickle into"
+    " the spawned child (fork is unusable with XLA, see runtime/topo.py)",
+)
+FD111 = _rule(
+    "FD111", "topo-isolated-stage", SEV_WARNING,
+    "stage declares wiring but neither produces nor consumes any link",
+)
+
+# -- AST rules (FD2xx): hot-loop + spawn discipline -------------------------
+
+FD200 = _rule(
+    "FD200", "parse-error", SEV_ERROR,
+    "file does not parse as Python (the rest of the rules never ran on it)",
+)
+FD201 = _rule(
+    "FD201", "host-sync-in-frag", SEV_ERROR,
+    "host-sync call (.item()/np.asarray/jax.device_get/block_until_ready/"
+    "float(device_val)) inside a before_frag/during_frag/after_frag body:"
+    " blocks the stage on the device per frag, serializing the pipeline",
+)
+FD202 = _rule(
+    "FD202", "wallclock-in-frag", SEV_ERROR,
+    "wall-clock read (time.time/monotonic/perf_counter) inside a frag"
+    " callback: per-frag syscall cost — stamp deadlines in before_credit"
+    " (run unconditionally every iteration) or during_housekeeping",
+)
+FD203 = _rule(
+    "FD203", "global-random", SEV_ERROR,
+    "module-level random.* call (process-global, unseeded): use the seeded"
+    " utils/rng.Rng (or a random.Random instance) for reproducible runs",
+)
+FD204 = _rule(
+    "FD204", "salted-hash-seed", SEV_ERROR,
+    "builtin hash() call: str/bytes hashing is salted per process"
+    " (PYTHONHASHSEED), so derived seeds/keys differ across spawned"
+    " children and runs — use zlib.crc32 or hashlib",
+)
+FD205 = _rule(
+    "FD205", "nonmodule-builder", SEV_ERROR,
+    "lambda / nested function / partial passed as a stage builder: will not"
+    " pickle under the spawn start method",
+)
+FD206 = _rule(
+    "FD206", "bare-except", SEV_WARNING,
+    "bare except (or except BaseException) without re-raise: swallows"
+    " KeyboardInterrupt/SystemExit and can eat a stage's HALT/teardown path",
+)

@@ -1,0 +1,47 @@
+"""Finding reporting: human text + machine JSON, shared by CLI and tests."""
+
+from __future__ import annotations
+
+import json
+
+from .framework import Finding, all_rules, get_rule
+
+
+def active(findings: list[Finding]) -> list[Finding]:
+    return [f for f in findings if not f.suppressed]
+
+
+def render_text(findings: list[Finding], *, verbose: bool = False) -> str:
+    shown = findings if verbose else active(findings)
+    lines = [f.format() for f in shown]
+    n_act = len(active(findings))
+    n_sup = len(findings) - n_act
+    lines.append(
+        f"fdlint: {n_act} finding(s), {n_sup} suppressed"
+        + (" — clean" if n_act == 0 else "")
+    )
+    return "\n".join(lines)
+
+
+def render_json(findings: list[Finding]) -> str:
+    return json.dumps(
+        [
+            {
+                "rule": f.rule,
+                "severity": get_rule(f.rule).severity,
+                "path": f.path,
+                "line": f.line,
+                "msg": f.msg,
+                "suppressed": f.suppressed,
+            }
+            for f in findings
+        ],
+        indent=2,
+    )
+
+
+def render_rules() -> str:
+    lines = []
+    for r in all_rules():
+        lines.append(f"{r.id}  {r.name:<24} [{r.severity:<7}] {r.summary}")
+    return "\n".join(lines)

@@ -1,0 +1,197 @@
+"""Topology checker: fd_topob's pre-boot validation for runtime/topo.
+
+Operates on a `runtime.topo.Topology` *object* — imported or built, never
+launched — so a mis-wired graph fails in the parent with a readable
+report instead of dying inside a spawned child.  `runtime.topo.launch()`
+calls `validate_or_raise` before any shared memory is created.
+
+Wiring is DECLARATIVE and optional: stages that pass `ins=` / `outs=`
+(link names) to `Topology.stage()` participate in graph checks; a
+topology whose stages declare nothing (hand-wired builders, tests) still
+gets the per-link invariants (depth, dcache, duplicate names).  Partial
+declaration is supported — graph rules fire on evidence, never on
+absence of declaration: rules about something MISSING (FD102 no
+producer, FD103 no consumer) require every stage to declare, because an
+undeclared stage may be the missing producer/consumer; rules about
+something PRESENT (FD101 duplicate producer, FD106 fseq
+underprovisioning, FD107 gated cycles, FD109 unknown links) fire on any
+declared subset.
+"""
+
+from __future__ import annotations
+
+from .framework import SEV_ERROR, Finding, get_rule
+
+
+class TopologyError(RuntimeError):
+    """Raised by validate_or_raise; .findings carries the full report."""
+
+    def __init__(self, findings: list[Finding]):
+        self.findings = findings
+        lines = [f.format() for f in findings]
+        super().__init__(
+            "topology failed pre-boot validation "
+            f"({len(findings)} finding(s)):\n  " + "\n  ".join(lines)
+        )
+
+
+def _is_pow2(n: int) -> bool:
+    return n > 0 and (n & (n - 1)) == 0
+
+
+def _builder_picklable(builder) -> bool:
+    """True iff the builder is a module-level callable the spawn pickler
+    can resolve by qualified name (the only kind that survives into a
+    fresh interpreter; see runtime/topo.py module docstring)."""
+    qn = getattr(builder, "__qualname__", None)
+    mod = getattr(builder, "__module__", None)
+    if qn is None or mod is None:
+        return False  # functools.partial, bound-method-less callables
+    if "<locals>" in qn or "<lambda>" in qn:
+        return False
+    return True  # module-level (incl. __main__, which spawn re-imports)
+
+
+def check_topology(topo, label: str = "topology") -> list[Finding]:
+    """All findings for a Topology; callers decide what is fatal."""
+    out: list[Finding] = []
+    where = f"topo:{label}"
+
+    def hit(rule: str, msg: str) -> None:
+        out.append(Finding(rule=rule, path=where, line=0, msg=msg))
+
+    links = {}
+    for ls in topo.links:
+        if ls.name in links:
+            hit("FD108", f"duplicate link name '{ls.name}'")
+        links[ls.name] = ls
+        if not _is_pow2(ls.depth):
+            hit("FD104", f"link '{ls.name}' depth {ls.depth} is not a power"
+                " of two")
+        dcache_sz = getattr(ls, "dcache_sz", None)
+        if dcache_sz is not None:
+            from firedancer_tpu.tango.rings import DCache
+
+            need = DCache.footprint(ls.mtu, ls.depth)
+            if dcache_sz < need:
+                hit("FD105", f"link '{ls.name}' dcache_sz {dcache_sz} <"
+                    f" footprint({ls.mtu}, {ls.depth}) = {need}")
+            elif dcache_sz % DCache.CHUNK_SZ:
+                hit("FD105", f"link '{ls.name}' dcache_sz {dcache_sz} is"
+                    f" not a multiple of the {DCache.CHUNK_SZ}-byte chunk"
+                    " granule: the u64 fseq/cnc cells after the dcache"
+                    " would be misaligned (torn cross-process loads)")
+
+    stage_names: set[str] = set()
+    producers: dict[str, list[str]] = {}  # link -> producing stages
+    consumers: dict[str, list[str]] = {}  # link -> consuming stages
+    declared = []  # stages that declared any wiring
+    for ss in topo.stages:
+        if ss.name in stage_names:
+            hit("FD108", f"duplicate stage name '{ss.name}'")
+        stage_names.add(ss.name)
+        if not _builder_picklable(ss.builder):
+            hit("FD110", f"stage '{ss.name}' builder"
+                f" {getattr(ss.builder, '__qualname__', ss.builder)!r} is"
+                " not a module-level function")
+        ins = getattr(ss, "ins", None)
+        outs = getattr(ss, "outs", None)
+        if ins is None and outs is None:
+            continue  # hand-wired stage: graph rules don't apply
+        declared.append(ss)
+        if not ins and not outs:
+            hit("FD111", f"stage '{ss.name}' declares wiring but no links")
+        for ln in outs or ():
+            if ln not in links:
+                hit("FD109", f"stage '{ss.name}' produces unknown link"
+                    f" '{ln}'")
+            producers.setdefault(ln, []).append(ss.name)
+        for ln in ins or ():
+            if ln not in links:
+                hit("FD109", f"stage '{ss.name}' consumes unknown link"
+                    f" '{ln}'")
+            consumers.setdefault(ln, []).append(ss.name)
+
+    for ln, ps in producers.items():
+        if len(ps) > 1:
+            hit("FD101", f"link '{ln}' has {len(ps)} producers"
+                f" ({', '.join(ps)}); mcache publish is single-producer")
+
+    if declared and len(declared) == len(topo.stages):
+        # absence rules need the FULL graph: with any hand-wired stage
+        # in play, the "missing" producer/consumer may simply be
+        # undeclared
+        for ln, cs in consumers.items():
+            if ln in links and ln not in producers:
+                hit("FD102", f"stage(s) {', '.join(cs)} consume link '{ln}'"
+                    " which no stage produces")
+        for ln, ps in producers.items():
+            if ln in links and ln not in consumers:
+                hit("FD103", f"link '{ln}' (produced by {ps[0]}) has no"
+                    " consumer; its fseq never advances and the producer"
+                    " stalls after depth frags")
+    if declared:
+        for ln, cs in consumers.items():
+            if ln in links and links[ln].n_consumers < len(cs):
+                hit("FD106", f"link '{ln}' provisions"
+                    f" {links[ln].n_consumers} fseq slot(s) for {len(cs)}"
+                    f" consumers ({', '.join(cs)})")
+        out.extend(_credit_cycles(topo, producers, consumers, where))
+    return out
+
+
+def _credit_cycles(topo, producers, consumers, where) -> list[Finding]:
+    """FD107: a directed cycle whose stages are ALL credit-gated
+    (Stage.require_credit analog: stop consuming inputs when any output
+    is backpressured) can deadlock — everyone waits for everyone's
+    credits.  A single non-gated stage on the loop keeps draining its
+    inputs while backpressured and breaks the cycle (exactly why pack
+    does not set require_credit while bank/poh do; the reference breaks
+    the same pack<->bank loop by making the busy-feedback link
+    unreliable, fd_topo.h:99-101)."""
+    gated = {s.name for s in topo.stages if getattr(s, "credit_gated", False)}
+    # adjacency restricted to gated stages: edge A->B iff A produces a
+    # link B consumes and both are gated
+    adj: dict[str, set[str]] = {n: set() for n in gated}
+    for ln, ps in producers.items():
+        for p in ps:
+            if p not in gated:
+                continue
+            for c in consumers.get(ln, ()):
+                if c in gated:
+                    adj[p].add(c)
+    out: list[Finding] = []
+    color: dict[str, int] = {}  # 0 visiting, 1 done
+
+    def dfs(n: str, path: list[str]) -> None:
+        color[n] = 0
+        path.append(n)
+        for m in sorted(adj[n]):
+            if m not in color:
+                dfs(m, path)
+            elif color[m] == 0:
+                cyc = path[path.index(m):] + [m]
+                out.append(Finding(
+                    rule="FD107", path=where, line=0,
+                    msg="credit-gated cycle "
+                        + " -> ".join(cyc)
+                        + "; no stage on the loop drains while"
+                          " backpressured",
+                ))
+        path.pop()
+        color[n] = 1
+
+    for n in sorted(gated):
+        if n not in color:
+            dfs(n, [])
+    return out
+
+
+def validate_or_raise(topo, label: str = "topology") -> list[Finding]:
+    """launch()'s entry: raise TopologyError on any error-severity
+    finding, return the (possibly warning-only) findings otherwise."""
+    findings = check_topology(topo, label)
+    fatal = [f for f in findings if get_rule(f.rule).severity == SEV_ERROR]
+    if fatal:
+        raise TopologyError(fatal)
+    return findings

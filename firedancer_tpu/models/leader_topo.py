@@ -189,15 +189,30 @@ def build_leader_topology(
     secret = hashlib.sha256(leader_seed).digest()
     leader_pub = ref.public_key(secret)
 
+    # ins/outs mirror what each builder above actually wires — the
+    # pre-boot topology checker (analysis FD1xx) validates the graph
+    # against these declarations before launch() creates any shm.
+    # pack is deliberately NOT credit_gated: it keeps draining the banks'
+    # done-feedback (bd) links while backpressured on pb, which is what
+    # breaks the pack<->bank cycle (FD107's rationale).
     sb = sandbox
     topo.stage("benchg", build_benchg, pool_size=pool_size, n_txns=n_txns,
-               sandbox=sb)
-    topo.stage("verify0", build_verify, batch=batch, sandbox=sb)
-    topo.stage("dedup", build_dedup, sandbox=sb)
-    topo.stage("pack", build_pack, n_bank=n_bank, sandbox=sb)
+               sandbox=sb, outs=["gv"])
+    topo.stage("verify0", build_verify, batch=batch, sandbox=sb,
+               ins=["gv"], outs=["vd"])
+    topo.stage("dedup", build_dedup, sandbox=sb, ins=["vd"], outs=["dp"])
+    topo.stage("pack", build_pack, n_bank=n_bank, sandbox=sb,
+               ins=["dp"] + [f"bd{b}" for b in range(n_bank)],
+               outs=[f"pb{b}" for b in range(n_bank)])
     for b in range(n_bank):
-        topo.stage(f"bank{b}", build_bank, bank_idx=b, slot=slot, sandbox=sb)
-    topo.stage("poh", build_poh, n_bank=n_bank, sandbox=sb)
-    topo.stage("shred", build_shred, secret=secret, slot=slot, sandbox=sb)
-    topo.stage("store", build_store, leader_pub=leader_pub, sandbox=sb)
+        topo.stage(f"bank{b}", build_bank, bank_idx=b, slot=slot, sandbox=sb,
+                   ins=[f"pb{b}"], outs=[f"bp{b}", f"bd{b}"],
+                   credit_gated=True)
+    topo.stage("poh", build_poh, n_bank=n_bank, sandbox=sb,
+               ins=[f"bp{b}" for b in range(n_bank)], outs=["ps"],
+               credit_gated=True)
+    topo.stage("shred", build_shred, secret=secret, slot=slot, sandbox=sb,
+               ins=["ps"], outs=["ss"])
+    topo.stage("store", build_store, leader_pub=leader_pub, sandbox=sb,
+               ins=["ss"])
     return topo

@@ -72,8 +72,6 @@ class PackStage(Stage):
                 return
             if self.pack.insert(p, desc):
                 self.metrics.inc("txn_in")
-                if self._first_pending_at is None:
-                    self._first_pending_at = time.monotonic()
                 if len(self._tsorig_by_sig) > 2 * self.pack.depth:
                     self._tsorig_by_sig.clear()
                 self._tsorig_by_sig[desc.signatures(p)[0]] = int(
@@ -86,6 +84,16 @@ class PackStage(Stage):
             self.pack.microblock_done(bank)
             self._bank_busy[bank] = False
             self.metrics.inc("microblock_done")
+
+    def before_credit(self) -> None:
+        # the mb_deadline_s clock starts here, not in after_frag (the
+        # per-frag path must stay free of wall-clock syscalls, fdlint
+        # FD202) and not in after_credit (run_once skips that hook while
+        # any bank link is backpressured): before_credit runs
+        # unconditionally every iteration, so the stamp lags a txn's
+        # arrival by at most one iteration even under backpressure
+        if self._first_pending_at is None and self.pack.pending_cnt():
+            self._first_pending_at = time.monotonic()
 
     def after_credit(self) -> None:
         if not self._ready_to_schedule():

@@ -23,8 +23,8 @@ tsc ticks (same randomized-lazy idea, fd_mux.c:389-474).
 
 from __future__ import annotations
 
-import random
 import time
+import zlib
 
 from firedancer_tpu.tango import shm
 from firedancer_tpu.tango.rings import CNC_SIG_HALT, CNC_SIG_RUN, Cnc, MCache
@@ -66,7 +66,13 @@ class Stage:
         self.require_credit = False
         # frags drained per run_once sweep (see run_once's burst loop)
         self.burst = 16
-        self._rng = random.Random(seed ^ hash(name))
+        # crc32, not builtin hash(): str hashing is salted per process
+        # (PYTHONHASHSEED), and spawned children must derive the SAME
+        # housekeeping phase for a given (name, seed) as the parent and
+        # as any restart — fdlint FD204 guards this.
+        from firedancer_tpu.utils.rng import Rng
+
+        self._rng = Rng(seed, zlib.crc32(name.encode()))
         self._next_housekeeping = 0
         self._iter = 0
         self._in_rr = 0  # round-robin input cursor
@@ -110,7 +116,7 @@ class Stage:
         self.cnc.diag_set(self.DIAG_ITER, self._iter)
         self.during_housekeeping()
         # randomized lazy interval: [lazy/2, 3*lazy/2) iterations
-        self._next_housekeeping = self._iter + self.lazy // 2 + self._rng.randrange(
+        self._next_housekeeping = self._iter + self.lazy // 2 + self._rng.roll(
             max(self.lazy, 1)
         )
 

@@ -23,6 +23,13 @@ shared across processes.  Children START FRESH (the multiprocessing
 initialized XLA runtime whose thread pools did not survive the fork, and
 its first device dispatch deadlocks — so builders must be module-level
 (picklable) functions, with per-stage parameters in StageSpec.kwargs.
+
+These invariants (and the link-graph ones: single producer per link,
+power-of-two depths, credit-cycle freedom) are CHECKED, not just
+documented: stages declare their wiring via StageSpec.ins/outs, and
+`launch()` runs the fdlint topology checker (firedancer_tpu/analysis,
+the fd_topob analog) in the parent before creating any shm — see
+docs/ANALYSIS.md.
 """
 
 from __future__ import annotations
@@ -48,6 +55,10 @@ class LinkSpec:
     depth: int = 1024
     mtu: int = 4096
     n_consumers: int = 1
+    # optional data-region oversizing (burst headroom); None = the exact
+    # DCache.footprint(mtu, depth).  Undersizing is refused at create and
+    # reported pre-boot by the topology checker (analysis FD105).
+    dcache_sz: int | None = None
 
 
 @dataclass
@@ -58,12 +69,26 @@ class StageSpec:
     (rlimits/namespaces/seccomp) applied in the CHILD after the builder
     ran (privileged_init analog: open sockets/keys first, then drop) and
     before the run loop, mirroring fd_topo_run's boot ordering
-    (src/disco/topo/fd_topo_run.c:50-190)."""
+    (src/disco/topo/fd_topo_run.c:50-190).
+
+    ins / outs: DECLARATIVE wiring — the link names this stage's builder
+    will consume / produce.  Purely descriptive (builders still wire the
+    actual Consumers/Producers), but declaring lets the pre-boot
+    topology checker (firedancer_tpu/analysis, the fd_topob analog)
+    validate the whole graph in the parent before any shm exists.  None
+    (default) means "hand-wired": graph rules skip this stage.
+
+    credit_gated mirrors Stage.require_credit: the stage stops consuming
+    inputs while any output is backpressured, which the checker uses to
+    find credit-deadlock cycles (FD107)."""
 
     name: str
     builder: object
     kwargs: dict = field(default_factory=dict)
     sandbox: dict | None = None
+    ins: tuple[str, ...] | None = None
+    outs: tuple[str, ...] | None = None
+    credit_gated: bool = False
 
 
 @dataclass
@@ -77,10 +102,25 @@ class Topology:
         return spec
 
     def stage(self, name: str, builder, *, sandbox: dict | None = None,
+              ins: list[str] | tuple[str, ...] | None = None,
+              outs: list[str] | tuple[str, ...] | None = None,
+              credit_gated: bool = False,
               **kwargs) -> "StageSpec":
-        spec = StageSpec(name, builder, kwargs, sandbox)
+        spec = StageSpec(
+            name, builder, kwargs, sandbox,
+            ins=tuple(ins) if ins is not None else None,
+            outs=tuple(outs) if outs is not None else None,
+            credit_gated=credit_gated,
+        )
         self.stages.append(spec)
         return spec
+
+    def validate(self, label: str = "topology"):
+        """Pre-boot check (fd_topob analog); raises analysis.TopologyError
+        with the full readable report on any error-severity finding."""
+        from firedancer_tpu.analysis.topo_check import validate_or_raise
+
+        return validate_or_raise(self, label)
 
 
 def _cnc_shm_name(uid: str, stage: str) -> str:
@@ -233,6 +273,10 @@ class TopologyHandle:
 
 
 def launch(topo: Topology) -> TopologyHandle:
+    # fail fast IN THE PARENT: a mis-wired graph raises a readable
+    # TopologyError here, before any shm segment or child process exists
+    # (the fd_topob contract — validation precedes boot)
+    topo.validate()
     ctx = mp.get_context("spawn")  # fresh interpreters: see module docstring
     uid = f"{os.getpid()}_{int(time.monotonic_ns() % 1_000_000)}"
     links: dict[str, shm.ShmLink] = {}
@@ -240,7 +284,8 @@ def launch(topo: Topology) -> TopologyHandle:
     for spec in topo.links:
         sn = f"fdtpu_{spec.name}_{uid}"
         links[spec.name] = shm.ShmLink.create(
-            sn, depth=spec.depth, mtu=spec.mtu, n_fseq=spec.n_consumers
+            sn, depth=spec.depth, mtu=spec.mtu, n_fseq=spec.n_consumers,
+            dcache_sz=spec.dcache_sz,
         )
         link_names[spec.name] = sn
     cncs: dict[str, Cnc] = {}

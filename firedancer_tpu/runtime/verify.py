@@ -99,6 +99,7 @@ class _Acc:
     def clear(self) -> None:
         self.payloads, self.descs = [], []
         self.elems, self.ranges, self.tsorigs, self.slots = [], [], [], []
+        self.opened_at = 0.0  # re-stamped by before_credit when reopened
 
 
 class VerifyStage(Stage):
@@ -173,8 +174,6 @@ class VerifyStage(Stage):
         acc = self._comb if slots is not None else self._gen
         if acc.elems and len(acc.elems) + t.signature_cnt > self.batch:
             self._close_batch(acc)
-        if not acc.elems:
-            acc.opened_at = time.monotonic()
         start = len(acc.elems)
         for i, (s, pk) in enumerate(zip(sigs, signers)):
             acc.elems.append((msg, s, pk))
@@ -187,11 +186,26 @@ class VerifyStage(Stage):
         if len(acc.elems) >= self.batch:
             self._close_batch(acc)
 
+    def before_credit(self) -> None:
+        # The batch-deadline clock is stamped HERE, not in after_frag
+        # (the per-frag path must stay free of wall-clock syscalls,
+        # fdlint FD202) and not in after_credit (run_once skips that
+        # hook entirely while any output is backpressured): before_credit
+        # runs unconditionally every iteration, so a fresh batch is
+        # stamped within one iteration of opening even under
+        # backpressure.  The clock is only read when a batch newly
+        # opened — idle spins stay syscall-free.  (clear() resets
+        # opened_at, so a stale stamp can never survive a close.)
+        for acc in (self._gen, self._comb):
+            if acc.elems and acc.opened_at == 0.0:
+                acc.opened_at = time.monotonic()
+
     def after_credit(self) -> None:
         # deadline-based batch close (p99 latency at low occupancy)
         now = time.monotonic()
         for acc in (self._gen, self._comb):
-            if acc.elems and now - acc.opened_at >= self.batch_deadline_s:
+            if acc.elems and acc.opened_at \
+                    and now - acc.opened_at >= self.batch_deadline_s:
                 self._close_batch(acc)
         self._drain(block=False)
 

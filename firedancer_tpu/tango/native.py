@@ -21,7 +21,7 @@ import os
 
 from firedancer_tpu.utils.nativebuild import NativeUnavailable, build_so
 
-from . import rings, shm
+from . import shm
 
 _SRC = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
@@ -90,7 +90,11 @@ def _load():
 
 
 def _link_struct(link: shm.ShmLink) -> tuple[_Link, object]:
-    a, b, c, d, e = shm._layout(link.depth, link.mtu, link.n_fseq)
+    # link.dcache_sz carries any oversizing (LinkSpec.dcache_sz burst
+    # headroom, stored in the shm header) — the layout and the C++ side
+    # must both honor it or their chunk watermarks diverge
+    a, b, c, d, e = shm._layout(link.depth, link.mtu, link.n_fseq,
+                                link.dcache_sz)
     buf = (ctypes.c_char * link._shm.size).from_buffer(link._shm.buf)
     ls = _Link(
         base=ctypes.addressof(buf),
@@ -98,7 +102,7 @@ def _link_struct(link: shm.ShmLink) -> tuple[_Link, object]:
         mtu=link.mtu,
         mcache_off=a,
         dcache_off=b,
-        dcache_sz=rings.DCache.footprint(link.mtu, link.depth),
+        dcache_sz=link.dcache_sz,
     )
     return ls, buf  # buf must outlive the struct (holds the buffer ref)
 

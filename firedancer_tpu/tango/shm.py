@@ -22,7 +22,7 @@ import numpy as np
 
 from . import rings
 
-_HDR = 4 * 8  # depth, mtu, n_fseq, pad
+_HDR = 4 * 8  # depth, mtu, n_fseq, dcache_sz (0 = DCache.footprint)
 
 
 def now_ns() -> int:
@@ -30,10 +30,10 @@ def now_ns() -> int:
     return time.monotonic_ns()
 
 
-def _layout(depth: int, mtu: int, n_fseq: int):
+def _layout(depth: int, mtu: int, n_fseq: int, dcache_sz: int | None = None):
     a = _HDR
     b = a + rings.MCache.footprint(depth)
-    c = b + rings.DCache.footprint(mtu, depth)
+    c = b + (dcache_sz or rings.DCache.footprint(mtu, depth))
     d = c + n_fseq * 8
     e = d + rings.Cnc.footprint()
     return a, b, c, d, e
@@ -42,13 +42,15 @@ def _layout(depth: int, mtu: int, n_fseq: int):
 class ShmLink:
     """One producer->consumers link over a named shared-memory block."""
 
-    def __init__(self, shm, depth: int, mtu: int, n_fseq: int, owner: bool):
+    def __init__(self, shm, depth: int, mtu: int, n_fseq: int, owner: bool,
+                 dcache_sz: int | None = None):
         self._shm = shm
         self.owner = owner
         self.depth = depth
         self.mtu = mtu
         self.n_fseq = n_fseq
-        a, b, c, d, e = _layout(depth, mtu, n_fseq)
+        self.dcache_sz = dcache_sz or rings.DCache.footprint(mtu, depth)
+        a, b, c, d, e = _layout(depth, mtu, n_fseq, dcache_sz)
         buf = shm.buf
         self.mcache = rings.MCache.__new__(rings.MCache)
         self.mcache.depth = depth
@@ -58,7 +60,7 @@ class ShmLink:
                 self.mcache.table[line, rings.MCache.COL_SEQ] = (
                     rings.MCache.BUSY | line
                 )
-        self.dcache = rings.DCache(mtu, depth, buf=np.frombuffer(buf, dtype=np.uint8, offset=b, count=rings.DCache.footprint(mtu, depth)))
+        self.dcache = rings.DCache(mtu, depth, buf=np.frombuffer(buf, dtype=np.uint8, offset=b, count=self.dcache_sz))
         self.fseqs = [
             rings.Fseq(np.frombuffer(buf, dtype=rings.U64, offset=c + 8 * i, count=1))
             for i in range(n_fseq)
@@ -66,18 +68,39 @@ class ShmLink:
         self.cnc = rings.Cnc(np.frombuffer(buf, dtype=rings.U64, offset=d, count=2 + rings.Cnc.NDIAG))
 
     @classmethod
-    def create(cls, name: str, depth: int, mtu: int, n_fseq: int = 1) -> "ShmLink":
-        size = _layout(depth, mtu, n_fseq)[-1]
+    def create(cls, name: str, depth: int, mtu: int, n_fseq: int = 1,
+               dcache_sz: int | None = None) -> "ShmLink":
+        """dcache_sz oversizes the data region beyond the minimum
+        footprint (burst headroom, the reference's tunable dcache data
+        size).  UNDERsizing would let in-flight frags be overwritten
+        before consumers read them, and a non-chunk-multiple size would
+        misalign the u64 fseq/cnc cells that follow the dcache in the
+        block (torn cross-process loads) — refuse both here, and the
+        topology checker (analysis FD105) reports them pre-boot with
+        context."""
+        if dcache_sz is not None:
+            if dcache_sz < rings.DCache.footprint(mtu, depth):
+                raise ValueError(
+                    f"dcache_sz {dcache_sz} < DCache.footprint({mtu},"
+                    f" {depth}) = {rings.DCache.footprint(mtu, depth)}"
+                )
+            if dcache_sz % rings.DCache.CHUNK_SZ:
+                raise ValueError(
+                    f"dcache_sz {dcache_sz} is not a multiple of the"
+                    f" {rings.DCache.CHUNK_SZ}-byte chunk granule"
+                )
+        size = _layout(depth, mtu, n_fseq, dcache_sz)[-1]
         shm = shared_memory.SharedMemory(name=name, create=True, size=size)
         hdr = np.frombuffer(shm.buf, dtype=np.int64, count=4)
-        hdr[0], hdr[1], hdr[2] = depth, mtu, n_fseq
-        return cls(shm, depth, mtu, n_fseq, owner=True)
+        hdr[0], hdr[1], hdr[2], hdr[3] = depth, mtu, n_fseq, dcache_sz or 0
+        return cls(shm, depth, mtu, n_fseq, owner=True, dcache_sz=dcache_sz)
 
     @classmethod
     def join(cls, name: str) -> "ShmLink":
         shm = shared_memory.SharedMemory(name=name)
         hdr = np.frombuffer(shm.buf, dtype=np.int64, count=4)
-        return cls(shm, int(hdr[0]), int(hdr[1]), int(hdr[2]), owner=False)
+        return cls(shm, int(hdr[0]), int(hdr[1]), int(hdr[2]), owner=False,
+                   dcache_sz=int(hdr[3]) or None)
 
     def close(self) -> None:
         # Views into shm.buf must be dropped before the mapping can close;

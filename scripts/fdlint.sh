@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# fdlint gate: byte-compile the whole package, then run the static
+# analyzer (topology graph + hot-path AST rules, docs/ANALYSIS.md).
+# Exits non-zero on any syntax error or unsuppressed finding; tier-1
+# runs this via tests/test_fdlint.py, so CI fails on new violations.
+#
+# Usage: scripts/fdlint.sh [extra fdlint args...]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+python -m compileall -q firedancer_tpu
+python -m firedancer_tpu.analysis firedancer_tpu/ "$@"

@@ -1,0 +1,464 @@
+"""fdlint (firedancer_tpu/analysis) tests: the topology checker's
+negative cases per rule ID, the AST rules on synthetic sources, inline +
+baseline suppression mechanics, launch()'s fail-fast integration, and —
+the tier-1 gate itself — the analyzer running clean over the whole
+shipped package via scripts/fdlint.sh.
+
+Also regression-locks the violations fdlint found and this codebase
+FIXED rather than baselined:
+  - runtime/stage.py seeded its housekeeping RNG with builtin hash(name)
+    (process-salted: every spawned child and every run drew a different
+    phase) — FD204, now zlib.crc32;
+  - runtime/verify.py and runtime/pack_stage.py stamped batch deadlines
+    with time.monotonic() INSIDE after_frag (a per-frag syscall on the
+    hot path) — FD202, stamping moved to before_credit (the hook
+    run_once calls unconditionally; after_credit is skipped under
+    backpressure).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from firedancer_tpu.analysis import ast_rules, check_topology
+from firedancer_tpu.analysis import baseline as bl
+from firedancer_tpu.analysis import cli as fdcli
+from firedancer_tpu.analysis.framework import all_rules, get_rule
+from firedancer_tpu.analysis.topo_check import TopologyError
+from firedancer_tpu.runtime import topo as ft
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "firedancer_tpu")
+
+
+def _builder(links, cnc):  # a picklable module-level builder for specs
+    raise AssertionError("never called: topologies here are checked, not run")
+
+
+def _ids(findings):
+    return sorted({f.rule for f in findings})
+
+
+# -- rule registry -----------------------------------------------------------
+
+
+def test_rule_registry_has_both_halves():
+    rules = all_rules()
+    ids = [r.id for r in rules]
+    assert len(ids) == len(set(ids))
+    assert len(ids) >= 8  # the acceptance floor, comfortably beaten
+    assert any(i.startswith("FD1") for i in ids)  # topology half
+    assert any(i.startswith("FD2") for i in ids)  # AST half
+    for r in rules:
+        assert r.severity in ("error", "warning") and r.summary
+
+
+def test_cli_list_rules_prints_every_id(capsys):
+    assert fdcli.main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for r in all_rules():
+        assert r.id in out
+
+
+# -- topology checker: negative cases per rule ID ---------------------------
+
+
+def _wired_pair(depth=64, **link_kw):
+    """gen -> l0 -> sink, fully declared and clean."""
+    topo = ft.Topology()
+    topo.link("l0", depth=depth, mtu=256, **link_kw)
+    topo.stage("gen", _builder, outs=["l0"])
+    topo.stage("sink", _builder, ins=["l0"])
+    return topo
+
+
+def test_clean_wired_topology_has_no_findings():
+    assert check_topology(_wired_pair()) == []
+
+
+def test_fd101_duplicate_producer():
+    topo = _wired_pair()
+    topo.stage("gen2", _builder, outs=["l0"])
+    assert "FD101" in _ids(check_topology(topo))
+
+
+def test_fd102_orphan_consumer():
+    topo = ft.Topology()
+    topo.link("l0", depth=64, mtu=256)
+    topo.stage("sink", _builder, ins=["l0"])  # nobody produces l0
+    assert "FD102" in _ids(check_topology(topo))
+
+
+def test_fd103_unconsumed_link():
+    topo = ft.Topology()
+    topo.link("l0", depth=64, mtu=256)
+    topo.stage("gen", _builder, outs=["l0"])  # nobody consumes l0
+    assert "FD103" in _ids(check_topology(topo))
+
+
+def test_fd104_non_pow2_depth():
+    topo = _wired_pair(depth=1000)
+    f = [x for x in check_topology(topo) if x.rule == "FD104"]
+    assert f and "1000" in f[0].msg
+
+
+def test_fd105_dcache_too_small():
+    topo = _wired_pair(dcache_sz=64)  # far below footprint(256, 64)
+    f = [x for x in check_topology(topo) if x.rule == "FD105"]
+    assert f and "footprint" in f[0].msg
+    # and the shm layer independently refuses to build it
+    from firedancer_tpu.tango import shm
+
+    with pytest.raises(ValueError):
+        shm.ShmLink.create("fdtpu_test_fd105", depth=64, mtu=256,
+                           dcache_sz=64)
+
+
+def test_fd105_oversized_dcache_is_fine_and_real():
+    """Oversizing is legal config, survives the header round-trip, and
+    the checker stays quiet."""
+    from firedancer_tpu.tango import shm
+    from firedancer_tpu.tango.rings import DCache
+
+    big = 2 * DCache.footprint(256, 64)
+    assert check_topology(_wired_pair(dcache_sz=big)) == []
+    link = shm.ShmLink.create("fdtpu_test_fd105b", depth=64, mtu=256,
+                              dcache_sz=big)
+    try:
+        joined = shm.ShmLink.join("fdtpu_test_fd105b")
+        assert joined.dcache_sz == big
+        assert len(joined.dcache.data) == big
+        joined.close()
+    finally:
+        link.close()
+        link.unlink()
+
+
+def test_fd106_fseq_underprovision():
+    topo = ft.Topology()
+    topo.link("l0", depth=64, mtu=256, n_consumers=1)
+    topo.stage("gen", _builder, outs=["l0"])
+    topo.stage("sink_a", _builder, ins=["l0"])
+    topo.stage("sink_b", _builder, ins=["l0"])
+    assert "FD106" in _ids(check_topology(topo))
+
+
+def test_fd107_credit_gated_cycle():
+    topo = ft.Topology()
+    topo.link("ab", depth=64, mtu=256)
+    topo.link("ba", depth=64, mtu=256)
+    topo.stage("a", _builder, ins=["ba"], outs=["ab"], credit_gated=True)
+    topo.stage("b", _builder, ins=["ab"], outs=["ba"], credit_gated=True)
+    f = [x for x in check_topology(topo) if x.rule == "FD107"]
+    assert f
+    assert "a -> b" in f[0].msg or "b -> a" in f[0].msg
+
+
+def test_fd107_silent_when_one_stage_drains():
+    """The leader pipeline's pack<->bank loop shape: one non-gated stage
+    on the cycle keeps draining and no deadlock is possible."""
+    topo = ft.Topology()
+    topo.link("ab", depth=64, mtu=256)
+    topo.link("ba", depth=64, mtu=256)
+    topo.stage("a", _builder, ins=["ba"], outs=["ab"])  # not gated
+    topo.stage("b", _builder, ins=["ab"], outs=["ba"], credit_gated=True)
+    assert "FD107" not in _ids(check_topology(topo))
+
+
+def test_fd108_duplicate_names():
+    topo = _wired_pair()
+    topo.link("l0", depth=64, mtu=256)
+    topo.stage("gen", _builder, outs=["l0"])
+    ids = _ids(check_topology(topo))
+    assert "FD108" in ids
+
+
+def test_fd109_unknown_link():
+    topo = ft.Topology()
+    topo.stage("gen", _builder, outs=["ghost"])
+    assert "FD109" in _ids(check_topology(topo))
+
+
+def test_fd110_unpicklable_builder():
+    topo = ft.Topology()
+    topo.link("l0", depth=64, mtu=256)
+    topo.stage("gen", lambda links, cnc: None, outs=["l0"])
+    topo.stage("sink", _builder, ins=["l0"])
+    assert "FD110" in _ids(check_topology(topo))
+
+
+def test_fd111_isolated_stage_warns_only():
+    topo = _wired_pair()
+    topo.stage("loner", _builder, ins=[], outs=[])
+    findings = check_topology(topo)
+    assert "FD111" in _ids(findings)
+    topo.validate()  # warnings never raise
+
+
+def test_hand_wired_topologies_skip_graph_rules():
+    """Stages with no declared wiring (pre-existing tests) stay valid."""
+    topo = ft.Topology()
+    topo.link("l0", depth=64, mtu=256)
+    topo.stage("gen", _builder)
+    topo.stage("sink", _builder)
+    assert check_topology(topo) == []
+
+
+def test_launch_fails_fast_in_parent_before_any_shm():
+    """Satellite: a mis-wired topology raises a readable TopologyError
+    from launch() itself — no child process, no shm segment."""
+    topo = _wired_pair(depth=1000)  # FD104
+    topo.stage("ghost_rider", _builder, ins=["ghost"])  # FD109 + FD102
+    with pytest.raises(TopologyError) as ei:
+        ft.launch(topo)
+    msg = str(ei.value)
+    assert "FD104" in msg and "FD109" in msg
+    assert "pre-boot validation" in msg
+
+
+def test_flagship_leader_topology_is_clean():
+    from firedancer_tpu.models.leader_topo import build_leader_topology
+
+    assert check_topology(build_leader_topology()) == []
+
+
+# -- AST rules ---------------------------------------------------------------
+
+
+_FRAG_SRC = '''
+import time, random
+
+class MyStage:
+    def after_frag(self, in_idx, meta, payload):
+        v = self.result.item()             # FD201
+        a = np.asarray(self.mask)          # FD201
+        jax.device_get(a)                  # FD201
+        self.mask.block_until_ready()      # FD201
+        x = float(payload[0])              # FD201 (non-constant arg)
+        y = float("inf")                   # ok: constant
+        t = time.monotonic()               # FD202
+        r = random.randrange(8)            # FD203
+        h = hash(payload)                  # FD204
+
+    def during_housekeeping(self):
+        import numpy as np
+        return np.asarray(self.mask)       # ok: housekeeping is blessed
+'''
+
+
+def test_frag_rules_fire_and_scope_to_frag_bodies():
+    findings = ast_rules.lint_source(_FRAG_SRC, "synth.py")
+    ids = [f.rule for f in findings]
+    assert ids.count("FD201") == 5
+    assert "FD202" in ids and "FD203" in ids and "FD204" in ids
+    # the housekeeping np.asarray produced nothing
+    hk_line = _FRAG_SRC[:_FRAG_SRC.index("during_housekeeping")].count("\n") + 1
+    assert all(f.line < hk_line for f in findings if f.rule == "FD201")
+
+
+def test_frag_rules_see_through_import_aliases():
+    """`from time import monotonic` / `import numpy as xp` must not
+    evade the module-call rules the PR's own fixes rely on."""
+    src = '''
+from time import monotonic as mono
+from random import randrange
+import numpy as xp
+
+class S:
+    def after_frag(self, i, m, p):
+        t = mono()
+        a = xp.asarray(p)
+        r = randrange(4)
+'''
+    ids = sorted(f.rule for f in ast_rules.lint_source(src, "synth.py"))
+    assert ids == ["FD201", "FD202", "FD203"]
+
+
+def test_fd205_ignores_defs_in_nested_class_scopes():
+    """A method of a nested class does not shadow the module-level
+    builder the Name resolves to — no false positive."""
+    src = '''
+def wire(topo):
+    class Helper:
+        def build_x(self):
+            return None
+    topo.stage("a", build_x)
+'''
+    assert ast_rules.lint_source(src, "synth.py") == []
+
+
+def test_fd105_unaligned_dcache_sz():
+    from firedancer_tpu.tango import shm
+    from firedancer_tpu.tango.rings import DCache
+
+    odd = DCache.footprint(256, 64) + 8  # big enough, but not 64-aligned
+    topo = _wired_pair(dcache_sz=odd)
+    f = [x for x in check_topology(topo) if x.rule == "FD105"]
+    assert f and "granule" in f[0].msg
+    with pytest.raises(ValueError):
+        shm.ShmLink.create("fdtpu_test_fd105c", depth=64, mtu=256,
+                           dcache_sz=odd)
+
+
+def test_fd205_lambda_and_nested_builders():
+    src = '''
+def wire(topo):
+    def local_builder(links, cnc):
+        return None
+    topo.stage("a", lambda links, cnc: None)
+    topo.stage("b", local_builder)
+    topo.stage("c", module_builder)
+'''
+    findings = ast_rules.lint_source(src, "synth.py")
+    assert [f.rule for f in findings] == ["FD205", "FD205"]
+
+
+def test_fd206_bare_except_unless_reraised():
+    src = '''
+try:
+    x = 1
+except:
+    pass
+try:
+    y = 2
+except:
+    raise
+'''
+    findings = ast_rules.lint_source(src, "synth.py")
+    assert [f.rule for f in findings] == ["FD206"]
+    assert findings[0].line == 4
+
+
+def test_fd200_unparseable_file():
+    findings = ast_rules.lint_source("def broken(:\n", "synth.py")
+    assert [f.rule for f in findings] == ["FD200"]
+
+
+def test_inline_disable_suppresses_named_rule_only():
+    src = ("class S:\n"
+           "    def after_frag(self, i, m, p):\n"
+           "        t = time.time()  "
+           "# fdlint: disable=FD202 -- latency probe\n"
+           "        h = hash(p)\n")
+    findings = ast_rules.lint_source(src, "synth.py")
+    by_rule = {f.rule: f for f in findings}
+    assert by_rule["FD202"].suppressed == "inline"
+    assert by_rule["FD204"].suppressed is None
+
+
+def test_baseline_grandfathers_exact_counts(tmp_path):
+    base = tmp_path / "baseline.toml"
+    base.write_text(
+        '[[suppress]]\npath = "synth.py"\nrule = "FD204"\ncount = 1\n'
+        'reason = "test"\n'
+    )
+    src = "a = hash(b)\nc = hash(d)\n"
+    findings = ast_rules.lint_source(src, "synth.py")
+    bl.apply_baseline(findings, bl.load_baseline(str(base)))
+    assert [f.suppressed for f in findings] == ["baseline", None]
+
+
+def test_write_baseline_roundtrip(tmp_path):
+    src = tmp_path / "mod.py"
+    src.write_text("a = hash(b)\n")
+    base = tmp_path / "generated.toml"
+    rc = fdcli.main(["--write-baseline", "--no-topo",
+                     "--baseline", str(base), str(src)])
+    assert rc == 0
+    # with the generated baseline the same tree is clean
+    assert fdcli.main(["--no-topo", "--baseline", str(base),
+                       str(src)]) == 0
+    # without it, the finding fails the run
+    assert fdcli.main(["--no-topo", "--no-baseline", str(src)]) == 1
+
+
+# -- the tier-1 gate + fixed-violation regressions ---------------------------
+
+
+def test_fdlint_script_runs_clean_over_shipped_tree():
+    """Satellite: scripts/fdlint.sh = compileall + analyzer, exit 0.
+    This is the CI hook — any new violation in the package fails here."""
+    r = subprocess.run(
+        ["bash", os.path.join(REPO, "scripts", "fdlint.sh")],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert r.returncode == 0, f"fdlint gate failed:\n{r.stdout}\n{r.stderr}"
+    assert "clean" in r.stdout
+
+
+def test_fixed_violations_stay_fixed():
+    """The three true positives fdlint found were FIXED, not baselined:
+    their files now lint clean, and the baseline has no entry for them."""
+    for mod in ("runtime/stage.py", "runtime/verify.py",
+                "runtime/pack_stage.py"):
+        findings = [f for f in ast_rules.lint_file(os.path.join(PKG, mod))
+                    if get_rule(f.rule).severity == "error"]
+        assert findings == [], f"{mod}: {[f.format() for f in findings]}"
+    assert bl.load_baseline() == {}
+
+
+def test_stage_housekeeping_phase_survives_hash_salt():
+    """Regression for the FD204 fix: the housekeeping schedule derived
+    from (name, seed) must be identical across interpreters with
+    different hash salts — exactly what builtin hash(name) broke for
+    every spawned child."""
+    prog = (
+        "from firedancer_tpu.runtime.stage import Stage\n"
+        "s = Stage('verify0', seed=7)\n"
+        "s._housekeeping()\n"
+        "print(s._next_housekeeping)\n"
+    )
+    outs = set()
+    for salt in ("0", "1"):
+        env = {**os.environ, "PYTHONHASHSEED": salt, "JAX_PLATFORMS": "cpu"}
+        r = subprocess.run([sys.executable, "-c", prog], env=env,
+                           capture_output=True, text=True, timeout=120,
+                           cwd=REPO)
+        assert r.returncode == 0, r.stderr
+        outs.add(r.stdout.strip())
+    assert len(outs) == 1, f"schedule depends on hash salt: {outs}"
+
+
+def test_verify_deadline_close_still_works():
+    """The FD202 fix moved deadline stamping to before_credit (the hook
+    run_once calls unconditionally every iteration, unlike after_credit
+    which is skipped under backpressure); a partial batch must still
+    close once the deadline passes."""
+    import time as _time
+
+    from firedancer_tpu.runtime.verify import VerifyStage
+
+    st = VerifyStage("v", batch=8, batch_deadline_s=0.01,
+                     precomputed_ok=True)
+    from firedancer_tpu.runtime.benchg import gen_transfer_pool
+
+    payload = gen_transfer_pool(1)[0]
+    meta = [0] * 8
+    st.after_frag(0, meta, payload)
+    assert st._gen.elems and st._gen.opened_at == 0.0
+    st.before_credit()  # stamps the clock (even under backpressure)
+    assert st._gen.opened_at > 0.0
+    _time.sleep(0.02)
+    st.after_credit()  # deadline passed -> closes + dispatches
+    assert not st._gen.elems
+    st.flush()
+    assert st.metrics.get("txn_verified") == 1
+
+
+def test_partial_declaration_never_fires_absence_rules():
+    """A hand-wired (undeclared) stage may be the missing producer or
+    consumer: FD102/FD103 need the FULL graph declared, while
+    evidence-based rules (here FD101) still fire on the subset."""
+    topo = ft.Topology()
+    topo.link("l0", depth=64, mtu=256)
+    topo.stage("mystery", _builder)  # actually produces l0, undeclared
+    topo.stage("sink", _builder, ins=["l0"])
+    assert check_topology(topo) == []
+    topo.validate()  # launch() accepts the mixed topology
+    # ...but a duplicate producer among the declared subset still fails
+    topo.stage("gen_a", _builder, outs=["l0"])
+    topo.stage("gen_b", _builder, outs=["l0"])
+    assert "FD101" in _ids(check_topology(topo))
