@@ -381,6 +381,30 @@ def run_comb_bench(args, batch: int, rounds: int, fetch) -> dict:
     }
 
 
+PIPELINE_MID_ARTIFACT = os.environ.get(
+    "FDTPU_BENCH_PIPELINE_MID_PATH",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "BENCH_pipeline_mid.json"),
+)
+
+
+def _persist_pipeline_mid(out: dict) -> None:
+    """Persist the host-pipeline numbers the moment they exist — the same
+    discipline FDTPU_BENCH_KERNEL_ONLY=1 applies to the kernel number: a
+    tunnel that wedges during the remaining accel extras must not erase
+    this round's measured pipeline evidence."""
+    try:
+        rec = dict(out)
+        rec["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        with open(PIPELINE_MID_ARTIFACT, "w") as f:
+            json.dump(rec, f)
+            f.write("\n")
+        print(f"# pipeline mid-run artifact persisted: {PIPELINE_MID_ARTIFACT}",
+              file=sys.stderr)
+    except OSError as e:
+        print(f"# pipeline mid-run artifact write failed: {e}", file=sys.stderr)
+
+
 def run_host_pipeline_bench() -> dict:
     """Pipeline machinery throughput NET of accelerator round trips: the
     verify stage runs with a precomputed all-pass mask (no device
@@ -434,10 +458,26 @@ def run_host_pipeline_bench() -> dict:
         target = n_txn - warm - 16
         last_progress_t = t0
         last_cnt = warm_exec
+        # per-stage breakdown, SAMPLED (every 8th sweep is clocked per
+        # stage, scaled back up) so the instrument costs ~1% of the run
+        # instead of two clock reads per stage per sweep
+        stage_s = {s.name: 0.0 for s in pipe.stages}
+        stage_s["pack.after_credit"] = 0.0
+        sample_every = 8
+        pc = time.perf_counter
         while executed_cnt() - warm_exec < target and it < 2_000_000:
-            for s in pipe.stages:
-                s.run_once()
-            pipe.pack.after_credit()
+            if it % sample_every == 0:
+                for s in pipe.stages:
+                    t1 = pc()
+                    s.run_once()
+                    stage_s[s.name] += pc() - t1
+                t1 = pc()
+                pipe.pack.after_credit()
+                stage_s["pack.after_credit"] += pc() - t1
+            else:
+                for s in pipe.stages:
+                    s.run_once()
+                pipe.pack.after_credit()
             it += 1
             if it % 512 == 0:
                 cur = executed_cnt()
@@ -468,10 +508,27 @@ def run_host_pipeline_bench() -> dict:
             f"({rate:.0f} txn/s, no device), commit p99 {p99_ms:.1f}ms",
             file=sys.stderr,
         )
+        # scale the sampled stage times back to the whole run; merge the
+        # bank stages into one lane (they share the executor)
+        breakdown_us = {}
+        if executed > 0:
+            scale = sample_every * 1e6 / executed
+            for name, sec in stage_s.items():
+                lane = "bank" if name.startswith("bank") else name
+                breakdown_us[lane] = round(
+                    breakdown_us.get(lane, 0.0) + sec * scale, 1
+                )
+            for lane, us in sorted(breakdown_us.items(), key=lambda kv: -kv[1]):
+                print(f"#   stage {lane:20s} {us:8.1f} us/txn",
+                      file=sys.stderr)
+        from firedancer_tpu.flamenco import exec_native
+
         out = {
             "pipeline_host_txn_per_s": round(rate, 1),
             "pipeline_host_commit_p99_ms": round(p99_ms, 2),
             "pipeline_host_txn_executed": executed,
+            "pipeline_host_stage_us_per_txn": breakdown_us,
+            "pipeline_host_native_exec": exec_native.available(),
         }
         if executed < target:
             out["pipeline_host_incomplete"] = True
@@ -482,6 +539,9 @@ def run_host_pipeline_bench() -> dict:
         except Exception as e:
             print(f"# verify stage loop bench failed: {type(e).__name__}",
                   file=sys.stderr)
+        # durable evidence first, before the caller's remaining (accel)
+        # sections get a chance to wedge
+        _persist_pipeline_mid(out)
         return out
     finally:
         pipe.close()

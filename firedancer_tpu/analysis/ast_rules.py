@@ -83,6 +83,33 @@ _MOD_CANON = {
 }
 
 
+def _native_imports(tree: ast.Module) -> tuple[set[str], set[str]]:
+    """Names bound to native-FFI surfaces for FD207: modules whose last
+    dotted segment mentions `native` (tango.native, protocol.txn_native,
+    flamenco.exec_native, tango.tcache_native, utils.nativebuild) plus
+    ctypes itself.  Returns (module aliases, from-imported names)."""
+    mods: set[str] = set()
+    funcs: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                last = a.name.split(".")[-1]
+                if "native" in last or a.name == "ctypes":
+                    mods.add(a.asname or a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            last = node.module.split(".")[-1]
+            if "native" in last or node.module == "ctypes":
+                for a in node.names:
+                    funcs.add(a.asname or a.name)
+            else:
+                for a in node.names:
+                    # `from pkg import txn_native as tn`: a native MODULE
+                    # imported by name — calls go through its alias
+                    if "native" in a.name:
+                        mods.add(a.asname or a.name)
+    return mods, funcs
+
+
 def _import_aliases(tree: ast.Module):
     """Resolve import aliasing so `import numpy as xp` / `from time
     import monotonic as mono` cannot evade the module-call rules.
@@ -123,13 +150,16 @@ def _local_defs(fn: ast.AST) -> set[str]:
 
 
 class _Linter(ast.NodeVisitor):
-    def __init__(self, path: str, mods=None, funcs=None):
+    def __init__(self, path: str, mods=None, funcs=None, nmods=None,
+                 nfuncs=None):
         self.path = path
         self.findings: list[Finding] = []
         self._frag_depth = 0  # >0 while inside a frag-callback body
         self._func_stack: list[ast.FunctionDef] = []
         self._mods = mods or {}  # import alias -> canonical module
         self._funcs = funcs or {}  # from-imported name -> (module, func)
+        self._nmods = nmods or set()  # FD207: native-module aliases
+        self._nfuncs = nfuncs or set()  # FD207: native from-imports
 
     def _resolve(self, node: ast.Call) -> tuple[str, str] | None:
         """Canonical (module, func) for a call, seeing through `import
@@ -220,6 +250,20 @@ class _Linter(ast.NodeVisitor):
                      f"time.{mf[1]}() in a frag callback; stamp deadlines"
                      " in before_credit/during_housekeeping instead"
                      " (after_credit is skipped under backpressure)")
+        # FD207: a native (ctypes) crossing per frag — the crossing
+        # itself costs ~1-3us, so it belongs at burst granularity (one
+        # call per drained burst / microblock, the fd_exec_batch shape)
+        dq = _dotted(node.func)
+        if dq is not None and (
+            "_lib" in dq
+            or dq[0] in self._nmods
+            or (len(dq) == 1 and dq[0] in self._nfuncs)
+        ):
+            self.hit("FD207", node,
+                     f"per-frag FFI crossing '{'.'.join(dq)}' in a frag"
+                     " callback; batch native calls at burst granularity"
+                     " (one crossing per drained burst, as"
+                     " flamenco/exec_native.fd_exec_batch)")
 
     def _check_builder_arg(self, node: ast.Call) -> None:
         """FD205: `<topo>.stage(name, builder, ...)` / `StageSpec(name,
@@ -292,7 +336,8 @@ def lint_source(source: str, path: str) -> list[Finding]:
         return [Finding(rule="FD200", path=path, line=e.lineno or 0,
                         msg=f"file does not parse: {e.msg}")]
     mods, funcs = _import_aliases(tree)
-    linter = _Linter(path, mods, funcs)
+    nmods, nfuncs = _native_imports(tree)
+    linter = _Linter(path, mods, funcs, nmods, nfuncs)
     linter.visit(tree)
     disabled = _disabled_lines(source)
     for f in linter.findings:

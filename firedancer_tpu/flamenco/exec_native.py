@@ -1,0 +1,218 @@
+"""ctypes binding for the native executor fast lane (native/fd_exec_native.cpp).
+
+The bank stage's per-microblock hot path: a drained burst of verified
+frags goes through ONE fd_exec_batch call — payloads + packed descriptors
+(the verify stage's trailer, fd_txn_parse's layout) + current funk values
+in, record writes + per-txn (status, fee) out.  The FFI crossing
+amortizes over the burst the same way stage.py's burst draining amortized
+loop overhead (fdlint FD207 enforces that discipline).
+
+Parity and fallback contract:
+
+  - `eligible_packed` is the Executor's routing classifier: a txn whose
+    every instruction is in the native subset (system transfers/creates/
+    assign/allocate, vote vote/vote_state_update/tower_sync) routes
+    native; CPI, BPF, nonces, lookup tables and unsupported variants go
+    through the Python lane byte-for-byte.
+  - the C++ side may still PUNT any txn it is not sure about (old vote
+    state versions, arithmetic Python's big ints would survive, bounds
+    surprises); the batch stops before that txn mutates anything and the
+    caller re-runs it in Python, then resubmits the remainder.
+  - `FDTPU_NATIVE_EXEC=0` disables the lane; a missing toolchain degrades
+    to the Python lane via NativeUnavailable (skip, never fail).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+
+from firedancer_tpu.utils.nativebuild import NativeUnavailable, build_so
+from firedancer_tpu.protocol.txn import (
+    SYSTEM_PROGRAM,
+    VOTE_PROGRAM,
+    _DESC_HDR,
+    _DESC_INSTR,
+)
+
+_SRC = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+    "fd_exec_native.cpp",
+)
+_SO = os.path.join(os.path.dirname(_SRC), "fd_exec_native.so")
+
+ENV_SWITCH = "FDTPU_NATIVE_EXEC"
+
+_REQ_MAGIC = 0x42584446  # 'FDXB'
+_RESP_MAGIC = 0x52584446  # 'FDXR'
+
+_U32 = struct.Struct("<I")
+_TXN_HEAD = struct.Struct("<HHB")
+_REC_HEAD = struct.Struct("<bQB")
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is None:
+        build_so(_SRC, _SO)
+        lib = ctypes.CDLL(_SO)
+        lib.fd_exec_batch.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint64,
+        ]
+        lib.fd_exec_batch.restype = ctypes.c_int64
+        _lib = lib
+    return _lib
+
+
+def enabled() -> bool:
+    """The env switch: FDTPU_NATIVE_EXEC=0 forces the Python lane."""
+    return os.environ.get(ENV_SWITCH, "1") != "0"
+
+
+def available() -> bool:
+    """enabled AND the .so loads (builds on demand; toolchain-less or
+    .so-less hosts degrade gracefully to the Python lane)."""
+    if not enabled():
+        return False
+    try:
+        _load()
+        return True
+    except (NativeUnavailable, OSError, AttributeError):
+        # AttributeError: a stale/foreign .so that CDLL loads but that
+        # lacks fd_exec_batch must degrade, not kill the bank stage
+        return False
+
+
+# -- eligibility classifier ----------------------------------------------------
+
+_HDR_SZ = _DESC_HDR.size  # 17
+_INSTR_SZ = _DESC_INSTR.size  # 9
+
+# VoteInstruction tags the native lane executes (Vote/VoteSwitch,
+# UpdateVoteState(Switch), TowerSync(Switch))
+NATIVE_VOTE_TAGS = frozenset((2, 6, 8, 9, 14, 15))
+# SystemInstruction tags routed to the Python lane (durable nonces)
+_NONCE_TAGS = frozenset((4, 5, 6, 7))
+
+
+def eligible_packed(payload: bytes, desc_bytes: bytes) -> bool:
+    """May this txn route native?  Works on the packed descriptor so the
+    zero-copy bank path never unpacks a Txn object for native traffic.
+    Conservative by design: the C++ side re-checks and punts."""
+    if len(desc_bytes) < _HDR_SZ or desc_bytes[13] != 0:  # lut_cnt
+        return False
+    acct_cnt = desc_bytes[8]
+    acct_off = desc_bytes[9] | (desc_bytes[10] << 8)
+    o = _HDR_SZ
+    for _ in range(desc_bytes[16]):  # instr_cnt
+        prog, _acnt, dsz, _aoff, doff = _DESC_INSTR.unpack_from(desc_bytes, o)
+        o += _INSTR_SZ
+        if prog >= acct_cnt:
+            return False
+        pa = acct_off + 32 * prog
+        pk = payload[pa : pa + 32]
+        if pk == SYSTEM_PROGRAM:
+            if dsz >= 4:
+                tag = int.from_bytes(payload[doff : doff + 4], "little")
+                if tag in _NONCE_TAGS:
+                    return False
+        elif pk == VOTE_PROGRAM:
+            if dsz >= 4:
+                tag = int.from_bytes(payload[doff : doff + 4], "little")
+                if tag not in NATIVE_VOTE_TAGS:
+                    return False
+            # dsz < 4: both lanes fail the txn with the same status
+        else:
+            return False  # BPF / other builtins / unknown programs
+    return True
+
+
+# -- batch runner --------------------------------------------------------------
+
+
+class BatchContext:
+    """One slot's native execution context: the request header (fee rate,
+    clock, slot-hashes sysvar) prebuilt once, reused per microblock."""
+
+    def __init__(
+        self,
+        *,
+        lamports_per_sig: int,
+        clock_slot: int | None = None,
+        clock_epoch: int | None = None,
+        slot_hashes: bytes | None = None,
+    ):
+        self._lib = _load()
+        sh = bytes(slot_hashes or b"")
+        self._fixed = (
+            struct.pack(
+                "<QBQQB",
+                lamports_per_sig,
+                1 if clock_slot is not None else 0,
+                clock_slot or 0,
+                clock_epoch or 0,
+                1 if sh else 0,
+            )
+            + _U32.pack(len(sh))
+            + sh
+        )
+
+    def run(self, entries) -> tuple[int, bool, list]:
+        """One fd_exec_batch call.  entries: [payload, desc_bytes, addrs,
+        vals, ...] lists — only the first four fields are read here.
+        Returns (n_done, punted, [(status, fee, [(acct_idx, value)])]).
+        """
+        parts = [struct.pack("<II", _REQ_MAGIC, len(entries)), self._fixed]
+        req_sz = 0
+        for e in entries:
+            payload, desc_bytes, _addrs, vals = e[0], e[1], e[2], e[3]
+            parts.append(_TXN_HEAD.pack(len(payload), len(desc_bytes),
+                                        len(vals)))
+            parts.append(payload)
+            parts.append(desc_bytes)
+            for v in vals:
+                v = v or b""
+                parts.append(_U32.pack(len(v)))
+                parts.append(v)
+                req_sz += len(v)
+            req_sz += len(payload) + 64
+        req = b"".join(parts)
+        cap = 4096 + 2 * req_sz
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            rc = self._lib.fd_exec_batch(req, len(req), buf, cap)
+            if rc == -2:
+                # a CreateAccount/Allocate burst can outgrow the heuristic
+                # capacity; the call is stateless, so retry bigger
+                cap *= 4
+                if cap > 1 << 28:
+                    raise NativeUnavailable("fd_exec_batch response > 256MB")
+                continue
+            if rc < 0:
+                raise NativeUnavailable(f"fd_exec_batch rc={rc}")
+            return self._parse(buf.raw[:rc])
+
+    @staticmethod
+    def _parse(buf: bytes) -> tuple[int, bool, list]:
+        magic, n_done = struct.unpack_from("<II", buf, 0)
+        if magic != _RESP_MAGIC:
+            raise NativeUnavailable("fd_exec_batch bad response magic")
+        punted = buf[8] != 0
+        o = 9
+        out = []
+        for _ in range(n_done):
+            status, fee, n_w = _REC_HEAD.unpack_from(buf, o)
+            o += _REC_HEAD.size
+            writes = []
+            for _ in range(n_w):
+                idx = buf[o]
+                (vlen,) = _U32.unpack_from(buf, o + 1)
+                o += 5
+                writes.append((idx, buf[o : o + vlen]))
+                o += vlen
+            out.append((status, fee, writes))
+        return n_done, punted, out

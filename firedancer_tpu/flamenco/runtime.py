@@ -391,6 +391,10 @@ class SlotExecution:
         # with a widened ancestor set: cache insertions from a speculative
         # competing block at this same slot must never gate this block
         self._block_seen: set[tuple[bytes, bytes]] = set()
+        # native executor fast lane (flamenco/exec_native.py), built
+        # lazily on the first execute_batch; False = unavailable/disabled
+        self._native_ctx = None
+        self._native_sh_blob = None
         self._table_cache: dict = {}  # ALT decode, once per block
         self._before: dict[bytes, bytes | None] = {}  # start-of-slot view
         self.results: list[TxnResult] = []
@@ -453,20 +457,206 @@ class SlotExecution:
         r = _execute_txn(self.funk, self.xid, payload, desc,
                          executor=self.executor, sysvars=self.sysvars,
                          extra=extra, durable_nonce=durable)
+        return self._finish(r, desc.signature_cnt, bh, sig)
+
+    def _finish(self, r: TxnResult, sig_cnt: int, bh, sig) -> TxnResult:
+        """Post-execution bookkeeping shared by the Python and native
+        lanes — the two must never disagree on the landed predicate."""
         if r.fee > 0:
             # the bank hash's signature count covers txns that LANDED
             # (fee-charged; dropped/gated txns leave no on-chain
             # footprint) — so a streaming leader and a replayer counting
             # only the recorded txns agree on the hash
-            self.signature_cnt += desc.signature_cnt
-        if self.status_cache is not None and r.fee > 0:
-            # any fee-charged txn occupies its signature (failed txns
-            # landed on chain too — fd_txncache records both); staged
-            # until the fork is chosen
-            self._block_seen.add((bh, sig))
-            self.status_cache.stage_insert(self.xid, bh, sig)
+            self.signature_cnt += sig_cnt
+            if self.status_cache is not None:
+                # any fee-charged txn occupies its signature (failed txns
+                # landed on chain too — fd_txncache records both); staged
+                # until the fork is chosen
+                self._block_seen.add((bh, sig))
+                self.status_cache.stage_insert(self.xid, bh, sig)
         self.results.append(r)
         return r
+
+    # -- native fast lane (flamenco/exec_native.py) ---------------------------
+
+    def _native_for_batch(self):
+        """The slot's native BatchContext, or None (disabled/unavailable).
+        Rebuilt if the slot-hashes sysvar blob was swapped out."""
+        sh = self.sysvars.get("slot_hashes")
+        if self._native_ctx is None or self._native_sh_blob is not sh:
+            from firedancer_tpu.flamenco import exec_native
+
+            self._native_sh_blob = sh
+            self._native_ctx = False
+            if exec_native.available():
+                clock_slot = clock_epoch = None
+                blob = self.sysvars.get("clock")
+                if blob:
+                    from firedancer_tpu.flamenco import types as T
+
+                    try:
+                        c = T.CLOCK.decode(blob, 0)[0]
+                        clock_slot, clock_epoch = c.slot, c.epoch
+                    except T.CodecError:
+                        pass  # no clock: vote txns fail typed, both lanes
+                try:
+                    self._native_ctx = exec_native.BatchContext(
+                        lamports_per_sig=LAMPORTS_PER_SIGNATURE,
+                        clock_slot=clock_slot,
+                        clock_epoch=clock_epoch,
+                        slot_hashes=sh,
+                    )
+                except exec_native.NativeUnavailable:
+                    pass
+        return self._native_ctx or None
+
+    @staticmethod
+    def _unpack_trailer(payload: bytes, desc_bytes: bytes) -> ft.Txn:
+        """Packed trailer -> validated Txn (decode_verified's contract)."""
+        try:
+            desc, end = ft.txn_unpack(desc_bytes)
+        except Exception as e:
+            raise ValueError(f"packed descriptor unparseable: {e}") from e
+        if end != len(desc_bytes):
+            raise ValueError("packed descriptor trailer size mismatch")
+        if not ft.txn_desc_valid(desc, len(payload)):
+            raise ValueError("packed descriptor fails validation")
+        return desc
+
+    def execute_batch(self, items) -> list[TxnResult]:
+        """Execute a burst of txns in block order, routing runs of
+        native-eligible txns through one FFI call each (the bank stage's
+        per-microblock commit path).  items: (payload, desc, desc_bytes)
+        tuples — desc (a Txn) or desc_bytes (the packed trailer) may be
+        None, not both.  Anything the native lane cannot take — Python
+        lane programs, lookup tables, stale blockhashes (durable-nonce
+        candidates), duplicate signatures — flushes the pending run and
+        goes through `execute` unchanged."""
+        base = len(self.results)
+        nat = self._native_for_batch()
+        if nat is not None:
+            from firedancer_tpu.flamenco.exec_native import eligible_packed
+        pend: list[list] = []   # [payload, desc_bytes, addrs, vals, bh, sig, sig_cnt]
+        pend_keys: set = set()
+
+        def fallback(payload, desc, desc_bytes):
+            if desc is None:
+                desc = self._unpack_trailer(payload, desc_bytes)
+            self.execute(payload, desc)
+
+        def flush():
+            if pend:
+                self._flush_native(nat, pend)
+                pend.clear()
+                pend_keys.clear()
+
+        for payload, desc, desc_bytes in items:
+            if nat is None:
+                fallback(payload, desc, desc_bytes)
+                continue
+            if desc_bytes is None:
+                desc_bytes = ft.txn_pack(desc)
+            psz = len(payload)
+            db = desc_bytes
+            if len(db) < 17:
+                flush()
+                fallback(payload, desc, desc_bytes)
+                continue
+            sig_cnt = db[1]
+            sig_off = db[2] | (db[3] << 8)
+            acct_cnt = db[8]
+            acct_off = db[9] | (db[10] << 8)
+            bh_off = db[11] | (db[12] << 8)
+            if (
+                db[13]  # lut_cnt: the ALT-resolution path is Python's
+                or sig_cnt == 0
+                or acct_cnt == 0
+                or sig_off + 64 > psz
+                or bh_off + 32 > psz
+                or acct_off + 32 * acct_cnt > psz
+                or not eligible_packed(payload, db)
+            ):
+                flush()
+                fallback(payload, desc, desc_bytes)
+                continue
+            bh = payload[bh_off : bh_off + 32]
+            sig = payload[sig_off : sig_off + 64]
+            if self.status_cache is not None and (
+                not self.status_cache.is_blockhash_valid(bh, self.slot)
+                or (bh, sig) in pend_keys
+                or (bh, sig) in self._block_seen
+                or self.status_cache.contains(bh, sig, self.ancestors)
+            ):
+                # stale blockhash (durable-nonce candidate) or duplicate:
+                # the Python gate owns these paths; a pending-run twin
+                # must land first so the duplicate gate sees it
+                flush()
+                fallback(payload, desc, desc_bytes)
+                continue
+            addrs = []
+            vals = []
+            q = self.funk.rec_query
+            before = self._before
+            for i in range(acct_cnt):
+                a = payload[acct_off + 32 * i : acct_off + 32 * (i + 1)]
+                addrs.append(a)
+                if a not in before:
+                    before[a] = q(self.parent_xid, a)
+                vals.append(q(self.xid, a))
+            pend.append([payload, desc_bytes, addrs, vals, bh, sig, sig_cnt])
+            pend_keys.add((bh, sig))
+        flush()
+        return self.results[base:]
+
+    def _run_gated(self, entry) -> None:
+        """Python-lane execution for an already-gated native entry (a
+        C++ punt): fresh blockhash, not a duplicate, no lookup tables."""
+        payload, desc_bytes, _addrs, _vals, bh, sig, sig_cnt = entry
+        desc = self._unpack_trailer(payload, desc_bytes)
+        r = _execute_txn(self.funk, self.xid, payload, desc,
+                         executor=self.executor, sysvars=self.sysvars,
+                         extra=([], []), durable_nonce=False)
+        self._finish(r, sig_cnt, bh, sig)
+
+    def _flush_native(self, nat, pend: list) -> None:
+        """Run the pending native-eligible txns in order: one FFI call
+        per run, punts re-routed through the Python lane, and the
+        remainder resubmitted with refreshed account values."""
+        from firedancer_tpu.flamenco import exec_native
+
+        i = 0
+        while i < len(pend):
+            chunk = pend[i:]
+            try:
+                n_done, punted, recs = nat.run(chunk)
+            except exec_native.NativeUnavailable:
+                # oversized response / native wedge: finish in Python
+                for entry in chunk:
+                    self._run_gated(entry)
+                return
+            for entry, (status, fee, writes) in zip(chunk, recs):
+                addrs = entry[2]
+                for idx, val in writes:
+                    self.funk.rec_insert(self.xid, addrs[idx], val)
+                self._finish(TxnResult(status, fee), entry[6], entry[4],
+                             entry[5])
+            i += n_done
+            if punted and i < len(pend):
+                self._run_gated(pend[i])
+                i += 1
+            elif n_done == 0 and not punted:
+                # defensive: a native lane that makes no progress must
+                # not spin — finish the remainder in Python
+                for entry in pend[i:]:
+                    self._run_gated(entry)
+                return
+            if i < len(pend):
+                # refresh the remainder's funk values: the overlay the
+                # next call starts with is empty, and the txns just
+                # committed (native or punt) may have written their accounts
+                for entry in pend[i:]:
+                    entry[3] = [self.funk.rec_query(self.xid, a)
+                                for a in entry[2]]
 
     def seal(self, poh_hash: bytes = b"\x00" * 32,
              waves: list[list[int]] | None = None) -> BlockResult:

@@ -81,6 +81,81 @@ def encode(data, parity_cnt: int):
     return par if batched else par[0]
 
 
+# -- host lane (native/fd_reedsol.cpp) ----------------------------------------
+# The leader's shredder encodes one-to-few FEC sets per entry batch, where
+# the device dispatch (+ fetch on tunneled backends) dwarfs the GF work.
+# The native kernel applies the SAME generator submatrix, so parity bytes
+# are identical; no toolchain -> numpy ground truth (gf256_ref).
+
+_HOST_LIB = None  # None = untried, False = unavailable
+
+
+@functools.lru_cache(maxsize=None)
+def _gen_parity_rows(d: int, p: int) -> bytes:
+    """G[d:] as contiguous (p, d) bytes for the native/ numpy host lane."""
+    return np.ascontiguousarray(gr.generator_matrix(d, d + p)[d:]).tobytes()
+
+
+def _host_lib():
+    global _HOST_LIB
+    if _HOST_LIB is None:
+        import ctypes
+        import os
+
+        from firedancer_tpu.utils.nativebuild import (
+            NativeUnavailable, build_so,
+        )
+
+        src = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))),
+            "native", "fd_reedsol.cpp",
+        )
+        so = os.path.join(os.path.dirname(src), "fd_reedsol.so")
+        try:
+            build_so(src, so)
+            lib = ctypes.CDLL(so)
+            lib.fd_reedsol_encode.argtypes = [
+                ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64,
+                ctypes.c_uint64, ctypes.c_uint64, ctypes.c_char_p,
+            ]
+            _HOST_LIB = lib
+        except (NativeUnavailable, OSError):
+            _HOST_LIB = False
+    return _HOST_LIB or None
+
+
+def encode_host(data: np.ndarray, parity_cnt: int) -> np.ndarray:
+    """Host-side encode, numpy in/out, no device round trip.  Same
+    shapes and parity bytes as encode()."""
+    data = np.ascontiguousarray(data, dtype=np.uint8)
+    batched = data.ndim == 3
+    if not batched:
+        data = data[None]
+    nsets, d, sz = data.shape
+    if not (0 < d <= DATA_SHREDS_MAX and 0 < parity_cnt <= PARITY_SHREDS_MAX):
+        raise ValueError("bad shred counts")
+    lib = _host_lib()
+    if lib is None:
+        # numpy ground truth: XOR-accumulated GF rank-1 updates
+        gen = np.frombuffer(_gen_parity_rows(d, parity_cnt),
+                            dtype=np.uint8).reshape(parity_cnt, d)
+        out = np.stack([gr.gf_matmul(gen, data[k]) for k in range(nsets)])
+        return out if batched else out[0]
+    import ctypes
+
+    gen = _gen_parity_rows(d, parity_cnt)
+    out = np.empty((nsets, parity_cnt, sz), dtype=np.uint8)
+    for k in range(nsets):
+        lib.fd_reedsol_encode(
+            gen,
+            data[k].tobytes(),
+            d, parity_cnt, sz,
+            out[k].ctypes.data_as(ctypes.c_char_p),
+        )
+    return out if batched else out[0]
+
+
 def recover(shreds, present, d: int):
     """Rebuild every shred of one FEC set from any >= d survivors.
 

@@ -81,6 +81,46 @@ def point_mul(s: int, p):
     return q
 
 
+# -- fixed-base comb ----------------------------------------------------------
+# The shredder signs every FEC set with the leader key, making [s]B the
+# host pipeline's hottest curve op.  A 4-bit windowed table over the
+# fixed base (64 windows x 16 entries, built lazily once) turns the
+# ~256-double/~128-add ladder into <= 63 additions; outputs are
+# byte-identical to point_mul(s, BASE).
+
+_BASE_COMB: list | None = None
+
+
+def _base_comb():
+    global _BASE_COMB
+    if _BASE_COMB is None:
+        tables = []
+        window_base = BASE
+        for _ in range(64):
+            row = [IDENT]
+            for _j in range(15):
+                row.append(point_add(row[-1], window_base))
+            tables.append(row)
+            for _k in range(4):
+                window_base = point_add(window_base, window_base)
+        _BASE_COMB = tables
+    return _BASE_COMB
+
+
+def point_mul_base(s: int):
+    """[s]B via the fixed-base comb (s < 2^256)."""
+    comb = _base_comb()
+    q = IDENT
+    i = 0
+    while s > 0:
+        nib = s & 15
+        if nib:
+            q = point_add(q, comb[i][nib])
+        s >>= 4
+        i += 1
+    return q
+
+
 def point_neg(p):
     x, y, z, t = p
     return (P - x if x else 0, y, z, P - t if t else 0)
@@ -131,16 +171,33 @@ def secret_expand(secret: bytes):
     return a, h[32:]
 
 
+# secret -> (a, prefix, compressed pubkey): signing re-derives all three
+# from SHA512(secret) every call, but a pipeline signs with a handful of
+# keys (the leader identity, benchg's payer pool) millions of times.
+# Bounded so adversarial key churn cannot grow it without limit.
+_KEY_CACHE: dict[bytes, tuple[int, bytes, bytes]] = {}
+_KEY_CACHE_MAX = 4096
+
+
+def _expanded(secret: bytes) -> tuple[int, bytes, bytes]:
+    hit = _KEY_CACHE.get(secret)
+    if hit is None:
+        a, prefix = secret_expand(secret)
+        hit = (a, prefix, point_compress(point_mul_base(a)))
+        if len(_KEY_CACHE) >= _KEY_CACHE_MAX:
+            _KEY_CACHE.clear()
+        _KEY_CACHE[secret] = hit
+    return hit
+
+
 def public_key(secret: bytes) -> bytes:
-    a, _ = secret_expand(secret)
-    return point_compress(point_mul(a, BASE))
+    return _expanded(secret)[2]
 
 
 def sign(secret: bytes, msg: bytes) -> bytes:
-    a, prefix = secret_expand(secret)
-    apk = point_compress(point_mul(a, BASE))
+    a, prefix, apk = _expanded(secret)
     r = _sha512_int(prefix, msg) % L
-    rpt = point_compress(point_mul(r, BASE))
+    rpt = point_compress(point_mul_base(r))
     k = _sha512_int(rpt, apk, msg) % L
     s = (r + k * a) % L
     return rpt + int.to_bytes(s, 32, "little")

@@ -40,7 +40,6 @@ import hashlib
 from firedancer_tpu.protocol import txn as ft
 from firedancer_tpu.tango.rings import MCache
 from .stage import Stage
-from .verify import decode_verified
 
 
 def parse_microblock(frame: bytes) -> tuple[int, list[bytes]]:
@@ -85,6 +84,12 @@ class BankCtx:
         self._parent_xid = parent_xid
         self._executor = executor
         self._sx = None
+        # force the native executor .so build/load NOW (one g++ shell-out
+        # on cold hosts), not inside the first microblock's after_frag —
+        # the same not-mid-stream discipline as verify.py's parser probe
+        from firedancer_tpu.flamenco import exec_native
+
+        exec_native.available()
 
     def fund(self, pubkey: bytes, lamports: int) -> None:
         """Genesis-style funding on the funk root (before the slot runs)."""
@@ -109,6 +114,11 @@ class BankCtx:
 
     def execute(self, payload: bytes, desc: ft.Txn):
         return self.sx.execute(payload, desc)
+
+    def execute_batch(self, items):
+        """One burst (microblock) through SlotExecution.execute_batch:
+        native-eligible txns ride the C++ lane in one FFI call."""
+        return self.sx.execute_batch(items)
 
     def seal(self, poh_hash: bytes):
         """End of slot: bank hash over the committed state."""
@@ -156,17 +166,25 @@ class BankStage(Stage):
         from firedancer_tpu.flamenco.runtime import TXN_SUCCESS
 
         mb_seq, frags = parse_microblock(payload)
+        # zero-copy commit path: the verified frag already carries
+        # payload || packed descriptor || u16 payload_sz, which is exactly
+        # what the native lane consumes — no Txn unpack for native
+        # traffic (execute_batch unpacks + validates only on fallback)
+        items = []
+        for frag in frags:
+            psz = int.from_bytes(frag[-2:], "little")
+            items.append((frag[:psz], None, frag[psz:-2]))
+        results = self.ctx.execute_batch(items)
         sigs = []
         txns = []
-        for frag in frags:
-            p, desc = decode_verified(frag)
-            r = self.ctx.execute(p, desc)
+        for (p, _desc, db), r in zip(items, results):
             # landed == fee charged: the SAME predicate SlotExecution
             # uses for signature_cnt and status-cache staging — the two
             # must never disagree or replay diverges from the sealed hash
             if r.fee > 0:
                 # landed (fee-charged, possibly failed): part of the block
-                sigs.append(desc.signatures(p)[0])
+                sig_off = db[2] | (db[3] << 8)
+                sigs.append(p[sig_off : sig_off + 64])
                 txns.append(p)
                 self.metrics.inc("txn_exec")
                 if r.status != TXN_SUCCESS:

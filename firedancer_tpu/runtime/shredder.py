@@ -110,6 +110,11 @@ class Shredder:
     data_idx_offset: int = 0
     parity_idx_offset: int = 0
 
+    def __post_init__(self):
+        # build/load the native RS encoder now, not when the first FEC
+        # set of a leader slot is mid-flight (cold hosts shell out to g++)
+        reedsol._host_lib()
+
     def entry_batch_to_fec_sets(
         self,
         entry_batch: bytes,
@@ -203,7 +208,9 @@ class Shredder:
                         bytes(buf[fs.SIGNATURE_SZ : fs.SIGNATURE_SZ + elt_sz]),
                         dtype=np.uint8,
                     )
-            par = np.asarray(reedsol.encode(stack, p))  # (nsets, p, elt_sz)
+            # host lane: one-to-few sets per batch is dispatch-bound on
+            # the device path (native/fd_reedsol.cpp; parity-identical)
+            par = reedsol.encode_host(stack, p)  # (nsets, p, elt_sz)
             for k, set_i in enumerate(idxs):
                 parity_by_set[set_i] = par[k]
 

@@ -52,13 +52,28 @@ from .stage import Stage
 # (C++) parser — differentially proven byte-identical — and fall back to
 # the python parser where no toolchain exists
 try:
-    from firedancer_tpu.protocol.txn_native import txn_parse_native as _txn_parse
+    from firedancer_tpu.protocol.txn_native import txn_parse_packed as _txn_packed
 
-    _txn_parse(b"")  # force the .so build/load now, not mid-stream
+    _txn_packed(b"")  # force the .so build/load now, not mid-stream
     PARSER = "native"
 except Exception:  # pragma: no cover - toolchain-less environment
-    _txn_parse = ft.txn_parse
+    _txn_packed = None
     PARSER = "python"
+
+
+def _parse_pair(payload: bytes):
+    """-> (Txn, packed-descriptor bytes | None); (None, None) on reject.
+    The native parser emits the packed trailer directly, so _emit never
+    re-serializes the descriptor (zero-copy through to the bank lane)."""
+    if _txn_packed is not None:
+        packed = _txn_packed(payload)
+        if packed is None:
+            return None, None
+        desc, end = ft.txn_unpack(packed)
+        if end != len(packed):
+            return None, None
+        return desc, packed
+    return ft.txn_parse(payload), None
 
 MCACHE_COL_TSORIG = MCache.COL_TSORIG
 
@@ -77,7 +92,7 @@ class _Pending:
     """A device batch in flight: txns + their element ranges + the future."""
 
     payloads: list[bytes]
-    descs: list[ft.Txn]
+    descs: list  # [(Txn, packed-desc | None)]
     elem_ranges: list[tuple[int, int]]
     tsorigs: list[int]
     n_elems: int
@@ -89,7 +104,7 @@ class _Acc:
     """One accumulating fixed-shape batch (generic or cached-signer)."""
 
     payloads: list[bytes] = field(default_factory=list)
-    descs: list[ft.Txn] = field(default_factory=list)
+    descs: list = field(default_factory=list)  # [(Txn, packed | None)]
     elems: list[tuple[bytes, bytes, bytes]] = field(default_factory=list)
     ranges: list[tuple[int, int]] = field(default_factory=list)
     tsorigs: list[int] = field(default_factory=list)
@@ -150,7 +165,7 @@ class VerifyStage(Stage):
         return (seq % self.shard_cnt) == self.shard_idx
 
     def after_frag(self, in_idx: int, meta, payload: bytes) -> None:
-        t = _txn_parse(payload)
+        t, packed = _parse_pair(payload)
         if t is None:
             self.metrics.inc("parse_fail")
             return
@@ -181,7 +196,7 @@ class VerifyStage(Stage):
                 acc.slots.append(slots[i])
         acc.ranges.append((start, len(acc.elems)))
         acc.payloads.append(payload)
-        acc.descs.append(t)
+        acc.descs.append((t, packed))
         acc.tsorigs.append(int(meta[MCACHE_COL_TSORIG]))
         if len(acc.elems) >= self.batch:
             self._close_batch(acc)
@@ -393,8 +408,11 @@ class VerifyStage(Stage):
             if block:
                 break
 
-    def _emit(self, payload: bytes, desc: ft.Txn, tsorig: int = 0) -> None:
-        out = encode_verified(payload, desc)
+    def _emit(self, payload: bytes, desc_pair, tsorig: int = 0) -> None:
+        desc, packed = desc_pair
+        if packed is None:
+            packed = ft.txn_pack(desc)
+        out = encode_verified_packed(payload, packed)
         if self.outs:
             # first signature's tag rides in the frag sig for cheap dedup
             self.publish(
@@ -412,6 +430,14 @@ class VerifyStage(Stage):
             self._drain(block=True)
 
 
+def encode_verified_packed(payload: bytes, packed: bytes) -> bytes:
+    """The verified-frag framing, ONE place: payload || packed-descriptor
+    trailer || u16 payload_sz.  Every producer (encode_verified, _emit's
+    native-parser fast path) and consumer (decode_verified, the bank
+    stage's zero-copy reader) speaks this layout."""
+    return payload + packed + len(payload).to_bytes(2, "little")
+
+
 def encode_verified(payload: bytes, desc: ft.Txn) -> bytes:
     """payload || packed-descriptor trailer || u16 payload_sz.
 
@@ -421,7 +447,7 @@ def encode_verified(payload: bytes, desc: ft.Txn) -> bytes:
     a real wire format, safe across trust/process boundaries and readable
     by the native runtime.
     """
-    return payload + ft.txn_pack(desc) + len(payload).to_bytes(2, "little")
+    return encode_verified_packed(payload, ft.txn_pack(desc))
 
 
 def decode_verified(frag: bytes) -> tuple[bytes, ft.Txn]:

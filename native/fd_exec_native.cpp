@@ -1,0 +1,964 @@
+// Native executor fast lane: system + vote transactions, batched per
+// microblock.
+//
+// Counterpart of the reference's hand-optimized bank-tile lanes
+// (fd_system_program.c / fd_vote_program.c): the two dominant txn shapes
+// execute entirely in C++ against account values in the funk wire format
+// (flamenco/executor.py acct_encode/acct_decode: u64 lamports | 32B owner
+// | u8 executable | data).  One fd_exec_batch call executes a whole
+// microblock: the Python bank stage drains its burst, sends payloads +
+// packed descriptors (fd_txn_parse's layout) + current account values in
+// one request, and applies the returned record writes straight to funk —
+// zero Account-object traffic on the hot path.
+//
+// Parity contract (differentially tested against flamenco/runtime.py
+// _execute_txn + programs.py/vote_program.py): identical status codes,
+// fees, and final account bytes.  Anything this lane is not SURE about —
+// other programs, nonce instructions, vote state versions != current,
+// lookup tables, arithmetic overflow that Python's big ints would survive
+// — raises Punt: the batch stops BEFORE the txn mutates anything, the
+// caller executes that txn through the Python lane, and resubmits the
+// remainder.  Sequential semantics hold across the batch via an account
+// overlay (a txn reads every earlier txn's committed writes).
+//
+// Status codes mirror flamenco/runtime.py:
+//   0 success | -1 fee payer short (no fee) | -2 insufficient funds
+//   -3 account error | -4 program error     (-2/-3/-4 still pay the fee)
+//
+// Build: scripts/build_native.sh (g++ -O2 -shared -fPIC).
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <array>
+#include <vector>
+
+namespace {
+
+typedef uint8_t u8;
+typedef uint16_t u16;
+typedef uint32_t u32;
+typedef uint64_t u64;
+typedef int64_t i64;
+typedef unsigned __int128 u128;
+
+typedef std::array<u8, 32> Key;
+
+constexpr i64 TXN_SUCCESS = 0;
+constexpr i64 ST_FEE = -1;
+constexpr i64 ST_FUNDS = -2;
+constexpr i64 ST_ACCT = -3;
+constexpr i64 ST_PROG = -4;
+
+constexpr u64 MAX_PERMITTED_DATA_LENGTH = 10ull * 1024 * 1024;
+constexpr u64 U64_MAX = ~0ull;
+
+// VoteState machine constants (flamenco/vote_program.py)
+constexpr unsigned MAX_LOCKOUT_HISTORY = 31;
+constexpr unsigned VOTE_CREDITS_GRACE_SLOTS = 2;
+constexpr unsigned VOTE_CREDITS_MAXIMUM_PER_SLOT = 16;
+constexpr unsigned MAX_EPOCH_CREDITS_HISTORY = 64;
+
+static const Key SYS_KEY = {};  // system program: 32 zero bytes
+// "Vote111111111111111111111111111111111111111" (protocol/txn.py)
+static const Key VOTE_KEY = {
+    0x07, 0x61, 0x48, 0x1d, 0x35, 0x74, 0x74, 0xbb,
+    0x7c, 0x4d, 0x76, 0x24, 0xeb, 0xd3, 0xbd, 0xb3,
+    0xd8, 0x35, 0x5e, 0x73, 0xd1, 0x10, 0x43, 0xfc,
+    0x0d, 0xa3, 0x53, 0x80, 0x00, 0x00, 0x00, 0x00,
+};
+
+// typed failures: InstrError family mapped to the runtime's txn status
+struct Err { i64 status; };
+// this lane is not sure -> the caller runs the txn through Python
+struct Punt {};
+
+static inline u16 rd16(const u8* p) { return (u16)p[0] | ((u16)p[1] << 8); }
+static inline u32 rd32(const u8* p) {
+  return (u32)p[0] | ((u32)p[1] << 8) | ((u32)p[2] << 16) | ((u32)p[3] << 24);
+}
+static inline u64 rd64(const u8* p) {
+  u64 v = 0;
+  for (int i = 7; i >= 0; i--) v = (v << 8) | p[i];
+  return v;
+}
+static inline void wr32(u8* p, u32 v) {
+  p[0] = (u8)v; p[1] = (u8)(v >> 8); p[2] = (u8)(v >> 16); p[3] = (u8)(v >> 24);
+}
+static inline void wr64(u8* p, u64 v) {
+  for (int i = 0; i < 8; i++) { p[i] = (u8)v; v >>= 8; }
+}
+
+// -- account wire format (executor.acct_encode/acct_decode) ------------------
+
+struct Acct {
+  Key key;
+  u64 lamports = 0;
+  Key owner = {};
+  bool exec = false;
+  std::vector<u8> data;
+
+  bool exists() const {
+    return lamports > 0 || !data.empty() || owner != SYS_KEY;
+  }
+  bool same_state(const Acct& o) const {
+    return lamports == o.lamports && owner == o.owner && exec == o.exec &&
+           data == o.data;
+  }
+};
+
+static void acct_decode(const u8* v, u64 n, Acct& a) {
+  if (n == 0) {  // missing record: the zero system account
+    a.lamports = 0; a.owner = SYS_KEY; a.exec = false; a.data.clear();
+    return;
+  }
+  if (n < 41) {  // legacy u64||data records (short lamport reads allowed)
+    u64 lam = 0;
+    u64 k = n < 8 ? n : 8;
+    for (u64 i = 0; i < k; i++) lam |= (u64)v[i] << (8 * i);
+    a.lamports = lam;
+    a.owner = SYS_KEY;
+    a.exec = false;
+    a.data.assign(n > 8 ? v + 8 : v, n > 8 ? v + n : v);
+    if (n <= 8) a.data.clear();
+    return;
+  }
+  a.lamports = rd64(v);
+  std::memcpy(a.owner.data(), v + 8, 32);
+  a.exec = v[40] != 0;
+  a.data.assign(v + 41, v + n);
+}
+
+static void acct_encode(const Acct& a, std::vector<u8>& out) {
+  out.resize(41 + a.data.size());
+  wr64(out.data(), a.lamports);
+  std::memcpy(out.data() + 8, a.owner.data(), 32);
+  out[40] = a.exec ? 1 : 0;
+  if (!a.data.empty())
+    std::memcpy(out.data() + 41, a.data.data(), a.data.size());
+}
+
+// -- packed txn descriptor (protocol/txn.py txn_pack layout) -----------------
+
+struct Instr {
+  u8 prog;
+  u16 acct_cnt, data_sz, acct_off, data_off;
+};
+
+struct Desc {
+  u8 version, sig_cnt;
+  u16 sig_off, msg_off;
+  u8 ro_signed, ro_unsigned, acct_cnt;
+  u16 acct_off, bh_off;
+  u8 lut_cnt, adtl_w, adtl, instr_cnt;
+  Instr instrs[64];
+};
+
+static void parse_desc(const u8* b, u64 n, Desc& d) {
+  if (n < 17) throw Punt{};
+  d.version = b[0]; d.sig_cnt = b[1];
+  d.sig_off = rd16(b + 2); d.msg_off = rd16(b + 4);
+  d.ro_signed = b[6]; d.ro_unsigned = b[7]; d.acct_cnt = b[8];
+  d.acct_off = rd16(b + 9); d.bh_off = rd16(b + 11);
+  d.lut_cnt = b[13]; d.adtl_w = b[14]; d.adtl = b[15]; d.instr_cnt = b[16];
+  if (d.instr_cnt > 64) throw Punt{};
+  if (n != 17ull + 9ull * d.instr_cnt + 10ull * d.lut_cnt) throw Punt{};
+  const u8* p = b + 17;
+  for (u32 k = 0; k < d.instr_cnt; k++, p += 9) {
+    d.instrs[k].prog = p[0];
+    d.instrs[k].acct_cnt = rd16(p + 1);
+    d.instrs[k].data_sz = rd16(p + 3);
+    d.instrs[k].acct_off = rd16(p + 5);
+    d.instrs[k].data_off = rd16(p + 7);
+  }
+}
+
+// Txn.is_writable (protocol/txn.py)
+static bool is_writable(const Desc& d, u32 idx) {
+  if (idx < d.acct_cnt) {
+    if (idx < d.sig_cnt) return idx < (u32)(d.sig_cnt - d.ro_signed);
+    return idx < (u32)(d.acct_cnt - d.ro_unsigned);
+  }
+  return idx < (u32)(d.acct_cnt + d.adtl_w);
+}
+
+// -- bincode cursor (flamenco/types.py semantics: short read = CodecError) ---
+
+struct Rd {
+  const u8* p;
+  u64 n, i;
+  void need(u64 k) { if (i + k > n) throw Err{ST_PROG}; }
+  u8 get8() { need(1); return p[i++]; }
+  u32 get32() { need(4); u32 v = rd32(p + i); i += 4; return v; }
+  u64 get64() { need(8); u64 v = rd64(p + i); i += 8; return v; }
+  i64 geti64() { u64 v = get64(); i64 s; std::memcpy(&s, &v, 8); return s; }
+  void getkey(Key& k) { need(32); std::memcpy(k.data(), p + i, 32); i += 32; }
+  bool getbool() {
+    u8 b = get8();
+    if (b > 1) throw Err{ST_PROG};
+    return b == 1;
+  }
+};
+
+// -- slot hashes sysvar ------------------------------------------------------
+
+struct SlotHashes {
+  bool ok = true;          // blob well-formed (malformed -> -4 at use)
+  std::vector<std::pair<u64, Key>> e;
+
+  bool contains(u64 s) const {
+    for (auto& kv : e) if (kv.first == s) return true;
+    return false;
+  }
+  // dict(list) semantics: the LAST duplicate entry wins
+  const Key* get(u64 s) const {
+    const Key* hit = nullptr;
+    for (auto& kv : e) if (kv.first == s) hit = &kv.second;
+    return hit;
+  }
+};
+
+static void parse_slot_hashes(const u8* p, u64 n, SlotHashes& sh) {
+  sh.e.clear();
+  sh.ok = false;
+  if (n < 8) return;
+  u64 cnt = rd64(p);
+  if (cnt > 512) return;  // Vec max_len=512 -> CodecError in Python
+  if (n != 8 + cnt * 40) return;  // loads() rejects trailing bytes
+  const u8* q = p + 8;
+  for (u64 k = 0; k < cnt; k++, q += 40) {
+    Key h;
+    std::memcpy(h.data(), q + 8, 32);
+    sh.e.emplace_back(rd64(q), h);
+  }
+  sh.ok = true;
+}
+
+// -- vote state (flamenco/agave_state.py, current version only) --------------
+
+struct Lk { u64 slot; u32 conf; };
+struct LV { u8 latency; Lk lk; };
+
+struct VoteSt {
+  Key node = {}, withdrawer = {};
+  u8 commission = 0;
+  std::vector<LV> votes;
+  bool has_root = false;
+  u64 root = 0;
+  std::map<u64, Key> auth;  // epoch -> authorized voter (BTreeMap)
+  u8 prior_raw[1536];       // 32 x (pubkey, u64, u64): opaque passthrough
+  u64 prior_idx = 31;
+  bool prior_empty = true;
+  std::vector<std::array<u64, 3>> credits;  // (epoch, credits, prev)
+  u64 ts_slot = 0;
+  i64 ts_ts = 0;
+};
+
+static void vote_state_decode(const u8* p, u64 n, VoteSt& vs) {
+  Rd r{p, n, 0};
+  u32 tag = r.get32();
+  if (tag != 2) {
+    if (tag <= 1) throw Punt{};  // old versions: the Python lane upgrades
+    throw Err{ST_PROG};          // unknown version -> CodecError
+  }
+  r.getkey(vs.node);
+  r.getkey(vs.withdrawer);
+  vs.commission = r.get8();
+  u64 nv = r.get64();
+  if (nv > 64) throw Err{ST_PROG};  // Vec(LANDED_VOTE, max_len=64)
+  vs.votes.clear();
+  for (u64 k = 0; k < nv; k++) {
+    LV lv;
+    lv.latency = r.get8();
+    lv.lk.slot = r.get64();
+    lv.lk.conf = r.get32();
+    vs.votes.push_back(lv);
+  }
+  u8 opt = r.get8();
+  if (opt > 1) throw Err{ST_PROG};
+  vs.has_root = opt == 1;
+  vs.root = vs.has_root ? r.get64() : 0;
+  u64 na = r.get64();
+  if (na > 1024) throw Err{ST_PROG};
+  vs.auth.clear();
+  for (u64 k = 0; k < na; k++) {
+    u64 epoch = r.get64();
+    Key pk;
+    r.getkey(pk);
+    vs.auth[epoch] = pk;  // duplicate keys: later wins (dict semantics)
+  }
+  r.need(1536);
+  std::memcpy(vs.prior_raw, r.p + r.i, 1536);
+  r.i += 1536;
+  vs.prior_idx = r.get64();
+  vs.prior_empty = r.getbool();
+  u64 nc = r.get64();
+  if (nc > 4096) throw Err{ST_PROG};
+  vs.credits.clear();
+  for (u64 k = 0; k < nc; k++) {
+    std::array<u64, 3> t;
+    t[0] = r.get64(); t[1] = r.get64(); t[2] = r.get64();
+    vs.credits.push_back(t);
+  }
+  vs.ts_slot = r.get64();
+  vs.ts_ts = r.geti64();
+  // trailing bytes (zero padding to the account size) are ignored, as
+  // the Python decode (decode, not loads) does
+}
+
+static void vote_state_encode(const VoteSt& vs, std::vector<u8>& out) {
+  out.clear();
+  out.reserve(3762);
+  auto put8 = [&](u8 v) { out.push_back(v); };
+  auto put32 = [&](u32 v) {
+    size_t o = out.size(); out.resize(o + 4); wr32(out.data() + o, v);
+  };
+  auto put64 = [&](u64 v) {
+    size_t o = out.size(); out.resize(o + 8); wr64(out.data() + o, v);
+  };
+  auto putkey = [&](const Key& k) {
+    out.insert(out.end(), k.begin(), k.end());
+  };
+  put32(2);  // VoteStateVersions::Current
+  putkey(vs.node);
+  putkey(vs.withdrawer);
+  put8(vs.commission);
+  put64(vs.votes.size());
+  for (auto& lv : vs.votes) {
+    put8(lv.latency);
+    put64(lv.lk.slot);
+    put32(lv.lk.conf);
+  }
+  if (vs.has_root) { put8(1); put64(vs.root); } else { put8(0); }
+  put64(vs.auth.size());
+  for (auto& kv : vs.auth) { put64(kv.first); putkey(kv.second); }
+  out.insert(out.end(), vs.prior_raw, vs.prior_raw + 1536);
+  put64(vs.prior_idx);
+  put8(vs.prior_empty ? 1 : 0);
+  put64(vs.credits.size());
+  for (auto& t : vs.credits) { put64(t[0]); put64(t[1]); put64(t[2]); }
+  put64(vs.ts_slot);
+  u64 uts;
+  std::memcpy(&uts, &vs.ts_ts, 8);
+  put64(uts);
+}
+
+}  // namespace
+
+namespace {
+
+// -- vote state machine (flamenco/vote_program.py, line-for-line) ------------
+
+static bool lockout_expired(const Lk& lk, u64 next_slot) {
+  // slot + 2^conf < next_slot; conf >= 64 can never expire within u64
+  if (lk.conf >= 64) return false;
+  return (u128)lk.slot + ((u128)1 << lk.conf) < (u128)next_slot;
+}
+
+static u64 credits_for_latency(u32 latency) {
+  if (latency == 0) return 1;  // legacy votes with no recorded latency
+  if (latency <= VOTE_CREDITS_GRACE_SLOTS) return VOTE_CREDITS_MAXIMUM_PER_SLOT;
+  u64 dec = latency - VOTE_CREDITS_GRACE_SLOTS;
+  if (dec >= VOTE_CREDITS_MAXIMUM_PER_SLOT) return 1;
+  u64 c = VOTE_CREDITS_MAXIMUM_PER_SLOT - dec;
+  return c < 1 ? 1 : c;
+}
+
+static void increment_credits(VoteSt& vs, u64 epoch, u64 credits) {
+  if (vs.credits.empty()) {
+    vs.credits.push_back({epoch, 0, 0});
+  } else if (epoch != vs.credits.back()[0]) {
+    u64 c = vs.credits.back()[1], p = vs.credits.back()[2];
+    if (c != p) {
+      vs.credits.push_back({epoch, c, c});
+    } else {
+      vs.credits.back() = {epoch, c, c};
+    }
+    if (vs.credits.size() > MAX_EPOCH_CREDITS_HISTORY)
+      vs.credits.erase(vs.credits.begin());
+  }
+  auto& last = vs.credits.back();
+  if (last[1] > U64_MAX - credits) throw Err{ST_PROG};  // py: encode overflow
+  last[1] += credits;
+}
+
+static void double_lockouts(VoteSt& vs) {
+  u64 depth = vs.votes.size();
+  for (u64 i = 0; i < depth; i++) {
+    LV& lv = vs.votes[i];
+    if (depth > i + (u64)lv.lk.conf) lv.lk.conf += 1;
+  }
+}
+
+static void pop_expired_votes(VoteSt& vs, u64 next_slot) {
+  while (!vs.votes.empty() && lockout_expired(vs.votes.back().lk, next_slot))
+    vs.votes.pop_back();
+}
+
+static void process_next_vote_slot(VoteSt& vs, u64 next_slot, u64 epoch,
+                                   u64 current_slot) {
+  if (!vs.votes.empty() && vs.votes.back().lk.slot >= next_slot) return;
+  pop_expired_votes(vs, next_slot);
+  u64 latency = 0;
+  if (current_slot != 0 && current_slot > next_slot)
+    latency = current_slot - next_slot;
+  LV lv;
+  lv.latency = (u8)(latency > 255 ? 255 : latency);
+  lv.lk = Lk{next_slot, 1};
+  if (vs.votes.size() == MAX_LOCKOUT_HISTORY) {
+    LV rooted = vs.votes.front();
+    vs.votes.erase(vs.votes.begin());
+    vs.has_root = true;
+    vs.root = rooted.lk.slot;
+    increment_credits(vs, epoch, credits_for_latency(rooted.latency));
+  }
+  vs.votes.push_back(lv);
+  double_lockouts(vs);
+}
+
+// VoteError -> InstrError -> TXN_ERR_PROGRAM: every VoteError is ST_PROG
+static void process_vote(VoteSt& vs, const std::vector<u64>& slots,
+                         const Key& vote_hash, bool has_ts, i64 ts,
+                         const SlotHashes& sh, u64 epoch, u64 current_slot);
+
+static void check_and_set_timestamp(VoteSt& vs, u64 slot, i64 ts) {
+  // process_timestamp: monotone; same slot may only re-assert the value
+  if (slot < vs.ts_slot || ts < vs.ts_ts ||
+      (slot == vs.ts_slot && ts != vs.ts_ts && vs.ts_slot != 0))
+    throw Err{ST_PROG};  // TimestampTooOld
+  vs.ts_slot = slot;
+  vs.ts_ts = ts;
+}
+
+static void process_vote(VoteSt& vs, const std::vector<u64>& slots,
+                         const Key& vote_hash, bool has_ts, i64 ts,
+                         const SlotHashes& sh, u64 epoch, u64 current_slot) {
+  if (slots.empty()) throw Err{ST_PROG};  // EmptySlots
+  // check_slots_are_valid
+  bool has_last = !vs.votes.empty();
+  u64 last = has_last ? vs.votes.back().lk.slot : 0;
+  std::vector<u64> accepted;
+  for (u64 s : slots)
+    if ((!has_last || s > last) && sh.contains(s)) accepted.push_back(s);
+  if (accepted.empty()) throw Err{ST_PROG};  // VotesTooOldAllFiltered
+  const Key* h = sh.get(accepted.back());
+  if (h == nullptr || *h != vote_hash) throw Err{ST_PROG};  // SlotHashMismatch
+  for (u64 s : accepted) process_next_vote_slot(vs, s, epoch, current_slot);
+  if (has_ts) check_and_set_timestamp(vs, slots.back(), ts);
+}
+
+static void process_new_vote_state(VoteSt& vs, const std::vector<Lk>& nl,
+                                   bool has_new_root, u64 new_root,
+                                   const Key& vote_hash, const SlotHashes& sh,
+                                   u64 epoch, u64 current_slot) {
+  if (nl.empty()) throw Err{ST_PROG};                       // EmptySlots
+  if (nl.size() > MAX_LOCKOUT_HISTORY) throw Err{ST_PROG};  // TooManyVotes
+  if (!vs.votes.empty() && nl.back().slot <= vs.votes.back().lk.slot)
+    throw Err{ST_PROG};  // VoteTooOld
+  if (has_new_root && vs.has_root && new_root < vs.root)
+    throw Err{ST_PROG};  // RootRollBack
+  if (!has_new_root && vs.has_root) throw Err{ST_PROG};  // RootRollBack
+  for (size_t i = 0; i < nl.size(); i++) {
+    const Lk& lk = nl[i];
+    if (lk.conf < 1 || lk.conf > MAX_LOCKOUT_HISTORY)
+      throw Err{ST_PROG};  // ConfirmationOutOfBounds
+    if (has_new_root && lk.slot <= new_root)
+      throw Err{ST_PROG};  // SlotSmallerThanRoot
+    if (i > 0) {
+      if (lk.slot <= nl[i - 1].slot) throw Err{ST_PROG};  // SlotsNotOrdered
+      if (lk.conf >= nl[i - 1].conf)
+        throw Err{ST_PROG};  // ConfirmationsNotOrdered
+    }
+  }
+  u64 last_slot = nl.back().slot;
+  const Key* h = sh.contains(last_slot) ? sh.get(last_slot) : nullptr;
+  if (h == nullptr) throw Err{ST_PROG};       // SlotsMismatch
+  if (*h != vote_hash) throw Err{ST_PROG};    // SlotHashMismatch
+  if (has_new_root) {
+    // credits for old votes the new root newly covers
+    bool has_old = vs.has_root;
+    u64 old_root = vs.root;
+    for (auto& lv : vs.votes) {
+      bool above_old = !has_old || lv.lk.slot > old_root;
+      if (above_old && lv.lk.slot <= new_root)
+        increment_credits(vs, epoch, credits_for_latency(lv.latency));
+    }
+  }
+  // carry landing latencies for surviving slots
+  std::map<u64, u8> lat;
+  for (auto& lv : vs.votes) lat[lv.lk.slot] = lv.latency;
+  std::vector<LV> nv;
+  for (auto& lk : nl) {
+    LV lv;
+    auto it = lat.find(lk.slot);
+    if (it != lat.end()) {
+      lv.latency = it->second;
+    } else if (current_slot != 0) {
+      u64 l = current_slot > lk.slot ? current_slot - lk.slot : 0;
+      lv.latency = (u8)(l > 255 ? 255 : l);
+    } else {
+      lv.latency = 0;
+    }
+    lv.lk = lk;
+    nv.push_back(lv);
+  }
+  vs.votes.swap(nv);
+  vs.has_root = has_new_root;
+  vs.root = new_root;
+}
+
+// authorized_voter_for: greatest epoch key <= epoch
+static const Key* authorized_voter_for(const VoteSt& vs, u64 epoch) {
+  const Key* best = nullptr;
+  for (auto& kv : vs.auth) {
+    if (kv.first <= epoch) best = &kv.second;
+    else break;
+  }
+  return best;
+}
+
+// -- per-txn execution context -----------------------------------------------
+
+struct IA {
+  u8 idx;
+  bool signer, writable;
+};
+
+struct TxnX {
+  const u8* payload;
+  u64 payload_sz;
+  Desc desc;
+  const u8* addrs;             // acct_cnt x 32B, inside the payload
+  std::vector<Acct> accts;     // loaded, payer fee-debited
+  std::vector<bool> signer, writable;
+
+  const u8* addr(u32 i) const { return addrs + 32ull * i; }
+};
+
+struct VoteEnv {
+  bool have_clock;
+  u64 clock_slot, clock_epoch;
+  bool sh_present;
+  const SlotHashes* sh;
+};
+
+// -- system program (flamenco/programs.py system_program) --------------------
+
+static Acct& sys_acct(TxnX& T, const std::vector<IA>& ia, u32 i) {
+  if (i >= ia.size()) throw Err{ST_ACCT};  // "system instr needs account i"
+  return T.accts[ia[i].idx];
+}
+
+static void sys_need_writable(const std::vector<IA>& ia, u32 i) {
+  if (!ia[i].writable) throw Err{ST_ACCT};
+}
+
+static void sys_need_signer(const std::vector<IA>& ia, u32 i) {
+  if (!ia[i].signer) throw Err{ST_ACCT};  // top level: no pda signers
+}
+
+static void system_instr(TxnX& T, const std::vector<IA>& ia, const u8* data,
+                         u32 dlen) {
+  if (dlen < 4) return;  // garbage instruction: no-op (legacy parity)
+  u32 tag = rd32(data);
+  if (tag == 2) {  // Transfer { lamports }
+    if (dlen < 12 || ia.size() < 2) return;  // no-op, mirrors python
+    u64 lamports = rd64(data + 4);
+    Acct& src = sys_acct(T, ia, 0);
+    Acct& dst = sys_acct(T, ia, 1);
+    sys_need_writable(ia, 0);
+    sys_need_writable(ia, 1);
+    sys_need_signer(ia, 0);
+    if (src.owner != SYS_KEY) throw Err{ST_ACCT};
+    if (!src.data.empty()) throw Err{ST_ACCT};  // source carries data
+    if (src.lamports < lamports) throw Err{ST_FUNDS};
+    if (src.key == dst.key) return;  // self-transfer: no-op, NOT a mint
+    if (dst.lamports > U64_MAX - lamports) throw Punt{};  // py bigint path
+    src.lamports -= lamports;
+    dst.lamports += lamports;
+  } else if (tag == 0) {  // CreateAccount { lamports, space, owner }
+    if (dlen < 4 + 8 + 8 + 32 || ia.size() < 2) throw Err{ST_ACCT};
+    u64 lamports = rd64(data + 4);
+    u64 space = rd64(data + 12);
+    Acct& src = sys_acct(T, ia, 0);
+    Acct& nw = sys_acct(T, ia, 1);
+    sys_need_writable(ia, 0);
+    sys_need_writable(ia, 1);
+    sys_need_signer(ia, 0);
+    sys_need_signer(ia, 1);
+    if (space > MAX_PERMITTED_DATA_LENGTH) throw Err{ST_ACCT};
+    if (src.owner != SYS_KEY) throw Err{ST_ACCT};
+    if (nw.exists()) throw Err{ST_ACCT};
+    if (src.lamports < lamports) throw Err{ST_FUNDS};
+    if (src.key != nw.key) {
+      // nw.exists() false => nw.lamports == 0: the add cannot overflow
+      src.lamports -= lamports;
+      nw.lamports += lamports;
+    }
+    nw.data.assign(space, 0);
+    std::memcpy(nw.owner.data(), data + 20, 32);
+  } else if (tag == 1) {  // Assign { owner }
+    if (dlen < 36 || ia.empty()) throw Err{ST_ACCT};
+    Acct& a = sys_acct(T, ia, 0);
+    sys_need_writable(ia, 0);
+    sys_need_signer(ia, 0);
+    if (a.owner != SYS_KEY) throw Err{ST_ACCT};
+    std::memcpy(a.owner.data(), data + 4, 32);
+  } else if (tag >= 4 && tag <= 7) {
+    throw Punt{};  // durable-nonce family: Python lane (flamenco/nonce.py)
+  } else if (tag == 8) {  // Allocate { space }
+    if (dlen < 12 || ia.empty()) throw Err{ST_ACCT};
+    u64 space = rd64(data + 4);
+    Acct& a = sys_acct(T, ia, 0);
+    sys_need_writable(ia, 0);
+    sys_need_signer(ia, 0);
+    if (space > MAX_PERMITTED_DATA_LENGTH) throw Err{ST_ACCT};
+    if (!a.data.empty() || a.owner != SYS_KEY) throw Err{ST_ACCT};
+    a.data.assign(space, 0);
+  }
+  // other tags: no-op (unimplemented surface is inert, never fatal)
+}
+
+// -- vote program (flamenco/vote_program.py vote_program) --------------------
+
+static bool vote_signed_by(const TxnX& T, const std::vector<IA>& ia,
+                           const Key* pk) {
+  if (pk == nullptr) return false;
+  for (auto& a : ia)
+    if (a.signer && T.accts[a.idx].key == *pk) return true;
+  return false;
+}
+
+static void vote_instr(TxnX& T, const std::vector<IA>& ia, const u8* data,
+                       u32 dlen, const VoteEnv& env) {
+  if (dlen < 4) throw Err{ST_PROG};  // "vote: truncated instruction"
+  u32 tag = rd32(data);
+  if (ia.empty()) throw Err{ST_ACCT};  // missing vote account
+  Acct& va = T.accts[ia[0].idx];
+  if (va.owner != VOTE_KEY) throw Err{ST_ACCT};
+  if (!ia[0].writable) throw Err{ST_ACCT};
+  if (!env.have_clock) throw Err{ST_PROG};  // VoteError: clock unavailable
+  if (tag == 0) throw Punt{};  // InitializeAccount: Python lane
+  // _state_load: all-zero data = uninitialized
+  bool all_zero = true;
+  for (u8 b : va.data)
+    if (b != 0) { all_zero = false; break; }
+  if (all_zero) throw Err{ST_PROG};  // "vote account uninitialized"
+  VoteSt vs;
+  vote_state_decode(va.data.data(), va.data.size(), vs);
+  u64 epoch = env.clock_epoch, cslot = env.clock_slot;
+
+  if (tag == 2 || tag == 6) {  // Vote / VoteSwitch
+    Rd r{data, dlen, 4};
+    u64 ns = r.get64();
+    if (ns > 64) throw Err{ST_PROG};  // Vec(U64, max_len=64)
+    std::vector<u64> slots;
+    for (u64 k = 0; k < ns; k++) slots.push_back(r.get64());
+    Key h;
+    r.getkey(h);
+    u8 opt = r.get8();
+    if (opt > 1) throw Err{ST_PROG};
+    bool has_ts = opt == 1;
+    i64 ts = has_ts ? r.geti64() : 0;
+    // trailing bytes (VoteSwitch proof hash) are ignored, as Python
+    if (!vote_signed_by(T, ia, authorized_voter_for(vs, epoch)))
+      throw Err{ST_ACCT};
+    if (!env.sh->ok) throw Err{ST_PROG};  // malformed SlotHashes sysvar
+    process_vote(vs, slots, h, has_ts, ts, *env.sh, epoch, cslot);
+  } else if (tag == 8 || tag == 9 || tag == 14 || tag == 15) {
+    // UpdateVoteState(Switch) / TowerSync(Switch)
+    Rd r{data, dlen, 4};
+    u64 nlk = r.get64();
+    if (nlk > 64) throw Err{ST_PROG};  // Vec(LOCKOUT, max_len=64)
+    std::vector<Lk> nl;
+    for (u64 k = 0; k < nlk; k++) {
+      Lk lk;
+      lk.slot = r.get64();
+      lk.conf = r.get32();
+      nl.push_back(lk);
+    }
+    u8 opt = r.get8();
+    if (opt > 1) throw Err{ST_PROG};
+    bool has_root = opt == 1;
+    u64 root = has_root ? r.get64() : 0;
+    Key h;
+    r.getkey(h);
+    opt = r.get8();
+    if (opt > 1) throw Err{ST_PROG};
+    bool has_ts = opt == 1;
+    i64 ts = has_ts ? r.geti64() : 0;
+    if (tag == 14 || tag == 15) {
+      Key block_id;
+      r.getkey(block_id);  // decoded (bounds-checked), unused as Python
+    }
+    if (!vote_signed_by(T, ia, authorized_voter_for(vs, epoch)))
+      throw Err{ST_ACCT};
+    if (!env.sh->ok) throw Err{ST_PROG};
+    process_new_vote_state(vs, nl, has_root, root, h, *env.sh, epoch, cslot);
+    if (has_ts && !nl.empty()) check_and_set_timestamp(vs, nl.back().slot, ts);
+  } else if (tag == 1 || tag == 3 || tag == 4 || tag == 5 || tag == 7) {
+    throw Punt{};  // authorize/withdraw/identity/commission: Python lane
+  } else {
+    throw Err{ST_PROG};  // "vote: unsupported instruction"
+  }
+  // _state_store: fixed account size, state may never grow past it
+  std::vector<u8> blob;
+  vote_state_encode(vs, blob);
+  if (blob.size() > va.data.size()) throw Err{ST_PROG};
+  std::memcpy(va.data.data(), blob.data(), blob.size());
+  std::fill(va.data.begin() + blob.size(), va.data.end(), 0);
+}
+
+}  // namespace
+
+namespace {
+
+// -- response writer ---------------------------------------------------------
+
+struct RespFull {};  // resp_cap too small: caller retries with a bigger buf
+
+struct Wr {
+  u8* p;
+  u64 cap, i;
+  void need(u64 k) { if (i + k > cap) throw RespFull{}; }
+  void put8(u8 v) { need(1); p[i++] = v; }
+  void put32(u32 v) { need(4); wr32(p + i, v); i += 4; }
+  void put64(u64 v) { need(8); wr64(p + i, v); i += 8; }
+  void bytes(const u8* b, u64 n) {
+    need(n);
+    if (n) std::memcpy(p + i, b, n);
+    i += n;
+  }
+};
+
+// -- one transaction (flamenco/runtime.py _execute_txn, native subset) -------
+
+struct Write {
+  u8 idx;
+  std::vector<u8> val;
+};
+
+struct TxnResult {
+  i64 status;
+  u64 fee;
+  std::vector<Write> writes;
+};
+
+typedef std::map<Key, std::vector<u8>> Overlay;
+
+struct TxnIn {
+  const u8* payload;
+  u64 payload_sz;
+  const u8* desc_bytes;
+  u64 desc_sz;
+  u32 acct_cnt;
+  // per-account supplied values (funk state at batch start)
+  std::vector<std::pair<const u8*, u64>> vals;
+};
+
+static void load_acct(const Overlay& ov, const TxnIn& in, u32 i,
+                      const Key& key, Acct& a) {
+  auto it = ov.find(key);
+  if (it != ov.end()) {
+    acct_decode(it->second.data(), it->second.size(), a);
+  } else {
+    acct_decode(in.vals[i].first, in.vals[i].second, a);
+  }
+  a.key = key;
+}
+
+static TxnResult execute_txn(const TxnIn& in, Overlay& ov, u64 lps,
+                             const VoteEnv& env) {
+  TxnX T;
+  T.payload = in.payload;
+  T.payload_sz = in.payload_sz;
+  parse_desc(in.desc_bytes, in.desc_sz, T.desc);
+  Desc& d = T.desc;
+  if (d.lut_cnt != 0 || d.adtl != 0) throw Punt{};  // ALT path: Python lane
+  if (in.acct_cnt != d.acct_cnt) throw Punt{};
+  if ((u64)d.acct_off + 32ull * d.acct_cnt > in.payload_sz) throw Punt{};
+  if (d.acct_cnt == 0 || d.sig_cnt == 0) throw Punt{};
+  T.addrs = in.payload + d.acct_off;
+
+  // AccountLoadedTwice analog: duplicate addresses are a typed failure
+  // BEFORE the fee is charged
+  for (u32 i = 0; i < d.acct_cnt; i++)
+    for (u32 j = i + 1; j < d.acct_cnt; j++)
+      if (std::memcmp(T.addr(i), T.addr(j), 32) == 0)
+        return TxnResult{ST_ACCT, 0, {}};
+
+  u64 fee = lps * d.sig_cnt;
+  Key payer_key;
+  std::memcpy(payer_key.data(), T.addr(0), 32);
+  Acct payer;
+  load_acct(ov, in, 0, payer_key, payer);
+  if (payer.lamports < fee) return TxnResult{ST_FEE, 0, {}};
+
+  // load the account set; the payer loads with the fee already debited
+  // (python writes the debit to funk before loading, so failure keeps it)
+  T.accts.resize(d.acct_cnt);
+  T.signer.resize(d.acct_cnt);
+  T.writable.resize(d.acct_cnt);
+  for (u32 i = 0; i < d.acct_cnt; i++) {
+    Key k;
+    std::memcpy(k.data(), T.addr(i), 32);
+    load_acct(ov, in, i, k, T.accts[i]);
+    T.signer[i] = i < d.sig_cnt;
+    T.writable[i] = is_writable(d, i);
+  }
+  T.accts[0].lamports -= fee;
+  std::vector<Acct> baseline = T.accts;
+
+  auto fail = [&](i64 status) {
+    TxnResult r{status, fee, {}};
+    Write w;
+    w.idx = 0;
+    acct_encode(baseline[0], w.val);  // fee-debited payer, no effects
+    r.writes.push_back(std::move(w));
+    return r;
+  };
+
+  for (u32 k = 0; k < d.instr_cnt; k++) {
+    const Instr& ins = d.instrs[k];
+    if (ins.prog >= d.acct_cnt) return fail(ST_ACCT);
+    if ((u64)ins.data_off + ins.data_sz > in.payload_sz) throw Punt{};
+    if ((u64)ins.acct_off + ins.acct_cnt > in.payload_sz) throw Punt{};
+    const u8* idx = in.payload + ins.acct_off;
+    bool bad_idx = false;
+    for (u32 j = 0; j < ins.acct_cnt; j++)
+      if (idx[j] >= d.acct_cnt) bad_idx = true;
+    if (bad_idx) return fail(ST_ACCT);
+    std::vector<IA> ia;
+    ia.reserve(ins.acct_cnt);
+    for (u32 j = 0; j < ins.acct_cnt; j++)
+      ia.push_back(IA{idx[j], T.signer[idx[j]], T.writable[idx[j]]});
+    const u8* data = in.payload + ins.data_off;
+    const u8* progkey = T.addr(ins.prog);
+    try {
+      if (std::memcmp(progkey, SYS_KEY.data(), 32) == 0) {
+        system_instr(T, ia, data, ins.data_sz);
+      } else if (std::memcmp(progkey, VOTE_KEY.data(), 32) == 0) {
+        vote_instr(T, ia, data, ins.data_sz, env);
+      } else {
+        throw Punt{};  // BPF / other builtins: Python lane
+      }
+    } catch (const Err& e) {
+      return fail(e.status);
+    }
+  }
+
+  // commit: writes may only land on accounts the wave generator saw as
+  // writable; validate everything before emitting anything
+  TxnResult r{TXN_SUCCESS, fee, {}};
+  for (u32 i = 0; i < d.acct_cnt; i++) {
+    bool changed = !T.accts[i].same_state(baseline[i]);
+    if (changed && !T.writable[i]) return fail(ST_ACCT);
+    if (i == 0 || changed) {  // payer writes unconditionally (fee debit)
+      Write w;
+      w.idx = (u8)i;
+      acct_encode(T.accts[i], w.val);
+      r.writes.push_back(std::move(w));
+    }
+  }
+  return r;
+}
+
+}  // namespace
+
+// -- entry point --------------------------------------------------------------
+
+extern "C" {
+
+// Executes up to n_txn transactions sequentially.  Returns the response
+// length, -1 on a malformed request, -2 when resp_cap is too small (the
+// caller retries with a larger buffer; no state escapes a failed call).
+int64_t fd_exec_batch(const uint8_t* req, uint64_t req_sz, uint8_t* resp,
+                      uint64_t resp_cap) {
+  const u8* p = req;
+  const u8* end = req + req_sz;
+  auto have = [&](u64 k) { return (u64)(end - p) >= k; };
+  if (!have(4 + 4 + 8 + 1 + 8 + 8 + 1 + 4)) return -1;
+  if (rd32(p) != 0x42584446u) return -1;  // 'FDXB'
+  p += 4;
+  u32 n_txn = rd32(p); p += 4;
+  u64 lps = rd64(p); p += 8;
+  VoteEnv env;
+  env.have_clock = *p++ != 0;
+  env.clock_slot = rd64(p); p += 8;
+  env.clock_epoch = rd64(p); p += 8;
+  env.sh_present = *p++ != 0;
+  u32 sh_sz = rd32(p); p += 4;
+  if (!have(sh_sz)) return -1;
+  SlotHashes sh;
+  if (env.sh_present) {
+    parse_slot_hashes(p, sh_sz, sh);
+  } else {
+    sh.ok = true;  // absent/empty sysvar -> empty list, not an error
+  }
+  p += sh_sz;
+  env.sh = &sh;
+
+  std::vector<TxnIn> txns;
+  txns.reserve(n_txn);
+  for (u32 t = 0; t < n_txn; t++) {
+    if (!have(2 + 2 + 1)) return -1;
+    TxnIn in;
+    in.payload_sz = rd16(p); p += 2;
+    in.desc_sz = rd16(p); p += 2;
+    in.acct_cnt = *p++;
+    if (!have(in.payload_sz + in.desc_sz)) return -1;
+    in.payload = p; p += in.payload_sz;
+    in.desc_bytes = p; p += in.desc_sz;
+    for (u32 i = 0; i < in.acct_cnt; i++) {
+      if (!have(4)) return -1;
+      u32 vs = rd32(p); p += 4;
+      if (!have(vs)) return -1;
+      in.vals.emplace_back(p, vs);
+      p += vs;
+    }
+    txns.push_back(std::move(in));
+  }
+  if (p != end) return -1;
+
+  Wr w{resp, resp_cap, 0};
+  try {
+    w.put32(0x52584446u);  // 'FDXR'
+    u64 ndone_off = w.i;
+    w.put32(0);
+    u64 punt_off = w.i;
+    w.put8(0);
+    Overlay ov;
+    u32 n_done = 0;
+    for (u32 t = 0; t < n_txn; t++) {
+      TxnResult r;
+      try {
+        r = execute_txn(txns[t], ov, lps, env);
+      } catch (const Punt&) {
+        resp[punt_off] = 1;
+        break;
+      }
+      w.put8((u8)(int8_t)r.status);
+      w.put64(r.fee);
+      w.put8((u8)r.writes.size());
+      // account addresses live in the payload at the descriptor's
+      // acct_off (validated inside execute_txn before any write exists)
+      const u8* addrs = txns[t].payload + rd16(txns[t].desc_bytes + 9);
+      for (auto& wr_ : r.writes) {
+        w.put8(wr_.idx);
+        w.put32((u32)wr_.val.size());
+        w.bytes(wr_.val.data(), wr_.val.size());
+        // the batch overlay: later txns read this txn's commit
+        Key k;
+        std::memcpy(k.data(), addrs + 32ull * wr_.idx, 32);
+        ov[k] = std::move(wr_.val);
+      }
+      n_done++;
+    }
+    wr32(resp + ndone_off, n_done);
+  } catch (const RespFull&) {
+    return -2;
+  }
+  return (int64_t)w.i;
+}
+
+}  // extern "C"

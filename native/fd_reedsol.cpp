@@ -1,0 +1,72 @@
+// Native Reed-Solomon parity for the shredder's host path.
+//
+// The TPU formulation (ops/reedsol.py) is one bit-matmul over every FEC
+// set in flight — right for wide device batches, but the leader pipeline
+// shreds entry batches of one-to-few sets, where the per-dispatch cost
+// dominates the actual GF(2^8) work.  This is the same small-batch lane
+// the reference serves with its GFNI backend (fd_reedsol_encode): parity
+// = G[d:] (p x d) times data (d x sz) over GF(2^8), poly 0x11D, computed
+// with a full 256x256 product table.  The generator submatrix comes from
+// the caller (ops/ref/gf256_ref.generator_matrix — one source of truth
+// for the code construction), so this file holds no protocol logic and
+// the differential test only has to assert parity-byte equality.
+//
+// Build: scripts/build_native.sh (g++ -O2 -shared -fPIC).
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+typedef uint8_t u8;
+typedef uint64_t u64;
+
+constexpr unsigned POLY = 0x11D;
+
+struct MulTable {
+  u8 t[256][256];
+  MulTable() {
+    // exp/log construction identical to gf256_ref._build_tables
+    u8 exp[512];
+    u8 log[256] = {};
+    unsigned x = 1;
+    for (unsigned i = 0; i < 255; i++) {
+      exp[i] = (u8)x;
+      log[x] = (u8)i;
+      x <<= 1;
+      if (x & 0x100) x ^= POLY;
+    }
+    for (unsigned i = 255; i < 510; i++) exp[i] = exp[i - 255];
+    for (unsigned a = 0; a < 256; a++)
+      for (unsigned b = 0; b < 256; b++)
+        t[a][b] = (a && b) ? exp[log[a] + log[b]] : 0;
+  }
+};
+
+const MulTable MUL;
+
+}  // namespace
+
+extern "C" {
+
+// out (p x sz) = gen (p x d) * data (d x sz) over GF(2^8).
+void fd_reedsol_encode(const u8* gen, const u8* data, u64 d, u64 p, u64 sz,
+                       u8* out) {
+  for (u64 pi = 0; pi < p; pi++) {
+    u8* dst = out + pi * sz;
+    std::memset(dst, 0, sz);
+    for (u64 di = 0; di < d; di++) {
+      u8 c = gen[pi * d + di];
+      if (c == 0) continue;
+      const u8* row = MUL.t[c];
+      const u8* src = data + di * sz;
+      if (c == 1) {
+        for (u64 s = 0; s < sz; s++) dst[s] ^= src[s];
+      } else {
+        for (u64 s = 0; s < sz; s++) dst[s] ^= row[src[s]];
+      }
+    }
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,38 @@
+#!/usr/bin/env bash
+# Build every native (C++) hot-path component with consistent flags.
+#
+# The runtime builds these on demand (utils/nativebuild.build_so uses the
+# same flags + an atomic rename, so concurrent stage processes never see a
+# half-written .so); this script is the explicit form for CI, containers
+# baked ahead of time, and clean rebuilds.  Hosts without a toolchain are
+# fine: every loader raises NativeUnavailable and its caller falls back to
+# the Python lane, and the tests SKIP (never fail).
+#
+# Usage: scripts/build_native.sh [--force]
+
+set -euo pipefail
+cd "$(dirname "$0")/../native"
+
+CXX=${CXX:-g++}
+CXXFLAGS=${CXXFLAGS:--O2 -shared -fPIC}
+
+if ! command -v "$CXX" >/dev/null 2>&1; then
+    echo "build_native: no $CXX on this host; runtime falls back to python lanes" >&2
+    exit 0
+fi
+
+force=0
+[ "${1:-}" = "--force" ] && force=1
+
+for src in *.cpp; do
+    so="${src%.cpp}.so"
+    if [ "$force" = 0 ] && [ -f "$so" ] && [ "$so" -nt "$src" ]; then
+        echo "build_native: $so up to date"
+        continue
+    fi
+    tmp="$so.$$"
+    # shellcheck disable=SC2086
+    "$CXX" $CXXFLAGS -o "$tmp" "$src"
+    mv -f "$tmp" "$so"
+    echo "build_native: built $so"
+done

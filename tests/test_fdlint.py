@@ -462,3 +462,35 @@ def test_partial_declaration_never_fires_absence_rules():
     topo.stage("gen_a", _builder, outs=["l0"])
     topo.stage("gen_b", _builder, outs=["l0"])
     assert "FD101" in _ids(check_topology(topo))
+
+
+# -- FD207: per-frag FFI crossings --------------------------------------------
+
+
+_FFI_FRAG_SRC = '''
+import ctypes
+from firedancer_tpu.protocol.txn_native import txn_parse_packed
+from firedancer_tpu.tango import tcache_native as tn
+
+class MyStage:
+    def after_frag(self, in_idx, meta, payload):
+        d = txn_parse_packed(payload)        # FD207: from-import of *native*
+        self._lib.fd_exec_batch(payload)     # FD207: _lib handle
+        tn.insert(payload)                   # FD207: native-module alias
+        f = ctypes.CDLL("x.so")              # FD207: raw ctypes
+        self.batch.append(payload)           # ok: plain python
+
+    def after_credit(self):
+        # burst granularity: one crossing per drained batch is the
+        # design (fd_exec_batch shape) — not a frag callback, no finding
+        return self._lib.fd_exec_batch(b"".join(self.batch))
+'''
+
+
+def test_fd207_flags_per_frag_ffi_only_in_frag_bodies():
+    findings = ast_rules.lint_source(_FFI_FRAG_SRC, "synth.py")
+    hits = [f for f in findings if f.rule == "FD207"]
+    assert len(hits) == 4
+    credit_line = _FFI_FRAG_SRC[: _FFI_FRAG_SRC.index("after_credit")].count(
+        "\n") + 1
+    assert all(f.line < credit_line for f in hits)
