@@ -317,6 +317,42 @@ def run_bench(backend: str, *, rounds: int = STEADY_ROUNDS,
 PIPELINE_BASELINE_TXN_PER_S = 270_000.0  # reference pure-leader bench
 
 
+def _scrape_stage_latencies(pipe) -> dict:
+    """Per-stage + end-to-end latency percentiles from the stages' schema
+    metrics (utils/metrics.py): every stage's frag_latency_ns histogram
+    observes now - tsorig per consumed frag, and tsorig is stamped ONCE
+    at benchg and carried through every ring — so the store stage's
+    histogram IS the whole ingress->verify->...->shred->store path."""
+    stages = {}
+    for s in pipe.stages:
+        try:
+            h = s.metrics.hist("frag_latency_ns")
+        except KeyError:
+            continue
+        if not h["count"]:
+            continue
+
+        def q(p):
+            # the +Inf overflow estimate must stay strict-JSON: clamp to
+            # the top edge and flag it (json.dumps would emit the
+            # non-standard `Infinity` token and break artifact parsers)
+            v = s.metrics.quantile("frag_latency_ns", p)
+            return (round(h["buckets"][-1], 1), True) if v == float("inf") \
+                else (round(v, 1), False)
+
+        p50, o50 = q(0.5)
+        p99, o99 = q(0.99)
+        stages[s.name] = {"p50_ns": p50, "p99_ns": p99, "count": h["count"]}
+        if o50 or o99:
+            stages[s.name]["overflow"] = True  # true value above top edge
+    out = {"stage_latency_ns": stages}
+    e2e = stages.get(pipe.store.name)
+    if e2e:
+        out["e2e_latency_p50_ns"] = e2e["p50_ns"]
+        out["e2e_latency_p99_ns"] = e2e["p99_ns"]
+    return out
+
+
 def run_comb_bench(args, batch: int, rounds: int, fetch) -> dict:
     """Steady-state the cached (comb-bank) kernel on the same batch."""
     import jax.numpy as jnp
@@ -530,6 +566,7 @@ def run_host_pipeline_bench() -> dict:
             "pipeline_host_stage_us_per_txn": breakdown_us,
             "pipeline_host_native_exec": exec_native.available(),
         }
+        out.update(_scrape_stage_latencies(pipe))
         if executed < target:
             out["pipeline_host_incomplete"] = True
         try:
@@ -624,7 +661,7 @@ def run_pipeline_bench(platform: str) -> dict:
             f"{pipe.shred.metrics.get('fec_sets')} FEC sets emitted",
             file=sys.stderr,
         )
-        return {
+        out = {
             # on the tunneled dev backend every verify dispatch pays a
             # ~250 ms round trip, which bounds this number far below the
             # host pipeline's real capacity (docs/PERF.md); the kernel
@@ -634,6 +671,8 @@ def run_pipeline_bench(platform: str) -> dict:
             "pipeline_commit_p99_ms": round(p99_ms, 2),
             "pipeline_txn_executed": executed,
         }
+        out.update(_scrape_stage_latencies(pipe))
+        return out
     finally:
         pipe.close()
 

@@ -8,6 +8,11 @@ main1.c: run / monitor / keys / configure / version, and fddev's bench):
                --sandbox: seccomp jail each stage); monitor table on exit
     monitor    live per-stage TUI attached to a running topology
     ready      block until every stage of a running topology is RUN
+    metrics    Prometheus scrape surface over a running topology's shm
+               metric segments (--once prints; --serve binds the
+               metric-tile HTTP endpoint), from an uninvolved process
+    trace      flight-recorder rings -> Chrome trace-event JSON (open
+               the output in Perfetto / chrome://tracing)
     configure  host setup stages: check | init (shm, fds, cpus, THP...)
     keys       new <path> | pubkey <path> — identity keypair management
     bench      quick pipeline throughput measurement (bench.py has the
@@ -32,7 +37,7 @@ import os
 import sys
 import time
 
-__version__ = "0.3.0"  # round 3
+__version__ = "0.5.0"  # round 5: live metrics plane + flight recorder
 
 
 def _load_cfg(args):
@@ -269,6 +274,83 @@ def cmd_monitor(args) -> int:
     return 0
 
 
+def cmd_metrics(args) -> int:
+    """The metric-tile position (fd_metric.c): attach to a live run's
+    shm metric segments READ-ONLY and serve/print the Prometheus text
+    exposition — a process the topology never knows about."""
+    from firedancer_tpu.runtime.monitor import MonitorSession
+
+    try:
+        ses = MonitorSession.attach(args.descriptor)
+    except (RuntimeError, OSError) as e:
+        print(f"metrics: {e}", file=sys.stderr)
+        return 1
+    try:
+        if not ses.registries():
+            print("metrics: run exposes no metrics segments "
+                  "(pre-metrics descriptor?)", file=sys.stderr)
+            return 1
+        if args.once:
+            sys.stdout.write(ses.scrape())
+            return 0
+        from firedancer_tpu.utils.metrics import MetricsServer
+
+        srv = MetricsServer(ses.registries(), port=args.serve)
+        try:
+            host, port = srv.addr
+            print(f"# serving /metrics on http://{host}:{port}/ (^C exits)",
+                  file=sys.stderr)
+            try:
+                while True:
+                    time.sleep(1.0)
+            except KeyboardInterrupt:
+                pass
+        finally:
+            srv.close()
+        return 0
+    finally:
+        ses.close()
+
+
+def cmd_trace(args) -> int:
+    """Export flight-recorder rings as Chrome trace-event JSON: from a
+    crash dump (--dump, written by the supervisor on any stage FAIL) or
+    live from the newest running topology."""
+    from firedancer_tpu.runtime import monitor as mon
+    from firedancer_tpu.utils.metrics import flight_to_chrome_trace
+
+    try:
+        if args.dump is not None:
+            with open(args.dump) as f:
+                dump = json.load(f)
+        elif args.descriptor is not None or mon.list_runs():
+            from firedancer_tpu.runtime.monitor import MonitorSession
+
+            ses = MonitorSession.attach(args.descriptor)
+            try:
+                dump = ses.flight_dump()
+            finally:
+                ses.close()
+        else:
+            dumps = mon.list_flight_dumps()
+            if not dumps:
+                print("trace: no live run and no flight dumps found",
+                      file=sys.stderr)
+                return 1
+            print(f"# using newest flight dump {dumps[0]}", file=sys.stderr)
+            with open(dumps[0]) as f:
+                dump = json.load(f)
+    except (RuntimeError, OSError, json.JSONDecodeError) as e:
+        print(f"trace: {e}", file=sys.stderr)
+        return 1
+    trace = flight_to_chrome_trace(dump)
+    with open(args.out, "w") as f:
+        json.dump(trace, f)
+    n = len(trace["traceEvents"])
+    print(f"# wrote {n} trace events to {args.out}", file=sys.stderr)
+    return 0
+
+
 def cmd_ready(args) -> int:
     """fdctl ready parity: exit 0 once every stage is RUN, 1 on timeout
     or failure."""
@@ -357,6 +439,27 @@ def main(argv=None) -> int:
     readyp.add_argument("--descriptor", default=None)
     readyp.add_argument("--timeout", type=float, default=60.0)
 
+    metp = sub.add_parser(
+        "metrics", help="Prometheus scrape surface over a running topology"
+    )
+    metp.add_argument("--descriptor", default=None,
+                      help="run descriptor path (default: newest live run)")
+    g = metp.add_mutually_exclusive_group()
+    g.add_argument("--once", action="store_true",
+                   help="print one text-exposition snapshot and exit")
+    g.add_argument("--serve", type=int, default=0, metavar="PORT",
+                   help="serve /metrics over HTTP (0 = ephemeral port)")
+
+    trcp = sub.add_parser(
+        "trace", help="flight recorder -> Chrome trace JSON (Perfetto)"
+    )
+    trcp.add_argument("--out", default="trace.json")
+    trcp.add_argument("--dump", default=None,
+                      help="a flight dump written by the supervisor on FAIL"
+                           " (default: live run, else newest dump)")
+    trcp.add_argument("--descriptor", default=None,
+                      help="run descriptor to snapshot live (optional)")
+
     ledp = sub.add_parser("ledger", help="ingest/inspect/replay a ledger")
     ledp.add_argument("action", choices=["show", "ingest", "replay"])
     ledp.add_argument("store", help="blockstore directory")
@@ -401,6 +504,10 @@ def main(argv=None) -> int:
         return cmd_monitor(args)
     if args.cmd == "ready":
         return cmd_ready(args)
+    if args.cmd == "metrics":
+        return cmd_metrics(args)
+    if args.cmd == "trace":
+        return cmd_trace(args)
     if args.cmd == "version":
         print(f"firedancer_tpu {__version__}")
         return 0

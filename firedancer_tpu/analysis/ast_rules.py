@@ -52,6 +52,37 @@ _RANDOM_GLOBALS = frozenset({
 
 _DISABLE_RE = re.compile(r"#\s*fdlint:\s*disable=([A-Z0-9, ]+)")
 
+# FD208: metric/trace entry points whose per-frag arguments must stay
+# allocation-free (a label f-string or a dict literal per observation is
+# a hidden allocator in the hottest path the stage has)
+_METRIC_HOT_ATTRS = frozenset({"observe", "trace", "record"})
+# builder calls that allocate a fresh container per invocation
+_ALLOC_BUILTINS = frozenset({"dict", "list", "set", "tuple"})
+
+
+def _fd208_offender(arg: ast.AST) -> str | None:
+    """Why `arg` allocates/formats, or None if it looks scalar-cheap."""
+    for node in ast.walk(arg):
+        if isinstance(node, ast.JoinedStr):
+            return "f-string"
+        if isinstance(node, (ast.Dict, ast.List, ast.Set)):
+            return "container literal"
+        if isinstance(node, (ast.DictComp, ast.ListComp, ast.SetComp,
+                             ast.GeneratorExp)):
+            return "comprehension"
+        if isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Name) \
+                    and node.func.id in _ALLOC_BUILTINS:
+                return f"{node.func.id}() construction"
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "format":
+                return "str.format()"
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mod) \
+                and isinstance(node.left, (ast.Constant, ast.JoinedStr)) \
+                and isinstance(getattr(node.left, "value", None), str):
+            return "%-formatting"
+    return None
+
 
 def _disabled_lines(source: str) -> dict[int, set[str]]:
     """line -> rule IDs inline-suppressed on that line."""
@@ -250,6 +281,20 @@ class _Linter(ast.NodeVisitor):
                      f"time.{mf[1]}() in a frag callback; stamp deadlines"
                      " in before_credit/during_housekeeping instead"
                      " (after_credit is skipped under backpressure)")
+        # FD208: the metric/trace hot path must not allocate or format
+        # per frag — a label f-string or a dict-literal tag set built per
+        # observation multiplies a hidden allocator by ingress rate
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _METRIC_HOT_ATTRS:
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                why = _fd208_offender(arg)
+                if why:
+                    self.hit("FD208", node,
+                             f"{why} passed to .{node.func.attr}() in a"
+                             " frag callback: metric/trace hot paths must"
+                             " be allocation-free — precompute the label/"
+                             "edges and pass scalars")
+                    break
         # FD207: a native (ctypes) crossing per frag — the crossing
         # itself costs ~1-3us, so it belongs at burst granularity (one
         # call per drained burst / microblock, the fd_exec_batch shape)

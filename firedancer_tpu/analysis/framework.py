@@ -146,3 +146,16 @@ FD206 = _rule(
     "bare except (or except BaseException) without re-raise: swallows"
     " KeyboardInterrupt/SystemExit and can eat a stage's HALT/teardown path",
 )
+FD207 = _rule(
+    "FD207", "ffi-in-frag", SEV_ERROR,
+    "native/FFI crossing (ctypes, a *native* module or a _lib handle)"
+    " inside a frag callback: ~1-3us of marshalling per frag — batch native"
+    " calls at burst granularity (the fd_exec_batch shape)",
+)
+FD208 = _rule(
+    "FD208", "alloc-in-metric-hot-path", SEV_ERROR,
+    "allocation/formatting (f-string, dict/list/set literal or"
+    " comprehension, str.format) passed to observe()/trace() inside a frag"
+    " callback: the metric/trace hot path must stay allocation-free —"
+    " precompute labels and pass scalars",
+)

@@ -398,6 +398,10 @@ class SlotExecution:
         self._table_cache: dict = {}  # ALT decode, once per block
         self._before: dict[bytes, bytes | None] = {}  # start-of-slot view
         self.results: list[TxnResult] = []
+        # native-lane accounting, read by the bank stage's metrics: txns
+        # committed by the C++ lane vs. punted back to the Python lane
+        self.native_done_cnt = 0
+        self.native_punt_cnt = 0
         self.signature_cnt = 0
         self.sealed: BlockResult | None = None
 
@@ -641,7 +645,9 @@ class SlotExecution:
                 self._finish(TxnResult(status, fee), entry[6], entry[4],
                              entry[5])
             i += n_done
+            self.native_done_cnt += n_done
             if punted and i < len(pend):
+                self.native_punt_cnt += 1
                 self._run_gated(pend[i])
                 i += 1
             elif n_done == 0 and not punted:

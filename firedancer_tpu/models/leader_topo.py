@@ -165,6 +165,14 @@ def build_leader_topology(
     with thread-creating clones allowed for XLA."""
     from firedancer_tpu.ops.ref import ed25519_ref as ref
 
+    # per-kind metric schemas: launch() sizes each stage's shm metrics
+    # segment from these (and records them in the run descriptor, so a
+    # scraper reconstructs the layout without importing these classes)
+    from firedancer_tpu.runtime.bank import BankStage
+    from firedancer_tpu.runtime.dedup import DedupStage
+    from firedancer_tpu.runtime.pack_stage import PackStage
+    from firedancer_tpu.runtime.verify import VerifyStage
+
     if n_bank != 1:
         # each bank process owns its own funk: two real-execution banks
         # in separate processes would commit into divergent state
@@ -199,15 +207,17 @@ def build_leader_topology(
     topo.stage("benchg", build_benchg, pool_size=pool_size, n_txns=n_txns,
                sandbox=sb, outs=["gv"])
     topo.stage("verify0", build_verify, batch=batch, sandbox=sb,
-               ins=["gv"], outs=["vd"])
-    topo.stage("dedup", build_dedup, sandbox=sb, ins=["vd"], outs=["dp"])
+               ins=["gv"], outs=["vd"], schema=VerifyStage.metrics_schema())
+    topo.stage("dedup", build_dedup, sandbox=sb, ins=["vd"], outs=["dp"],
+               schema=DedupStage.metrics_schema())
     topo.stage("pack", build_pack, n_bank=n_bank, sandbox=sb,
                ins=["dp"] + [f"bd{b}" for b in range(n_bank)],
-               outs=[f"pb{b}" for b in range(n_bank)])
+               outs=[f"pb{b}" for b in range(n_bank)],
+               schema=PackStage.metrics_schema())
     for b in range(n_bank):
         topo.stage(f"bank{b}", build_bank, bank_idx=b, slot=slot, sandbox=sb,
                    ins=[f"pb{b}"], outs=[f"bp{b}", f"bd{b}"],
-                   credit_gated=True)
+                   credit_gated=True, schema=BankStage.metrics_schema())
     topo.stage("poh", build_poh, n_bank=n_bank, sandbox=sb,
                ins=[f"bp{b}" for b in range(n_bank)], outs=["ps"],
                credit_gated=True)

@@ -39,6 +39,7 @@ import hashlib
 
 from firedancer_tpu.protocol import txn as ft
 from firedancer_tpu.tango.rings import MCache
+from firedancer_tpu.utils import metrics as fm
 from .stage import Stage
 
 
@@ -153,6 +154,20 @@ def default_bank_ctx(
 
 
 class BankStage(Stage):
+    @classmethod
+    def extra_schema(cls) -> fm.MetricsSchema:
+        return (
+            fm.MetricsSchema()
+            .counter("txn_exec", "txns landed (fee charged)")
+            .counter("txn_exec_failed", "landed txns whose program failed")
+            .counter("txn_rejected", "txns with no on-chain footprint")
+            .counter("microblocks", "microblocks committed")
+            .counter("native_exec",
+                     "txns committed by the C++ fast lane")
+            .counter("native_punt",
+                     "C++ fast-lane punts resumed on the Python lane")
+        )
+
     def __init__(self, *args, bank_idx: int = 0, ctx: BankCtx | None = None,
                  **kwargs):
         super().__init__(*args, **kwargs)
@@ -174,7 +189,19 @@ class BankStage(Stage):
         for frag in frags:
             psz = int.from_bytes(frag[-2:], "little")
             items.append((frag[:psz], None, frag[psz:-2]))
+        # native-lane attribution: bracket the batch with the shared
+        # SlotExecution's counters (safe: bank stages sharing a ctx run
+        # cooperatively in one thread; the process topology runs one bank)
+        sx = self.ctx.sx
+        nd0, np0 = sx.native_done_cnt, sx.native_punt_cnt
         results = self.ctx.execute_batch(items)
+        d_native = sx.native_done_cnt - nd0
+        d_punt = sx.native_punt_cnt - np0
+        if d_native:
+            self.metrics.inc("native_exec", d_native)
+        if d_punt:
+            self.metrics.inc("native_punt", d_punt)
+            self.trace(fm.EV_NATIVE_PUNT, d_punt)
         sigs = []
         txns = []
         for (p, _desc, db), r in zip(items, results):
@@ -193,6 +220,7 @@ class BankStage(Stage):
                 # no on-chain footprint: never recorded in an entry
                 self.metrics.inc("txn_rejected")
         self.metrics.inc("microblocks")
+        self.trace(fm.EV_MICROBLOCK, len(txns))
         tsorig = int(meta[MCache.COL_TSORIG])
         if tsorig and len(self.commit_latencies_ns) < 100_000:
             from firedancer_tpu.tango.shm import now_ns

@@ -9,12 +9,20 @@ across round-robin peers; this is the authoritative filter.
 from __future__ import annotations
 
 from firedancer_tpu.tango.rings import TCache
+from firedancer_tpu.utils import metrics as fm
 from .stage import Stage
 
 DEDUP_TCACHE_DEPTH = 1 << 16
 
 
 class DedupStage(Stage):
+    @classmethod
+    def extra_schema(cls) -> fm.MetricsSchema:
+        # hit rate for dashboards = dedup_dup / frags_in
+        return fm.MetricsSchema().counter(
+            "dedup_dup", "duplicate txns dropped by the global tcache"
+        )
+
     def __init__(self, *args, tcache_depth: int = DEDUP_TCACHE_DEPTH, **kwargs):
         super().__init__(*args, **kwargs)
         # the native C++ tcache is the hot path (fd_dedup.c's position is

@@ -27,21 +27,57 @@ import numpy as np
 
 from firedancer_tpu.tango import rings
 from firedancer_tpu.tango.rings import CNC_SIG_FAIL, CNC_SIG_RUN, Cnc
+from firedancer_tpu.utils import metrics as fm
 
 RUN_DIR = os.environ.get("FDTPU_RUN_DIR", "/tmp")
 _SIG_NAMES = {0: "BOOT", 1: "RUN", 2: "HALT", 3: "FAIL"}
+
+
+def _attach_shm(name: str) -> shared_memory.SharedMemory:
+    """Join a segment WITHOUT adopting ownership: CPython's resource
+    tracker unlinks every tracked segment when its process exits, so a
+    short-lived scraper (`fdtpu metrics --once`) would destroy the live
+    topology's shm behind its back.  Observers must unregister — the
+    segments belong to the launching supervisor (3.13's track=False,
+    done by hand for this interpreter)."""
+    s = shared_memory.SharedMemory(name=name)
+    try:
+        from multiprocessing import resource_tracker
+
+        resource_tracker.unregister(s._name, "shared_memory")
+    except Exception:
+        pass  # tracker layout changed: worst case is the old behavior
+    return s
 
 
 def descriptor_path(uid: str) -> str:
     return os.path.join(RUN_DIR, f"fdtpu_run_{uid}.json")
 
 
-def write_descriptor(uid: str, stages: dict[str, str]) -> str:
-    """stages: name -> cnc shm name.  Returns the descriptor path."""
+def flight_dump_path(uid: str) -> str:
+    return os.path.join(RUN_DIR, f"fdtpu_flight_{uid}.json")
+
+
+def list_flight_dumps() -> list[str]:
+    """Flight-recorder dump paths, newest first (dumps outlive their
+    runs deliberately — they are crash evidence)."""
+    out = [
+        os.path.join(RUN_DIR, fn)
+        for fn in os.listdir(RUN_DIR)
+        if fn.startswith("fdtpu_flight_") and fn.endswith(".json")
+    ]
+    return sorted(out, key=os.path.getmtime, reverse=True)
+
+
+def write_descriptor(uid: str, stages: dict[str, str],
+                     metrics: dict | None = None) -> str:
+    """stages: name -> cnc shm name; metrics: name -> {"shm": metrics
+    segment shm name, "schema": schema_to_obj(...)}.  Returns the path."""
     path = descriptor_path(uid)
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
-        json.dump({"uid": uid, "pid": os.getpid(), "stages": stages}, f)
+        json.dump({"uid": uid, "pid": os.getpid(), "stages": stages,
+                   "metrics": metrics or {}}, f)
     os.replace(tmp, path)
     return path
 
@@ -79,13 +115,19 @@ class _Joined:
     name: str
     cnc: Cnc
     shm: shared_memory.SharedMemory
+    # metrics-plane joins (None on descriptors that predate them or when
+    # the segment failed to map — the cnc surface still works)
+    registry: object = None  # fm.MetricsRegistry
+    recorder: object = None  # fm.FlightRecorder
+    met_shm: shared_memory.SharedMemory | None = None
 
 
 class MonitorSession:
-    """Read-only join of a running topology's cnc regions."""
+    """Read-only join of a running topology's cnc + metrics regions."""
 
-    def __init__(self, joined: list[_Joined]):
+    def __init__(self, joined: list[_Joined], uid: str | None = None):
         self._joined = joined
+        self.uid = uid
 
     @classmethod
     def attach(cls, descriptor: str | None = None) -> "MonitorSession":
@@ -98,18 +140,75 @@ class MonitorSession:
         with open(descriptor) as f:
             d = json.load(f)
         joined = []
+        met = d.get("metrics", {})
         for name, shm_name in d["stages"].items():
-            s = shared_memory.SharedMemory(name=shm_name)
+            s = _attach_shm(shm_name)
             cnc = Cnc(np.frombuffer(s.buf, dtype=rings.U64,
                                     count=2 + Cnc.NDIAG))
-            joined.append(_Joined(name, cnc, s))
-        return cls(joined)
+            j = _Joined(name, cnc, s)
+            m = met.get(name)
+            if m:
+                ms = None
+                try:
+                    ms = _attach_shm(m["shm"])
+                    schema = fm.schema_from_obj(m["schema"])
+                    j.registry, j.recorder = fm.metrics_segment_attach(
+                        ms.buf, schema
+                    )
+                    j.met_shm = ms
+                except (OSError, ValueError, KeyError):
+                    # metrics plane unavailable; cnc view still works —
+                    # but never leak a mapping opened before the failure
+                    if ms is not None and j.met_shm is None:
+                        try:
+                            ms.close()
+                        except (OSError, BufferError):
+                            pass
+            joined.append(j)
+        return cls(joined, uid=d.get("uid"))
 
     def close(self) -> None:
         for j in self._joined:
-            # drop the numpy view before closing the mapping
+            # drop the numpy views before closing the mappings
             j.cnc.cells = np.zeros(2 + Cnc.NDIAG, dtype=rings.U64)
             j.shm.close()
+            if j.met_shm is not None:
+                j.registry = j.recorder = None
+        import gc
+
+        gc.collect()
+        for j in self._joined:
+            if j.met_shm is not None:
+                try:
+                    j.met_shm.close()
+                except BufferError:
+                    pass
+                j.met_shm = None
+
+    # -- metrics plane ------------------------------------------------------
+
+    def registries(self) -> dict:
+        """{stage: MetricsRegistry} for every stage whose segment joined."""
+        return {j.name: j.registry for j in self._joined
+                if j.registry is not None}
+
+    def scrape(self) -> str:
+        """The Prometheus text exposition over all joined stages (what
+        `fdtpu metrics --once` prints and `--serve` serves)."""
+        return fm.render_prometheus(self.registries())
+
+    def flight_records(self) -> dict:
+        """{stage: [(ts_ns, event, arg), ...]} from the live rings."""
+        return {j.name: j.recorder.records() for j in self._joined
+                if j.recorder is not None}
+
+    def flight_dump(self, reason: str = "live snapshot") -> dict:
+        return fm.flight_dump_obj(
+            self.uid or "?",
+            {j.name: (j.registry, j.recorder) for j in self._joined
+             if j.recorder is not None},
+            failed=None, reason=reason,
+        )
 
     # -- sampling -----------------------------------------------------------
 
@@ -120,7 +219,7 @@ class MonitorSession:
         out = []
         for j in self._joined:
             hb = j.cnc.last_heartbeat
-            out.append({
+            row = {
                 "stage": j.name,
                 "signal": j.cnc.signal,
                 "heartbeat_age_ms": (now - hb) / 1e6 if hb else None,
@@ -129,7 +228,9 @@ class MonitorSession:
                 "overrun": j.cnc.diag(Stage.DIAG_OVERRUN),
                 "backpressure": j.cnc.diag(Stage.DIAG_BACKPRESSURE),
                 "iters": j.cnc.diag(Stage.DIAG_ITER),
-            })
+            }
+            row.update(fm.latency_row(j.registry))
+            out.append(row)
         return out
 
     def all_running(self, *, max_heartbeat_age_s: float = 5.0) -> bool:
@@ -163,7 +264,8 @@ class MonitorSession:
     def render(rows: list[dict], prev: list[dict] | None,
                dt_s: float) -> str:
         hdr = (f"{'stage':<14}{'state':<6}{'hb_ms':>8}{'in/s':>11}"
-               f"{'out/s':>11}{'busy%':>7}{'ovrn':>7}{'bkp':>7}")
+               f"{'out/s':>11}{'busy%':>7}{'ovrn':>7}{'bkp':>7}"
+               f"{'p50 lat':>9}{'p99 lat':>9}")
         lines = [hdr, "-" * len(hdr)]
         prev_by = {r["stage"]: r for r in prev or []}
         for r in rows:
@@ -178,10 +280,14 @@ class MonitorSession:
             hb = (f"{r['heartbeat_age_ms']:.1f}"
                   if r["heartbeat_age_ms"] is not None else "-")
             fmt = lambda v: "-" if v != v else f"{v:,.0f}"  # noqa: E731
+            # cumulative per-stage latency percentiles from the shm
+            # histogram (ms; "-" when the metrics plane is not joined)
             lines.append(
                 f"{r['stage']:<14}{_SIG_NAMES.get(r['signal'], '?'):<6}"
                 f"{hb:>8}{fmt(in_rate):>11}{fmt(out_rate):>11}"
                 f"{fmt(busy):>7}{r['overrun']:>7}{r['backpressure']:>7}"
+                f"{fm.format_latency_ms(r.get('lat_p50_ms')):>9}"
+                f"{fm.format_latency_ms(r.get('lat_p99_ms')):>9}"
             )
         return "\n".join(lines)
 

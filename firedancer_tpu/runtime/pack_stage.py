@@ -27,11 +27,32 @@ import time
 
 from firedancer_tpu.pack.scheduler import Pack
 from firedancer_tpu.tango.rings import MCache
+from firedancer_tpu.utils import metrics as fm
 from .stage import Stage
 from .verify import decode_verified
 
 
 class PackStage(Stage):
+    @classmethod
+    def extra_schema(cls) -> fm.MetricsSchema:
+        return (
+            fm.MetricsSchema()
+            .counter("txn_in", "verified txns accepted into the pool")
+            .counter("txn_dropped", "txns the pool rejected (full/limits)")
+            .counter("bad_frag", "malformed verified-frags dropped")
+            .counter("microblocks", "microblocks scheduled to banks")
+            .counter("microblock_done", "bank completion acks consumed")
+            .counter("txn_scheduled", "txns scheduled into microblocks")
+            .counter("cu_consumed",
+                     "cost units of every txn scheduled (the block cost"
+                     " model, pack/cost.py)")
+            .histogram(
+                "mb_fill",
+                fm.exp_buckets(1, 64, 7),
+                "txns per emitted microblock",
+            )
+        )
+
     def __init__(
         self,
         *args,
@@ -129,6 +150,7 @@ class PackStage(Stage):
         from .verify import encode_verified
 
         tsorig = 0
+        cu = 0
         frame = bytearray()
         frame += self._mb_seq.to_bytes(4, "little")
         frame += len(chosen).to_bytes(2, "little")
@@ -136,6 +158,7 @@ class PackStage(Stage):
             frag = encode_verified(o.payload, o.desc)
             frame += len(frag).to_bytes(2, "little")
             frame += frag
+            cu += o.cost.total
             ts = self._tsorig_by_sig.pop(o.first_sig(), 0)
             # the microblock inherits its OLDEST txn's origin stamp
             tsorig = min(tsorig, ts) if tsorig and ts else (tsorig or ts)
@@ -144,6 +167,9 @@ class PackStage(Stage):
         self._bank_busy[bank] = True
         self.metrics.inc("microblocks")
         self.metrics.inc("txn_scheduled", len(chosen))
+        self.metrics.inc("cu_consumed", cu)
+        self.metrics.observe("mb_fill", len(chosen))
+        self.trace(fm.EV_MICROBLOCK, len(chosen))
 
     def flush(self) -> None:
         """Force remaining txns out (end of run); banks must keep draining

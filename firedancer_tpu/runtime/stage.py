@@ -25,22 +25,91 @@ from __future__ import annotations
 
 import time
 import zlib
+from bisect import bisect_left
 
 from firedancer_tpu.tango import shm
 from firedancer_tpu.tango.rings import CNC_SIG_HALT, CNC_SIG_RUN, Cnc, MCache
+from firedancer_tpu.utils import metrics as fm
 
 
 class Metrics:
-    """Per-stage counters, a plain dict (metrics schema comes later)."""
+    """Per-stage metrics over a declared schema (utils/metrics.py).
 
-    def __init__(self):
+    Two-tier design, the same split the reference gets in C for free:
+    the PER-FRAG update path is plain dict/int arithmetic (a numpy u64
+    scalar store costs ~20x a dict bump in Python, and frag-rate work
+    cannot afford it), and `flush()` — called from the housekeeping pass
+    alongside the cnc diag stores — copies the local state into the
+    shm-backed MetricsRegistry a monitor/scrape process reads.  Readers
+    therefore see values at most one lazy interval stale, exactly the
+    staleness contract the cnc diag words already have.
+
+    Counter names outside the schema still work (they stay local-only,
+    like the old plain-dict Metrics); `observe()` requires a declared
+    histogram.  `counters` stays a public dict for existing callers.
+    """
+
+    def __init__(self, schema: fm.MetricsSchema | None = None):
+        self.schema = schema if schema is not None else fm.stage_schema()
         self.counters: dict[str, int] = {}
+        # histogram state: plain lists + float sums; bisect_left over a
+        # tuple of precomputed edges is ~10x cheaper than np.searchsorted
+        self._hedges: dict[str, tuple] = {}
+        self._hcounts: dict[str, list[int]] = {}
+        self._hsums: dict[str, float] = {}
+        for d in self.schema.defs:
+            if d.kind == fm.HISTOGRAM:
+                self._hedges[d.name] = d.buckets
+                self._hcounts[d.name] = [0] * (len(d.buckets) + 1)
+                self._hsums[d.name] = 0.0
+        self.registry: fm.MetricsRegistry | None = None
 
     def inc(self, name: str, v: int = 1) -> None:
         self.counters[name] = self.counters.get(name, 0) + v
 
     def get(self, name: str) -> int:
         return self.counters.get(name, 0)
+
+    def observe(self, name: str, value: float) -> None:
+        c = self._hcounts[name]
+        c[bisect_left(self._hedges[name], value)] += 1
+        if value > 0:
+            self._hsums[name] += value
+
+    def hist(self, name: str) -> dict:
+        return {
+            "buckets": list(self._hedges[name]),
+            "counts": list(self._hcounts[name]),
+            "sum": self._hsums[name],
+            "count": sum(self._hcounts[name]),
+        }
+
+    def quantile(self, name: str, q: float) -> float:
+        return fm.hist_quantile(self.hist(name), q)
+
+    # -- shm publication ----------------------------------------------------
+
+    def attach(self, registry: fm.MetricsRegistry) -> None:
+        """Bind the shm-backed registry (child boot path) and publish the
+        current local state immediately."""
+        self.registry = registry
+        self.flush()
+
+    def flush(self) -> None:
+        """Publish local counters/histograms into the attached registry
+        (no-op unattached).  Called from the stage housekeeping pass."""
+        reg = self.registry
+        if reg is None:
+            return
+        for name, (d, _off) in reg._off.items():
+            if d.kind == fm.HISTOGRAM:
+                if name in self._hcounts:
+                    reg.store_hist(name, self._hcounts[name],
+                                   self._hsums[name])
+            else:
+                v = self.counters.get(name)
+                if v is not None:
+                    reg.store(name, v)
 
 
 class Stage:
@@ -57,7 +126,14 @@ class Stage:
         self.ins = ins or []
         self.outs = outs or []
         self.cnc = cnc or Cnc()
-        self.metrics = Metrics()
+        self.metrics = Metrics(type(self).metrics_schema())
+        # flight recorder: local ring by default; attach_observability
+        # swaps in the shm-backed ring (replaying boot-time records) so
+        # the record survives this process crashing
+        self.recorder = fm.FlightRecorder(fm.FLIGHT_DEPTH)
+        self.recorder.record(fm.EV_BOOT)
+        self._bp_since: int | None = None  # iteration backpressure began
+        self._hk_cnt = 0  # housekeeping passes (trace decimation)
         self.lazy = lazy
         # Stages that publish from after_frag set this so they never consume
         # an input frag they couldn't forward (losing e.g. a lock-release
@@ -77,6 +153,35 @@ class Stage:
         self._iter = 0
         self._in_rr = 0  # round-robin input cursor
         self.cnc.signal = CNC_SIG_RUN
+
+    # -- observability ------------------------------------------------------
+
+    @classmethod
+    def metrics_schema(cls) -> fm.MetricsSchema:
+        """The stage KIND's metric layout: the shared stage-loop block
+        plus whatever `extra_schema` adds.  topo.launch sizes the shm
+        segment from this (via the StageSpec), so override extra_schema
+        in subclasses rather than this."""
+        s = fm.stage_schema()
+        for d in cls.extra_schema().defs:
+            s.defs.append(d)
+        return s
+
+    @classmethod
+    def extra_schema(cls) -> fm.MetricsSchema:
+        """Per-kind metric extensions (the per-tile block of metrics.xml)."""
+        return fm.MetricsSchema()
+
+    def trace(self, event: int, arg: int = 0) -> None:
+        """Flight-recorder append (rare events only — never per frag)."""
+        self.recorder.record(event, arg)
+
+    def attach_observability(self, registry, recorder) -> None:
+        """Bind the shm-backed metrics registry + flight ring (child boot
+        path, after the builder ran)."""
+        self.metrics.attach(registry)
+        self.recorder.replay_into(recorder)
+        self.recorder = recorder
 
     # -- callbacks (override in subclasses) ---------------------------------
 
@@ -114,6 +219,12 @@ class Stage:
         self.cnc.diag_set(self.DIAG_OVERRUN, m.get("overrun"))
         self.cnc.diag_set(self.DIAG_BACKPRESSURE, m.get("backpressure"))
         self.cnc.diag_set(self.DIAG_ITER, self._iter)
+        m.flush()  # publish schema metrics to the shm registry (if any)
+        # decimated: one timeline tick per 32 passes, or the 512-slot
+        # ring would hold nothing but housekeeping when a stage runs hot
+        self._hk_cnt += 1
+        if self._hk_cnt & 31 == 1:
+            self.trace(fm.EV_HOUSEKEEPING, self._iter)
         self.during_housekeeping()
         # randomized lazy interval: [lazy/2, 3*lazy/2) iterations
         self._next_housekeeping = self._iter + self.lazy // 2 + self._rng.roll(
@@ -133,6 +244,15 @@ class Stage:
             for p in self.outs:  # stale credits? re-read consumer fseqs
                 p.refresh_credits()
             backpressured = any(p.cr_avail <= 0 for p in self.outs)
+        # backpressure onset/relief transitions ride the flight recorder
+        # (a transition, not a per-frag event: two int compares per iter)
+        if backpressured:
+            if self._bp_since is None:
+                self._bp_since = self._iter
+                self.trace(fm.EV_BACKPRESSURE_ON, self._iter)
+        elif self._bp_since is not None:
+            self.trace(fm.EV_BACKPRESSURE_OFF, self._iter - self._bp_since)
+            self._bp_since = None
         if not backpressured:
             self.after_credit()
         if self.require_credit and any(p.cr_avail <= 0 for p in self.outs):
@@ -163,6 +283,12 @@ class Stage:
                     continue
                 if res == shm.POLL_OVERRUN:
                     self.metrics.inc("overrun")
+                    # decimated: a sustained lap overruns per poll and
+                    # would flood the flight ring (arg = running total,
+                    # so the dump still shows the loss magnitude)
+                    n = self.metrics.get("overrun")
+                    if n & 63 == 1:
+                        self.trace(fm.EV_OVERRUN, n)
                     progressed = True
                     got = True
                     break
@@ -175,6 +301,17 @@ class Stage:
                     self.during_frag(idx, meta, payload)
                     self.after_frag(idx, meta, payload)
                     self.metrics.inc("frags_in")
+                    # per-hop + e2e latency: tsorig is stamped once at the
+                    # origin stage and carried through every ring, so this
+                    # observation at the LAST stage is the whole-pipeline
+                    # figure.  Cheap by construction: one vDSO clock read
+                    # (the same cost Producer.try_publish already pays per
+                    # frag) + a bisect over precomputed edges.
+                    ts = int(meta[MCache.COL_TSORIG])
+                    if ts:
+                        lat = shm.now_ns() - ts
+                        if lat >= 0:
+                            self.metrics.observe("frag_latency_ns", lat)
                 self._in_rr = (idx + 1) % n_in
                 break
             if not got:
@@ -194,6 +331,7 @@ class Stage:
         the loop naps briefly (progress resets the counter)."""
         it = 0
         idle = 0
+        self.trace(fm.EV_RUN)
         while self.cnc.signal != CNC_SIG_HALT:
             if self.run_once():
                 idle = 0
@@ -204,6 +342,8 @@ class Stage:
             it += 1
             if max_iters is not None and it >= max_iters:
                 break
+        self.trace(fm.EV_HALT, self._iter)
+        self.metrics.flush()  # final state visible to post-mortem readers
 
     def halt(self) -> None:
         self.cnc.signal = CNC_SIG_HALT

@@ -45,6 +45,7 @@ import numpy as np
 from firedancer_tpu.tango import rings, shm
 from firedancer_tpu.tango.rings import CNC_SIG_FAIL, CNC_SIG_HALT, CNC_SIG_RUN, Cnc
 from firedancer_tpu.utils import log as fl
+from firedancer_tpu.utils import metrics as fm
 
 _log = fl.get_logger("topo")
 
@@ -80,7 +81,13 @@ class StageSpec:
 
     credit_gated mirrors Stage.require_credit: the stage stops consuming
     inputs while any output is backpressured, which the checker uses to
-    find credit-deadlock cycles (FD107)."""
+    find credit-deadlock cycles (FD107).
+
+    schema: the stage KIND's metric layout (Stage.metrics_schema()).
+    launch() sizes the per-stage metrics shm segment from it IN THE
+    PARENT, and the child attaches with the same spec-resolved schema,
+    so writer and reader can never disagree on the layout.  None means
+    the shared base stage_schema()."""
 
     name: str
     builder: object
@@ -89,6 +96,7 @@ class StageSpec:
     ins: tuple[str, ...] | None = None
     outs: tuple[str, ...] | None = None
     credit_gated: bool = False
+    schema: fm.MetricsSchema | None = None
 
 
 @dataclass
@@ -105,12 +113,14 @@ class Topology:
               ins: list[str] | tuple[str, ...] | None = None,
               outs: list[str] | tuple[str, ...] | None = None,
               credit_gated: bool = False,
+              schema: fm.MetricsSchema | None = None,
               **kwargs) -> "StageSpec":
         spec = StageSpec(
             name, builder, kwargs, sandbox,
             ins=tuple(ins) if ins is not None else None,
             outs=tuple(outs) if outs is not None else None,
             credit_gated=credit_gated,
+            schema=schema,
         )
         self.stages.append(spec)
         return spec
@@ -127,32 +137,77 @@ def _cnc_shm_name(uid: str, stage: str) -> str:
     return f"fdtpu_cnc_{uid}_{stage}"
 
 
+def _met_shm_name(uid: str, stage: str) -> str:
+    return f"fdtpu_met_{uid}_{stage}"
+
+
+def _spec_schema(spec: StageSpec) -> fm.MetricsSchema:
+    """The ONE schema resolution both parent (segment sizing, descriptor)
+    and child (attach) use — never resolve this any other way."""
+    if spec.schema is not None:
+        return spec.schema
+    from firedancer_tpu.runtime.stage import Stage
+
+    return Stage.metrics_schema()
+
+
 def _stage_main(spec: StageSpec, link_names: dict, uid: str) -> None:
-    """Child entry: join links + cnc, build the stage, run until HALT."""
+    """Child entry: join links + cnc + metrics segment, build the stage,
+    run until HALT.  On any raise the flight ring gets an EV_FAIL record
+    BEFORE the cnc flips to FAIL — the ring lives in shm, so the record
+    survives this process for the supervisor's dump."""
     cnc_shm = shared_memory.SharedMemory(name=_cnc_shm_name(uid, spec.name))
     cnc = Cnc(np.frombuffer(cnc_shm.buf, dtype=rings.U64, count=2 + Cnc.NDIAG))
+    met_shm = shared_memory.SharedMemory(name=_met_shm_name(uid, spec.name))
+    registry, recorder = fm.metrics_segment_attach(
+        met_shm.buf, _spec_schema(spec)
+    )
     links = {n: shm.ShmLink.join(sn) for n, sn in link_names.items()}
+    stage = None
     try:
         stage = spec.builder(links, cnc, **spec.kwargs)
+        # schema-drift guard: a stage kind with extra_schema() whose spec
+        # forgot schema=Kind.metrics_schema() would silently publish only
+        # the base block — make the partial-metrics trap loud at boot
+        missing = (type(stage).metrics_schema().names()
+                   - _spec_schema(spec).names())
+        if missing:
+            _log.warning(
+                f"stage '{spec.name}': metrics {sorted(missing)} are "
+                f"declared by {type(stage).__name__}.extra_schema() but "
+                f"absent from the StageSpec schema — they will not reach "
+                f"the shm metrics plane (pass "
+                f"schema={type(stage).__name__}.metrics_schema() to "
+                f"Topology.stage)"
+            )
+        stage.attach_observability(registry, recorder)
         if spec.sandbox is not None:
             from firedancer_tpu.utils import sandbox as sb
 
             sb.enter(**spec.sandbox)
         stage.run()
     except Exception:
+        recorder.record(fm.EV_FAIL)
+        if stage is not None:
+            stage.metrics.flush()  # last state, for the post-mortem dump
         cnc.signal = CNC_SIG_FAIL
         raise
 
 
 class TopologyHandle:
-    def __init__(self, topo, uid, links, cncs, cnc_shms, procs):
+    def __init__(self, topo, uid, links, cncs, cnc_shms, procs,
+                 met_shms=None, met_views=None):
         self.topo = topo
         self.uid = uid
         self.links = links  # name -> ShmLink (parent-side joins)
         self.cncs = cncs  # stage name -> Cnc
         self._cnc_shms = cnc_shms
         self.procs = procs  # stage name -> mp.Process
+        self._met_shms = met_shms or {}
+        # stage name -> (MetricsRegistry, FlightRecorder), parent views
+        self.met_views = met_views or {}
         self.failed: str | None = None
+        self.flight_dump_path: str | None = None
 
     # -- supervision --------------------------------------------------------
 
@@ -180,6 +235,10 @@ class TopologyHandle:
                         f"stage '{name}' died (alive={p.is_alive()}, "
                         f"signal={cnc.signal}); killing topology"
                     )
+                    self.dump_flight(
+                        f"stage '{name}' died (alive={p.is_alive()}, "
+                        f"signal={cnc.signal})"
+                    )
                     self.kill()
                     return False
                 hb = cnc.last_heartbeat
@@ -188,6 +247,10 @@ class TopologyHandle:
                     _log.warning(
                         f"stage '{name}' heartbeat stale "
                         f"({(now - hb) / 1e9:.1f}s); killing topology"
+                    )
+                    self.dump_flight(
+                        f"stage '{name}' heartbeat stale "
+                        f"({(now - hb) / 1e9:.1f}s)"
                     )
                     self.kill()
                     return False
@@ -211,6 +274,30 @@ class TopologyHandle:
         for p in self.procs.values():
             p.join(timeout=5)
 
+    def dump_flight(self, reason: str = "") -> str | None:
+        """Write the crash dump — every stage's flight ring + a final
+        metrics snapshot — to RUN_DIR (the supervisor's abnormal-exit
+        path; also callable any time for a live snapshot).  The file
+        OUTLIVES close(): it is the evidence trail."""
+        import json as _json
+
+        from firedancer_tpu.runtime import monitor as mon
+
+        if not self.met_views:
+            return None
+        obj = fm.flight_dump_obj(self.uid, self.met_views,
+                                 failed=self.failed, reason=reason)
+        path = mon.flight_dump_path(self.uid)
+        try:
+            with open(path, "w") as f:
+                _json.dump(obj, f)
+            self.flight_dump_path = path
+            _log.notice(f"flight-recorder dump written: {path}")
+            return path
+        except OSError as e:  # diagnostics must never mask the real failure
+            _log.warning(f"flight dump failed: {e}")
+            return None
+
     def close(self) -> None:
         from firedancer_tpu.runtime import monitor as mon
 
@@ -222,7 +309,12 @@ class TopologyHandle:
                 link.unlink()
             except FileNotFoundError:
                 pass
-        for s in self._cnc_shms.values():
+        # numpy views into the metric segments must drop before close
+        self.met_views = {}
+        import gc
+
+        gc.collect()
+        for s in list(self._cnc_shms.values()) + list(self._met_shms.values()):
             try:
                 s.close()
                 s.unlink()
@@ -240,26 +332,27 @@ class TopologyHandle:
         for name, p in self.procs.items():
             cnc = self.cncs[name]
             hb = cnc.last_heartbeat
-            out.append(
-                {
-                    "stage": name,
-                    "alive": p.is_alive(),
-                    "signal": cnc.signal,
-                    "heartbeat_age_ms": (now - hb) / 1e6 if hb else None,
-                    "frags_in": cnc.diag(Stage.DIAG_FRAGS_IN),
-                    "frags_out": cnc.diag(Stage.DIAG_FRAGS_OUT),
-                    "overrun": cnc.diag(Stage.DIAG_OVERRUN),
-                    "backpressure": cnc.diag(Stage.DIAG_BACKPRESSURE),
-                    "iters": cnc.diag(Stage.DIAG_ITER),
-                }
-            )
+            row = {
+                "stage": name,
+                "alive": p.is_alive(),
+                "signal": cnc.signal,
+                "heartbeat_age_ms": (now - hb) / 1e6 if hb else None,
+                "frags_in": cnc.diag(Stage.DIAG_FRAGS_IN),
+                "frags_out": cnc.diag(Stage.DIAG_FRAGS_OUT),
+                "overrun": cnc.diag(Stage.DIAG_OVERRUN),
+                "backpressure": cnc.diag(Stage.DIAG_BACKPRESSURE),
+                "iters": cnc.diag(Stage.DIAG_ITER),
+            }
+            reg = self.met_views.get(name, (None, None))[0]
+            row.update(fm.latency_row(reg))
+            out.append(row)
         return out
 
     def format_monitor(self) -> str:
         rows = self.snapshot()
         hdr = (
             f"{'stage':<12}{'alive':<7}{'hb_ms':>8}{'in':>10}{'out':>10}"
-            f"{'ovrn':>7}{'bkp':>7}"
+            f"{'ovrn':>7}{'bkp':>7}{'p50 lat':>10}{'p99 lat':>10}"
         )
         lines = [hdr]
         for r in rows:
@@ -268,6 +361,8 @@ class TopologyHandle:
                 f"{r['stage']:<12}{str(r['alive']):<7}{hb:>8}"
                 f"{r['frags_in']:>10}{r['frags_out']:>10}"
                 f"{r['overrun']:>7}{r['backpressure']:>7}"
+                f"{fm.format_latency_ms(r.get('lat_p50_ms')):>10}"
+                f"{fm.format_latency_ms(r.get('lat_p99_ms')):>10}"
             )
         return "\n".join(lines)
 
@@ -290,6 +385,8 @@ def launch(topo: Topology) -> TopologyHandle:
         link_names[spec.name] = sn
     cncs: dict[str, Cnc] = {}
     cnc_shms: dict[str, shared_memory.SharedMemory] = {}
+    met_shms: dict[str, shared_memory.SharedMemory] = {}
+    met_views: dict[str, tuple] = {}
     for spec in topo.stages:
         s = shared_memory.SharedMemory(
             name=_cnc_shm_name(uid, spec.name), create=True, size=Cnc.footprint()
@@ -298,6 +395,16 @@ def launch(topo: Topology) -> TopologyHandle:
         cncs[spec.name] = Cnc(
             np.frombuffer(s.buf, dtype=rings.U64, count=2 + Cnc.NDIAG)
         )
+        # one metrics segment per stage, sized by the declared schema
+        # (+ the flight-recorder ring), created before any child exists
+        # so a stage that crashes during boot still has a ring to dump
+        schema = _spec_schema(spec)
+        ms = shared_memory.SharedMemory(
+            name=_met_shm_name(uid, spec.name), create=True,
+            size=fm.metrics_segment_footprint(schema),
+        )
+        met_shms[spec.name] = ms
+        met_views[spec.name] = fm.metrics_segment_init(ms.buf, schema)
     procs: dict[str, mp.Process] = {}
     for spec in topo.stages:
         p = ctx.Process(
@@ -307,11 +414,22 @@ def launch(topo: Topology) -> TopologyHandle:
         p.start()
         procs[spec.name] = p
         _log.info(f"spawned stage '{spec.name}' pid={p.pid}")
-    # advertise the run so `fdtpu monitor` / `fdtpu ready` can attach
-    # from another process (runtime/monitor.py)
+    # advertise the run so `fdtpu monitor` / `fdtpu ready` / `fdtpu
+    # metrics` can attach from another process (runtime/monitor.py);
+    # the metrics entries carry the schema so an uninvolved scraper can
+    # reconstruct the registry layout without importing stage classes
     from firedancer_tpu.runtime import monitor as mon
 
     mon.write_descriptor(
-        uid, {s.name: _cnc_shm_name(uid, s.name) for s in topo.stages}
+        uid,
+        {s.name: _cnc_shm_name(uid, s.name) for s in topo.stages},
+        metrics={
+            s.name: {
+                "shm": _met_shm_name(uid, s.name),
+                "schema": fm.schema_to_obj(_spec_schema(s)),
+            }
+            for s in topo.stages
+        },
     )
-    return TopologyHandle(topo, uid, links, cncs, cnc_shms, procs)
+    return TopologyHandle(topo, uid, links, cncs, cnc_shms, procs,
+                          met_shms, met_views)

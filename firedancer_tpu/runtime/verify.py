@@ -46,6 +46,7 @@ import numpy as np
 
 from firedancer_tpu.protocol import txn as ft
 from firedancer_tpu.tango.rings import MCache, TCache
+from firedancer_tpu.utils import metrics as fm
 from .stage import Stage
 
 # the per-packet parse is this stage's host hot path: prefer the native
@@ -158,6 +159,29 @@ class VerifyStage(Stage):
         self._gen = _Acc()
         self._comb = _Acc()
         self._inflight: list[_Pending] = []
+
+    # -- observability ------------------------------------------------------
+
+    @classmethod
+    def extra_schema(cls) -> fm.MetricsSchema:
+        return (
+            fm.MetricsSchema()
+            .counter("txn_verified", "txns whose every signature verified")
+            .counter("verify_fail", "txns failing signature verification")
+            .counter("parse_fail", "malformed txns dropped at parse")
+            .counter("dedup_dup", "duplicates caught by the stage tcache")
+            .counter("msg_too_long", "txns over max_msg_len")
+            .counter("too_many_sigs", "txns that can never fit a batch")
+            .counter("batches", "device batches dispatched")
+            .counter("batch_elems", "signature elements dispatched")
+            .counter("comb_elems", "elements on the cached-signer lane")
+            .counter("comb_filled", "comb tables installed in the bank")
+            .histogram(
+                "batch_fill",
+                fm.exp_buckets(1, 4096, 13),
+                "elements per closed device batch (fill vs the fixed shape)",
+            )
+        )
 
     # -- mux callbacks ------------------------------------------------------
 
@@ -326,6 +350,8 @@ class VerifyStage(Stage):
         )
         self.metrics.inc("batches", 1)
         self.metrics.inc("batch_elems", n)
+        self.metrics.observe("batch_fill", n)
+        self.trace(fm.EV_BATCH_SUBMIT, n)
         if cached:
             self.metrics.inc("comb_elems", n)
         acc.clear()
@@ -398,6 +424,7 @@ class VerifyStage(Stage):
                     return
             mask = np.asarray(head.result)
             self._inflight.pop(0)
+            self.trace(fm.EV_BATCH_COMPLETE, head.n_elems)
             for payload, desc, (a, b), tsorig in zip(
                 head.payloads, head.descs, head.elem_ranges, head.tsorigs
             ):

@@ -11,7 +11,23 @@ render_prometheus emits the text exposition format.
 
 Histograms are fixed-bucket log-spaced (the fd_histf shape): `buckets`
 edges; value counts land in the first bucket whose edge >= value, plus a
-+Inf overflow bucket and a running sum for averages.
++Inf overflow bucket and a running sum for averages.  The sum word is a
+SCALED integer (value * SUM_SCALE, rounded) so sub-unit observations —
+e.g. ms-denominated latencies — accumulate without truncating to zero;
+readers divide back out, so `hist()["sum"]` is a float in the metric's
+own unit.  Negative observations clamp to zero (counted in the first
+bucket, zero added to the sum) — histograms here measure non-negative
+quantities (latencies, sizes).
+
+This module also carries the FLIGHT RECORDER: a tiny fixed ring of
+(ts, event, arg) records living in the same shm segment as a stage's
+metric words, written in-line (not flushed lazily) so the record
+survives the writing process crashing — the supervisor dumps every
+stage's ring on abnormal exit and `flight_to_chrome_trace` converts a
+dump into Chrome trace-event JSON that Perfetto/chrome://tracing opens.
+
+Segment layout (metrics_segment_*): 4 header words (magic, metric word
+count, recorder capacity, reserved) | metric words | recorder words.
 """
 
 from __future__ import annotations
@@ -23,6 +39,12 @@ import numpy as np
 COUNTER = "counter"
 GAUGE = "gauge"
 HISTOGRAM = "histogram"
+
+# histogram sum words store round(value * SUM_SCALE): 1/1024 resolution,
+# so a 0.5 ms observation into an ms-denominated histogram adds 512, not 0
+SUM_SCALE = 1024
+
+_MASK64 = (1 << 64) - 1
 
 
 @dataclass(frozen=True)
@@ -60,6 +82,27 @@ class MetricsSchema:
     def footprint(self) -> int:
         return sum(d.words() for d in self.defs)
 
+    def names(self) -> set[str]:
+        return {d.name for d in self.defs}
+
+
+def schema_to_obj(schema: MetricsSchema) -> list[dict]:
+    """JSON-serializable schema (run-descriptor form): a monitor process
+    reconstructs the registry layout without importing stage classes."""
+    return [
+        {"name": d.name, "kind": d.kind, "help": d.help,
+         "buckets": list(d.buckets)}
+        for d in schema.defs
+    ]
+
+
+def schema_from_obj(obj: list[dict]) -> MetricsSchema:
+    s = MetricsSchema()
+    for d in obj:
+        s.defs.append(MetricDef(d["name"], d["kind"], d.get("help", ""),
+                                tuple(d.get("buckets", ()))))
+    return s
+
 
 def exp_buckets(lo: float, hi: float, n: int) -> tuple:
     """Log-spaced bucket edges (the fd_histf approximate-exponential shape)."""
@@ -76,9 +119,18 @@ class MetricsRegistry:
         if len(self.words) < n:
             raise ValueError("buffer too small for schema")
         self._off: dict[str, tuple[MetricDef, int]] = {}
+        # bucket edges precomputed per histogram: observe() must not
+        # allocate per call (fdlint FD208's rationale)
+        self._edges: dict[str, np.ndarray] = {}
         off = 0
         for d in schema.defs:
+            if d.name in self._off:
+                # a colliding name would silently orphan the first def's
+                # words and emit duplicate series — fail at layout time
+                raise ValueError(f"duplicate metric name '{d.name}'")
             self._off[d.name] = (d, off)
+            if d.kind == HISTOGRAM:
+                self._edges[d.name] = np.asarray(d.buckets, dtype=np.float64)
             off += d.words()
 
     # -- producers ----------------------------------------------------------
@@ -99,9 +151,28 @@ class MetricsRegistry:
         d, off = self._off[name]
         if d.kind != HISTOGRAM:
             raise TypeError(f"{name} is a {d.kind}")
-        idx = int(np.searchsorted(np.asarray(d.buckets), value, side="left"))
+        idx = int(np.searchsorted(self._edges[name], value, side="left"))
         self.words[off + idx] += np.uint64(1)  # overflow lands at len(buckets)
-        self.words[off + len(d.buckets) + 1] += np.uint64(max(int(value), 0))
+        # scaled integer sum: fractional observations accumulate exactly
+        # to 1/SUM_SCALE resolution instead of truncating to 0
+        self.words[off + len(d.buckets) + 1] += np.uint64(
+            max(int(value * SUM_SCALE + 0.5), 0)
+        )
+
+    def store(self, name: str, value: int) -> None:
+        """Overwrite a counter/gauge word (the housekeeping-flush path:
+        the stage's local count is the source of truth)."""
+        d, off = self._off[name]
+        self.words[off] = np.uint64(int(value) & _MASK64)
+
+    def store_hist(self, name: str, counts, sum_value: float) -> None:
+        """Overwrite a histogram's words from local (counts, sum)."""
+        d, off = self._off[name]
+        n = len(d.buckets) + 1
+        self.words[off : off + n] = counts
+        self.words[off + n] = np.uint64(
+            max(int(sum_value * SUM_SCALE + 0.5), 0) & _MASK64
+        )
 
     # -- readers ------------------------------------------------------------
 
@@ -117,23 +188,65 @@ class MetricsRegistry:
         return {
             "buckets": list(d.buckets),
             "counts": counts,
-            "sum": int(self.words[off + len(d.buckets) + 1]),
+            "sum": int(self.words[off + len(d.buckets) + 1]) / SUM_SCALE,
             "count": sum(counts),
         }
 
     def quantile(self, name: str, q: float) -> float:
         """Upper-edge estimate of the q-quantile from bucket counts."""
-        h = self.hist(name)
-        total = h["count"]
-        if total == 0:
-            return 0.0
-        target = q * total
-        run = 0
-        for edge, c in zip(h["buckets"] + [float("inf")], h["counts"]):
-            run += c
-            if run >= target:
-                return edge
-        return float("inf")
+        return hist_quantile(self.hist(name), q)
+
+
+def latency_row(reg: "MetricsRegistry | None") -> dict:
+    """The monitor/snapshot latency fields from a stage registry: p50/p99
+    of frag_latency_ns in ms, or Nones when the plane is not joined."""
+    out = {"lat_p50_ms": None, "lat_p99_ms": None}
+    if reg is not None and "frag_latency_ns" in reg._off:
+        h = reg.hist("frag_latency_ns")
+        if h["count"]:
+            out["lat_p50_ms"] = hist_quantile(h, 0.5) / 1e6
+            out["lat_p99_ms"] = hist_quantile(h, 0.99) / 1e6
+    return out
+
+
+def format_latency_ms(v: float | None) -> str:
+    """One cell of the monitor's latency columns: '-' when the metrics
+    plane is not joined, '>max' when the quantile overflowed the last
+    bucket (the +Inf estimate carries no magnitude)."""
+    if v is None:
+        return "-"
+    if v == float("inf"):
+        return ">max"
+    return f"{v:,.1f}ms"
+
+
+def hist_quantile(h: dict, q: float) -> float:
+    """Upper-edge q-quantile estimate over a hist() dict."""
+    total = h["count"]
+    if total == 0:
+        return 0.0
+    target = q * total
+    run = 0
+    for edge, c in zip(h["buckets"] + [float("inf")], h["counts"]):
+        run += c
+        if run >= target:
+            return edge
+    return float("inf")
+
+
+# -- Prometheus text exposition ----------------------------------------------
+
+
+def _escape_label(v: str) -> str:
+    """Label-value escaping per the Prometheus text format: backslash,
+    double-quote and line-feed must be escaped or a hostile stage name
+    injects fake series into the scrape."""
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _escape_help(v: str) -> str:
+    """HELP-text escaping: backslash and line-feed only (spec)."""
+    return v.replace("\\", "\\\\").replace("\n", "\\n")
 
 
 def render_prometheus(stages: dict[str, MetricsRegistry]) -> str:
@@ -141,11 +254,12 @@ def render_prometheus(stages: dict[str, MetricsRegistry]) -> str:
     seen_help: set[str] = set()
     lines: list[str] = []
     for stage, reg in stages.items():
+        stage = _escape_label(stage)
         for d in reg.schema.defs:
             if d.name not in seen_help:
                 seen_help.add(d.name)
                 if d.help:
-                    lines.append(f"# HELP {d.name} {d.help}")
+                    lines.append(f"# HELP {d.name} {_escape_help(d.help)}")
                 lines.append(f"# TYPE {d.name} {d.kind}")
             label = f'{{stage="{stage}"}}'
             if d.kind == HISTOGRAM:
@@ -199,6 +313,229 @@ class MetricsServer:
         self._srv.close()
 
 
+# -- flight recorder ----------------------------------------------------------
+
+# event ids (stable wire values: dumps outlive the writing process)
+EV_BOOT = 1            # stage constructed
+EV_RUN = 2             # run loop entered
+EV_HALT = 3            # clean halt observed
+EV_FAIL = 4            # stage raised / signaled FAIL
+EV_HOUSEKEEPING = 5    # housekeeping pass (arg = iteration)
+EV_BACKPRESSURE_ON = 6   # an output ran out of credits (arg = iteration)
+EV_BACKPRESSURE_OFF = 7  # credits recovered (arg = iterations spent stalled)
+EV_BATCH_SUBMIT = 8    # device/work batch submitted (arg = elements)
+EV_BATCH_COMPLETE = 9  # device/work batch drained (arg = elements)
+EV_NATIVE_PUNT = 10    # native fast lane punted to the fallback (arg = count)
+EV_OVERRUN = 11        # input overrun detected (arg = input index)
+EV_MICROBLOCK = 12     # microblock committed/emitted (arg = txn count)
+
+EVENT_NAMES = {
+    EV_BOOT: "boot",
+    EV_RUN: "run",
+    EV_HALT: "halt",
+    EV_FAIL: "fail",
+    EV_HOUSEKEEPING: "housekeeping",
+    EV_BACKPRESSURE_ON: "backpressure_on",
+    EV_BACKPRESSURE_OFF: "backpressure_off",
+    EV_BATCH_SUBMIT: "batch_submit",
+    EV_BATCH_COMPLETE: "batch_complete",
+    EV_NATIVE_PUNT: "native_punt",
+    EV_OVERRUN: "overrun",
+    EV_MICROBLOCK: "microblock",
+}
+
+FLIGHT_DEPTH = 512  # records per stage ring (fixed, small: ~12 KiB)
+
+
+class FlightRecorder:
+    """Fixed ring of (ts_ns, event, arg) u64 triples + a write-count word.
+
+    Records are written STRAIGHT to the backing words (no lazy flush):
+    the whole point is surviving the writer's crash, so the last records
+    before an abort must already be in shared memory.  Events are rare
+    (lifecycle, backpressure transitions, batch boundaries), so the ~µs
+    numpy store cost never rides the per-frag path.
+    """
+
+    REC_WORDS = 3
+
+    def __init__(self, capacity: int = FLIGHT_DEPTH,
+                 words: np.ndarray | None = None):
+        if words is None:
+            words = np.zeros(1 + capacity * self.REC_WORDS, dtype=np.uint64)
+        else:
+            capacity = (len(words) - 1) // self.REC_WORDS
+        if capacity <= 0:
+            raise ValueError("flight recorder needs capacity >= 1")
+        self.capacity = capacity
+        self.words = words
+
+    @classmethod
+    def words_needed(cls, capacity: int) -> int:
+        return 1 + capacity * cls.REC_WORDS
+
+    def record(self, event: int, arg: int = 0, ts: int | None = None) -> None:
+        if ts is None:
+            import time
+
+            ts = time.monotonic_ns()
+        w = self.words
+        n = int(w[0])
+        i = 1 + (n % self.capacity) * self.REC_WORDS
+        w[i] = np.uint64(ts & _MASK64)
+        w[i + 1] = np.uint64(event & _MASK64)
+        w[i + 2] = np.uint64(int(arg) & _MASK64)
+        w[0] = np.uint64(n + 1)
+
+    def records(self) -> list[tuple[int, int, int]]:
+        """Oldest-first [(ts_ns, event, arg)]; at most `capacity` entries."""
+        w = self.words
+        n = int(w[0])
+        take = min(n, self.capacity)
+        out = []
+        for k in range(n - take, n):
+            i = 1 + (k % self.capacity) * self.REC_WORDS
+            out.append((int(w[i]), int(w[i + 1]), int(w[i + 2])))
+        return out
+
+    def replay_into(self, other: "FlightRecorder") -> None:
+        """Copy this ring's records (preserving timestamps) into `other` —
+        the attach path moves pre-shm boot events into the shared ring."""
+        for ts, ev, arg in self.records():
+            other.record(ev, arg, ts=ts)
+
+
+# -- the per-stage shm segment ------------------------------------------------
+
+SEG_MAGIC = 0xFD7B0F17  # arbitrary, stable
+_SEG_HDR_WORDS = 4  # magic, metric word count, recorder capacity, reserved
+
+
+def metrics_segment_words(schema: MetricsSchema,
+                          recorder_depth: int = FLIGHT_DEPTH) -> int:
+    return (_SEG_HDR_WORDS + schema.footprint()
+            + FlightRecorder.words_needed(recorder_depth))
+
+
+def metrics_segment_footprint(schema: MetricsSchema,
+                              recorder_depth: int = FLIGHT_DEPTH) -> int:
+    return metrics_segment_words(schema, recorder_depth) * 8
+
+
+def metrics_segment_init(buf, schema: MetricsSchema,
+                         recorder_depth: int = FLIGHT_DEPTH):
+    """Lay out a fresh segment over `buf` (shm or bytes-like); returns
+    (registry, recorder).  Called once by the CREATOR (topo.launch)."""
+    nw = metrics_segment_words(schema, recorder_depth)
+    arr = np.frombuffer(buf, dtype=np.uint64, count=nw)
+    arr[0] = np.uint64(SEG_MAGIC)
+    arr[1] = np.uint64(schema.footprint())
+    arr[2] = np.uint64(recorder_depth)
+    arr[3] = np.uint64(0)
+    return _segment_views(arr, schema)
+
+
+def metrics_segment_attach(buf, schema: MetricsSchema):
+    """Join an existing segment (child stage or read-only monitor)."""
+    hdr = np.frombuffer(buf, dtype=np.uint64, count=_SEG_HDR_WORDS)
+    if int(hdr[0]) != SEG_MAGIC:
+        raise ValueError("not a metrics segment (bad magic)")
+    n_met = int(hdr[1])
+    if n_met != schema.footprint():
+        raise ValueError(
+            f"segment metric words ({n_met}) != schema footprint "
+            f"({schema.footprint()}): schema drift between writer and reader"
+        )
+    depth = int(hdr[2])
+    nw = _SEG_HDR_WORDS + n_met + FlightRecorder.words_needed(depth)
+    arr = np.frombuffer(buf, dtype=np.uint64, count=nw)
+    return _segment_views(arr, schema)
+
+
+def _segment_views(arr: np.ndarray, schema: MetricsSchema):
+    n_met = int(arr[1])
+    a = _SEG_HDR_WORDS
+    b = a + n_met
+    reg = MetricsRegistry(schema, buf=arr[a:b])
+    rec = FlightRecorder(words=arr[b:])
+    return reg, rec
+
+
+# -- flight dumps + Chrome trace export ---------------------------------------
+
+
+def flight_dump_obj(uid: str, stages: dict, *, failed: str | None = None,
+                    reason: str = "") -> dict:
+    """Build the crash-dump object: per-stage flight records + a final
+    Prometheus snapshot.  `stages`: name -> (registry|None, recorder)."""
+    obj = {
+        "uid": uid,
+        "failed": failed,
+        "reason": reason,
+        "stages": {},
+    }
+    regs = {}
+    for name, (reg, rec) in stages.items():
+        obj["stages"][name] = {
+            "records": [list(r) for r in rec.records()] if rec else [],
+        }
+        if reg is not None:
+            regs[name] = reg
+    if regs:
+        obj["metrics"] = render_prometheus(regs)
+    return obj
+
+
+def flight_to_chrome_trace(dump: dict) -> dict:
+    """Chrome trace-event JSON from a flight dump: one thread per stage,
+    instant events per record, ASYNC b/e span pairs for batch
+    submit/complete.  Async (not B/E duration) events because batches
+    pipeline: verify keeps max_inflight batches going and completes them
+    FIFO, while Chrome pairs B/E as a LIFO stack — duration events would
+    swap overlapping spans.  Async ids pair submit k with the k-th
+    completion (the stage's own FIFO drain order)."""
+    events = []
+    stages = sorted(dump.get("stages", {}))
+    for tid, name in enumerate(stages):
+        events.append({
+            "name": "thread_name", "ph": "M", "pid": 1, "tid": tid,
+            "args": {"name": name},
+        })
+        open_ids: list[int] = []  # FIFO of submitted-batch ids
+        batch_seq = 0
+        for ts, ev, arg in dump["stages"][name].get("records", []):
+            us = ts / 1e3
+            ev_name = EVENT_NAMES.get(ev, f"ev{ev}")
+            if ev == EV_BATCH_SUBMIT:
+                batch_seq += 1
+                bid = f"{name}:{batch_seq}"
+                open_ids.append(bid)
+                events.append({"name": "batch", "cat": "batch", "ph": "b",
+                               "id": bid, "pid": 1, "tid": tid, "ts": us,
+                               "args": {"elems": arg}})
+            elif ev == EV_BATCH_COMPLETE and open_ids:
+                bid = open_ids.pop(0)  # completions drain FIFO
+                events.append({"name": "batch", "cat": "batch", "ph": "e",
+                               "id": bid, "pid": 1, "tid": tid, "ts": us,
+                               "args": {"elems": arg}})
+            else:
+                events.append({"name": ev_name, "ph": "i", "pid": 1,
+                               "tid": tid, "ts": us, "s": "t",
+                               "args": {"arg": arg}})
+        # close dangling batch spans (crash mid-flight) at the last ts
+        # so the JSON stays well-formed for strict importers
+        for bid in open_ids:
+            events.append({"name": "batch", "cat": "batch", "ph": "e",
+                           "id": bid, "pid": 1, "tid": tid,
+                           "ts": events[-1]["ts"], "args": {}})
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {"uid": dump.get("uid"), "failed": dump.get("failed"),
+                      "reason": dump.get("reason", "")},
+    }
+
+
 # The stage-loop schema every pipeline stage shares (the "all tiles" block
 # of metrics.xml): frag counters + latency histograms.
 def stage_schema() -> MetricsSchema:
@@ -208,6 +545,8 @@ def stage_schema() -> MetricsSchema:
         .counter("frags_out", "fragments published")
         .counter("overrun", "input overruns detected")
         .counter("backpressure", "publishes dropped for credits")
+        .counter("backpressure_stall", "consume stalls while credit-gated")
+        .counter("filtered", "frags dropped by before_frag")
         .histogram(
             "frag_latency_ns",
             exp_buckets(1e3, 1e10, 24),

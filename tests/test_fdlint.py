@@ -494,3 +494,45 @@ def test_fd207_flags_per_frag_ffi_only_in_frag_bodies():
     credit_line = _FFI_FRAG_SRC[: _FFI_FRAG_SRC.index("after_credit")].count(
         "\n") + 1
     assert all(f.line < credit_line for f in hits)
+
+
+# -- FD208: allocation/formatting in metric/trace hot paths -------------------
+
+
+_METRIC_HOT_SRC = '''
+class MyStage:
+    def after_frag(self, in_idx, meta, payload):
+        self.metrics.observe(f"lat_{in_idx}", 5)       # FD208: f-string label
+        self.metrics.observe("lat", len({1: 2}))       # FD208: dict literal
+        self.trace(EV_X, dict(n=len(payload)))         # FD208: dict() call
+        self.recorder.record(EV_X, "n={}".format(3))   # FD208: str.format
+        self.metrics.observe("lat", [x for x in payload][0])  # FD208: comp
+        self.metrics.observe("lat", 5)                 # ok: scalar
+        self.trace(EV_X, len(payload))                 # ok: scalar
+        self.metrics.inc("seen")                       # ok: not observe/trace
+
+    def during_housekeeping(self):
+        # not a frag callback: formatting here is fine (cold path)
+        self.trace(EV_X, sum(len(p) for p in self.batch))
+'''
+
+
+def test_fd208_flags_alloc_in_observe_trace_frag_paths():
+    findings = ast_rules.lint_source(_METRIC_HOT_SRC, "synth.py")
+    hits = [f for f in findings if f.rule == "FD208"]
+    assert len(hits) == 5
+    hk_line = _METRIC_HOT_SRC[: _METRIC_HOT_SRC.index(
+        "during_housekeeping")].count("\n") + 1
+    assert all(f.line < hk_line for f in hits)
+
+
+def test_fd208_clean_on_repo_hot_paths():
+    """The shipped stages' frag callbacks observe/trace with scalars
+    only — the rule that gates new code must hold on the code that
+    motivated it."""
+    import os
+
+    root = os.path.join(os.path.dirname(__file__), "..", "firedancer_tpu",
+                        "runtime")
+    findings = ast_rules.lint_path(root)
+    assert [f for f in findings if f.rule == "FD208"] == []

@@ -96,6 +96,55 @@ def test_prometheus_exposition():
     assert text.count("# TYPE txn_total counter") == 1
 
 
+def test_histogram_fractional_and_negative_sum():
+    """Regression (ISSUE 5 satellite): observe() used to truncate each
+    observation via int(), so sub-unit values (ms-denominated latencies)
+    summed to 0 and negatives silently corrupted the sum.  The sum word
+    now stores value * SUM_SCALE rounded; hist() divides back out."""
+    schema = fm.MetricsSchema().histogram("lat_ms", [1.0, 10.0])
+    reg = fm.MetricsRegistry(schema)
+    reg.observe("lat_ms", 0.5)
+    reg.observe("lat_ms", 0.25)
+    h = reg.hist("lat_ms")
+    assert h["count"] == 2
+    assert h["sum"] == pytest.approx(0.75, abs=2 / fm.SUM_SCALE)
+    # negatives clamp to zero contribution (first bucket, nothing summed)
+    reg.observe("lat_ms", -5.0)
+    h = reg.hist("lat_ms")
+    assert h["count"] == 3 and h["counts"][0] == 3
+    assert h["sum"] == pytest.approx(0.75, abs=2 / fm.SUM_SCALE)
+    # integer-valued observations stay exact (the pre-fix contract)
+    reg2 = fm.MetricsRegistry(schema)
+    for v in (1, 2, 3):
+        reg2.observe("lat_ms", v)
+    assert reg2.hist("lat_ms")["sum"] == 6
+
+
+def test_prometheus_escaping_hostile_names():
+    """Stage names and help strings are interpolated into the exposition
+    format: backslash, quote and newline must escape per the text-format
+    spec or a hostile name injects fake series."""
+    schema = fm.MetricsSchema().counter(
+        "txn_total", 'has "quotes" and \\slashes\nand newlines'
+    ).histogram("lat", [1.0])
+    reg = fm.MetricsRegistry(schema)
+    reg.inc("txn_total", 3)
+    reg.observe("lat", 0.5)
+    hostile = 'st"age\\one\ninjected_metric 999'
+    text = fm.render_prometheus({hostile: reg})
+    # one logical line per metric sample: the newline never leaks raw
+    assert "injected_metric 999\n" not in text.replace("\\n", "")
+    for ln in text.splitlines():
+        assert not ln.startswith("injected_metric")
+    assert 'stage="st\\"age\\\\one\\ninjected_metric 999"' in text
+    assert "# HELP txn_total" in text
+    help_line = [ln for ln in text.splitlines()
+                 if ln.startswith("# HELP txn_total")][0]
+    assert "\\\\slashes" in help_line and "\\n" in help_line
+    # histogram label lines escape the same way
+    assert 'lat_bucket{stage="st\\"age\\\\one\\ninjected_metric 999",le="1.0"}' in text
+
+
 def test_prometheus_http_endpoint():
     """The metric-tile analog: live registries scraped over HTTP."""
     import urllib.request
