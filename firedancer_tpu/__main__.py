@@ -13,6 +13,9 @@ main1.c: run / monitor / keys / configure / version, and fddev's bench):
                metric-tile HTTP endpoint), from an uninvolved process
     trace      flight-recorder rings -> Chrome trace-event JSON (open
                the output in Perfetto / chrome://tracing)
+    chaos      the scenario harness: adversarial load + fault injection
+               + invariant checking over the full validator loop
+               (`chaos list`; `chaos run <scenario> --seed S`)
     configure  host setup stages: check | init (shm, fds, cpus, THP...)
     keys       new <path> | pubkey <path> — identity keypair management
     bench      quick pipeline throughput measurement (bench.py has the
@@ -37,7 +40,7 @@ import os
 import sys
 import time
 
-__version__ = "0.5.0"  # round 5: live metrics plane + flight recorder
+__version__ = "0.6.0"  # round 6: chaos scenario harness
 
 
 def _load_cfg(args):
@@ -460,6 +463,22 @@ def main(argv=None) -> int:
     trcp.add_argument("--descriptor", default=None,
                       help="run descriptor to snapshot live (optional)")
 
+    chp = sub.add_parser(
+        "chaos",
+        help="scenario harness: adversarial load + faults + invariants",
+    )
+    chp.add_argument("action", choices=["run", "list"])
+    chp.add_argument("scenario", nargs="?", default=None,
+                     help="scenario name (see `chaos list`)")
+    chp.add_argument("--seed", type=int, default=0,
+                     help="run seed; identical seeds -> identical "
+                          "invariant summaries (the replay contract)")
+    chp.add_argument("--duration", type=float, default=None,
+                     help="wall-clock budget in seconds (scenario default"
+                          " if omitted)")
+    chp.add_argument("--clients", type=int, default=None,
+                     help="connection-storm population size")
+
     ledp = sub.add_parser("ledger", help="ingest/inspect/replay a ledger")
     ledp.add_argument("action", choices=["show", "ingest", "replay"])
     ledp.add_argument("store", help="blockstore directory")
@@ -508,6 +527,17 @@ def main(argv=None) -> int:
         return cmd_metrics(args)
     if args.cmd == "trace":
         return cmd_trace(args)
+    if args.cmd == "chaos":
+        from firedancer_tpu.utils.platform import (
+            enable_compile_cache,
+            force_cpu_backend,
+        )
+
+        force_cpu_backend()  # scenarios must never cold-init a device
+        enable_compile_cache()
+        from firedancer_tpu.chaos import scenario as _chaos
+
+        return _chaos.main(args)
     if args.cmd == "version":
         print(f"firedancer_tpu {__version__}")
         return 0

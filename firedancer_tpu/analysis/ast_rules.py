@@ -56,6 +56,18 @@ _DISABLE_RE = re.compile(r"#\s*fdlint:\s*disable=([A-Z0-9, ]+)")
 # allocation-free (a label f-string or a dict literal per observation is
 # a hidden allocator in the hottest path the stage has)
 _METRIC_HOT_ATTRS = frozenset({"observe", "trace", "record"})
+
+# FD209: non-seeded entropy entry points forbidden inside the chaos
+# package (firedancer_tpu/chaos/): reproducible replay from the run seed
+# is the harness's contract, so every random choice must come from
+# utils/rng.Rng (or something seeded from it).  Bare names only match
+# from-imports (a method on a SEEDED instance, e.g. r.getrandbits(), is
+# compliant and must not trip the rule); module-qualified matching
+# covers the whole secrets surface.
+_FD209_BARE = frozenset({
+    "urandom", "token_bytes", "token_hex", "token_urlsafe",
+    "randbelow", "getrandbits", "uuid4", "SystemRandom",
+})
 # builder calls that allocate a fresh container per invocation
 _ALLOC_BUILTINS = frozenset({"dict", "list", "set", "tuple"})
 
@@ -191,6 +203,8 @@ class _Linter(ast.NodeVisitor):
         self._funcs = funcs or {}  # from-imported name -> (module, func)
         self._nmods = nmods or set()  # FD207: native-module aliases
         self._nfuncs = nfuncs or set()  # FD207: native from-imports
+        # FD209 scope: files under a chaos/ package directory
+        self._chaos = "chaos" in re.split(r"[/\\]", path)
 
     def _resolve(self, node: ast.Call) -> tuple[str, str] | None:
         """Canonical (module, func) for a call, seeing through `import
@@ -256,8 +270,43 @@ class _Linter(ast.NodeVisitor):
                      "builtin hash() is salted per process"
                      " (PYTHONHASHSEED); use zlib.crc32/hashlib for"
                      " stable values")
+        if self._chaos:
+            self._check_chaos_entropy(node)
         self._check_builder_arg(node)
         self.generic_visit(node)
+
+    def _check_chaos_entropy(self, node: ast.Call) -> None:
+        """FD209: the chaos package must derive ALL randomness from the
+        run seed (utils/rng) — an os.urandom/secrets/unseeded-generator
+        call anywhere in a scenario silently breaks seed-replay.  The
+        process-global random module (random.choice/randint/...) is NOT
+        re-checked here: FD203 already flags it repo-wide, chaos
+        included."""
+        dq = _dotted(node.func)
+        if dq is None:
+            return
+        entropy = (
+            dq[0] == "secrets"               # the whole secrets surface
+            or dq == ("os", "urandom")
+            or dq[-1] in ("uuid4", "SystemRandom")
+            or (len(dq) == 1 and dq[0] in _FD209_BARE)  # from-imports
+        )
+        if entropy:
+            self.hit("FD209", node,
+                     f"non-seeded entropy '{'.'.join(dq)}' in chaos/:"
+                     " thread the run seed through utils/rng.Rng"
+                     " (reproducible replay is the harness contract)")
+            return
+        unseeded = not node.args and not node.keywords
+        if dq[-1] == "Random" and unseeded:
+            self.hit("FD209", node,
+                     "unseeded random.Random() in chaos/: construct from"
+                     " the run seed (or use utils/rng.Rng)")
+        elif dq[-1] == "default_rng" and len(dq) >= 2 \
+                and dq[-2] == "random" and unseeded:
+            self.hit("FD209", node,
+                     "unseeded np.random.default_rng() in chaos/: pass"
+                     " the run seed")
 
     def _check_frag_call(self, node: ast.Call,
                          mf: tuple[str, str] | None) -> None:

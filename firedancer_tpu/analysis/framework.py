@@ -159,3 +159,10 @@ FD208 = _rule(
     " callback: the metric/trace hot path must stay allocation-free —"
     " precompute labels and pass scalars",
 )
+FD209 = _rule(
+    "FD209", "unseeded-randomness-in-chaos", SEV_ERROR,
+    "non-seeded entropy source (os.urandom, secrets.*, uuid4, unseeded"
+    " random.Random()/np.random.default_rng()) inside the chaos package:"
+    " every scenario must thread the run seed through utils/rng —"
+    " reproducible replay is the harness's core contract",
+)

@@ -1,0 +1,29 @@
+"""chaos/: the million-user scenario harness.
+
+The production test the subsystems cannot give individually (ROADMAP
+open item 5): adversarial load, fault injection, and invariant checking
+over the FULL validator loop — QUIC connection storms through the real
+waltz ingress, duplicate floods through dedup, fork storms through
+choreo, leader handoffs under load, and stage kills under the process
+supervisor — each scenario ending in an invariant suite (liveness,
+bank-hash integrity vs a golden replay, conservation of accepted-txn
+counts across hops, no frag corruption) whose failure artifact is the
+existing flight-recorder dump + Chrome trace.
+
+Layout:
+    population.py   N simulated clients over the real QUIC ingress
+                    (honest / storm / garbage mixes, seeded arrivals)
+    faults.py       declarative fault schedule + the supervisor hook
+                    (kill/freeze stages) and link-fault specs (the
+                    tango/lossy.py shim)
+    invariants.py   the checker: named checks -> a deterministic summary
+    scenario.py     named scenarios + the runner behind
+                    `python -m firedancer_tpu chaos run <name> --seed S`
+
+Reproducibility is the core contract: every random choice threads the
+run seed through utils/rng.Rng (fdlint FD209 flags anything else inside
+this package), so `chaos run <scenario> --seed S` emits an identical
+invariant summary on every run.
+"""
+
+from firedancer_tpu.chaos.scenario import SCENARIOS, run_scenario  # noqa: F401

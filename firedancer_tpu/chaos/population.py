@@ -1,0 +1,277 @@
+"""Population load generator: N simulated clients over the REAL QUIC
+ingress.
+
+Every client is a genuine `waltz.quic.Connection` endpoint (real RFC
+9000/9001 wire bytes, real TLS 1.3 handshake, real Retry handling) —
+what is simulated is only the NETWORK: datagrams move through an
+in-memory `ChaosSock` instead of a kernel socket, so a single process
+drives thousands of peers deterministically and the harness holds an
+independent per-address byte ledger to audit the server's
+anti-amplification discipline from the outside.
+
+Client kinds (the adversarial mix):
+  honest    full handshake (identity-pinned), ships unique signed-shape
+            txn payloads on per-txn unidirectional streams, pumps loss
+            recovery until everything is delivered AND acked
+  storm     one real (padded, untokened) Initial, then silence — the
+            spoofed-source connection-storm attacker; the server must
+            answer with at most a stateless Retry and allocate nothing
+  garbage   malformed/unknown-version/unknown-CID datagrams — the
+            fuzzer-shaped noise every public port eats
+
+Arrival times are heavy-tailed (a bounded Pareto over the step axis)
+from the seeded Rng: a storm is a stampede, not a uniform trickle.
+All randomness threads `utils/rng.Rng` (fdlint FD209).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+
+from firedancer_tpu.utils.rng import Rng
+
+HONEST = "honest"
+STORM = "storm"
+GARBAGE = "garbage"
+
+
+def rng_bytes_fn(rng: Rng):
+    """An os.urandom-shaped callable over the seeded Rng — what
+    quic.Connection/tls13 accept as their entropy source, so client CIDs
+    and key shares derive from the run seed."""
+
+    def take(n: int) -> bytes:
+        out = bytearray()
+        while len(out) < n:
+            out += rng.ulong().to_bytes(8, "little")
+        return bytes(out[:n])
+
+    return take
+
+
+class ChaosSock:
+    """The ingress stage's socket, virtualized: captures every outbound
+    (datagram, dst) into a per-destination queue and keeps the
+    independent tx-byte ledger the amplification audit reads.  recvfrom
+    is always empty — inbound datagrams are injected straight into the
+    stage's `_on_datagram` by the population pump."""
+
+    def __init__(self):
+        self.tx: dict = {}          # dst -> deque[datagram]
+        self.tx_bytes: dict = {}    # dst -> total bytes sent to dst
+        self.tx_datagrams = 0
+
+    def setblocking(self, flag) -> None:  # socket surface the stage uses
+        pass
+
+    def getsockname(self):
+        return ("chaos", 0)
+
+    def recvfrom(self, n: int):
+        raise BlockingIOError  # the pump injects; the socket is silent
+
+    def sendto(self, dg: bytes, dst) -> None:
+        self.tx.setdefault(dst, deque()).append(bytes(dg))
+        self.tx_bytes[dst] = self.tx_bytes.get(dst, 0) + len(dg)
+        self.tx_datagrams += 1
+
+    def close(self) -> None:
+        pass
+
+
+@dataclass
+class _Client:
+    addr: tuple
+    kind: str
+    start_step: int
+    conn: object = None          # quic.Connection (honest/storm)
+    txns: list = field(default_factory=list)   # payloads still to send
+    sent: list = field(default_factory=list)   # payloads handed to QUIC
+    next_sid: int = 2
+    launched: bool = False
+    done: bool = False
+
+
+class Population:
+    """Drive `n_honest + n_storm + n_garbage` clients against a
+    QuicIngressStage whose socket is a ChaosSock.  `step()` advances one
+    round; the scenario interleaves it with `stage.run_once()` and its
+    own sink drain."""
+
+    def __init__(self, stage, *, seed: int, n_honest: int, n_storm: int,
+                 n_garbage: int = 0, server_pub: bytes | None = None,
+                 txns_per_honest: int = 4, txn_len: int = 96,
+                 loss_p: float = 0.0, spread_steps: int = 16):
+        assert isinstance(stage.sock, ChaosSock), \
+            "Population needs the stage socket virtualized (ChaosSock)"
+        self.stage = stage
+        self.server_pub = server_pub
+        self.loss_p = loss_p
+        self.rng = Rng(seed, 0xC4A05)
+        self._net_rng = Rng(seed, 0x10557)  # loss decisions: own stream
+        self.rx_bytes: dict = {}  # addr -> bytes the server received
+        self.clients: list[_Client] = []
+        self.honest_payloads: list[bytes] = []
+        self.garbage_counts = [0, 0, 0]  # by _spray_garbage pick
+        mk = []
+        mk += [HONEST] * n_honest
+        mk += [STORM] * n_storm
+        mk += [GARBAGE] * n_garbage
+        honest_seen = 0
+        for i, kind in enumerate(mk):
+            addr = (f"10.{(i >> 16) & 255}.{(i >> 8) & 255}.{i & 255}",
+                    40_000 + (i & 0x3FFF))
+            # bounded-Pareto arrival over the step axis: most clients
+            # stampede early, a heavy tail straggles in
+            u = max(self.rng.float01(), 1e-9)
+            start = min(int((u ** -0.5 - 1.0) * spread_steps / 4),
+                        spread_steps)
+            c = _Client(addr, kind, start)
+            if kind == HONEST:
+                honest_seen += 1
+                for k in range(txns_per_honest):
+                    payload = (b"chaos-txn-%06d-%02d-" % (i, k)
+                               + rng_bytes_fn(self.rng)(txn_len))
+                    if honest_seen % 3 == 0 and k == txns_per_honest - 1:
+                        # the garbled-content lane: a txn-shaped payload
+                        # whose "signature" region is trash — the ingress
+                        # is content-agnostic (delivery IS the invariant;
+                        # rejection belongs to the verify stage, which
+                        # the pipeline scenarios exercise)
+                        payload = b"chaos-badsig-" + payload[13:]
+                    c.txns.append(payload)
+                    self.honest_payloads.append(payload)
+                if honest_seen % 5 == 0 and c.txns:
+                    # the duplicate lane: this client re-ships its first
+                    # txn on a fresh stream; the ingress must deliver
+                    # BOTH copies (txn dedup is dedup's job, not QUIC's)
+                    c.txns.append(c.txns[0])
+                    self.honest_payloads.append(c.txns[0])
+            self.clients.append(c)
+        self._step = 0
+
+    # -- the wire (both directions, with seeded loss) -------------------------
+
+    def _to_server(self, c: _Client, dg: bytes) -> None:
+        if self.loss_p and self._net_rng.float01() < self.loss_p:
+            return
+        self.rx_bytes[c.addr] = self.rx_bytes.get(c.addr, 0) + len(dg)
+        self.stage._on_datagram(dg, c.addr)
+
+    def _drain_server(self, c: _Client) -> list[bytes]:
+        q = self.stage.sock.tx.get(c.addr)
+        out = []
+        while q:
+            dg = q.popleft()
+            if self.loss_p and self._net_rng.float01() < self.loss_p:
+                continue
+            out.append(dg)
+        return out
+
+    # -- per-kind behavior ----------------------------------------------------
+
+    def _launch(self, c: _Client) -> None:
+        from firedancer_tpu.waltz import quic
+
+        c.launched = True
+        if c.kind == GARBAGE:
+            self._spray_garbage(c)
+            c.done = True
+            return
+        rnd = rng_bytes_fn(self.rng)
+        c.conn = quic.Connection.client_new(
+            expected_peer=self.server_pub if c.kind == HONEST else None,
+            rng=rnd,
+        )
+        for dg in c.conn.flush():
+            self._to_server(c, dg)
+        if c.kind == STORM:
+            # the attacker never processes the (at most stateless Retry)
+            # response; its single flight is the whole attack
+            c.done = True
+
+    def _spray_garbage(self, c: _Client) -> None:
+        import struct
+
+        rnd = rng_bytes_fn(self.rng)
+        pick = self.rng.roll(3)
+        self.garbage_counts[pick] += 1
+        if pick == 0:  # unknown long-header version, big enough for VN
+            dg = bytearray([0xC0]) + struct.pack(">I", 0x1A2A3A4A)
+            dg += bytes([8]) + rnd(8) + bytes([8]) + rnd(8)
+            dg += rnd(1200 - len(dg))
+            self._to_server(c, bytes(dg))
+        elif pick == 1:  # short-header unknown CID -> stateless reset
+            self._to_server(c, bytes([0x41]) + rnd(8) + rnd(60))
+        else:
+            # undersized unknown-version junk: a fixed long-header
+            # prefix (version 0xABADBEEF, never 0 or 1) so the server's
+            # deterministic answer is SILENCE for every seed — tiny
+            # unknown-version probes must never draw a reply (§6)
+            import struct
+
+            dg = bytes([0xC0]) + struct.pack(">I", 0xABADBEEF) + rnd(43)
+            self._to_server(c, dg)
+
+    def _pump_honest(self, c: _Client) -> None:
+        conn = c.conn
+        for dg in self._drain_server(c):
+            try:
+                conn.receive(dg)
+            except Exception:
+                # a chaos-mangled datagram must not kill the CLIENT model
+                # either; real clients drop undecryptable packets too
+                continue
+        if conn.established and c.txns:
+            payload = c.txns.pop(0)
+            conn.send_stream(c.next_sid, payload, fin=True)
+            c.sent.append(payload)
+            c.next_sid += 4
+        conn.poll_timers()
+        for dg in conn.flush():
+            self._to_server(c, dg)
+        if conn.established and not c.txns and not conn.has_unacked():
+            c.done = True
+
+    # -- the round ------------------------------------------------------------
+
+    def step(self) -> None:
+        self._step += 1
+        for c in self.clients:
+            if c.done or self._step <= c.start_step:
+                continue
+            if not c.launched:
+                self._launch(c)
+            elif c.kind == HONEST:
+                self._pump_honest(c)
+
+    def all_launched(self) -> bool:
+        return all(c.launched for c in self.clients)
+
+    def honest_done(self) -> bool:
+        return all(c.done for c in self.clients if c.kind == HONEST)
+
+    def counts(self) -> dict:
+        out = {HONEST: 0, STORM: 0, GARBAGE: 0}
+        for c in self.clients:
+            out[c.kind] += 1
+        return out
+
+    # -- the amplification audit ---------------------------------------------
+
+    def budget_violations(self) -> list:
+        """Addresses the server sent MORE than 3x what they sent it,
+        excluding validated (handshake-complete) peers — the outside-in
+        check of RFC 9000 §8.1 over the harness's own ledgers."""
+        validated = set()
+        for c in self.clients:
+            if c.conn is not None and getattr(c.conn, "established", False):
+                validated.add(c.addr)
+        out = []
+        for addr, tx in self.stage.sock.tx_bytes.items():
+            if addr in validated:
+                continue
+            if tx > 3 * self.rx_bytes.get(addr, 0):
+                out.append(addr)
+        return sorted(out)

@@ -1,0 +1,903 @@
+"""Named chaos scenarios + the runner behind `fdtpu chaos run`.
+
+Each scenario composes real subsystems (waltz QUIC ingress, the dedup
+tcache, the choreo fork machinery, the full leader pipeline, the process
+supervisor) with the population generator and fault injector, then runs
+the invariant checker.  The contract:
+
+  - `run_scenario(name, seed=S)` is DETERMINISTIC: the returned
+    summary (checks -> booleans + the info dict) is identical for
+    identical seeds — counts and digests only, never wall-clock values;
+  - on any invariant violation (or an induced stage failure) the
+    existing observability plane IS the failure artifact: the supervisor
+    flight dump where a process topology ran, a recorder dump built from
+    the stages' rings otherwise, plus the Chrome-trace conversion —
+    written next to the summary under RUN_DIR as
+    fdtpu_chaos_<scenario>_s<seed>*.json.
+
+Catalog (docs/OPERATIONS.md has the runbook):
+  connection-storm  >=1k clients (honest/storm/garbage mix) against the
+                    real QUIC ingress: RetryGate statelessness, the 3x
+                    anti-amplification budget (audited from the
+                    harness's own byte ledgers), honest delivery
+  dedup-flood       duplicate-heavy txn flood (+ injected link
+                    duplication/reordering) through the dedup stage:
+                    exactly-once survival, dup accounting conserves
+  fork-storm        seeded fork/vote storm with a partition fault
+                    through ghost+tower: stake-weight conservation,
+                    heaviest-path head, post-heal convergence, pruning
+  leader-handoff    two consecutive leader slots under load with a
+                    lossy shred link: both blocks golden-replay to the
+                    sealed bank hashes, chained
+  stage-kill        SIGKILL a pipeline stage mid-run under the process
+                    supervisor: fail-fast, flight dump written, every
+                    shm segment reclaimed, clean restart
+
+Stage classes and builders are module-level: the stage-kill scenario
+spawns real child processes (fdlint FD205/FD110 discipline).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from dataclasses import dataclass, field
+
+from firedancer_tpu.chaos import faults as cf
+from firedancer_tpu.chaos import invariants as inv
+from firedancer_tpu.runtime.stage import Stage
+from firedancer_tpu.tango import shm
+from firedancer_tpu.utils import metrics as fm
+from firedancer_tpu.utils.rng import Rng
+
+
+def _run_dir() -> str:
+    from firedancer_tpu.runtime import monitor as mon
+
+    return mon.RUN_DIR
+
+
+@dataclass
+class ScenarioResult:
+    scenario: str
+    seed: int
+    suite: inv.InvariantSuite
+    info: dict = field(default_factory=dict)
+    artifacts: list = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return self.suite.ok
+
+    def summary(self) -> dict:
+        """The deterministic contract: identical for identical seeds."""
+        return {
+            "scenario": self.scenario,
+            "seed": self.seed,
+            "ok": self.ok,
+            "checks": self.suite.summary(),
+            "info": {k: self.info[k] for k in sorted(self.info)},
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.summary(), sort_keys=True, indent=1)
+
+
+def _artifact_base(name: str, seed: int) -> str:
+    return os.path.join(_run_dir(), f"fdtpu_chaos_{name}_s{seed}")
+
+
+def _capture_coop_failure(result: ScenarioResult, stages) -> None:
+    """Cooperative pipelines have no supervisor to dump for them: build
+    the flight dump from the stages' own recorder rings + the Chrome
+    trace, the same artifact pair the process path gets for free.
+    `stages`: a list of Stage objects, or {label: Stage} when names
+    alone would collide (e.g. the same pipeline run twice)."""
+    if not isinstance(stages, dict):
+        stages = {s.name: s for s in stages}
+    base = _artifact_base(result.scenario, result.seed)
+    dump = fm.flight_dump_obj(
+        f"chaos-{result.scenario}-s{result.seed}",
+        {label: (None, s.recorder) for label, s in stages.items()},
+        failed=None,
+        reason="; ".join(c.name for c in result.suite.violations()),
+    )
+    path = base + "_flight.json"
+    with open(path, "w") as f:
+        json.dump(dump, f)
+    result.artifacts.append(path)
+    tpath = base + "_trace.json"
+    with open(tpath, "w") as f:
+        json.dump(fm.flight_to_chrome_trace(dump), f)
+    result.artifacts.append(tpath)
+
+
+def _capture_trace_from_dump(result: ScenarioResult,
+                             dump_path: str | None) -> None:
+    if not dump_path or not os.path.exists(dump_path):
+        return
+    result.artifacts.append(dump_path)
+    with open(dump_path) as f:
+        dump = json.load(f)
+    tpath = _artifact_base(result.scenario, result.seed) + "_trace.json"
+    with open(tpath, "w") as f:
+        json.dump(fm.flight_to_chrome_trace(dump), f)
+    result.artifacts.append(tpath)
+
+
+# =============================================================================
+# connection-storm
+# =============================================================================
+
+
+def run_connection_storm(seed: int = 0, duration: float = 20.0, *,
+                         n_clients: int = 1000, n_honest: int = 16,
+                         txns_per_honest: int = 3, loss_p: float = 0.0,
+                         amplification_probe: bool = True) -> ScenarioResult:
+    """>=1k simulated clients against the real waltz QUIC ingress with
+    the retry gate armed: the storm must cost the server nothing but
+    stateless Retries, honest traffic must hand-shake through the gate
+    and deliver every txn, and the server must never send an unvalidated
+    address more than 3x what it received (audited from the population's
+    own byte ledger, not the server's)."""
+    from firedancer_tpu.chaos.population import ChaosSock, Population
+    from firedancer_tpu.ops.ref import ed25519_ref as ref
+    from firedancer_tpu.runtime.net import QuicIngressStage
+
+    suite = inv.InvariantSuite()
+    identity = hashlib.sha256(b"chaos-storm-%d" % seed).digest()
+    n_garbage = max(n_clients // 8, 3)
+    n_storm = max(n_clients - n_honest - n_garbage, 0)
+    uid = f"chaos{os.getpid()}_{seed}"
+    link = shm.ShmLink.create(f"fdtpu_cs_{uid}", depth=4096, mtu=2048)
+    stage = QuicIngressStage(
+        "quic", outs=[shm.Producer(link)], sock=ChaosSock(), rx_burst=8,
+        identity_secret=identity, retry=True,
+        max_conns=max(64, 2 * n_honest),
+    )
+    sink = shm.Consumer(link, lazy=16)
+    received: list[bytes] = []
+    pop = Population(
+        stage, seed=seed, n_honest=n_honest, n_storm=n_storm,
+        n_garbage=n_garbage, server_pub=ref.public_key(identity),
+        txns_per_honest=txns_per_honest, loss_p=loss_p,
+    )
+    try:
+        deadline = time.monotonic() + duration
+        while time.monotonic() < deadline:
+            pop.step()
+            for _ in range(4):
+                stage.run_once()
+            while True:
+                r = sink.poll()
+                if not isinstance(r, tuple):
+                    break
+                received.append(bytes(r[1]))
+            if pop.all_launched() and pop.honest_done():
+                break
+        expect = sorted(pop.honest_payloads)
+        suite.check("ingress-survived-storm", True)
+        suite.check("honest-clients-completed", pop.honest_done(),
+                    "honest traffic did not finish inside the duration")
+        suite.check("honest-txns-delivered-exactly-once",
+                    sorted(received) == expect,
+                    f"received {len(received)} vs expected {len(expect)}")
+        inv.check_no_corruption(suite, expect, received)
+        # stateless retry accounting: every valid untokened Initial —
+        # the whole storm plus each honest client's first flight — cost
+        # exactly one Retry and zero state.  The equality is TIMING-
+        # ROBUST, not luck: the virtual net delivers synchronously
+        # (_to_server -> _on_datagram -> Retry queued within the same
+        # call), and _pump_honest always drains the server queue BEFORE
+        # polling recovery timers, so a client processes its Retry (and
+        # carries the token ever after) before any PTO could re-send an
+        # untokened Initial on a slow machine.  (>= under injected loss,
+        # where a dropped Retry legitimately makes PTO re-send one.)
+        retries = stage.metrics.get("retry_tx")
+        if loss_p:
+            suite.check("retry-per-untokened-initial",
+                        retries >= n_storm + n_honest,
+                        f"retry_tx {retries} < {n_storm + n_honest}")
+        else:
+            suite.check("retry-per-untokened-initial",
+                        retries == n_storm + n_honest,
+                        f"retry_tx {retries} != {n_storm + n_honest}")
+        suite.check("storm-allocates-no-connections",
+                    len(stage.conns) == n_honest,
+                    f"{len(stage.conns)} conns != {n_honest} honest")
+        suite.check("amplification-budget-held",
+                    not pop.budget_violations(),
+                    f"addrs over 3x: {pop.budget_violations()[:5]}")
+        g = pop.garbage_counts
+        suite.check("garbage-answered-boundedly",
+                    stage.metrics.get("version_negotiation_tx") == g[0]
+                    and stage.metrics.get("stateless_reset_tx") == g[1],
+                    f"vn={stage.metrics.get('version_negotiation_tx')}"
+                    f"/{g[0]} reset="
+                    f"{stage.metrics.get('stateless_reset_tx')}/{g[1]}")
+        info = {
+            "clients": n_clients,
+            "honest": n_honest,
+            "storm": n_storm,
+            "garbage": n_garbage,
+            "txns_expected": len(pop.honest_payloads),
+            "delivered_digest": inv.payload_digest(received),
+            "retry_tx": retries if not loss_p else None,
+        }
+        if amplification_probe:
+            info["amplification_capped"] = _amplification_probe(
+                suite, seed, identity, min(duration / 4, 3.0))
+    finally:
+        stage.close()
+        link.close()
+        link.unlink()
+    result = ScenarioResult("connection-storm", seed, suite, info)
+    if not suite.ok:
+        _capture_coop_failure(result, [stage])
+    return result
+
+
+def _amplification_probe(suite: inv.InvariantSuite, seed: int,
+                         identity: bytes, budget_s: float) -> bool:
+    """The no-retry flank: storm Initials against retry=False force the
+    server to START handshakes toward silent (spoofed-looking) peers;
+    sustained PTO retransmission pressure must hit the 3x cap, never
+    break it.  The recovery clock is driven in VIRTUAL time (the
+    loss-test idiom): the raw-public-key server flight is small, so in
+    wall time exponential backoff would take minutes to accumulate 3x —
+    virtual time walks the same PTO/flush/_send machinery through as
+    many probe timeouts as the budget math needs, deterministically."""
+    from firedancer_tpu.chaos.population import ChaosSock, Population
+    from firedancer_tpu.runtime.net import QuicIngressStage
+
+    uid = f"chaosamp{os.getpid()}_{seed}"
+    link = shm.ShmLink.create(f"fdtpu_ca_{uid}", depth=256, mtu=2048)
+    stage = QuicIngressStage(
+        "quic-amp", outs=[shm.Producer(link)], sock=ChaosSock(), rx_burst=8,
+        identity_secret=identity, retry=False, max_conns=8,
+    )
+    pop = Population(stage, seed=seed + 1, n_honest=0, n_storm=8,
+                     n_garbage=0, spread_steps=1)
+    try:
+        for _ in range(4):  # launch every storm client (real ingress path)
+            pop.step()
+            stage.run_once()
+        now = time.monotonic()  # virtual clock starts at the real one:
+        # the in-flight packets carry real monotonic send stamps
+        for _ in range(24):  # >> the fires needed to accumulate 3x
+            if stage.metrics.get("tx_amplification_capped"):
+                break
+            now += max((c.pto_interval()
+                        for c in stage.conns.values()), default=1.0) + 1e-3
+            for src, conn in stage.conns.items():
+                conn.poll_timers(now)
+                for dg in conn.flush(now):
+                    stage._send(dg, src)
+        capped = stage.metrics.get("tx_amplification_capped") > 0
+        suite.check("amplification-cap-engaged-under-pto-pressure", capped,
+                    "PTO pressure never hit the 3x cap")
+        suite.check("amplification-budget-held-no-retry",
+                    not pop.budget_violations(),
+                    f"addrs over 3x: {pop.budget_violations()[:5]}")
+        return capped
+    finally:
+        stage.close()
+        link.close()
+        link.unlink()
+
+
+# =============================================================================
+# dedup-flood
+# =============================================================================
+
+
+class FloodFeeder(Stage):
+    """Publishes a prebuilt (sig, payload) schedule at max rate."""
+
+    def __init__(self, schedule, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.schedule = schedule
+        self._i = 0
+
+    def after_credit(self) -> None:
+        for _ in range(max(1, self.burst)):
+            if self._i >= len(self.schedule):
+                return
+            sig, payload = self.schedule[self._i]
+            if not self.publish(0, payload, sig=sig):
+                return
+            self._i += 1
+
+    @property
+    def done(self) -> bool:
+        return self._i >= len(self.schedule)
+
+
+class CollectSink(Stage):
+    """Collects (sig, payload) pairs for the invariant checker."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.got: list[tuple[int, bytes]] = []
+
+    def after_frag(self, in_idx: int, meta, payload: bytes) -> None:
+        from firedancer_tpu.tango.rings import MCache
+
+        self.got.append((int(meta[MCache.COL_SIG]), bytes(payload)))
+
+
+def run_dedup_flood(seed: int = 0, duration: float = 10.0, *,
+                    n_unique: int = 256, copies: int = 6,
+                    dup_p: float = 0.05,
+                    reorder_p: float = 0.10) -> ScenarioResult:
+    """Flood the REAL dedup stage with every txn duplicated `copies`
+    times in seeded-shuffled order, and additionally duplicate/reorder
+    frags on the wire (the tango lossy shim): exactly one copy of every
+    unique txn survives, and the duplicate accounting reconciles to the
+    frag."""
+    from firedancer_tpu.runtime.dedup import DedupStage
+    from firedancer_tpu.tango.lossy import wrap_stage_input
+
+    suite = inv.InvariantSuite()
+    rng = Rng(seed, 0xDED)
+    uniq = []
+    for i in range(n_unique):
+        payload = (b"flood-%05d-" % i
+                   + b"".join(rng.ulong().to_bytes(8, "little")
+                              for _ in range(10)))
+        sig = int.from_bytes(
+            hashlib.sha256(payload).digest()[:8], "little")
+        uniq.append((sig, payload))
+    schedule = uniq * copies
+    rng.shuffle(schedule)
+
+    uid = f"chaosdd{os.getpid()}_{seed}"
+    l_in = shm.ShmLink.create(f"fdtpu_dfi_{uid}", depth=1024, mtu=256)
+    l_out = shm.ShmLink.create(f"fdtpu_dfo_{uid}", depth=1024, mtu=256)
+    feeder = FloodFeeder(schedule, "flood", outs=[shm.Producer(l_in)])
+    dedup = DedupStage("dedup", ins=[shm.Consumer(l_in, lazy=32)],
+                       outs=[shm.Producer(l_out)])
+    sink = CollectSink("sink", ins=[shm.Consumer(l_out, lazy=32)])
+    shim = wrap_stage_input(dedup, 0, Rng(seed, 0x5417),
+                            dup_p=dup_p, reorder_p=reorder_p)
+    stages = [feeder, dedup, sink]
+    try:
+        deadline = time.monotonic() + duration
+        idle = 0
+        while time.monotonic() < deadline and idle < 3:
+            progressed = False
+            for s in stages:
+                progressed |= bool(s.run_once())
+            idle = 0 if (progressed or not feeder.done) else idle + 1
+        total_in = len(schedule) + shim.duplicated
+        suite.check("flood-fully-fed", feeder.done,
+                    f"fed {feeder._i}/{len(schedule)}")
+        suite.check("exactly-once-survival",
+                    sorted(s for s, _ in sink.got)
+                    == sorted(s for s, _ in uniq),
+                    f"{len(sink.got)} survivors vs {n_unique} unique")
+        suite.check("dup-accounting-conserves",
+                    dedup.metrics.get("dedup_dup")
+                    == total_in - n_unique,
+                    f"dedup_dup {dedup.metrics.get('dedup_dup')} != "
+                    f"{total_in} - {n_unique}")
+        by_sig = dict(uniq)
+        inv.check_no_corruption(
+            suite, [p for _s, p in uniq], [p for _s, p in sink.got],
+            allow_dupes=False)
+        suite.check("payloads-keyed-consistently",
+                    all(by_sig.get(s) == p for s, p in sink.got))
+        info = {
+            "unique": n_unique,
+            "copies": copies,
+            "shim_duplicated": shim.duplicated,
+            "shim_reordered": shim.reordered,
+            "survivor_digest": inv.payload_digest(p for _s, p in sink.got),
+        }
+    finally:
+        for link in (l_in, l_out):
+            link.close()
+            try:
+                link.unlink()
+            except FileNotFoundError:
+                pass
+    result = ScenarioResult("dedup-flood", seed, suite, info)
+    if not suite.ok:
+        _capture_coop_failure(result, stages)
+    return result
+
+
+# =============================================================================
+# fork-storm
+# =============================================================================
+
+
+def run_fork_storm(seed: int = 0, duration: float = 10.0, *,
+                   n_voters: int = 24, rounds: int = 60,
+                   fork_p: float = 0.35) -> ScenarioResult:
+    """A seeded storm of competing forks through the real choreo stack
+    (ghost fork choice + tower lockouts) with a mid-storm partition that
+    withholds a voter group's stake and then heals: stake weights must
+    conserve exactly, the head must sit on the heaviest path at every
+    round, the tower must never vote across a lockout, and after the
+    heal the cluster must converge on one chain which pruning then
+    isolates.
+
+    (duration is accepted for the uniform scenario signature, but a
+    fork storm runs in VIRTUAL rounds — its length is `rounds` and it
+    completes in bounded work regardless of the wall clock.)"""
+    from firedancer_tpu.choreo.ghost import Ghost
+    from firedancer_tpu.choreo.tower import Tower
+
+    suite = inv.InvariantSuite()
+    rng = Rng(seed, 0xF04C)
+    part = cf.Partition(at_step=rounds // 3, heal_step=2 * rounds // 3,
+                        group_frac=0.3)
+    voters = [hashlib.sha256(b"chaos-voter-%d-%d" % (seed, i)).digest()
+              for i in range(n_voters)]
+    stake = {v: 50 + int(rng.roll(100)) for v in voters}
+    total_stake = sum(stake.values())
+    cut = voters[: int(n_voters * part.group_frac)]
+
+    ghost = Ghost(0)
+    tower = Tower()
+    tips = [0]  # live fork tips; a storm keeps several alive
+    next_slot = 1
+    own_votes: list[int] = []
+    blocks = 0
+    head_ok_every_round = True
+    withheld: list[tuple[bytes, int, int]] = []
+    for step in range(1, rounds + 1):
+        # grow: extend a seeded tip; sometimes fork a second child off it
+        tip = tips[int(rng.roll(len(tips)))]
+        ghost.insert(next_slot, tip)
+        new_tip = next_slot
+        next_slot += 1
+        blocks += 1
+        if rng.float01() < fork_p:
+            ghost.insert(next_slot, tip)
+            tips.append(next_slot)
+            next_slot += 1
+            blocks += 1
+        tips = [t for t in tips if t != tip] + [new_tip]
+        if len(tips) > 6:  # bound the frontier like pruning would
+            tips = tips[-6:]
+        # votes: every voter votes its heaviest visible tip; a
+        # partitioned voter's vote is WITHHELD (the gossip cut) and
+        # replayed at heal — late, exactly like real gossip convergence
+        partitioned = part.at_step <= step < part.heal_step
+        for v in voters:
+            target = max(tips, key=lambda s: (ghost.nodes[s].weight, -s))
+            if partitioned and v in cut:
+                withheld.append((v, target, stake[v]))
+                continue
+            ghost.vote(v, target, stake[v])
+        if step == part.heal_step:
+            for v, slot, st in withheld:
+                if slot in ghost.nodes:
+                    ghost.vote(v, slot, st)
+            withheld.clear()
+        # our own node: the backtest decision rule over the live tree
+        head = ghost.head()
+        cur = ghost.root
+        while ghost.nodes[cur].children:
+            cur = min(ghost.nodes[cur].children,
+                      key=lambda s: (-ghost.nodes[s].weight, s))
+        head_ok_every_round &= (cur == head)
+        last = tower.last_vote()
+        if (last is None or head > last) and tower.lockout_check(
+            head, ghost.is_ancestor
+        ) and tower.threshold_check(head, ghost.weight, total_stake):
+            tower.vote(head)
+            own_votes.append(head)
+
+    inv.check_ghost_weight_conservation(suite, ghost)
+    inv.check_head_on_heaviest_path(suite, ghost)
+    suite.check("head-on-heaviest-path-every-round", head_ok_every_round)
+    suite.check("tower-votes-monotonic",
+                own_votes == sorted(own_votes)
+                and len(set(own_votes)) == len(own_votes),
+                f"votes: {own_votes[-8:]}")
+    # real lockout discipline over the FINAL tower stack: strictly
+    # increasing slots, every deeper vote still unexpired at the votes
+    # stacked on top of it (nested lockouts), and the whole stack on one
+    # chain — a tower that ever voted across a lockout leaves a
+    # non-ancestor pair here
+    stack = list(tower.votes)
+    nested = all(
+        a.slot < b.slot and a.expiration >= b.slot
+        for a, b in zip(stack, stack[1:])
+    )
+    on_one_chain = all(
+        ghost.is_ancestor(a.slot, b.slot)
+        for a, b in zip(stack, stack[1:])
+        if a.slot in ghost.nodes and b.slot in ghost.nodes
+    )
+    suite.check("tower-lockouts-nested", nested,
+                f"stack: {[(v.slot, v.conf) for v in stack][-6:]}")
+    suite.check("tower-stack-on-one-chain", on_one_chain)
+    final_head = ghost.head()
+    # post-heal convergence: every voter's latest vote sits on the head's
+    # chain (the partition healed INTO one fork)
+    diverged = [
+        v.hex()[:8] for v, (slot, _st) in ghost.latest_vote.items()
+        if not (ghost.is_ancestor(slot, final_head)
+                or ghost.is_ancestor(final_head, slot))
+    ]
+    suite.check("post-heal-convergence", not diverged,
+                f"voters off the winning chain: {diverged}")
+    # publish: root at the head's grandparent prunes every dead fork
+    new_root = final_head
+    for _ in range(2):
+        parent = ghost.nodes[new_root].parent
+        if parent is None:
+            break
+        new_root = parent
+    pruned = ghost.publish(new_root)
+    suite.check("publish-prunes-dead-forks",
+                all(ghost.is_ancestor(new_root, s) for s in ghost.nodes))
+    # and weights still conserve over the pruned tree
+    inv.check_ghost_weight_conservation(suite, ghost,
+                                        prefix="post-publish-")
+
+    weights_digest = hashlib.sha256(
+        b"".join(b"%d:%d;" % (s, ghost.nodes[s].weight)
+                 for s in sorted(ghost.nodes))
+    ).hexdigest()
+    info = {
+        "voters": n_voters,
+        "rounds": rounds,
+        "blocks": blocks,
+        "own_votes": len(own_votes),
+        "final_head": final_head,
+        "pruned": pruned,
+        "partition": part.describe(),
+        "weights_digest": weights_digest,
+    }
+    return ScenarioResult("fork-storm", seed, suite, info)
+
+
+# =============================================================================
+# leader-handoff
+# =============================================================================
+
+
+def run_leader_handoff(seed: int = 0, duration: float = 120.0, *,
+                       txns_per_slot: int = 32,
+                       dup_p: float = 0.04,
+                       reorder_p: float = 0.08) -> ScenarioResult:
+    """Two consecutive leader slots under load: slot 1 runs the full
+    pipeline, seals, and hands the bank off to slot 2 mid-traffic — with
+    a faulty shred->store link (duplicated + reordered wire shreds) in
+    BOTH slots.  The FEC/store path must absorb the faults, and each
+    slot's wire entries must golden-replay to its sealed bank hash with
+    the parent chain intact.
+
+    (duration is accepted for the uniform scenario signature; the slot
+    runs are bounded by txn count + max_iters, not the wall clock —
+    budget the XLA compile time in, see docs/OPERATIONS.md.)"""
+    from firedancer_tpu.flamenco.blockstore import StatusCache
+    from firedancer_tpu.models.leader import build_leader_pipeline
+    from firedancer_tpu.runtime.bank import BankCtx
+    from firedancer_tpu.runtime.benchg import (
+        gen_transfer_pool,
+        pool_blockhash,
+        pool_payers,
+    )
+
+    suite = inv.InvariantSuite()
+    seed_a = b"chaos-ho-a-%d" % seed
+    seed_b = b"chaos-ho-b-%d" % seed
+    pools = {1: gen_transfer_pool(txns_per_slot, seed=seed_a),
+             2: gen_transfer_pool(txns_per_slot, seed=seed_b)}
+
+    def fund_all(ctx) -> None:
+        for s in (seed_a, seed_b):
+            for _sec, pub in pool_payers(s):
+                ctx.fund(pub, 10**12)
+
+    def live_ctx(slot, funk=None, parent_hash=b"\x00" * 32,
+                 parent_xid=None, status_cache=None):
+        ctx = BankCtx(
+            funk, slot=slot, parent_bank_hash=parent_hash,
+            parent_xid=parent_xid,
+            status_cache=status_cache or StatusCache(),
+            blockhashes=(pool_blockhash(seed_a), pool_blockhash(seed_b)),
+        )
+        if funk is None:
+            fund_all(ctx)
+        return ctx
+
+    ctx1 = live_ctx(1)
+    seals = {}
+    batches = {}
+    reports = {}
+    shim_stats = {}
+    # recorders are plain local rings for cooperative stages: keeping
+    # the stage objects past pipe.close() preserves the flight evidence
+    # for the failure artifact
+    artifact_stages: dict = {}
+    ctx = ctx1
+    try:
+        for slot in (1, 2):
+            pipe = build_leader_pipeline(
+                n_verify=1, n_bank=2, pool_size=txns_per_slot,
+                gen_limit=txns_per_slot, batch=64, max_msg_len=256,
+                slot=slot, bank_ctx=ctx,
+            )
+            pipe.benchg.pool = pools[slot]
+            shims = cf.apply_link_faults(
+                pipe,
+                [cf.LinkFaults("store", 0, dup_p=dup_p,
+                               reorder_p=reorder_p)],
+                Rng(seed, 0x10FF + slot),
+            )
+            try:
+                pipe.run(until_txns=txns_per_slot, max_iters=400_000)
+                seals[slot] = pipe.seal()
+                batches[slot] = pipe.store.entry_batch_bytes(slot)
+                reports[slot] = pipe.report()
+                for k, sh in shims.items():
+                    shim_stats[f"slot{slot}:{k}"] = (sh.duplicated,
+                                                     sh.reordered)
+            finally:
+                for s in pipe.stages:
+                    artifact_stages[f"slot{slot}-{s.name}"] = s
+                pipe.close()
+            inv.check_pipeline_conservation(
+                suite, reports[slot], txns_per_slot, prefix=f"slot{slot}-")
+            if slot == 1:
+                # THE HANDOFF: slot 2 extends slot 1's unsealed fork —
+                # same funk, chained parent hash/xid, shared status cache
+                ctx = live_ctx(
+                    2, funk=ctx1.funk, parent_hash=seals[1].bank_hash,
+                    parent_xid=ctx1.sx.xid,
+                    status_cache=ctx1.status_cache,
+                )
+        # golden replay of BOTH slots on one fresh bank, chained: the
+        # wire entries alone must reproduce each sealed hash (and
+        # signature count), slot 2's parent being slot 1's REPLAYED
+        # (not live) result.  One replay ctx carries the funk across
+        # both slots — the same chaining check_bank_hash_golden's
+        # returned BlockResult exists for.
+        replay_ctx = live_ctx(1)
+        parent_hash, parent_xid = b"\x00" * 32, None
+        for slot in (1, 2):
+            res = inv.check_bank_hash_golden(
+                suite, entry_batch=batches[slot], seal=seals[slot],
+                slot=slot, make_fresh_ctx=lambda: replay_ctx,
+                parent_bank_hash=parent_hash, parent_xid=parent_xid,
+                prefix=f"slot{slot}-")
+            if res is None:
+                break
+            parent_hash, parent_xid = res.bank_hash, res.xid
+        suite.check("handoff-under-link-faults-absorbed",
+                    any(d or r for d, r in shim_stats.values()),
+                    "the lossy shim never fired — the fault was not "
+                    "exercised")
+        info = {
+            "txns_per_slot": txns_per_slot,
+            "bank_hash_slot1": seals[1].bank_hash.hex(),
+            "bank_hash_slot2": seals[2].bank_hash.hex(),
+            "shim_stats": {k: list(v) for k, v in sorted(
+                shim_stats.items())},
+        }
+    finally:
+        pass
+    result = ScenarioResult("leader-handoff", seed, suite, info)
+    if not suite.ok:
+        _capture_coop_failure(result, artifact_stages)
+    return result
+
+
+# =============================================================================
+# stage-kill
+# =============================================================================
+
+
+class ChaosGenStage(Stage):
+    def __init__(self, *args, limit=100_000, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.limit = limit
+        self._sent = 0
+
+    def after_credit(self) -> None:
+        for _ in range(max(1, self.burst)):
+            if self._sent >= self.limit:
+                return
+            if not self.publish(0, b"chaos" * 8, sig=self._sent):
+                return
+            self._sent += 1
+
+
+class ChaosRelayStage(Stage):
+    def after_frag(self, in_idx, meta, payload) -> None:
+        from firedancer_tpu.tango.rings import MCache
+
+        self.publish(0, payload, sig=int(meta[MCache.COL_SIG]),
+                     tsorig=int(meta[MCache.COL_TSORIG]))
+
+
+class ChaosSinkStage(Stage):
+    pass
+
+
+def _b_gen(links, cnc, *, limit):
+    return ChaosGenStage("gen", outs=[shm.Producer(links["gr"])], cnc=cnc,
+                         limit=limit)
+
+
+def _b_relay(links, cnc):
+    return ChaosRelayStage("relay", ins=[shm.Consumer(links["gr"], lazy=8)],
+                           outs=[shm.Producer(links["rs"])], cnc=cnc)
+
+
+def _b_sink(links, cnc):
+    return ChaosSinkStage("sink", ins=[shm.Consumer(links["rs"], lazy=8)],
+                          cnc=cnc)
+
+
+def _kill_topology(limit: int):
+    from firedancer_tpu.runtime import topo as ft
+
+    topo = ft.Topology()
+    topo.link("gr", depth=256, mtu=64)
+    topo.link("rs", depth=256, mtu=64)
+    topo.stage("gen", _b_gen, limit=limit, outs=["gr"])
+    topo.stage("relay", _b_relay, ins=["gr"], outs=["rs"])
+    topo.stage("sink", _b_sink, ins=["rs"])
+    return topo
+
+
+def _wait_registry(handle, stage: str, counter: str, target: int,
+                   timeout_s: float = 30.0) -> bool:
+    deadline = time.monotonic() + timeout_s
+    reg = handle.met_views[stage][0]
+    while time.monotonic() < deadline:
+        if reg.get(counter) >= target:
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def run_stage_kill(seed: int = 0, duration: float = 30.0, *,
+                   warm_frags: int = 64) -> ScenarioResult:
+    """SIGKILL one stage of a live process topology mid-run: the
+    supervisor must fail FAST naming the victim, the flight-recorder
+    dump must land on disk as the failure artifact, close() must reclaim
+    every shm segment, and a fresh launch of the same topology must run
+    clean (the restart half of crash containment).  Conservation is
+    checked from the PR-5 shm metric registries at a quiescent point
+    before the kill."""
+    from firedancer_tpu.runtime import topo as ft
+
+    suite = inv.InvariantSuite()
+    info: dict = {}
+    artifacts: list = []
+    h = ft.launch(_kill_topology(limit=warm_frags))
+    names = h.shm_names()
+    try:
+        # quiesce BOTH ends of the hop before reconciling: registry
+        # values are housekeeping-flushed, so sink can show its final
+        # count one lazy interval before relay does
+        warmed = _wait_registry(h, "sink", "frags_in", warm_frags,
+                                timeout_s=min(duration, 30.0)) \
+            and _wait_registry(h, "relay", "frags_out", warm_frags,
+                               timeout_s=10.0)
+        suite.check("pipeline-warmed", warmed,
+                    f"sink never reached {warm_frags} frags")
+        inv.check_heartbeats_fresh(suite, h)
+        if warmed:
+            inv.check_registry_conservation(suite, h, producer="relay",
+                                            consumer="sink")
+        injector = cf.FaultInjector(
+            [cf.KillStage("relay", at_s=0.05)]).arm()
+        ok = h.supervise(until=lambda hh: False,
+                         timeout_s=min(duration, 30.0),
+                         heartbeat_timeout_s=10.0, on_poll=injector)
+        suite.check("fault-schedule-fired", injector.all_fired())
+        suite.check("supervisor-fails-fast", ok is False,
+                    "supervise returned success past a dead stage")
+        suite.check("victim-identified", h.failed == "relay",
+                    f"failed={h.failed!r}")
+        dump_ok = bool(h.flight_dump_path
+                       and os.path.exists(h.flight_dump_path))
+        suite.check("flight-dump-written", dump_ok,
+                    f"path={h.flight_dump_path!r}")
+        if dump_ok:
+            with open(h.flight_dump_path) as f:
+                dump = json.load(f)
+            suite.check("dump-names-victim", dump.get("failed") == "relay")
+            suite.check("dump-carries-all-stage-rings",
+                        set(dump.get("stages", {}))
+                        == {"gen", "relay", "sink"})
+            _capture_trace_from_dump(
+                ScenarioResult("stage-kill", seed, suite, info, artifacts),
+                h.flight_dump_path)
+    finally:
+        h.close()
+    inv.check_shm_reclaimed(suite, names)
+    # restart: the same topology comes back clean after the crash
+    h2 = ft.launch(_kill_topology(limit=warm_frags))
+    names2 = h2.shm_names()
+    try:
+        restarted = _wait_registry(h2, "sink", "frags_in", warm_frags,
+                                   timeout_s=min(duration, 30.0))
+        suite.check("restart-runs-clean", restarted,
+                    "restarted topology never drained")
+        h2.halt()
+    finally:
+        h2.close()
+    inv.check_shm_reclaimed(suite, names2, prefix="restart-")
+    info.update({"victim": "relay", "warm_frags": warm_frags,
+                 "faults": ["kill:relay@0.05s"]})
+    return ScenarioResult("stage-kill", seed, suite, info, artifacts)
+
+
+# =============================================================================
+# registry + runner
+# =============================================================================
+
+SCENARIOS = {
+    "connection-storm": run_connection_storm,
+    "dedup-flood": run_dedup_flood,
+    "fork-storm": run_fork_storm,
+    "leader-handoff": run_leader_handoff,
+    "stage-kill": run_stage_kill,
+}
+
+
+def run_scenario(name: str, *, seed: int = 0, duration: float | None = None,
+                 **kw) -> ScenarioResult:
+    fn = SCENARIOS.get(name)
+    if fn is None:
+        raise KeyError(
+            f"unknown scenario {name!r}; have {sorted(SCENARIOS)}")
+    if duration is not None:
+        kw["duration"] = duration
+    result = fn(seed=seed, **kw)
+    path = _artifact_base(name, seed) + ".json"
+    with open(path, "w") as f:
+        f.write(result.to_json() + "\n")
+    result.artifacts.insert(0, path)
+    return result
+
+
+def main(args) -> int:
+    """`python -m firedancer_tpu chaos {run <scenario>|list} ...`."""
+    import sys
+
+    if args.action == "list":
+        for name in sorted(SCENARIOS):
+            doc = (SCENARIOS[name].__doc__ or "").strip().split("\n")[0]
+            print(f"{name:<18} {doc}")
+        return 0
+    if not args.scenario:
+        print("chaos run: scenario name required "
+              f"(have {', '.join(sorted(SCENARIOS))})", file=sys.stderr)
+        return 2
+    if args.scenario not in SCENARIOS:
+        # validated HERE, not by catching KeyError around the run — a
+        # KeyError raised INSIDE a scenario is a harness bug and must
+        # surface with its traceback, not masquerade as a CLI typo
+        print(f"chaos: unknown scenario {args.scenario!r}; have "
+              f"{', '.join(sorted(SCENARIOS))}", file=sys.stderr)
+        return 2
+    kw = {}
+    if args.clients is not None:
+        if args.scenario != "connection-storm":
+            print("chaos: --clients only applies to connection-storm",
+                  file=sys.stderr)
+            return 2
+        kw["n_clients"] = args.clients
+    result = run_scenario(args.scenario, seed=args.seed,
+                          duration=args.duration, **kw)
+    # stdout carries ONLY the deterministic summary (the replay/diff
+    # surface); context and artifact paths go to stderr
+    print(result.to_json())
+    print(result.suite.describe(), file=sys.stderr)
+    for a in result.artifacts:
+        print(f"# artifact: {a}", file=sys.stderr)
+    return 0 if result.ok else 1

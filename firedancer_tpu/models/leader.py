@@ -107,6 +107,19 @@ class LeaderPipeline:
         return self.bank_ctx.seal(self.poh.last_entry_hash)
 
     def close(self):
+        # Drop every stage's Producer/Consumer link views FIRST: a
+        # lingering Fseq/mcache numpy view pins the mmap, close() then
+        # fails with BufferError, and at interpreter exit every
+        # SharedMemory.__del__ retries and spews 'cannot close exported
+        # pointers exist' into whatever artifact tail captured stderr
+        # (the BENCH_r03-05 pollution).  Ordering is the fix: views die,
+        # THEN the mappings close, THEN the names unlink.
+        for s in self.stages:
+            s.ins = []
+            s.outs = []
+        import gc
+
+        gc.collect()
         for link in self.links:
             link.close()
             link.unlink()

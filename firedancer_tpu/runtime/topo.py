@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import multiprocessing as mp
 import os
+import signal as _signal
 import time
 from dataclasses import dataclass, field
 from multiprocessing import shared_memory
@@ -151,6 +152,24 @@ def _spec_schema(spec: StageSpec) -> fm.MetricsSchema:
     return Stage.metrics_schema()
 
 
+def _quiet_shm_close(s: shared_memory.SharedMemory) -> None:
+    """Close a segment; if exported views still pin the mapping, detach
+    the fd/mmap from the wrapper so interpreter-exit __del__ cannot spew
+    'cannot close exported pointers exist' into the parent's stderr
+    (refcounting frees the mapping when the last view dies)."""
+    try:
+        s.close()
+    except BufferError:
+        try:
+            if getattr(s, "_fd", -1) >= 0:
+                os.close(s._fd)
+                s._fd = -1
+            s._mmap = None
+            s._buf = None
+        except OSError:
+            pass
+
+
 def _stage_main(spec: StageSpec, link_names: dict, uid: str) -> None:
     """Child entry: join links + cnc + metrics segment, build the stage,
     run until HALT.  On any raise the flight ring gets an EV_FAIL record
@@ -192,6 +211,23 @@ def _stage_main(spec: StageSpec, link_names: dict, uid: str) -> None:
             stage.metrics.flush()  # last state, for the post-mortem dump
         cnc.signal = CNC_SIG_FAIL
         raise
+    finally:
+        # clean-exit hygiene: drop the stage's views and close the
+        # joined segments quietly, or every HALTing child sprays
+        # BufferError __del__ noise onto the shared stderr (the
+        # BENCH-tail pollution's process-topology sibling).  Crash paths
+        # already flushed their evidence above; the supervisor owns the
+        # segments, so closing here never unlinks anything.
+        stage = None
+        registry = recorder = None
+        cnc.cells = np.zeros(2 + Cnc.NDIAG, dtype=rings.U64)
+        import gc
+
+        gc.collect()
+        for _lnk in links.values():
+            _lnk.close()
+        _quiet_shm_close(cnc_shm)
+        _quiet_shm_close(met_shm)
 
 
 class TopologyHandle:
@@ -218,12 +254,21 @@ class TopologyHandle:
         timeout_s: float = 30.0,
         heartbeat_timeout_s: float = 5.0,
         poll_s: float = 0.02,
+        on_poll=None,
     ) -> bool:
         """Watchdog loop (run.c:252-330): returns True when `until()` says
         done; kills the whole topology and returns False if any stage dies,
-        signals FAIL, or stops heartbeating."""
+        signals FAIL, or stops heartbeating.
+
+        on_poll(handle): called once per watchdog iteration BEFORE the
+        liveness checks — the fault-injection hook (chaos/faults.py
+        schedules stage kills/freezes through it), also usable for live
+        sampling.  It runs in the supervisor, so anything it does to the
+        brood is judged by the same checks as a real failure."""
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:
+            if on_poll is not None:
+                on_poll(self)
             if until is not None and until(self):
                 return True
             now = time.monotonic_ns()
@@ -270,9 +315,43 @@ class TopologyHandle:
     def kill(self) -> None:
         for p in self.procs.values():
             if p.is_alive():
+                # a SIGSTOPped child ignores SIGTERM until continued —
+                # thaw first so terminate() cannot hang the join below
+                try:
+                    os.kill(p.pid, _signal.SIGCONT)
+                except (OSError, TypeError):
+                    pass
                 p.terminate()
         for p in self.procs.values():
             p.join(timeout=5)
+
+    # -- fault injection (the chaos harness's supervisor surface) ------------
+
+    def kill_stage(self, name: str, sig: int | None = None) -> None:
+        """Deliver `sig` (default SIGKILL) to ONE stage process and leave
+        the verdict to the supervisor loop — the stage-kill fault: the
+        watchdog must notice, dump the flight rings, and fail fast."""
+        p = self.procs[name]
+        if p.pid is not None and p.is_alive():
+            os.kill(p.pid, sig if sig is not None else _signal.SIGKILL)
+
+    def freeze_stage(self, name: str) -> None:
+        """SIGSTOP one stage: the process stays alive but its heartbeat
+        goes stale — the wedged-stage fault (cnc heartbeat contract)."""
+        self.kill_stage(name, _signal.SIGSTOP)
+
+    def thaw_stage(self, name: str) -> None:
+        self.kill_stage(name, _signal.SIGCONT)
+
+    def shm_names(self) -> list[str]:
+        """Every shared-memory segment name this topology owns (links +
+        cnc + metrics) — the chaos leak check scans /dev/shm for them
+        after close()."""
+        out = [f"fdtpu_{spec.name}_{self.uid}" for spec in self.topo.links]
+        for spec in self.topo.stages:
+            out.append(_cnc_shm_name(self.uid, spec.name))
+            out.append(_met_shm_name(self.uid, spec.name))
+        return out
 
     def dump_flight(self, reason: str = "") -> str | None:
         """Write the crash dump — every stage's flight ring + a final
@@ -309,16 +388,26 @@ class TopologyHandle:
                 link.unlink()
             except FileNotFoundError:
                 pass
-        # numpy views into the metric segments must drop before close
+        # numpy views into the metric and cnc segments must drop before
+        # close — a pinned view turns close() into a BufferError and the
+        # interpreter-exit SharedMemory.__del__ into stderr noise
         self.met_views = {}
+        for cnc in self.cncs.values():
+            cnc.cells = np.zeros(2 + Cnc.NDIAG, dtype=rings.U64)
         import gc
 
         gc.collect()
+        # close and unlink SEPARATELY: a close() refused by a straggling
+        # exported view (a caller that kept a met_views registry) must
+        # never skip the unlink, or the /dev/shm entry leaks past the
+        # topology's lifetime — the chaos harness's reclaim invariant
+        # scans for exactly that.  _quiet_shm_close also detaches the
+        # refused wrapper so interpreter-exit __del__ stays silent.
         for s in list(self._cnc_shms.values()) + list(self._met_shms.values()):
+            _quiet_shm_close(s)
             try:
-                s.close()
                 s.unlink()
-            except (BufferError, FileNotFoundError):
+            except FileNotFoundError:
                 pass
 
     # -- monitor ------------------------------------------------------------

@@ -15,6 +15,7 @@ Layout (8-byte aligned):
 
 from __future__ import annotations
 
+import os
 import time
 from multiprocessing import shared_memory
 
@@ -114,7 +115,22 @@ class ShmLink:
         try:
             self._shm.close()
         except BufferError:
-            pass
+            # An external attacher still holds a view, so the mapping
+            # must outlive this call anyway — hand it to refcounting:
+            # detach the fd and the mmap from the SharedMemory wrapper
+            # so its __del__ at GC/interpreter-exit cannot re-raise the
+            # noisy 'cannot close exported pointers exist' (the
+            # BENCH-artifact-tail pollution; same resource-discipline
+            # fix runtime/monitor applies to its read-only attachers).
+            # The mmap object frees itself when the last view dies.
+            try:
+                if getattr(self._shm, "_fd", -1) >= 0:
+                    os.close(self._shm._fd)
+                    self._shm._fd = -1
+                self._shm._mmap = None
+                self._shm._buf = None
+            except OSError:  # fd already gone: nothing left to detach
+                pass
 
     def unlink(self) -> None:
         self._shm.unlink()

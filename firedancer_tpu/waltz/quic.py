@@ -81,10 +81,20 @@ RETRY_NONCE_V1 = bytes.fromhex("461599d35d632bf2239825bb")
 MAX_DATAGRAM = 1452
 MAX_FRAMES_PAYLOAD = 1200  # per-packet payload budget when packing frames
 
-# loss recovery (RFC 9002-shaped, fixed-timer profile)
+# loss recovery (RFC 9002-shaped): packet-threshold + time-threshold
+# loss declaration, RTT-adaptive PTO (srtt + 4*rttvar) with exponential
+# backoff.  PTO_INITIAL_S is only the pre-first-sample value (kInitialRtt
+# territory); once acks flow the timer tracks the measured path.
 ACK_REORDER_THRESH = 3
 PTO_INITIAL_S = 0.2
-PTO_BACKOFF_CAP = 5  # doubling cap: 0.2 * 2^5 = 6.4 s
+PTO_BACKOFF_CAP = 5  # doubling cap: base * 2^5
+# timer floor (kGranularity, scaled up for a Python engine: a 1 ms floor
+# would let a same-host srtt≈0 path fire PTO storms between event-loop
+# iterations)
+PTO_GRANULARITY_S = 0.01
+# time-threshold loss: outstanding packets older than 9/8 * rtt behind
+# the largest acked are lost without waiting for the full PTO (§6.1.2)
+TIME_THRESHOLD = 9 / 8
 
 # flow control windows (our receive side / assumed peer until updated)
 DEFAULT_MAX_DATA = 1 << 20
@@ -703,6 +713,12 @@ class SentPacket:
     pn: int
     time_sent: float
     frames: list  # ('crypto', off, bytes) | ('stream', sid, off, bytes, fin)
+    # ack-eliciting bookkeeping (§2, §6.2.1): only ack-eliciting packets
+    # arm the PTO timer and take RTT samples.  Pure-ACK packets are never
+    # tracked at all (flush records nothing for them), so every tracked
+    # packet is ack-eliciting today — the flag keeps the contract
+    # explicit for future non-eliciting tracked kinds.
+    ack_eliciting: bool = True
 
 
 # -- connection ---------------------------------------------------------------
@@ -761,6 +777,16 @@ class Connection:
         self.stream_rtx: list[tuple[int, int, bytes, bool]] = []
         self.raw_rtx: list[bytes] = []  # lost ctrl frames (MAX_DATA...)
         self.pto_count = 0
+        # RTT estimator (RFC 9002 §5): EWMA smoothed rtt + variance from
+        # ack samples of newly-acked ack-eliciting packets.  None until
+        # the first sample — poll_timers falls back to PTO_INITIAL_S.
+        self.srtt: float | None = None
+        self.rttvar: float = 0.0
+        self.min_rtt: float | None = None
+        self.latest_rtt: float | None = None
+        # per-level send time of the LAST ack-eliciting packet: the PTO
+        # timer re-arms from it (§6.2.1 — not from the oldest packet)
+        self.last_ae_time = {lvl: None for lvl in lvls}
         self.stream_rx: dict[int, _OrderedStream] = {}
         self.send_offset: dict[int, int] = {}
         self.app_out: list[tuple] = []  # retransmittable stream tuples
@@ -980,16 +1006,58 @@ class Connection:
             pn for pn in sent
             if any(lo <= pn <= hi for lo, hi in ranges)
         ]
+        largest_acked = max(hi for _lo, hi in ranges)
+        # RTT sample (§5.1): only when the LARGEST acked pn is newly
+        # acked and ack-eliciting — a stale range re-ack carries no
+        # timing signal
+        if largest_acked in sent and sent[largest_acked].ack_eliciting:
+            sample = now - sent[largest_acked].time_sent
+            if sample >= 0:
+                self._rtt_update(sample)
         for pn in newly:
             del sent[pn]
         if newly:
             self.pto_count = 0
-        largest_acked = max(hi for _lo, hi in ranges)
         # packet-threshold loss: anything ACK_REORDER_THRESH below the
-        # largest acked that is still outstanding is lost
+        # largest acked that is still outstanding is lost; the TIME
+        # threshold (§6.1.2) additionally catches small-gap losses a
+        # packet count can never reach (e.g. the last packet of a burst)
+        loss_delay = None
+        rtt = self.latest_rtt if self.srtt is None else max(
+            self.srtt, self.latest_rtt or 0.0
+        )
+        if rtt is not None:
+            loss_delay = max(TIME_THRESHOLD * rtt, PTO_GRANULARITY_S)
         for pn in sorted(sent):
-            if pn <= largest_acked - ACK_REORDER_THRESH:
+            if pn >= largest_acked:
+                break
+            if pn <= largest_acked - ACK_REORDER_THRESH or (
+                loss_delay is not None
+                and now - sent[pn].time_sent >= loss_delay
+            ):
                 self._queue_rtx(level, sent.pop(pn))
+
+    def _rtt_update(self, sample: float) -> None:
+        self.latest_rtt = sample
+        if self.min_rtt is None or sample < self.min_rtt:
+            self.min_rtt = sample
+        if self.srtt is None:  # first sample (§5.3)
+            self.srtt = sample
+            self.rttvar = sample / 2
+        else:
+            self.rttvar = 0.75 * self.rttvar + 0.25 * abs(self.srtt - sample)
+            self.srtt = 0.875 * self.srtt + 0.125 * sample
+
+    def pto_interval(self) -> float:
+        """The current probe timeout: srtt + max(4*rttvar, granularity)
+        once the path is measured, PTO_INITIAL_S before the first RTT
+        sample; doubled per consecutive PTO (capped)."""
+        if self.srtt is None:
+            base = PTO_INITIAL_S
+        else:
+            base = self.srtt + max(4 * self.rttvar, PTO_GRANULARITY_S)
+            base = max(base, PTO_GRANULARITY_S)
+        return base * (2 ** min(self.pto_count, PTO_BACKOFF_CAP))
 
     def _queue_rtx(self, level: int, pkt: SentPacket) -> None:
         for fr in pkt.frames:
@@ -1004,17 +1072,21 @@ class Connection:
                 self.raw_rtx.append(fr[1])
 
     def poll_timers(self, now: float | None = None) -> None:
-        """PTO: when the oldest outstanding packet of a level has waited
-        a full timeout with no ack, re-queue everything outstanding at
-        that level (the next flush retransmits) and back off."""
+        """PTO (§6.2): when a level's last ack-eliciting packet has
+        waited a full probe timeout with no ack, re-queue everything
+        outstanding at that level (the next flush retransmits) and back
+        off.  The timeout adapts to the measured RTT (pto_interval);
+        levels with only non-eliciting state never arm the timer."""
         now = _time.monotonic() if now is None else now
-        pto = PTO_INITIAL_S * (2 ** min(self.pto_count, PTO_BACKOFF_CAP))
+        pto = self.pto_interval()
         fired = False
         for lvl, sent in self.sent.items():
-            if not sent:
+            if not any(p.ack_eliciting for p in sent.values()):
                 continue
-            oldest = min(p.time_sent for p in sent.values())
-            if now - oldest >= pto:
+            last_ae = self.last_ae_time[lvl]
+            if last_ae is None:  # pre-tracking state: fall back to oldest
+                last_ae = min(p.time_sent for p in sent.values())
+            if now - last_ae >= pto:
                 for pn in sorted(sent):
                     self._queue_rtx(lvl, sent.pop(pn))
                 fired = True
@@ -1158,6 +1230,7 @@ class Connection:
                 ))
                 if record:
                     self.sent[lvl][pn] = SentPacket(pn, now, record)
+                    self.last_ae_time[lvl] = now  # re-arm the PTO timer
         return out
 
     def probe_datagram(self, frames: bytes) -> bytes | None:

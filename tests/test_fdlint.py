@@ -336,6 +336,60 @@ def test_fd200_unparseable_file():
     assert [f.rule for f in findings] == ["FD200"]
 
 
+def test_fd209_unseeded_randomness_scoped_to_chaos():
+    """ISSUE 7 satellite: every entropy source inside chaos/ must thread
+    the run seed through utils/rng — os.urandom, secrets.*, uuid4, and
+    unseeded generator constructions are flagged there, and ONLY there
+    (net.py et al legitimately use os.urandom for protocol CIDs)."""
+    src = '''
+import os
+import secrets
+import random
+import uuid
+import numpy as np
+
+cid = os.urandom(8)
+tok = secrets.token_bytes(16)
+pick = secrets.choice(options)
+uid = uuid.uuid4()
+r1 = random.Random()
+r2 = np.random.default_rng()
+'''
+    findings = ast_rules.lint_source(
+        src, "firedancer_tpu/chaos/population.py")
+    assert [f.rule for f in findings] == ["FD209"] * 6
+    # seeded constructions pass — including METHODS on seeded instances
+    # (the rule's own prescribed fix must not trip the rule)
+    ok = '''
+import random
+import numpy as np
+from firedancer_tpu.utils.rng import Rng
+
+rng = Rng(seed, 7)
+r1 = random.Random(seed)
+bits = r1.getrandbits(64)
+pick = r1.choice(options)
+r2 = np.random.default_rng(seed)
+'''
+    assert ast_rules.lint_source(
+        ok, "firedancer_tpu/chaos/scenario.py") == []
+    # identical entropy OUTSIDE chaos/ is not FD209's business
+    assert ast_rules.lint_source(src, "firedancer_tpu/runtime/net.py") == []
+    # the process-global random module in chaos/ is FD203's catch (the
+    # division of labor _check_chaos_entropy documents): still an error
+    glob = "import random\npick = random.choice([1, 2])\n"
+    assert [f.rule for f in ast_rules.lint_source(
+        glob, "firedancer_tpu/chaos/scenario.py")] == ["FD203"]
+
+
+def test_fd209_listed_and_chaos_package_clean():
+    from firedancer_tpu.analysis.framework import all_rules
+
+    assert "FD209" in {r.id for r in all_rules()}
+    findings = ast_rules.lint_path(os.path.join(PKG, "chaos"))
+    assert [f for f in findings if f.rule == "FD209"] == []
+
+
 def test_inline_disable_suppresses_named_rule_only():
     src = ("class S:\n"
            "    def after_frag(self, i, m, p):\n"

@@ -125,6 +125,112 @@ def test_pto_retransmits_without_acks():
     assert client.flush(0.7)
 
 
+def test_rtt_estimator_feeds_adaptive_pto():
+    """ISSUE 7 satellite: once acks flow, the PTO tracks the measured
+    path (srtt + 4*rttvar) instead of the fixed 0.2 s profile."""
+    p = LossyPair(0)
+    p.run_until(lambda: p.client.established and p.server.established)
+    # the manual clock ticks 0.25s per exchange (the client's acks land
+    # same-tick, so its samples are 0.0 and the granularity floor rules):
+    # both endpoints measured the path and run the adaptive interval
+    for side in (p.client, p.server):
+        assert side.srtt is not None
+        assert side.min_rtt is not None and side.min_rtt <= side.srtt
+        # adaptive interval: srtt + max(4*rttvar, granularity), no backoff
+        assert side.pto_count == 0
+        expect = max(
+            side.srtt + max(4 * side.rttvar, quic.PTO_GRANULARITY_S),
+            quic.PTO_GRANULARITY_S,
+        )
+        assert side.pto_interval() == pytest.approx(expect)
+    assert p.server.srtt > 0  # the cross-tick direction took real samples
+    p.tick(); p.tick()  # drain pending acks so flush below is app-only
+    # a black-holed flight now retransmits on the ADAPTIVE schedule
+    c = p.client
+    c.send_stream(2, b"adaptive-pto", fin=True)
+    assert c.flush(p.now)  # into the void (server never ticks)
+    pto = c.pto_interval()
+    c.poll_timers(p.now + pto * 0.5)
+    assert c.flush(p.now + pto * 0.5) == []  # before the timer: silence
+    c.poll_timers(p.now + pto + 1e-6)
+    assert c.flush(p.now + pto + 1e-6), "adaptive PTO must retransmit"
+    assert c.pto_count == 1  # and back off
+
+
+def test_pto_before_first_sample_uses_initial():
+    c = quic.Connection.client_new()
+    assert c.srtt is None
+    assert c.pto_interval() == quic.PTO_INITIAL_S
+
+
+def test_ack_only_packets_never_arm_pto():
+    """Pure-ACK packets are not ack-eliciting: they are never tracked,
+    so an endpoint with only ACKs in flight must not retransmit them on
+    a timer (an ACK loop would never converge)."""
+    p = LossyPair(0)
+    p.run_until(lambda: p.client.established and p.server.established)
+    p.tick(); p.tick()  # drain pending acks both ways
+    # hand the server a PING so it owes exactly one ACK
+    keys = p.client.keys_tx[quic.APPLICATION]
+    pn = p.client.pn_next[quic.APPLICATION]
+    p.client.pn_next[quic.APPLICATION] += 1
+    pkt = quic.seal_packet(
+        keys, level=quic.APPLICATION, dcid=p.server.local_cid,
+        scid=p.client.local_cid, pn=pn,
+        payload=bytes([quic.FT_PING]) + bytes(3),
+    )
+    p.server.receive(pkt, now=p.now)
+    before = dict(p.server.sent[quic.APPLICATION])
+    assert p.server.flush(p.now)  # the ACK-only packet goes out
+    # nothing new tracked -> a later PTO poll re-queues nothing
+    assert p.server.sent[quic.APPLICATION] == before
+    p.server.poll_timers(p.now + 100.0)
+    assert p.server.flush(p.now + 100.0) == []
+
+
+def test_time_threshold_loss_beats_full_pto():
+    """A small-gap loss (behind the largest acked by < the packet
+    threshold) is declared lost once it ages past 9/8 * rtt — without
+    waiting for the much longer PTO (§6.1.2)."""
+    p = LossyPair(0)
+    p.run_until(lambda: p.client.established and p.server.established)
+    p.tick(); p.tick()  # drain handshake-tail acks both ways
+    c = p.client
+    t0 = p.now
+    # two separate flushes -> two distinct app packets A (lost) and B
+    c.send_stream(2, b"packet-A", fin=True)
+    dgs_a = c.flush(t0)
+    assert len(dgs_a) == 1
+    c.send_stream(6, b"packet-B", fin=True)
+    dgs_b = c.flush(t0)
+    assert len(dgs_b) == 1
+    pn_a, pn_b = sorted(c.sent[quic.APPLICATION])
+    # B arrives, A vanished; the server acks B promptly
+    evs = p.server.receive(dgs_b[0], now=t0 + 0.25)
+    p.server.receive_stream_events(evs)
+    ack1 = p.server.flush(t0 + 0.25)
+    for dg in ack1:
+        c.receive(dg, now=t0 + 0.25)
+    assert pn_a in c.sent[quic.APPLICATION]  # gap of 1 < packet threshold
+    rtt = c.latest_rtt
+    assert rtt is not None
+    # a later ack (PING-elicited) re-covering B arrives after A aged past
+    # the time threshold: A is declared lost on THAT ack, not at full PTO
+    later = t0 + max(9 / 8 * max(c.srtt, rtt), quic.PTO_GRANULARITY_S) + 0.01
+    assert later - t0 < c.pto_interval() + 0.25  # the point of the test
+    wire = quic.ack_frame([(pn_b, pn_b)])
+    keys = p.server.keys_tx[quic.APPLICATION]
+    pkt = quic.seal_packet(
+        keys, level=quic.APPLICATION, dcid=c.local_cid,
+        scid=p.server.local_cid, pn=p.server.pn_next[quic.APPLICATION],
+        payload=wire,
+    )
+    p.server.pn_next[quic.APPLICATION] += 1
+    c.receive(pkt, now=later)
+    assert pn_a not in c.sent[quic.APPLICATION], "time-threshold missed"
+    assert c.stream_rtx, "lost stream data must be queued for rtx"
+
+
 def test_ping_only_packet_gets_acked():
     """PING is ack-eliciting: a PTO probe must draw an ACK or the peer
     backs off into an idle timeout (review finding r4)."""
