@@ -20,6 +20,9 @@ main1.c: run / monitor / keys / configure / version, and fddev's bench):
     keys       new <path> | pubkey <path> — identity keypair management
     bench      quick pipeline throughput measurement (bench.py has the
                full headline benchmark)
+    warmup     AOT-compile the sharded serving step for a mesh shape
+               through the persistent serve cache (leader boot-time
+               obligation; `bench.py --multichip-serve` is the ladder)
     genesis    create | show a genesis blob (+ faucet key)
     snapshot   inspect a snapshot archive
     ledger     show | ingest | replay a stored ledger (bank-hash checks)
@@ -40,7 +43,7 @@ import os
 import sys
 import time
 
-__version__ = "0.6.0"  # round 6: chaos scenario harness
+__version__ = "0.7.0"  # round 7: sharded serving plane
 
 
 def _load_cfg(args):
@@ -162,6 +165,46 @@ def cmd_bench(args) -> int:
 
     out = bench_mod.run_pipeline_bench(jax.devices()[0].platform)
     print(json.dumps(out))
+    return 0
+
+
+def cmd_warmup(args) -> int:
+    """AOT-compile the sharded serving step for a mesh shape, through the
+    repo-local persistent serve cache (utils/platform.enable_serve_cache):
+    the leader's boot-time obligation, run BEFORE a slot, so traffic never
+    waits on XLA.  Second runs load from cache in seconds — pass
+    --assert-warm S to fail (exit 2) when the compile/load took longer,
+    which is how CI proves the cache-hit path works."""
+    from firedancer_tpu.utils.platform import (
+        enable_serve_cache,
+        force_cpu_backend,
+    )
+
+    if not args.real:
+        force_cpu_backend(device_count=max(args.devices, 8))
+    cache_dir = enable_serve_cache()
+    from firedancer_tpu.parallel.serve import ServeConfig, ServePlane
+
+    cfg = ServeConfig(
+        n_devices=args.devices,
+        batch_per_shard=args.batch_per_shard,
+        max_msg_len=args.max_msg_len,
+        poh_iters=args.poh_iters,
+    )
+    plane = ServePlane(cfg)
+    compile_s = plane.warmup()
+    print(json.dumps({
+        "serve_step": cfg.cache_key(),
+        "devices": args.devices,
+        "batch": cfg.batch,
+        "compile_s": round(compile_s, 2),
+        "cache_dir": cache_dir,
+    }))
+    if args.assert_warm is not None and compile_s > args.assert_warm:
+        print(f"warmup: compile/load took {compile_s:.1f}s "
+              f"> --assert-warm {args.assert_warm}s (cache miss?)",
+              file=sys.stderr)
+        return 2
     return 0
 
 
@@ -298,7 +341,8 @@ def cmd_metrics(args) -> int:
             return 0
         from firedancer_tpu.utils.metrics import MetricsServer
 
-        srv = MetricsServer(ses.registries(), port=args.serve)
+        srv = MetricsServer(ses.registries(), port=args.serve,
+                            labels=ses.shard_labels())
         try:
             host, port = srv.addr
             print(f"# serving /metrics on http://{host}:{port}/ (^C exits)",
@@ -402,6 +446,21 @@ def main(argv=None) -> int:
     benchp = sub.add_parser("bench", help="pipeline throughput bench")
     benchp.add_argument("--cpu", action="store_true")
 
+    wup = sub.add_parser(
+        "warmup",
+        help="AOT-compile the sharded serving step (persistent cache)",
+    )
+    wup.add_argument("--devices", type=int, default=8,
+                     help="mesh size (devices) to compile for")
+    wup.add_argument("--batch-per-shard", type=int, default=32)
+    wup.add_argument("--max-msg-len", type=int, default=256)
+    wup.add_argument("--poh-iters", type=int, default=64)
+    wup.add_argument("--real", action="store_true",
+                     help="use real devices (default: forced CPU mesh)")
+    wup.add_argument("--assert-warm", type=float, default=None, metavar="S",
+                     help="exit 2 unless compile/load finished within S "
+                          "seconds (the CI cache-hit proof)")
+
     cfgp = sub.add_parser("config", help="print effective configuration")
     cfgp.add_argument("--config", default=None)
 
@@ -500,6 +559,8 @@ def main(argv=None) -> int:
         return cmd_keys(args)
     if args.cmd == "bench":
         return cmd_bench(args)
+    if args.cmd == "warmup":
+        return cmd_warmup(args)
     if args.cmd == "config":
         return cmd_config(args)
     if args.cmd == "genesis":

@@ -204,7 +204,11 @@ class _Linter(ast.NodeVisitor):
         self._nmods = nmods or set()  # FD207: native-module aliases
         self._nfuncs = nfuncs or set()  # FD207: native from-imports
         # FD209 scope: files under a chaos/ package directory
-        self._chaos = "chaos" in re.split(r"[/\\]", path)
+        parts = re.split(r"[/\\]", path)
+        self._chaos = "chaos" in parts
+        # FD210 scope: the packages whose frag callbacks feed (or are) the
+        # sharded serving plane
+        self._serve_scope = "runtime" in parts or "parallel" in parts
 
     def _resolve(self, node: ast.Call) -> tuple[str, str] | None:
         """Canonical (module, func) for a call, seeing through `import
@@ -325,6 +329,24 @@ class _Linter(ast.NodeVisitor):
             self.hit("FD201", node,
                      "float(x) on a non-constant in a frag callback: if x"
                      " is a device scalar this is a blocking sync")
+        # FD210: host->device transfers per frag (runtime/ + parallel/).
+        # The device->host direction (np.asarray, device_get, .item,
+        # block_until_ready) is FD201 above; this closes the other half:
+        # a device_put per frag re-commits (and on a mesh re-shards) one
+        # element at a time, serializing the plane behind the host.
+        if self._serve_scope:
+            if (mf == ("jax", "device_put")) or (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "copy_to_host_async"
+            ):
+                what = (
+                    "jax.device_put" if mf == ("jax", "device_put")
+                    else ".copy_to_host_async()"
+                )
+                self.hit("FD210", node,
+                         f"{what} in a frag callback: commit device arrays"
+                         " at batch-close granularity (the serving plane's"
+                         " place_verify path), never per frag")
         if mf and mf[0] == "time" and mf[1] in _CLOCK_CALLS:
             self.hit("FD202", node,
                      f"time.{mf[1]}() in a frag callback; stamp deadlines"

@@ -166,3 +166,11 @@ FD209 = _rule(
     " every scenario must thread the run seed through utils/rng —"
     " reproducible replay is the harness's core contract",
 )
+FD210 = _rule(
+    "FD210", "transfer-in-frag", SEV_ERROR,
+    "host<->device transfer (jax.device_put / .copy_to_host_async) inside a"
+    " frag callback in runtime/ or parallel/: on a sharded serving plane a"
+    " per-frag transfer serializes the mesh behind the host — commit arrays"
+    " at batch-close granularity (serve.ServePlane.place_verify), never per"
+    " frag (device->host syncs are FD201's half of the same rule)",
+)

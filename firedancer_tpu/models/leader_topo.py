@@ -55,12 +55,52 @@ def build_verify(links, cnc, *, batch):
     )
 
 
+def build_router(links, cnc, *, n_shards):
+    from firedancer_tpu.parallel.router import ShardRouterStage
+
+    return ShardRouterStage(
+        "router",
+        ins=[shm.Consumer(links["gv"], lazy=32)],
+        outs=[shm.Producer(links[f"sv{i}"]) for i in range(n_shards)],
+        cnc=cnc,
+        n_shards=n_shards,
+    )
+
+
+def build_verify_shard(links, cnc, *, shard_idx, batch, precomputed):
+    if not precomputed:
+        _cpu()
+    from firedancer_tpu.runtime.verify import VerifyStage
+
+    return VerifyStage(
+        f"verify_s{shard_idx}",
+        ins=[shm.Consumer(links[f"sv{shard_idx}"], lazy=32)],
+        outs=[shm.Producer(links[f"vd{shard_idx}"])],
+        cnc=cnc,
+        batch=batch,
+        max_msg_len=256,
+        batch_deadline_s=0.002,
+        precomputed_ok=precomputed,
+    )
+
+
 def build_dedup(links, cnc):
     from firedancer_tpu.runtime.dedup import DedupStage
 
     return DedupStage(
         "dedup",
         ins=[shm.Consumer(links["vd"], lazy=32)],
+        outs=[shm.Producer(links["dp"])],
+        cnc=cnc,
+    )
+
+
+def build_dedup_sharded(links, cnc, *, n_shards):
+    from firedancer_tpu.runtime.dedup import DedupStage
+
+    return DedupStage(
+        "dedup",
+        ins=[shm.Consumer(links[f"vd{i}"], lazy=32) for i in range(n_shards)],
         outs=[shm.Producer(links["dp"])],
         cnc=cnc,
     )
@@ -209,6 +249,92 @@ def build_leader_topology(
     topo.stage("verify0", build_verify, batch=batch, sandbox=sb,
                ins=["gv"], outs=["vd"], schema=VerifyStage.metrics_schema())
     topo.stage("dedup", build_dedup, sandbox=sb, ins=["vd"], outs=["dp"],
+               schema=DedupStage.metrics_schema())
+    topo.stage("pack", build_pack, n_bank=n_bank, sandbox=sb,
+               ins=["dp"] + [f"bd{b}" for b in range(n_bank)],
+               outs=[f"pb{b}" for b in range(n_bank)],
+               schema=PackStage.metrics_schema())
+    for b in range(n_bank):
+        topo.stage(f"bank{b}", build_bank, bank_idx=b, slot=slot, sandbox=sb,
+                   ins=[f"pb{b}"], outs=[f"bp{b}", f"bd{b}"],
+                   credit_gated=True, schema=BankStage.metrics_schema())
+    topo.stage("poh", build_poh, n_bank=n_bank, sandbox=sb,
+               ins=[f"bp{b}" for b in range(n_bank)], outs=["ps"],
+               credit_gated=True)
+    topo.stage("shred", build_shred, secret=secret, slot=slot, sandbox=sb,
+               ins=["ps"], outs=["ss"])
+    topo.stage("store", build_store, leader_pub=leader_pub, sandbox=sb,
+               ins=["ss"])
+    return topo
+
+
+def build_sharded_leader_topology(
+    *,
+    n_shards: int = 4,
+    n_txns: int = 64,
+    pool_size: int = 64,
+    batch: int = 32,
+    leader_seed: bytes = b"leader",
+    slot: int = 1,
+    sandbox: dict | None = None,
+    verify_precomputed: bool = False,
+    shard_depth: int = 512,
+) -> ft.Topology:
+    """The SHARDED serving topology (process form): ingress round-robins
+    through an explicit shard router into per-shard rings, and one verify
+    process per shard carries shard labels the whole observability plane
+    understands (run descriptor -> scrape {stage="verify",shard=i} ->
+    monitor aggregation).
+
+        benchg -> gv -> router -> sv{i} -> verify_s{i} -> vd{i} -> dedup
+               -> pack -> bank -> poh -> shred -> store
+
+    verify_precomputed skips the device dispatch in the shard children
+    (the host-machinery bench/test instrument — a spawned child would
+    otherwise cold-compile the kernel per shard).  The mesh-sharded
+    single-step serving plane is the COOPERATIVE form
+    (models/leader.build_sharded_leader_pipeline); this topology is its
+    process-isolation counterpart where each shard is a crash domain.
+    """
+    from firedancer_tpu.ops.ref import ed25519_ref as ref
+    from firedancer_tpu.parallel.router import ShardRouterStage
+    from firedancer_tpu.runtime.bank import BankStage
+    from firedancer_tpu.runtime.dedup import DedupStage
+    from firedancer_tpu.runtime.pack_stage import PackStage
+    from firedancer_tpu.runtime.verify import VerifyStage
+
+    n_bank = 1  # see build_leader_topology: one bank until funk is shared
+    topo = ft.Topology()
+    topo.link("gv", depth=1024, mtu=1232)
+    for i in range(n_shards):
+        topo.link(f"sv{i}", depth=shard_depth, mtu=1232)  # pow2 (FD104)
+        topo.link(f"vd{i}", depth=shard_depth, mtu=4096)
+    topo.link("dp", depth=1024, mtu=4096)
+    for b in range(n_bank):
+        topo.link(f"pb{b}", depth=256, mtu=65536)
+        topo.link(f"bp{b}", depth=256, mtu=65536)
+        topo.link(f"bd{b}", depth=256, mtu=64)
+    topo.link("ps", depth=1024, mtu=65536)
+    topo.link("ss", depth=4096, mtu=1232)
+
+    secret = hashlib.sha256(leader_seed).digest()
+    leader_pub = ref.public_key(secret)
+
+    sb = sandbox
+    topo.stage("benchg", build_benchg, pool_size=pool_size, n_txns=n_txns,
+               sandbox=sb, outs=["gv"])
+    topo.stage("router", build_router, n_shards=n_shards, sandbox=sb,
+               ins=["gv"], outs=[f"sv{i}" for i in range(n_shards)],
+               credit_gated=True,
+               schema=ShardRouterStage.metrics_schema_n(n_shards))
+    for i in range(n_shards):
+        topo.stage(f"verify_s{i}", build_verify_shard,
+                   shard=i, logical="verify", shard_idx=i,
+                   batch=batch, precomputed=verify_precomputed, sandbox=sb,
+                   ins=[f"sv{i}"], outs=[f"vd{i}"],
+                   schema=VerifyStage.metrics_schema())
+    topo.stage("dedup", build_dedup_sharded, n_shards=n_shards, sandbox=sb,
+               ins=[f"vd{i}" for i in range(n_shards)], outs=["dp"],
                schema=DedupStage.metrics_schema())
     topo.stage("pack", build_pack, n_bank=n_bank, sandbox=sb,
                ins=["dp"] + [f"bd{b}" for b in range(n_bank)],

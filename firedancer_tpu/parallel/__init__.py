@@ -1,8 +1,14 @@
 """Mesh construction and sharded dispatch for multi-chip scale-out.
 
 The data-parallel fan-out axis of the leader pipeline (the reference's
-N-verify-tile round-robin, fd_verify.c:46) mapped onto a jax.sharding.Mesh;
-see mesh.py.
+N-verify-tile round-robin, fd_verify.c:46) mapped onto a jax.sharding.Mesh
+(mesh.py), and the SERVING plane that pushes real pipeline traffic through
+it: the shard router (router.py) and the single-pjit-step serve plane +
+stage (serve.py).
+
+serve/router are imported lazily (not here): importing them pulls in the
+runtime stage machinery, which pure mesh users (the dryrun, kernels-only
+callers) must not pay for.
 """
 
 from .mesh import (  # noqa: F401

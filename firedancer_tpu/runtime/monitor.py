@@ -70,14 +70,17 @@ def list_flight_dumps() -> list[str]:
 
 
 def write_descriptor(uid: str, stages: dict[str, str],
-                     metrics: dict | None = None) -> str:
+                     metrics: dict | None = None,
+                     shards: dict | None = None) -> str:
     """stages: name -> cnc shm name; metrics: name -> {"shm": metrics
-    segment shm name, "schema": schema_to_obj(...)}.  Returns the path."""
+    segment shm name, "schema": schema_to_obj(...)}; shards: name ->
+    {"shard": int, "logical": str} for sharded-serving stages (absent
+    entries are unsharded).  Returns the path."""
     path = descriptor_path(uid)
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
         json.dump({"uid": uid, "pid": os.getpid(), "stages": stages,
-                   "metrics": metrics or {}}, f)
+                   "metrics": metrics or {}, "shards": shards or {}}, f)
     os.replace(tmp, path)
     return path
 
@@ -120,6 +123,9 @@ class _Joined:
     registry: object = None  # fm.MetricsRegistry
     recorder: object = None  # fm.FlightRecorder
     met_shm: shared_memory.SharedMemory | None = None
+    # sharded-serving labels (None/name on unsharded stages)
+    shard: int | None = None
+    logical: str | None = None
 
 
 class MonitorSession:
@@ -141,11 +147,16 @@ class MonitorSession:
             d = json.load(f)
         joined = []
         met = d.get("metrics", {})
+        shards = d.get("shards", {})
         for name, shm_name in d["stages"].items():
             s = _attach_shm(shm_name)
             cnc = Cnc(np.frombuffer(s.buf, dtype=rings.U64,
                                     count=2 + Cnc.NDIAG))
             j = _Joined(name, cnc, s)
+            sh = shards.get(name)
+            if sh:
+                j.shard = sh.get("shard")
+                j.logical = sh.get("logical", name)
             m = met.get(name)
             if m:
                 ms = None
@@ -192,10 +203,22 @@ class MonitorSession:
         return {j.name: j.registry for j in self._joined
                 if j.registry is not None}
 
+    def shard_labels(self) -> dict:
+        """{physical stage: {"stage": logical, "shard": i}} for sharded
+        stages — the scrape relabeling that lets shards of one logical
+        stage aggregate instead of fragmenting over physical names."""
+        return {
+            j.name: {"stage": j.logical or j.name, "shard": j.shard}
+            for j in self._joined
+            if j.shard is not None
+        }
+
     def scrape(self) -> str:
         """The Prometheus text exposition over all joined stages (what
-        `fdtpu metrics --once` prints and `--serve` serves)."""
-        return fm.render_prometheus(self.registries())
+        `fdtpu metrics --once` prints and `--serve` serves); sharded
+        stages carry {stage=<logical>,shard=<i>} labels."""
+        return fm.render_prometheus(self.registries(),
+                                    labels=self.shard_labels())
 
     def flight_records(self) -> dict:
         """{stage: [(ts_ns, event, arg), ...]} from the live rings."""
@@ -212,12 +235,22 @@ class MonitorSession:
 
     # -- sampling -----------------------------------------------------------
 
-    def sample(self) -> list[dict]:
+    def sample(self, *, aggregate_shards: bool = False) -> list[dict]:
+        """Per-stage liveness + counters.  aggregate_shards=True folds
+        the N physical shards of each logical stage into ONE row (the
+        monitor-TUI view): counters sum, heartbeat age is the WORST
+        shard's, signal is FAIL if any shard failed (else the minimum —
+        a still-BOOTing shard keeps the row at BOOT), and the latency
+        percentiles come from the merged cross-shard histogram."""
         from firedancer_tpu.runtime.stage import Stage
 
         now = time.monotonic_ns()
         out = []
+        groups: dict[str, list] = {}
         for j in self._joined:
+            if aggregate_shards and j.shard is not None:
+                groups.setdefault(j.logical or j.name, []).append(j)
+                continue
             hb = j.cnc.last_heartbeat
             row = {
                 "stage": j.name,
@@ -228,8 +261,31 @@ class MonitorSession:
                 "overrun": j.cnc.diag(Stage.DIAG_OVERRUN),
                 "backpressure": j.cnc.diag(Stage.DIAG_BACKPRESSURE),
                 "iters": j.cnc.diag(Stage.DIAG_ITER),
+                "shard": j.shard,
             }
             row.update(fm.latency_row(j.registry))
+            out.append(row)
+        for logical, js in groups.items():
+            sigs = [j.cnc.signal for j in js]
+            ages = [
+                (now - j.cnc.last_heartbeat) / 1e6
+                for j in js if j.cnc.last_heartbeat
+            ]
+            row = {
+                "stage": f"{logical} x{len(js)}",
+                "signal": (CNC_SIG_FAIL if CNC_SIG_FAIL in sigs
+                           else min(sigs)),
+                "heartbeat_age_ms": max(ages) if ages else None,
+                "in": sum(j.cnc.diag(Stage.DIAG_FRAGS_IN) for j in js),
+                "out": sum(j.cnc.diag(Stage.DIAG_FRAGS_OUT) for j in js),
+                "overrun": sum(j.cnc.diag(Stage.DIAG_OVERRUN) for j in js),
+                "backpressure": sum(
+                    j.cnc.diag(Stage.DIAG_BACKPRESSURE) for j in js
+                ),
+                "iters": sum(j.cnc.diag(Stage.DIAG_ITER) for j in js),
+                "shards": len(js),
+            }
+            row.update(fm.latency_row_merged([j.registry for j in js]))
             out.append(row)
         return out
 
@@ -299,7 +355,7 @@ class MonitorSession:
         n = 0
         try:
             while iterations is None or n < iterations:
-                rows = self.sample()
+                rows = self.sample(aggregate_shards=True)
                 now = time.monotonic()
                 text = self.render(rows, prev, now - prev_t)
                 if not first:

@@ -57,6 +57,7 @@ class PohStage(Stage):
         hashes_per_tick: int = 64,
         ticks_per_slot: int = 8,
         hashes_per_iter: int = 16,
+        plane=None,
         **kwargs,
     ):
         super().__init__(*args, **kwargs)
@@ -71,6 +72,14 @@ class PohStage(Stage):
         # entries is an optional in-memory record for replay tests
         self.last_entry_hash = seed
         self.entries: list[tuple[int, bytes, list[bytes]]] | None = None
+        # serving plane (parallel/serve.ServePlane): full-tick pure-append
+        # spans are parked on the plane and re-verified ON the mesh by the
+        # next serving step — the leader auditing its own clock with the
+        # same device program replay uses, at zero extra dispatches.  Spans
+        # only match the compiled shape when a whole tick passed without a
+        # mixin (poh_iters == hashes_per_tick); others are skipped.
+        self.plane = plane
+        self._span_start = seed
 
     # -- callbacks ----------------------------------------------------------
 
@@ -102,6 +111,7 @@ class PohStage(Stage):
         self.chain.mixin(mixin)
         num_hashes = self._hashes_since_entry + 1  # mixin counts as one
         self._hashes_since_entry = 0
+        self._span_start = self.chain.hash  # mixin breaks the append span
         self.metrics.inc("mixins")
         self.entries_out += 1
         self.last_entry_hash = self.chain.hash
@@ -121,6 +131,13 @@ class PohStage(Stage):
         self._tick_cnt += 1
         num_hashes = self._hashes_since_entry
         self._hashes_since_entry = 0
+        if (
+            self.plane is not None
+            and num_hashes == self.plane.cfg.poh_iters
+            and self.plane.queue_poh_span(self._span_start, self.chain.hash)
+        ):
+            self.metrics.inc("poh_spans_queued")
+        self._span_start = self.chain.hash
         self.metrics.inc("ticks")
         self.entries_out += 1
         self.last_entry_hash = self.chain.hash

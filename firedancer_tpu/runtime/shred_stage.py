@@ -31,10 +31,12 @@ class ShredStage(Stage):
         shred_version: int = 1,
         batch_target_sz: int = 16384,
         keep_sets: bool = False,
+        plane=None,
         **kwargs,
     ):
         super().__init__(*args, **kwargs)
-        self.shredder = Shredder(signer=signer, shred_version=shred_version)
+        self.shredder = Shredder(signer=signer, shred_version=shred_version,
+                                 plane=plane)
         self.slot = slot
         self.batch_target_sz = batch_target_sz
         self.keep_sets = keep_sets

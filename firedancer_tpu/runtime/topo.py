@@ -84,6 +84,14 @@ class StageSpec:
     inputs while any output is backpressured, which the checker uses to
     find credit-deadlock cycles (FD107).
 
+    shard / logical: the sharded-serving labels.  A sharded topology runs
+    N instances of one LOGICAL stage (e.g. "verify") as physically
+    distinct stages ("verify_s0".."verify_s3") — `logical` names the
+    stage kind and `shard` its index, and both ride the run descriptor so
+    the scrape surface labels series {stage=<logical>,shard=<i>} and the
+    monitor aggregates across shards instead of colliding on (or being
+    fragmented by) the physical names.  None = unsharded (no label).
+
     schema: the stage KIND's metric layout (Stage.metrics_schema()).
     launch() sizes the per-stage metrics shm segment from it IN THE
     PARENT, and the child attaches with the same spec-resolved schema,
@@ -98,6 +106,8 @@ class StageSpec:
     outs: tuple[str, ...] | None = None
     credit_gated: bool = False
     schema: fm.MetricsSchema | None = None
+    shard: int | None = None
+    logical: str | None = None
 
 
 @dataclass
@@ -115,6 +125,8 @@ class Topology:
               outs: list[str] | tuple[str, ...] | None = None,
               credit_gated: bool = False,
               schema: fm.MetricsSchema | None = None,
+              shard: int | None = None,
+              logical: str | None = None,
               **kwargs) -> "StageSpec":
         spec = StageSpec(
             name, builder, kwargs, sandbox,
@@ -122,6 +134,8 @@ class Topology:
             outs=tuple(outs) if outs is not None else None,
             credit_gated=credit_gated,
             schema=schema,
+            shard=shard,
+            logical=logical,
         )
         self.stages.append(spec)
         return spec
@@ -518,6 +532,13 @@ def launch(topo: Topology) -> TopologyHandle:
                 "schema": fm.schema_to_obj(_spec_schema(s)),
             }
             for s in topo.stages
+        },
+        # sharded-serving labels: physical stage -> {shard, logical}, so
+        # scrapers label series per shard and the monitor can aggregate
+        shards={
+            s.name: {"shard": s.shard, "logical": s.logical or s.name}
+            for s in topo.stages
+            if s.shard is not None
         },
     )
     return TopologyHandle(topo, uid, links, cncs, cnc_shms, procs,

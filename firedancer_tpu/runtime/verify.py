@@ -132,9 +132,23 @@ class VerifyStage(Stage):
         precomputed_ok: bool = False,
         comb_slots: int = 0,
         promote_threshold: int = 2,
+        plane=None,
         **kwargs,
     ):
         super().__init__(*args, **kwargs)
+        # plane: a parallel/serve.ServePlane — when configured, generic
+        # batches dispatch through the mesh-sharded serving step instead
+        # of the single-device kernel (the stage's batch geometry must
+        # match the plane's compiled shape; checked here, not mid-stream)
+        self.plane = plane
+        if plane is not None:
+            if batch != plane.cfg.batch or max_msg_len != plane.cfg.max_msg_len:
+                raise ValueError(
+                    f"verify stage (batch={batch}, max_msg_len={max_msg_len})"
+                    f" does not match the serving plane's compiled shape"
+                    f" (batch={plane.cfg.batch},"
+                    f" max_msg_len={plane.cfg.max_msg_len})"
+                )
         # precomputed_ok: bench instrument — skip the device dispatch and
         # mark every element valid, so the HOST pipeline machinery (rings,
         # parse, dedup, pack, bank, poh, shred) is measured net of
@@ -393,6 +407,10 @@ class VerifyStage(Stage):
         # uint8 byte rows: 4x less host->device transfer; the kernel
         # widens to int32 on-device
         msg, ln, sig, pk = self._assemble(acc)
+        if self.plane is not None and not cached:
+            # mesh route: the sharded serving step (pad lanes beyond n
+            # are masked by the step itself via the per-shard fills)
+            return self.plane.verify_batch(msg, ln, sig, pk)
         if cached:
             slots = np.zeros((b,), dtype=np.int32)
             slots[:n] = acc.slots
@@ -413,16 +431,25 @@ class VerifyStage(Stage):
             max_msg_len=self.max_msg_len,
         )
 
+    # result-extraction hooks: the sharded serving stage (parallel/serve.
+    # ShardedVerifyStage) reuses THIS drain loop — the txn-level
+    # pass-iff-all-pass rule must have exactly one implementation — and
+    # only overrides how a pending entry exposes readiness and its mask.
+
+    def _result_ready(self, head) -> bool:
+        # jax arrays expose readiness via is_ready() on committed
+        # arrays; fall back to treating it as ready.
+        return getattr(head.result, "is_ready", lambda: True)()
+
+    def _result_mask(self, head) -> np.ndarray:
+        return np.asarray(head.result)
+
     def _drain(self, block: bool) -> None:
         while self._inflight:
             head = self._inflight[0]
-            if not block:
-                # jax arrays expose readiness via is_ready() on committed
-                # arrays; fall back to treating it as ready.
-                ready = getattr(head.result, "is_ready", lambda: True)()
-                if not ready:
-                    return
-            mask = np.asarray(head.result)
+            if not block and not self._result_ready(head):
+                return
+            mask = self._result_mask(head)
             self._inflight.pop(0)
             self.trace(fm.EV_BATCH_COMPLETE, head.n_elems)
             for payload, desc, (a, b), tsorig in zip(

@@ -209,6 +209,30 @@ def latency_row(reg: "MetricsRegistry | None") -> dict:
     return out
 
 
+def latency_row_merged(regs: list) -> dict:
+    """latency_row over SEVERAL shard registries of one logical stage:
+    bucket counts merge (histograms of the same schema sum exactly), so
+    the quantiles are the logical stage's true cross-shard estimates,
+    not any single shard's."""
+    merged = None
+    for reg in regs:
+        if reg is None or "frag_latency_ns" not in reg._off:
+            continue
+        h = reg.hist("frag_latency_ns")
+        if merged is None:
+            merged = h
+        else:
+            merged["counts"] = [a + b for a, b in
+                                zip(merged["counts"], h["counts"])]
+            merged["count"] += h["count"]
+            merged["sum"] += h["sum"]
+    out = {"lat_p50_ms": None, "lat_p99_ms": None}
+    if merged and merged["count"]:
+        out["lat_p50_ms"] = hist_quantile(merged, 0.5) / 1e6
+        out["lat_p99_ms"] = hist_quantile(merged, 0.99) / 1e6
+    return out
+
+
 def format_latency_ms(v: float | None) -> str:
     """One cell of the monitor's latency columns: '-' when the metrics
     plane is not joined, '>max' when the quantile overflowed the last
@@ -249,29 +273,44 @@ def _escape_help(v: str) -> str:
     return v.replace("\\", "\\\\").replace("\n", "\\n")
 
 
-def render_prometheus(stages: dict[str, MetricsRegistry]) -> str:
-    """Text exposition over {stage_name: registry} (fd_metric.c's endpoint)."""
+def render_prometheus(stages: dict[str, MetricsRegistry],
+                      labels: dict[str, dict] | None = None) -> str:
+    """Text exposition over {stage_name: registry} (fd_metric.c's endpoint).
+
+    labels: optional per-stage extra label sets (the sharded-serving
+    plane's {"stage": <logical>, "shard": <i>} relabeling) — when a stage
+    has an entry, its series carry THOSE labels (the "stage" key replaces
+    the physical name), so N shards of one logical stage surface as one
+    metric family distinguished by the shard label and aggregate with a
+    plain `sum by (stage)` instead of colliding on (or fragmenting over)
+    physical stage names."""
     seen_help: set[str] = set()
     lines: list[str] = []
     for stage, reg in stages.items():
-        stage = _escape_label(stage)
+        lset = {"stage": stage}
+        if labels and stage in labels:
+            lset.update({k: v for k, v in labels[stage].items()
+                         if v is not None})
+        base = ",".join(
+            f'{k}="{_escape_label(str(v))}"' for k, v in lset.items()
+        )
+        label = "{" + base + "}"
         for d in reg.schema.defs:
             if d.name not in seen_help:
                 seen_help.add(d.name)
                 if d.help:
                     lines.append(f"# HELP {d.name} {_escape_help(d.help)}")
                 lines.append(f"# TYPE {d.name} {d.kind}")
-            label = f'{{stage="{stage}"}}'
             if d.kind == HISTOGRAM:
                 h = reg.hist(d.name)
                 run = 0
                 for edge, c in zip(h["buckets"], h["counts"]):
                     run += c
                     lines.append(
-                        f'{d.name}_bucket{{stage="{stage}",le="{edge}"}} {run}'
+                        f'{d.name}_bucket{{{base},le="{edge}"}} {run}'
                     )
                 lines.append(
-                    f'{d.name}_bucket{{stage="{stage}",le="+Inf"}} {h["count"]}'
+                    f'{d.name}_bucket{{{base},le="+Inf"}} {h["count"]}'
                 )
                 lines.append(f"{d.name}_sum{label} {h['sum']}")
                 lines.append(f"{d.name}_count{label} {h['count']}")
@@ -285,10 +324,12 @@ class MetricsServer:
     over HTTP (run/tiles/fd_metric.c:1-3).  `stages` may be swapped or
     mutated live; every scrape renders the current registries."""
 
-    def __init__(self, stages: dict[str, MetricsRegistry], *, host="127.0.0.1", port=0):
+    def __init__(self, stages: dict[str, MetricsRegistry], *,
+                 host="127.0.0.1", port=0, labels: dict | None = None):
         from firedancer_tpu.protocol import http as H
 
         self.stages = stages
+        self.labels = labels
 
         def handler(req, _body):
             if req.method != "GET":
@@ -297,7 +338,8 @@ class MetricsServer:
                 return H.build_response(404, b"not found\n")
             # snapshot the dict: a registrar may add stages while a
             # scrape renders (this runs on a per-connection thread)
-            body = render_prometheus(dict(self.stages)).encode()
+            body = render_prometheus(dict(self.stages),
+                                     labels=self.labels).encode()
             return H.build_response(
                 200, body,
                 content_type="text/plain; version=0.0.4; charset=utf-8",

@@ -115,3 +115,36 @@ def enable_compile_cache(path: str | None = None, min_compile_secs: float = 1.0)
         os.path.join(base, _config_fingerprint()),
     )
     jax.config.update("jax_persistent_cache_min_compile_time_secs", min_compile_secs)
+
+
+def serve_cache_dir() -> str:
+    """Repo-local persistent cache for the SERVING step's executables,
+    partitioned by target fingerprint like default_cache_dir."""
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))),
+        ".jax_cache_serve",
+        _config_fingerprint(),
+    )
+
+
+def enable_serve_cache(path: str | None = None) -> str:
+    """Persistent compilation cache for the sharded SERVING plane — the
+    warm-boot path that turns the 2m+ serving-step compile
+    (MULTICHIP_r05's jit_step) into a seconds-long cache load.
+
+    Unlike enable_compile_cache this FORCES the cache on CPU: the CPU
+    AOT-persistence hazard documented there was observed on the single
+    -device 16K-batch sigverify executables; the serving step is a
+    different, smaller program and its producers/consumers are exactly
+    the opt-in serve surfaces (warmup CLI, multichip_serve bench, the CI
+    smoke job) — never the test suite — so a (never observed so far)
+    bad cache entry cannot take down tier-1.  Wipe `.jax_cache_serve/`
+    to recover from a corrupt entry.  Returns the cache dir."""
+    import jax
+
+    d = path or serve_cache_dir()
+    os.makedirs(d, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", d)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    return d

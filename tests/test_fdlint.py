@@ -590,3 +590,53 @@ def test_fd208_clean_on_repo_hot_paths():
                         "runtime")
     findings = ast_rules.lint_path(root)
     assert [f for f in findings if f.rule == "FD208"] == []
+
+
+# -- FD210: host<->device transfers in serving frag paths ---------------------
+
+
+_TRANSFER_SRC = '''
+import jax
+from jax import device_put
+
+class ServeishStage:
+    def after_frag(self, in_idx, meta, payload):
+        a = jax.device_put(payload, self.sharding)   # FD210: per-frag commit
+        b = device_put(payload)                      # FD210: from-import
+        self.pending.copy_to_host_async()            # FD210: transfer kick
+        self.acc.append(payload)                     # ok: host accumulation
+
+    def after_credit(self):
+        # batch-close granularity: the sanctioned place for device_put
+        return jax.device_put(self.batch, self.sharding)
+'''
+
+
+def test_fd210_flags_per_frag_transfers_in_serve_scope():
+    findings = ast_rules.lint_source(
+        _TRANSFER_SRC, "firedancer_tpu/runtime/somestage.py")
+    hits = [f for f in findings if f.rule == "FD210"]
+    assert len(hits) == 3
+    ac_line = _TRANSFER_SRC[: _TRANSFER_SRC.index("after_credit")].count(
+        "\n") + 1
+    assert all(f.line < ac_line for f in hits)
+
+
+def test_fd210_scoped_to_runtime_and_parallel():
+    # the same source outside runtime//parallel/ is not FD210's business
+    findings = ast_rules.lint_source(_TRANSFER_SRC, "firedancer_tpu/waltz/x.py")
+    assert [f for f in findings if f.rule == "FD210"] == []
+    findings = ast_rules.lint_source(
+        _TRANSFER_SRC, "firedancer_tpu/parallel/serve.py")
+    assert len([f for f in findings if f.rule == "FD210"]) == 3
+
+
+def test_fd210_registered_and_clean_on_repo():
+    assert "FD210" in {r.id for r in all_rules()}
+    import os
+
+    for pkg in ("runtime", "parallel"):
+        root = os.path.join(os.path.dirname(__file__), "..",
+                            "firedancer_tpu", pkg)
+        findings = ast_rules.lint_path(root)
+        assert [f for f in findings if f.rule == "FD210"] == []
