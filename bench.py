@@ -447,12 +447,43 @@ def run_host_pipeline_bench() -> dict:
     dispatch), so rings/parse/dedup/pack/bank/poh/shred are what's timed.
     This is the tunnel-independent number the r3 verdict asked for; the
     target to beat is the reference's stock single-host bench, 63K txn/s
-    (book/guide/tuning.md:131)."""
+    (book/guide/tuning.md:131).
+
+    Measures BOTH pack lanes on the same box: the fused native
+    dedup+pack lane (the headline artifact) and, briefly, the Python
+    lane (`*_native_pack_off`), so every round records the native lane's
+    step explicitly (the ISSUE 9 acceptance shape)."""
+    from firedancer_tpu.pack import scheduler_native as sn
+
+    out = {}
+    if sn.available():
+        off = _host_pipeline_measure(native_pack=False)
+        out["pipeline_host_txn_per_s_native_pack_off"] = \
+            off["pipeline_host_txn_per_s"]
+        out.update(_host_pipeline_measure(native_pack=True))
+        out["pipeline_host_native_pack"] = True
+    else:
+        out.update(_host_pipeline_measure(native_pack=False))
+        out["pipeline_host_native_pack"] = False
+    try:
+        out["verify_stage_host_txn_per_s"] = round(
+            _verify_stage_loop_rate(), 1
+        )
+    except Exception as e:
+        print(f"# verify stage loop bench failed: {type(e).__name__}",
+              file=sys.stderr)
+    # durable evidence first, before the caller's remaining (accel)
+    # sections get a chance to wedge
+    _persist_pipeline_mid(out)
+    return out
+
+
+def _host_pipeline_measure(*, native_pack: bool) -> dict:
     from firedancer_tpu.models.leader import build_leader_pipeline
     from firedancer_tpu.runtime.bank import default_bank_ctx
     from firedancer_tpu.runtime.benchg import gen_transfer_pool
 
-    n_txn = 8192
+    n_txn = int(os.environ.get("FDTPU_BENCH_PIPELINE_TXNS", "8192"))
     n_payers = 64  # schedulable parallelism (fd_benchg rotates a
     #                bounded funded account set the same way)
     t0 = time.time()
@@ -467,11 +498,12 @@ def run_host_pipeline_bench() -> dict:
         batch_deadline_s=0.005,
         verify_precomputed=True,
         bank_ctx=ctx,
+        native_pack=native_pack,
     )
     pipe.benchg.pool = gen_transfer_pool(n_txn, n_payers=n_payers,
                                          n_dests=1024)
-    print(f"# host pipeline: pool of {n_txn} signed in {time.time()-t0:.1f}s",
-          file=sys.stderr)
+    print(f"# host pipeline: pool of {n_txn} signed in {time.time()-t0:.1f}s"
+          f" (native_pack={native_pack})", file=sys.stderr)
 
     def executed_cnt() -> int:
         return sum(b.metrics.get("txn_exec") for b in pipe.banks)
@@ -559,26 +591,23 @@ def run_host_pipeline_bench() -> dict:
                       file=sys.stderr)
         from firedancer_tpu.flamenco import exec_native
 
+        # the ISSUE 9 criterion watches pack + dedup COMBINED us/txn
+        # (the fused lane has no dedup stage at all)
+        pack_dedup_us = round(
+            breakdown_us.get("pack", 0.0)
+            + breakdown_us.get("pack.after_credit", 0.0)
+            + breakdown_us.get("dedup", 0.0), 1)
         out = {
             "pipeline_host_txn_per_s": round(rate, 1),
             "pipeline_host_commit_p99_ms": round(p99_ms, 2),
             "pipeline_host_txn_executed": executed,
             "pipeline_host_stage_us_per_txn": breakdown_us,
+            "pipeline_host_pack_dedup_us_per_txn": pack_dedup_us,
             "pipeline_host_native_exec": exec_native.available(),
         }
         out.update(_scrape_stage_latencies(pipe))
         if executed < target:
             out["pipeline_host_incomplete"] = True
-        try:
-            out["verify_stage_host_txn_per_s"] = round(
-                _verify_stage_loop_rate(), 1
-            )
-        except Exception as e:
-            print(f"# verify stage loop bench failed: {type(e).__name__}",
-                  file=sys.stderr)
-        # durable evidence first, before the caller's remaining (accel)
-        # sections get a chance to wedge
-        _persist_pipeline_mid(out)
         return out
     finally:
         pipe.close()

@@ -209,6 +209,13 @@ class _Linter(ast.NodeVisitor):
         # FD210 scope: the packages whose frag callbacks feed (or are) the
         # sharded serving plane
         self._serve_scope = "runtime" in parts or "parallel" in parts
+        # FD211 scope: pack modules (the pack package + the runtime pack
+        # stage) — their frag callbacks are the pool intake hot path.
+        # Exact matches only: a future packet.py/unpack_utils.py must
+        # not inherit the comprehension ban by substring accident.
+        self._pack_scope = bool(parts) and (
+            "pack" in parts or parts[-1] == "pack_stage.py"
+        )
 
     def _resolve(self, node: ast.Call) -> tuple[str, str] | None:
         """Canonical (module, func) for a call, seeing through `import
@@ -366,6 +373,26 @@ class _Linter(ast.NodeVisitor):
                              " be allocation-free — precompute the label/"
                              "edges and pass scalars")
                     break
+        # FD211: sorting in a pack frag callback — pool maintenance is
+        # O(log n) in the ordered pool (or native); a sorted()/insort in
+        # the intake path re-pays O(pool) per frag
+        if self._pack_scope:
+            is_sort = (
+                isinstance(node.func, ast.Name) and node.func.id == "sorted"
+            ) or (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("sort", "insort", "insort_left",
+                                       "insort_right")
+            )
+            if is_sort:
+                what = (node.func.id if isinstance(node.func, ast.Name)
+                        else node.func.attr)
+                self.hit("FD211", node,
+                         f"'{what}' in a pack frag callback: per-frag"
+                         " sorting is O(pool) x ingress rate — keep the"
+                         " pool ordered incrementally (scheduler insort"
+                         " at insert / the native treap) and keep the"
+                         " frag path append-only")
         # FD207: a native (ctypes) crossing per frag — the crossing
         # itself costs ~1-3us, so it belongs at burst granularity (one
         # call per drained burst / microblock, the fd_exec_batch shape)
@@ -424,6 +451,22 @@ class _Linter(ast.NodeVisitor):
                              f" '{fn.name}' and will not pickle under"
                              " spawn")
                     return
+
+    def _visit_comp(self, node: ast.AST) -> None:
+        # FD211 (other half): a comprehension per frag in pack intake is
+        # a hidden allocator + O(n) pass in the hottest path pack has
+        if self._frag_depth and self._pack_scope:
+            self.hit("FD211", node,
+                     "comprehension in a pack frag callback: per-frag"
+                     " container builds multiply an allocator by ingress"
+                     " rate — keep the frag path append-only and batch"
+                     " the work at burst granularity")
+        self.generic_visit(node)
+
+    visit_ListComp = _visit_comp
+    visit_SetComp = _visit_comp
+    visit_DictComp = _visit_comp
+    visit_GeneratorExp = _visit_comp
 
     def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
         bare = node.type is None or (

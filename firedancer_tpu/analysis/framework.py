@@ -174,3 +174,13 @@ FD210 = _rule(
     " at batch-close granularity (serve.ServePlane.place_verify), never per"
     " frag (device->host syncs are FD201's half of the same rule)",
 )
+FD211 = _rule(
+    "FD211", "alloc-sort-in-pack-frag", SEV_ERROR,
+    "sort (sorted()/.sort()/bisect.insort*) or per-frag comprehension inside"
+    " a frag callback in a pack module: pack's intake runs per verified frag"
+    " and a sort or container build there is O(pool) work multiplied by"
+    " ingress rate — pool maintenance belongs in the ordered structure"
+    " (scheduler's insort at insert is the POOL's cost, paid once per"
+    " accepted txn; the native lane pays it in C++), and burst handoff must"
+    " be append-only (NativePackStage.after_frag's shape)",
+)

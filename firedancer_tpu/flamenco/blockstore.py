@@ -195,6 +195,10 @@ class StatusCache:
     """
 
     def __init__(self):
+        # bumped whenever the blockhash registry changes, so callers
+        # caching a derived view (the native gate's valid set) can
+        # re-ship only on change
+        self.version = 0
         self.blockhash_slot: dict[bytes, int] = {}
         self.seen: dict[tuple[bytes, bytes], list[int]] = {}
         # signature-keyed index for the RPC's getSignatureStatuses (a hot
@@ -207,7 +211,9 @@ class StatusCache:
         self._staged: dict[bytes, tuple[int, list, list[bytes]]] = {}
 
     def register_blockhash(self, blockhash: bytes, slot: int) -> None:
-        self.blockhash_slot.setdefault(blockhash, slot)
+        if blockhash not in self.blockhash_slot:
+            self.blockhash_slot[blockhash] = slot
+            self.version += 1
 
     # -- speculative block staging --
 
@@ -254,6 +260,7 @@ class StatusCache:
             bh: s for bh, s in self.blockhash_slot.items()
             if s >= root_slot - MAX_BLOCKHASH_AGE
         }
+        self.version += 1
         for index in (self.seen, self.by_sig):
             dead = []
             for key, slots in index.items():

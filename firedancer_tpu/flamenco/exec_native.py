@@ -46,6 +46,7 @@ _SO = os.path.join(os.path.dirname(_SRC), "fd_exec_native.so")
 ENV_SWITCH = "FDTPU_NATIVE_EXEC"
 
 _REQ_MAGIC = 0x42584446  # 'FDXB'
+_REQ2_MAGIC = 0x32584446  # 'FDX2' (session + native gate)
 _RESP_MAGIC = 0x52584446  # 'FDXR'
 
 _U32 = struct.Struct("<I")
@@ -64,8 +65,40 @@ def _load():
             ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint64,
         ]
         lib.fd_exec_batch.restype = ctypes.c_int64
+        lib.fd_exec_session_new.restype = ctypes.c_void_p
+        lib.fd_exec_session_delete.argtypes = [ctypes.c_void_p]
+        lib.fd_exec_batch2.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.c_char_p, ctypes.c_uint64,
+        ]
+        lib.fd_exec_batch2.restype = ctypes.c_int64
         _lib = lib
     return _lib
+
+
+class Session:
+    """One slot's native execution session (native/fd_exec_native.cpp
+    Session): the status-cache gate (valid blockhashes + landed
+    (blockhash, signature) pairs) and the cross-microblock account-value
+    overlay live on the C++ side, so the per-txn Python gate and the
+    per-call funk value marshalling disappear from the bank hot path."""
+
+    def __init__(self):
+        self._lib = _load()
+        self._h = self._lib.fd_exec_session_new()
+        if not self._h:
+            raise NativeUnavailable("fd_exec_session_new failed")
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.fd_exec_session_delete(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 def enabled() -> bool:
@@ -145,8 +178,10 @@ class BatchContext:
         clock_slot: int | None = None,
         clock_epoch: int | None = None,
         slot_hashes: bytes | None = None,
+        session: Session | None = None,
     ):
         self._lib = _load()
+        self._session = session
         sh = bytes(slot_hashes or b"")
         self._fixed = (
             struct.pack(
@@ -161,33 +196,84 @@ class BatchContext:
             + sh
         )
 
-    def run(self, entries) -> tuple[int, bool, list]:
-        """One fd_exec_batch call.  entries: [payload, desc_bytes, addrs,
-        vals, ...] lists — only the first four fields are read here.
-        Returns (n_done, punted, [(status, fee, [(acct_idx, value)])]).
-        """
-        parts = [struct.pack("<II", _REQ_MAGIC, len(entries)), self._fixed]
-        req_sz = 0
-        for e in entries:
-            payload, desc_bytes, _addrs, vals = e[0], e[1], e[2], e[3]
-            parts.append(_TXN_HEAD.pack(len(payload), len(desc_bytes),
-                                        len(vals)))
-            parts.append(payload)
-            parts.append(desc_bytes)
-            for v in vals:
-                v = v or b""
-                parts.append(_U32.pack(len(v)))
-                parts.append(v)
-                req_sz += len(v)
-            req_sz += len(payload) + 64
+    def run(self, entries, *, gate=None) -> tuple[int, bool, list]:
+        """One fd_exec_batch(2) call.  entries: [payload, desc_bytes,
+        addrs, vals, ...] lists — only the first four fields are read
+        here.  Returns (n_done, punted, [(status, fee, [(idx, value)])]).
+
+        Session mode (constructed with one): vals entries may be None,
+        meaning "the session already holds this account's current value"
+        — only first-touch/dirtied values cross the FFI (Python-lane
+        writes resync the same way: the dirty set forces the next touch
+        to ship a fresh have=1 value).  `gate` arms the native
+        status-cache gate: (valid_blockhashes | None = unchanged,
+        seen_delta) where seen_delta is an iterable of 96-byte
+        blockhash||signature entries landed OUTSIDE the session since
+        the last call."""
+        if self._session is not None:
+            parts = [struct.pack("<II", _REQ2_MAGIC, len(entries)),
+                     self._fixed]
+            req_sz = 0
+            if gate is not None:
+                valid_bh, seen_delta = gate
+                if valid_bh is None:
+                    # gate on, valid set unchanged since last shipped
+                    # (flag 2): the session keeps its current set
+                    parts.append(b"\x02" + _U32.pack(0))
+                else:
+                    parts.append(b"\x01" + _U32.pack(len(valid_bh)))
+                    parts.extend(valid_bh)
+                parts.append(_U32.pack(len(seen_delta)))
+                parts.extend(seen_delta)
+            else:
+                parts.append(b"\x00" + _U32.pack(0) + _U32.pack(0))
+            # reserved refresh section (count always 0: per-txn have=1
+            # values carry all account resyncs; the C++ side accepts
+            # out-of-band refresh records should a future caller batch
+            # them separately)
+            parts.append(_U32.pack(0))
+            for e in entries:
+                payload, desc_bytes, _addrs, vals = e[0], e[1], e[2], e[3]
+                parts.append(_TXN_HEAD.pack(len(payload), len(desc_bytes),
+                                            len(vals)))
+                parts.append(payload)
+                parts.append(desc_bytes)
+                for v in vals:
+                    if v is None:  # session-known: nothing crosses
+                        parts.append(b"\x00")
+                    else:
+                        parts.append(b"\x01" + _U32.pack(len(v)))
+                        parts.append(v)
+                        req_sz += len(v)
+                req_sz += len(payload) + 64
+        else:
+            parts = [struct.pack("<II", _REQ_MAGIC, len(entries)), self._fixed]
+            req_sz = 0
+            for e in entries:
+                payload, desc_bytes, _addrs, vals = e[0], e[1], e[2], e[3]
+                parts.append(_TXN_HEAD.pack(len(payload), len(desc_bytes),
+                                            len(vals)))
+                parts.append(payload)
+                parts.append(desc_bytes)
+                for v in vals:
+                    v = v or b""
+                    parts.append(_U32.pack(len(v)))
+                    parts.append(v)
+                    req_sz += len(v)
+                req_sz += len(payload) + 64
         req = b"".join(parts)
         cap = 4096 + 2 * req_sz
         while True:
             buf = ctypes.create_string_buffer(cap)
-            rc = self._lib.fd_exec_batch(req, len(req), buf, cap)
+            if self._session is not None:
+                rc = self._lib.fd_exec_batch2(self._session._h, req,
+                                              len(req), buf, cap)
+            else:
+                rc = self._lib.fd_exec_batch(req, len(req), buf, cap)
             if rc == -2:
                 # a CreateAccount/Allocate burst can outgrow the heuristic
-                # capacity; the call is stateless, so retry bigger
+                # capacity; the call did not commit (v1 is stateless, v2
+                # commits only after serializing), so retry bigger
                 cap *= 4
                 if cap > 1 << 28:
                     raise NativeUnavailable("fd_exec_batch response > 256MB")

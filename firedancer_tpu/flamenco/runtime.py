@@ -395,6 +395,19 @@ class SlotExecution:
         # lazily on the first execute_batch; False = unavailable/disabled
         self._native_ctx = None
         self._native_sh_blob = None
+        # slot-scoped native session (ISSUE 9 bank-lane residual): the
+        # C++ side keeps the status-cache gate + an account-value overlay
+        # across microblocks, so Python ships each account's value ONCE
+        # (first touch, or after a Python-lane write dirties it) and
+        # skips the per-txn gate checks entirely
+        self._native_session = None
+        self._native_poisoned = False  # a failed call leaves the session
+        #                                stale: python lane for the rest
+        self._gate_seen_delta: list[bytes] = []  # 96B bh||sig, py-landed
+        self._gate_seeded = False
+        self._gate_shipped_version = None  # StatusCache.version last sent
+        self._native_known: set[bytes] = set()  # addrs the session holds
+        self._native_dirty: set[bytes] = set()  # py-written since sync
         self._table_cache: dict = {}  # ALT decode, once per block
         self._before: dict[bytes, bytes | None] = {}  # start-of-slot view
         self.results: list[TxnResult] = []
@@ -433,9 +446,10 @@ class SlotExecution:
         # snapshot the start-of-slot value of every account this txn can
         # touch, for the accounts-delta hash (query the PARENT view: an
         # earlier in-block writer must not shift this txn's "before")
-        for a in desc.acct_addrs(payload) + (
+        touched = desc.acct_addrs(payload) + (
             extra[0] + extra[1] if extra else []
-        ):
+        )
+        for a in touched:
             if a not in self._before:
                 self._before[a] = self.funk.rec_query(self.parent_xid, a)
         durable = False
@@ -458,12 +472,20 @@ class SlotExecution:
                 r = TxnResult(TXN_ERR_ALREADY_PROCESSED, 0)
                 self.results.append(r)
                 return r
+        if self._native_session is not None:
+            # this Python-lane execution may write any touched account:
+            # the native session's cached values go stale until resynced
+            # on next touch (the dirty set ships a fresh have=1 value).
+            # Marked HERE — after the gate — so gated-out txns (which
+            # can never write) don't churn the session's value cache.
+            self._native_dirty.update(touched)
         r = _execute_txn(self.funk, self.xid, payload, desc,
                          executor=self.executor, sysvars=self.sysvars,
                          extra=extra, durable_nonce=durable)
         return self._finish(r, desc.signature_cnt, bh, sig)
 
-    def _finish(self, r: TxnResult, sig_cnt: int, bh, sig) -> TxnResult:
+    def _finish(self, r: TxnResult, sig_cnt: int, bh, sig,
+                native: bool = False) -> TxnResult:
         """Post-execution bookkeeping shared by the Python and native
         lanes — the two must never disagree on the landed predicate."""
         if r.fee > 0:
@@ -478,6 +500,12 @@ class SlotExecution:
                 # until the fork is chosen
                 self._block_seen.add((bh, sig))
                 self.status_cache.stage_insert(self.xid, bh, sig)
+                if not native and self._native_session is not None \
+                        and bh is not None and sig is not None:
+                    # python-lane landing: the native gate learns it on
+                    # the next crossing (native landings were inserted by
+                    # the C++ side already)
+                    self._gate_seen_delta.append(bh + sig)
         self.results.append(r)
         return r
 
@@ -486,6 +514,8 @@ class SlotExecution:
     def _native_for_batch(self):
         """The slot's native BatchContext, or None (disabled/unavailable).
         Rebuilt if the slot-hashes sysvar blob was swapped out."""
+        if self._native_poisoned:
+            return None
         sh = self.sysvars.get("slot_hashes")
         if self._native_ctx is None or self._native_sh_blob is not sh:
             from firedancer_tpu.flamenco import exec_native
@@ -504,15 +534,66 @@ class SlotExecution:
                     except T.CodecError:
                         pass  # no clock: vote txns fail typed, both lanes
                 try:
+                    if self._native_session is None:
+                        # one session per SlotExecution: the overlay and
+                        # gate survive a BatchContext rebuild (only the
+                        # sysvar header changes)
+                        self._native_session = exec_native.Session()
                     self._native_ctx = exec_native.BatchContext(
                         lamports_per_sig=LAMPORTS_PER_SIGNATURE,
                         clock_slot=clock_slot,
                         clock_epoch=clock_epoch,
                         slot_hashes=sh,
+                        session=self._native_session,
                     )
                 except exec_native.NativeUnavailable:
                     pass
         return self._native_ctx or None
+
+    def _gate_args(self):
+        """(valid_blockhashes | None, seen_delta) for the next native
+        crossing — valid_blockhashes is None when the registry hasn't
+        changed since last shipped (the session keeps its set; flag 2 on
+        the wire), so steady state ships only the seen delta.  Returns
+        None when there is no status cache (the Python lane does not
+        gate either, so neither should the native side)."""
+        sc = self.status_cache
+        if sc is None:
+            return None
+        if sc.version == self._gate_shipped_version:
+            valid = None
+        else:
+            valid = [bh for bh in sc.blockhash_slot
+                     if sc.is_blockhash_valid(bh, self.slot)]
+        if not self._gate_seeded:
+            if valid is None:  # first call always ships the set
+                valid = [bh for bh in sc.blockhash_slot
+                         if sc.is_blockhash_valid(bh, self.slot)]
+            # one-time seed: everything already visible to contains()
+            # on this fork (committed ancestor entries + anything this
+            # block landed before the session armed)
+            self._gate_seeded = True
+            vs = set(valid)
+            for (bh, sig), slots in sc.seen.items():
+                if bh in vs and (
+                    self.ancestors is None
+                    or any(s in self.ancestors for s in slots)
+                ):
+                    self._gate_seen_delta.append(bh + sig)
+            for bh, sig in self._block_seen:
+                self._gate_seen_delta.append(bh + sig)
+        if valid is not None:
+            self._gate_shipped_version = sc.version
+        return (valid, self._gate_seen_delta)
+
+    def _poison_native(self) -> None:
+        """A failed native call leaves the session overlay unsynced:
+        disable the lane for the rest of this slot (python lane owns it)."""
+        self._native_poisoned = True
+        self._native_ctx = False
+        if self._native_session is not None:
+            self._native_session.close()
+            self._native_session = None
 
     @staticmethod
     def _unpack_trailer(payload: bytes, desc_bytes: bytes) -> ft.Txn:
@@ -540,6 +621,10 @@ class SlotExecution:
         nat = self._native_for_batch()
         if nat is not None:
             from firedancer_tpu.flamenco.exec_native import eligible_packed
+        # session mode: the C++ side owns the status-cache gate + the
+        # account-value overlay, so the per-txn python gate checks and
+        # the per-call funk value marshalling disappear (ISSUE 9)
+        session = self._native_session if nat is not None else None
         pend: list[list] = []   # [payload, desc_bytes, addrs, vals, bh, sig, sig_cnt]
         pend_keys: set = set()
 
@@ -550,12 +635,15 @@ class SlotExecution:
 
         def flush():
             if pend:
-                self._flush_native(nat, pend)
+                self._flush_native(nat, pend, session)
                 pend.clear()
                 pend_keys.clear()
 
         for payload, desc, desc_bytes in items:
-            if nat is None:
+            if nat is None or self._native_poisoned:
+                # poisoned mid-batch: the cached locals point at a dead
+                # session — stop marshalling into it and finish on the
+                # Python lane immediately
                 fallback(payload, desc, desc_bytes)
                 continue
             if desc_bytes is None:
@@ -585,15 +673,17 @@ class SlotExecution:
                 continue
             bh = payload[bh_off : bh_off + 32]
             sig = payload[sig_off : sig_off + 64]
-            if self.status_cache is not None and (
+            if session is None and self.status_cache is not None and (
                 not self.status_cache.is_blockhash_valid(bh, self.slot)
                 or (bh, sig) in pend_keys
                 or (bh, sig) in self._block_seen
                 or self.status_cache.contains(bh, sig, self.ancestors)
             ):
-                # stale blockhash (durable-nonce candidate) or duplicate:
-                # the Python gate owns these paths; a pending-run twin
-                # must land first so the duplicate gate sees it
+                # legacy (session-less) path: stale blockhash
+                # (durable-nonce candidate) or duplicate — the Python
+                # gate owns these; a pending-run twin must land first so
+                # the duplicate gate sees it.  With a session the C++
+                # gate decides in-line instead.
                 flush()
                 fallback(payload, desc, desc_bytes)
                 continue
@@ -601,20 +691,36 @@ class SlotExecution:
             vals = []
             q = self.funk.rec_query
             before = self._before
-            for i in range(acct_cnt):
-                a = payload[acct_off + 32 * i : acct_off + 32 * (i + 1)]
-                addrs.append(a)
-                if a not in before:
-                    before[a] = q(self.parent_xid, a)
-                vals.append(q(self.xid, a))
+            if session is not None:
+                known = self._native_known
+                dirty = self._native_dirty
+                for i in range(acct_cnt):
+                    a = payload[acct_off + 32 * i : acct_off + 32 * (i + 1)]
+                    addrs.append(a)
+                    if a not in before:
+                        before[a] = q(self.parent_xid, a)
+                    if a in known and a not in dirty:
+                        vals.append(None)  # the session holds it current
+                    else:
+                        vals.append(q(self.xid, a) or b"")
+                        known.add(a)
+                        dirty.discard(a)
+            else:
+                for i in range(acct_cnt):
+                    a = payload[acct_off + 32 * i : acct_off + 32 * (i + 1)]
+                    addrs.append(a)
+                    if a not in before:
+                        before[a] = q(self.parent_xid, a)
+                    vals.append(q(self.xid, a))
+                pend_keys.add((bh, sig))
             pend.append([payload, desc_bytes, addrs, vals, bh, sig, sig_cnt])
-            pend_keys.add((bh, sig))
         flush()
         return self.results[base:]
 
     def _run_gated(self, entry) -> None:
         """Python-lane execution for an already-gated native entry (a
-        C++ punt): fresh blockhash, not a duplicate, no lookup tables."""
+        C++ punt on the legacy session-less path): fresh blockhash, not
+        a duplicate, no lookup tables."""
         payload, desc_bytes, _addrs, _vals, bh, sig, sig_cnt = entry
         desc = self._unpack_trailer(payload, desc_bytes)
         r = _execute_txn(self.funk, self.xid, payload, desc,
@@ -622,44 +728,91 @@ class SlotExecution:
                          extra=([], []), durable_nonce=False)
         self._finish(r, sig_cnt, bh, sig)
 
-    def _flush_native(self, nat, pend: list) -> None:
+    def _run_ungated(self, entry) -> None:
+        """Python-lane execution for an UNGATED native entry (a session
+        punt: the C++ gate stopped before deciding — possibly a stale
+        blockhash / durable-nonce candidate): the full execute() path
+        owns gating, _before snapshots, and dirty-marking."""
+        payload, desc_bytes = entry[0], entry[1]
+        desc = self._unpack_trailer(payload, desc_bytes)
+        self.execute(payload, desc, ([], []))
+
+    def _flush_native(self, nat, pend: list, session=None) -> None:
         """Run the pending native-eligible txns in order: one FFI call
         per run, punts re-routed through the Python lane, and the
-        remainder resubmitted with refreshed account values."""
+        remainder resubmitted.  Session mode: account values live in
+        the C++ overlay across calls, so no per-call refresh loop; the
+        gate delta rides the same crossing."""
         from firedancer_tpu.flamenco import exec_native
 
         i = 0
         while i < len(pend):
             chunk = pend[i:]
+            gate = self._gate_args() if session is not None else None
+            n_delta = len(gate[1]) if gate else 0
             try:
-                n_done, punted, recs = nat.run(chunk)
+                if session is not None:
+                    n_done, punted, recs = nat.run(chunk, gate=gate)
+                else:
+                    n_done, punted, recs = nat.run(chunk)
             except exec_native.NativeUnavailable:
-                # oversized response / native wedge: finish in Python
-                for entry in chunk:
-                    self._run_gated(entry)
+                if session is not None:
+                    # the session overlay may be out of sync with funk
+                    # now: retire it for the rest of the slot
+                    self._poison_native()
+                    for entry in chunk:
+                        self._run_ungated(entry)
+                else:
+                    # oversized response / native wedge: finish in Python
+                    for entry in chunk:
+                        self._run_gated(entry)
                 return
+            if n_delta:
+                # the session absorbed these python-lane landings
+                del self._gate_seen_delta[:n_delta]
             for entry, (status, fee, writes) in zip(chunk, recs):
                 addrs = entry[2]
                 for idx, val in writes:
                     self.funk.rec_insert(self.xid, addrs[idx], val)
                 self._finish(TxnResult(status, fee), entry[6], entry[4],
-                             entry[5])
+                             entry[5], native=True)
             i += n_done
             self.native_done_cnt += n_done
             if punted and i < len(pend):
                 self.native_punt_cnt += 1
-                self._run_gated(pend[i])
-                i += 1
+                if session is not None:
+                    self._run_ungated(pend[i])
+                    i += 1
+                    # the punt ran on the Python lane and dirtied its
+                    # accounts: remainder entries marked session-known
+                    # (vals None) for those accounts must re-ship fresh
+                    # values — the first shipper re-syncs the session
+                    dirty = self._native_dirty
+                    if dirty:
+                        for entry in pend[i:]:
+                            vals = entry[3]
+                            for j, a in enumerate(entry[2]):
+                                if a in dirty:
+                                    vals[j] = self.funk.rec_query(
+                                        self.xid, a) or b""
+                                    dirty.discard(a)
+                else:
+                    self._run_gated(pend[i])
+                    i += 1
             elif n_done == 0 and not punted:
                 # defensive: a native lane that makes no progress must
                 # not spin — finish the remainder in Python
                 for entry in pend[i:]:
-                    self._run_gated(entry)
+                    if session is not None:
+                        self._run_ungated(entry)
+                    else:
+                        self._run_gated(entry)
                 return
-            if i < len(pend):
-                # refresh the remainder's funk values: the overlay the
-                # next call starts with is empty, and the txns just
-                # committed (native or punt) may have written their accounts
+            if i < len(pend) and session is None:
+                # legacy path only: refresh the remainder's funk values
+                # (the stateless overlay restarts empty each call); the
+                # session keeps its own writes and the punt txn's
+                # accounts were dirty-marked by execute()
                 for entry in pend[i:]:
                     entry[3] = [self.funk.rec_query(self.xid, a)
                                 for a in entry[2]]

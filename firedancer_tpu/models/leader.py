@@ -34,12 +34,23 @@ from firedancer_tpu.ops.ref import ed25519_ref as ref
 from firedancer_tpu.runtime.bank import BankCtx, BankStage, default_bank_ctx
 from firedancer_tpu.runtime.benchg import BenchGStage, gen_transfer_pool
 from firedancer_tpu.runtime.dedup import DedupStage
-from firedancer_tpu.runtime.pack_stage import PackStage
+from firedancer_tpu.runtime.pack_stage import NativePackStage, PackStage
 from firedancer_tpu.runtime.poh_stage import PohStage
 from firedancer_tpu.runtime.shred_stage import ShredStage
 from firedancer_tpu.runtime.store import StoreStage
 from firedancer_tpu.runtime.verify import VerifyStage
 from firedancer_tpu.tango import shm
+
+
+def resolve_native_pack(native_pack: bool | None) -> bool:
+    """None = auto: use the fused native pack+dedup lane when the .so is
+    available and FDTPU_NATIVE_PACK != 0 (the same auto-detect posture as
+    the bank stage's native executor lane)."""
+    if native_pack is not None:
+        return bool(native_pack)
+    from firedancer_tpu.pack import scheduler_native as sn
+
+    return sn.available()
 
 
 @dataclass
@@ -48,7 +59,7 @@ class LeaderPipeline:
     links: list
     benchg: BenchGStage
     verifies: list[VerifyStage]
-    dedup: DedupStage
+    dedup: DedupStage | None  # None on the fused native-pack lane
     pack: PackStage
     banks: list[BankStage]
     poh: PohStage
@@ -161,7 +172,9 @@ def build_leader_pipeline(
     verify_comb_slots: int = 0,
     bank_ctx: BankCtx | None = None,
     keep_entries: bool = False,
+    native_pack: bool | None = None,
 ) -> LeaderPipeline:
+    use_native_pack = resolve_native_pack(native_pack)
     uid = f"{os.getpid()}_{int(time.monotonic_ns() % 1_000_000)}"
     links = []
 
@@ -174,7 +187,9 @@ def build_leader_pipeline(
 
     gen_verify = mklink("gv", mtu=1232, n_consumers=n_verify)
     verify_dedup = [mklink(f"vd{i}", mtu=4096) for i in range(n_verify)]
-    dedup_pack = mklink("dp", mtu=4096)
+    # the fused native lane has no dedup stage: pack consumes the verify
+    # links directly and probes the tcache inside its insert crossing
+    dedup_pack = None if use_native_pack else mklink("dp", mtu=4096)
     pack_bank = [mklink(f"pb{b}", mtu=65536) for b in range(n_bank)]
     bank_poh = [mklink(f"bp{b}", mtu=65536) for b in range(n_bank)]
     bank_done = [mklink(f"bd{b}", mtu=64) for b in range(n_bank)]
@@ -203,18 +218,29 @@ def build_leader_pipeline(
         )
         for i in range(n_verify)
     ]
-    dedup = DedupStage(
-        "dedup",
-        ins=[shm.Consumer(l, lazy=32) for l in verify_dedup],
-        outs=[shm.Producer(dedup_pack)],
-    )
-    pack = PackStage(
-        "pack",
-        ins=[shm.Consumer(dedup_pack, lazy=32)]
-        + [shm.Consumer(l, lazy=8) for l in bank_done],
-        outs=[shm.Producer(l) for l in pack_bank],
-        bank_cnt=n_bank,
-    )
+    if use_native_pack:
+        dedup = None
+        pack = NativePackStage(
+            "pack",
+            ins=[shm.Consumer(l, lazy=32) for l in verify_dedup]
+            + [shm.Consumer(l, lazy=8) for l in bank_done],
+            outs=[shm.Producer(l) for l in pack_bank],
+            bank_cnt=n_bank,
+            n_txn_ins=n_verify,
+        )
+    else:
+        dedup = DedupStage(
+            "dedup",
+            ins=[shm.Consumer(l, lazy=32) for l in verify_dedup],
+            outs=[shm.Producer(dedup_pack)],
+        )
+        pack = PackStage(
+            "pack",
+            ins=[shm.Consumer(dedup_pack, lazy=32)]
+            + [shm.Consumer(l, lazy=8) for l in bank_done],
+            outs=[shm.Producer(l) for l in pack_bank],
+            bank_cnt=n_bank,
+        )
     # ONE live bank shared by every bank stage (the Frankendancer shape:
     # all bank tiles commit into the same Agave bank over the FFI)
     if bank_ctx is None:
@@ -257,7 +283,8 @@ def build_leader_pipeline(
         ins=[shm.Consumer(shred_store, lazy=64)],
         verify_sig=None,
     )
-    stages = [benchg, *verifies, dedup, pack, *banks, poh, shred, store]
+    stages = [benchg, *verifies] + ([dedup] if dedup else []) \
+        + [pack, *banks, poh, shred, store]
     return LeaderPipeline(
         stages=stages,
         links=links,
@@ -291,6 +318,7 @@ def build_sharded_leader_pipeline(
     bank_ctx: BankCtx | None = None,
     verify_precomputed: bool = False,
     hashes_per_tick: int = 64,
+    native_pack: bool | None = None,
 ) -> LeaderPipeline:
     """The SHARDED serving pipeline (cooperative form): real leader
     traffic through the device mesh.
@@ -341,12 +369,13 @@ def build_sharded_leader_pipeline(
         links.append(link)
         return link
 
+    use_native_pack = resolve_native_pack(native_pack)
     gen_router = mklink("gv", mtu=1232)
     shard_rings = [
         mklink(f"sv{i}", mtu=1232, d=shard_depth) for i in range(n_shards)
     ]
     verify_dedup = mklink("vd", mtu=4096)
-    dedup_pack = mklink("dp", mtu=4096)
+    dedup_pack = None if use_native_pack else mklink("dp", mtu=4096)
     pack_bank = [mklink(f"pb{b}", mtu=65536) for b in range(n_bank)]
     bank_poh = [mklink(f"bp{b}", mtu=65536) for b in range(n_bank)]
     bank_done = [mklink(f"bd{b}", mtu=64) for b in range(n_bank)]
@@ -375,18 +404,28 @@ def build_sharded_leader_pipeline(
         batch_deadline_s=batch_deadline_s,
         precomputed_ok=verify_precomputed,
     )
-    dedup = DedupStage(
-        "dedup",
-        ins=[shm.Consumer(verify_dedup, lazy=32)],
-        outs=[shm.Producer(dedup_pack)],
-    )
-    pack = PackStage(
-        "pack",
-        ins=[shm.Consumer(dedup_pack, lazy=32)]
-        + [shm.Consumer(l, lazy=8) for l in bank_done],
-        outs=[shm.Producer(l) for l in pack_bank],
-        bank_cnt=n_bank,
-    )
+    if use_native_pack:
+        dedup = None
+        pack = NativePackStage(
+            "pack",
+            ins=[shm.Consumer(verify_dedup, lazy=32)]
+            + [shm.Consumer(l, lazy=8) for l in bank_done],
+            outs=[shm.Producer(l) for l in pack_bank],
+            bank_cnt=n_bank,
+        )
+    else:
+        dedup = DedupStage(
+            "dedup",
+            ins=[shm.Consumer(verify_dedup, lazy=32)],
+            outs=[shm.Producer(dedup_pack)],
+        )
+        pack = PackStage(
+            "pack",
+            ins=[shm.Consumer(dedup_pack, lazy=32)]
+            + [shm.Consumer(l, lazy=8) for l in bank_done],
+            outs=[shm.Producer(l) for l in pack_bank],
+            bank_cnt=n_bank,
+        )
     if bank_ctx is None:
         bank_ctx = default_bank_ctx(slot=slot)
     banks = [
@@ -423,7 +462,8 @@ def build_sharded_leader_pipeline(
         ins=[shm.Consumer(shred_store, lazy=64)],
         verify_sig=None,
     )
-    stages = [benchg, router, verify, dedup, pack, *banks, poh, shred, store]
+    stages = [benchg, router, verify] + ([dedup] if dedup else []) \
+        + [pack, *banks, poh, shred, store]
     return LeaderPipeline(
         stages=stages,
         links=links,

@@ -123,6 +123,27 @@ def build_pack(links, cnc, *, n_bank):
     )
 
 
+def build_pack_native(links, cnc, *, n_bank, txn_links):
+    """The fused native dedup+pack stage: consumes the verify output
+    links directly (no dedup process) and runs native/fd_pack.cpp via
+    one FFI crossing per burst.  The parent only wires this when
+    pack/scheduler_native.available() said so pre-boot (the .so is
+    already built; the child just loads it)."""
+    from firedancer_tpu.runtime.pack_stage import NativePackStage
+
+    return NativePackStage(
+        "pack",
+        ins=[shm.Consumer(links[l], lazy=32) for l in txn_links]
+        + [shm.Consumer(links[f"bd{b}"], lazy=8) for b in range(n_bank)],
+        outs=[shm.Producer(links[f"pb{b}"]) for b in range(n_bank)],
+        cnc=cnc,
+        bank_cnt=n_bank,
+        n_txn_ins=len(txn_links),
+        min_pending=1,
+        mb_deadline_s=0.0,
+    )
+
+
 def build_bank(links, cnc, *, bank_idx, slot=1):
     # the bank process OWNS the live bank (its own funk + SlotExecution,
     # default_bank_ctx): the process topology therefore runs n_bank=1 —
@@ -198,11 +219,18 @@ def build_leader_topology(
     leader_seed: bytes = b"leader",
     slot: int = 1,
     sandbox: dict | None = None,
+    native_pack: bool | None = None,
 ) -> ft.Topology:
     """sandbox: utils/sandbox.enter kwargs applied to EVERY stage child
     (the per-tile jail; fd_topo_run's seccomp step).  The default policy
     shape: {"rlimits": {"nofile": 512}} + the spawn/exec/priv deny list,
-    with thread-creating clones allowed for XLA."""
+    with thread-creating clones allowed for XLA.
+
+    native_pack: None = auto — when pack/scheduler_native.available()
+    (checked HERE in the parent, which also builds the .so so children
+    just load it), the dedup process disappears and the pack process
+    runs the fused native dedup+pack lane over the verify link."""
+    from firedancer_tpu.models.leader import resolve_native_pack
     from firedancer_tpu.ops.ref import ed25519_ref as ref
 
     # per-kind metric schemas: launch() sizes each stage's shm metrics
@@ -223,10 +251,12 @@ def build_leader_topology(
             "(models/leader.py) runs any bank count over the shared ctx"
         )
 
+    use_native_pack = resolve_native_pack(native_pack)
     topo = ft.Topology()
     topo.link("gv", depth=1024, mtu=1232)
     topo.link("vd", depth=1024, mtu=4096)
-    topo.link("dp", depth=1024, mtu=4096)
+    if not use_native_pack:
+        topo.link("dp", depth=1024, mtu=4096)
     for b in range(n_bank):
         topo.link(f"pb{b}", depth=256, mtu=65536)
         topo.link(f"bp{b}", depth=256, mtu=65536)
@@ -248,12 +278,19 @@ def build_leader_topology(
                sandbox=sb, outs=["gv"])
     topo.stage("verify0", build_verify, batch=batch, sandbox=sb,
                ins=["gv"], outs=["vd"], schema=VerifyStage.metrics_schema())
-    topo.stage("dedup", build_dedup, sandbox=sb, ins=["vd"], outs=["dp"],
-               schema=DedupStage.metrics_schema())
-    topo.stage("pack", build_pack, n_bank=n_bank, sandbox=sb,
-               ins=["dp"] + [f"bd{b}" for b in range(n_bank)],
-               outs=[f"pb{b}" for b in range(n_bank)],
-               schema=PackStage.metrics_schema())
+    if use_native_pack:
+        topo.stage("pack", build_pack_native, n_bank=n_bank,
+                   txn_links=["vd"], sandbox=sb,
+                   ins=["vd"] + [f"bd{b}" for b in range(n_bank)],
+                   outs=[f"pb{b}" for b in range(n_bank)],
+                   schema=PackStage.metrics_schema())
+    else:
+        topo.stage("dedup", build_dedup, sandbox=sb, ins=["vd"], outs=["dp"],
+                   schema=DedupStage.metrics_schema())
+        topo.stage("pack", build_pack, n_bank=n_bank, sandbox=sb,
+                   ins=["dp"] + [f"bd{b}" for b in range(n_bank)],
+                   outs=[f"pb{b}" for b in range(n_bank)],
+                   schema=PackStage.metrics_schema())
     for b in range(n_bank):
         topo.stage(f"bank{b}", build_bank, bank_idx=b, slot=slot, sandbox=sb,
                    ins=[f"pb{b}"], outs=[f"bp{b}", f"bd{b}"],
@@ -279,6 +316,7 @@ def build_sharded_leader_topology(
     sandbox: dict | None = None,
     verify_precomputed: bool = False,
     shard_depth: int = 512,
+    native_pack: bool | None = None,
 ) -> ft.Topology:
     """The SHARDED serving topology (process form): ingress round-robins
     through an explicit shard router into per-shard rings, and one verify
@@ -296,6 +334,7 @@ def build_sharded_leader_topology(
     (models/leader.build_sharded_leader_pipeline); this topology is its
     process-isolation counterpart where each shard is a crash domain.
     """
+    from firedancer_tpu.models.leader import resolve_native_pack
     from firedancer_tpu.ops.ref import ed25519_ref as ref
     from firedancer_tpu.parallel.router import ShardRouterStage
     from firedancer_tpu.runtime.bank import BankStage
@@ -303,13 +342,15 @@ def build_sharded_leader_topology(
     from firedancer_tpu.runtime.pack_stage import PackStage
     from firedancer_tpu.runtime.verify import VerifyStage
 
+    use_native_pack = resolve_native_pack(native_pack)
     n_bank = 1  # see build_leader_topology: one bank until funk is shared
     topo = ft.Topology()
     topo.link("gv", depth=1024, mtu=1232)
     for i in range(n_shards):
         topo.link(f"sv{i}", depth=shard_depth, mtu=1232)  # pow2 (FD104)
         topo.link(f"vd{i}", depth=shard_depth, mtu=4096)
-    topo.link("dp", depth=1024, mtu=4096)
+    if not use_native_pack:
+        topo.link("dp", depth=1024, mtu=4096)
     for b in range(n_bank):
         topo.link(f"pb{b}", depth=256, mtu=65536)
         topo.link(f"bp{b}", depth=256, mtu=65536)
@@ -333,13 +374,22 @@ def build_sharded_leader_topology(
                    batch=batch, precomputed=verify_precomputed, sandbox=sb,
                    ins=[f"sv{i}"], outs=[f"vd{i}"],
                    schema=VerifyStage.metrics_schema())
-    topo.stage("dedup", build_dedup_sharded, n_shards=n_shards, sandbox=sb,
-               ins=[f"vd{i}" for i in range(n_shards)], outs=["dp"],
-               schema=DedupStage.metrics_schema())
-    topo.stage("pack", build_pack, n_bank=n_bank, sandbox=sb,
-               ins=["dp"] + [f"bd{b}" for b in range(n_bank)],
-               outs=[f"pb{b}" for b in range(n_bank)],
-               schema=PackStage.metrics_schema())
+    if use_native_pack:
+        vd_links = [f"vd{i}" for i in range(n_shards)]
+        topo.stage("pack", build_pack_native, n_bank=n_bank,
+                   txn_links=vd_links, sandbox=sb,
+                   ins=vd_links + [f"bd{b}" for b in range(n_bank)],
+                   outs=[f"pb{b}" for b in range(n_bank)],
+                   schema=PackStage.metrics_schema())
+    else:
+        topo.stage("dedup", build_dedup_sharded, n_shards=n_shards,
+                   sandbox=sb,
+                   ins=[f"vd{i}" for i in range(n_shards)], outs=["dp"],
+                   schema=DedupStage.metrics_schema())
+        topo.stage("pack", build_pack, n_bank=n_bank, sandbox=sb,
+                   ins=["dp"] + [f"bd{b}" for b in range(n_bank)],
+                   outs=[f"pb{b}" for b in range(n_bank)],
+                   schema=PackStage.metrics_schema())
     for b in range(n_bank):
         topo.stage(f"bank{b}", build_bank, bank_idx=b, slot=slot, sandbox=sb,
                    ins=[f"pb{b}"], outs=[f"bp{b}", f"bd{b}"],

@@ -511,7 +511,6 @@ from firedancer_tpu.runtime.verify import (  # noqa: E402
     MCACHE_COL_TSORIG,
     VerifyStage,
     _Acc,
-    _parse_pair,
     _Pending as _VPending,
     sig_tag,
 )
@@ -572,29 +571,20 @@ class ShardedVerifyStage(VerifyStage):
         return True  # the router already sharded; never re-filter
 
     def after_frag(self, in_idx: int, meta, payload: bytes) -> None:
-        t, packed = _parse_pair(payload)
-        if t is None:
-            self.metrics.inc("parse_fail")
+        # the intake rules (parse incl. the packed-offset fast path,
+        # dedup tag, length + fit guards) are VerifyStage._intake — one
+        # implementation across both verify lanes
+        got = self._intake(payload)
+        if got is None:
             return
-        sigs = t.signatures(payload)
-        if self.tcache.insert(sig_tag(sigs[0])):
-            self.metrics.inc("dedup_dup")
-            return
-        msg = t.message(payload)
-        if len(msg) > self.max_msg_len:
-            self.metrics.inc("msg_too_long")
-            return
-        if t.signature_cnt > self.batch:
-            self.metrics.inc("too_many_sigs")
-            return
+        sigs, msg, signers, t, packed = got
         acc = self._shards[in_idx]
-        if acc.elems and len(acc.elems) + t.signature_cnt > self.batch:
+        if acc.elems and len(acc.elems) + len(sigs) > self.batch:
             # this shard's lane range is full: close the WHOLE step (the
             # fixed shape ships every shard's partial fill, masked)
             self._close_batch()
             acc = self._shards[in_idx]
         start = len(acc.elems)
-        signers = t.signers(payload)
         for s, pk in zip(sigs, signers):
             acc.elems.append((msg, s, pk))
         acc.ranges.append((start, len(acc.elems)))

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import ctypes
 import os
+import threading
 
 from firedancer_tpu.utils.nativebuild import NativeUnavailable, build_so
 
@@ -26,6 +27,13 @@ _SO = os.path.join(os.path.dirname(_SRC), "fd_txn_parse.so")
 
 _lib = None
 _OUT_CAP = 4096
+# reusable PER-THREAD output buffer: this binding runs once per ingress
+# packet (the verify hot path), and a fresh create_string_buffer per
+# call was ~20% of the crossing's cost.  Thread-local because ctypes
+# RELEASES the GIL for the foreign call — a shared buffer could be
+# written by two threads' fd_txn_parse concurrently (the repo does run
+# helper threads: rpc, http); the bytes are copied out before return.
+_tls = threading.local()
 
 
 def _load():
@@ -46,7 +54,9 @@ def txn_parse_packed(payload: bytes) -> bytes | None:
     """Native parse -> packed descriptor bytes (txn_pack layout), or None
     on malformed input."""
     lib = _load()
-    out = ctypes.create_string_buffer(_OUT_CAP)
+    out = getattr(_tls, "out", None)
+    if out is None:
+        out = _tls.out = ctypes.create_string_buffer(_OUT_CAP)
     n = lib.fd_txn_parse(payload, len(payload), out, _OUT_CAP)
     if n < 0:
         return None

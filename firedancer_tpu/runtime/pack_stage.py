@@ -9,16 +9,31 @@ always leader (the became_leader poh->pack message arrives when a poh stage
 precedes pack in a full validator; the synthetic pipeline produces blocks
 continuously).
 
-Inputs:  ins[0] = dedup->pack txns; ins[1+b] = bank b's done feedback.
-Outputs: outs[b] = pack->bank b microblock link.
+Two lanes, one policy:
+
+  - `PackStage` — the portable Python lane over pack/scheduler.Pack,
+    fed by the dedup stage (runtime/dedup.py).
+  - `NativePackStage` — the C++ fast lane (native/fd_pack.cpp behind
+    pack/scheduler_native.py) with dedup FUSED into the same crossing:
+    it consumes the verify output directly, probes the fd_tcache.so
+    table inside `fd_pack_insert_burst`, and gets publish-ready
+    microblock frames back from `fd_pack_schedule` — one FFI call per
+    drained burst / per microblock (FD207), zero per-txn Python work.
+    Byte-identical frames vs the Python lane (tests/test_pack_native).
+
+Inputs:  ins[0..n_txn_ins) = txn links; ins[n_txn_ins+b] = bank b's done
+feedback.  Outputs: outs[b] = pack->bank b microblock link.
 
 Microblock frame: u32 bank_seq | u16 txn_cnt | (u16 len || verified-frag)*
 where each verified-frag is payload||packed-desc||u16 (runtime/verify.py) —
 banks never reparse.
 
-Batching policy: a microblock is scheduled for an idle bank when at least
-`min_pending` txns are waiting or the oldest has waited `mb_deadline_s`
-(the same full-or-deadline shape as the verify stage's device batches).
+Batching policy (shared by both lanes): a microblock is scheduled for an
+idle bank when at least `min_pending` txns are waiting, the oldest has
+waited `mb_deadline_s`, or — the ADAPTIVE close — the txn inputs ran dry
+this iteration (backlog exhausted: waiting for min_pending under light
+load would only add latency, the 37/149 ms p50 batch-accumulation hops
+ROADMAP item #4 measured).
 """
 
 from __future__ import annotations
@@ -40,6 +55,10 @@ class PackStage(Stage):
             .counter("txn_in", "verified txns accepted into the pool")
             .counter("txn_dropped", "txns the pool rejected (full/limits)")
             .counter("bad_frag", "malformed verified-frags dropped")
+            .counter("dedup_dup",
+                     "duplicate txns dropped by the fused dedup probe"
+                     " (native lane; the python lane's dedup stage counts"
+                     " its own)")
             .counter("microblocks", "microblocks scheduled to banks")
             .counter("microblock_done", "bank completion acks consumed")
             .counter("txn_scheduled", "txns scheduled into microblocks")
@@ -61,31 +80,41 @@ class PackStage(Stage):
         max_txn_per_microblock: int = 31,
         min_pending: int = 8,
         mb_deadline_s: float = 0.002,
+        adaptive: bool = True,
+        n_txn_ins: int = 1,
         **kwargs,
     ):
         super().__init__(*args, **kwargs)
         if len(self.outs) != bank_cnt:
             raise ValueError("need one output link per bank")
         self.bank_cnt = bank_cnt
-        self.pack = Pack(
+        self.n_txn_ins = n_txn_ins
+        self.pack = self._make_pack(
             bank_cnt=bank_cnt,
             depth=depth,
             max_txn_per_microblock=max_txn_per_microblock,
         )
         self.min_pending = min_pending
         self.mb_deadline_s = mb_deadline_s
+        # adaptive close: schedule as soon as the txn inputs run dry —
+        # accumulating toward min_pending only pays when a backlog exists
+        self.adaptive = adaptive
         self.force_flush = False  # end-of-run: drain regardless of policy
         self._bank_busy = [False] * bank_cnt
         self._mb_seq = 0
         self._first_pending_at: float | None = None
+        self._input_idle = False  # stamped in before_credit (has_pending)
         # first-sig -> tsorig for end-to-end latency attribution; bounded:
         # entries for txns evicted from the pool would otherwise leak
         self._tsorig_by_sig: dict[bytes, int] = {}
 
+    def _make_pack(self, **kw):
+        return Pack(**kw)
+
     # -- callbacks ----------------------------------------------------------
 
     def after_frag(self, in_idx: int, meta, payload: bytes) -> None:
-        if in_idx == 0:
+        if in_idx < self.n_txn_ins:
             try:
                 p, desc = decode_verified(payload)
             except ValueError:
@@ -101,7 +130,7 @@ class PackStage(Stage):
             else:
                 self.metrics.inc("txn_dropped")
         else:
-            bank = in_idx - 1
+            bank = in_idx - self.n_txn_ins
             self.pack.microblock_done(bank)
             self._bank_busy[bank] = False
             self.metrics.inc("microblock_done")
@@ -113,10 +142,18 @@ class PackStage(Stage):
         # any bank link is backpressured): before_credit runs
         # unconditionally every iteration, so the stamp lags a txn's
         # arrival by at most one iteration even under backpressure
-        if self._first_pending_at is None and self.pack.pending_cnt():
+        self._flush_intake()
+        if self.adaptive:
+            # adaptive close probe: one mcache row read per txn input —
+            # no syscalls, stamped here for the same FD202 reason
+            self._input_idle = not any(
+                self.ins[i].has_pending() for i in range(self.n_txn_ins)
+            )
+        if self._first_pending_at is None and self._pending_cnt():
             self._first_pending_at = time.monotonic()
 
     def after_credit(self) -> None:
+        self._flush_intake()
         if not self._ready_to_schedule():
             return
         for bank in range(self.bank_cnt):
@@ -124,27 +161,43 @@ class PackStage(Stage):
                 continue
             if self.outs[bank].cr_avail <= 0:
                 continue
-            chosen = self.pack.schedule_next_microblock(bank)
-            if not chosen:
-                chosen = self.pack.schedule_next_microblock(bank, votes=True)
-            if not chosen:
+            if not self._try_emit(bank):
                 break  # nothing schedulable right now (conflicts/empty)
-            self._emit(bank, chosen)
-        if self.pack.pending_cnt() == 0:
+        if self._pending_cnt() == 0:
             self._first_pending_at = None
 
     # -- internals ----------------------------------------------------------
 
+    def _flush_intake(self) -> None:
+        """Native-lane hook: push the accumulated frag burst through the
+        single FFI crossing.  The Python lane inserts per frag already."""
+
+    def _pending_cnt(self) -> int:
+        return self.pack.pending_cnt()
+
     def _ready_to_schedule(self) -> bool:
-        n = self.pack.pending_cnt()
+        n = self._pending_cnt()
         if n == 0:
             return False
         if self.force_flush or n >= self.min_pending:
+            return True
+        if self.adaptive and self._input_idle:
+            # inputs ran dry: nothing else is coming this instant, so
+            # waiting for min_pending would trade pure latency for nothing
             return True
         return (
             self._first_pending_at is not None
             and time.monotonic() - self._first_pending_at >= self.mb_deadline_s
         )
+
+    def _try_emit(self, bank: int) -> bool:
+        chosen = self.pack.schedule_next_microblock(bank)
+        if not chosen:
+            chosen = self.pack.schedule_next_microblock(bank, votes=True)
+        if not chosen:
+            return False
+        self._emit(bank, chosen)
+        return True
 
     def _emit(self, bank: int, chosen) -> None:
         from .verify import encode_verified
@@ -162,17 +215,110 @@ class PackStage(Stage):
             ts = self._tsorig_by_sig.pop(o.first_sig(), 0)
             # the microblock inherits its OLDEST txn's origin stamp
             tsorig = min(tsorig, ts) if tsorig and ts else (tsorig or ts)
+        self._publish_mb(bank, bytes(frame), len(chosen), cu, tsorig)
+
+    def _publish_mb(self, bank: int, frame: bytes, txn_cnt: int, cu: int,
+                    tsorig: int) -> None:
         self._mb_seq += 1
-        self.publish(bank, bytes(frame), sig=self._mb_seq, tsorig=tsorig)
+        self.publish(bank, frame, sig=self._mb_seq, tsorig=tsorig)
         self._bank_busy[bank] = True
         self.metrics.inc("microblocks")
-        self.metrics.inc("txn_scheduled", len(chosen))
+        self.metrics.inc("txn_scheduled", txn_cnt)
         self.metrics.inc("cu_consumed", cu)
-        self.metrics.observe("mb_fill", len(chosen))
-        self.trace(fm.EV_MICROBLOCK, len(chosen))
+        self.metrics.observe("mb_fill", txn_cnt)
+        self.trace(fm.EV_MICROBLOCK, txn_cnt)
 
     def flush(self) -> None:
         """Force remaining txns out (end of run); banks must keep draining
         their done feedback for this to terminate."""
         self.force_flush = True
         self.after_credit()
+
+
+class NativePackStage(PackStage):
+    """The fused native lane: dedup + pack in one C++ structure.
+
+    Consumes the verify stage's output links DIRECTLY (no dedup stage in
+    the topology): `after_frag` only appends (frag, tag, tsorig) to a
+    burst list, `before_credit`/`after_credit` push the burst through one
+    `fd_pack_insert_burst` crossing that probes the shared fd_tcache.so
+    table natively — duplicates never surface into Python — and
+    `fd_pack_schedule` hands back a publish-ready frame, byte-identical
+    to the Python lane's.  Construct only when pack/scheduler_native
+    .available(); callers fall back to DedupStage + PackStage otherwise.
+    """
+
+    def __init__(self, *args, tcache_depth: int | None = None, **kwargs):
+        from firedancer_tpu.runtime.dedup import DEDUP_TCACHE_DEPTH
+
+        self._tcache_depth = tcache_depth or DEDUP_TCACHE_DEPTH
+        self._burst: list = []
+        super().__init__(*args, **kwargs)
+        # intake is an append per frag (~no work): drain deeper bursts
+        # per sweep so the stage-loop overhead (credits, sibling polls)
+        # and the per-burst FFI crossing amortize over 4x the frags
+        self.burst = 64
+
+    def _make_pack(self, **kw):
+        from firedancer_tpu.pack import scheduler_native as sn
+        from firedancer_tpu.tango.tcache_native import NativeTCache
+
+        pack = sn.NativePack(**kw)
+        pack.attach_tcache(NativeTCache(self._tcache_depth))
+        return pack
+
+    # -- callbacks ----------------------------------------------------------
+
+    def after_frag(self, in_idx: int, meta, payload: bytes) -> None:
+        if in_idx < self.n_txn_ins:
+            # append-only: the FFI crossing happens at burst granularity
+            # in before_credit/after_credit (FD207)
+            self._burst.append(
+                (payload, int(meta[MCache.COL_SIG]),
+                 int(meta[MCache.COL_TSORIG]))
+            )
+        else:
+            bank = in_idx - self.n_txn_ins
+            self.pack.microblock_done(bank)
+            self._bank_busy[bank] = False
+            self.metrics.inc("microblock_done")
+
+    def _flush_intake(self) -> None:
+        if not self._burst:
+            return
+        from firedancer_tpu.pack import scheduler_native as sn
+
+        codes = self.pack.insert_burst(self._burst)
+        self._burst.clear()
+        m = self.metrics
+        n_ok = codes.count(sn.INS_OK)
+        if n_ok:
+            m.inc("txn_in", n_ok)
+        n_dup = codes.count(sn.INS_DUP)
+        if n_dup:
+            m.inc("dedup_dup", n_dup)
+        n_bad = codes.count(sn.INS_BAD_FRAG)
+        if n_bad:
+            m.inc("bad_frag", n_bad)
+        n_drop = len(codes) - n_ok - n_dup - n_bad
+        if n_drop:
+            m.inc("txn_dropped", n_drop)
+
+    def _pending_cnt(self) -> int:
+        # the pool only changes through insert_burst/schedule, and every
+        # crossing reports the post-op size: the policy checks that run
+        # each loop iteration cost zero FFI
+        return self.pack.last_pending + len(self._burst)
+
+    def _try_emit(self, bank: int) -> bool:
+        # regular-then-votes fallback inside ONE crossing (votes=2)
+        res = self.pack.schedule(bank, mb_seq=self._mb_seq, any_pool=True)
+        if res is None:
+            return False
+        frame, txn_cnt, cu, tsorig = res
+        self._publish_mb(bank, frame, txn_cnt, cu, tsorig)
+        return True
+
+    def flush(self) -> None:
+        self._flush_intake()
+        super().flush()

@@ -1,0 +1,50 @@
+"""Per-hop latency budgets: the metrics plane as a RATCHET, not a dashboard.
+
+ROADMAP item #4: the PR-5 observability plane records per-hop
+`frag_latency_ns` histograms (now - tsorig per consumed frag, tsorig
+stamped once at the origin stage), so regressions in hop latency are
+measurable — this module declares the budgets and the check, and
+tests/test_latency_budget.py enforces them in tier-1 after driving the
+real pipeline.
+
+Budgets are p50s over the shm metric registries, deliberately loose
+(~5-10x the measured medians on the throttled 1-core CI class box) so
+they catch REGRESSIONS — a stage reverting to per-frag batching, an
+accumulation deadline wedged open, a lane silently falling back — not
+scheduler noise.  Ratchet them down as the pipeline gets faster.
+"""
+
+from __future__ import annotations
+
+# hop (stage name in the flagship cooperative pipeline) -> p50 budget, ns.
+# "store" observes the whole ingress->...->store path (its tsorig is
+# benchg's), so its row IS the e2e budget.
+HOP_P50_BUDGET_NS: dict[str, int] = {
+    "verify0": 200_000_000,   # ingress -> verify (batch close included)
+    "dedup": 300_000_000,     # python lane only (fused lane has no hop)
+    "pack": 400_000_000,      # ingress -> pack intake (dedup hop included)
+    "bank0": 600_000_000,     # ingress -> commit (microblock close incl.)
+    "store": 1_000_000_000,   # end to end
+}
+
+
+def check_hop_budgets(hists: dict[str, dict]) -> list[str]:
+    """hists: stage name -> frag_latency_ns histogram dict (the
+    MetricsRegistry.hist / Metrics.hist shape).  Returns human-readable
+    violations; empty = within budget.  Stages without a budget row or
+    without observations are skipped (a hop that consumed nothing has no
+    p50; the caller asserts traffic separately)."""
+    from firedancer_tpu.utils.metrics import hist_quantile
+
+    out = []
+    for name, budget in HOP_P50_BUDGET_NS.items():
+        h = hists.get(name)
+        if not h or not h.get("count"):
+            continue
+        p50 = hist_quantile(h, 0.5)
+        if p50 > budget:
+            out.append(
+                f"{name}: p50 {p50 / 1e6:.1f}ms exceeds budget "
+                f"{budget / 1e6:.1f}ms"
+            )
+    return out

@@ -63,18 +63,44 @@ except Exception:  # pragma: no cover - toolchain-less environment
 
 
 def _parse_pair(payload: bytes):
-    """-> (Txn, packed-descriptor bytes | None); (None, None) on reject.
-    The native parser emits the packed trailer directly, so _emit never
-    re-serializes the descriptor (zero-copy through to the bank lane)."""
+    """-> (Txn | None, packed-descriptor bytes | None); (None, None) on
+    reject.  The native parser emits the packed trailer directly, and
+    the stage reads the few fields it needs (signatures, message,
+    signers) straight from the packed offsets — no Txn object is ever
+    built on the native-parse path (the txn_unpack construction cost ~7
+    us/frag of the verify host path), and _emit never re-serializes the
+    descriptor (zero-copy through to pack and the bank lane)."""
     if _txn_packed is not None:
         packed = _txn_packed(payload)
         if packed is None:
             return None, None
-        desc, end = ft.txn_unpack(packed)
-        if end != len(packed):
+        # structural sanity without unpacking: the trailer must be
+        # exactly the declared fixed-layout length (instr/lut counts at
+        # bytes 16/13; the layout has ONE owner — protocol/txn.py)
+        if len(packed) != ft.txn_packed_sz(packed[16], packed[13]):
             return None, None
-        return desc, packed
-    return ft.txn_parse(payload), None
+        return None, packed
+    t = ft.txn_parse(payload)
+    return t, None
+
+
+def _packed_fields(payload: bytes, packed: bytes):
+    """(signatures, message, signers) read straight off the packed
+    descriptor — the zero-object fast path for the per-frag loop."""
+    sig_cnt = packed[1]
+    sig_off = packed[2] | (packed[3] << 8)
+    msg_off = packed[4] | (packed[5] << 8)
+    acct_off = packed[9] | (packed[10] << 8)
+    sigs = [payload[sig_off + 64 * i : sig_off + 64 * (i + 1)]
+            for i in range(sig_cnt)]
+    signers = [payload[acct_off + 32 * i : acct_off + 32 * (i + 1)]
+               for i in range(sig_cnt)]
+    return sigs, payload[msg_off:], signers
+
+
+def _packed_first_sig(payload: bytes, packed: bytes) -> bytes:
+    sig_off = packed[2] | (packed[3] << 8)
+    return payload[sig_off : sig_off + 64]
 
 MCACHE_COL_TSORIG = MCache.COL_TSORIG
 
@@ -202,30 +228,44 @@ class VerifyStage(Stage):
     def before_frag(self, in_idx: int, seq: int, sig: int) -> bool:
         return (seq % self.shard_cnt) == self.shard_idx
 
-    def after_frag(self, in_idx: int, meta, payload: bytes) -> None:
+    def _intake(self, payload: bytes):
+        """Parse + guard one ingress frag; (sigs, msg, signers, t,
+        packed) or None after counting the drop.  The ONE implementation
+        of the frag-intake rules — the sharded serving stage
+        (parallel/serve.ShardedVerifyStage) reuses it verbatim, so the
+        two verify lanes can never silently diverge on a guard."""
         t, packed = _parse_pair(payload)
-        if t is None:
+        if packed is not None:
+            sigs, msg, signers = _packed_fields(payload, packed)
+        elif t is not None:
+            sigs = t.signatures(payload)
+            msg = t.message(payload)
+            signers = t.signers(payload)
+        else:
             self.metrics.inc("parse_fail")
-            return
-        sigs = t.signatures(payload)
+            return None
         if self.tcache.insert(sig_tag(sigs[0])):
             self.metrics.inc("dedup_dup")
-            return
-        msg = t.message(payload)
+            return None
         if len(msg) > self.max_msg_len:
             self.metrics.inc("msg_too_long")
-            return
+            return None
         # a txn's elements must land in ONE device batch (the txn-level
-        # pass-iff-all-pass rule is evaluated per batch): drop txns that can
-        # never fit, and close the current batch first if this txn would
-        # straddle the fixed batch shape.
-        if t.signature_cnt > self.batch:
+        # pass-iff-all-pass rule is evaluated per batch): drop txns that
+        # can never fit
+        if len(sigs) > self.batch:
             self.metrics.inc("too_many_sigs")
+            return None
+        return sigs, msg, signers, t, packed
+
+    def after_frag(self, in_idx: int, meta, payload: bytes) -> None:
+        got = self._intake(payload)
+        if got is None:
             return
-        signers = t.signers(payload)
+        sigs, msg, signers, t, packed = got
         slots = self._signer_slots(signers)
         acc = self._comb if slots is not None else self._gen
-        if acc.elems and len(acc.elems) + t.signature_cnt > self.batch:
+        if acc.elems and len(acc.elems) + len(sigs) > self.batch:
             self._close_batch(acc)
         start = len(acc.elems)
         for i, (s, pk) in enumerate(zip(sigs, signers)):
@@ -452,10 +492,14 @@ class VerifyStage(Stage):
             mask = self._result_mask(head)
             self._inflight.pop(0)
             self.trace(fm.EV_BATCH_COMPLETE, head.n_elems)
+            # honest traffic overwhelmingly passes whole batches: one
+            # all-reduce decides the common case instead of a numpy
+            # slice + reduction per txn (~1.5us/txn of the host path)
+            all_ok = bool(mask[: head.n_elems].all())
             for payload, desc, (a, b), tsorig in zip(
                 head.payloads, head.descs, head.elem_ranges, head.tsorigs
             ):
-                if bool(mask[a:b].all()):
+                if all_ok or bool(mask[a:b].all()):
                     self._emit(payload, desc, tsorig)
                 else:
                     self.metrics.inc("verify_fail")
@@ -470,7 +514,8 @@ class VerifyStage(Stage):
         if self.outs:
             # first signature's tag rides in the frag sig for cheap dedup
             self.publish(
-                0, out, sig=sig_tag(desc.signatures(payload)[0]), tsorig=tsorig
+                0, out, sig=sig_tag(_packed_first_sig(payload, packed)),
+                tsorig=tsorig,
             )
         self.metrics.inc("txn_verified")
 

@@ -226,6 +226,12 @@ class Consumer:
             self.publish_progress()
         return meta, payload
 
+    def has_pending(self) -> bool:
+        """Non-destructive: is a frag ready at this consumer's cursor?
+        (One mcache row read; the adaptive batch-close policy probes
+        this per iteration to distinguish backlog from idle ingress.)"""
+        return self.link.mcache.query(self.seq)[0] >= 0
+
     def publish_progress(self) -> None:
         self.fseq.publish(self.seq)
         self._since_publish = 0

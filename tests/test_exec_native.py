@@ -430,6 +430,88 @@ def test_env_switch_disables():
         os.environ.pop(exec_native.ENV_SWITCH, None)
 
 
+def test_session_gate_duplicates_stay_native():
+    """ISSUE 9 bank-lane residual: with the session armed, a duplicate
+    signature in a LATER microblock is gated by the C++ side in-line
+    (TXN_ERR_ALREADY_PROCESSED) — it still counts as native work, never
+    re-enters the Python lane, and matches the Python lane's verdict."""
+    from firedancer_tpu.flamenco.runtime import TXN_ERR_ALREADY_PROCESSED
+
+    rng = random.Random(55)
+    p = _pk("payerA")
+    t1 = _txn(rng, [p], [_pk("sgd1"), SYSTEM_PROGRAM],
+              [ft.InstrSpec(2, bytes([0, 1]), _transfer_data(7))],
+              ro_unsigned=1)
+    t2 = _txn(rng, [p], [_pk("sgd2"), SYSTEM_PROGRAM],
+              [ft.InstrSpec(2, bytes([0, 1]), _transfer_data(8))],
+              ro_unsigned=1)
+    funk, sc = _world()
+    sx = SlotExecution(funk, slot=SLOT, status_cache=sc,
+                       slot_hashes=SLOT_HASHES)
+    r1 = sx.execute_batch([(t1, ft.txn_parse(t1), None)])
+    r2 = sx.execute_batch([(t2, ft.txn_parse(t2), None),
+                           (t1, ft.txn_parse(t1), None)])
+    assert [r.status for r in r1] == [0]
+    assert [r.status for r in r2] == [0, TXN_ERR_ALREADY_PROCESSED]
+    assert r2[1].fee == 0
+    # all four records were native-lane work: the duplicate was gated by
+    # the session, not flushed back to Python
+    assert sx.native_done_cnt == 3
+    assert sx.native_punt_cnt == 0
+    assert sx._native_session is not None
+
+
+def test_session_values_survive_python_lane_interleave():
+    """The session's account-value overlay must resync after Python-lane
+    writes dirty it: native transfer -> BPF-ish fallback touching the
+    same payer -> native transfer again.  Balances must equal the pure
+    Python lane's (a stale overlay would double-spend or under-debit)."""
+    rng = random.Random(66)
+    p = _pk("payerA")
+
+    def t_native(i, lam):
+        return _txn(rng, [p], [_pk("svi%d" % i), SYSTEM_PROGRAM],
+                    [ft.InstrSpec(2, bytes([0, 1]), _transfer_data(lam))],
+                    ro_unsigned=1)
+
+    # a nonce-family txn is Python-lane by classifier, touches the payer
+    py_lane = _txn(rng, [p], [_pk("svin"), SYSTEM_PROGRAM],
+                   [ft.InstrSpec(2, bytes([1, 0]),
+                                 (6).to_bytes(4, "little") + _pk("auth"))],
+                   ro_unsigned=1)
+    txns = [t_native(0, 100), py_lane, t_native(1, 200), py_lane,
+            t_native(2, 400)]
+    py = _run(txns, native=False, batch=2)  # crosses microblock bounds
+    nat = _run(txns, native=True, batch=2)
+    assert py[0] == nat[0]
+    assert py[1] == nat[1], "bank hash diverged (stale session overlay?)"
+    assert py[4] == nat[4]
+
+
+def test_session_stale_blockhash_punts_to_python_gate():
+    """An unknown/stale blockhash mid-batch: the session gate PUNTS (it
+    cannot rule out a durable nonce), and the Python gate settles it
+    with the same TXN_ERR_BLOCKHASH the pure lane produces."""
+    from firedancer_tpu.flamenco.runtime import TXN_ERR_BLOCKHASH
+
+    rng = random.Random(77)
+    p = _pk("payerA")
+    good = _txn(rng, [p], [_pk("sbp1"), SYSTEM_PROGRAM],
+                [ft.InstrSpec(2, bytes([0, 1]), _transfer_data(5))],
+                ro_unsigned=1)
+    stale = _txn(rng, [p], [_pk("sbp2"), SYSTEM_PROGRAM],
+                 [ft.InstrSpec(2, bytes([0, 1]), _transfer_data(5))],
+                 ro_unsigned=1, blockhash=STALE_BH)
+    tail = _txn(rng, [p], [_pk("sbp3"), SYSTEM_PROGRAM],
+                [ft.InstrSpec(2, bytes([0, 1]), _transfer_data(5))],
+                ro_unsigned=1)
+    py = _run([good, stale, tail], native=False, batch=3)
+    nat = _run([good, stale, tail], native=True, batch=3)
+    assert py[0] == nat[0]
+    assert nat[0][1] == (TXN_ERR_BLOCKHASH, 0)
+    assert py[4] == nat[4]
+
+
 def test_punt_mid_batch_resumes_in_order():
     """A punt (vote init) between native txns: order, statuses and state
     all match the pure-Python lane."""

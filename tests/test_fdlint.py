@@ -640,3 +640,55 @@ def test_fd210_registered_and_clean_on_repo():
                             "firedancer_tpu", pkg)
         findings = ast_rules.lint_path(root)
         assert [f for f in findings if f.rule == "FD210"] == []
+
+
+# -- FD211: per-frag allocation/sort in pack hot paths ------------------------
+
+
+_PACK_SORT_SRC = '''
+import bisect
+
+class PackishStage:
+    def after_frag(self, in_idx, meta, payload):
+        self.pool.sort()                          # FD211: per-frag sort
+        k = sorted(self.pool)                     # FD211: per-frag sort
+        bisect.insort(self.pool, payload)         # FD211: per-frag insort
+        w = {a for a in self.addrs}               # FD211: comprehension
+        self.burst.append((payload, 1))           # ok: append-only handoff
+
+    def after_credit(self):
+        # burst granularity: the sanctioned place for pool work
+        return sorted(self.pool)
+'''
+
+
+def test_fd211_flags_sort_and_comprehension_in_pack_frag():
+    findings = ast_rules.lint_source(
+        _PACK_SORT_SRC, "firedancer_tpu/runtime/pack_stage.py")
+    hits = [f for f in findings if f.rule == "FD211"]
+    assert len(hits) == 4
+    ac_line = _PACK_SORT_SRC[: _PACK_SORT_SRC.index("after_credit")].count(
+        "\n") + 1
+    assert all(f.line < ac_line for f in hits)
+
+
+def test_fd211_scoped_to_pack_modules():
+    # identical source outside a pack module is not FD211's business
+    findings = ast_rules.lint_source(
+        _PACK_SORT_SRC, "firedancer_tpu/runtime/verify.py")
+    assert [f for f in findings if f.rule == "FD211"] == []
+    # the pack package itself is in scope
+    findings = ast_rules.lint_source(
+        _PACK_SORT_SRC, "firedancer_tpu/pack/scheduler.py")
+    assert len([f for f in findings if f.rule == "FD211"]) == 4
+
+
+def test_fd211_registered_and_clean_on_repo():
+    assert "FD211" in {r.id for r in all_rules()}
+    import os
+
+    for rel in (("pack",), ("runtime", "pack_stage.py")):
+        root = os.path.join(os.path.dirname(__file__), "..",
+                            "firedancer_tpu", *rel)
+        findings = ast_rules.lint_path(root)
+        assert [f for f in findings if f.rule == "FD211"] == []

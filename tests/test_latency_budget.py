@@ -1,0 +1,114 @@
+"""Tier-1 latency-budget ratchet (ISSUE 9 / ROADMAP #4).
+
+Drives the REAL flagship pipeline (cooperative form, precomputed verify
+so no device compile), with every stage's metrics bound to a live SHM
+registry segment — then scrapes those segments back from raw shared
+memory, exactly as an uninvolved monitor process would, and fails if any
+hop's p50 `frag_latency_ns` regresses past the budgets declared in
+runtime/slo.py.  This turns the PR-5 metrics plane into a gate: a stage
+silently reverting to per-frag batching or a wedged-open accumulation
+deadline shows up HERE, not in the next manual bench round.
+"""
+
+from __future__ import annotations
+
+from multiprocessing import shared_memory
+
+import pytest
+
+from firedancer_tpu.runtime.slo import HOP_P50_BUDGET_NS, check_hop_budgets
+from firedancer_tpu.utils import metrics as fm
+
+N_TXNS = 384
+
+
+def _scrape(segs, schemas):
+    """Fresh attach per segment (the monitor-process view); a helper so
+    the registry's numpy views die on return and the segments close."""
+    hists = {}
+    counters = {}
+    for name, seg in segs.items():
+        reg, _rec = fm.metrics_segment_attach(seg.buf, schemas[name])
+        hists[name] = reg.hist("frag_latency_ns")
+        counters[name] = {
+            d.name: reg.get(d.name)
+            for d in schemas[name].defs if d.kind != fm.HISTOGRAM
+        }
+        del reg, _rec
+    return hists, counters
+
+
+@pytest.fixture(scope="module")
+def scraped_hists():
+    """Run the pipeline once with shm-backed registries; yield the
+    frag_latency_ns histograms read back from the segments."""
+    from firedancer_tpu.models.leader import build_leader_pipeline
+
+    pipe = build_leader_pipeline(
+        n_verify=1, n_bank=2, pool_size=N_TXNS, gen_limit=N_TXNS,
+        batch=64, max_msg_len=256, verify_precomputed=True,
+    )
+    segs: dict[str, shared_memory.SharedMemory] = {}
+    schemas = {}
+    reg = rec = None
+    try:
+        for s in pipe.stages:
+            schema = type(s).metrics_schema()
+            seg = shared_memory.SharedMemory(
+                create=True, size=fm.metrics_segment_footprint(schema)
+            )
+            segs[s.name] = seg
+            schemas[s.name] = schema
+            reg, rec = fm.metrics_segment_init(seg.buf, schema)
+            s.attach_observability(reg, rec)
+        pipe.run(until_txns=N_TXNS, max_iters=400_000)
+        for s in pipe.stages:
+            s.metrics.flush()  # the housekeeping publication, forced final
+        hists, counters = _scrape(segs, schemas)
+        yield {"hists": hists, "counters": counters,
+               "native_pack": pipe.dedup is None}
+    finally:
+        # registries/recorders hold numpy views over seg.buf: drop them
+        # (including the setup loop's own locals) before closing or
+        # SharedMemory.close raises BufferError
+        reg = rec = None
+        for s in pipe.stages:
+            s.metrics.registry = None
+            s.recorder = fm.FlightRecorder(8)
+        pipe.close()
+        import gc
+
+        gc.collect()
+        for seg in segs.values():
+            seg.close()
+            seg.unlink()
+
+
+def test_pipeline_carried_traffic(scraped_hists):
+    """The budgets only mean something if the hops actually consumed the
+    stream: every budgeted hop present in the topology saw frags."""
+    counters = scraped_hists["counters"]
+    assert counters["pack"]["txn_in"] == N_TXNS
+    execs = sum(counters[b]["txn_exec"] for b in ("bank0", "bank1"))
+    assert execs == N_TXNS
+    hists = scraped_hists["hists"]
+    for name in HOP_P50_BUDGET_NS:
+        if name in hists and name in counters:
+            assert hists[name]["count"] > 0, f"hop {name} observed nothing"
+
+
+def test_hop_p50s_within_budget(scraped_hists):
+    violations = check_hop_budgets(scraped_hists["hists"])
+    assert not violations, "latency budget regressions:\n  " + "\n  ".join(
+        violations
+    )
+
+
+def test_e2e_budget_declared_and_enforced():
+    """The ratchet covers the end-to-end path (the store hop observes
+    benchg's tsorig) — guard against the budget table losing that row."""
+    assert "store" in HOP_P50_BUDGET_NS
+    # and the checker flags an over-budget histogram
+    bad = {"store": {"buckets": [1e12], "counts": [0, 5], "sum": 5e12,
+                     "count": 5}}
+    assert check_hop_budgets(bad)
