@@ -449,12 +449,17 @@ def run_host_pipeline_bench() -> dict:
     target to beat is the reference's stock single-host bench, 63K txn/s
     (book/guide/tuning.md:131).
 
-    Measures BOTH pack lanes on the same box: the fused native
-    dedup+pack lane (the headline artifact) and, briefly, the Python
-    lane (`*_native_pack_off`), so every round records the native lane's
-    step explicitly (the ISSUE 9 acceptance shape)."""
+    Measures BOTH pack lanes AND both ring lanes on the same box: the
+    all-native configuration is the headline; `*_native_pack_off` and
+    `*_native_ring_off` record the Python fallbacks in the same run
+    (the ISSUE 9/10 interleaved-A/B acceptance shape).  Every measure
+    also splits ring overhead (poll+publish) from stage compute in the
+    per-stage us/txn breakdown, so the crossing cost is in the artifact
+    directly."""
     from firedancer_tpu.pack import scheduler_native as sn
+    from firedancer_tpu.tango import shm as tango_shm
 
+    ring_avail = tango_shm._native_ring_available()
     out = {}
     if sn.available():
         off = _host_pipeline_measure(native_pack=False)
@@ -465,6 +470,16 @@ def run_host_pipeline_bench() -> dict:
     else:
         out.update(_host_pipeline_measure(native_pack=False))
         out["pipeline_host_native_pack"] = False
+    if ring_avail:
+        roff = _host_pipeline_measure(
+            native_pack=out["pipeline_host_native_pack"], native_ring=False
+        )
+        out["pipeline_host_txn_per_s_native_ring_off"] = \
+            roff["pipeline_host_txn_per_s"]
+        out["pipeline_host_ring_us_per_txn_native_ring_off"] = \
+            roff["pipeline_host_ring_us_per_txn"]
+        out["pipeline_host_ring_us_per_stage_native_ring_off"] = \
+            roff["pipeline_host_ring_us_per_stage"]
     try:
         out["verify_stage_host_txn_per_s"] = round(
             _verify_stage_loop_rate(), 1
@@ -478,7 +493,8 @@ def run_host_pipeline_bench() -> dict:
     return out
 
 
-def _host_pipeline_measure(*, native_pack: bool) -> dict:
+def _host_pipeline_measure(*, native_pack: bool,
+                           native_ring: bool | None = None) -> dict:
     from firedancer_tpu.models.leader import build_leader_pipeline
     from firedancer_tpu.runtime.bank import default_bank_ctx
     from firedancer_tpu.runtime.benchg import gen_transfer_pool
@@ -488,22 +504,36 @@ def _host_pipeline_measure(*, native_pack: bool) -> dict:
     #                bounded funded account set the same way)
     t0 = time.time()
     ctx = default_bank_ctx(n_payers=n_payers)
-    pipe = build_leader_pipeline(
-        n_verify=1,
-        n_bank=4,
-        pool_size=64,  # placeholder; the real pool replaces it below
-        gen_limit=n_txn,
-        batch=512,
-        max_msg_len=256,
-        batch_deadline_s=0.005,
-        verify_precomputed=True,
-        bank_ctx=ctx,
-        native_pack=native_pack,
-    )
+    # the ring lane is chosen at endpoint CONSTRUCTION (shm.make_*): the
+    # env switch only needs to hold while the pipeline builds
+    ring_env_prev = os.environ.get("FDTPU_NATIVE_RING")
+    if native_ring is not None:
+        os.environ["FDTPU_NATIVE_RING"] = "1" if native_ring else "0"
+    try:
+        pipe = build_leader_pipeline(
+            n_verify=1,
+            n_bank=4,
+            pool_size=64,  # placeholder; the real pool replaces it below
+            gen_limit=n_txn,
+            batch=512,
+            max_msg_len=256,
+            batch_deadline_s=0.005,
+            verify_precomputed=True,
+            bank_ctx=ctx,
+            native_pack=native_pack,
+        )
+    finally:
+        if native_ring is not None:
+            if ring_env_prev is None:
+                os.environ.pop("FDTPU_NATIVE_RING", None)
+            else:
+                os.environ["FDTPU_NATIVE_RING"] = ring_env_prev
+    ring_on = type(pipe.pack.ins[0]).__name__ == "NativeConsumer"
     pipe.benchg.pool = gen_transfer_pool(n_txn, n_payers=n_payers,
                                          n_dests=1024)
     print(f"# host pipeline: pool of {n_txn} signed in {time.time()-t0:.1f}s"
-          f" (native_pack={native_pack})", file=sys.stderr)
+          f" (native_pack={native_pack}, native_ring={ring_on})",
+          file=sys.stderr)
 
     def executed_cnt() -> int:
         return sum(b.metrics.get("txn_exec") for b in pipe.banks)
@@ -531,17 +561,31 @@ def _host_pipeline_measure(*, native_pack: bool) -> dict:
         # instead of two clock reads per stage per sweep
         stage_s = {s.name: 0.0 for s in pipe.stages}
         stage_s["pack.after_credit"] = 0.0
+        # ring time spent inside the explicit after_credit call (native
+        # pack publishes its microblocks there): tracked apart so the
+        # ring split stays a SUBSET of the same lane it is printed under
+        ring_ac_s = 0.0
         sample_every = 8
         pc = time.perf_counter
         while executed_cnt() - warm_exec < target and it < 2_000_000:
             if it % sample_every == 0:
+                # sampled sweeps also run the ring-cost instrument
+                # (stage.ring_clock): poll/drain + publish time accumulate
+                # per stage, scaled alongside the stage times below
                 for s in pipe.stages:
+                    s.ring_clock = True
                     t1 = pc()
                     s.run_once()
                     stage_s[s.name] += pc() - t1
+                    s.ring_clock = False
+                pipe.pack.ring_clock = True
+                r0 = pipe.pack.ring_poll_s + pipe.pack.ring_publish_s
                 t1 = pc()
                 pipe.pack.after_credit()
                 stage_s["pack.after_credit"] += pc() - t1
+                ring_ac_s += (pipe.pack.ring_poll_s
+                              + pipe.pack.ring_publish_s) - r0
+                pipe.pack.ring_clock = False
             else:
                 for s in pipe.stages:
                     s.run_once()
@@ -579,6 +623,8 @@ def _host_pipeline_measure(*, native_pack: bool) -> dict:
         # scale the sampled stage times back to the whole run; merge the
         # bank stages into one lane (they share the executor)
         breakdown_us = {}
+        ring_us = {}
+        ring_total_us = 0.0
         if executed > 0:
             scale = sample_every * 1e6 / executed
             for name, sec in stage_s.items():
@@ -586,9 +632,25 @@ def _host_pipeline_measure(*, native_pack: bool) -> dict:
                 breakdown_us[lane] = round(
                     breakdown_us.get(lane, 0.0) + sec * scale, 1
                 )
+            # the ring split: poll/drain + publish time per stage, a
+            # SUBSET of the stage lane above — (stage - ring) is compute
+            for s in pipe.stages:
+                sec = s.ring_poll_s + s.ring_publish_s
+                if s is pipe.pack:
+                    # publishes from the explicit after_credit call were
+                    # clocked into the same counters; re-home them so
+                    # each ring figure subsets its own printed lane
+                    sec -= ring_ac_s
+                lane = "bank" if s.name.startswith("bank") else s.name
+                ring_us[lane] = round(ring_us.get(lane, 0.0) + sec * scale, 1)
+            ring_us["pack.after_credit"] = round(ring_ac_s * scale, 1)
+            ring_total_us = round(sum(ring_us.values()), 1)
             for lane, us in sorted(breakdown_us.items(), key=lambda kv: -kv[1]):
-                print(f"#   stage {lane:20s} {us:8.1f} us/txn",
+                print(f"#   stage {lane:20s} {us:8.1f} us/txn"
+                      f"   (ring {ring_us.get(lane, 0.0):6.1f})",
                       file=sys.stderr)
+            print(f"#   ring poll+publish total {ring_total_us:8.1f} us/txn",
+                  file=sys.stderr)
         from firedancer_tpu.flamenco import exec_native
 
         # the ISSUE 9 criterion watches pack + dedup COMBINED us/txn
@@ -603,6 +665,9 @@ def _host_pipeline_measure(*, native_pack: bool) -> dict:
             "pipeline_host_txn_executed": executed,
             "pipeline_host_stage_us_per_txn": breakdown_us,
             "pipeline_host_pack_dedup_us_per_txn": pack_dedup_us,
+            "pipeline_host_ring_us_per_txn": ring_total_us,
+            "pipeline_host_ring_us_per_stage": ring_us,
+            "pipeline_host_native_ring": ring_on,
             "pipeline_host_native_exec": exec_native.available(),
         }
         out.update(_scrape_stage_latencies(pipe))

@@ -71,6 +71,14 @@ _FD209_BARE = frozenset({
 # builder calls that allocate a fresh container per invocation
 _ALLOC_BUILTINS = frozenset({"dict", "list", "set", "tuple"})
 
+# FD212: ctypes entry points that allocate/marshal a fresh object per
+# call — per-frag churn on top of the crossing cost FD207 already flags.
+# Native endpoints cache these at construction (tango/native.py).
+_CTYPES_CHURN = frozenset({
+    "create_string_buffer", "create_unicode_buffer", "byref", "cast",
+    "addressof", "string_at",
+})
+
 
 def _fd208_offender(arg: ast.AST) -> str | None:
     """Why `arg` allocates/formats, or None if it looks scalar-cheap."""
@@ -126,31 +134,41 @@ _MOD_CANON = {
 }
 
 
-def _native_imports(tree: ast.Module) -> tuple[set[str], set[str]]:
-    """Names bound to native-FFI surfaces for FD207: modules whose last
-    dotted segment mentions `native` (tango.native, protocol.txn_native,
-    flamenco.exec_native, tango.tcache_native, utils.nativebuild) plus
-    ctypes itself.  Returns (module aliases, from-imported names)."""
+def _native_imports(tree: ast.Module):
+    """Names bound to native-FFI surfaces for FD207/FD212: modules whose
+    last dotted segment mentions `native` (tango.native,
+    protocol.txn_native, flamenco.exec_native, tango.tcache_native,
+    utils.nativebuild) plus ctypes itself.  Returns (module aliases,
+    from-imported names, ctypes module aliases, ctypes from-imports) —
+    the ctypes sets are tracked separately so FD212's churn check never
+    fires on a *native*-module helper that happens to share a name."""
     mods: set[str] = set()
     funcs: set[str] = set()
+    cmods: set[str] = set()
+    cfuncs: dict[str, str] = {}  # bound name -> original ctypes name
     for node in ast.walk(tree):
         if isinstance(node, ast.Import):
             for a in node.names:
                 last = a.name.split(".")[-1]
                 if "native" in last or a.name == "ctypes":
                     mods.add(a.asname or a.name.split(".")[0])
+                if a.name == "ctypes":
+                    cmods.add(a.asname or "ctypes")
         elif isinstance(node, ast.ImportFrom) and node.module:
             last = node.module.split(".")[-1]
             if "native" in last or node.module == "ctypes":
                 for a in node.names:
                     funcs.add(a.asname or a.name)
-            else:
+            if node.module == "ctypes":
+                for a in node.names:
+                    cfuncs[a.asname or a.name] = a.name
+            if "native" not in last and node.module != "ctypes":
                 for a in node.names:
                     # `from pkg import txn_native as tn`: a native MODULE
                     # imported by name — calls go through its alias
                     if "native" in a.name:
                         mods.add(a.asname or a.name)
-    return mods, funcs
+    return mods, funcs, cmods, cfuncs
 
 
 def _import_aliases(tree: ast.Module):
@@ -194,7 +212,7 @@ def _local_defs(fn: ast.AST) -> set[str]:
 
 class _Linter(ast.NodeVisitor):
     def __init__(self, path: str, mods=None, funcs=None, nmods=None,
-                 nfuncs=None):
+                 nfuncs=None, cmods=None, cfuncs=None):
         self.path = path
         self.findings: list[Finding] = []
         self._frag_depth = 0  # >0 while inside a frag-callback body
@@ -203,6 +221,8 @@ class _Linter(ast.NodeVisitor):
         self._funcs = funcs or {}  # from-imported name -> (module, func)
         self._nmods = nmods or set()  # FD207: native-module aliases
         self._nfuncs = nfuncs or set()  # FD207: native from-imports
+        self._cmods = cmods or set()  # FD212: ctypes module aliases
+        self._cfuncs = cfuncs or {}  # FD212: ctypes from-import -> orig
         # FD209 scope: files under a chaos/ package directory
         parts = re.split(r"[/\\]", path)
         self._chaos = "chaos" in parts
@@ -238,6 +258,24 @@ class _Linter(ast.NodeVisitor):
             rule=rule, path=self.path,
             line=getattr(node, "lineno", 0), msg=msg,
         ))
+
+    def _ctypesish(self, node: ast.AST) -> bool:
+        """An expression that references a ctypes type: rooted at a
+        ctypes module alias or from-import, or a `c_*`-named type (the
+        ctypes naming convention).  FD212's array-shape check requires
+        this of an operand — AND the file to bind ctypes at all (the
+        call-site gate), so neither `(scale * gain)(x)` next to a ctypes
+        import nor `(c_scale * gain)(x)` in a ctypes-free file is
+        mistaken for `(c_u64 * n)()`."""
+        for sub in ast.walk(node):
+            d = _dotted(sub)
+            if d is None:
+                continue
+            if d[0] in self._cmods or d[0] in self._cfuncs:
+                return True
+            if d[-1].startswith("c_"):
+                return True
+        return False
 
     # -- scope tracking -----------------------------------------------------
 
@@ -393,6 +431,32 @@ class _Linter(ast.NodeVisitor):
                          " pool ordered incrementally (scheduler insort"
                          " at insert / the native treap) and keep the"
                          " frag path append-only")
+        # FD212: per-frag ctypes allocation/marshalling churn — a fresh
+        # create_string_buffer/byref/cast temporary per frag is an
+        # allocator in the hot path even before the crossing itself
+        # (FD207) is counted; native endpoints cache these objects at
+        # construction (tango/native.py) and cross at burst granularity
+        cdq = _dotted(node.func)
+        if cdq is not None and (
+            (cdq[0] in self._cmods and cdq[-1] in _CTYPES_CHURN)
+            or (len(cdq) == 1
+                and self._cfuncs.get(cdq[0]) in _CTYPES_CHURN)
+        ):
+            self.hit("FD212", node,
+                     f"per-frag ctypes churn '{'.'.join(cdq)}' in a frag"
+                     " callback: cache the buffer/byref at construction"
+                     " and batch crossings (fdr_drain/fdr_publish_burst)")
+        if (self._cmods or self._cfuncs) \
+                and isinstance(node.func, ast.BinOp) \
+                and isinstance(node.func.op, ast.Mult) \
+                and (self._ctypesish(node.func.left)
+                     or self._ctypesish(node.func.right)):
+            # `(c_uint64 * n)()` — a fresh ctypes ARRAY TYPE + instance
+            # per frag (the costliest churn shape: type creation)
+            self.hit("FD212", node,
+                     "ctypes array construction `(c_type * n)()` in a"
+                     " frag callback: allocate once at construction and"
+                     " reuse (tango/native.py's _meta/_out discipline)")
         # FD207: a native (ctypes) crossing per frag — the crossing
         # itself costs ~1-3us, so it belongs at burst granularity (one
         # call per drained burst / microblock, the fd_exec_batch shape)
@@ -495,8 +559,8 @@ def lint_source(source: str, path: str) -> list[Finding]:
         return [Finding(rule="FD200", path=path, line=e.lineno or 0,
                         msg=f"file does not parse: {e.msg}")]
     mods, funcs = _import_aliases(tree)
-    nmods, nfuncs = _native_imports(tree)
-    linter = _Linter(path, mods, funcs, nmods, nfuncs)
+    nmods, nfuncs, cmods, cfuncs = _native_imports(tree)
+    linter = _Linter(path, mods, funcs, nmods, nfuncs, cmods, cfuncs)
     linter.visit(tree)
     disabled = _disabled_lines(source)
     for f in linter.findings:

@@ -184,3 +184,12 @@ FD211 = _rule(
     " accepted txn; the native lane pays it in C++), and burst handoff must"
     " be append-only (NativePackStage.after_frag's shape)",
 )
+FD212 = _rule(
+    "FD212", "ctypes-alloc-in-frag", SEV_ERROR,
+    "per-frag ctypes allocation/marshalling churn (create_string_buffer,"
+    " byref/cast/addressof temporaries, `(c_type * n)()` array construction)"
+    " inside a frag callback: each builds a fresh ctypes object per frag on"
+    " top of the crossing FD207 already bans — native endpoints cache their"
+    " byref/out-buffer objects at construction (tango/native.py) and cross"
+    " the FFI once per drained burst (fdr_drain / fdr_publish_burst)",
+)

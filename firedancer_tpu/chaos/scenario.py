@@ -153,11 +153,11 @@ def run_connection_storm(seed: int = 0, duration: float = 20.0, *,
     uid = f"chaos{os.getpid()}_{seed}"
     link = shm.ShmLink.create(f"fdtpu_cs_{uid}", depth=4096, mtu=2048)
     stage = QuicIngressStage(
-        "quic", outs=[shm.Producer(link)], sock=ChaosSock(), rx_burst=8,
+        "quic", outs=[shm.make_producer(link)], sock=ChaosSock(), rx_burst=8,
         identity_secret=identity, retry=True,
         max_conns=max(64, 2 * n_honest),
     )
-    sink = shm.Consumer(link, lazy=16)
+    sink = shm.make_consumer(link, lazy=16)
     received: list[bytes] = []
     pop = Population(
         stage, seed=seed, n_honest=n_honest, n_storm=n_storm,
@@ -255,7 +255,7 @@ def _amplification_probe(suite: inv.InvariantSuite, seed: int,
     uid = f"chaosamp{os.getpid()}_{seed}"
     link = shm.ShmLink.create(f"fdtpu_ca_{uid}", depth=256, mtu=2048)
     stage = QuicIngressStage(
-        "quic-amp", outs=[shm.Producer(link)], sock=ChaosSock(), rx_burst=8,
+        "quic-amp", outs=[shm.make_producer(link)], sock=ChaosSock(), rx_burst=8,
         identity_secret=identity, retry=False, max_conns=8,
     )
     pop = Population(stage, seed=seed + 1, n_honest=0, n_storm=8,
@@ -356,10 +356,10 @@ def run_dedup_flood(seed: int = 0, duration: float = 10.0, *,
     uid = f"chaosdd{os.getpid()}_{seed}"
     l_in = shm.ShmLink.create(f"fdtpu_dfi_{uid}", depth=1024, mtu=256)
     l_out = shm.ShmLink.create(f"fdtpu_dfo_{uid}", depth=1024, mtu=256)
-    feeder = FloodFeeder(schedule, "flood", outs=[shm.Producer(l_in)])
-    dedup = DedupStage("dedup", ins=[shm.Consumer(l_in, lazy=32)],
-                       outs=[shm.Producer(l_out)])
-    sink = CollectSink("sink", ins=[shm.Consumer(l_out, lazy=32)])
+    feeder = FloodFeeder(schedule, "flood", outs=[shm.make_producer(l_in)])
+    dedup = DedupStage("dedup", ins=[shm.make_consumer(l_in, lazy=32)],
+                       outs=[shm.make_producer(l_out)])
+    sink = CollectSink("sink", ins=[shm.make_consumer(l_out, lazy=32)])
     shim = wrap_stage_input(dedup, 0, Rng(seed, 0x5417),
                             dup_p=dup_p, reorder_p=reorder_p)
     stages = [feeder, dedup, sink]
@@ -725,17 +725,17 @@ class ChaosSinkStage(Stage):
 
 
 def _b_gen(links, cnc, *, limit):
-    return ChaosGenStage("gen", outs=[shm.Producer(links["gr"])], cnc=cnc,
+    return ChaosGenStage("gen", outs=[shm.make_producer(links["gr"])], cnc=cnc,
                          limit=limit)
 
 
 def _b_relay(links, cnc):
-    return ChaosRelayStage("relay", ins=[shm.Consumer(links["gr"], lazy=8)],
-                           outs=[shm.Producer(links["rs"])], cnc=cnc)
+    return ChaosRelayStage("relay", ins=[shm.make_consumer(links["gr"], lazy=8)],
+                           outs=[shm.make_producer(links["rs"])], cnc=cnc)
 
 
 def _b_sink(links, cnc):
-    return ChaosSinkStage("sink", ins=[shm.Consumer(links["rs"], lazy=8)],
+    return ChaosSinkStage("sink", ins=[shm.make_consumer(links["rs"], lazy=8)],
                           cnc=cnc)
 
 

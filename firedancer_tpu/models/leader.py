@@ -201,13 +201,13 @@ def build_leader_pipeline(
 
     pool = gen_transfer_pool(pool_size)
     benchg = BenchGStage(
-        pool, "benchg", outs=[shm.Producer(gen_verify)], limit=gen_limit
+        pool, "benchg", outs=[shm.make_producer(gen_verify)], limit=gen_limit
     )
     verifies = [
         VerifyStage(
             f"verify{i}",
-            ins=[shm.Consumer(gen_verify, fseq_idx=i, lazy=32)],
-            outs=[shm.Producer(verify_dedup[i])],
+            ins=[shm.make_consumer(gen_verify, fseq_idx=i, lazy=32)],
+            outs=[shm.make_producer(verify_dedup[i])],
             shard_idx=i,
             shard_cnt=n_verify,
             batch=batch,
@@ -222,23 +222,23 @@ def build_leader_pipeline(
         dedup = None
         pack = NativePackStage(
             "pack",
-            ins=[shm.Consumer(l, lazy=32) for l in verify_dedup]
-            + [shm.Consumer(l, lazy=8) for l in bank_done],
-            outs=[shm.Producer(l) for l in pack_bank],
+            ins=[shm.make_consumer(l, lazy=32) for l in verify_dedup]
+            + [shm.make_consumer(l, lazy=8) for l in bank_done],
+            outs=[shm.make_producer(l) for l in pack_bank],
             bank_cnt=n_bank,
             n_txn_ins=n_verify,
         )
     else:
         dedup = DedupStage(
             "dedup",
-            ins=[shm.Consumer(l, lazy=32) for l in verify_dedup],
-            outs=[shm.Producer(dedup_pack)],
+            ins=[shm.make_consumer(l, lazy=32) for l in verify_dedup],
+            outs=[shm.make_producer(dedup_pack)],
         )
         pack = PackStage(
             "pack",
-            ins=[shm.Consumer(dedup_pack, lazy=32)]
-            + [shm.Consumer(l, lazy=8) for l in bank_done],
-            outs=[shm.Producer(l) for l in pack_bank],
+            ins=[shm.make_consumer(dedup_pack, lazy=32)]
+            + [shm.make_consumer(l, lazy=8) for l in bank_done],
+            outs=[shm.make_producer(l) for l in pack_bank],
             bank_cnt=n_bank,
         )
     # ONE live bank shared by every bank stage (the Frankendancer shape:
@@ -248,8 +248,8 @@ def build_leader_pipeline(
     banks = [
         BankStage(
             f"bank{b}",
-            ins=[shm.Consumer(pack_bank[b], lazy=8)],
-            outs=[shm.Producer(bank_poh[b]), shm.Producer(bank_done[b])],
+            ins=[shm.make_consumer(pack_bank[b], lazy=8)],
+            outs=[shm.make_producer(bank_poh[b]), shm.make_producer(bank_done[b])],
             bank_idx=b,
             ctx=bank_ctx,
         )
@@ -259,16 +259,16 @@ def build_leader_pipeline(
         bstage.require_credit = True
     poh = PohStage(
         "poh",
-        ins=[shm.Consumer(l, lazy=8) for l in bank_poh],
-        outs=[shm.Producer(poh_shred)],
+        ins=[shm.make_consumer(l, lazy=8) for l in bank_poh],
+        outs=[shm.make_producer(poh_shred)],
     )
     poh.require_credit = True
     if keep_entries:
         poh.entries = []
     shred = ShredStage(
         "shred",
-        ins=[shm.Consumer(poh_shred, lazy=8)],
-        outs=[shm.Producer(shred_store)],
+        ins=[shm.make_consumer(poh_shred, lazy=8)],
+        outs=[shm.make_producer(shred_store)],
         signer=lambda root: ref.sign(secret, root),
         slot=slot,
         keep_sets=True,
@@ -280,7 +280,7 @@ def build_leader_pipeline(
     # keep full verification
     store = StoreStage(
         "store",
-        ins=[shm.Consumer(shred_store, lazy=64)],
+        ins=[shm.make_consumer(shred_store, lazy=64)],
         verify_sig=None,
     )
     stages = [benchg, *verifies] + ([dedup] if dedup else []) \
@@ -387,18 +387,18 @@ def build_sharded_leader_pipeline(
 
     pool = gen_transfer_pool(pool_size)
     benchg = BenchGStage(
-        pool, "benchg", outs=[shm.Producer(gen_router)], limit=gen_limit
+        pool, "benchg", outs=[shm.make_producer(gen_router)], limit=gen_limit
     )
     router = ShardRouterStage(
         "router",
-        ins=[shm.Consumer(gen_router, lazy=32)],
-        outs=[shm.Producer(l) for l in shard_rings],
+        ins=[shm.make_consumer(gen_router, lazy=32)],
+        outs=[shm.make_producer(l) for l in shard_rings],
         n_shards=n_shards,
     )
     verify = ShardedVerifyStage(
         "verify",
-        ins=[shm.Consumer(l, lazy=32) for l in shard_rings],
-        outs=[shm.Producer(verify_dedup)],
+        ins=[shm.make_consumer(l, lazy=32) for l in shard_rings],
+        outs=[shm.make_producer(verify_dedup)],
         plane=plane,
         batch=cfg.batch_per_shard,
         batch_deadline_s=batch_deadline_s,
@@ -408,22 +408,22 @@ def build_sharded_leader_pipeline(
         dedup = None
         pack = NativePackStage(
             "pack",
-            ins=[shm.Consumer(verify_dedup, lazy=32)]
-            + [shm.Consumer(l, lazy=8) for l in bank_done],
-            outs=[shm.Producer(l) for l in pack_bank],
+            ins=[shm.make_consumer(verify_dedup, lazy=32)]
+            + [shm.make_consumer(l, lazy=8) for l in bank_done],
+            outs=[shm.make_producer(l) for l in pack_bank],
             bank_cnt=n_bank,
         )
     else:
         dedup = DedupStage(
             "dedup",
-            ins=[shm.Consumer(verify_dedup, lazy=32)],
-            outs=[shm.Producer(dedup_pack)],
+            ins=[shm.make_consumer(verify_dedup, lazy=32)],
+            outs=[shm.make_producer(dedup_pack)],
         )
         pack = PackStage(
             "pack",
-            ins=[shm.Consumer(dedup_pack, lazy=32)]
-            + [shm.Consumer(l, lazy=8) for l in bank_done],
-            outs=[shm.Producer(l) for l in pack_bank],
+            ins=[shm.make_consumer(dedup_pack, lazy=32)]
+            + [shm.make_consumer(l, lazy=8) for l in bank_done],
+            outs=[shm.make_producer(l) for l in pack_bank],
             bank_cnt=n_bank,
         )
     if bank_ctx is None:
@@ -431,8 +431,8 @@ def build_sharded_leader_pipeline(
     banks = [
         BankStage(
             f"bank{b}",
-            ins=[shm.Consumer(pack_bank[b], lazy=8)],
-            outs=[shm.Producer(bank_poh[b]), shm.Producer(bank_done[b])],
+            ins=[shm.make_consumer(pack_bank[b], lazy=8)],
+            outs=[shm.make_producer(bank_poh[b]), shm.make_producer(bank_done[b])],
             bank_idx=b,
             ctx=bank_ctx,
         )
@@ -442,16 +442,16 @@ def build_sharded_leader_pipeline(
         bstage.require_credit = True
     poh = PohStage(
         "poh",
-        ins=[shm.Consumer(l, lazy=8) for l in bank_poh],
-        outs=[shm.Producer(poh_shred)],
+        ins=[shm.make_consumer(l, lazy=8) for l in bank_poh],
+        outs=[shm.make_producer(poh_shred)],
         hashes_per_tick=hashes_per_tick,
         plane=plane,
     )
     poh.require_credit = True
     shred = ShredStage(
         "shred",
-        ins=[shm.Consumer(poh_shred, lazy=8)],
-        outs=[shm.Producer(shred_store)],
+        ins=[shm.make_consumer(poh_shred, lazy=8)],
+        outs=[shm.make_producer(shred_store)],
         signer=lambda root: ref.sign(secret, root),
         slot=slot,
         keep_sets=True,
@@ -459,7 +459,7 @@ def build_sharded_leader_pipeline(
     )
     store = StoreStage(
         "store",
-        ins=[shm.Consumer(shred_store, lazy=64)],
+        ins=[shm.make_consumer(shred_store, lazy=64)],
         verify_sig=None,
     )
     stages = [benchg, router, verify] + ([dedup] if dedup else []) \

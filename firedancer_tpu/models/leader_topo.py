@@ -34,7 +34,7 @@ def build_benchg(links, cnc, *, pool_size, n_txns):
     return BenchGStage(
         gen_transfer_pool(pool_size),
         "benchg",
-        outs=[shm.Producer(links["gv"])],
+        outs=[shm.make_producer(links["gv"])],
         cnc=cnc,
         limit=n_txns,
     )
@@ -46,8 +46,8 @@ def build_verify(links, cnc, *, batch):
 
     return VerifyStage(
         "verify0",
-        ins=[shm.Consumer(links["gv"], lazy=32)],
-        outs=[shm.Producer(links["vd"])],
+        ins=[shm.make_consumer(links["gv"], lazy=32)],
+        outs=[shm.make_producer(links["vd"])],
         cnc=cnc,
         batch=batch,
         max_msg_len=256,
@@ -60,8 +60,8 @@ def build_router(links, cnc, *, n_shards):
 
     return ShardRouterStage(
         "router",
-        ins=[shm.Consumer(links["gv"], lazy=32)],
-        outs=[shm.Producer(links[f"sv{i}"]) for i in range(n_shards)],
+        ins=[shm.make_consumer(links["gv"], lazy=32)],
+        outs=[shm.make_producer(links[f"sv{i}"]) for i in range(n_shards)],
         cnc=cnc,
         n_shards=n_shards,
     )
@@ -74,8 +74,8 @@ def build_verify_shard(links, cnc, *, shard_idx, batch, precomputed):
 
     return VerifyStage(
         f"verify_s{shard_idx}",
-        ins=[shm.Consumer(links[f"sv{shard_idx}"], lazy=32)],
-        outs=[shm.Producer(links[f"vd{shard_idx}"])],
+        ins=[shm.make_consumer(links[f"sv{shard_idx}"], lazy=32)],
+        outs=[shm.make_producer(links[f"vd{shard_idx}"])],
         cnc=cnc,
         batch=batch,
         max_msg_len=256,
@@ -89,8 +89,8 @@ def build_dedup(links, cnc):
 
     return DedupStage(
         "dedup",
-        ins=[shm.Consumer(links["vd"], lazy=32)],
-        outs=[shm.Producer(links["dp"])],
+        ins=[shm.make_consumer(links["vd"], lazy=32)],
+        outs=[shm.make_producer(links["dp"])],
         cnc=cnc,
     )
 
@@ -100,8 +100,8 @@ def build_dedup_sharded(links, cnc, *, n_shards):
 
     return DedupStage(
         "dedup",
-        ins=[shm.Consumer(links[f"vd{i}"], lazy=32) for i in range(n_shards)],
-        outs=[shm.Producer(links["dp"])],
+        ins=[shm.make_consumer(links[f"vd{i}"], lazy=32) for i in range(n_shards)],
+        outs=[shm.make_producer(links["dp"])],
         cnc=cnc,
     )
 
@@ -111,9 +111,9 @@ def build_pack(links, cnc, *, n_bank):
 
     return PackStage(
         "pack",
-        ins=[shm.Consumer(links["dp"], lazy=32)]
-        + [shm.Consumer(links[f"bd{b}"], lazy=8) for b in range(n_bank)],
-        outs=[shm.Producer(links[f"pb{b}"]) for b in range(n_bank)],
+        ins=[shm.make_consumer(links["dp"], lazy=32)]
+        + [shm.make_consumer(links[f"bd{b}"], lazy=8) for b in range(n_bank)],
+        outs=[shm.make_producer(links[f"pb{b}"]) for b in range(n_bank)],
         cnc=cnc,
         bank_cnt=n_bank,
         # a process pipeline has real inter-stage latency: schedule as
@@ -133,9 +133,9 @@ def build_pack_native(links, cnc, *, n_bank, txn_links):
 
     return NativePackStage(
         "pack",
-        ins=[shm.Consumer(links[l], lazy=32) for l in txn_links]
-        + [shm.Consumer(links[f"bd{b}"], lazy=8) for b in range(n_bank)],
-        outs=[shm.Producer(links[f"pb{b}"]) for b in range(n_bank)],
+        ins=[shm.make_consumer(links[l], lazy=32) for l in txn_links]
+        + [shm.make_consumer(links[f"bd{b}"], lazy=8) for b in range(n_bank)],
+        outs=[shm.make_producer(links[f"pb{b}"]) for b in range(n_bank)],
         cnc=cnc,
         bank_cnt=n_bank,
         n_txn_ins=len(txn_links),
@@ -155,10 +155,10 @@ def build_bank(links, cnc, *, bank_idx, slot=1):
 
     stage = BankStage(
         f"bank{bank_idx}",
-        ins=[shm.Consumer(links[f"pb{bank_idx}"], lazy=8)],
+        ins=[shm.make_consumer(links[f"pb{bank_idx}"], lazy=8)],
         outs=[
-            shm.Producer(links[f"bp{bank_idx}"]),
-            shm.Producer(links[f"bd{bank_idx}"]),
+            shm.make_producer(links[f"bp{bank_idx}"]),
+            shm.make_producer(links[f"bd{bank_idx}"]),
         ],
         cnc=cnc,
         bank_idx=bank_idx,
@@ -173,8 +173,8 @@ def build_poh(links, cnc, *, n_bank):
 
     stage = PohStage(
         "poh",
-        ins=[shm.Consumer(links[f"bp{b}"], lazy=8) for b in range(n_bank)],
-        outs=[shm.Producer(links["ps"])],
+        ins=[shm.make_consumer(links[f"bp{b}"], lazy=8) for b in range(n_bank)],
+        outs=[shm.make_producer(links["ps"])],
         cnc=cnc,
     )
     stage.require_credit = True
@@ -188,8 +188,8 @@ def build_shred(links, cnc, *, secret, slot):
 
     return ShredStage(
         "shred",
-        ins=[shm.Consumer(links["ps"], lazy=8)],
-        outs=[shm.Producer(links["ss"])],
+        ins=[shm.make_consumer(links["ps"], lazy=8)],
+        outs=[shm.make_producer(links["ss"])],
         cnc=cnc,
         signer=lambda root: ref.sign(secret, root),
         slot=slot,
@@ -204,7 +204,7 @@ def build_store(links, cnc, *, leader_pub):
 
     return StoreStage(
         "store",
-        ins=[shm.Consumer(links["ss"], lazy=64)],
+        ins=[shm.make_consumer(links["ss"], lazy=64)],
         cnc=cnc,
         verify_sig=lambda r, s: ref.verify(r, s, leader_pub),
     )

@@ -63,15 +63,75 @@ class BenchGStage(Stage):
         self.pool = pool
         self.limit = limit
         self._i = 0
+        self._pool_ref = None  # strong ref: the pool the native form mirrors
+        self._pool_buf = b""
+        self._pool_tbl = None
+
+    def _native_pool(self):
+        """The pool in fdr_publish_pool form (joined buffer + (off, sz)
+        rows), rebuilt only when self.pool is swapped — so the sweep's
+        crossing carries zero per-frame Python work.  The cache holds a
+        strong reference (identity check, not id(): a freed list's id is
+        routinely reused by the replacement).  Payload sizes validate
+        against the link mtu here, once per pool — fdr_publish_pool
+        itself trusts the table (no per-frame bound check in C++)."""
+        if self._pool_ref is not self.pool:
+            import numpy as np
+
+            if not self.pool:
+                # the Python lane raises ZeroDivisionError at
+                # `pool[i % 0]`; an empty table handed to C++ would be a
+                # process-killing SIGFPE at `% pool_n` instead
+                raise ValueError("BenchGStage pool is empty")
+            mtu = self.outs[0].link.mtu
+            tbl = np.empty((len(self.pool), 2), dtype=np.uint64)
+            off = 0
+            for k, payload in enumerate(self.pool):
+                if len(payload) > mtu:
+                    raise ValueError(
+                        f"pool payload {k} ({len(payload)}B) exceeds link"
+                        f" mtu {mtu}"
+                    )
+                tbl[k, 0] = off
+                tbl[k, 1] = len(payload)
+                off += len(payload)
+            self._pool_buf = b"".join(self.pool)
+            self._pool_tbl = tbl
+            self._pool_ref = self.pool
+        return self._pool_buf, self._pool_tbl
 
     def after_credit(self) -> None:
         # burst-publish: one txn per sweep starves the burst-draining
         # consumers downstream (stage.py run_once)
-        for _ in range(max(1, self.burst)):
-            if self.limit is not None and self._i >= self.limit:
-                return
-            if not self.publish(0, self.pool[self._i % len(self.pool)],
-                                sig=self._i):
-                return
-            self._i += 1
-            self.metrics.inc("txn_gen")
+        n = max(1, self.burst)
+        if self.limit is not None:
+            n = min(n, self.limit - self._i)
+        if n <= 0:
+            return
+        p = self.outs[0]
+        pub_pool = getattr(p, "publish_pool", None)
+        if pub_pool is None:
+            for _ in range(n):
+                if not self.publish(0, self.pool[self._i % len(self.pool)],
+                                    sig=self._i):
+                    return
+                self._i += 1
+                self.metrics.inc("txn_gen")
+            return
+        # native ring lane: the whole sweep's frames in ONE crossing
+        # (tsorig stamped in C++ — this stage is the stream's origin)
+        buf, tbl = self._native_pool()
+        if self.ring_clock:
+            import time as _time
+
+            t0 = _time.perf_counter()
+            done = pub_pool(buf, tbl, len(self.pool), self._i, n)
+            self.ring_publish_s += _time.perf_counter() - t0
+        else:
+            done = pub_pool(buf, tbl, len(self.pool), self._i, n)
+        self._i += done
+        if done:
+            self.metrics.inc("txn_gen", done)
+            self.metrics.inc("frags_out", done)
+        if done < n:
+            self.metrics.inc("backpressure")

@@ -86,12 +86,15 @@ class ShredStage(Stage):
             if self.keep_sets:
                 self.sets.append(st)
             if self.outs:
-                for buf in st.data_shreds:
-                    self.publish(0, buf, sig=st.fec_set_idx, tsorig=tsorig)
-                    self.metrics.inc("data_shreds_out")
-                for buf in st.parity_shreds:
-                    self.publish(0, buf, sig=st.fec_set_idx, tsorig=tsorig)
-                    self.metrics.inc("parity_shreds_out")
+                # a whole FEC set's shreds in one ring crossing on the
+                # native lane (~65 frames; _room() pre-gated the credits)
+                items = [(buf, st.fec_set_idx, tsorig)
+                         for buf in st.data_shreds]
+                items += [(buf, st.fec_set_idx, tsorig)
+                          for buf in st.parity_shreds]
+                self.publish_burst_out(0, items)
+                self.metrics.inc("data_shreds_out", len(st.data_shreds))
+                self.metrics.inc("parity_shreds_out", len(st.parity_shreds))
 
 
 def deshred_entry_batch(batch: bytes) -> list[bytes]:

@@ -27,9 +27,33 @@ import time
 import zlib
 from bisect import bisect_left
 
+import numpy as np
+
 from firedancer_tpu.tango import shm
 from firedancer_tpu.tango.rings import CNC_SIG_HALT, CNC_SIG_RUN, Cnc, MCache
 from firedancer_tpu.utils import metrics as fm
+
+_pc = time.perf_counter
+
+# tango.native, resolved lazily: stages must boot (and the Python lane
+# must run) in toolchain-less environments where the import-time .so
+# build would fail
+_native_mod = None
+_native_probe_done = False
+
+
+def _native_ring():
+    global _native_mod, _native_probe_done
+    if not _native_probe_done:
+        _native_probe_done = True
+        # one probe source of truth (shm's build-and-load cache); the env
+        # switch is NOT consulted here — the drainer engages whenever the
+        # stage's consumers actually ARE native, however they were made
+        if shm._native_ring_available():
+            from firedancer_tpu.tango import native as fn
+
+            _native_mod = fn
+    return _native_mod
 
 
 class Metrics:
@@ -55,6 +79,7 @@ class Metrics:
         # histogram state: plain lists + float sums; bisect_left over a
         # tuple of precomputed edges is ~10x cheaper than np.searchsorted
         self._hedges: dict[str, tuple] = {}
+        self._hedges_np: dict[str, np.ndarray] = {}  # observe_batch lane
         self._hcounts: dict[str, list[int]] = {}
         self._hsums: dict[str, float] = {}
         for d in self.schema.defs:
@@ -75,6 +100,24 @@ class Metrics:
         c[bisect_left(self._hedges[name], value)] += 1
         if value > 0:
             self._hsums[name] += value
+
+    def observe_batch(self, name: str, values) -> None:
+        """Vectorized observe() over a 1-D ndarray — the native
+        burst-drain path observes a whole sweep's frag latencies from the
+        returned meta table in one searchsorted+bincount instead of a
+        clock read + bisect per frag."""
+        edges = self._hedges_np.get(name)
+        if edges is None:
+            edges = self._hedges_np[name] = np.asarray(
+                self._hedges[name], dtype=np.float64
+            )
+        c = self._hcounts[name]
+        bc = np.bincount(
+            np.searchsorted(edges, values, side="left"), minlength=len(c)
+        )
+        for j in np.flatnonzero(bc):
+            c[j] += int(bc[j])
+        self._hsums[name] += float(values[values > 0].sum())
 
     def hist(self, name: str) -> dict:
         return {
@@ -142,6 +185,16 @@ class Stage:
         self.require_credit = False
         # frags drained per run_once sweep (see run_once's burst loop)
         self.burst = 16
+        # native ring plane: when every input is a NativeConsumer the
+        # sweep drains through ONE fdr_drain FFI crossing (cached plan,
+        # rebuilt when the input list changes — e.g. a chaos LossyConsumer
+        # splice drops the stage back to the per-frag poll path)
+        self._drainer: tuple | None = None
+        # ring-cost instrument (bench.py): when enabled, poll/drain and
+        # publish time accumulate separately from stage compute
+        self.ring_clock = False
+        self.ring_poll_s = 0.0
+        self.ring_publish_s = 0.0
         # crc32, not builtin hash(): str hashing is salted per process
         # (PYTHONHASHSEED), and spawned children must derive the SAME
         # housekeeping phase for a given (name, seed) as the parent and
@@ -261,8 +314,12 @@ class Stage:
             # we can't forward would silently drop it.
             self.metrics.inc("backpressure_stall")
             return False
-        progressed = False
         n_in = len(self.ins)
+        if n_in:
+            drainer = self._native_drainer()
+            if drainer is not None:
+                return self._native_burst(drainer)
+        progressed = False
         # burst-drain: up to `burst` frags per sweep.  One-frag sweeps
         # make the COOPERATIVE scheduler pay the whole loop overhead
         # (credits, housekeeping checks, empty polls of sibling inputs)
@@ -278,7 +335,12 @@ class Stage:
                 idx = (self._in_rr + k) % n_in
                 cons = self.ins[idx]
                 seq = cons.seq
-                res = cons.poll()
+                if self.ring_clock:
+                    _t = _pc()
+                    res = cons.poll()
+                    self.ring_poll_s += _pc() - _t
+                else:
+                    res = cons.poll()
                 if res == shm.POLL_EMPTY:
                     continue
                 if res == shm.POLL_OVERRUN:
@@ -318,6 +380,96 @@ class Stage:
                 break
         return progressed
 
+    # -- native ring burst path ---------------------------------------------
+
+    def _native_drainer(self):
+        """The cached fdr_drain plan when EVERY input is a native-ring
+        consumer, else None (per-frag poll path — Python consumers,
+        LossyConsumer shims, mixed lanes).  Keyed on the input objects so
+        a spliced/replaced input rebuilds the plan."""
+        cached = self._drainer
+        # list == compares elements by identity here (consumers define no
+        # __eq__), so revalidation costs no allocation per sweep; a chaos
+        # LossyConsumer splice (stage.ins[i] = shim) breaks the equality
+        # and rebuilds the plan
+        if cached is not None and cached[0] == self.ins:
+            return cached[1]
+        drainer = None
+        fn = _native_ring()
+        if fn is not None and all(
+            type(c) is fn.NativeConsumer for c in self.ins
+        ):
+            drainer = fn.BurstDrainer(self.ins, max(1, self.burst))
+        self._drainer = (list(self.ins), drainer)
+        return drainer
+
+    def _native_burst(self, drainer) -> bool:
+        """One run_once sweep over the native ring plane: ONE FFI
+        crossing pulls up to `burst` frags from all inputs round-robin
+        into the drainer's arena; frag callbacks then run over the
+        returned meta table (after_frag semantics unchanged), and
+        frag_latency_ns is batch-observed from the tsorig column — no
+        per-frag Python timestamping."""
+        max_frags = self.burst if self.burst > 0 else 1
+        if self.require_credit and self.outs:
+            # never pull a frag we may not be able to forward: each input
+            # frag spends at most one credit per output link in every
+            # stage that sets require_credit (router/bank/poh)
+            cap = min(p.cr_avail for p in self.outs)
+            if cap < max_frags:
+                max_frags = cap
+        if max_frags <= 0:
+            return False
+        m = self.metrics
+        if self.ring_clock:
+            _t = _pc()
+            n, self._in_rr, d_ovr = drainer.drain(self._in_rr, max_frags)
+            self.ring_poll_s += _pc() - _t
+        else:
+            n, self._in_rr, d_ovr = drainer.drain(self._in_rr, max_frags)
+        if d_ovr:
+            m.inc("overrun", d_ovr)
+            tot = m.get("overrun")
+            # decimated like the per-frag path: one timeline tick per
+            # 64-overrun stride (arg = running total)
+            if (tot ^ (tot - d_ovr)) >> 6 or tot == d_ovr:
+                self.trace(fm.EV_OVERRUN, tot)
+        if n == 0:
+            return d_ovr > 0
+        # one block conversion each: meta rows become plain-int lists
+        # (python list indexing beats a numpy scalar read ~5x in the
+        # per-frag loop below) and payloads one contiguous bytes copy
+        # (frags land back-to-back in the arena, so the last frag's end
+        # bounds them all; bytes slicing is then near-free per frag)
+        rows = drainer.meta[:n].tolist()
+        last = rows[n - 1]
+        buf = drainer.arena[: last[2] + last[3]].tobytes()
+        before_frag = self.before_frag
+        during_frag = self.during_frag
+        after_frag = self.after_frag
+        n_done = 0
+        ts_done: list[int] = []
+        for row in rows:
+            idx = row[7]
+            if not before_frag(idx, row[0], row[1]):
+                m.inc("filtered")
+                continue
+            off = row[2]
+            payload = buf[off : off + row[3]]
+            during_frag(idx, row, payload)
+            after_frag(idx, row, payload)
+            n_done += 1
+            ts_done.append(row[5])
+        if n_done:
+            m.inc("frags_in", n_done)
+            # batch latency observation: one clock read for the sweep
+            ts_col = np.asarray(ts_done, dtype=np.int64)
+            lat = shm.now_ns() - ts_col
+            ok = lat[(ts_col > 0) & (lat >= 0)]
+            if ok.size:
+                m.observe_batch("frag_latency_ns", ok)
+        return True
+
     def run(
         self,
         max_iters: int | None = None,
@@ -354,9 +506,48 @@ class Stage:
         self, out_idx: int, payload: bytes, sig: int = 0, tsorig: int = 0
     ) -> bool:
         p = self.outs[out_idx]
-        ok = p.try_publish(payload, sig=sig, tsorig=tsorig)
+        if self.ring_clock:
+            _t = _pc()
+            ok = p.try_publish(payload, sig=sig, tsorig=tsorig)
+            self.ring_publish_s += _pc() - _t
+        else:
+            ok = p.try_publish(payload, sig=sig, tsorig=tsorig)
         if ok:
             self.metrics.inc("frags_out")
         else:
             self.metrics.inc("backpressure")
         return ok
+
+    def publish_burst_out(self, out_idx: int, items: list) -> int:
+        """Publish a frame list [(payload, sig, tsorig), ...] on one
+        output — ONE ring crossing on the native lane
+        (fdr_publish_burst), an in-order per-frame loop on the Python
+        lane.  Both stop at credit exhaustion; the shortfall counts as
+        backpressure and stays with the caller.  Returns frames
+        published."""
+        if not items:
+            return 0
+        p = self.outs[out_idx]
+        burst = getattr(p, "publish_burst", None)
+        if self.ring_clock:
+            _t = _pc()
+            n = self._publish_items(p, burst, items)
+            self.ring_publish_s += _pc() - _t
+        else:
+            n = self._publish_items(p, burst, items)
+        if n:
+            self.metrics.inc("frags_out", n)
+        if n < len(items):
+            self.metrics.inc("backpressure", len(items) - n)
+        return n
+
+    @staticmethod
+    def _publish_items(p, burst, items) -> int:
+        if burst is not None:
+            return burst(items)
+        n = 0
+        for payload, sig, tsorig in items:
+            if not p.try_publish(payload, sig=sig, tsorig=tsorig):
+                break
+            n += 1
+        return n

@@ -199,6 +199,13 @@ class VerifyStage(Stage):
         self._gen = _Acc()
         self._comb = _Acc()
         self._inflight: list[_Pending] = []
+        # verified frames awaiting output-ring credits: a whole batch can
+        # complete while the out ring holds fewer credits than the burst,
+        # and dropping the tail (the old per-frag posture) loses verified
+        # work — queue and retry, bounded so a dead consumer cannot grow
+        # the queue without limit
+        self._emit_queue: list = []
+        self._emit_queue_max = 8192
 
     # -- observability ------------------------------------------------------
 
@@ -216,6 +223,9 @@ class VerifyStage(Stage):
             .counter("batch_elems", "signature elements dispatched")
             .counter("comb_elems", "elements on the cached-signer lane")
             .counter("comb_filled", "comb tables installed in the bank")
+            .counter("emit_dropped",
+                     "verified frames dropped after the bounded emit"
+                     " retry queue overflowed (dead/wedged consumer)")
             .histogram(
                 "batch_fill",
                 fm.exp_buckets(1, 4096, 13),
@@ -294,6 +304,10 @@ class VerifyStage(Stage):
                 acc.opened_at = time.monotonic()
 
     def after_credit(self) -> None:
+        # credits are available again: retry frames a full out ring
+        # parked on the emit queue before touching new work
+        if self._emit_queue:
+            self._emit_burst([])
         # deadline-based batch close (p99 latency at low occupancy)
         now = time.monotonic()
         for acc in (self._gen, self._comb):
@@ -496,28 +510,53 @@ class VerifyStage(Stage):
             # all-reduce decides the common case instead of a numpy
             # slice + reduction per txn (~1.5us/txn of the host path)
             all_ok = bool(mask[: head.n_elems].all())
+            emits = []
             for payload, desc, (a, b), tsorig in zip(
                 head.payloads, head.descs, head.elem_ranges, head.tsorigs
             ):
                 if all_ok or bool(mask[a:b].all()):
-                    self._emit(payload, desc, tsorig)
+                    emits.append(self._encode_emit(payload, desc, tsorig))
                 else:
                     self.metrics.inc("verify_fail")
+            self._emit_burst(emits)
             if block:
                 break
 
-    def _emit(self, payload: bytes, desc_pair, tsorig: int = 0) -> None:
+    def _encode_emit(self, payload: bytes, desc_pair, tsorig: int):
         desc, packed = desc_pair
         if packed is None:
             packed = ft.txn_pack(desc)
         out = encode_verified_packed(payload, packed)
-        if self.outs:
-            # first signature's tag rides in the frag sig for cheap dedup
-            self.publish(
-                0, out, sig=sig_tag(_packed_first_sig(payload, packed)),
-                tsorig=tsorig,
-            )
-        self.metrics.inc("txn_verified")
+        # first signature's tag rides in the frag sig for cheap dedup
+        return out, sig_tag(_packed_first_sig(payload, packed)), tsorig
+
+    def _emit_burst(self, emits: list) -> None:
+        """Publish a completed batch's verified frags downstream — ONE
+        ring crossing on the native lane (fdr_publish_burst), in-order
+        per-frag on the Python lane.  Frames past credit exhaustion stay
+        queued and retry next credit window (after_credit), so a full
+        out ring backpressures verify instead of losing verified txns."""
+        if emits:
+            self.metrics.inc("txn_verified", len(emits))
+        if not self.outs:
+            return
+        q = self._emit_queue
+        q.extend(emits)
+        if not q:
+            return
+        n = self.publish_burst_out(0, q)
+        if n == len(q):
+            q.clear()
+        else:
+            del q[:n]
+            if len(q) > self._emit_queue_max:
+                drop = len(q) - self._emit_queue_max
+                del q[:drop]
+                self.metrics.inc("emit_dropped", drop)
+
+    def _emit(self, payload: bytes, desc_pair, tsorig: int = 0) -> None:
+        """Single-frag emit (compat surface for tests/subclasses)."""
+        self._emit_burst([self._encode_emit(payload, desc_pair, tsorig)])
 
     def flush(self) -> None:
         """Close and drain everything (test/shutdown path)."""
@@ -527,6 +566,8 @@ class VerifyStage(Stage):
                 self._close_batch(acc)
         while self._inflight:
             self._drain(block=True)
+        if self._emit_queue:
+            self._emit_burst([])
 
 
 def encode_verified_packed(payload: bytes, packed: bytes) -> bytes:

@@ -38,10 +38,16 @@ from . import shm
 
 
 class LossyConsumer:
-    """Wraps a `shm.Consumer`; same polling surface (`poll`,
-    `publish_progress`, attribute passthrough) so a Stage's input list
-    accepts it in place.  Fault counters (`dropped`, `duplicated`,
-    `reordered`) feed the chaos conservation invariants."""
+    """Wraps a `shm.Consumer` OR a `native.NativeConsumer`; same polling
+    surface (`poll`, `has_pending`, `publish_progress`, attribute
+    passthrough) so a Stage's input list accepts it in place — chaos
+    scenarios run identically with `FDTPU_NATIVE_RING=1` (both lanes
+    return u64-ndarray metas, so sig values >= 2^63 survive the copy).
+    Splicing the shim over a native input also drops that stage off the
+    one-crossing burst-drain path (stage.py `_native_drainer` keys on the
+    input objects), so every frag passes through the fault model.  Fault
+    counters (`dropped`, `duplicated`, `reordered`) feed the chaos
+    conservation invariants."""
 
     def __init__(self, inner: shm.Consumer, rng: Rng, *,
                  drop_p: float = 0.0, dup_p: float = 0.0,
@@ -99,6 +105,12 @@ class LossyConsumer:
                 return nxt
             # nothing to swap with: in-order after all
         return meta, payload
+
+    def has_pending(self) -> bool:
+        # a shim-held frag (dup redelivery / reorder partner) IS pending
+        # work even when the inner ring is empty — the adaptive
+        # batch-close probe must not read backlog as idle ingress
+        return bool(self._ready) or self._inner.has_pending()
 
     def publish_progress(self) -> None:
         self._inner.publish_progress()

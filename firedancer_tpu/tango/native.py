@@ -1,13 +1,31 @@
-"""ctypes bindings for the native (C++) tango ring hot path.
+"""ctypes bindings for the native (C++) tango ring plane.
 
 The runtime around the TPU compute is native where the reference's is
-(SURVEY §7.1): native/fd_ring.cpp implements the per-frag critical path
-(publish + poll with the BUSY-bit/speculative-read protocol) directly
-over the SAME shared-memory blocks tango/shm.py creates — a native
-producer interoperates with a Python consumer and vice versa, which the
-differential tests assert.  The layout offsets are computed once in
-Python (shm._layout) and handed to C++ in the init struct: one source of
-truth for the wire format.
+(SURVEY §7.1): native/fd_ring.cpp implements the COMPLETE link protocol
+(credit-gated publish over the reliable fseqs, lazy consumer progress
+publication, overrun resync + counting, tsorig pass-through / tspub
+stamping) directly over the SAME shared-memory blocks tango/shm.py
+creates — a native producer interoperates with a Python consumer and
+vice versa, which the differential tests assert.  The layout offsets are
+computed once in Python (shm._layout) and handed to C++ in the init
+struct: one source of truth for the wire format.
+
+Two granularities:
+
+  - `NativeProducer` / `NativeConsumer` are drop-ins for shm.Producer /
+    shm.Consumer (same surface: try_publish, poll, has_pending,
+    publish_progress, cr_avail/refresh_credits), one FFI call per op —
+    construct them through shm.make_producer / shm.make_consumer, which
+    honor the FDTPU_NATIVE_RING switch;
+  - `BurstDrainer` + `NativeProducer.publish_burst` are the stage-sweep
+    entry points: ONE crossing drains all of a stage's input links into
+    a reusable arena (metas as a numpy-viewable table) or publishes a
+    whole frame list — runtime/stage.py's run_once burst path.
+
+Teardown discipline: every native endpoint pins the link's shm buffer
+via a ctypes from_buffer view, so it registers with its ShmLink and
+`ShmLink.close()` detaches them first — no BufferError-path fallback on
+native-ring runs.
 
 The .so builds on demand with the baked-in g++ and is cached next to the
 source; environments without a toolchain raise NativeUnavailable and
@@ -18,6 +36,9 @@ from __future__ import annotations
 
 import ctypes
 import os
+import weakref
+
+import numpy as np
 
 from firedancer_tpu.utils.nativebuild import NativeUnavailable, build_so
 
@@ -30,6 +51,10 @@ _SRC = os.path.join(
 )
 _SO = os.path.join(os.path.dirname(_SRC), "fd_ring.so")
 
+_MASK64 = (1 << 64) - 1
+FDR_MAX_REL = 16  # mirrors the C++ enum
+DRAIN_NCOL = 8  # 7 mcache-compatible columns + in_idx
+
 
 class _Link(ctypes.Structure):
     _fields_ = [
@@ -39,6 +64,8 @@ class _Link(ctypes.Structure):
         ("mcache_off", ctypes.c_uint64),
         ("dcache_off", ctypes.c_uint64),
         ("dcache_sz", ctypes.c_uint64),
+        ("fseq_off", ctypes.c_uint64),
+        ("n_fseq", ctypes.c_uint64),
     ]
 
 
@@ -47,11 +74,21 @@ class _Producer(ctypes.Structure):
         ("seq", ctypes.c_uint64),
         ("chunk", ctypes.c_uint64),
         ("wmark", ctypes.c_uint64),
+        ("cr_avail", ctypes.c_uint64),
+        ("cr_max", ctypes.c_uint64),
+        ("n_rel", ctypes.c_uint64),
+        ("rel_idx", ctypes.c_uint64 * FDR_MAX_REL),
     ]
 
 
 class _Consumer(ctypes.Structure):
-    _fields_ = [("seq", ctypes.c_uint64), ("ovrn_cnt", ctypes.c_uint64)]
+    _fields_ = [
+        ("seq", ctypes.c_uint64),
+        ("ovrn_cnt", ctypes.c_uint64),
+        ("fseq_idx", ctypes.c_uint64),
+        ("lazy", ctypes.c_uint64),
+        ("since_publish", ctypes.c_uint64),
+    ]
 
 
 _lib = None
@@ -63,28 +100,37 @@ def _load():
         return _lib
     build_so(_SRC, _SO)
     lib = ctypes.CDLL(_SO)
-    lib.fdr_producer_init.argtypes = [
-        ctypes.POINTER(_Link), ctypes.POINTER(_Producer),
+    PL = ctypes.POINTER(_Link)
+    PP = ctypes.POINTER(_Producer)
+    PC = ctypes.POINTER(_Consumer)
+    u64 = ctypes.c_uint64
+    lib.fdr_producer_init.argtypes = [PL, PP]
+    lib.fdr_refresh_credits.argtypes = [PL, PP]
+    lib.fdr_refresh_credits.restype = u64
+    lib.fdr_publish.argtypes = [PL, PP, ctypes.c_char_p, u64, u64, u64, u64]
+    lib.fdr_try_publish.argtypes = [PL, PP, ctypes.c_char_p, u64, u64, u64]
+    lib.fdr_try_publish.restype = ctypes.c_int
+    lib.fdr_publish_burst.argtypes = [
+        PL, PP, ctypes.c_char_p, ctypes.c_void_p, u64,
     ]
-    lib.fdr_publish.argtypes = [
-        ctypes.POINTER(_Link), ctypes.POINTER(_Producer),
-        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
-        ctypes.c_uint64, ctypes.c_uint64,
+    lib.fdr_publish_burst.restype = u64
+    lib.fdr_publish_pool.argtypes = [
+        PL, PP, ctypes.c_char_p, ctypes.c_void_p, u64, u64, u64,
     ]
-    lib.fdr_poll.argtypes = [
-        ctypes.POINTER(_Link), ctypes.POINTER(_Consumer),
-        ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64),
-    ]
+    lib.fdr_publish_pool.restype = u64
+    lib.fdr_publish_progress.argtypes = [PL, PC]
+    lib.fdr_poll.argtypes = [PL, PC, ctypes.c_char_p, ctypes.POINTER(u64)]
     lib.fdr_poll.restype = ctypes.c_int
-    lib.fdr_publish_n.argtypes = [
-        ctypes.POINTER(_Link), ctypes.POINTER(_Producer),
-        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
+    lib.fdr_has_pending.argtypes = [PL, PC]
+    lib.fdr_has_pending.restype = ctypes.c_int
+    lib.fdr_drain.argtypes = [
+        ctypes.POINTER(PL), ctypes.POINTER(PC), u64, ctypes.POINTER(u64),
+        u64, ctypes.c_void_p, u64, ctypes.c_void_p, ctypes.POINTER(u64),
     ]
-    lib.fdr_consume_n.argtypes = [
-        ctypes.POINTER(_Link), ctypes.POINTER(_Consumer),
-        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
-    ]
-    lib.fdr_consume_n.restype = ctypes.c_uint64
+    lib.fdr_drain.restype = ctypes.c_int64
+    lib.fdr_publish_n.argtypes = [PL, PP, ctypes.c_char_p, u64, u64]
+    lib.fdr_consume_n.argtypes = [PL, PC, ctypes.c_char_p, u64, u64]
+    lib.fdr_consume_n.restype = u64
     _lib = lib
     return lib
 
@@ -103,46 +149,173 @@ def _link_struct(link: shm.ShmLink) -> tuple[_Link, object]:
         mcache_off=a,
         dcache_off=b,
         dcache_sz=link.dcache_sz,
+        fseq_off=c,
+        n_fseq=link.n_fseq,
     )
     return ls, buf  # buf must outlive the struct (holds the buffer ref)
 
 
-class NativeProducer:
-    """Drop-in for shm.Producer's publish path, native hot loop."""
+def _register(link: shm.ShmLink, obj) -> None:
+    """Teardown registration: ShmLink.close() detaches every live native
+    endpoint (dropping its from_buffer pin) before closing the mapping."""
+    reg = getattr(link, "_natives", None)
+    if reg is None:
+        reg = []
+        link._natives = reg
+    reg.append(weakref.ref(obj))
 
-    def __init__(self, link: shm.ShmLink):
+
+class NativeProducer:
+    """Drop-in for shm.Producer: credit-gated publish, native hot loop.
+
+    reliable_fseq_idx matches shm.Producer's: None = all the link's
+    fseqs are reliable consumers; [] = free-running (never backpressured,
+    laps slow consumers — the overrun-test shape)."""
+
+    def __init__(self, link: shm.ShmLink,
+                 reliable_fseq_idx: list[int] | None = None):
         self._lib = _load()
         self._ls, self._keep = _link_struct(link)
         self._p = _Producer()
-        self._lib.fdr_producer_init(ctypes.byref(self._ls), ctypes.byref(self._p))
+        self._lib.fdr_producer_init(ctypes.byref(self._ls),
+                                    ctypes.byref(self._p))
+        idxs = (reliable_fseq_idx if reliable_fseq_idx is not None
+                else list(range(link.n_fseq)))
+        if len(idxs) > FDR_MAX_REL:
+            raise ValueError(f"more than {FDR_MAX_REL} reliable fseqs")
+        for i in idxs:
+            if not 0 <= i < link.n_fseq:
+                # shm.Producer parity: link.fseqs[i] would raise — an
+                # unchecked index here would read cnc words as fseqs
+                raise IndexError(f"reliable fseq idx {i} out of range"
+                                 f" (n_fseq={link.n_fseq})")
+        self._p.n_rel = len(idxs)
+        for k, i in enumerate(idxs):
+            self._p.rel_idx[k] = i
+        # byref results cached once: the per-frag call must not rebuild
+        # argument temporaries (the churn fdlint FD212 bans in frag paths)
+        self._lsp = ctypes.byref(self._ls)
+        self._pp = ctypes.byref(self._p)
+        self.link = link
+        _register(link, self)
 
     @property
     def seq(self) -> int:
         return self._p.seq
 
+    @property
+    def cr_avail(self) -> int:
+        return self._p.cr_avail
+
+    def refresh_credits(self) -> None:
+        self._lib.fdr_refresh_credits(self._lsp, self._pp)
+
+    def try_publish(self, payload: bytes, sig: int = 0, tsorig: int = 0) -> bool:
+        """shm.Producer.try_publish parity; False means backpressured."""
+        if self._lsp is None:
+            raise RuntimeError("detached native producer (link closed)")
+        if len(payload) > self.link.mtu:
+            raise ValueError("payload exceeds mtu")
+        return bool(self._lib.fdr_try_publish(
+            self._lsp, self._pp, payload, len(payload), sig & _MASK64,
+            tsorig,
+        ))
+
+    def publish_burst(self, items) -> int:
+        """Publish a frame list [(payload, sig, tsorig), ...] with ONE
+        crossing; credit-gated per frame.  Returns frames published (the
+        tail past credit exhaustion stays with the caller).  The frame
+        table is built only for the creditable PREFIX — a retry queue
+        deep in backpressure must not pay an O(queue) join per sweep to
+        publish a handful of frames."""
+        n = len(items)
+        if not n:
+            return 0
+        if self._lsp is None:
+            raise RuntimeError("detached native producer (link closed)")
+        if self._p.cr_avail < n:
+            self.refresh_credits()
+        n = min(n, self._p.cr_avail)
+        if not n:
+            return 0
+        mtu = self.link.mtu
+        tbl = np.empty((n, 4), dtype=np.uint64)
+        off = 0
+        for k in range(n):
+            payload, sig, tsorig = items[k]
+            sz = len(payload)
+            if sz > mtu:
+                raise ValueError("payload exceeds mtu")
+            tbl[k, 0] = off
+            tbl[k, 1] = sz
+            tbl[k, 2] = sig & _MASK64
+            tbl[k, 3] = tsorig
+            off += sz
+        buf = b"".join(items[k][0] for k in range(n))
+        return int(self._lib.fdr_publish_burst(
+            self._lsp, self._pp, buf, tbl.ctypes.data, n,
+        ))
+
+    def publish_pool(self, buf: bytes, tbl: np.ndarray, pool_n: int,
+                     start_sig: int, n: int) -> int:
+        """Cycle a pregenerated pool (joined buffer + (off, sz) rows,
+        both built once) publishing n frames with sig = start_sig + k,
+        tsorig stamped in C++ — the synthetic-ingress crossing
+        (runtime/benchg.py), zero per-frame Python work.  Contract: the
+        caller validates every pool sz <= link mtu when it BUILDS the
+        table (BenchGStage._native_pool does); the C++ side trusts the
+        rows — an oversized sz would memcpy past the dcache region."""
+        if self._lsp is None:
+            raise RuntimeError("detached native producer (link closed)")
+        return int(self._lib.fdr_publish_pool(
+            self._lsp, self._pp, buf, tbl.ctypes.data, pool_n,
+            start_sig, n,
+        ))
+
     def publish(self, payload: bytes, sig: int = 0, tsorig: int = 0) -> None:
+        """Raw uncredited publish (mcache.publish analog; bench/tests)."""
+        if self._lsp is None:
+            raise RuntimeError("detached native producer (link closed)")
         ts = tsorig or shm.now_ns()
         self._lib.fdr_publish(
-            ctypes.byref(self._ls), ctypes.byref(self._p),
-            payload, len(payload), sig, ts, shm.now_ns(),
+            self._lsp, self._pp, payload, len(payload), sig & _MASK64,
+            ts, shm.now_ns(),
         )
 
     def publish_n(self, payload: bytes, n: int) -> None:
-        self._lib.fdr_publish_n(
-            ctypes.byref(self._ls), ctypes.byref(self._p), payload,
-            len(payload), n,
-        )
+        self._lib.fdr_publish_n(self._lsp, self._pp, payload, len(payload), n)
+
+    def detach(self) -> None:
+        """Drop the shm-buffer pin (ShmLink.close path); the producer is
+        unusable afterwards, exactly like a closed link's numpy views."""
+        self._lsp = self._pp = None
+        self._ls = self._p = None
+        self._keep = None
+        self.link = None
 
 
 class NativeConsumer:
-    """Drop-in for shm.Consumer's poll path, native hot loop."""
+    """Drop-in for shm.Consumer: poll + lazy fseq progress, native loop."""
 
-    def __init__(self, link: shm.ShmLink):
+    def __init__(self, link: shm.ShmLink, fseq_idx: int = 0, lazy: int = 64):
+        if not 0 <= fseq_idx < link.n_fseq:
+            # shm.Consumer parity (link.fseqs[fseq_idx] raises): an
+            # unchecked index would publish progress over the cnc words
+            raise IndexError(f"fseq idx {fseq_idx} out of range"
+                             f" (n_fseq={link.n_fseq})")
         self._lib = _load()
         self._ls, self._keep = _link_struct(link)
         self._c = _Consumer()
+        self._c.fseq_idx = fseq_idx
+        self._c.lazy = lazy
+        self.lazy = lazy
         self._out = ctypes.create_string_buffer(link.mtu)
         self._meta = (ctypes.c_uint64 * 7)()
+        self._meta_np = np.frombuffer(self._meta, dtype=np.uint64)
+        self._lsp = ctypes.byref(self._ls)
+        self._cp = ctypes.byref(self._c)
+        self.link = link
+        _register(link, self)
 
     @property
     def seq(self) -> int:
@@ -153,18 +326,94 @@ class NativeConsumer:
         return self._c.ovrn_cnt
 
     def poll(self):
-        """(meta tuple, payload bytes) | shm.POLL_EMPTY | shm.POLL_OVERRUN."""
-        rc = self._lib.fdr_poll(
-            ctypes.byref(self._ls), ctypes.byref(self._c), self._out, self._meta
-        )
+        """(meta u64 row copy, payload bytes) | POLL_EMPTY | POLL_OVERRUN.
+
+        The per-frag fallback surface (LossyConsumer wraps it, mixed-lane
+        stages poll it); all-native stages drain through BurstDrainer
+        instead.  Meta is a u64 ndarray copy like shm.Consumer's — sig
+        values >= 2^63 must survive the round trip."""
+        if self._lsp is None:
+            raise RuntimeError("detached native consumer (link closed)")
+        rc = self._lib.fdr_poll(self._lsp, self._cp, self._out, self._meta)
         if rc == -1:
             return shm.POLL_EMPTY
         if rc == 1:
             return shm.POLL_OVERRUN
-        meta = tuple(self._meta)
-        return meta, self._out.raw[: self._meta[3]]
+        return self._meta_np.copy(), self._out.raw[: int(self._meta[3])]
+
+    def has_pending(self) -> bool:
+        """Non-destructive: a frag is ready at this consumer's cursor
+        (the adaptive batch-close probe, shm.Consumer.has_pending)."""
+        if self._lsp is None:
+            raise RuntimeError("detached native consumer (link closed)")
+        return bool(self._lib.fdr_has_pending(self._lsp, self._cp))
+
+    def publish_progress(self) -> None:
+        if self._lsp is None:
+            raise RuntimeError("detached native consumer (link closed)")
+        self._lib.fdr_publish_progress(self._lsp, self._cp)
 
     def consume_n(self, n: int, spin_limit: int = 1 << 30) -> int:
+        if self._lsp is None:
+            raise RuntimeError("detached native consumer (link closed)")
         return self._lib.fdr_consume_n(
-            ctypes.byref(self._ls), ctypes.byref(self._c), self._out, n, spin_limit
+            self._lsp, self._cp, self._out, n, spin_limit
         )
+
+    def detach(self) -> None:
+        self._lsp = self._cp = None
+        self._ls = self._c = None
+        self._keep = None
+        self.link = None
+
+
+class BurstDrainer:
+    """One-crossing-per-sweep input plane over a stage's all-native ins.
+
+    Owns a reusable payload arena + an (max_frags, 8) u64 meta table
+    (columns 0..6 index-compatible with an mcache row — chunk repurposed
+    as the arena byte offset — column 7 the input index), so the stage
+    loop reads frags as numpy rows with zero per-frag FFI."""
+
+    def __init__(self, consumers: list[NativeConsumer], max_frags: int):
+        self._lib = _load()
+        self.consumers = list(consumers)
+        n = len(self.consumers)
+        if not n:
+            raise ValueError("drainer needs at least one consumer")
+        self.max_frags = max_frags
+        mtu = max(c.link.mtu for c in self.consumers)
+        self.arena = np.zeros(max_frags * mtu, dtype=np.uint8)
+        self.meta = np.zeros((max_frags, DRAIN_NCOL), dtype=np.uint64)
+        self._links = (ctypes.POINTER(_Link) * n)(
+            *[ctypes.pointer(c._ls) for c in self.consumers]
+        )
+        self._cons = (ctypes.POINTER(_Consumer) * n)(
+            *[ctypes.pointer(c._c) for c in self.consumers]
+        )
+        self._n = n
+        self._rr = ctypes.c_uint64(0)
+        self._rrp = ctypes.byref(self._rr)
+        self._ovrn = ctypes.c_uint64(0)
+        self._ovrnp = ctypes.byref(self._ovrn)
+        self._arena_p = self.arena.ctypes.data
+        self._arena_sz = self.arena.size
+        self._meta_p = self.meta.ctypes.data
+
+    def drain(self, rr: int, max_frags: int) -> tuple[int, int, int]:
+        """Drain up to max_frags frags round-robin starting at input rr;
+        returns (frags delivered, next rr cursor, overruns this sweep).
+        Payloads land in self.arena at the meta rows' byte offsets."""
+        for c in self.consumers:
+            # the drainer's struct pointers outlive a detach (they pin
+            # the struct objects), but the structs' base would then point
+            # into an unmapped buffer — refuse instead of segfaulting
+            if c._lsp is None:
+                raise RuntimeError("detached native consumer (link closed)")
+        self._rr.value = rr % self._n
+        n = self._lib.fdr_drain(
+            self._links, self._cons, self._n, self._rrp,
+            min(max_frags, self.max_frags), self._arena_p, self._arena_sz,
+            self._meta_p, self._ovrnp,
+        )
+        return int(n), int(self._rr.value), int(self._ovrn.value)

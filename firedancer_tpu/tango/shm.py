@@ -47,6 +47,10 @@ class ShmLink:
                  dcache_sz: int | None = None):
         self._shm = shm
         self.owner = owner
+        # native (C++) endpoints pin shm.buf via ctypes from_buffer views;
+        # they register here so close() can detach them first (weakrefs —
+        # an already-collected endpoint needs no detach)
+        self._natives: list = []
         self.depth = depth
         self.mtu = mtu
         self.n_fseq = n_fseq
@@ -106,8 +110,16 @@ class ShmLink:
     def close(self) -> None:
         # Views into shm.buf must be dropped before the mapping can close;
         # Producer/Consumer objects may still hold some.  Best effort: drop
-        # ours, collect, and let the mapping live until process exit if
-        # foreign views remain (harmless — shm is reference counted).
+        # ours, detach registered native endpoints (their ctypes
+        # from_buffer views pin the buffer harder than numpy views — a
+        # live one makes every close take the BufferError path), collect,
+        # and let the mapping live until process exit if foreign views
+        # remain (harmless — shm is reference counted).
+        for ref in getattr(self, "_natives", ()):
+            obj = ref()
+            if obj is not None:
+                obj.detach()
+        self._natives = []
         self.mcache = self.dcache = self.fseqs = self.cnc = None
         import gc
 
@@ -235,3 +247,54 @@ class Consumer:
     def publish_progress(self) -> None:
         self.fseq.publish(self.seq)
         self._since_publish = 0
+
+
+# -- ring-lane selection ------------------------------------------------------
+#
+# The native (C++) ring plane is a drop-in for Producer/Consumer over the
+# SAME byte-level wire format, so mixed native/Python topologies keep
+# working (a spawned child without a toolchain simply joins with Python
+# rings).  Construct through these factories wherever a topology wires
+# its stages; FDTPU_NATIVE_RING=0 restores the Python rings.
+
+_NATIVE_RING_OK: bool | None = None
+
+
+def _native_ring_available() -> bool:
+    global _NATIVE_RING_OK
+    if _NATIVE_RING_OK is None:
+        try:
+            from . import native
+
+            native._load()
+            _NATIVE_RING_OK = True
+        except Exception:  # toolchain-less environment / build failure
+            _NATIVE_RING_OK = False
+    return _NATIVE_RING_OK
+
+
+def native_ring_enabled() -> bool:
+    """The native ring lane switch: FDTPU_NATIVE_RING=0 forces the Python
+    rings; default auto (on when native/fd_ring.so builds and loads —
+    the same posture as the native pack/exec lanes)."""
+    if os.environ.get("FDTPU_NATIVE_RING", "") == "0":
+        return False
+    return _native_ring_available()
+
+
+def make_producer(link: "ShmLink", reliable_fseq_idx: list[int] | None = None):
+    """A publish endpoint on the active ring lane (Producer-compatible)."""
+    if native_ring_enabled():
+        from . import native
+
+        return native.NativeProducer(link, reliable_fseq_idx=reliable_fseq_idx)
+    return Producer(link, reliable_fseq_idx)
+
+
+def make_consumer(link: "ShmLink", fseq_idx: int = 0, lazy: int = 64):
+    """A receive endpoint on the active ring lane (Consumer-compatible)."""
+    if native_ring_enabled():
+        from . import native
+
+        return native.NativeConsumer(link, fseq_idx=fseq_idx, lazy=lazy)
+    return Consumer(link, fseq_idx=fseq_idx, lazy=lazy)

@@ -1,17 +1,30 @@
-// Native tango ring hot path: single-producer publish + consumer poll.
+// Native tango ring plane: the complete link protocol in C++.
 //
 // The C++ half of the runtime (the reference's tango layer is C for the
 // same reason: the ring protocol IS the per-frag overhead).  Operates on
 // the exact shared-memory layout tango/shm.py creates — the layout
 // offsets arrive in the init struct from Python, so there is exactly one
-// source of truth for the format.  Protocol parity with tango/rings.py:
+// source of truth for the format.  Protocol parity with tango/rings.py +
+// tango/shm.py, asserted by the differential suite (tests/test_native_ring):
 //
 //   - mcache rows of 7 u64 (seq, sig, chunk, sz, ctl, tsorig, tspub);
 //     BUSY bit (1<<63) set in the seq word while a row is mid-overwrite;
 //     seq word written LAST on publish (release), checked before AND
 //     after the payload copy on poll (the speculative-read discipline);
 //   - compact dcache chunk allocation (64-byte granules, wrap at wmark);
-//   - overrun detection by seq comparison in 64-bit wraparound space.
+//   - overrun detection by seq comparison in 64-bit wraparound space;
+//   - credit flow control over the link's reliable fseqs
+//     (shm.Producer.try_publish / rings.FlowControl.credits, exactly);
+//   - lazy consumer progress publication to the fseq cell (the same
+//     `lazy` cadence shm.Consumer keeps);
+//   - tsorig pass-through + tspub stamping per hop (CLOCK_MONOTONIC —
+//     the same clock Python's time.monotonic_ns() reads, so latency
+//     attribution spans mixed native/Python topologies).
+//
+// The burst entry points are the point of the module: fdr_drain sweeps
+// ALL of a stage's input links round-robin into a reusable arena and
+// fdr_publish_burst pushes a frame list — one FFI crossing per run_once
+// sweep instead of one per frag (runtime/stage.py's burst-drain path).
 //
 // Build: g++ -O2 -shared -fPIC -o fd_ring.so fd_ring.cpp
 // (tango/native.py builds and loads it via ctypes).
@@ -19,15 +32,25 @@
 #include <atomic>
 #include <cstdint>
 #include <cstring>
+#include <ctime>
 
 namespace {
 
 constexpr uint64_t BUSY = 1ull << 63;
 constexpr uint64_t CHUNK_SZ = 64;
 constexpr int NCOL = 7;
+constexpr int DRAIN_NCOL = 8;  // 7 mcache cols (chunk -> arena offset) + in_idx
 
 inline int64_t seq_diff(uint64_t a, uint64_t b) {
   return (int64_t)(a - b);
+}
+
+inline uint64_t now_ns() {
+  // CLOCK_MONOTONIC: the exact clock behind time.monotonic_ns(), so a
+  // C++-stamped tspub/tsorig compares against Python-side readings.
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (uint64_t)ts.tv_sec * 1000000000ull + (uint64_t)ts.tv_nsec;
 }
 
 inline std::atomic<uint64_t>* row(uint8_t* base, uint64_t mcache_off,
@@ -41,6 +64,8 @@ inline std::atomic<uint64_t>* row(uint8_t* base, uint64_t mcache_off,
 
 extern "C" {
 
+enum { FDR_MAX_REL = 16 };  // reliable consumers per producer (fctl fan-in)
+
 // Mirrors the python-side link geometry; filled by tango/native.py from
 // shm._layout so C++ never re-derives the format.
 struct fdr_link {
@@ -50,28 +75,65 @@ struct fdr_link {
   uint64_t mcache_off;
   uint64_t dcache_off;
   uint64_t dcache_sz;
+  uint64_t fseq_off;
+  uint64_t n_fseq;
 };
 
 struct fdr_producer {
   uint64_t seq;
-  uint64_t chunk;  // compact dcache cursor (granules)
-  uint64_t wmark;  // last chunk a max-size payload may start at
+  uint64_t chunk;     // compact dcache cursor (granules)
+  uint64_t wmark;     // last chunk a max-size payload may start at
+  uint64_t cr_avail;  // credits toward the slowest reliable consumer
+  uint64_t cr_max;    // = depth (rings.FlowControl default)
+  uint64_t n_rel;     // reliable fseq count (0 = free-running producer)
+  uint64_t rel_idx[FDR_MAX_REL];
 };
 
 struct fdr_consumer {
   uint64_t seq;
   uint64_t ovrn_cnt;
+  uint64_t fseq_idx;
+  uint64_t lazy;  // publish progress every `lazy` frags (0 = every frag,
+                  // shm.Consumer's `since_publish >= lazy` exactly)
+  uint64_t since_publish;
 };
+
+static inline std::atomic<uint64_t>* fseq_cell(const fdr_link* l,
+                                               uint64_t idx) {
+  return reinterpret_cast<std::atomic<uint64_t>*>(l->base + l->fseq_off +
+                                                  idx * 8);
+}
 
 void fdr_producer_init(const fdr_link* l, fdr_producer* p) {
   p->seq = 0;
   p->chunk = 0;
   uint64_t chunk_mtu = (l->mtu + CHUNK_SZ - 1) / CHUNK_SZ;
   p->wmark = l->dcache_sz / CHUNK_SZ - chunk_mtu;
+  p->cr_avail = 0;  // shm.Producer boots with 0 and refreshes on demand
+  p->cr_max = l->depth;
+  p->n_rel = 0;  // caller fills rel_idx[] for credit-gated publishing
 }
 
-// Publish one frag.  No credit logic here: flow control stays host-side
-// (it is lazy by design); this is the per-frag critical path.
+// cr_avail = max(cr_max - max(lag_i, 0), 0) over the reliable fseqs —
+// rings.FlowControl.credits verbatim.  No reliable consumers = free run.
+uint64_t fdr_refresh_credits(const fdr_link* l, fdr_producer* p) {
+  if (!p->n_rel) {
+    p->cr_avail = p->cr_max;
+    return p->cr_avail;
+  }
+  int64_t lag = 0;
+  for (uint64_t i = 0; i < p->n_rel; i++) {
+    int64_t d = seq_diff(
+        p->seq, fseq_cell(l, p->rel_idx[i])->load(std::memory_order_acquire));
+    if (d > lag) lag = d;
+  }
+  int64_t cr = (int64_t)p->cr_max - lag;
+  p->cr_avail = cr > 0 ? (uint64_t)cr : 0;
+  return p->cr_avail;
+}
+
+// Publish one frag, no credit logic (the raw mcache.publish analog; the
+// credit-gated entry points below call through here).
 void fdr_publish(const fdr_link* l, fdr_producer* p, const uint8_t* payload,
                  uint64_t sz, uint64_t sig, uint64_t tsorig, uint64_t tspub) {
   uint64_t chunk = p->chunk;
@@ -92,12 +154,63 @@ void fdr_publish(const fdr_link* l, fdr_producer* p, const uint8_t* payload,
   p->seq++;
 }
 
-// Poll for the consumer's next frag.
-//   returns  0 = frag copied out (meta[7] filled, payload into out)
-//           -1 = not yet published (caught up)
-//            1 = overrun (consumer resynced to the overwriting frag)
-int fdr_poll(const fdr_link* l, fdr_consumer* c, uint8_t* out,
-             uint64_t* meta_out) {
+// shm.Producer.try_publish: 1 = published, 0 = backpressured.  tsorig=0
+// means "this stage is the origin" and stamps now; tspub stamps at every
+// hop (fd_tango_base.h:48-60).
+int fdr_try_publish(const fdr_link* l, fdr_producer* p, const uint8_t* payload,
+                    uint64_t sz, uint64_t sig, uint64_t tsorig) {
+  if (!p->cr_avail) {
+    fdr_refresh_credits(l, p);
+    if (!p->cr_avail) return 0;
+  }
+  uint64_t ts = now_ns();
+  fdr_publish(l, p, payload, sz, sig, tsorig ? tsorig : ts, ts);
+  p->cr_avail--;
+  return 1;
+}
+
+// Burst publish: frame table rows of (byte offset into buf, sz, sig,
+// tsorig).  Credit-gated per frame; returns frames published (stops at
+// credit exhaustion — the caller keeps or drops the tail).
+uint64_t fdr_publish_burst(const fdr_link* l, fdr_producer* p,
+                           const uint8_t* buf, const uint64_t* tbl,
+                           uint64_t n) {
+  uint64_t done = 0;
+  for (; done < n; done++) {
+    const uint64_t* r = tbl + done * 4;
+    if (!fdr_try_publish(l, p, buf + r[0], r[1], r[2], r[3])) break;
+  }
+  return done;
+}
+
+// The synthetic-ingress crossing (benchg): cycle a pregenerated pool —
+// one joined payload buffer + an (off, sz) row per pool entry, both
+// built ONCE — publishing n frames with sig = start_sig + k and
+// tsorig = now (this stage is the stream's origin).  Zero per-frame
+// Python work, one crossing per sweep.
+uint64_t fdr_publish_pool(const fdr_link* l, fdr_producer* p,
+                          const uint8_t* buf, const uint64_t* tbl,
+                          uint64_t pool_n, uint64_t start_sig, uint64_t n) {
+  uint64_t done = 0;
+  for (; done < n; done++) {
+    const uint64_t* r = tbl + ((start_sig + done) % pool_n) * 2;
+    if (!fdr_try_publish(l, p, buf + r[0], r[1], start_sig + done, 0)) break;
+  }
+  return done;
+}
+
+void fdr_publish_progress(const fdr_link* l, fdr_consumer* c) {
+  fseq_cell(l, c->fseq_idx)->store(c->seq, std::memory_order_release);
+  c->since_publish = 0;
+}
+
+// Poll one frag into `out` (>= mtu bytes) + meta_out[7]:
+//    0 = frag copied out, -1 = not yet published, 1 = overrun (resynced).
+// Consumed frags bump the lazy fseq-publication counter, same cadence as
+// shm.Consumer (progress published once `since_publish >= lazy`, so
+// lazy=0 publishes after every frag — the Python lane's semantics).
+static int poll_step(const fdr_link* l, fdr_consumer* c, uint8_t* out,
+                     uint64_t* meta_out) {
   std::atomic<uint64_t>* r = row(l->base, l->mcache_off, l->depth, c->seq);
   uint64_t mseq = r[0].load(std::memory_order_acquire);
   if (mseq & BUSY) {
@@ -137,7 +250,67 @@ int fdr_poll(const fdr_link* l, fdr_consumer* c, uint8_t* out,
   meta_out[5] = tsorig;
   meta_out[6] = tspub;
   c->seq++;
+  c->since_publish++;
+  if (c->since_publish >= c->lazy) fdr_publish_progress(l, c);
   return 0;
+}
+
+int fdr_poll(const fdr_link* l, fdr_consumer* c, uint8_t* out,
+             uint64_t* meta_out) {
+  return poll_step(l, c, out, meta_out);
+}
+
+// Non-destructive shm.Consumer.has_pending: a frag (or an overrun) is
+// ready at the consumer's cursor.  One mcache row read.
+int fdr_has_pending(const fdr_link* l, const fdr_consumer* c) {
+  std::atomic<uint64_t>* r = row(l->base, l->mcache_off, l->depth, c->seq);
+  uint64_t mseq = r[0].load(std::memory_order_acquire);
+  if (mseq & BUSY) return seq_diff(mseq & ~BUSY, c->seq) > 0 ? 1 : 0;
+  return seq_diff(mseq, c->seq) >= 0 ? 1 : 0;
+}
+
+// The stage-sweep crossing: poll all input links round-robin (starting
+// at *rr_io, one frag per link per pass — runtime/stage.py's input
+// fairness) into `arena`, metas into meta_out rows of 8 u64
+// (seq, sig, ARENA BYTE OFFSET, sz, ctl, tsorig, tspub, in_idx — the
+// first 7 columns index-compatible with an mcache row, chunk repurposed).
+// Stops when max_frags frags landed or a full pass found every link
+// empty.  Overruns resync + count skipped FRAGS into each consumer's
+// ovrn_cnt (shm.Consumer.ovrn_cnt parity) and overrun EVENTS into
+// *ovrn_out — the unit the stage-level `overrun` metric counts on the
+// Python per-frag lane (one POLL_OVERRUN return per resync, however
+// many frags the lap swallowed), so A/B artifacts stay commensurable.
+// Returns frags delivered; *rr_io advances to the next round-robin
+// cursor.
+int64_t fdr_drain(fdr_link* const* links, fdr_consumer* const* cons,
+                  uint64_t n_links, uint64_t* rr_io, uint64_t max_frags,
+                  uint8_t* arena, uint64_t arena_sz, uint64_t* meta_out,
+                  uint64_t* ovrn_out) {
+  uint64_t got = 0, off = 0, rr = *rr_io, idle = 0, ovrn = 0;
+  while (got < max_frags && idle < n_links) {
+    uint64_t i = rr % n_links;
+    const fdr_link* l = links[i];
+    fdr_consumer* c = cons[i];
+    rr = i + 1;
+    if (off + l->mtu > arena_sz) break;  // arena full: deliver what we have
+    uint64_t* m = meta_out + got * DRAIN_NCOL;
+    int rc = poll_step(l, c, arena + off, m);
+    if (rc == 0) {
+      m[2] = off;  // chunk col -> arena byte offset (payload is a copy)
+      m[7] = i;
+      off += m[3];
+      got++;
+      idle = 0;
+    } else if (rc == 1) {
+      ovrn++;  // one EVENT, like one POLL_OVERRUN return per resync
+      idle = 0;  // overrun: the consumer resynced — that is progress
+    } else {
+      idle++;
+    }
+  }
+  *rr_io = rr % n_links;
+  *ovrn_out = ovrn;
+  return (int64_t)got;
 }
 
 // Bulk benchmark helpers: move n frags entirely in native code (the
@@ -149,10 +322,10 @@ void fdr_publish_n(const fdr_link* l, fdr_producer* p, const uint8_t* payload,
 
 uint64_t fdr_consume_n(const fdr_link* l, fdr_consumer* c, uint8_t* scratch,
                        uint64_t n, uint64_t spin_limit) {
-  uint64_t meta[7];
+  uint64_t meta[NCOL];
   uint64_t got = 0, spins = 0;
   while (got < n && spins < spin_limit) {
-    int rc = fdr_poll(l, c, scratch, meta);
+    int rc = poll_step(l, c, scratch, meta);
     if (rc == 0) got++;
     else if (rc == -1) spins++;
   }

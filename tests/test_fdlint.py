@@ -692,3 +692,92 @@ def test_fd211_registered_and_clean_on_repo():
                             "firedancer_tpu", *rel)
         findings = ast_rules.lint_path(root)
         assert [f for f in findings if f.rule == "FD211"] == []
+
+
+# -- FD212: per-frag ctypes allocation churn ----------------------------------
+
+
+_CTYPES_CHURN_SRC = '''
+import ctypes
+from ctypes import byref as br
+
+class RingishStage:
+    def after_frag(self, in_idx, meta, payload):
+        out = ctypes.create_string_buffer(1232)   # FD212: buffer per frag
+        self._lib.fdr_poll(br(self._ls), out)     # FD212: byref temporary
+        m = (ctypes.c_uint64 * 7)()               # FD212: array per frag
+        p = ctypes.cast(out, ctypes.c_void_p)     # FD212: cast temporary
+        self._burst.append(payload)               # ok: append-only handoff
+
+    def before_credit(self):
+        # burst granularity: the sanctioned place for the crossing
+        return self._lib.fdr_drain(self._lsp)
+'''
+
+
+def test_fd212_flags_ctypes_churn_in_frag():
+    findings = ast_rules.lint_source(
+        _CTYPES_CHURN_SRC, "firedancer_tpu/tango/somering.py")
+    hits = [f for f in findings if f.rule == "FD212"]
+    assert len(hits) == 4
+    bc_line = _CTYPES_CHURN_SRC[: _CTYPES_CHURN_SRC.index(
+        "before_credit")].count("\n") + 1
+    assert all(f.line < bc_line for f in hits)
+
+
+def test_fd212_needs_ctypes_import():
+    # the same shapes without a ctypes import (e.g. a math `(a*b)(x)`,
+    # even with a c_-prefixed name) are not FD212's business
+    src = '''
+class S:
+    def after_frag(self, in_idx, meta, payload):
+        f = (scale * gain)(payload)
+        g = (c_scale * gain)(payload)
+        out = create_string_buffer(64)
+'''
+    findings = ast_rules.lint_source(src, "firedancer_tpu/tango/x.py")
+    assert [f for f in findings if f.rule == "FD212"] == []
+
+
+def test_fd212_non_ctypes_mult_callee_ok():
+    # `(a * b)(x)` where neither operand references ctypes must not trip
+    # the array-shape check just because the FILE imports ctypes
+    src = '''
+import ctypes
+
+class S:
+    def after_frag(self, in_idx, meta, payload):
+        f = (scale * gain)(payload)
+        m = (ctypes.c_uint64 * 7)()   # this one IS the churn shape
+'''
+    findings = ast_rules.lint_source(src, "firedancer_tpu/tango/x.py")
+    hits = [f for f in findings if f.rule == "FD212"]
+    assert len(hits) == 1
+    assert "array construction" in hits[0].msg
+
+
+def test_fd212_cached_byref_outside_frag_ok():
+    # the tango/native.py discipline: byref/buffers cached in __init__,
+    # frag-adjacent code only *uses* them
+    src = '''
+import ctypes
+
+class Endpoint:
+    def __init__(self):
+        self._out = ctypes.create_string_buffer(1232)
+        self._lsp = ctypes.byref(self._ls)
+
+    def after_frag(self, in_idx, meta, payload):
+        self._burst.append((payload, int(meta[1])))
+'''
+    findings = ast_rules.lint_source(src, "firedancer_tpu/tango/x.py")
+    assert [f for f in findings if f.rule == "FD212"] == []
+
+
+def test_fd212_registered_and_clean_on_repo():
+    assert "FD212" in {r.id for r in all_rules()}
+    import os
+
+    root = os.path.join(os.path.dirname(__file__), "..", "firedancer_tpu")
+    findings = ast_rules.lint_path(root)
+    assert [f for f in findings if f.rule == "FD212"] == []

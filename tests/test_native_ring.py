@@ -1,14 +1,22 @@
 """Native (C++) ring tests: build via ctypes, differential interop with
-the Python rings in BOTH directions, overrun semantics, and a throughput
-sanity race (native must beat the Python loop)."""
+the Python rings in BOTH directions, overrun semantics, a throughput
+sanity race (native must beat the Python loop), and the full-protocol
+differential suite — randomized op scripts replayed against every lane
+combination (native/Python producer x native/Python consumer) under
+credit exhaustion, dcache wrap, forced overrun + resync, and the lazy
+fseq cadence, asserting identical metas, payloads, publish outcomes,
+ovrn_cnt, and fseq values; plus stage-level pipeline diffs with the
+FDTPU_NATIVE_RING toggle flipped and a mixed-lane topology."""
 
 import os
 import time
 
+import numpy as np
 import pytest
 
 from firedancer_tpu.tango import shm
 from firedancer_tpu.tango.rings import MCache
+from firedancer_tpu.utils.rng import Rng
 
 try:
     from firedancer_tpu.tango import native as fn
@@ -115,3 +123,351 @@ def test_native_bulk_roundtrip_and_speed(link):
     rate = n / native_dt
     print(f"native ring: {rate:,.0f} frags/s vs python {n / py_dt:,.0f}")
     assert native_dt < py_dt, "native hot path should outrun the Python loop"
+
+
+# -- full-protocol differential suite -----------------------------------------
+#
+# The same deterministic op script replays against every producer x
+# consumer lane combination on its own fresh link; everything observable
+# at the protocol level must match across lanes: publish outcomes (credit
+# exhaustion points), consumed metas (all columns except tspub, which is
+# a wall-clock stamp) and payloads, overrun events + ovrn_cnt, and the
+# fseq progress values the lazy cadence publishes.
+
+DIFF_DEPTH = 16
+DIFF_MTU = 192
+
+
+def _mk_link(tag):
+    return shm.ShmLink.create(
+        f"fdtpu_nrd_{tag}_{os.getpid()}_{int(time.monotonic_ns() % 1_000_000)}",
+        depth=DIFF_DEPTH,
+        mtu=DIFF_MTU,
+    )
+
+
+def _endpoints(link, prod_native, cons_native, *, reliable, lazy):
+    prod = (fn.NativeProducer(link, reliable_fseq_idx=reliable)
+            if prod_native else shm.Producer(link, reliable))
+    cons = (fn.NativeConsumer(link, lazy=lazy)
+            if cons_native else shm.Consumer(link, lazy=lazy))
+    return prod, cons
+
+
+def _script(seed, n_steps=240):
+    """Deterministic op list: bursts of publishes (sizes spanning 0 to
+    full-mtu so the compact dcache allocator wraps), consume runs, and
+    stalls that push the producer into credit exhaustion."""
+    r = Rng(seed)
+    ops = []
+    for _ in range(n_steps):
+        k = r.roll(3)
+        if k == 0:
+            ops.append(("pub", 1 + r.roll(8),
+                        [r.roll(DIFF_MTU + 1) for _ in range(8)]))
+        elif k == 1:
+            ops.append(("consume", 1 + r.roll(10)))
+        else:
+            ops.append(("stall",))
+    ops.append(("consume", 4 * DIFF_DEPTH))  # final drain
+    return ops
+
+
+def _run_script(link, prod, cons, ops):
+    """Replay `ops`; returns the observable event log."""
+    log = []
+    pub_i = 0
+    for op in ops:
+        if op[0] == "pub":
+            for j in range(op[1]):
+                sz = op[2][j % len(op[2])]
+                payload = bytes((pub_i + i) & 0xFF for i in range(sz))
+                ok = prod.try_publish(payload, sig=(pub_i << 56) | 7,
+                                      tsorig=1_000_000 + pub_i)
+                log.append(("pub", pub_i, bool(ok)))
+                if ok:
+                    pub_i += 1
+        elif op[0] == "consume":
+            for _ in range(op[1]):
+                res = cons.poll()
+                if res == shm.POLL_EMPTY:
+                    log.append(("empty",))
+                    break
+                if res == shm.POLL_OVERRUN:
+                    log.append(("ovrn", cons.ovrn_cnt))
+                    continue
+                meta, payload = res
+                # all meta columns except tspub (wall-clock stamp)
+                log.append(("frag", tuple(int(meta[c]) for c in range(6)),
+                            payload))
+        log.append(("fseq", link.fseqs[0].query()))
+    log.append(("final", cons.ovrn_cnt, prod.seq, cons.seq))
+    return log
+
+
+LANES = [(False, False), (True, True), (True, False), (False, True)]
+
+
+def test_lane_protocol_parity_credit_gated():
+    """Reliable consumer: credit exhaustion + dcache wrap + lazy fseq
+    cadence identical across all four lane combos."""
+    ops = _script(0xC0FFEE)
+    logs = []
+    for pn, cn in LANES:
+        link = _mk_link(f"cg{int(pn)}{int(cn)}")
+        try:
+            prod, cons = _endpoints(link, pn, cn, reliable=None, lazy=5)
+            logs.append(_run_script(link, prod, cons, ops))
+        finally:
+            link.close()
+            link.unlink()
+    for i in range(1, len(logs)):
+        assert logs[i] == logs[0], f"lane {LANES[i]} diverged from python"
+
+
+def test_lane_protocol_parity_lazy_zero():
+    """lazy=0 publishes progress after EVERY frag on both lanes
+    (shm.Consumer's `since_publish >= lazy`), so a credit-gated producer
+    never wedges on one lane only."""
+    ops = _script(0xBEEF, n_steps=120)
+    logs = []
+    for pn, cn in LANES:
+        link = _mk_link(f"lz{int(pn)}{int(cn)}")
+        try:
+            prod, cons = _endpoints(link, pn, cn, reliable=None, lazy=0)
+            logs.append(_run_script(link, prod, cons, ops))
+        finally:
+            link.close()
+            link.unlink()
+    for i in range(1, len(logs)):
+        assert logs[i] == logs[0], f"lane {LANES[i]} diverged from python"
+
+
+def test_lane_protocol_parity_overrun_resync():
+    """Free-running producer (no reliable fseqs): forced overruns, the
+    resync point, and ovrn_cnt accounting identical across lanes."""
+    ops = _script(0xFEED, n_steps=160)
+    logs = []
+    for pn, cn in LANES:
+        link = _mk_link(f"ov{int(pn)}{int(cn)}")
+        try:
+            prod, cons = _endpoints(link, pn, cn, reliable=[], lazy=7)
+            logs.append(_run_script(link, prod, cons, ops))
+        finally:
+            link.close()
+            link.unlink()
+    assert any(e[0] == "ovrn" for e in logs[0]), "script must force overruns"
+    for i in range(1, len(logs)):
+        assert logs[i] == logs[0], f"lane {LANES[i]} diverged from python"
+
+
+def test_publish_burst_matches_per_frag_lane(link):
+    """fdr_publish_burst: one crossing, same wire frames + credit gate as
+    per-frag try_publish on the Python lane."""
+    prod = fn.NativeProducer(link)
+    cons = shm.Consumer(link, lazy=16)
+    items = [(b"burst-%03d" % i, (i << 48) | 5, 10_000 + i)
+             for i in range(100)]
+    n = prod.publish_burst(items)
+    assert n == link.depth  # credit-gated: one ring of frames, no more
+    got = []
+    while True:
+        res = cons.poll()
+        if res == shm.POLL_EMPTY:
+            break
+        assert isinstance(res, tuple)
+        got.append(res)
+    assert [p for _, p in got] == [it[0] for it in items[:n]]
+    assert [int(m[MCache.COL_SIG]) for m, _ in got] == \
+        [it[1] for it in items[:n]]
+    assert [int(m[MCache.COL_TSORIG]) for m, _ in got] == \
+        [it[2] for it in items[:n]]
+    cons.publish_progress()
+    prod.refresh_credits()
+    # credits released: the tail goes through on the next burst
+    assert prod.publish_burst(items[n:]) == len(items) - n
+
+
+def test_drainer_round_robin_union(link):
+    """BurstDrainer: one crossing drains multiple links round-robin; the
+    meta table carries mcache-compatible columns + in_idx, and payloads
+    land at the table's arena offsets."""
+    link2 = _mk_link("dr2")
+    try:
+        p1 = fn.NativeProducer(link)
+        p2 = fn.NativeProducer(link2)
+        c1 = fn.NativeConsumer(link, lazy=64)
+        c2 = fn.NativeConsumer(link2, lazy=64)
+        for i in range(6):
+            assert p1.try_publish(b"a%d" % i, sig=100 + i, tsorig=1 + i)
+        for i in range(3):
+            assert p2.try_publish(b"b%d" % i, sig=200 + i, tsorig=50 + i)
+        dr = fn.BurstDrainer([c1, c2], max_frags=16)
+        n, rr, d_ovr = dr.drain(0, 16)
+        assert n == 9 and d_ovr == 0
+        rows = [dr.meta[i] for i in range(n)]
+        payloads = [
+            dr.arena[int(r[2]): int(r[2]) + int(r[3])].tobytes()
+            for r in rows
+        ]
+        # round-robin interleave while both have frags, then the rest
+        assert payloads == [b"a0", b"b0", b"a1", b"b1", b"a2", b"b2",
+                            b"a3", b"a4", b"a5"]
+        assert [int(r[7]) for r in rows] == [0, 1, 0, 1, 0, 1, 0, 0, 0]
+        assert [int(r[1]) for r in rows[:2]] == [100, 200]
+        assert [int(r[5]) for r in rows[:2]] == [1, 50]
+        # nothing left
+        n2, _, _ = dr.drain(rr, 16)
+        assert n2 == 0
+    finally:
+        link2.close()
+        link2.unlink()
+
+
+def test_native_teardown_no_buffer_error(link):
+    """Satellite: live native endpoints registered with the ShmLink are
+    detached by close(), so the mapping closes on the clean path (no
+    BufferError fallback) even while the endpoint objects are alive."""
+    prod = fn.NativeProducer(link)
+    cons = fn.NativeConsumer(link)
+    assert prod.try_publish(b"x", sig=1)
+    assert isinstance(cons.poll(), tuple)
+    # instrument the underlying close: the BufferError fallback must not
+    # run (pre-fix, a pinned from_buffer view forced it on every run)
+    raised = []
+    real_close = link._shm.close
+
+    def checked_close():
+        try:
+            real_close()
+        except BufferError:
+            raised.append(True)
+            raise
+
+    link._shm.close = checked_close
+    link.close()  # endpoints still referenced by this frame
+    assert prod._keep is None and cons._keep is None  # detached
+    assert not raised, "close took the BufferError fallback path"
+    # a detached endpoint refuses instead of passing NULL into C
+    with pytest.raises(RuntimeError):
+        cons.poll()
+    with pytest.raises(RuntimeError):
+        prod.try_publish(b"y")
+
+
+def test_native_fseq_idx_range_checked(link):
+    """shm lane parity: an out-of-range fseq index raises at
+    construction instead of silently addressing past the fseq region
+    (the adjacent cnc words)."""
+    with pytest.raises(IndexError):
+        fn.NativeConsumer(link, fseq_idx=link.n_fseq)
+    with pytest.raises(IndexError):
+        fn.NativeProducer(link, reliable_fseq_idx=[link.n_fseq])
+
+
+def test_env_toggle_restores_python_rings(link, monkeypatch):
+    monkeypatch.setenv("FDTPU_NATIVE_RING", "0")
+    assert not shm.native_ring_enabled()
+    assert type(shm.make_producer(link)) is shm.Producer
+    assert type(shm.make_consumer(link)) is shm.Consumer
+    monkeypatch.delenv("FDTPU_NATIVE_RING")
+    assert shm.native_ring_enabled()
+    assert type(shm.make_producer(link)) is fn.NativeProducer
+    assert type(shm.make_consumer(link)) is fn.NativeConsumer
+
+
+# -- stage-level pipeline diffs ----------------------------------------------
+
+
+def _run_small_pipeline(n_txns=96):
+    from firedancer_tpu.models.leader import build_leader_pipeline
+
+    pipe = build_leader_pipeline(
+        n_verify=1, n_bank=2, pool_size=n_txns, gen_limit=n_txns,
+        batch=32, max_msg_len=256, verify_precomputed=True,
+    )
+    try:
+        pipe.run(until_txns=n_txns, max_iters=400_000)
+        return {
+            "executed": sum(b.metrics.get("txn_exec") for b in pipe.banks),
+            "pack_in": pipe.pack.metrics.get("txn_in"),
+            "verified": pipe.verifies[0].metrics.get("txn_verified"),
+            "mixins": pipe.poh.metrics.get("mixins"),
+            "store_sets": pipe.store.metrics.get("sets_stored"),
+            "overruns": sum(s.metrics.get("overrun") for s in pipe.stages),
+            "store_lat_count":
+                pipe.store.metrics.hist("frag_latency_ns")["count"],
+        }
+    finally:
+        pipe.close()
+
+
+def test_pipeline_stream_diff_env_toggle(monkeypatch):
+    """The same pipeline run with the native ring plane ON and OFF moves
+    the identical stream: every conservation count matches, nothing is
+    lost to overruns on either lane, and the latency histograms populate
+    under the native lane (tsorig rides the C++ rings unchanged)."""
+    monkeypatch.setenv("FDTPU_NATIVE_RING", "0")
+    off = _run_small_pipeline()
+    monkeypatch.setenv("FDTPU_NATIVE_RING", "1")
+    on = _run_small_pipeline()
+    assert off["overruns"] == 0 and on["overruns"] == 0
+    assert on["executed"] == off["executed"] == 96
+    for key in ("pack_in", "verified", "mixins", "store_sets"):
+        assert on[key] == off[key], key
+    assert on["store_lat_count"] > 0
+
+
+def test_pipeline_mixed_lane_topology(monkeypatch):
+    """Wire-format compatibility IN SITU: alternate lanes per endpoint
+    while building the pipeline (native producer feeding a Python
+    consumer and vice versa on the same links) — the stream still moves
+    end to end."""
+    flip = {"n": 0}
+    real_mp, real_mc = shm.make_producer, shm.make_consumer
+
+    def mixed_producer(link, reliable_fseq_idx=None):
+        flip["n"] += 1
+        if flip["n"] % 2:
+            return shm.Producer(link, reliable_fseq_idx)
+        return real_mp(link, reliable_fseq_idx)
+
+    def mixed_consumer(link, fseq_idx=0, lazy=64):
+        flip["n"] += 1
+        if flip["n"] % 2:
+            return real_mc(link, fseq_idx=fseq_idx, lazy=lazy)
+        return shm.Consumer(link, fseq_idx=fseq_idx, lazy=lazy)
+
+    monkeypatch.setattr(shm, "make_producer", mixed_producer)
+    monkeypatch.setattr(shm, "make_consumer", mixed_consumer)
+    out = _run_small_pipeline()
+    assert out["executed"] == 96
+    assert out["overruns"] == 0
+
+
+def test_lossy_consumer_wraps_native(link):
+    """Chaos satellite: the seeded drop/dup/reorder shim runs over a
+    native consumer — including sig values >= 2^63 surviving the meta
+    copy — and keeps the no-stranded-frag liveness contract."""
+    from firedancer_tpu.tango.lossy import LossyConsumer
+
+    prod = fn.NativeProducer(link)
+    inner = fn.NativeConsumer(link, lazy=16)
+    lossy = LossyConsumer(inner, Rng(0xD00D), drop_p=0.25, dup_p=0.2,
+                          reorder_p=0.2)
+    sigs = [(1 << 63) | i for i in range(40)]
+    for i, s in enumerate(sigs):
+        assert prod.try_publish(b"L%02d" % i, sig=s, tsorig=5 + i)
+    got = []
+    while True:
+        assert lossy.has_pending() or not lossy._ready
+        res = lossy.poll()
+        if res == shm.POLL_EMPTY:
+            break
+        assert res != shm.POLL_OVERRUN
+        meta, payload = res
+        got.append((int(meta[MCache.COL_SIG]), payload))
+    delivered = len(got) - lossy.duplicated
+    assert delivered == 40 - lossy.dropped
+    assert lossy.dropped > 0 and lossy.duplicated > 0
+    assert all(s >= (1 << 63) for s, _ in got)  # u64 sigs intact
