@@ -441,7 +441,50 @@ def _persist_pipeline_mid(out: dict) -> None:
         print(f"# pipeline mid-run artifact write failed: {e}", file=sys.stderr)
 
 
-def run_host_pipeline_bench() -> dict:
+AB_MIN_PAIRS = 2
+
+
+def _require_ab_pairs(pairs: int, label: str) -> int:
+    """Variance hygiene (ISSUE 11): single-window A/B readings on the
+    1-core box swing +-15% run to run and have produced absurd per-stage
+    figures (see docs/PERF.md round 8's postmortem) — interleaved ON/OFF
+    pairs are MANDATORY for every A/B metric.  Fails loudly rather than
+    producing a number that looks like evidence."""
+    if pairs < AB_MIN_PAIRS:
+        raise ValueError(
+            f"single-window A/B requested for '{label}' (pairs={pairs}): "
+            f"readings on this box swing +-15% between windows, so a "
+            f"lone ON/OFF comparison is noise dressed as a delta — pass "
+            f"pairs >= {AB_MIN_PAIRS} (interleaved ON/OFF measurement)."
+        )
+    return pairs
+
+
+from statistics import median as _median
+
+
+def ab_summary(ons: list[dict], offs: list[dict], key: str) -> dict:
+    """Per-pair deltas + median-of-pairs for one metric across the
+    interleaved readings (every A/B metric in an artifact reports this
+    shape, never a single window)."""
+    on_v = [o.get(key) for o in ons]
+    off_v = [o.get(key) for o in offs]
+    deltas = [None if (a is None or b is None) else round(a - b, 2)
+              for a, b in zip(on_v, off_v)]
+    ok_d = [d for d in deltas if d is not None]
+    return {
+        "on": on_v,
+        "off": off_v,
+        "pair_delta": deltas,
+        "on_median": round(_median([v for v in on_v if v is not None]), 2)
+        if any(v is not None for v in on_v) else None,
+        "off_median": round(_median([v for v in off_v if v is not None]), 2)
+        if any(v is not None for v in off_v) else None,
+        "delta_median": round(_median(ok_d), 2) if ok_d else None,
+    }
+
+
+def run_host_pipeline_bench(pairs: int | None = None) -> dict:
     """Pipeline machinery throughput NET of accelerator round trips: the
     verify stage runs with a precomputed all-pass mask (no device
     dispatch), so rings/parse/dedup/pack/bank/poh/shred are what's timed.
@@ -449,37 +492,90 @@ def run_host_pipeline_bench() -> dict:
     target to beat is the reference's stock single-host bench, 63K txn/s
     (book/guide/tuning.md:131).
 
-    Measures BOTH pack lanes AND both ring lanes on the same box: the
-    all-native configuration is the headline; `*_native_pack_off` and
-    `*_native_ring_off` record the Python fallbacks in the same run
-    (the ISSUE 9/10 interleaved-A/B acceptance shape).  Every measure
-    also splits ring overhead (poll+publish) from stage compute in the
-    per-stage us/txn breakdown, so the crossing cost is in the artifact
-    directly."""
+    Measures the all-native configuration against each lane's Python
+    fallback (`*_native_pack_off`, `*_native_ring_off`,
+    `*_native_shred_off`) in INTERLEAVED ON/OFF pairs — single-window
+    A/B readings swing +-15% on the 1-core box, so every pair cycle
+    measures ON then each OFF lane back to back and the artifact
+    carries per-pair deltas + median-of-pairs (`ab` key).  Every
+    measure also splits ring overhead (poll+publish) from stage compute
+    in the per-stage us/txn breakdown."""
     from firedancer_tpu.pack import scheduler_native as sn
+    from firedancer_tpu.runtime import shred_native as shn
     from firedancer_tpu.tango import shm as tango_shm
 
+    pairs = _require_ab_pairs(
+        pairs if pairs is not None
+        else int(os.environ.get("FDTPU_BENCH_AB_PAIRS", "2")),
+        "host pipeline lanes",
+    )
     ring_avail = tango_shm._native_ring_available()
-    out = {}
-    if sn.available():
-        off = _host_pipeline_measure(native_pack=False)
-        out["pipeline_host_txn_per_s_native_pack_off"] = \
-            off["pipeline_host_txn_per_s"]
-        out.update(_host_pipeline_measure(native_pack=True))
-        out["pipeline_host_native_pack"] = True
-    else:
-        out.update(_host_pipeline_measure(native_pack=False))
-        out["pipeline_host_native_pack"] = False
+    pack_avail = sn.available()
+    shred_avail = shn.available()
+    if not (ring_avail or pack_avail or shred_avail):
+        # toolchain-less host: no fallback lane to compare against, so
+        # repeated identical windows buy nothing — one measurement
+        pairs = 1
+    ons: list[dict] = []
+    lanes: dict[str, list[dict]] = {}
+    windows: list[tuple] = [("on", dict(native_pack=pack_avail))]
+    if pack_avail:
+        windows.append(("pack", dict(native_pack=False)))
     if ring_avail:
-        roff = _host_pipeline_measure(
-            native_pack=out["pipeline_host_native_pack"], native_ring=False
-        )
-        out["pipeline_host_txn_per_s_native_ring_off"] = \
-            roff["pipeline_host_txn_per_s"]
+        windows.append(("ring", dict(native_pack=pack_avail,
+                                     native_ring=False)))
+    if shred_avail:
+        windows.append(("shred", dict(native_pack=pack_avail,
+                                      native_shred=False)))
+    if len(windows) > 1:
+        # the process's first measure pays one-time costs (imports, comb
+        # tables, numpy warmup) — discard one window so pair 0's first
+        # lane isn't systematically biased low
+        _host_pipeline_warm_window()
+    for i in range(pairs):
+        # alternate within-pair order so a slow box phase (and any
+        # residual process aging) penalizes lanes evenly across the run
+        order = windows if i % 2 == 0 else list(reversed(windows))
+        for lane, kw in order:
+            m = _host_pipeline_measure(**kw)
+            (ons if lane == "on" else lanes.setdefault(lane, [])).append(m)
+    out = dict(ons[-1])  # headline keys: the last all-native window
+    out["pipeline_host_txn_per_s"] = round(
+        _median([o["pipeline_host_txn_per_s"] for o in ons]), 1
+    )
+    out["pipeline_host_native_pack"] = pack_avail
+    out["pipeline_host_ab_pairs"] = pairs
+    ab: dict = {}
+    for lane, offs in lanes.items():
+        ab[lane] = {
+            "txn_per_s": ab_summary(ons, offs, "pipeline_host_txn_per_s"),
+        }
+        # legacy single-value keys stay as the medians so existing
+        # consumers keep working
+        out[f"pipeline_host_txn_per_s_native_{lane}_off"] = \
+            ab[lane]["txn_per_s"]["off_median"]
+    if "ring" in lanes:
+        roffs = lanes["ring"]
+        ab["ring"]["ring_us_per_txn"] = ab_summary(
+            ons, roffs, "pipeline_host_ring_us_per_txn")
         out["pipeline_host_ring_us_per_txn_native_ring_off"] = \
-            roff["pipeline_host_ring_us_per_txn"]
+            ab["ring"]["ring_us_per_txn"]["off_median"]
         out["pipeline_host_ring_us_per_stage_native_ring_off"] = \
-            roff["pipeline_host_ring_us_per_stage"]
+            roffs[-1]["pipeline_host_ring_us_per_stage"]
+    if "shred" in lanes:
+        soffs = lanes["shred"]
+        ab["shred"]["shred_stage_us_per_txn"] = ab_summary(
+            [{"v": o["pipeline_host_stage_us_per_txn"].get("shred")}
+             for o in ons],
+            [{"v": o["pipeline_host_stage_us_per_txn"].get("shred")}
+             for o in soffs],
+            "v",
+        )
+        out["pipeline_host_shred_us_per_txn_native_shred_off"] = \
+            ab["shred"]["shred_stage_us_per_txn"]["off_median"]
+        out["pipeline_host_stage_us_per_txn_native_shred_off"] = \
+            soffs[-1]["pipeline_host_stage_us_per_txn"]
+    out["ab"] = ab
     try:
         out["verify_stage_host_txn_per_s"] = round(
             _verify_stage_loop_rate(), 1
@@ -493,8 +589,87 @@ def run_host_pipeline_bench() -> dict:
     return out
 
 
+def _host_pipeline_warm_window() -> None:
+    """One small, DISCARDED pipeline window: the process's first measure
+    pays one-time costs (imports, comb tables, numpy warmup) that the
+    in-measure 512-txn warmup does not cover — without this the first
+    real window reads ~1K txn/s low and 'pair 0' measures process age."""
+    prev = os.environ.get("FDTPU_BENCH_PIPELINE_TXNS")
+    os.environ["FDTPU_BENCH_PIPELINE_TXNS"] = "2048"
+    try:
+        print("# A/B warmup window (discarded)", file=sys.stderr)
+        _host_pipeline_measure(native_pack=False)
+    finally:
+        if prev is None:
+            os.environ.pop("FDTPU_BENCH_PIPELINE_TXNS", None)
+        else:
+            os.environ["FDTPU_BENCH_PIPELINE_TXNS"] = prev
+
+
+def run_shred_ab(pairs: int = 3, out_path: str | None = None) -> dict:
+    """The ISSUE 11 acceptance artifact: interleaved same-box A/B of the
+    native shredder lane — per pair, one all-native window and one
+    window with ONLY the shred lane off, per-stage us/txn tables for
+    both, per-pair deltas and median-of-pairs.  Writes
+    BENCH_r10_shred_ab.json (or FDTPU_BENCH_SHRED_AB_PATH)."""
+    from firedancer_tpu.runtime import shred_native as shn
+
+    from firedancer_tpu.pack import scheduler_native as sn_pack
+
+    _require_ab_pairs(pairs, "shred lane A/B")
+    if not shn.available():
+        print("# native shredder unavailable: no A/B to run",
+              file=sys.stderr)
+        return {"shred_ab_unavailable": True}
+    pack_avail = sn_pack.available()
+    ons, offs = [], []
+    _host_pipeline_warm_window()
+    for i in range(pairs):
+        print(f"# shred A/B pair {i + 1}/{pairs}", file=sys.stderr)
+        # alternate within-pair order so a slow box phase penalizes both
+        # lanes evenly across the run, not always the same one
+        order = (True, False) if i % 2 == 0 else (False, True)
+        for on in order:
+            (ons if on else offs).append(_host_pipeline_measure(
+                native_pack=pack_avail, native_shred=on))
+    out = {
+        "pairs": pairs,
+        "txn_per_s": ab_summary(ons, offs, "pipeline_host_txn_per_s"),
+        # one A/B-metric shape everywhere: the same {"v": ...} wrap the
+        # host-pipeline artifact uses for per-stage keys
+        "shred_us_per_txn": ab_summary(
+            [{"v": o["pipeline_host_stage_us_per_txn"].get("shred")}
+             for o in ons],
+            [{"v": o["pipeline_host_stage_us_per_txn"].get("shred")}
+             for o in offs],
+            "v",
+        ),
+        "pipeline_host_txn_per_s": round(_median(
+            [o["pipeline_host_txn_per_s"] for o in ons]), 1),
+        "stage_us_per_txn_on": [o["pipeline_host_stage_us_per_txn"]
+                                for o in ons],
+        "stage_us_per_txn_off": [o["pipeline_host_stage_us_per_txn"]
+                                 for o in offs],
+        "shred_mode_on": ons[-1].get("pipeline_host_native_shred"),
+        "shred_mode_off": offs[-1].get("pipeline_host_native_shred"),
+        "native_exec": ons[-1].get("pipeline_host_native_exec"),
+        "native_ring": ons[-1].get("pipeline_host_native_ring"),
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+    }
+    path = out_path or os.environ.get("FDTPU_BENCH_SHRED_AB_PATH",
+                                      "BENCH_r10_shred_ab.json")
+    try:
+        with open(path, "w") as fh:
+            json.dump(out, fh, indent=1)
+        print(f"# shred A/B artifact -> {path}", file=sys.stderr)
+    except OSError as e:
+        print(f"# shred A/B artifact write failed: {e}", file=sys.stderr)
+    return out
+
+
 def _host_pipeline_measure(*, native_pack: bool,
-                           native_ring: bool | None = None) -> dict:
+                           native_ring: bool | None = None,
+                           native_shred: bool | None = None) -> dict:
     from firedancer_tpu.models.leader import build_leader_pipeline
     from firedancer_tpu.runtime.bank import default_bank_ctx
     from firedancer_tpu.runtime.benchg import gen_transfer_pool
@@ -504,11 +679,15 @@ def _host_pipeline_measure(*, native_pack: bool,
     #                bounded funded account set the same way)
     t0 = time.time()
     ctx = default_bank_ctx(n_payers=n_payers)
-    # the ring lane is chosen at endpoint CONSTRUCTION (shm.make_*): the
-    # env switch only needs to hold while the pipeline builds
-    ring_env_prev = os.environ.get("FDTPU_NATIVE_RING")
+    # the ring AND shred lanes are chosen at endpoint/stage CONSTRUCTION
+    # (shm.make_*, ShredStage.__init__): the env switches only need to
+    # hold while the pipeline builds
+    env_prev = {k: os.environ.get(k)
+                for k in ("FDTPU_NATIVE_RING", "FDTPU_NATIVE_SHRED")}
     if native_ring is not None:
         os.environ["FDTPU_NATIVE_RING"] = "1" if native_ring else "0"
+    if native_shred is not None:
+        os.environ["FDTPU_NATIVE_SHRED"] = "1" if native_shred else "0"
     try:
         pipe = build_leader_pipeline(
             n_verify=1,
@@ -521,18 +700,22 @@ def _host_pipeline_measure(*, native_pack: bool,
             verify_precomputed=True,
             bank_ctx=ctx,
             native_pack=native_pack,
+            keep_sets=False,  # frees the shred stage for the sweep lane
         )
     finally:
-        if native_ring is not None:
-            if ring_env_prev is None:
-                os.environ.pop("FDTPU_NATIVE_RING", None)
+        for k, v in env_prev.items():
+            if v is None:
+                os.environ.pop(k, None)
             else:
-                os.environ["FDTPU_NATIVE_RING"] = ring_env_prev
+                os.environ[k] = v
     ring_on = type(pipe.pack.ins[0]).__name__ == "NativeConsumer"
+    shred_mode = ("sweep" if pipe.shred._sweep_client is not None
+                  else ("batch" if pipe.shred.native_shred else "python"))
     pipe.benchg.pool = gen_transfer_pool(n_txn, n_payers=n_payers,
                                          n_dests=1024)
     print(f"# host pipeline: pool of {n_txn} signed in {time.time()-t0:.1f}s"
-          f" (native_pack={native_pack}, native_ring={ring_on})",
+          f" (native_pack={native_pack}, native_ring={ring_on},"
+          f" shred={shred_mode})",
           file=sys.stderr)
 
     def executed_cnt() -> int:
@@ -565,6 +748,7 @@ def _host_pipeline_measure(*, native_pack: bool,
         # pack publishes its microblocks there): tracked apart so the
         # ring split stays a SUBSET of the same lane it is printed under
         ring_ac_s = 0.0
+        progress_snap = None
         sample_every = 8
         pc = time.perf_counter
         while executed_cnt() - warm_exec < target and it < 2_000_000:
@@ -596,15 +780,32 @@ def _host_pipeline_measure(*, native_pack: bool,
                 if cur > last_cnt:
                     last_cnt = cur
                     last_progress_t = time.time()
-                elif time.time() - last_progress_t > 30:
+                    # snapshot the sampled instruments at every progress
+                    # mark: if the run later stalls, the dead-spin tail
+                    # (sampled idle sweeps) must not pollute the
+                    # per-stage table — the stall made round-9 artifacts
+                    # read 1300 us/txn for a stage while throughput was
+                    # fine
+                    progress_snap = (
+                        dict(stage_s),
+                        {s.name: (s.ring_poll_s, s.ring_publish_s)
+                         for s in pipe.stages},
+                        ring_ac_s,
+                    )
+                elif time.time() - last_progress_t > 5:
                     break  # stalled: stop rather than time a dead spin
         executed = executed_cnt() - warm_exec
         if executed < target:
             # a partial run must be VISIBLE, and the dead tail must not
-            # deflate the rate: time only to the last observed progress
+            # deflate the rate OR inflate the sampled per-stage times:
+            # time (and count) only to the last observed progress
             print(f"# host pipeline INCOMPLETE: {executed}/{target} "
                   f"executed (drops/stall)", file=sys.stderr)
             elapsed = max(last_progress_t - t0, 1e-9)
+            if progress_snap is not None:
+                stage_s, ring_snap, ring_ac_s = progress_snap
+                for s in pipe.stages:
+                    s.ring_poll_s, s.ring_publish_s = ring_snap[s.name]
         else:
             elapsed = time.time() - t0
         lats = sorted(
@@ -669,6 +870,7 @@ def _host_pipeline_measure(*, native_pack: bool,
             "pipeline_host_ring_us_per_stage": ring_us,
             "pipeline_host_native_ring": ring_on,
             "pipeline_host_native_exec": exec_native.available(),
+            "pipeline_host_native_shred": shred_mode,
         }
         out.update(_scrape_stage_latencies(pipe))
         if executed < target:
@@ -1109,6 +1311,15 @@ def run_multichip_serve() -> None:
 
 
 def main() -> None:
+    if "--shred-ab" in sys.argv:
+        i = sys.argv.index("--shred-ab")
+        n = int(sys.argv[i + 1]) if len(sys.argv) > i + 1 \
+            and sys.argv[i + 1].isdigit() else 3
+        print(json.dumps(run_shred_ab(pairs=n), indent=1))
+        return
+    if "--host-pipeline" in sys.argv:
+        print(json.dumps(run_host_pipeline_bench(), indent=1))
+        return
     if "--serve-child" in sys.argv:
         n = int(sys.argv[sys.argv.index("--serve-child") + 1])
         serve_child(n)

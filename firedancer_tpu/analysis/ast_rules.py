@@ -79,6 +79,21 @@ _CTYPES_CHURN = frozenset({
     "addressof", "string_at",
 })
 
+# FD213: hashing entry points whose per-frag use is merkle node churn in
+# the shred path — bare-name matches cover from-imports of the hashlib
+# constructors and the bmtree helpers the shredder/resolver build trees
+# with; `hashlib.*` is matched module-qualified (any attr).  Scoped to
+# shred-path modules so a hash in an unrelated stage stays FD-clean.
+_FD213_HASH_NAMES = frozenset({
+    "sha256", "sha512", "sha3_256", "blake2b", "blake2s",
+    "hash_leaf_full", "hash_leaf", "hash_node", "tree_layers",
+    "root32_from_layers", "verify_proof",
+})
+_SHRED_PATH_FILES = frozenset({
+    "shredder.py", "shred_stage.py", "shred_native.py", "store.py",
+    "fec_resolver.py",
+})
+
 
 def _fd208_offender(arg: ast.AST) -> str | None:
     """Why `arg` allocates/formats, or None if it looks scalar-cheap."""
@@ -236,6 +251,10 @@ class _Linter(ast.NodeVisitor):
         self._pack_scope = bool(parts) and (
             "pack" in parts or parts[-1] == "pack_stage.py"
         )
+        # FD213 scope: the shred-path modules — their frag callbacks run
+        # once per entry/shred and must stay append-only; hashing and
+        # shred framing happen at FEC-set granularity
+        self._shred_scope = bool(parts) and parts[-1] in _SHRED_PATH_FILES
 
     def _resolve(self, node: ast.Call) -> tuple[str, str] | None:
         """Canonical (module, func) for a call, seeing through `import
@@ -457,6 +476,37 @@ class _Linter(ast.NodeVisitor):
                      "ctypes array construction `(c_type * n)()` in a"
                      " frag callback: allocate once at construction and"
                      " reuse (tango/native.py's _meta/_out discipline)")
+        # FD213: per-frag hashing / bytes assembly in the shred path —
+        # merkle node churn (a hashlib/bmtree call per frag) and
+        # per-shred concat (bytes()/b"".join) multiply an allocator +
+        # compression function by ingress rate; both belong at FEC-set
+        # granularity (entry_batch_to_fec_sets / one native crossing)
+        if self._shred_scope:
+            hq = _dotted(node.func)
+            if hq is not None and (
+                hq[0] == "hashlib" or hq[-1] in _FD213_HASH_NAMES
+            ):
+                self.hit("FD213", node,
+                         f"per-frag hash '{'.'.join(hq)}' in a shred-path"
+                         " frag callback: merkle/hash work belongs at"
+                         " FEC-set granularity (close the batch, then"
+                         " hash once per set)")
+            elif isinstance(node.func, ast.Name) \
+                    and node.func.id in ("bytes", "bytearray") \
+                    and node.args:
+                self.hit("FD213", node,
+                         f"{node.func.id}() construction in a shred-path"
+                         " frag callback: accumulate entries append-only"
+                         " (bytearray extend) and frame shreds once per"
+                         " closed FEC set")
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "join" \
+                    and isinstance(node.func.value, ast.Constant) \
+                    and isinstance(node.func.value.value, (bytes, str)):
+                self.hit("FD213", node,
+                         "per-frag join-concat in a shred-path frag"
+                         " callback: shred framing belongs at FEC-set"
+                         " granularity, not per entry")
         # FD207: a native (ctypes) crossing per frag — the crossing
         # itself costs ~1-3us, so it belongs at burst granularity (one
         # call per drained burst / microblock, the fd_exec_batch shape)
@@ -515,6 +565,31 @@ class _Linter(ast.NodeVisitor):
                              f" '{fn.name}' and will not pickle under"
                              " spawn")
                     return
+
+    def visit_BinOp(self, node: ast.BinOp) -> None:
+        # FD213 (concat half): `hdr + payload`-style bytes assembly per
+        # frag in the shred path.  Only literal-anchored concats are
+        # decidable from the AST (an operand that IS a bytes constant);
+        # the bytes()/join() construction shapes are caught in
+        # _check_frag_call.
+        def _bytesish(o: ast.AST) -> bool:
+            # a bytes literal, or the `b"\\x00" * n` padding idiom
+            if isinstance(o, ast.Constant) and isinstance(o.value, bytes):
+                return True
+            return isinstance(o, ast.BinOp) \
+                and isinstance(o.op, ast.Mult) \
+                and any(isinstance(x, ast.Constant)
+                        and isinstance(x.value, bytes)
+                        for x in (o.left, o.right))
+
+        if self._frag_depth and self._shred_scope \
+                and isinstance(node.op, ast.Add) \
+                and (_bytesish(node.left) or _bytesish(node.right)):
+            self.hit("FD213", node,
+                     "bytes-literal concat in a shred-path frag callback:"
+                     " per-shred framing belongs at FEC-set granularity —"
+                     " accumulate append-only and frame once per set")
+        self.generic_visit(node)
 
     def _visit_comp(self, node: ast.AST) -> None:
         # FD211 (other half): a comprehension per frag in pack intake is

@@ -193,3 +193,13 @@ FD212 = _rule(
     " byref/out-buffer objects at construction (tango/native.py) and cross"
     " the FFI once per drained burst (fdr_drain / fdr_publish_burst)",
 )
+FD213 = _rule(
+    "FD213", "hash-alloc-in-shred-frag", SEV_ERROR,
+    "per-frag hashing or bytes assembly (hashlib/merkle-helper call,"
+    " bytes()/b''.join()/bytes-literal concat) inside a frag callback of a"
+    " shred-path module: merkle node churn and per-shred concat belong at"
+    " FEC-set granularity — accumulate entries append-only (bytearray"
+    " extend) and hash/frame once per closed batch (the shredder's"
+    " entry_batch_to_fec_sets shape; the native lane does it all in one"
+    " crossing)",
+)

@@ -195,6 +195,27 @@ class BatchContext:
             + _U32.pack(len(sh))
             + sh
         )
+        # request arena + response buffer, REUSED across microblocks
+        # (ISSUE 11 bank-lane residual): the session path marshals with
+        # pack_into/slice-assign into one bytearray instead of building
+        # ~6 bytes objects per txn and joining per call — the ~5 us/txn
+        # of Python allocation around fd_exec_batch2.  Lazily built:
+        # only the session hot path uses them.
+        self._arena: bytearray | None = None
+        self._arena_view = None
+        self._resp_cap = 1 << 16
+        self._resp = None
+
+    def _ensure_arena(self, need: int) -> None:
+        if self._arena is None or need > len(self._arena):
+            cap = 1 << 16 if self._arena is None else len(self._arena)
+            while cap < need:
+                cap *= 2
+            self._arena_view = None  # drop the old from_buffer pin first
+            self._arena = bytearray(cap)
+            self._arena_view = (ctypes.c_char * cap).from_buffer(self._arena)
+        if self._resp is None:
+            self._resp = ctypes.create_string_buffer(self._resp_cap)
 
     def run(self, entries, *, gate=None) -> tuple[int, bool, list]:
         """One fd_exec_batch(2) call.  entries: [payload, desc_bytes,
@@ -211,65 +232,26 @@ class BatchContext:
         blockhash||signature entries landed OUTSIDE the session since
         the last call."""
         if self._session is not None:
-            parts = [struct.pack("<II", _REQ2_MAGIC, len(entries)),
-                     self._fixed]
-            req_sz = 0
-            if gate is not None:
-                valid_bh, seen_delta = gate
-                if valid_bh is None:
-                    # gate on, valid set unchanged since last shipped
-                    # (flag 2): the session keeps its current set
-                    parts.append(b"\x02" + _U32.pack(0))
-                else:
-                    parts.append(b"\x01" + _U32.pack(len(valid_bh)))
-                    parts.extend(valid_bh)
-                parts.append(_U32.pack(len(seen_delta)))
-                parts.extend(seen_delta)
-            else:
-                parts.append(b"\x00" + _U32.pack(0) + _U32.pack(0))
-            # reserved refresh section (count always 0: per-txn have=1
-            # values carry all account resyncs; the C++ side accepts
-            # out-of-band refresh records should a future caller batch
-            # them separately)
-            parts.append(_U32.pack(0))
-            for e in entries:
-                payload, desc_bytes, _addrs, vals = e[0], e[1], e[2], e[3]
-                parts.append(_TXN_HEAD.pack(len(payload), len(desc_bytes),
-                                            len(vals)))
-                parts.append(payload)
-                parts.append(desc_bytes)
-                for v in vals:
-                    if v is None:  # session-known: nothing crosses
-                        parts.append(b"\x00")
-                    else:
-                        parts.append(b"\x01" + _U32.pack(len(v)))
-                        parts.append(v)
-                        req_sz += len(v)
-                req_sz += len(payload) + 64
-        else:
-            parts = [struct.pack("<II", _REQ_MAGIC, len(entries)), self._fixed]
-            req_sz = 0
-            for e in entries:
-                payload, desc_bytes, _addrs, vals = e[0], e[1], e[2], e[3]
-                parts.append(_TXN_HEAD.pack(len(payload), len(desc_bytes),
-                                            len(vals)))
-                parts.append(payload)
-                parts.append(desc_bytes)
-                for v in vals:
-                    v = v or b""
-                    parts.append(_U32.pack(len(v)))
-                    parts.append(v)
-                    req_sz += len(v)
-                req_sz += len(payload) + 64
+            return self._run_session_arena(entries, gate)
+        parts = [struct.pack("<II", _REQ_MAGIC, len(entries)), self._fixed]
+        req_sz = 0
+        for e in entries:
+            payload, desc_bytes, _addrs, vals = e[0], e[1], e[2], e[3]
+            parts.append(_TXN_HEAD.pack(len(payload), len(desc_bytes),
+                                        len(vals)))
+            parts.append(payload)
+            parts.append(desc_bytes)
+            for v in vals:
+                v = v or b""
+                parts.append(_U32.pack(len(v)))
+                parts.append(v)
+                req_sz += len(v)
+            req_sz += len(payload) + 64
         req = b"".join(parts)
         cap = 4096 + 2 * req_sz
         while True:
             buf = ctypes.create_string_buffer(cap)
-            if self._session is not None:
-                rc = self._lib.fd_exec_batch2(self._session._h, req,
-                                              len(req), buf, cap)
-            else:
-                rc = self._lib.fd_exec_batch(req, len(req), buf, cap)
+            rc = self._lib.fd_exec_batch(req, len(req), buf, cap)
             if rc == -2:
                 # a CreateAccount/Allocate burst can outgrow the heuristic
                 # capacity; the call did not commit (v1 is stateless, v2
@@ -281,6 +263,91 @@ class BatchContext:
             if rc < 0:
                 raise NativeUnavailable(f"fd_exec_batch rc={rc}")
             return self._parse(buf.raw[:rc])
+
+    def _run_session_arena(self, entries, gate) -> tuple[int, bool, list]:
+        """Session-mode crossing through the preallocated request arena:
+        one capacity pass (plain int sums), then pack_into/slice-assign
+        into the reused bytearray — no per-txn bytes construction, no
+        per-call join, no per-call response allocation."""
+        fixed = self._fixed
+        # -- capacity pass ----------------------------------------------------
+        need = 8 + len(fixed) + 5 + 4 + 4  # headers + gate flag + counts
+        if gate is not None:
+            valid_bh, seen_delta = gate
+            if valid_bh is not None:
+                need += 32 * len(valid_bh)
+            need += 96 * len(seen_delta)
+        for e in entries:
+            need += _TXN_HEAD.size + len(e[0]) + len(e[1])
+            for v in e[3]:
+                need += 1 if v is None else 5 + len(v)
+        self._ensure_arena(need)
+        a = self._arena
+        # -- serialize --------------------------------------------------------
+        struct.pack_into("<II", a, 0, _REQ2_MAGIC, len(entries))
+        o = 8
+        a[o : o + len(fixed)] = fixed
+        o += len(fixed)
+        if gate is not None:
+            valid_bh, seen_delta = gate
+            if valid_bh is None:
+                # gate on, valid set unchanged since last shipped
+                # (flag 2): the session keeps its current set
+                a[o] = 2
+                struct.pack_into("<I", a, o + 1, 0)
+                o += 5
+            else:
+                a[o] = 1
+                struct.pack_into("<I", a, o + 1, len(valid_bh))
+                o += 5
+                for bh in valid_bh:
+                    a[o : o + 32] = bh
+                    o += 32
+            struct.pack_into("<I", a, o, len(seen_delta))
+            o += 4
+            for s in seen_delta:
+                a[o : o + 96] = s
+                o += 96
+        else:
+            a[o] = 0
+            struct.pack_into("<II", a, o + 1, 0, 0)
+            o += 9
+        # reserved refresh section (count always 0: per-txn have=1
+        # values carry all account resyncs)
+        struct.pack_into("<I", a, o, 0)
+        o += 4
+        for e in entries:
+            payload, desc_bytes, vals = e[0], e[1], e[3]
+            _TXN_HEAD.pack_into(a, o, len(payload), len(desc_bytes),
+                                len(vals))
+            o += _TXN_HEAD.size
+            a[o : o + len(payload)] = payload
+            o += len(payload)
+            a[o : o + len(desc_bytes)] = desc_bytes
+            o += len(desc_bytes)
+            for v in vals:
+                if v is None:  # session-known: nothing crosses
+                    a[o] = 0
+                    o += 1
+                else:
+                    a[o] = 1
+                    struct.pack_into("<I", a, o + 1, len(v))
+                    o += 5
+                    a[o : o + len(v)] = v
+                    o += len(v)
+        # -- the crossing (response buffer reused; grown on -2) ---------------
+        while True:
+            rc = self._lib.fd_exec_batch2(self._session._h, self._arena_view,
+                                          o, self._resp, self._resp_cap)
+            if rc == -2:
+                self._resp_cap *= 4
+                if self._resp_cap > 1 << 28:
+                    raise NativeUnavailable("fd_exec_batch response > 256MB")
+                self._resp = ctypes.create_string_buffer(self._resp_cap)
+                continue
+            if rc < 0:
+                raise NativeUnavailable(f"fd_exec_batch rc={rc}")
+            return self._parse(ctypes.string_at(self._resp, rc))
 
     @staticmethod
     def _parse(buf: bytes) -> tuple[int, bool, list]:

@@ -172,8 +172,13 @@ def build_leader_pipeline(
     verify_comb_slots: int = 0,
     bank_ctx: BankCtx | None = None,
     keep_entries: bool = False,
+    keep_sets: bool = True,
     native_pack: bool | None = None,
 ) -> LeaderPipeline:
+    """keep_sets=False releases the shred stage from materializing
+    FecSets in Python, which lets it adopt the zero-Python sweep lane
+    (bench uses this; tests that read pipe.shred.sets keep the
+    default)."""
     use_native_pack = resolve_native_pack(native_pack)
     uid = f"{os.getpid()}_{int(time.monotonic_ns() % 1_000_000)}"
     links = []
@@ -270,8 +275,9 @@ def build_leader_pipeline(
         ins=[shm.make_consumer(poh_shred, lazy=8)],
         outs=[shm.make_producer(shred_store)],
         signer=lambda root: ref.sign(secret, root),
+        secret=secret,  # arms the native shredder lane when available
         slot=slot,
-        keep_sets=True,
+        keep_sets=keep_sets,
     )
     # the leader's own store trusts its own signing path (the reference's
     # shred tile only signature-verifies shreds arriving from OTHER
@@ -282,6 +288,7 @@ def build_leader_pipeline(
         "store",
         ins=[shm.make_consumer(shred_store, lazy=64)],
         verify_sig=None,
+        trust_membership=True,
     )
     stages = [benchg, *verifies] + ([dedup] if dedup else []) \
         + [pack, *banks, poh, shred, store]
@@ -461,6 +468,7 @@ def build_sharded_leader_pipeline(
         "store",
         ins=[shm.make_consumer(shred_store, lazy=64)],
         verify_sig=None,
+        trust_membership=True,
     )
     stages = [benchg, router, verify] + ([dedup] if dedup else []) \
         + [pack, *banks, poh, shred, store]

@@ -192,6 +192,7 @@ def build_shred(links, cnc, *, secret, slot):
         outs=[shm.make_producer(links["ss"])],
         cnc=cnc,
         signer=lambda root: ref.sign(secret, root),
+        secret=secret,  # arms the native shredder lane when available
         slot=slot,
         batch_target_sz=4096,
     )

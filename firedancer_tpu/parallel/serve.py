@@ -567,6 +567,11 @@ class ShardedVerifyStage(VerifyStage):
 
     # -- mux callbacks -------------------------------------------------------
 
+    # this subclass accumulates per SHARD in after_frag below; the base
+    # class's drain-table batch intake would route through the wrong
+    # accumulator — keep the per-frag path
+    sweep_frags = None
+
     def before_frag(self, in_idx: int, seq: int, sig: int) -> bool:
         return True  # the router already sharded; never re-filter
 

@@ -46,6 +46,11 @@ def _load():
         ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint64,
     ]
     lib.fd_txn_parse.restype = ctypes.c_int64
+    lib.fd_txn_parse_burst.argtypes = [
+        ctypes.c_char_p, ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p,
+        ctypes.c_uint64, ctypes.c_void_p,
+    ]
+    lib.fd_txn_parse_burst.restype = ctypes.c_int64
     _lib = lib
     return lib
 
@@ -73,3 +78,64 @@ def txn_parse_native(payload: bytes) -> ft.Txn | None:
     if end != len(packed):
         return None
     return desc
+
+
+class BurstParser:
+    """Sweep-granularity parser (ISSUE 11): ONE fd_txn_parse_burst
+    crossing parses every payload of a drained sweep, with the scratch
+    buffers (rows table, descriptor arena, per-row meta) preallocated
+    and REUSED — the per-sweep caller (verify's sweep_frags) must pay
+    zero allocation beyond the returned descriptor bytes.  Single-owner
+    by design: one instance per stage, never shared across threads."""
+
+    def __init__(self, max_rows: int = 64):
+        import numpy as np
+
+        self._lib = _load()
+        self._max = max_rows
+        self._rows = np.zeros((max_rows, 2), dtype=np.uint64)
+        self._rows_p = self._rows.ctypes.data
+        self._meta = np.zeros((max_rows, 2), dtype=np.uint64)
+        self._meta_p = self._meta.ctypes.data
+        self._cap = max(_OUT_CAP, 512 * max_rows)
+        self._out = ctypes.create_string_buffer(self._cap)
+
+    def _grow(self, n: int) -> None:
+        import numpy as np
+
+        self._max = max(n, 2 * self._max)
+        self._rows = np.zeros((self._max, 2), dtype=np.uint64)
+        self._rows_p = self._rows.ctypes.data
+        self._meta = np.zeros((self._max, 2), dtype=np.uint64)
+        self._meta_p = self._meta.ctypes.data
+        self._cap = max(self._cap, 512 * self._max)
+        self._out = ctypes.create_string_buffer(self._cap)
+
+    def parse(self, buf: bytes, rows) -> list[bytes | None]:
+        """rows: iterable of drain-table rows (off at col 2, sz at col
+        3).  Returns one packed descriptor (or None = rejected) per row,
+        each byte-identical to txn_parse_packed on the same payload."""
+        n = len(rows)
+        if n == 0:
+            return []
+        if n > self._max:
+            self._grow(n)
+        rt = self._rows
+        for i, row in enumerate(rows):
+            rt[i, 0] = row[2]
+            rt[i, 1] = row[3]
+        while True:
+            total = self._lib.fd_txn_parse_burst(
+                buf, self._rows_p, n, self._out, self._cap, self._meta_p,
+            )
+            if total != -2:
+                break
+            self._cap *= 4
+            self._out = ctypes.create_string_buffer(self._cap)
+        raw = ctypes.string_at(self._out, total)
+        meta = self._meta
+        return [
+            raw[int(meta[i, 0]): int(meta[i, 0]) + int(meta[i, 1])]
+            if meta[i, 1] else None
+            for i in range(n)
+        ]

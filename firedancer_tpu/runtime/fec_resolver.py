@@ -56,10 +56,21 @@ class FecResolver:
         max_inflight: int = 64,
         done_depth: int = 512,
         verify_sig=None,  # callable(root: bytes, sig: bytes) -> bool
+        trust_membership: bool = False,
     ):
+        """trust_membership: verify the merkle membership proof only for
+        the FIRST shred of each set (which also yields the set's root —
+        the FecSet.merkle_root contract is unchanged) instead of per
+        shred (~7 hashes each).  ONLY for a resolver consuming shreds
+        this process itself produced — the leader's own store trusting
+        its own signing path (the reference's fd_fec_resolver_new
+        NULL-signer contract extended to the whole proof: same trust
+        boundary).  Receive-path resolvers (turbine, repair) must keep
+        full verification."""
         self.max_inflight = max_inflight
         self.done_depth = done_depth
         self.verify_sig = verify_sig
+        self.trust_membership = trust_membership and verify_sig is None
         self._sets: OrderedDict[tuple, _SetCtx] = OrderedDict()
         self._done: OrderedDict[tuple, None] = OrderedDict()
         self.metrics = {
@@ -85,18 +96,25 @@ class FecResolver:
             return None
 
         # membership proof: leaf through the shred's own proof to the
-        # (untruncated 32-byte) root
+        # (untruncated 32-byte) root.  A trusted (self-produced) stream
+        # recomputes it ONCE PER SET (from the first shred's proof chain
+        # — the FecSet.merkle_root contract stays intact at 1/d the
+        # hashing) instead of per shred; set identity is then
+        # (slot, fec_set_idx) alone, which is exactly what the producing
+        # shredder keyed on.
         depth = fs.merkle_cnt(s.variant)
-        leaf = bmtree.hash_leaf_full(s.merkle_leaf_data(buf))
         pos = (s.idx - s.fec_set_idx) if s.is_data else None
-        if s.is_data:
-            leaf_idx = pos
-        else:
-            # parity leaves sit after the data leaves in the set's tree
-            leaf_idx = s.data_cnt + s.code_idx
-        root = bmtree.verify_proof(leaf, leaf_idx, s.merkle_proof(buf))
-
         ctx = self._sets.get(key)
+        if self.trust_membership and ctx is not None:
+            root = ctx.merkle_root
+        else:
+            leaf = bmtree.hash_leaf_full(s.merkle_leaf_data(buf))
+            if s.is_data:
+                leaf_idx = pos
+            else:
+                # parity leaves sit after the data leaves in the set's tree
+                leaf_idx = s.data_cnt + s.code_idx
+            root = bmtree.verify_proof(leaf, leaf_idx, s.merkle_proof(buf))
         if ctx is None:
             # first shred of the set fixes root + signature (verified once)
             sig = s.signature(buf)

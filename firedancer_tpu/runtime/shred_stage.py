@@ -13,6 +13,23 @@ Outputs: outs[0] = wire shreds (mtu >= 1228).
 Entry batches close when the accumulated serialized entries reach
 `batch_target_sz` (the reference bounds batches by pending shred budget)
 or on flush at slot end.
+
+Native lanes (ISSUE 11), chosen at construction when `secret` is given:
+
+  - sweep mode: with the native shredder built, a native out producer,
+    and no keep_sets/plane requirement, the stage registers a
+    shred_native.StageClient as its sweep-harness client — the ENTIRE
+    run_once sweep (drain entries -> accumulate -> batch close -> shred
+    -> publish) is one fdr_sweep crossing with zero Python per frag,
+    the reference's mux-run-loop shape.  The Python callbacks below
+    remain the fallback surface (mixed-lane/lossy splices) and forward
+    into the SAME C-side batch buffer, so the lanes cannot diverge.
+  - batch mode: keep_sets/plane-less topologies that stay on the Python
+    frag path still shred through NativeShredder — one FFI crossing per
+    entry batch, byte-identical sets.
+
+`FDTPU_NATIVE_SHRED=0` (or a toolchain-less host) restores the pure
+Python shredder end to end.
 """
 
 from __future__ import annotations
@@ -27,6 +44,7 @@ class ShredStage(Stage):
         self,
         *args,
         signer,
+        secret: bytes | None = None,
         slot: int = 1,
         shred_version: int = 1,
         batch_target_sz: int = 16384,
@@ -35,16 +53,61 @@ class ShredStage(Stage):
         **kwargs,
     ):
         super().__init__(*args, **kwargs)
-        self.shredder = Shredder(signer=signer, shred_version=shred_version,
-                                 plane=plane)
-        self.slot = slot
+        self._slot = slot
         self.batch_target_sz = batch_target_sz
         self.keep_sets = keep_sets
         self.sets: list[FecSet] = []  # retained for tests/observers
         self._buf = bytearray()
         self._buf_tsorig = 0
+        # -- lane selection ---------------------------------------------------
+        # the mesh-sharded parity path (plane) is the Python shredder's;
+        # keep_sets needs materialized FecSets, so sweep mode is out
+        self.shredder = None
+        self._sweep_client = None
+        self.native_shred = False
+        if secret is not None and plane is None:
+            from . import shred_native as sd
+
+            if sd.available():
+                try:
+                    nshred = sd.NativeShredder(secret=secret,
+                                               shred_version=shred_version)
+                    self.shredder = nshred
+                    self.native_shred = True
+                    if not keep_sets and self.outs and type(
+                        self.outs[0]
+                    ).__name__ == "NativeProducer":
+                        self._sweep_client = sd.StageClient(
+                            nshred._ctx, self.outs[0], slot=slot,
+                            batch_target=batch_target_sz,
+                        )
+                except sd.NativeUnavailable:
+                    self.shredder = None
+                    self.native_shred = False
+        if self.shredder is None:
+            self.shredder = Shredder(signer=signer,
+                                     shred_version=shred_version, plane=plane)
+
+    # slot is a property so the sweep client's C-side state (and its
+    # slot-scoped shred index reset) tracks reassignment exactly like
+    # the Python Shredder's `if slot != self.slot` check does per batch
+    @property
+    def slot(self) -> int:
+        return self._slot
+
+    @slot.setter
+    def slot(self, v: int) -> None:
+        self._slot = v
+        if self._sweep_client is not None:
+            self._sweep_client.set_slot(v)
 
     def after_frag(self, in_idx: int, meta, payload: bytes) -> None:
+        c = self._sweep_client
+        if c is not None:
+            # fallback surface (mixed-lane / lossy splice): forward into
+            # the C-side buffer the sweep callback fills — one state
+            c.append(payload, int(meta[MCache.COL_TSORIG]))
+            return
         # entries are appended verbatim: the entry frame IS this build's
         # entry-batch serialization (the reference ships bincode entries)
         self._buf += len(payload).to_bytes(4, "little")
@@ -57,9 +120,24 @@ class ShredStage(Stage):
             self._shred_batch(block_complete=False)
 
     def after_credit(self) -> None:
+        c = self._sweep_client
+        if c is not None:
+            # batch deferred for credits in C: retry with the flag the
+            # deferred flush recorded (block_complete survives the wait)
+            if c.pending_flush:
+                c.retry_flush()
+            return
         # batch closed for size but deferred for credits: retry here
         if len(self._buf) >= self.batch_target_sz and self._room():
             self._shred_batch(block_complete=False)
+
+    def during_housekeeping(self) -> None:
+        c = self._sweep_client
+        if c is not None:
+            # C-side counters are authoritative in sweep mode: copy the
+            # absolute values into the schema metrics at the same lazy
+            # cadence every other stage metric has
+            self.metrics.counters.update(c.counters())
 
     def _room(self) -> bool:
         """A batch bursts ~2 sets x ~65 shreds; don't start shredding unless
@@ -67,6 +145,11 @@ class ShredStage(Stage):
         return not self.outs or self.outs[0].cr_avail >= 256
 
     def flush(self, *, block_complete: bool = True) -> None:
+        c = self._sweep_client
+        if c is not None:
+            c.flush(block_complete=block_complete)
+            self.metrics.counters.update(c.counters())
+            return
         if self._buf:
             self._shred_batch(block_complete=block_complete)
 

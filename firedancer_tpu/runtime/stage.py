@@ -190,6 +190,13 @@ class Stage:
         # rebuilt when the input list changes — e.g. a chaos LossyConsumer
         # splice drops the stage back to the per-frag poll path)
         self._drainer: tuple | None = None
+        # sweep-harness client (ISSUE 11): a stage that registers one (an
+        # object with .cb/.cb_ctx — e.g. shred_native.StageClient) runs
+        # its ENTIRE sweep through fdr_sweep: drain -> C stage callback
+        # -> publish, zero Python per frag.  The fallback surfaces
+        # (after_frag on mixed/lossy lanes) must forward into the same
+        # C-side state so the two paths never diverge.
+        self._sweep_client = None
         # ring-cost instrument (bench.py): when enabled, poll/drain and
         # publish time accumulate separately from stage compute
         self.ring_clock = False
@@ -318,6 +325,8 @@ class Stage:
         if n_in:
             drainer = self._native_drainer()
             if drainer is not None:
+                if self._sweep_client is not None:
+                    return self._native_sweep(drainer)
                 return self._native_burst(drainer)
         progressed = False
         # burst-drain: up to `burst` frags per sweep.  One-frag sweeps
@@ -383,25 +392,68 @@ class Stage:
     # -- native ring burst path ---------------------------------------------
 
     def _native_drainer(self):
-        """The cached fdr_drain plan when EVERY input is a native-ring
-        consumer, else None (per-frag poll path — Python consumers,
-        LossyConsumer shims, mixed lanes).  Keyed on the input objects so
-        a spliced/replaced input rebuilds the plan."""
+        """The cached fdr_drain/fdr_sweep plan when EVERY input is a
+        native-ring consumer, else None (per-frag poll path — Python
+        consumers, LossyConsumer shims, mixed lanes).  Keyed on the
+        input objects AND the sweep client so a spliced/replaced input
+        (or a re-armed client) rebuilds the plan."""
         cached = self._drainer
+        client = self._sweep_client
         # list == compares elements by identity here (consumers define no
         # __eq__), so revalidation costs no allocation per sweep; a chaos
         # LossyConsumer splice (stage.ins[i] = shim) breaks the equality
         # and rebuilds the plan
-        if cached is not None and cached[0] == self.ins:
+        if cached is not None and cached[0] == self.ins \
+                and cached[2] is client:
             return cached[1]
         drainer = None
         fn = _native_ring()
         if fn is not None and all(
             type(c) is fn.NativeConsumer for c in self.ins
         ):
-            drainer = fn.BurstDrainer(self.ins, max(1, self.burst))
-        self._drainer = (list(self.ins), drainer)
+            if client is not None:
+                drainer = fn.SweepDrainer(self.ins, max(1, self.burst),
+                                          client)
+            else:
+                drainer = fn.BurstDrainer(self.ins, max(1, self.burst))
+        self._drainer = (list(self.ins), drainer, client)
         return drainer
+
+    def _native_sweep(self, drainer) -> bool:
+        """One run_once sweep through the generic sweep harness: ONE FFI
+        crossing drains every input AND runs the stage's registered C
+        callback per frag (fdr_sweep) — drain table -> stage compute ->
+        publish with zero Python per frag.  Python's per-sweep work is
+        bookkeeping only: frags_in and the batched frag_latency_ns
+        observation off the returned meta table."""
+        max_frags = self.burst if self.burst > 0 else 1
+        m = self.metrics
+        # the crossing fuses drain + stage compute + publish: its time
+        # is stage compute, not ring machinery — even under ring_clock
+        # it is NOT clocked into ring_poll_s (the A/B ring split stays
+        # honest)
+        n, self._in_rr, d_ovr = drainer.sweep(self._in_rr, max_frags)
+        if d_ovr:
+            m.inc("overrun", d_ovr)
+            tot = m.get("overrun")
+            if (tot ^ (tot - d_ovr)) >> 6 or tot == d_ovr:
+                self.trace(fm.EV_OVERRUN, tot)
+        if n == 0:
+            return d_ovr > 0
+        m.inc("frags_in", n)
+        ts_col = drainer.meta[:n, 5].astype(np.int64)
+        lat = shm.now_ns() - ts_col
+        ok = lat[(ts_col > 0) & (lat >= 0)]
+        if ok.size:
+            m.observe_batch("frag_latency_ns", ok)
+        return True
+
+    # drain-table batch hook: a stage may process a whole drained sweep
+    # from the meta table + joined payload buffer in ONE call instead of
+    # per-frag before/during/after dispatch (3 dynamic calls per frag on
+    # the hot path).  Return (frags consumed, [tsorig...]) with the same
+    # counting rules the per-frag loop has.  None = use the per-frag loop.
+    sweep_frags = None
 
     def _native_burst(self, drainer) -> bool:
         """One run_once sweep over the native ring plane: ONE FFI
@@ -444,6 +496,17 @@ class Stage:
         rows = drainer.meta[:n].tolist()
         last = rows[n - 1]
         buf = drainer.arena[: last[2] + last[3]].tobytes()
+        sweep_frags = self.sweep_frags
+        if sweep_frags is not None:
+            n_done, ts_done = sweep_frags(rows, buf)
+            if n_done:
+                m.inc("frags_in", n_done)
+                ts_col = np.asarray(ts_done, dtype=np.int64)
+                lat = shm.now_ns() - ts_col
+                ok = lat[(ts_col > 0) & (lat >= 0)]
+                if ok.size:
+                    m.observe_batch("frag_latency_ns", ok)
+            return True
         before_frag = self.before_frag
         during_frag = self.during_frag
         after_frag = self.after_frag

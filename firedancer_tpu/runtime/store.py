@@ -19,9 +19,15 @@ from .stage import Stage
 
 
 class StoreStage(Stage):
-    def __init__(self, *args, verify_sig=None, blockstore=None, **kwargs):
+    def __init__(self, *args, verify_sig=None, blockstore=None,
+                 trust_membership: bool = False, **kwargs):
         super().__init__(*args, **kwargs)
-        self.resolver = FecResolver(verify_sig=verify_sig, max_inflight=256)
+        # trust_membership: the leader's own store consuming its own
+        # shred stream skips the per-shred merkle membership recompute
+        # (~7 hashes/shred) — the fd_fec_resolver NULL-signer trust
+        # boundary; receive-path stores keep full verification
+        self.resolver = FecResolver(verify_sig=verify_sig, max_inflight=256,
+                                    trust_membership=trust_membership)
         self.sets_by_slot: dict[int, list] = {}
         # optional persistent history (flamenco/blockstore.Blockstore):
         # every data shred lands there, making the slot replayable after

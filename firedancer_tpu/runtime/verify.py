@@ -206,6 +206,8 @@ class VerifyStage(Stage):
         # the queue without limit
         self._emit_queue: list = []
         self._emit_queue_max = 8192
+        # sweep-granularity parser (drain-table path), built on first use
+        self._burst_parser = None
 
     # -- observability ------------------------------------------------------
 
@@ -268,10 +270,9 @@ class VerifyStage(Stage):
             return None
         return sigs, msg, signers, t, packed
 
-    def after_frag(self, in_idx: int, meta, payload: bytes) -> None:
-        got = self._intake(payload)
-        if got is None:
-            return
+    def _accumulate(self, got, payload: bytes, tsorig: int) -> None:
+        """Batch one intaken txn (the ONE accumulation implementation —
+        after_frag and the drain-table sweep_frags path both land here)."""
         sigs, msg, signers, t, packed = got
         slots = self._signer_slots(signers)
         acc = self._comb if slots is not None else self._gen
@@ -285,9 +286,83 @@ class VerifyStage(Stage):
         acc.ranges.append((start, len(acc.elems)))
         acc.payloads.append(payload)
         acc.descs.append((t, packed))
-        acc.tsorigs.append(int(meta[MCACHE_COL_TSORIG]))
+        acc.tsorigs.append(tsorig)
         if len(acc.elems) >= self.batch:
             self._close_batch(acc)
+
+    def after_frag(self, in_idx: int, meta, payload: bytes) -> None:
+        got = self._intake(payload)
+        if got is None:
+            return
+        self._accumulate(got, payload, int(meta[MCACHE_COL_TSORIG]))
+
+    def sweep_frags(self, rows, buf: bytes):
+        """Drain-table batch intake (ISSUE 11): one call consumes a whole
+        native-ring sweep off the meta table + joined payload buffer —
+        the shard filter reads the seq column directly, the per-packet
+        parse collapses into ONE fd_txn_parse_burst crossing over the
+        table's (off, sz) columns, and the 3-call per-frag dispatch
+        (before/during/after) disappears.  Counting parity with the
+        per-frag path: shard-filtered frags are `filtered` (not
+        frags_in); intake drops count frags_in."""
+        shard_cnt = self.shard_cnt
+        shard_idx = self.shard_idx
+        accumulate = self._accumulate
+        m = self.metrics
+        n_done = 0
+        ts_done: list[int] = []
+        if shard_cnt > 1:
+            kept = []
+            for row in rows:
+                if (row[0] % shard_cnt) != shard_idx:
+                    m.inc("filtered")
+                else:
+                    kept.append(row)
+            rows = kept
+        if not rows:
+            return 0, ts_done
+        if _txn_packed is None:
+            # python-parser fallback: per-frag intake, still one sweep
+            for row in rows:
+                off = row[2]
+                payload = buf[off : off + row[3]]
+                n_done += 1
+                ts_done.append(row[5])
+                got = self._intake(payload)
+                if got is not None:
+                    accumulate(got, payload, row[5])
+            return n_done, ts_done
+        bp = self._burst_parser
+        if bp is None:
+            from firedancer_tpu.protocol.txn_native import BurstParser
+
+            bp = self._burst_parser = BurstParser(max(64, self.burst))
+        descs = bp.parse(buf, rows)
+        tcache = self.tcache
+        max_msg = self.max_msg_len
+        batch = self.batch
+        for row, packed in zip(rows, descs):
+            n_done += 1
+            ts_done.append(row[5])
+            if packed is None or len(packed) != ft.txn_packed_sz(
+                packed[16], packed[13]
+            ):
+                m.inc("parse_fail")
+                continue
+            off = row[2]
+            payload = buf[off : off + row[3]]
+            sigs, msg, signers = _packed_fields(payload, packed)
+            if tcache.insert(sig_tag(sigs[0])):
+                m.inc("dedup_dup")
+                continue
+            if len(msg) > max_msg:
+                m.inc("msg_too_long")
+                continue
+            if len(sigs) > batch:
+                m.inc("too_many_sigs")
+                continue
+            accumulate((sigs, msg, signers, None, packed), payload, row[5])
+        return n_done, ts_done
 
     def before_credit(self) -> None:
         # The batch-deadline clock is stamped HERE, not in after_frag

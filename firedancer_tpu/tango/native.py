@@ -128,6 +128,12 @@ def _load():
         u64, ctypes.c_void_p, u64, ctypes.c_void_p, ctypes.POINTER(u64),
     ]
     lib.fdr_drain.restype = ctypes.c_int64
+    lib.fdr_sweep.argtypes = [
+        ctypes.POINTER(PL), ctypes.POINTER(PC), u64, ctypes.POINTER(u64),
+        u64, ctypes.c_void_p, u64, ctypes.c_void_p, ctypes.POINTER(u64),
+        ctypes.c_void_p, ctypes.c_void_p,
+    ]
+    lib.fdr_sweep.restype = ctypes.c_int64
     lib.fdr_publish_n.argtypes = [PL, PP, ctypes.c_char_p, u64, u64]
     lib.fdr_consume_n.argtypes = [PL, PC, ctypes.c_char_p, u64, u64]
     lib.fdr_consume_n.restype = u64
@@ -415,5 +421,35 @@ class BurstDrainer:
             self._links, self._cons, self._n, self._rrp,
             min(max_frags, self.max_frags), self._arena_p, self._arena_sz,
             self._meta_p, self._ovrnp,
+        )
+        return int(n), int(self._rr.value), int(self._ovrn.value)
+
+
+class SweepDrainer(BurstDrainer):
+    """The full sweep-harness crossing (fdr_sweep): drain all inputs AND
+    run the registered stage's C callback per frag in the same crossing
+    — zero Python per frag.  `client` is a stage sweep client exposing
+    `.cb` (address of its fdr_sweep_cb-conformant C function) and
+    `.cb_ctx` (its context pointer) — e.g. runtime/shred_native
+    .StageClient.  The meta table still fills like fdr_drain's, so the
+    stage loop batch-observes frag latencies from the tsorig column."""
+
+    def __init__(self, consumers: list[NativeConsumer], max_frags: int,
+                 client):
+        super().__init__(consumers, max_frags)
+        self.client = client
+        self._cb = client.cb
+        self._cb_ctx = client.cb_ctx
+
+    def sweep(self, rr: int, max_frags: int) -> tuple[int, int, int]:
+        """(frags processed, next rr cursor, overruns this sweep)."""
+        for c in self.consumers:
+            if c._lsp is None:
+                raise RuntimeError("detached native consumer (link closed)")
+        self._rr.value = rr % self._n
+        n = self._lib.fdr_sweep(
+            self._links, self._cons, self._n, self._rrp,
+            min(max_frags, self.max_frags), self._arena_p, self._arena_sz,
+            self._meta_p, self._ovrnp, self._cb, self._cb_ctx,
         )
         return int(n), int(self._rr.value), int(self._ovrn.value)

@@ -313,6 +313,53 @@ int64_t fdr_drain(fdr_link* const* links, fdr_consumer* const* cons,
   return (int64_t)got;
 }
 
+// The generic native-stage sweep (ISSUE 11): fdr_drain's loop with a C
+// stage callback invoked per frag — a registered stage's ENTIRE
+// run_once sweep (drain -> stage compute -> publish, the publish side
+// living behind function pointers handed to the stage module) executes
+// in one FFI crossing with zero Python per frag, mirroring the
+// reference's mux run loop.  The meta table still fills exactly like
+// fdr_drain's so the Python side batch-observes frag latencies from the
+// tsorig column without touching payloads.  The callback returns >= 0
+// to continue, < 0 to stop the sweep after this (already consumed)
+// frag — a stage must buffer internally rather than reject, the same
+// contract its Python after_frag has.
+typedef int (*fdr_sweep_cb)(void* ctx, const uint64_t* meta8,
+                            const uint8_t* payload);
+
+int64_t fdr_sweep(fdr_link* const* links, fdr_consumer* const* cons,
+                  uint64_t n_links, uint64_t* rr_io, uint64_t max_frags,
+                  uint8_t* arena, uint64_t arena_sz, uint64_t* meta_out,
+                  uint64_t* ovrn_out, fdr_sweep_cb cb, void* cb_ctx) {
+  uint64_t got = 0, off = 0, rr = *rr_io, idle = 0, ovrn = 0;
+  int stop = 0;
+  while (!stop && got < max_frags && idle < n_links) {
+    uint64_t i = rr % n_links;
+    const fdr_link* l = links[i];
+    fdr_consumer* c = cons[i];
+    rr = i + 1;
+    if (off + l->mtu > arena_sz) break;
+    uint64_t* m = meta_out + got * DRAIN_NCOL;
+    int rc = poll_step(l, c, arena + off, m);
+    if (rc == 0) {
+      m[2] = off;
+      m[7] = i;
+      if (cb(cb_ctx, m, arena + off) < 0) stop = 1;
+      off += m[3];
+      got++;
+      idle = 0;
+    } else if (rc == 1) {
+      ovrn++;
+      idle = 0;
+    } else {
+      idle++;
+    }
+  }
+  *rr_io = rr % n_links;
+  *ovrn_out = ovrn;
+  return (int64_t)got;
+}
+
 // Bulk benchmark helpers: move n frags entirely in native code (the
 // ping-pong microbench shape, bench_frag_tx analog).
 void fdr_publish_n(const fdr_link* l, fdr_producer* p, const uint8_t* payload,

@@ -208,4 +208,30 @@ int64_t fd_txn_parse(const uint8_t* payload, uint64_t sz, uint8_t* out,
   return (int64_t)w.i;
 }
 
+// Burst parse over a drained sweep (ISSUE 11 verify host orchestration):
+// rows are (byte offset, size) u64 pairs into `buf` — the drain table's
+// chunk/sz columns verbatim — and every payload parses in ONE crossing.
+// Per row, out_meta gets (offset into out, descriptor length); length 0
+// means the payload was rejected.  Returns total bytes written, or -2
+// when out ran out of capacity (caller grows and retries).
+int64_t fd_txn_parse_burst(const uint8_t* buf, const uint64_t* rows,
+                           uint64_t n, uint8_t* out, uint64_t out_cap,
+                           uint64_t* out_meta) {
+  uint64_t off = 0;
+  for (uint64_t i = 0; i < n; i++) {
+    int64_t r = fd_txn_parse(buf + rows[2 * i], rows[2 * i + 1], out + off,
+                             out_cap - off);
+    if (r == -2) return -2;
+    if (r < 0) {
+      out_meta[2 * i] = 0;
+      out_meta[2 * i + 1] = 0;
+    } else {
+      out_meta[2 * i] = off;
+      out_meta[2 * i + 1] = (uint64_t)r;
+      off += (uint64_t)r;
+    }
+  }
+  return (int64_t)off;
+}
+
 }  // extern "C"

@@ -5,6 +5,7 @@ import hashlib
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from firedancer_tpu.ops import curve as fc
 from firedancer_tpu.ops import limbs as fl
@@ -162,6 +163,7 @@ def test_scalar_reduce512(rng):
     assert got == [v % L for v in cases]
 
 
+@pytest.mark.slow  # jit-compiles the full double-scalar-mult (~2 min)
 def test_double_scalar_mul_base(rng):
     # [s]B + [k]A vs python ref, including k or s = 0 edge cases
     ks = [int.from_bytes(rng.bytes(32), "little") % L for _ in range(6)] + [0, 1]
@@ -189,6 +191,7 @@ def test_double_scalar_mul_base(rng):
     assert got == expect
 
 
+@pytest.mark.slow  # compiles BOTH scalar-mult paths (~100 s on 1 core)
 def test_windowed_matches_ladder(rng):
     """Differential: the windowed fast path == the 1-bit Shamir ladder on
     random (k, s, A) triples (both must equal the host ref, but checking

@@ -781,3 +781,74 @@ def test_fd212_registered_and_clean_on_repo():
     root = os.path.join(os.path.dirname(__file__), "..", "firedancer_tpu")
     findings = ast_rules.lint_path(root)
     assert [f for f in findings if f.rule == "FD212"] == []
+
+
+# -- FD213: per-frag hashing/bytes assembly in the shred path -----------------
+
+
+_SHRED_CHURN_SRC = '''
+import hashlib
+from firedancer_tpu.ops.ref.bmtree import hash_leaf_full
+
+class ShredishStage:
+    def after_frag(self, in_idx, meta, payload):
+        leaf = hash_leaf_full(payload)            # FD213: merkle churn
+        node = hashlib.sha256(payload).digest()   # FD213: hash per frag
+        frame = b"\\x00" * 4 + payload            # FD213: literal concat
+        buf = bytes(payload)                      # FD213: bytes() per frag
+        joined = b"".join(self._parts)            # FD213: join concat
+        self._buf += payload                      # ok: append-only extend
+
+    def _shred_batch(self):
+        # FEC-set granularity: the sanctioned place for all of it
+        root = hashlib.sha256(bytes(self._buf)).digest()
+        return b"".join(self._shreds)
+'''
+
+
+def test_fd213_flags_hash_and_concat_in_shred_frag():
+    findings = ast_rules.lint_source(
+        _SHRED_CHURN_SRC, "firedancer_tpu/runtime/shredder.py")
+    hits = [f for f in findings if f.rule == "FD213"]
+    assert len(hits) == 5
+    batch_line = _SHRED_CHURN_SRC[: _SHRED_CHURN_SRC.index(
+        "_shred_batch")].count("\n") + 1
+    assert all(f.line < batch_line for f in hits)
+
+
+def test_fd213_scoped_to_shred_path_modules():
+    # the identical body in a non-shred module is not FD213's business
+    findings = ast_rules.lint_source(
+        _SHRED_CHURN_SRC, "firedancer_tpu/runtime/dedup.py")
+    assert [f for f in findings if f.rule == "FD213"] == []
+
+
+def test_fd213_batch_granularity_ok():
+    # the ShredStage discipline: frag callbacks append; hashing/framing
+    # happen when the batch closes (helper methods, not frag callbacks)
+    src = '''
+import hashlib
+
+class ShredStage:
+    def after_frag(self, in_idx, meta, payload):
+        self._buf += len(payload).to_bytes(4, "little")
+        self._buf += payload
+
+    def flush(self):
+        return hashlib.sha256(bytes(self._buf)).digest()
+'''
+    findings = ast_rules.lint_source(
+        src, "firedancer_tpu/runtime/shred_stage.py")
+    assert [f for f in findings if f.rule == "FD213"] == []
+
+
+def test_fd213_registered_and_clean_on_repo():
+    assert "FD213" in {r.id for r in all_rules()}
+    import os
+
+    for rel in ("shredder.py", "shred_stage.py", "shred_native.py",
+                "store.py", "fec_resolver.py"):
+        root = os.path.join(os.path.dirname(__file__), "..",
+                            "firedancer_tpu", "runtime", rel)
+        findings = ast_rules.lint_path(root)
+        assert [f for f in findings if f.rule == "FD213"] == []

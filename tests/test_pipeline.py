@@ -191,6 +191,7 @@ def test_two_way_verify_fanout():
         pipe.close()
 
 
+@pytest.mark.slow  # batch=32 kernel shape = a second ~4-min XLA compile
 def test_corrupted_txn_dropped_by_kernel():
     from firedancer_tpu.runtime.benchg import gen_transfer_pool
     from firedancer_tpu.models import leader as ml
@@ -228,6 +229,7 @@ def test_encode_decode_verified_roundtrip():
     assert p2 == p and t2 == t
 
 
+@pytest.mark.slow  # third sigverify compile shape (~3.5 min on 1 core)
 @pytest.mark.timeout(1200)
 def test_mixed_workload_pipeline_replays_to_same_bank_hash():
     """The VERDICT r4 #1 done-criterion: a block containing system +
