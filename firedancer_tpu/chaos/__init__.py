@@ -16,6 +16,11 @@ Layout:
     faults.py       declarative fault schedule + the supervisor hook
                     (kill/freeze stages) and link-fault specs (the
                     tango/lossy.py shim)
+    cluster.py      cluster-in-a-box: N full validator loops
+                    (models/validator.py) over the real loopback wire —
+                    gossip discovery, wsample leader rotation, turbine
+                    fan-out with a receipt-ledger audit, repair,
+                    snapshot cold boot, partition/kill/freeze faults
     invariants.py   the checker: named checks -> a deterministic summary
     scenario.py     named scenarios + the runner behind
                     `python -m firedancer_tpu chaos run <name> --seed S`

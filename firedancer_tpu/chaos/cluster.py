@@ -1,0 +1,527 @@
+"""Cluster-in-a-box: N full validators over the real loopback wire.
+
+`ClusterHarness` boots N `models/validator.Validator` loops in one
+process — each with its own identity/stake, funk, blockstore, gossip
+node, repair server/client and choreo voter — discovering each other via
+real gossip push/pull over UDP, rotating leaders per the wsample epoch
+schedule, fanning shreds over the real Turbine tree, with followers
+resolving FEC sets, replaying, and voting through the tower.  The
+cooperative step loop is the only scheduler, so a whole cluster run is
+deterministic per seed (the chaos summary contract).
+
+Fault machinery (the cluster flavors of chaos/faults.py):
+
+  - `PartitionCluster` splits validators into wire groups; every
+    cross-group datagram (gossip, shreds, votes, repair) is dropped at
+    the `WireSock` shim until heal — forks grow for real;
+  - `KillValidator` stops a node mid-slot (its sockets stay bound and
+    unread, exactly what a SIGKILLed process leaves behind);
+  - `FreezeValidator` models a wedged node whose NIC drains to nowhere
+    (the laggard fault; thaw brings it back behind the cluster);
+  - seeded `drop_p` wire loss reuses the tango/lossy parameterization at
+    datagram granularity.
+
+The receipt-ledger + `turbine_audit` prove shreds only ever travel
+tree-legal paths (or repair).  `TxnClient` is the honest user: it
+submits each txn to the slot leader's TPU port and re-submits anything
+that has not landed on the observer's best chain — the exactly-once
+invariant rides on the bank's staged status-cache gate, not on client
+discipline.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import socket
+from dataclasses import dataclass
+
+from firedancer_tpu.models.validator import (
+    GenesisConfig,
+    Validator,
+    make_cluster_genesis,
+)
+from firedancer_tpu.protocol.shred_dest import NO_DEST, Dest, ShredDest
+from firedancer_tpu.utils.rng import Rng
+
+
+class ClusterNet:
+    """The shared wire model: who owns which UDP port, which partition
+    group each validator is in, and the seeded loss the shims apply."""
+
+    def __init__(self, rng: Rng):
+        self.rng = rng
+        self.port_owner: dict[int, bytes] = {}
+        self.groups: dict[bytes, int] = {}
+        self.partitioned = False
+        self.drop_p = 0.0
+        self.cut_dropped = 0  # partition cuts
+        self.lossy_dropped = 0  # seeded random loss
+        self.dead: set[bytes] = set()
+
+    def register(self, pubkey: bytes, *ports: int) -> None:
+        for p in ports:
+            self.port_owner[p] = pubkey
+
+    def partition(self, groups: dict[bytes, int]) -> None:
+        self.groups = dict(groups)
+        self.partitioned = True
+
+    def heal(self) -> None:
+        self.partitioned = False
+
+    def allow(self, src_pk: bytes, dst_port: int) -> bool:
+        if self.partitioned:
+            dst_pk = self.port_owner.get(dst_port)
+            if dst_pk is not None and self.groups.get(
+                src_pk, -1
+            ) != self.groups.get(dst_pk, -1):
+                self.cut_dropped += 1
+                return False
+        if self.drop_p and self.rng.float01() < self.drop_p:
+            self.lossy_dropped += 1
+            return False
+        return True
+
+
+class WireSock:
+    """Socket proxy applying the cluster wire model on sendto (receive
+    side stays untouched: the network drops, endpoints do not)."""
+
+    def __init__(self, inner: socket.socket, net: ClusterNet,
+                 owner: bytes):
+        self._inner = inner
+        self._net = net
+        self._owner = owner
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def sendto(self, data, addr):
+        if not self._net.allow(self._owner, addr[1]):
+            return len(data)  # the sender cannot tell a drop happened
+        return self._inner.sendto(data, addr)
+
+
+# -- cluster fault specs (chaos/faults.py's declarative convention) ----------
+
+
+@dataclass(frozen=True)
+class PartitionCluster:
+    """Cut the wire between validator groups during [at_slot, heal_slot):
+    group_of maps validator index -> group id."""
+
+    at_slot: int
+    heal_slot: int
+    group_of: tuple  # (group_id per validator index, ...)
+
+    def describe(self) -> str:
+        return (f"partition:{list(self.group_of)}"
+                f"@[{self.at_slot},{self.heal_slot})")
+
+
+@dataclass(frozen=True)
+class KillValidator:
+    """Stop validator `index` for good at (at_slot, at_step) — mid-slot
+    when the step lands inside the leader's shred broadcast."""
+
+    index: int
+    at_slot: int
+    at_step: int = 1
+
+    def describe(self) -> str:
+        return f"kill:v{self.index}@{self.at_slot}.{self.at_step}"
+
+
+@dataclass(frozen=True)
+class FreezeValidator:
+    """Wedge validator `index` during [at_slot, thaw_slot): alive but
+    deaf (its sockets drain to nowhere) — the laggard fault."""
+
+    index: int
+    at_slot: int
+    thaw_slot: int
+
+    def describe(self) -> str:
+        return f"freeze:v{self.index}@[{self.at_slot},{self.thaw_slot})"
+
+
+class TxnClient:
+    """The honest-user population of a cluster run: submits each txn of
+    a pregenerated pool to the CURRENT slot leader's TPU port, watches an
+    observer validator's best chain, and re-submits anything that has
+    not landed — across leader handoffs, kills, and partitions."""
+
+    def __init__(self, harness: "ClusterHarness", txns: list[bytes],
+                 *, per_slot: int = 4, resubmit_after_slots: int = 2):
+        from firedancer_tpu.protocol import txn as ft
+
+        self.harness = harness
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.sock.setblocking(False)
+        self.txns = []
+        for p in txns:
+            t = ft.txn_parse(p)
+            self.txns.append((t.signatures(p)[0], bytes(p)))
+        self.per_slot = per_slot
+        self.resubmit_after_slots = resubmit_after_slots
+        self._submitted_at: dict[bytes, int] = {}  # sig -> last submit slot
+        self._cursor = 0
+        self.submitted = 0
+        self.resubmitted = 0
+
+    @property
+    def sigs(self) -> list[bytes]:
+        return [s for s, _ in self.txns]
+
+    def tick(self, slot: int) -> None:
+        leader = self.harness.leader_of(slot)
+        if leader is None or not leader.alive or leader.frozen:
+            return
+        landed = self.harness.observer.chain_landed()
+        batch = []
+        # re-submit what fell off the chain (fork loss / missed slot)
+        for sig, payload in self.txns[: self._cursor]:
+            at = self._submitted_at.get(sig)
+            if sig in landed or at is None:
+                continue
+            if slot - at >= self.resubmit_after_slots:
+                batch.append((sig, payload))
+                self.resubmitted += 1
+        # fresh submissions
+        fresh_end = min(self._cursor + self.per_slot, len(self.txns))
+        for sig, payload in self.txns[self._cursor : fresh_end]:
+            batch.append((sig, payload))
+        self._cursor = fresh_end
+        for sig, payload in batch:
+            self.sock.sendto(payload, leader.tpu_addr)
+            self._submitted_at[sig] = slot
+            self.submitted += 1
+
+    def close(self) -> None:
+        self.sock.close()
+
+
+class ClusterHarness:
+    def __init__(
+        self,
+        n: int = 4,
+        *,
+        seed: int = 0,
+        steps_per_slot: int = 24,
+        txns_per_slot: int = 4,
+        n_txns: int | None = None,
+        fanout: int = 2,
+        slot_cnt: int = 128,
+        drop_p: float = 0.0,
+        root_lag: int = 4,
+        epoch: int = 0,
+    ):
+        from firedancer_tpu.runtime.benchg import (
+            gen_transfer_pool,
+            pool_blockhash,
+            pool_payers,
+        )
+
+        self.n = n
+        self.seed = seed
+        self.steps_per_slot = steps_per_slot
+        self.fanout = fanout
+        self.rounds = 0  # the cluster's only clock
+        clock = lambda: 1_000 + self.rounds * 50  # noqa: E731
+
+        pool_seed = b"cluster-%d" % seed
+        self.n_txns = n_txns if n_txns is not None else txns_per_slot * 64
+        self.pool = gen_transfer_pool(self.n_txns, seed=pool_seed)
+        accounts = tuple(
+            (pub, 10**12) for _sec, pub in pool_payers(pool_seed)
+        )
+        blockhashes = (pool_blockhash(pool_seed),)
+        self.genesis, secrets = make_cluster_genesis(
+            n, seed=seed, accounts=accounts, blockhashes=blockhashes,
+            slot_cnt=slot_cnt, epoch=epoch,
+        )
+        self.lsched = self.genesis.leaders()
+        self.net = ClusterNet(Rng(seed, 0xC1A5))
+        self.net.drop_p = drop_p
+        self.validators: list[Validator] = []
+        for i, sec in enumerate(secrets):
+            v = Validator(sec, genesis=self.genesis, clock=clock,
+                          seed=seed, index=i, fanout=fanout)
+            v.root_lag = root_lag
+            self.validators.append(v)
+        self.by_pubkey = {v.pubkey: v for v in self.validators}
+        for v in self.validators:
+            self.net.register(
+                v.pubkey, v.tvu_addr[1], v.tpu_addr[1],
+                v.gossip.addr[1], v.repair_server.addr[1],
+            )
+            # splice the wire model over every socket the node sends from
+            v.tvu_sock = WireSock(v.tvu_sock, self.net, v.pubkey)
+            v.gossip.sock = WireSock(v.gossip.sock, self.net, v.pubkey)
+            v.repair_server.sock = WireSock(v.repair_server.sock, self.net,
+                                            v.pubkey)
+            v.repair_client.sock = WireSock(v.repair_client.sock, self.net,
+                                            v.pubkey)
+        self._gossip_addrs = {v.pubkey: v.gossip.addr
+                              for v in self.validators}
+        self.client: TxnClient | None = None
+        self.current_slot = self.genesis.slot0 - 1
+        self.fired: list[str] = []
+        self._sdest_cache: dict[bytes, ShredDest] = {}
+
+    # -- convenience ---------------------------------------------------------
+
+    @property
+    def observer(self) -> Validator:
+        """The client's chain view: the first never-faulted validator."""
+        for v in self.validators:
+            if v.alive and not v.frozen and v.cold_boots == 0:
+                return v
+        return self.validators[0]
+
+    def leader_of(self, slot: int) -> Validator | None:
+        pk = self.lsched.leader_for_slot(slot)
+        return self.by_pubkey.get(pk) if pk is not None else None
+
+    def live(self) -> list[Validator]:
+        return [v for v in self.validators if v.alive]
+
+    def make_client(self, *, per_slot: int = 4) -> TxnClient:
+        self.client = TxnClient(self, list(self.pool), per_slot=per_slot)
+        return self.client
+
+    # -- boot: real gossip discovery -----------------------------------------
+
+    def boot(self, *, max_rounds: int = 600) -> int:
+        """Discover the cluster through the entrypoint (validator 0):
+        every node pushes its record there and pulls the table back, the
+        CRDS way.  Returns rounds used; raises on non-discovery."""
+        entry = self.validators[0]
+        want = self.n - 1
+        for r in range(max_rounds):
+            self.rounds += 1
+            if r % 4 == 0:
+                for v in self.validators[1:]:
+                    v.gossip.push([entry.gossip.addr])
+            if r % 8 == 4:
+                for v in self.validators[1:]:
+                    v.gossip.pull(entry.gossip.addr)
+            for v in self.validators:
+                v.gossip.poll()
+            if all(len(v.gossip.table) >= want for v in self.validators):
+                break
+        else:
+            raise RuntimeError(
+                f"gossip discovery incomplete after {max_rounds} rounds: "
+                f"{[len(v.gossip.table) for v in self.validators]}"
+            )
+        for v in self.validators:
+            v.gossip.refresh_active_set(b"cluster-%d" % self.seed)
+            v.build_dests(v.dest_table_from_gossip())
+        return r + 1
+
+    # -- the slot loop -------------------------------------------------------
+
+    def _fire_faults(self, faults, slot: int, step: int) -> None:
+        for f in faults:
+            if isinstance(f, PartitionCluster):
+                if slot == f.at_slot and step == 0:
+                    self.net.partition({
+                        self.validators[i].pubkey: g
+                        for i, g in enumerate(f.group_of)
+                    })
+                    self.fired.append(f.describe())
+                if slot == f.heal_slot and step == 0:
+                    self.net.heal()
+                    self.fired.append(f"heal@{slot}")
+            elif isinstance(f, KillValidator):
+                if slot == f.at_slot and step == f.at_step:
+                    v = self.validators[f.index]
+                    v.alive = False
+                    self.net.dead.add(v.pubkey)
+                    self.fired.append(f.describe())
+            elif isinstance(f, FreezeValidator):
+                if slot == f.at_slot and step == 0:
+                    self.validators[f.index].frozen = True
+                    self.fired.append(f.describe())
+                if slot == f.thaw_slot and step == 0:
+                    self.validators[f.index].frozen = False
+                    self.fired.append(f"thaw:v{f.index}@{slot}")
+
+    def pump_wire(self, exclude: Validator | None = None) -> None:
+        """The repair spin: the REST of the cluster keeps moving its
+        wire (gossip, shred intake, repair serving, outbox) while one
+        node blocks on a request — catch-up under load, without
+        re-entering replay."""
+        for v in self.validators:
+            if v is exclude or not v.alive:
+                continue
+            if v.frozen:
+                v._drain_discard()
+                continue
+            v.gossip.poll()
+            v.repair_server.poll()
+            v.poll_wire()
+            v.drain_outbox()
+
+    def run_slots(self, first_slot: int, n_slots: int, *, faults=(),
+                  repair_every: int = 6, housekeep_every: int = 8,
+                  gossip_horizon_ms: int | None = None) -> None:
+        for slot in range(first_slot, first_slot + n_slots):
+            self.current_slot = slot
+            for step in range(self.steps_per_slot):
+                self.rounds += 1
+                self._fire_faults(faults, slot, step)
+                if step == 0:
+                    if self.client is not None:
+                        self.client.tick(slot)
+                    leader = self.leader_of(slot)
+                    if (leader is not None and leader.alive
+                            and not leader.frozen
+                            and leader._sdest is not None):
+                        leader.poll_wire()  # drain the TPU inbox first
+                        leader.produce_block(slot)
+                for v in self.validators:
+                    v.step()
+                if step % repair_every == repair_every - 1:
+                    for v in self.validators:
+                        if v.alive and not v.frozen:
+                            v.repair_tick(
+                                spin=lambda v=v: self.pump_wire(exclude=v),
+                                current_slot=slot, budget=4,
+                            )
+                if step % housekeep_every == housekeep_every - 1:
+                    for v in self.validators:
+                        if not v.alive or v.frozen:
+                            continue
+                        # record refresh keeps live peers inside the
+                        # staleness horizon; partitioned halves age out
+                        v.gossip.push([
+                            a for pk, a in self._gossip_addrs.items()
+                            if pk != v.pubkey
+                        ])
+                        if gossip_horizon_ms is not None:
+                            v.gossip.housekeeping(
+                                horizon_ms=gossip_horizon_ms)
+
+    def settle(self, steps: int, *, repair_every: int = 4) -> None:
+        """Post-run quiesce: no new blocks, but replay/repair/votes keep
+        flowing until the cluster converges."""
+        for step in range(steps):
+            self.rounds += 1
+            for v in self.validators:
+                v.step()
+            if step % repair_every == repair_every - 1:
+                for v in self.validators:
+                    if v.alive and not v.frozen:
+                        v.repair_tick(
+                            spin=lambda v=v: self.pump_wire(exclude=v),
+                            current_slot=self.current_slot + 1, budget=4,
+                        )
+
+    # -- laggard cold boot ---------------------------------------------------
+
+    def snapshot_handoff(self, from_v: Validator, to_v: Validator,
+                         path: str) -> int:
+        """Cold-boot `to_v` from `from_v`'s published root: write the
+        snapshot archive, load it, and hand over the root's PoH tip
+        (captured at the same instant, like a real manifest would)."""
+        root = from_v.forks.root_slot
+        poh = from_v.forks.get(root).poh_hash
+        from_v.write_snapshot(path)
+        got = to_v.cold_boot_from_snapshot(path)
+        assert got == root
+        to_v.adopt_root_poh(poh)
+        return got
+
+    # -- audits --------------------------------------------------------------
+
+    def _sdest_for(self, source_pk: bytes) -> ShredDest:
+        sd = self._sdest_cache.get(source_pk)
+        if sd is None:
+            dests = [Dest(pubkey=pk, stake=st)
+                     for pk, st in self.genesis.stakes]
+            sd = ShredDest(dests, self.lsched, source_pk)
+            self._sdest_cache[source_pk] = sd
+        return sd
+
+    def turbine_audit(self, chain_slots) -> dict:
+        """Replay the receipt ledgers against the tree: every turbine
+        arrival must come from the sender the tree names (the leader,
+        for the root; the parent, below), and every (validator, slot,
+        FEC set) on `chain_slots` must be covered by a tree-legal
+        turbine receipt or repair.  Returns the audit summary dict."""
+        chain = set(chain_slots)
+        forbidden = []
+        covered = 0
+        missing = []
+        turbine_total = repair_total = 0
+        for v in self.validators:
+            have: dict[tuple, set] = {}
+            by_slot: dict[int, set] = {}  # slot -> fec_set_idxs seen
+            for r in v.receipts:
+                by_slot.setdefault(r.slot, set()).add(r.fec_set_idx)
+                sender = self.net.port_owner.get(r.src[1])
+                if r.lane == "repair":
+                    repair_total += 1
+                    have.setdefault((r.slot, r.fec_set_idx),
+                                    set()).add("repair")
+                    continue
+                turbine_total += 1
+                leader = self.lsched.leader_for_slot(r.slot)
+                ok = False
+                if sender is not None and leader is not None:
+                    if sender == leader:
+                        sd = self._sdest_for(leader)
+                        di = sd.first_for(r.slot, r.idx, r.is_data)
+                        ok = (di != NO_DEST
+                              and sd.dests[di].pubkey == v.pubkey)
+                    else:
+                        sd = self._sdest_for(sender)
+                        kids = sd.children_for(r.slot, r.idx, r.is_data,
+                                               fanout=self.fanout)
+                        ok = v.pubkey in {sd.dests[k].pubkey for k in kids}
+                if ok:
+                    have.setdefault((r.slot, r.fec_set_idx),
+                                    set()).add("turbine")
+                else:
+                    forbidden.append(
+                        (v.index, r.slot, r.idx, r.is_data,
+                         sender.hex()[:8] if sender else "?"))
+            for slot in chain:
+                leader = self.lsched.leader_for_slot(slot)
+                if leader == v.pubkey or not v.alive:
+                    continue
+                if slot not in v.blocks:
+                    continue
+                for fsi in by_slot.get(slot, ()):
+                    if have.get((slot, fsi)):
+                        covered += 1
+                    else:
+                        missing.append((v.index, slot, fsi))
+        return {
+            "forbidden": forbidden,
+            "covered": covered,
+            "missing": missing,
+            "turbine_receipts": turbine_total,
+            "repair_receipts": repair_total,
+        }
+
+    def landed_digest(self) -> str:
+        """Order-independent digest of the observer chain's landed txn
+        signatures (the deterministic summary form)."""
+        h = hashlib.sha256()
+        for slot in self.observer.best_chain():
+            for sig in self.observer.landed.get(slot, ()):
+                h.update(slot.to_bytes(8, "little"))
+                h.update(sig)
+        return h.hexdigest()
+
+    def close(self) -> None:
+        if self.client is not None:
+            self.client.close()
+        for v in self.validators:
+            try:
+                v.close()
+            except OSError:
+                pass

@@ -233,6 +233,86 @@ def check_shm_reclaimed(suite: InvariantSuite, shm_names, *,
                 f"leaked /dev/shm segments: {leaked}")
 
 
+# -- cluster-wide checks (chaos/cluster.ClusterHarness) -----------------------
+
+
+def check_cluster_convergence(suite: InvariantSuite, validators, *,
+                              prefix: str = "") -> int | None:
+    """All live nodes sit on ONE heaviest fork (identical ghost heads)
+    and agree on the bank hash at the convergence slot AND at every slot
+    both chains carry — the cluster's safety core.  Returns the
+    convergence slot (or None when heads diverged)."""
+    p = prefix
+    live = [v for v in validators if v.alive and not v.frozen]
+    heads = {v.ghost.head() for v in live}
+    if not suite.check(f"{p}heads-converged", len(heads) == 1,
+                       f"heads: {sorted(heads)}"):
+        return None
+    head = heads.pop()
+    hashes = {v.blocks[head].bank_hash for v in live if head in v.blocks}
+    suite.check(f"{p}all-replayed-head", all(head in v.blocks for v in live),
+                f"nodes missing head {head}: "
+                f"{[v.index for v in live if head not in v.blocks]}")
+    suite.check(f"{p}bank-hash-agree-at-head", len(hashes) == 1,
+                f"hashes at {head}: {sorted(h.hex()[:16] for h in hashes)}")
+    # every common chain slot agrees too (not just the tip)
+    chains = [v.best_chain() for v in live]
+    common = set(chains[0]).intersection(*map(set, chains[1:])) if len(
+        chains) > 1 else set(chains[0])
+    bad = []
+    for s in sorted(common):
+        hs = {v.blocks[s].bank_hash for v in live if s in v.blocks}
+        if len(hs) > 1:
+            bad.append(s)
+    suite.check(f"{p}bank-hash-agree-on-common-chain", not bad,
+                f"diverging slots: {bad}")
+    return head
+
+
+def check_cluster_exactly_once(suite: InvariantSuite, observer,
+                               honest_sigs, *, prefix: str = "",
+                               expect_all_landed: bool = True) -> None:
+    """Every honest txn lands exactly ONCE on the converged chain (the
+    across-handoffs contract: resubmissions after kills/forks must be
+    absorbed by the status-cache gate, never double-land), and nothing
+    outside the honest set lands."""
+    p = prefix
+    landed: dict[bytes, int] = {}
+    for slot in observer.best_chain():
+        for sig in observer.landed.get(slot, ()):
+            landed[sig] = landed.get(sig, 0) + 1
+    honest = set(honest_sigs)
+    dup = [s.hex()[:12] for s, n in landed.items() if n > 1]
+    unknown = [s.hex()[:12] for s in landed if s not in honest]
+    suite.check(f"{p}no-txn-landed-twice", not dup, f"dups: {dup[:4]}")
+    suite.check(f"{p}no-unknown-txns-landed", not unknown,
+                f"unknown: {unknown[:4]}")
+    if expect_all_landed:
+        missing = [s.hex()[:12] for s in honest if s not in landed]
+        suite.check(f"{p}every-honest-txn-landed", not missing,
+                    f"{len(missing)} missing: {missing[:4]}")
+
+
+def check_turbine_paths(suite: InvariantSuite, audit: dict, *,
+                        prefix: str = "",
+                        expect_repair: bool = False) -> None:
+    """The receipt-ledger audit (chaos/cluster.turbine_audit): no shred
+    ever arrived over a path the tree forbids, and every (node, slot,
+    FEC set) on the chain was covered via the node's turbine parent or
+    repair."""
+    p = prefix
+    suite.check(f"{p}no-forbidden-turbine-path", not audit["forbidden"],
+                f"violations: {audit['forbidden'][:4]}")
+    suite.check(f"{p}fec-sets-covered", not audit["missing"],
+                f"uncovered: {audit['missing'][:6]}")
+    suite.check(f"{p}turbine-carried-traffic",
+                audit["turbine_receipts"] > 0)
+    if expect_repair:
+        suite.check(f"{p}repair-path-exercised",
+                    audit["repair_receipts"] > 0,
+                    "no shred ever arrived via repair")
+
+
 # -- choreo checks ------------------------------------------------------------
 
 

@@ -32,6 +32,17 @@ Catalog (docs/OPERATIONS.md has the runbook):
   stage-kill        SIGKILL a pipeline stage mid-run under the process
                     supervisor: fail-fast, flight dump written, every
                     shm segment reclaimed, clean restart
+  partition-heal    CLUSTER: 4 full validators over the real wire, the
+                    cluster split across a leader rotation so both
+                    halves fork, then healed: one heaviest fork, bank
+                    hashes agree, losers pruned, weights conserve
+  laggard-catchup   CLUSTER: a wedged validator cold-boots from a
+                    peer's snapshot and repairs forward (Orphan +
+                    WindowIndex) under load to the cluster's bank hash
+  leader-rotation   CLUSTER: consecutive slots across distinct leaders
+                    per the wsample epoch schedule, one leader killed
+                    mid-broadcast: missed slot observed (not fatal),
+                    resubmitted txns land exactly once
 
 Stage classes and builders are module-level: the stage-kill scenario
 spawns real child processes (fdlint FD205/FD110 discipline).
@@ -150,7 +161,7 @@ def run_connection_storm(seed: int = 0, duration: float = 20.0, *,
     identity = hashlib.sha256(b"chaos-storm-%d" % seed).digest()
     n_garbage = max(n_clients // 8, 3)
     n_storm = max(n_clients - n_honest - n_garbage, 0)
-    uid = f"chaos{os.getpid()}_{seed}"
+    uid = shm.fresh_uid(f"chaos{seed}")
     link = shm.ShmLink.create(f"fdtpu_cs_{uid}", depth=4096, mtu=2048)
     stage = QuicIngressStage(
         "quic", outs=[shm.make_producer(link)], sock=ChaosSock(), rx_burst=8,
@@ -252,7 +263,7 @@ def _amplification_probe(suite: inv.InvariantSuite, seed: int,
     from firedancer_tpu.chaos.population import ChaosSock, Population
     from firedancer_tpu.runtime.net import QuicIngressStage
 
-    uid = f"chaosamp{os.getpid()}_{seed}"
+    uid = shm.fresh_uid(f"chaosamp{seed}")
     link = shm.ShmLink.create(f"fdtpu_ca_{uid}", depth=256, mtu=2048)
     stage = QuicIngressStage(
         "quic-amp", outs=[shm.make_producer(link)], sock=ChaosSock(), rx_burst=8,
@@ -353,7 +364,7 @@ def run_dedup_flood(seed: int = 0, duration: float = 10.0, *,
     schedule = uniq * copies
     rng.shuffle(schedule)
 
-    uid = f"chaosdd{os.getpid()}_{seed}"
+    uid = shm.fresh_uid(f"chaosdd{seed}")
     l_in = shm.ShmLink.create(f"fdtpu_dfi_{uid}", depth=1024, mtu=256)
     l_out = shm.ShmLink.create(f"fdtpu_dfo_{uid}", depth=1024, mtu=256)
     feeder = FloodFeeder(schedule, "flood", outs=[shm.make_producer(l_in)])
@@ -837,6 +848,294 @@ def run_stage_kill(seed: int = 0, duration: float = 30.0, *,
 
 
 # =============================================================================
+# cluster scenarios (chaos/cluster.ClusterHarness: N full validators
+# over the real loopback wire — gossip discovery, wsample leader
+# rotation, turbine fan-out, repair, choreo voting)
+# =============================================================================
+
+
+def _capture_cluster_failure(result: ScenarioResult, harness) -> None:
+    """Clusters are cooperative validator loops, not Stage pipelines:
+    the failure artifact is a full cluster state dump (per-node fork
+    view, receipt counts, repair/vote metrics) next to the summary."""
+    dump = {
+        "scenario": result.scenario,
+        "seed": result.seed,
+        "violations": [c.name for c in result.suite.violations()],
+        "validators": [
+            {
+                "index": v.index,
+                "alive": v.alive,
+                "frozen": v.frozen,
+                "head": v.ghost.head(),
+                "root": v.forks.root_slot,
+                "blocks": sorted(v.blocks),
+                "chain": v.best_chain(),
+                "missed": v.missed_slots,
+                "dead_slots": sorted(v.dead_slots),
+                "receipts": len(v.receipts),
+                "repaired": v.repaired_shreds,
+                "repair_kinds": dict(v.repair_kinds),
+                "rejected_sets": v.rejected_sets,
+                "vote_conflicts": v.vote_conflicts,
+                "cold_boots": v.cold_boots,
+                "gossip": dict(v.gossip.metrics),
+            }
+            for v in harness.validators
+        ],
+        "wire": {
+            "cut_dropped": harness.net.cut_dropped,
+            "lossy_dropped": harness.net.lossy_dropped,
+            "dead": sorted(pk.hex()[:16] for pk in harness.net.dead),
+        },
+        "fired": list(harness.fired),
+    }
+    path = _artifact_base(result.scenario, result.seed) + "_cluster.json"
+    with open(path, "w") as f:
+        json.dump(dump, f, indent=1)
+    result.artifacts.append(path)
+
+
+def _cluster_common_checks(suite, h, *, expect_repair=False,
+                           expect_all_landed=True):
+    """The invariant block every cluster scenario ends with."""
+    head = inv.check_cluster_convergence(suite, h.validators)
+    inv.check_cluster_exactly_once(
+        suite, h.observer, h.client.sigs,
+        expect_all_landed=expect_all_landed)
+    audit = h.turbine_audit(h.observer.best_chain())
+    inv.check_turbine_paths(suite, audit, expect_repair=expect_repair)
+    for v in h.validators:
+        if v.alive and not v.frozen:
+            inv.check_ghost_weight_conservation(
+                suite, v.ghost, prefix=f"v{v.index}-")
+    suite.check("no-forged-sets-accepted",
+                all(v.rejected_sets == 0 for v in h.validators))
+    suite.check("no-vote-conflicts",
+                all(v.vote_conflicts == 0 for v in h.validators))
+    return head, audit
+
+
+def run_cluster_partition_heal(seed: int = 0, duration: float = 60.0, *,
+                               n_slots: int = 14,
+                               settle_steps: int = 140) -> ScenarioResult:
+    """Split a 4-validator cluster across a leader-rotation boundary so
+    BOTH sides keep producing — real forks grow on each half — then heal:
+    the halves repair each other's slots, ghost converges on ONE heaviest
+    fork with agreeing bank hashes, the losing fork's blocks are pruned
+    by the root advance, weights conserve, and every honest txn (the
+    losers' resubmitted) lands exactly once.
+
+    (duration is accepted for the uniform scenario signature; the run
+    is bounded by slots/steps, not the wall clock.)"""
+    from firedancer_tpu.chaos.cluster import ClusterHarness, PartitionCluster
+
+    suite = inv.InvariantSuite()
+    info: dict = {}
+    h = ClusterHarness(4, seed=seed, steps_per_slot=24, n_txns=28,
+                       root_lag=5)
+    try:
+        boot_rounds = h.boot()
+        h.make_client(per_slot=2)
+        suite.check("gossip-discovery-complete",
+                    all(len(v.gossip.table) == 3 for v in h.validators))
+        part = PartitionCluster(at_slot=3, heal_slot=8,
+                                group_of=(0, 0, 1, 1))
+        h.run_slots(1, n_slots, faults=[part], gossip_horizon_ms=4000)
+        h.settle(settle_steps)
+        head, audit = _cluster_common_checks(suite, h, expect_repair=True)
+        suite.check("partition-cut-traffic", h.net.cut_dropped > 0)
+        suite.check("gossip-liveness-expired-partitioned-peers",
+                    any(v.gossip.metrics["peer_expired"] > 0
+                        for v in h.validators))
+        # the fork was REAL: someone froze blocks that lost and were
+        # pruned off the ghost tree by the post-heal root advance
+        off_chain = {
+            v.index: sorted(set(v.blocks)
+                            - set(v.best_chain()) - set(v.ghost.nodes))
+            for v in h.validators
+        }
+        losers = {i: s for i, s in off_chain.items() if s}
+        suite.check("fork-grew-and-was-pruned", bool(losers),
+                    "no validator holds pruned off-chain blocks — the "
+                    "partition never forked")
+        suite.check("roots-converged",
+                    len({v.forks.root_slot for v in h.validators
+                         if v.alive}) == 1)
+        info = {
+            "boot_rounds": boot_rounds,
+            "head": head,
+            "head_bank_hash": (
+                h.observer.blocks[head].bank_hash.hex()
+                if head in h.observer.blocks else None),
+            "chain": h.observer.best_chain(),
+            "pruned_fork_blocks": {str(k): v for k, v in losers.items()},
+            "landed_digest": h.landed_digest(),
+            "resubmitted": h.client.resubmitted > 0,
+            "repair_used": sum(v.repaired_shreds for v in h.validators) > 0,
+            "faults": [part.describe()],
+        }
+    finally:
+        result = ScenarioResult("partition-heal", seed, suite, info)
+        if not suite.ok:
+            _capture_cluster_failure(result, h)
+        h.close()
+    return result
+
+
+def run_cluster_laggard_catchup(seed: int = 0, duration: float = 60.0, *,
+                                freeze_slots: tuple = (2, 8),
+                                n_slots: int = 14,
+                                settle_steps: int = 140) -> ScenarioResult:
+    """One validator wedges (its NIC drains to nowhere) while the
+    cluster keeps producing UNDER LOAD; at thaw it cold-boots from a
+    peer's snapshot archive (flamenco/snapshot: funk root + bank hash at
+    the peer's published root) and walks the rest of the gap with repair
+    (Orphan + HighestWindowIndex + WindowIndex, retry/backoff/rotation),
+    replaying to the cluster's exact bank hash.
+
+    (duration is accepted for the uniform scenario signature; the run
+    is bounded by slots/steps, not the wall clock.)"""
+    import tempfile
+
+    from firedancer_tpu.chaos.cluster import ClusterHarness, FreezeValidator
+
+    suite = inv.InvariantSuite()
+    info: dict = {}
+    # 6 txns/slot -> multi-shred blocks (entry batch larger than one
+    # shred's payload), so catch-up exercises WindowIndex hole-fill,
+    # not just the orphan walk
+    h = ClusterHarness(4, seed=seed, steps_per_slot=24, n_txns=84,
+                       root_lag=3)
+    lag = h.validators[2]
+    at, thaw = freeze_slots
+    try:
+        boot_rounds = h.boot()
+        h.make_client(per_slot=6)
+        h.run_slots(1, thaw - 1,
+                    faults=[FreezeValidator(index=2, at_slot=at,
+                                            thaw_slot=thaw)])
+        # thaw fires at `thaw`'s first step; cold-boot right before it
+        peer = h.observer
+        suite.check("peer-root-advanced-under-load",
+                    peer.forks.root_slot > h.genesis.root_slot,
+                    f"peer root {peer.forks.root_slot}")
+        with tempfile.TemporaryDirectory() as td:
+            snap_slot = h.snapshot_handoff(
+                peer, lag, os.path.join(td, "snap.tar.zst"))
+        lag.frozen = False
+        h.run_slots(thaw, n_slots - thaw + 1)
+        h.settle(settle_steps)
+        head, audit = _cluster_common_checks(suite, h, expect_repair=True)
+        suite.check("laggard-cold-booted", lag.cold_boots == 1)
+        suite.check("laggard-used-repair", lag.repaired_shreds > 0,
+                    f"kinds: {lag.repair_kinds}")
+        suite.check("laggard-orphan-walked",
+                    lag.repair_kinds.get("orphan", 0) > 0,
+                    f"kinds: {lag.repair_kinds}")
+        suite.check("laggard-window-filled",
+                    lag.repair_kinds.get("window_index", 0) > 0
+                    or lag.repair_kinds.get("highest_window_index", 0) > 0,
+                    f"kinds: {lag.repair_kinds}")
+        suite.check("laggard-on-cluster-head",
+                    head is not None and head in lag.blocks
+                    and lag.blocks[head].bank_hash
+                    == h.observer.blocks[head].bank_hash)
+        info = {
+            "boot_rounds": boot_rounds,
+            "head": head,
+            "head_bank_hash": (
+                h.observer.blocks[head].bank_hash.hex()
+                if head is not None and head in h.observer.blocks
+                else None),
+            "snapshot_slot": snap_slot,
+            "laggard_chain": lag.best_chain(),
+            "laggard_repair_kinds": dict(sorted(
+                lag.repair_kinds.items())),
+            "landed_digest": h.landed_digest(),
+            "faults": [f"freeze:v2@[{at},{thaw})",
+                       f"snapshot-cold-boot@{snap_slot}"],
+        }
+    finally:
+        result = ScenarioResult("laggard-catchup", seed, suite, info)
+        if not suite.ok:
+            _capture_cluster_failure(result, h)
+        h.close()
+    return result
+
+
+def run_cluster_leader_rotation(seed: int = 0, duration: float = 60.0, *,
+                                n_slots: int = 16, kill_slot: int = 5,
+                                settle_steps: int = 160) -> ScenarioResult:
+    """Consecutive slots across DISTINCT leaders per the wsample epoch
+    schedule (epoch 2 rotates four leaders in 16 slots), with the
+    second rotation's leader killed mid-slot — its shred broadcast cut
+    off below the FEC data count, so the slot is unrecoverable: every
+    live node must observe a MISSED slot (bounded repair, then give
+    up), keep rotating, and land the dead slot's resubmitted txns
+    exactly once on the surviving chain.
+
+    (duration is accepted for the uniform scenario signature; the run
+    is bounded by slots/steps, not the wall clock.)"""
+    from firedancer_tpu.chaos.cluster import ClusterHarness, KillValidator
+
+    suite = inv.InvariantSuite()
+    info: dict = {}
+    h = ClusterHarness(4, seed=seed, steps_per_slot=24, n_txns=48,
+                       root_lag=3, epoch=2)
+    try:
+        boot_rounds = h.boot()
+        h.make_client(per_slot=4)
+        victim = h.validators.index(h.leader_of(kill_slot))
+        # slow the victim's broadcast so the kill lands mid-slot: one
+        # datagram out, the rest of the FEC set dies with the process
+        h.validators[victim].outbox_rate = 1
+        h.run_slots(1, n_slots,
+                    faults=[KillValidator(index=victim, at_slot=kill_slot,
+                                          at_step=1)])
+        h.settle(settle_steps)
+        head, audit = _cluster_common_checks(suite, h)
+        live = [v for v in h.validators if v.alive]
+        chain = h.observer.best_chain()
+        leaders_on_chain = {h.lsched.leader_for_slot(s) for s in chain}
+        suite.check("several-distinct-leaders",
+                    len(leaders_on_chain) >= 3,
+                    f"{len(leaders_on_chain)} distinct leaders")
+        suite.check("missed-slot-observed-not-fatal",
+                    all(kill_slot in v.missed_slots for v in live),
+                    f"missed per node: "
+                    f"{[v.missed_slots for v in live]}")
+        suite.check("chain-extends-past-missed-slot",
+                    head is not None and head > kill_slot)
+        suite.check("killed-leader-slots-skipped",
+                    kill_slot not in chain)
+        suite.check("dead-slot-txns-relanded",
+                    h.client.resubmitted > 0,
+                    "nothing was resubmitted — the kill cost no txns?")
+        info = {
+            "boot_rounds": boot_rounds,
+            "victim": victim,
+            "kill_slot": kill_slot,
+            "head": head,
+            "head_bank_hash": (
+                h.observer.blocks[head].bank_hash.hex()
+                if head is not None and head in h.observer.blocks
+                else None),
+            "chain": chain,
+            "missed": sorted({s for v in live for s in v.missed_slots}),
+            "distinct_leaders_on_chain": len(leaders_on_chain),
+            "landed_digest": h.landed_digest(),
+            "faults": [f"kill:v{victim}@{kill_slot}.1"],
+        }
+    finally:
+        result = ScenarioResult("leader-rotation", seed, suite, info)
+        if not suite.ok:
+            _capture_cluster_failure(result, h)
+        h.close()
+    return result
+
+
+# =============================================================================
 # registry + runner
 # =============================================================================
 
@@ -846,6 +1145,9 @@ SCENARIOS = {
     "fork-storm": run_fork_storm,
     "leader-handoff": run_leader_handoff,
     "stage-kill": run_stage_kill,
+    "partition-heal": run_cluster_partition_heal,
+    "laggard-catchup": run_cluster_laggard_catchup,
+    "leader-rotation": run_cluster_leader_rotation,
 }
 
 

@@ -43,6 +43,10 @@ class Forks:
     def __contains__(self, slot: int) -> bool:
         return slot in self._forks
 
+    def slots(self) -> list[int]:
+        """Every tracked fork slot (root included), ascending."""
+        return sorted(self._forks)
+
     def get(self, slot: int) -> Fork:
         f = self._forks.get(slot)
         if f is None:

@@ -391,6 +391,14 @@ class SlotExecution:
         # with a widened ancestor set: cache insertions from a speculative
         # competing block at this same slot must never gate this block
         self._block_seen: set[tuple[bytes, bytes]] = set()
+        # unrooted ancestor blocks gate too: their entries are still
+        # STAGED in the status cache (publish hasn't folded them), but a
+        # txn one of them carries must answer ALREADY_PROCESSED here —
+        # the exactly-once contract across leader handoffs on one fork
+        self._ancestor_xids: tuple[bytes, ...] = (
+            tuple(funk.txn_ancestry(parent_xid))
+            if parent_xid is not None else ()
+        )
         # native executor fast lane (flamenco/exec_native.py), built
         # lazily on the first execute_batch; False = unavailable/disabled
         self._native_ctx = None
@@ -468,6 +476,8 @@ class SlotExecution:
                 durable = True
             if (bh, sig) in self._block_seen or self.status_cache.contains(
                 bh, sig, self.ancestors
+            ) or self.status_cache.contains_staged(
+                bh, sig, self._ancestor_xids
             ):
                 r = TxnResult(TXN_ERR_ALREADY_PROCESSED, 0)
                 self.results.append(r)
@@ -580,6 +590,13 @@ class SlotExecution:
                     or any(s in self.ancestors for s in slots)
                 ):
                     self._gate_seen_delta.append(bh + sig)
+            # unrooted ancestor blocks' staged landings gate natively too
+            # (the Python gate's contains_staged, shipped once)
+            staged = getattr(sc, "_staged_seen", {})
+            for x in self._ancestor_xids:
+                for bh, sig in staged.get(x, ()):
+                    if bh in vs:
+                        self._gate_seen_delta.append(bh + sig)
             for bh, sig in self._block_seen:
                 self._gate_seen_delta.append(bh + sig)
         if valid is not None:
@@ -678,6 +695,8 @@ class SlotExecution:
                 or (bh, sig) in pend_keys
                 or (bh, sig) in self._block_seen
                 or self.status_cache.contains(bh, sig, self.ancestors)
+                or self.status_cache.contains_staged(bh, sig,
+                                                     self._ancestor_xids)
             ):
                 # legacy (session-less) path: stale blockhash
                 # (durable-nonce candidate) or duplicate — the Python
@@ -834,6 +853,14 @@ class SlotExecution:
                 vals.append(lt.lthash_of(a + after))
                 signs.append(1)
         if vals:
+            # pad the row count to a power of two (zero rows, sign 0 —
+            # the lattice sum is unchanged): a cluster of banks sealing
+            # blocks of varying account counts would otherwise compile
+            # one XLA reduction per distinct N
+            cap = 1 << (len(vals) - 1).bit_length()
+            if cap != len(vals):
+                vals.extend([lt.lthash_zero()] * (cap - len(vals)))
+                signs.extend([0] * (cap - len(signs)))
             delta = np.asarray(
                 lt.combine_device(np.stack(vals), np.asarray(signs))
             )

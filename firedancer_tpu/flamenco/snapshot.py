@@ -33,19 +33,74 @@ overlaying base then incremental — the reference's two-archive scheme.
 
 from __future__ import annotations
 
+import gzip
 import hashlib
 import io
 import struct
 import tarfile
 from dataclasses import dataclass
 
-import zstandard
+try:
+    import zstandard
+except ImportError:  # pragma: no cover - environment-dependent
+    # zstd is the protocol's container compression, but hosts without the
+    # binding still need working snapshots (the cluster harness's cold
+    # boot): fall back to stdlib gzip on write and SNIFF the magic on
+    # read, so archives stay interchangeable where both codecs exist.
+    zstandard = None
 
 from firedancer_tpu.flamenco import types as T
 from firedancer_tpu.flamenco.executor import acct_decode, acct_encode
 from firedancer_tpu.funk import Funk
 
 SNAPSHOT_VERSION = b"1.2.0"
+_ZSTD_MAGIC = b"\x28\xb5\x2f\xfd"
+_GZIP_MAGIC = b"\x1f\x8b"
+
+
+def _compress(raw: bytes, level: int) -> bytes:
+    if zstandard is not None:
+        return zstandard.ZstdCompressor(level=level).compress(raw)
+    # mtime=0: gzip.compress() would stamp wall-clock time into the
+    # header, making same-seed archives byte-different (the determinism
+    # contract the zstd path gives for free)
+    buf = io.BytesIO()
+    with gzip.GzipFile(fileobj=buf, mode="wb",
+                       compresslevel=min(max(level, 1), 9), mtime=0) as gz:
+        gz.write(raw)
+    return buf.getvalue()
+
+
+def _decompress(raw: bytes) -> bytes:
+    if raw[:4] == _ZSTD_MAGIC:
+        if zstandard is None:
+            raise SnapshotError(
+                "zstd-compressed snapshot but the zstandard module is "
+                "unavailable on this host"
+            )
+        return zstandard.ZstdDecompressor().decompress(
+            raw, max_output_size=1 << 31
+        )
+    if raw[:2] == _GZIP_MAGIC:
+        return gzip.decompress(raw)
+    raise SnapshotError("unrecognized snapshot compression magic")
+
+
+def _stream_reader(f):
+    """Streaming decompressor over an open binary file, codec-sniffed
+    (the agave loader path; cluster snapshots are tens of GiB)."""
+    head = f.read(4)
+    f.seek(0)
+    if head[:4] == _ZSTD_MAGIC:
+        if zstandard is None:
+            raise SnapshotError(
+                "zstd-compressed snapshot but the zstandard module is "
+                "unavailable on this host"
+            )
+        return zstandard.ZstdDecompressor().stream_reader(f)
+    if head[:2] == _GZIP_MAGIC:
+        return gzip.GzipFile(fileobj=f, mode="rb")
+    raise SnapshotError("unrecognized snapshot compression magic")
 
 
 class SnapshotError(RuntimeError):
@@ -166,7 +221,7 @@ def snapshot_write(
         add("version", SNAPSHOT_VERSION)
         add(f"snapshots/{slot}/{slot}", MANIFEST.encode(man))
         add(f"accounts/{slot}.0", bytes(blob))
-    comp = zstandard.ZstdCompressor(level=level).compress(tar_buf.getvalue())
+    comp = _compress(tar_buf.getvalue(), level)
     with open(path, "wb") as f:
         f.write(comp)
     return len(accounts)
@@ -175,9 +230,7 @@ def snapshot_write(
 def snapshot_read(path: str) -> tuple[Manifest, dict[bytes, bytes]]:
     """-> (manifest, pubkey -> account value bytes)."""
     with open(path, "rb") as f:
-        raw = zstandard.ZstdDecompressor().decompress(
-            f.read(), max_output_size=1 << 31
-        )
+        raw = _decompress(f.read())
     accounts: dict[bytes, bytes] = {}
     manifest = None
     version = None
@@ -259,7 +312,7 @@ def agave_snapshot_write(
         add(f"snapshots/{slot}/{slot}", manifest_encode(manifest))
         for (vslot, vid), blob in sorted(vecs.items()):
             add(f"accounts/{vslot}.{vid}", blob)
-    comp = zstandard.ZstdCompressor(level=level).compress(tar_buf.getvalue())
+    comp = _compress(tar_buf.getvalue(), level)
     with open(path, "wb") as f:
         f.write(comp)
 
@@ -302,9 +355,8 @@ def agave_snapshot_load(
     manifest = None
     spill = tempfile.mkdtemp(prefix="fdtpu_snapload_")
     try:
-        with open(path, "rb") as f, zstandard.ZstdDecompressor().stream_reader(
-            f
-        ) as zr, tarfile.open(fileobj=zr, mode="r|") as tar:
+        with open(path, "rb") as f, _stream_reader(f) as zr, \
+                tarfile.open(fileobj=zr, mode="r|") as tar:
             for member in tar:
                 payload = tar.extractfile(member)
                 if payload is None:

@@ -26,8 +26,6 @@ Link map (names follow the reference's link table, fd_frankendancer.c:55-83):
 from __future__ import annotations
 
 import hashlib
-import os
-import time
 from dataclasses import dataclass
 
 from firedancer_tpu.ops.ref import ed25519_ref as ref
@@ -180,7 +178,7 @@ def build_leader_pipeline(
     (bench uses this; tests that read pipe.shred.sets keep the
     default)."""
     use_native_pack = resolve_native_pack(native_pack)
-    uid = f"{os.getpid()}_{int(time.monotonic_ns() % 1_000_000)}"
+    uid = shm.fresh_uid()
     links = []
 
     def mklink(name, mtu, n_consumers=1, d=None):
@@ -366,7 +364,7 @@ def build_sharded_leader_pipeline(
             f"plane has {cfg.n_devices} shards, pipeline asked for {n_shards}"
         )
 
-    uid = f"{os.getpid()}_{int(time.monotonic_ns() % 1_000_000)}"
+    uid = shm.fresh_uid()
     links = []
 
     def mklink(name, mtu, n_consumers=1, d=None):

@@ -115,31 +115,59 @@ class ShredDest:
 
     # -- public API ---------------------------------------------------------
 
+    def first_for(self, slot: int, idx: int, is_data: bool) -> int:
+        """Leader side, field-keyed: the Turbine root for one shred (dest
+        index or NO_DEST).  The cluster harness's receipt-ledger audit
+        recomputes trees from recorded (slot, idx, type) triples, so the
+        tree query must not require the original wire bytes."""
+        leader = self.lsched.leader_for_slot(slot)
+        if leader is None:
+            return NO_DEST
+        rng = self._rng(shred_seed(slot, idx, is_data, leader))
+        weights = [
+            self.dests[i].stake
+            for i in range(self.staked_cnt)
+            if i != self.source_idx
+        ]
+        idx_map = [i for i in range(self.staked_cnt) if i != self.source_idx]
+        if weights:
+            ws = WSample(rng, weights, excluded_weight=self.excluded_stake)
+            got = ws.sample()
+            return NO_DEST if got == INDETERMINATE else idx_map[got]
+        cands = self._sample_unstaked(rng, exclude=self.source_idx)
+        return cands[0] if cands else NO_DEST
+
+    def children_for(
+        self, slot: int, idx: int, is_data: bool, *, fanout: int
+    ) -> list[int]:
+        """Non-leader side, field-keyed: this validator's retransmit
+        targets for one shred."""
+        leader = self.lsched.leader_for_slot(slot)
+        if leader is None or leader == self.dests[self.source_idx].pubkey:
+            return []  # the leader uses first_for/compute_first
+        order = self._shuffle(shred_seed(slot, idx, is_data, leader))
+        # the leader doesn't participate in its own tree
+        leader_idx = self._idx_of.get(leader)
+        order = [i for i in order if i != leader_idx]
+        try:
+            my = order.index(self.source_idx)
+        except ValueError:
+            return []  # we fell past a poisoned (truncated) order
+        if my == 0:
+            positions = range(1, fanout + 1)
+        elif my <= fanout:
+            positions = range(my + fanout, my + fanout * fanout + 1, fanout)
+        else:
+            positions = range(0)
+        return [order[p] for p in positions if p < len(order)]
+
     def compute_first(self, shreds: list[bytes]) -> list[int]:
         """Leader side: the Turbine root for each shred (dest index or
         NO_DEST)."""
         out = []
         for buf in shreds:
             s = fs.parse(buf)
-            leader = self.lsched.leader_for_slot(s.slot)
-            if leader is None:
-                out.append(NO_DEST)
-                continue
-            rng = self._rng(shred_seed(s.slot, s.idx, s.is_data, leader))
-            src_staked = self.source_idx < self.staked_cnt
-            weights = [
-                self.dests[i].stake
-                for i in range(self.staked_cnt)
-                if i != self.source_idx
-            ]
-            idx_map = [i for i in range(self.staked_cnt) if i != self.source_idx]
-            if weights:
-                ws = WSample(rng, weights, excluded_weight=self.excluded_stake)
-                got = ws.sample()
-                out.append(NO_DEST if got == INDETERMINATE else idx_map[got])
-            else:
-                cands = self._sample_unstaked(rng, exclude=self.source_idx)
-                out.append(cands[0] if cands else NO_DEST)
+            out.append(self.first_for(s.slot, s.idx, s.is_data))
         return out
 
     def compute_children(
@@ -149,24 +177,6 @@ class ShredDest:
         out = []
         for buf in shreds:
             s = fs.parse(buf)
-            leader = self.lsched.leader_for_slot(s.slot)
-            if leader is None or leader == self.dests[self.source_idx].pubkey:
-                out.append([])  # the leader uses compute_first
-                continue
-            order = self._shuffle(shred_seed(s.slot, s.idx, s.is_data, leader))
-            # the leader doesn't participate in its own tree
-            leader_idx = self._idx_of.get(leader)
-            order = [i for i in order if i != leader_idx]
-            try:
-                my = order.index(self.source_idx)
-            except ValueError:
-                out.append([])  # we fell past a poisoned (truncated) order
-                continue
-            if my == 0:
-                positions = range(1, fanout + 1)
-            elif my <= fanout:
-                positions = range(my + fanout, my + fanout * fanout + 1, fanout)
-            else:
-                positions = range(0)
-            out.append([order[p] for p in positions if p < len(order)])
+            out.append(self.children_for(s.slot, s.idx, s.is_data,
+                                         fanout=fanout))
         return out

@@ -104,11 +104,17 @@ class GossipNode:
         # (pusher pubkey, addr) -> {origin: duplicate count} for pruning
         self._dup_pushes: dict[tuple, dict[bytes, int]] = {}
         self.prune_threshold = 3
+        # liveness state: ping attempts outstanding per peer pubkey, and
+        # the receive stamp (clock() domain) of each table record
+        self._ping_fails: dict[bytes, int] = {}
+        self._seen_at: dict[bytes, int] = {}
+        self.ping_fail_max = 3
         self.metrics = {"push_rx": 0, "pull_rx": 0, "rec_rejected": 0,
                         "rec_upserted": 0, "rec_stale": 0,
                         "ping_rx": 0, "pong_rx": 0, "prune_rx": 0,
                         "prune_tx": 0, "push_tx": 0, "push_dropped": 0,
-                        "pull_served": 0, "pull_skipped": 0}
+                        "pull_served": 0, "pull_skipped": 0,
+                        "peer_expired": 0, "peer_dead": 0}
 
     @property
     def addr(self):
@@ -237,6 +243,56 @@ class GossipNode:
             gw.encode_message("ping", gw.ping_make(self._secret, token)), peer
         )
 
+    # -- peer liveness ------------------------------------------------------
+
+    def drop_peer(self, pubkey: bytes) -> None:
+        """Remove every trace of a peer: table view, cached signed record
+        (it stops being served to pulls or forwarded by pushes), active
+        set, pong verification — the peer must re-enter through the
+        normal upsert path to come back."""
+        self.table.pop(pubkey, None)
+        self._signed.pop(pubkey, None)
+        self._hash.pop(pubkey, None)
+        self.active_set.pop(pubkey, None)
+        self.verified_peers.discard(pubkey)
+        self._seen_at.pop(pubkey, None)
+        self._ping_fails.pop(pubkey, None)
+
+    def housekeeping(self, *, horizon_ms: int | None = None,
+                     ping_peers: bool = False) -> list[bytes]:
+        """Peer liveness sweep (call at a lazy cadence):
+
+          - contact info not refreshed within `horizon_ms` of clock() is
+            EXPIRED — partitioned/killed nodes age out of the table so
+            `refresh_active_set` and the repair/turbine consumers stop
+            routing to corpses;
+          - with `ping_peers`, every current active-set peer is pinged;
+            a peer that accumulates `ping_fail_max` unanswered pings
+            (counted at send, cleared by a verified pong) is dropped.
+
+        Returns the pubkeys dropped this sweep."""
+        now = self.clock()
+        dropped = []
+        if horizon_ms is not None:
+            for pk, seen in list(self._seen_at.items()):
+                if now - seen > horizon_ms:
+                    self.drop_peer(pk)
+                    self.metrics["peer_expired"] += 1
+                    dropped.append(pk)
+        if ping_peers:
+            for pk, (addr, _pruned) in list(self.active_set.items()):
+                if pk not in self.table:
+                    continue
+                fails = self._ping_fails.get(pk, 0)
+                if fails >= self.ping_fail_max:
+                    self.drop_peer(pk)
+                    self.metrics["peer_dead"] += 1
+                    dropped.append(pk)
+                    continue
+                self._ping_fails[pk] = fails + 1
+                self.ping(addr)
+        return dropped
+
     # -- receive --
 
     def poll(self, burst: int = 32) -> None:
@@ -283,6 +339,7 @@ class GossipNode:
                 token = self._ping_tokens_by_addr.get(src)
                 if token is not None and gw.pong_verify(payload, token):
                     self.verified_peers.add(payload.from_)
+                    self._ping_fails.pop(payload.from_, None)
                     del self._ping_tokens_by_addr[src]
 
     def _serve_pull(self, src, filt: "gw.CrdsFilter | None" = None) -> None:
@@ -330,6 +387,7 @@ class GossipNode:
         self.table[info.pubkey] = info
         self._signed[info.pubkey] = value
         self._hash[info.pubkey] = gw.value_hash(gw.CRDS_VALUE.encode(value))
+        self._seen_at[info.pubkey] = self.clock()
         self._need_push.append(info.pubkey)
         self.metrics["rec_upserted"] += 1
         return True

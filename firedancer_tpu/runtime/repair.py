@@ -23,6 +23,7 @@ import time
 from firedancer_tpu.flamenco import repair_wire as rw
 from firedancer_tpu.ops.ref import ed25519_ref as ref
 from firedancer_tpu.protocol import shred as fs
+from firedancer_tpu.utils.rng import Rng
 
 
 class Blockstore:
@@ -112,26 +113,30 @@ class RepairServer:
 
 class RepairClient:
     def __init__(self, identity_secret: bytes, *, signer=None,
-                 pubkey: bytes | None = None):
+                 pubkey: bytes | None = None, rng: Rng | None = None):
         """`signer` (msg -> 64B sig) keeps the real key out-of-process
-        (the sign-stage pattern); pass the matching `pubkey` with it."""
+        (the sign-stage pattern); pass the matching `pubkey` with it.
+        `rng` seeds the retry backoff jitter (utils/rng — deterministic
+        per seed, never wall-clock entropy; FD209 discipline)."""
         self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         self.sock.setblocking(False)
         self._secret = identity_secret
         self._signer = signer
         self.pubkey = pubkey or ref.public_key(identity_secret)
         self._nonce = 0
-        self.metrics = {"req": 0, "ok": 0, "bad_response": 0}
+        self._rng = rng if rng is not None else Rng(0x52E7A12, 0)
+        self.last_peer = None  # (host, port) that answered the last ok
+        self.metrics = {"req": 0, "ok": 0, "bad_response": 0,
+                        "timeout": 0, "retry": 0, "peer_rotated": 0}
 
     def _request(self, peer, name: str, payload) -> bytes:
         return rw.sign_request(self._secret, name, payload,
                                signer=self._signer)
 
-    def request(
-        self, peer, slot: int, shred_idx: int, *, spin=None,
-        max_spins=200_000, recipient: bytes = bytes(32), kind="window_index",
-    ) -> bytes | None:
-        """One request/response round trip; None on timeout/bad reply."""
+    def _attempt(self, peer, slot: int, shred_idx: int, *, spin,
+                 budget_spins: int, recipient: bytes, kind: str
+                 ) -> bytes | None:
+        """One signed request + one bounded wait window on one peer."""
         self._nonce += 1
         nonce = self._nonce
         header = rw.RepairRequestHeader(
@@ -146,15 +151,18 @@ class RepairClient:
             payload = rw.Orphan(header, slot)
         self.sock.sendto(self._request(peer, kind, payload), peer)
         self.metrics["req"] += 1
-        for _ in range(max_spins):
+        for _ in range(budget_spins):
             if spin is not None:
                 spin()
             try:
-                data, _src = self.sock.recvfrom(2048)
+                data, src = self.sock.recvfrom(2048)
             except (BlockingIOError, InterruptedError):
                 continue
             res = rw.decode_response(data)
             if res is None or res[1] != nonce:
+                # includes straggler replies to a timed-out earlier
+                # attempt: the nonce check keeps them from satisfying
+                # the current request with the wrong shred
                 self.metrics["bad_response"] += 1
                 continue
             shred = res[0]
@@ -165,7 +173,50 @@ class RepairClient:
                 self.metrics["bad_response"] += 1
                 continue
             self.metrics["ok"] += 1
+            self.last_peer = src
             return shred
+        self.metrics["timeout"] += 1
+        return None
+
+    def request(
+        self, peer, slot: int, shred_idx: int, *, spin=None,
+        max_spins=200_000, recipient: bytes = bytes(32), kind="window_index",
+        retries: int = 0, backoff: float = 2.0,
+    ) -> bytes | None:
+        """Request/response round trip(s); None when every attempt timed
+        out or produced only bad replies.
+
+        `peer` is one (host, port) address or a LIST of entries, each an
+        address or an (address, recipient_pubkey) pair (signing servers
+        refuse misdirected requests, so the recipient must rotate with
+        the peer).  The wait budget is `max_spins` for the first attempt
+        and grows by `backoff`x per retry (+- up to 25% seeded jitter,
+        so a fleet of catching-up validators does not re-ask a
+        struggling server in lockstep); each retry ROTATES to the next
+        peer in the list, so one dead repair peer costs one timeout
+        window, not the whole catch-up.  Spin counts (not wall time) are
+        the clock: the caller pumps the serving side via `spin`, which
+        keeps runs seeded-deterministic."""
+        peers = peer if isinstance(peer, list) else [peer]
+        budget = max_spins
+        for attempt in range(retries + 1):
+            target = peers[attempt % len(peers)]
+            if isinstance(target[0], str):
+                t_addr, t_recipient = target, recipient
+            else:
+                t_addr, t_recipient = target
+            if attempt:
+                self.metrics["retry"] += 1
+                if len(peers) > 1:
+                    self.metrics["peer_rotated"] += 1
+            got = self._attempt(t_addr, slot, shred_idx, spin=spin,
+                                budget_spins=int(budget),
+                                recipient=t_recipient, kind=kind)
+            if got is not None:
+                return got
+            # exponential backoff with seeded jitter: 75%..125% of the
+            # scaled window
+            budget = budget * backoff * (0.75 + 0.5 * self._rng.float01())
         return None
 
     def close(self):

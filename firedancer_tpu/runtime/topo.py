@@ -470,13 +470,17 @@ class TopologyHandle:
         return "\n".join(lines)
 
 
-def launch(topo: Topology) -> TopologyHandle:
+def launch(topo: Topology, *, namespace: str | None = None) -> TopologyHandle:
+    """`namespace` prefixes every segment name this topology creates
+    (links, cnc, metrics): N simultaneous topologies in one box — e.g.
+    one per validator of a cluster — stay disjoint in /dev/shm, and a
+    supervisor FAIL/close reclaims only its own validator's segments."""
     # fail fast IN THE PARENT: a mis-wired graph raises a readable
     # TopologyError here, before any shm segment or child process exists
     # (the fd_topob contract — validation precedes boot)
     topo.validate()
     ctx = mp.get_context("spawn")  # fresh interpreters: see module docstring
-    uid = f"{os.getpid()}_{int(time.monotonic_ns() % 1_000_000)}"
+    uid = shm.fresh_uid(namespace)
     links: dict[str, shm.ShmLink] = {}
     link_names: dict[str, str] = {}
     for spec in topo.links:

@@ -26,6 +26,24 @@ from . import rings
 _HDR = 4 * 8  # depth, mtu, n_fseq, dcache_sz (0 = DCache.footprint)
 
 
+_uid_seq = iter(range(1 << 62))
+
+
+def fresh_uid(namespace: str | None = None) -> str:
+    """A /dev/shm-unique run id: `[namespace_]pid_counter`.
+
+    Every segment-name producer (topology launch, pipeline builders,
+    chaos scenarios) must derive names through this: pid alone collides
+    across sequential runs in one process, and the old
+    `monotonic_ns % 1e6` suffix wraps every millisecond — two topologies
+    booted back-to-back (a cluster of validators in one box) could land
+    on the same uid and silently share rings.  The process-wide counter
+    cannot repeat within a pid; `namespace` scopes a validator's (or
+    test's) segments so a supervisor FAIL reclaims only its own."""
+    tag = f"{os.getpid()}_{next(_uid_seq)}"
+    return f"{namespace}_{tag}" if namespace else tag
+
+
 def now_ns() -> int:
     """The frag-timestamp clock (tsorig/tspub, fd_tango_base.h:48-60)."""
     return time.monotonic_ns()

@@ -136,3 +136,29 @@ def test_unstaked_only_cluster():
     roots = sd.compute_first(_mk_shreds(0, [1, 2, 3]))
     for r in roots:
         assert r != NO_DEST and r != 0  # picked an unstaked non-self dest
+
+
+def test_field_keyed_queries_match_buf_apis():
+    """first_for/children_for (the receipt-ledger audit's entry points:
+    tree queries from recorded (slot, idx, type) triples, no wire bytes)
+    must agree exactly with the buf-parsing APIs."""
+    dests, lsched = _mk_cluster()
+    slot = 3
+    idxs = [0, 1, 5, 9]
+    shreds = _mk_shreds(slot, idxs)
+    leader = lsched.leader_for_slot(slot)
+    sd_leader = ShredDest(dests, lsched, source=leader)
+    assert sd_leader.compute_first(shreds) == [
+        sd_leader.first_for(slot, i, True) for i in idxs
+    ]
+    src = next(d.pubkey for d in dests if d.pubkey != leader)
+    sd = ShredDest(dests, lsched, source=src)
+    assert sd.compute_children(shreds, fanout=3) == [
+        sd.children_for(slot, i, True, fanout=3) for i in idxs
+    ]
+    # the data/code distinction feeds the seed: same idx, different tree
+    assert any(
+        sd.children_for(slot, i, True, fanout=3)
+        != sd.children_for(slot, i, False, fanout=3)
+        for i in idxs
+    )
