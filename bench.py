@@ -502,6 +502,7 @@ def run_host_pipeline_bench(pairs: int | None = None) -> dict:
     in the per-stage us/txn breakdown."""
     from firedancer_tpu.pack import scheduler_native as sn
     from firedancer_tpu.runtime import shred_native as shn
+    from firedancer_tpu.runtime import verify_native as vfn
     from firedancer_tpu.tango import shm as tango_shm
 
     pairs = _require_ab_pairs(
@@ -512,7 +513,8 @@ def run_host_pipeline_bench(pairs: int | None = None) -> dict:
     ring_avail = tango_shm._native_ring_available()
     pack_avail = sn.available()
     shred_avail = shn.available()
-    if not (ring_avail or pack_avail or shred_avail):
+    verify_avail = vfn.available()
+    if not (ring_avail or pack_avail or shred_avail or verify_avail):
         # toolchain-less host: no fallback lane to compare against, so
         # repeated identical windows buy nothing — one measurement
         pairs = 1
@@ -527,6 +529,9 @@ def run_host_pipeline_bench(pairs: int | None = None) -> dict:
     if shred_avail:
         windows.append(("shred", dict(native_pack=pack_avail,
                                       native_shred=False)))
+    if verify_avail:
+        windows.append(("verify", dict(native_pack=pack_avail,
+                                       native_verify=False)))
     if len(windows) > 1:
         # the process's first measure pays one-time costs (imports, comb
         # tables, numpy warmup) — discard one window so pair 0's first
@@ -562,6 +567,17 @@ def run_host_pipeline_bench(pairs: int | None = None) -> dict:
             ab["ring"]["ring_us_per_txn"]["off_median"]
         out["pipeline_host_ring_us_per_stage_native_ring_off"] = \
             roffs[-1]["pipeline_host_ring_us_per_stage"]
+    if "verify" in lanes:
+        voffs = lanes["verify"]
+        ab["verify"]["verify_stage_us_per_txn"] = ab_summary(
+            [{"v": o["pipeline_host_stage_us_per_txn"].get("verify0")}
+             for o in ons],
+            [{"v": o["pipeline_host_stage_us_per_txn"].get("verify0")}
+             for o in voffs],
+            "v",
+        )
+        out["pipeline_host_verify_us_per_txn_native_verify_off"] = \
+            ab["verify"]["verify_stage_us_per_txn"]["off_median"]
     if "shred" in lanes:
         soffs = lanes["shred"]
         ab["shred"]["shred_stage_us_per_txn"] = ab_summary(
@@ -667,9 +683,67 @@ def run_shred_ab(pairs: int = 3, out_path: str | None = None) -> dict:
     return out
 
 
+def run_verify_ab(pairs: int = 3, out_path: str | None = None) -> dict:
+    """The ISSUE 13 host acceptance artifact: interleaved same-box A/B
+    of the native verify sweep lane — per pair, one all-native window
+    and one window with ONLY the verify sweep client off (per-frag
+    python intake on the same rings), per-stage us/txn tables for both,
+    per-pair deltas and median-of-pairs.  Writes
+    BENCH_r11_verify_ab.json (or FDTPU_BENCH_VERIFY_AB_PATH)."""
+    from firedancer_tpu.pack import scheduler_native as sn_pack
+    from firedancer_tpu.runtime import verify_native as vfn
+
+    _require_ab_pairs(pairs, "verify sweep-lane A/B")
+    if not vfn.available():
+        print("# native verify client unavailable: no A/B to run",
+              file=sys.stderr)
+        return {"verify_ab_unavailable": True}
+    pack_avail = sn_pack.available()
+    ons, offs = [], []
+    _host_pipeline_warm_window()
+    for i in range(pairs):
+        print(f"# verify A/B pair {i + 1}/{pairs}", file=sys.stderr)
+        order = (True, False) if i % 2 == 0 else (False, True)
+        for on in order:
+            (ons if on else offs).append(_host_pipeline_measure(
+                native_pack=pack_avail, native_verify=on))
+
+    def _stage_key(rows, key):
+        return [{"v": o["pipeline_host_stage_us_per_txn"].get(key)}
+                for o in rows]
+
+    out = {
+        "pairs": pairs,
+        "txn_per_s": ab_summary(ons, offs, "pipeline_host_txn_per_s"),
+        "verify_us_per_txn": ab_summary(
+            _stage_key(ons, "verify0"), _stage_key(offs, "verify0"), "v"),
+        "pipeline_host_txn_per_s": round(_median(
+            [o["pipeline_host_txn_per_s"] for o in ons]), 1),
+        "stage_us_per_txn_on": [o["pipeline_host_stage_us_per_txn"]
+                                for o in ons],
+        "stage_us_per_txn_off": [o["pipeline_host_stage_us_per_txn"]
+                                 for o in offs],
+        "verify_mode_on": ons[-1].get("pipeline_host_native_verify"),
+        "verify_mode_off": offs[-1].get("pipeline_host_native_verify"),
+        "native_exec": ons[-1].get("pipeline_host_native_exec"),
+        "native_ring": ons[-1].get("pipeline_host_native_ring"),
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+    }
+    path = out_path or os.environ.get("FDTPU_BENCH_VERIFY_AB_PATH",
+                                      "BENCH_r11_verify_ab.json")
+    try:
+        with open(path, "w") as fh:
+            json.dump(out, fh, indent=1)
+        print(f"# verify A/B artifact -> {path}", file=sys.stderr)
+    except OSError as e:
+        print(f"# verify A/B artifact write failed: {e}", file=sys.stderr)
+    return out
+
+
 def _host_pipeline_measure(*, native_pack: bool,
                            native_ring: bool | None = None,
-                           native_shred: bool | None = None) -> dict:
+                           native_shred: bool | None = None,
+                           native_verify: bool | None = None) -> dict:
     from firedancer_tpu.models.leader import build_leader_pipeline
     from firedancer_tpu.runtime.bank import default_bank_ctx
     from firedancer_tpu.runtime.benchg import gen_transfer_pool
@@ -683,11 +757,14 @@ def _host_pipeline_measure(*, native_pack: bool,
     # (shm.make_*, ShredStage.__init__): the env switches only need to
     # hold while the pipeline builds
     env_prev = {k: os.environ.get(k)
-                for k in ("FDTPU_NATIVE_RING", "FDTPU_NATIVE_SHRED")}
+                for k in ("FDTPU_NATIVE_RING", "FDTPU_NATIVE_SHRED",
+                          "FDTPU_NATIVE_VERIFY")}
     if native_ring is not None:
         os.environ["FDTPU_NATIVE_RING"] = "1" if native_ring else "0"
     if native_shred is not None:
         os.environ["FDTPU_NATIVE_SHRED"] = "1" if native_shred else "0"
+    if native_verify is not None:
+        os.environ["FDTPU_NATIVE_VERIFY"] = "1" if native_verify else "0"
     try:
         pipe = build_leader_pipeline(
             n_verify=1,
@@ -711,11 +788,13 @@ def _host_pipeline_measure(*, native_pack: bool,
     ring_on = type(pipe.pack.ins[0]).__name__ == "NativeConsumer"
     shred_mode = ("sweep" if pipe.shred._sweep_client is not None
                   else ("batch" if pipe.shred.native_shred else "python"))
+    verify_mode = ("sweep" if pipe.verifies[0]._sweep_client is not None
+                   else "python")
     pipe.benchg.pool = gen_transfer_pool(n_txn, n_payers=n_payers,
                                          n_dests=1024)
     print(f"# host pipeline: pool of {n_txn} signed in {time.time()-t0:.1f}s"
           f" (native_pack={native_pack}, native_ring={ring_on},"
-          f" shred={shred_mode})",
+          f" shred={shred_mode}, verify={verify_mode})",
           file=sys.stderr)
 
     def executed_cnt() -> int:
@@ -871,6 +950,7 @@ def _host_pipeline_measure(*, native_pack: bool,
             "pipeline_host_native_ring": ring_on,
             "pipeline_host_native_exec": exec_native.available(),
             "pipeline_host_native_shred": shred_mode,
+            "pipeline_host_native_verify": verify_mode,
         }
         out.update(_scrape_stage_latencies(pipe))
         if executed < target:
@@ -895,6 +975,161 @@ def _verify_stage_loop_rate(n: int = 20_000, batch: int = 512) -> float:
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod.bench_stage_loop(n, batch)
+
+
+# -- the kernel ladder (ISSUE 13) ---------------------------------------------
+
+KERNEL_ARTIFACT = os.environ.get(
+    "FDTPU_KERNEL_LADDER_PATH",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "KERNEL_r01.json"),
+)
+
+
+def _kernel_ladder_stage_probe() -> dict:
+    """Fill-rate / occupancy / autotuner evidence from the verify STAGE
+    machinery (precomputed mask, no device): feed a real signed-txn
+    stream through intake + batching and read the stage's own schema
+    histograms — the same numbers the live metrics plane records."""
+    import numpy as _np
+
+    from firedancer_tpu.runtime import verify_tune as vt
+    from firedancer_tpu.runtime.benchg import gen_transfer_pool
+    from firedancer_tpu.runtime.verify import VerifyStage
+
+    st = VerifyStage("kprobe", ins=[], outs=[], batch=64, max_msg_len=256,
+                     batch_deadline_s=0.0005, precomputed_ok=True,
+                     native_client=False)
+    pool = gen_transfer_pool(512, n_payers=32, n_dests=64)
+    meta = _np.zeros(7, dtype=_np.uint64)
+    for i, p in enumerate(pool):
+        meta[5] = 1 + i
+        st.after_frag(0, meta, p)
+        st.before_credit()
+        st.after_credit()
+    st.flush()
+    m = st.metrics
+    batches = m.get("batches")
+    fill_rate = (m.get("batch_elems") / (batches * st.batch)
+                 if batches else 0.0)
+    rec = vt.recommend_for_stage(st)
+    return {
+        "batches": batches,
+        "batch": st.batch,
+        "fill_rate": round(fill_rate, 3),
+        "occupancy_p50": round(m.quantile("inflight_occupancy", 0.5), 2),
+        "occupancy_p99": round(m.quantile("inflight_occupancy", 0.99), 2),
+        "msg_len_p99": round(m.quantile("msg_len", 0.99), 1),
+        "autotune_recommendation": rec.as_dict(),
+    }
+
+
+def run_kernel_ladder(out_path: str | None = None) -> dict:
+    """bench.py --kernel-ladder: the verify-kernel capture that runs on
+    CPU today and on a real chip unchanged (KERNEL_r01.json).  Per
+    ladder lane (fused/split[/baseline]): compile_s, dispatches per
+    batch PROVEN by counting live compiled entries, and steady-state
+    elems/s at each async in-flight window; plus the stage-machinery
+    section (batch fill rate, window occupancy, the autotuner's
+    recommendation from the same histograms the metrics plane records).
+    Knobs: FDTPU_KERNEL_BATCH / _ROUNDS / _LANES / _WINDOWS."""
+    from firedancer_tpu.utils.platform import enable_compile_cache
+
+    import jax
+    import jax.numpy as jnp
+
+    enable_compile_cache()
+
+    from firedancer_tpu.ops import sigverify as sv
+    import __graft_entry__ as ge
+
+    dev = jax.devices()[0]
+    cpu = dev.platform == "cpu"
+    batch = int(os.environ.get("FDTPU_KERNEL_BATCH",
+                               "256" if cpu else str(BATCH)))
+    rounds = int(os.environ.get("FDTPU_KERNEL_ROUNDS",
+                                "4" if cpu else str(STEADY_ROUNDS)))
+    lanes = [k.strip() for k in os.environ.get(
+        "FDTPU_KERNEL_LANES", "fused,split").split(",") if k.strip()]
+    wins = tuple(int(x) for x in os.environ.get(
+        "FDTPU_KERNEL_WINDOWS", "3,8").split(","))
+    print(f"# kernel ladder: {dev.platform}:{dev.device_kind} batch={batch}"
+          f" rounds={rounds} lanes={lanes} windows={wins}", file=sys.stderr)
+    msg, msg_len, sig, pk = ge._example_batch(batch)
+    args = tuple(jax.device_put(jnp.asarray(a), dev)
+                 for a in (msg, msg_len, sig, pk))
+    art = {
+        "metric": "verify_kernel_ladder",
+        "backend": dev.platform,
+        "device_kind": dev.device_kind,
+        "batch": batch,
+        "max_msg_len": MAX_MSG_LEN,
+        "rounds": rounds,
+        "rungs": [],
+    }
+
+    for kernel in lanes:
+        sv.kernel_clear_caches(kernel)
+
+        def step():
+            mask, n_ok = sv.verify_dispatch(kernel, *args, batch,
+                                            max_msg_len=MAX_MSG_LEN)
+            return (n_ok if n_ok is not None
+                    else jnp.sum(mask.astype(jnp.int32)))
+
+        t0 = time.time()
+        n = int(np.asarray(step()))
+        compile_s = time.time() - t0
+        assert n == batch, f"{kernel}: honest signatures must all verify"
+        entries = sv.kernel_compiled_entries(kernel)
+        want = sv.kernel_dispatch_count(kernel)
+        rung = {
+            "kernel": kernel,
+            "compile_s": round(compile_s, 2),
+            "dispatches_per_batch": want,
+            "compiled_entries": entries,
+            # the acceptance check: one batch shape ran, so live entries
+            # == modules entered per dispatch (1 for fused, 4 for split)
+            "single_dispatch_ok": entries == want,
+            "windows": {},
+        }
+        for w in wins:
+            outs = []
+            occ = occ_n = 0
+            t0 = time.time()
+            for _ in range(rounds):
+                outs.append(step())
+                occ += len(outs)
+                occ_n += 1
+                if len(outs) >= w:
+                    int(np.asarray(outs.pop(0)))
+            for o in outs:
+                int(np.asarray(o))
+            el = time.time() - t0
+            rung["windows"][str(w)] = {
+                "elems_per_s": round(batch * rounds / el, 1),
+                "inflight_mean": round(occ / occ_n, 2),
+            }
+        art["rungs"].append(rung)
+        print(f"# ladder {kernel}: compile {compile_s:.1f}s, "
+              f"{want} dispatch(es)/batch (entries={entries}), "
+              f"{rung['windows']}", file=sys.stderr)
+
+    try:
+        art["stage"] = _kernel_ladder_stage_probe()
+    except Exception as e:  # the device rungs must survive a probe bug
+        print(f"# stage probe failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        art["stage_error"] = f"{type(e).__name__}"
+    art["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    path = out_path or KERNEL_ARTIFACT
+    try:
+        with open(path, "w") as fh:
+            json.dump(art, fh, indent=1)
+        print(f"# kernel ladder artifact -> {path}", file=sys.stderr)
+    except OSError as e:
+        print(f"# kernel ladder artifact write failed: {e}", file=sys.stderr)
+    return art
 
 
 def run_pipeline_bench(platform: str) -> dict:
@@ -932,10 +1167,13 @@ def run_pipeline_bench(platform: str) -> dict:
         wm2 = np.zeros((256, batch), dtype=np.uint8)  # match VerifyStage's wire dtype
         wm2[: wm.shape[0]] = wm
         t0 = time.time()
-        sv.ed25519_verify_batch(
-            jnp.asarray(wm2), jnp.asarray(wl), jnp.asarray(ws), jnp.asarray(wp),
-            max_msg_len=256,
-        ).block_until_ready()
+        # warm the STAGE's default program (the fused single-dispatch
+        # lane) at its exact shape, so compile cost stays out of the
+        # timed pipeline window
+        sv.ed25519_verify_batch_fused(
+            jnp.asarray(wm2), jnp.asarray(wl), jnp.asarray(ws),
+            jnp.asarray(wp), jnp.int32(batch), max_msg_len=256,
+        )[0].block_until_ready()
         print(f"# pipeline: verify kernel warm in {time.time()-t0:.1f}s",
               file=sys.stderr)
         t0 = time.time()
@@ -1311,6 +1549,22 @@ def run_multichip_serve() -> None:
 
 
 def main() -> None:
+    if "--kernel-ladder" in sys.argv:
+        from firedancer_tpu.utils.platform import force_cpu_backend
+
+        # CPU by default (the tier the capture runs on today); pass
+        # --real to use whatever accelerator jax resolves — the capture
+        # itself is backend-agnostic (one command on a real chip)
+        if "--real" not in sys.argv:
+            force_cpu_backend()
+        print(json.dumps(run_kernel_ladder(), indent=1))
+        return
+    if "--verify-ab" in sys.argv:
+        i = sys.argv.index("--verify-ab")
+        n = int(sys.argv[i + 1]) if len(sys.argv) > i + 1 \
+            and sys.argv[i + 1].isdigit() else 3
+        print(json.dumps(run_verify_ab(pairs=n), indent=1))
+        return
     if "--shred-ab" in sys.argv:
         i = sys.argv.index("--shred-ab")
         n = int(sys.argv[i + 1]) if len(sys.argv) > i + 1 \

@@ -94,6 +94,22 @@ _SHRED_PATH_FILES = frozenset({
     "fec_resolver.py",
 })
 
+# FD214: the async-window discipline (ISSUE 13).  A verify stage keeps
+# >= 8 device batches in flight; ONE designated reap point consumes
+# device results, and a device->host sync anywhere else in the stage
+# (np.asarray on a future, .item(), block_until_ready) silently
+# serializes the window back to depth 1.  Scoped to the verify-stage
+# classes in the verify-path modules; the reap-point methods are the
+# allowlist.  Frag callbacks are excluded here — FD201 already owns
+# them.
+_FD214_FILES = frozenset({"verify.py", "serve.py", "verify_native.py"})
+_FD214_REAP_METHODS = frozenset({
+    "_drain", "_nv_drain", "_result_mask", "_result_ready", "flush",
+})
+_FD214_SYNC_CALLS = frozenset({
+    ("np", "asarray"), ("np", "array"), ("jax", "device_get"),
+})
+
 
 def _fd208_offender(arg: ast.AST) -> str | None:
     """Why `arg` allocates/formats, or None if it looks scalar-cheap."""
@@ -255,6 +271,11 @@ class _Linter(ast.NodeVisitor):
         # once per entry/shred and must stay append-only; hashing and
         # shred framing happen at FEC-set granularity
         self._shred_scope = bool(parts) and parts[-1] in _SHRED_PATH_FILES
+        # FD214 scope: verify-path modules; the class/method context is
+        # tracked below (verify-stage classes only, reap methods exempt)
+        self._verify_scope = bool(parts) and parts[-1] in _FD214_FILES
+        self._vclass_stack: list[bool] = []  # is-a-verify-stage class?
+        self._fd214_method: list[str] = []  # enclosing method per depth
 
     def _resolve(self, node: ast.Call) -> tuple[str, str] | None:
         """Canonical (module, func) for a call, seeing through `import
@@ -300,6 +321,14 @@ class _Linter(ast.NodeVisitor):
 
     def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
         is_frag = node.name in FRAG_CALLBACKS and self._in_class()
+        # FD214 method attribution: a def directly inside a verify-stage
+        # class opens a method scope; nested defs inherit it
+        opens_method = (
+            not self._func_stack
+            and self._vclass_stack and self._vclass_stack[-1]
+        )
+        if opens_method:
+            self._fd214_method.append(node.name)
         self._func_stack.append(node)
         if is_frag:
             self._frag_depth += 1
@@ -307,6 +336,8 @@ class _Linter(ast.NodeVisitor):
         if is_frag:
             self._frag_depth -= 1
         self._func_stack.pop()
+        if opens_method:
+            self._fd214_method.pop()
 
     visit_AsyncFunctionDef = visit_FunctionDef
 
@@ -317,7 +348,19 @@ class _Linter(ast.NodeVisitor):
 
     def visit_ClassDef(self, node: ast.ClassDef) -> None:
         self._class_depth += 1
+        # FD214: a verify-stage class by name or by base (subclasses like
+        # ShardedVerifyStage inherit the async-window discipline)
+        def _base_name(b: ast.AST) -> str:
+            d = _dotted(b)
+            return d[-1] if d else ""
+
+        is_vs = self._verify_scope and (
+            "VerifyStage" in node.name
+            or any("VerifyStage" in _base_name(b) for b in node.bases)
+        )
+        self._vclass_stack.append(is_vs)
         self.generic_visit(node)
+        self._vclass_stack.pop()
         self._class_depth -= 1
 
     _class_depth = 0
@@ -328,6 +371,7 @@ class _Linter(ast.NodeVisitor):
         mf = self._resolve(node)
         if self._frag_depth:
             self._check_frag_call(node, mf)
+        self._check_fd214(node, mf)
         if mf and mf[0] == "random" and mf[1] in _RANDOM_GLOBALS:
             self.hit("FD203", node,
                      f"process-global random.{mf[1]}() — use a seeded"
@@ -342,6 +386,34 @@ class _Linter(ast.NodeVisitor):
             self._check_chaos_entropy(node)
         self._check_builder_arg(node)
         self.generic_visit(node)
+
+    def _check_fd214(self, node: ast.Call,
+                     mf: tuple[str, str] | None) -> None:
+        """FD214: device sync outside the designated reap point in a
+        verify-stage class.  The verify stage's whole point is a >= 8
+        deep async in-flight window; ONE method family (_drain /
+        _nv_drain and its _result_* hooks, plus flush) is WHERE device
+        results become host values.  An np.asarray/.item()/
+        block_until_ready anywhere else in the stage stalls the loop on
+        the device mid-stream and quietly serializes the window.  Frag
+        callbacks are FD201's jurisdiction and are not re-flagged."""
+        if not self._fd214_method or self._frag_depth:
+            return
+        method = self._fd214_method[-1]
+        if method in _FD214_REAP_METHODS or method in FRAG_CALLBACKS:
+            return
+        what = None
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _SYNC_ATTRS:
+            what = f".{node.func.attr}()"
+        elif mf and mf in _FD214_SYNC_CALLS:
+            what = f"{'.'.join(mf)}()"
+        if what:
+            self.hit("FD214", node,
+                     f"device sync {what} in verify-stage method "
+                     f"'{method}' outside the designated reap point"
+                     " (_drain/_result_mask/flush): syncing mid-stream"
+                     " serializes the async in-flight window")
 
     def _check_chaos_entropy(self, node: ast.Call) -> None:
         """FD209: the chaos package must derive ALL randomness from the

@@ -193,6 +193,16 @@ FD212 = _rule(
     " byref/out-buffer objects at construction (tango/native.py) and cross"
     " the FFI once per drained burst (fdr_drain / fdr_publish_burst)",
 )
+FD214 = _rule(
+    "FD214", "sync-outside-reap-point", SEV_ERROR,
+    "device->host sync (np.asarray/np.array on device values, .item(),"
+    " .block_until_ready(), jax.device_get) inside a verify-stage method"
+    " that is NOT the designated reap point (_drain/_nv_drain, the"
+    " _result_mask/_result_ready hooks, flush): the verify stage keeps a"
+    " >= 8 deep async in-flight window and exactly one place may block on"
+    " device results — a sync anywhere else (intake, batching, submit,"
+    " housekeeping) quietly serializes the window back to depth 1",
+)
 FD213 = _rule(
     "FD213", "hash-alloc-in-shred-frag", SEV_ERROR,
     "per-frag hashing or bytes assembly (hashlib/merkle-helper call,"

@@ -33,6 +33,7 @@ so a warmed host's next process boots the step from cache in seconds.
 from __future__ import annotations
 
 import os
+import sys
 import time
 from dataclasses import dataclass
 
@@ -216,31 +217,108 @@ class ServePlane:
         out_sh = (self.s_vec, self.s_repl, self.s_sets, self.s_vec)
         return in_sh, out_sh
 
+    def _mesh_platform(self) -> str:
+        """The platform the step actually runs on (the plane's OWN mesh,
+        not the process default — a CPU dryrun next to a TPU mesh must
+        not pick the TPU lane)."""
+        return self.mesh.devices.flat[0].platform
+
+    def _use_serialized_executable(self) -> bool:
+        """Warm-boot lane choice: serialize_executable on accelerator
+        backends (deserialization is seconds — the 10 s warm_cold_start
+        budget's path), jax.export + persistent cache on CPU where the
+        executable round trip is known to fail (utils/platform
+        .serialize_executable_ok)."""
+        from firedancer_tpu.utils.platform import serialize_executable_ok
+
+        return serialize_executable_ok(self._mesh_platform())
+
+    def _exec_blob_path(self, cache_dir: str | None) -> str | None:
+        if not cache_dir:
+            return None
+        return os.path.join(
+            cache_dir,
+            f"serve_step_{self.cfg.cache_key()}_{self._mesh_platform()}.xc",
+        )
+
     def warmup(self) -> float:
         """AOT-compile the serving step before any traffic exists (the
         leader's boot-time obligation).  Returns seconds.
 
-        Warm boots skip BOTH expensive phases where a cache directory is
-        configured (utils/platform.enable_serve_cache):
+        Two warm-boot lanes, selected by backend
+        (_use_serialized_executable):
 
-          - the Python trace/lower (~20s for this kernel on one core) is
-            skipped by reloading the serialized StableHLO export written
-            by the first warmup (`serve_step_<key>.hlo` next to the
-            cache entries);
-          - the XLA optimization pipeline is skipped by the persistent
-            compilation cache — the cold and warm paths compile the SAME
-            exported module, so the cache key always matches.
+          - accelerators: the COMPILED executable serializes
+            (jax.experimental.serialize_executable) next to the cache as
+            `serve_step_<key>_<platform>.xc`; a warm boot is pure
+            deserialization — no trace, no XLA, no codegen — which is
+            what fits the 10 s warm_cold_start budget;
+          - CPU (the executable round trip fails there: "Symbols not
+            found"): the jax.export lane below — the Python trace/lower
+            (~20s on one core) is skipped by reloading the serialized
+            StableHLO export (`serve_step_<key>.hlo`), and the XLA
+            optimization pipeline by the persistent compilation cache.
+            What remains is LLVM rehydration (~26s on one core).
 
-        What remains on a warm CPU boot is executable rehydration (XLA:
-        CPU re-runs LLVM codegen from the cached post-optimization HLO;
-        measured ~26s on one core, parallelizes with cores); accelerator
-        backends store machine code and load in seconds.  Measured
-        ladder on this class of host: ~175s cold / ~27s warm."""
+        Measured ladder on this host class: ~175s cold / ~27s warm via
+        the export lane."""
         import jax
-        import jax.export
 
         t0 = time.monotonic()
         cache_dir = jax.config.jax_compilation_cache_dir
+        if self._use_serialized_executable():
+            if self._warmup_serialized(cache_dir):
+                self.compile_s = time.monotonic() - t0
+                return self.compile_s
+        self._warmup_export(cache_dir)
+        self.compile_s = time.monotonic() - t0
+        return self.compile_s
+
+    def _warmup_serialized(self, cache_dir: str | None) -> bool:
+        """The accelerator lane: load the serialized executable if one
+        exists, else compile through the export lane and serialize the
+        result for the next boot.  Returns False only when the blob
+        machinery is unusable (no cache dir and nothing to gain)."""
+        import pickle
+
+        from jax.experimental import serialize_executable as se
+
+        blob = self._exec_blob_path(cache_dir)
+        if blob is None:
+            return False
+        if os.path.exists(blob):
+            try:
+                with open(blob, "rb") as f:
+                    payload, in_tree, out_tree = pickle.load(f)
+                self._aot = se.deserialize_and_load(payload, in_tree,
+                                                    out_tree)
+                return True
+            except Exception as e:
+                # a stale/incompatible blob (jaxlib upgrade, runtime
+                # change) must cost ONE slow recompile, not the boot:
+                # drop it and fall through to the export lane, which
+                # rewrites a fresh blob below
+                print(f"# warm-boot blob unusable ({type(e).__name__}: "
+                      f"{e}); recompiling", file=sys.stderr)
+                try:
+                    os.remove(blob)
+                except OSError:
+                    pass
+        self._warmup_export(cache_dir)
+        payload, in_tree, out_tree = se.serialize(self._aot)
+        tmp = f"{blob}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            pickle.dump((payload, in_tree, out_tree), f)
+        os.replace(tmp, blob)
+        return True
+
+    def _warmup_export(self, cache_dir: str | None) -> None:
+        """The CPU-safe lane: serialized StableHLO export (skips
+        re-trace) + persistent compilation cache (skips
+        re-optimization)."""
+        import jax
+        import jax.export
+
         blob = None
         if cache_dir:
             blob = os.path.join(
@@ -262,8 +340,6 @@ class ServePlane:
         self._aot = jax.jit(
             exp.call, in_shardings=in_sh, out_shardings=out_sh
         ).lower(*self._abstract_args()).compile()
-        self.compile_s = time.monotonic() - t0
-        return self.compile_s
 
     # -- sharded argument plumbing -------------------------------------------
 

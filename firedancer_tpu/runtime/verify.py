@@ -39,6 +39,7 @@ without the retry).
 
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import dataclass, field
 
@@ -108,6 +109,23 @@ VERIFY_TCACHE_DEPTH = 16  # tiny by design (fd_verify.h:6-7)
 
 COMB_FILL_BATCH = 32  # pubkeys per comb_fill dispatch (fixed jit shape)
 
+# the generic-lane kernel ladder (ops/sigverify.KERNEL_LADDER): fused is
+# the default — ONE compiled module per batch (validate + sha512 + dsm +
+# compare + pad mask + ok-count); split stays available for tunneled
+# remote-compile backends, baseline for A/B reference
+VERIFY_KERNELS = ("fused", "baseline", "split")
+DEFAULT_KERNEL = os.environ.get("FDTPU_VERIFY_KERNEL", "fused")
+
+# the async in-flight window (wiredancer shape): how many device batches
+# may be outstanding before submit defers.  >= 8 keeps the accelerator
+# fed while the host streams the next batches; reaping is strictly in
+# submission order regardless of width.
+DEFAULT_MAX_INFLIGHT = int(os.environ.get("FDTPU_VERIFY_INFLIGHT", "8"))
+
+# native sweep-client frames are payload + packed descriptor + u16; the
+# out link must carry them (fd_verify.cpp FRAME_CAP)
+_NATIVE_FRAME_MTU = 1232 + 2048 + 2
+
 
 def sig_tag(sig: bytes) -> int:
     """64-bit dedup tag: low 8 bytes of the (uniformly distributed) sig."""
@@ -124,6 +142,10 @@ class _Pending:
     tsorigs: list[int]
     n_elems: int
     result: object  # jax array future
+    # fused-lane rider: the on-device ok-count over real lanes (None on
+    # the baseline/split/cached/plane lanes — the reap falls back to
+    # host mask arithmetic)
+    n_ok: object = None
 
 
 @dataclass
@@ -153,7 +175,10 @@ class VerifyStage(Stage):
         batch: int = 256,
         max_msg_len: int = 1232,
         batch_deadline_s: float = 0.002,
-        max_inflight: int = 3,
+        max_inflight: int | None = None,
+        kernel: str | None = None,
+        autotune_after: int = 0,
+        native_client: bool | None = None,
         devices=None,
         precomputed_ok: bool = False,
         comb_slots: int = 0,
@@ -185,7 +210,20 @@ class VerifyStage(Stage):
         self.batch = batch
         self.max_msg_len = max_msg_len
         self.batch_deadline_s = batch_deadline_s
-        self.max_inflight = max_inflight
+        self.max_inflight = (max_inflight if max_inflight is not None
+                             else DEFAULT_MAX_INFLIGHT)
+        self.kernel = kernel if kernel is not None else DEFAULT_KERNEL
+        if self.kernel not in VERIFY_KERNELS:
+            raise ValueError(
+                f"unknown verify kernel {self.kernel!r} "
+                f"(ladder: {', '.join(VERIFY_KERNELS)})"
+            )
+        # autotune_after: re-derive (batch, max_msg_len, comb split) from
+        # this stage's own batch-fill/msg-len histograms every N closed
+        # batches (runtime/verify_tune.py); 0 = off (retuning recompiles)
+        self.autotune_after = autotune_after
+        self._last_tune_batches = 0
+        self._comb_lane_on = True
         self.tcache = TCache(VERIFY_TCACHE_DEPTH)
         # comb bank (0 slots = fast path disabled)
         self.comb_slots = comb_slots
@@ -199,6 +237,12 @@ class VerifyStage(Stage):
         self._gen = _Acc()
         self._comb = _Acc()
         self._inflight: list[_Pending] = []
+        # sealed batches waiting for an in-flight window slot: submit is
+        # backpressure-aware — a full window parks the sealed batch here
+        # instead of blocking the loop on the oldest device future; a
+        # deep queue (memory bound) falls back to the blocking drain
+        self._submit_queue: list = []
+        self._submit_queue_max = 4
         # verified frames awaiting output-ring credits: a whole batch can
         # complete while the out ring holds fewer credits than the burst,
         # and dropping the tail (the old per-frag posture) loses verified
@@ -208,6 +252,58 @@ class VerifyStage(Stage):
         self._emit_queue_max = 8192
         # sweep-granularity parser (drain-table path), built on first use
         self._burst_parser = None
+        # -- native sweep client (ISSUE 13) -----------------------------------
+        # the whole intake sweep (drain -> parse -> guards -> batch
+        # assembly) in ONE fdr_sweep crossing with zero Python per frag;
+        # armed only on the plain generic lane (no plane, no comb bank)
+        # over all-native rings whose out link carries the preassembled
+        # frame size.  native_client: None = auto-arm for exact
+        # VerifyStage instances, False = never, True = required.
+        self._sweep_client = None
+        self._nv_inflight: list = []  # (slot, n_elems, n_txn, result, n_ok)
+        self._nv_emit: list = []  # [slot, frame table, published idx]
+        self._nv_opened_at = 0.0
+        want_native = (native_client if native_client is not None
+                       else type(self) is VerifyStage)
+        if want_native:
+            # structural preconditions, each named so native_client=True
+            # (the "required" contract) can say exactly what blocked it
+            blocker = None
+            if plane is not None:
+                blocker = "a serving plane routes generic batches"
+            elif comb_slots != 0:
+                blocker = "the comb bank needs Python signer tracking"
+            elif not self.ins or not self.outs:
+                blocker = "stage has no rings"
+            elif not all(type(c).__name__ == "NativeConsumer"
+                         for c in self.ins):
+                blocker = "not every input is a native-ring consumer"
+            elif type(self.outs[0]).__name__ != "NativeProducer":
+                blocker = "the output is not a native-ring producer"
+            elif self.outs[0].link.mtu < _NATIVE_FRAME_MTU:
+                blocker = (f"out link mtu {self.outs[0].link.mtu} <"
+                           f" {_NATIVE_FRAME_MTU} (frame headroom)")
+            if blocker is None:
+                from . import verify_native as vn
+
+                try:
+                    if not vn.available():
+                        raise vn.NativeUnavailable(
+                            "toolchain missing or FDTPU_NATIVE_VERIFY=0")
+                    self._sweep_client = vn.StageClient(
+                        shard_idx=shard_idx, shard_cnt=shard_cnt,
+                        batch=batch, max_msg_len=max_msg_len,
+                        n_slots=self.max_inflight + 2,
+                    )
+                except vn.NativeUnavailable as e:
+                    if native_client:
+                        raise RuntimeError(
+                            f"native_client=True but the verify sweep"
+                            f" client is unavailable: {e}") from e
+            elif native_client:
+                raise RuntimeError(
+                    f"native_client=True but the stage cannot arm the"
+                    f" sweep client: {blocker}")
 
     # -- observability ------------------------------------------------------
 
@@ -228,10 +324,27 @@ class VerifyStage(Stage):
             .counter("emit_dropped",
                      "verified frames dropped after the bounded emit"
                      " retry queue overflowed (dead/wedged consumer)")
+            .counter("submit_deferred",
+                     "batches sealed while the in-flight window was full"
+                     " (backpressure-aware submit parked them)")
+            .counter("intake_dropped",
+                     "frags dropped after the native intake stash"
+                     " overflowed (dead/wedged consumer)")
+            .counter("retunes", "autotuner geometry changes applied")
             .histogram(
                 "batch_fill",
                 fm.exp_buckets(1, 4096, 13),
                 "elements per closed device batch (fill vs the fixed shape)",
+            )
+            .histogram(
+                "msg_len",
+                fm.exp_buckets(32, 2048, 13),
+                "per-txn message bytes (autotuner evidence)",
+            )
+            .histogram(
+                "inflight_occupancy",
+                tuple(float(i) for i in range(1, 17)),
+                "in-flight batches at submit (async window fill)",
             )
         )
 
@@ -274,6 +387,7 @@ class VerifyStage(Stage):
         """Batch one intaken txn (the ONE accumulation implementation —
         after_frag and the drain-table sweep_frags path both land here)."""
         sigs, msg, signers, t, packed = got
+        self.metrics.observe("msg_len", len(msg))
         slots = self._signer_slots(signers)
         acc = self._comb if slots is not None else self._gen
         if acc.elems and len(acc.elems) + len(sigs) > self.batch:
@@ -291,6 +405,14 @@ class VerifyStage(Stage):
             self._close_batch(acc)
 
     def after_frag(self, in_idx: int, meta, payload: bytes) -> None:
+        c = self._sweep_client
+        if c is not None:
+            # fallback surface (mixed-lane / lossy splice): forward into
+            # the SAME C-side batch state the sweep callback fills; the
+            # deadline stamp happens in before_credit off the C-side
+            # open_elems word (the FD202 discipline)
+            c.append(payload, int(meta[MCACHE_COL_TSORIG]))
+            return
         got = self._intake(payload)
         if got is None:
             return
@@ -374,11 +496,25 @@ class VerifyStage(Stage):
         # backpressure.  The clock is only read when a batch newly
         # opened — idle spins stay syscall-free.  (clear() resets
         # opened_at, so a stale stamp can never survive a close.)
+        c = self._sweep_client
+        if c is not None:
+            # native lane: ONE u64 read probes the C-side open batch
+            if self._nv_opened_at == 0.0 and c.open_elems():
+                self._nv_opened_at = time.monotonic()
+            return
         for acc in (self._gen, self._comb):
             if acc.elems and acc.opened_at == 0.0:
                 acc.opened_at = time.monotonic()
 
     def after_credit(self) -> None:
+        if self._sweep_client is not None:
+            # deadline-based batch close, then dispatch/reap/publish
+            if self._nv_opened_at and time.monotonic() \
+                    - self._nv_opened_at >= self.batch_deadline_s:
+                self._sweep_client.seal()
+                self._nv_opened_at = 0.0
+            self._nv_pump()
+            return
         # credits are available again: retry frames a full out ring
         # parked on the emit queue before touching new work
         if self._emit_queue:
@@ -389,18 +525,182 @@ class VerifyStage(Stage):
             if acc.elems and acc.opened_at \
                     and now - acc.opened_at >= self.batch_deadline_s:
                 self._close_batch(acc)
+        self._pump_submits()
         self._drain(block=False)
 
     def during_housekeeping(self) -> None:
+        c = self._sweep_client
+        if c is not None:
+            self._nv_pump()
+            # C-side intake counters are authoritative in sweep mode
+            # (the shred-client discipline): absolute values copied at
+            # the same lazy cadence every other stage metric has
+            self.metrics.counters.update(c.counters())
+            return
+        self._pump_submits()
         self._drain(block=False)
         self._fill_bank()
+        self._maybe_retune()
+
+    # -- autotuner (runtime/verify_tune.py) ---------------------------------
+
+    def _maybe_retune(self) -> None:
+        """Re-derive batch geometry from this stage's own histograms at
+        housekeeping cadence, applying only at a quiet point (nothing
+        accumulated, nothing in flight) — a retune is a recompile, so
+        the evidence bar (autotune_after batches) is deliberately
+        high."""
+        if not self.autotune_after:
+            return
+        if self.metrics.get("batches") - self._last_tune_batches \
+                < self.autotune_after:
+            return
+        if (self._inflight or self._submit_queue or self._gen.elems
+                or self._comb.elems):
+            return
+        from . import verify_tune as vt
+
+        self._last_tune_batches = self.metrics.get("batches")
+        rec = vt.recommend_for_stage(self)
+        changed = (rec.batch != self.batch
+                   or rec.max_msg_len != self.max_msg_len
+                   or rec.comb_split != self._comb_lane_on)
+        if not changed:
+            return
+        self.batch = rec.batch
+        self.max_msg_len = rec.max_msg_len
+        self._comb_lane_on = rec.comb_split
+        self.metrics.inc("retunes")
+
+    # -- native sweep-client plumbing ---------------------------------------
+
+    def _native_sweep(self, drainer) -> bool:
+        c = self._sweep_client
+        if c is not None and not c.can_accept():
+            # every slot busy: sweeping now would only stash — reap and
+            # publish first so the intake window reopens
+            self._nv_pump()
+            return False
+        return super()._native_sweep(drainer)
+
+    def _nv_pump(self) -> None:
+        """The native lane's batch-granular loop: submit sealed slots
+        into the in-flight window (in seal order), reap completed heads
+        (in order), publish reaped frames from the slot arenas."""
+        c = self._sweep_client
+        while len(self._nv_inflight) < self.max_inflight:
+            got = c.take_sealed()
+            if got is None:
+                break
+            self._nv_dispatch(*got)
+        self._nv_drain(block=False)
+        self._nv_publish()
+
+    def _nv_dispatch(self, slot: int, n_elems: int, n_txn: int) -> None:
+        c = self._sweep_client
+        views = c.slots[slot]
+        # per-txn msg lengths for the autotuner: one vectorized observe
+        # off the ln column at the txns' first elements
+        starts = views.ranges[:n_txn, 0].astype(np.int64)
+        self.metrics.observe_batch("msg_len", views.ln[starts])
+        if self.precomputed_ok:
+            result, n_ok = np.ones((n_elems,), dtype=bool), None
+        else:
+            import jax.numpy as jnp
+
+            from firedancer_tpu.ops import sigverify as sv
+
+            result, n_ok = sv.verify_dispatch(
+                self.kernel,
+                jnp.asarray(views.msg.T),
+                jnp.asarray(views.ln),
+                jnp.asarray(views.sig.T),
+                jnp.asarray(views.pk.T),
+                n_elems,
+                max_msg_len=self.max_msg_len,
+            )
+        self._nv_inflight.append((slot, n_elems, n_txn, result, n_ok))
+        self.metrics.inc("batches", 1)
+        self.metrics.inc("batch_elems", n_elems)
+        self.metrics.observe("batch_fill", n_elems)
+        self.metrics.observe("inflight_occupancy", len(self._nv_inflight))
+        self.trace(fm.EV_BATCH_SUBMIT, n_elems)
+
+    def _nv_drain(self, block: bool) -> None:
+        c = self._sweep_client
+        while self._nv_inflight:
+            slot, n_elems, n_txn, result, n_ok = self._nv_inflight[0]
+            ready = getattr(result, "is_ready", lambda: True)()
+            if not block and not ready:
+                return
+            mask = np.asarray(result)
+            self._nv_inflight.pop(0)
+            self.trace(fm.EV_BATCH_COMPLETE, n_elems)
+            views = c.slots[slot]
+            frames = views.frames[:n_txn]
+            if n_ok is not None:
+                all_ok = int(n_ok) == n_elems
+            else:
+                all_ok = bool(mask[:n_elems].all())
+            if all_ok:
+                tbl = frames
+                kept = n_txn
+            else:
+                starts = views.ranges[:n_txn, 0].astype(np.int64)
+                ok_txn = np.minimum.reduceat(
+                    mask[:n_elems].astype(np.uint8), starts
+                ).astype(bool)
+                tbl = np.ascontiguousarray(frames[ok_txn])
+                kept = int(ok_txn.sum())
+                self.metrics.inc("verify_fail", n_txn - kept)
+            if kept:
+                self.metrics.inc("txn_verified", kept)
+                self._nv_emit.append([slot, tbl, 0])
+            else:
+                c.release(slot)
+            if block:
+                break
+
+    def _nv_publish(self) -> None:
+        """Publish reaped frame tables head-first (global emit order is
+        reap order), straight from the slot arenas: one
+        fdr_publish_burst crossing per table, credit-gated, the
+        unpublished tail retried next credit window.  A slot returns to
+        the intake ring only when its frames are fully out."""
+        if not self._nv_emit or not self.outs:
+            return
+        c = self._sweep_client
+        p = self.outs[0]
+        pc = time.perf_counter
+        while self._nv_emit:
+            ent = self._nv_emit[0]
+            slot, tbl, pos = ent
+            sub = tbl[pos:]
+            if self.ring_clock:
+                _t = pc()
+                done = p.publish_burst_raw(c.slots[slot].arena_ptr, sub,
+                                           len(sub))
+                self.ring_publish_s += pc() - _t
+            else:
+                done = p.publish_burst_raw(c.slots[slot].arena_ptr, sub,
+                                           len(sub))
+            if done:
+                self.metrics.inc("frags_out", done)
+            ent[2] = pos + done
+            if ent[2] == len(tbl):
+                self._nv_emit.pop(0)
+                c.release(slot)
+            else:
+                self.metrics.inc("backpressure", len(sub) - done)
+                break
 
     # -- comb bank ----------------------------------------------------------
 
     def _signer_slots(self, signers: list[bytes]) -> list[int] | None:
         """Bank slots if EVERY signer is cached, else None; bumps repeat
         counters and queues promotions on the way."""
-        if not self.comb_slots or self.precomputed_ok:
+        if not self.comb_slots or self.precomputed_ok \
+                or not self._comb_lane_on:
             return None
         slots = []
         all_cached = True
@@ -469,18 +769,45 @@ class VerifyStage(Stage):
     # -- device batching ----------------------------------------------------
 
     def _close_batch(self, acc: _Acc | None = None) -> None:
+        """Seal the accumulating batch and submit it if the in-flight
+        window has room; a full window PARKS the sealed batch (submit is
+        backpressure-aware — the loop never blocks on the oldest device
+        future just to close a batch) until reaping frees a slot.  Only
+        a deep submit queue (the memory bound) falls back to the
+        blocking drain."""
         if acc is None:  # legacy single-lane callers (tests)
             acc = self._gen
         if not acc.elems:
             return
-        if len(self._inflight) >= self.max_inflight:
-            self._drain(block=True)
-        n = len(acc.elems)
         cached = acc is self._comb
-        if self.precomputed_ok:
-            result = np.ones((n,), dtype=bool)
+        # take the accumulator object itself as the sealed snapshot and
+        # open a fresh one (clear() would free the lists we still need)
+        if cached:
+            self._comb = _Acc()
         else:
-            result = self._dispatch(acc, cached)
+            self._gen = _Acc()
+        self._submit_queue.append((acc, cached))
+        self._pump_submits()
+        if self._submit_queue:
+            self.metrics.inc("submit_deferred")
+            if len(self._submit_queue) > self._submit_queue_max:
+                self._drain(block=True)
+                self._pump_submits()
+
+    def _pump_submits(self) -> None:
+        """Move sealed batches into the device window, in seal order,
+        while the window has room."""
+        q = self._submit_queue
+        while q and len(self._inflight) < self.max_inflight:
+            acc, cached = q.pop(0)
+            self._submit(acc, cached)
+
+    def _submit(self, acc: _Acc, cached: bool) -> None:
+        n = len(acc.elems)
+        if self.precomputed_ok:
+            result, n_ok = np.ones((n,), dtype=bool), None
+        else:
+            result, n_ok = self._dispatch(acc, cached)
         self._inflight.append(
             _Pending(
                 payloads=acc.payloads,
@@ -489,15 +816,16 @@ class VerifyStage(Stage):
                 tsorigs=acc.tsorigs,
                 n_elems=n,
                 result=result,
+                n_ok=n_ok,
             )
         )
         self.metrics.inc("batches", 1)
         self.metrics.inc("batch_elems", n)
         self.metrics.observe("batch_fill", n)
+        self.metrics.observe("inflight_occupancy", len(self._inflight))
         self.trace(fm.EV_BATCH_SUBMIT, n)
         if cached:
             self.metrics.inc("comb_elems", n)
-        acc.clear()
 
     def _assemble(self, acc: _Acc):
         """elems -> device-shaped uint8 byte-row arrays.
@@ -527,6 +855,7 @@ class VerifyStage(Stage):
         return msg.T, ln, sig.T, pk.T
 
     def _dispatch(self, acc: _Acc, cached: bool):
+        """-> (mask future, ok-count future | None)."""
         import jax.numpy as jnp
 
         from firedancer_tpu.ops import sigverify as sv
@@ -539,7 +868,7 @@ class VerifyStage(Stage):
         if self.plane is not None and not cached:
             # mesh route: the sharded serving step (pad lanes beyond n
             # are masked by the step itself via the per-shard fills)
-            return self.plane.verify_batch(msg, ln, sig, pk)
+            return self.plane.verify_batch(msg, ln, sig, pk), None
         if cached:
             slots = np.zeros((b,), dtype=np.int32)
             slots[:n] = acc.slots
@@ -551,12 +880,16 @@ class VerifyStage(Stage):
                 self._bank,
                 jnp.asarray(slots),
                 max_msg_len=self.max_msg_len,
-            )
-        return sv.ed25519_verify_batch(
+            ), None
+        # the kernel-ladder lane (fused by default: one compiled module
+        # per batch, pad lanes masked + ok-count computed on device)
+        return sv.verify_dispatch(
+            self.kernel,
             jnp.asarray(msg),
             jnp.asarray(ln),
             jnp.asarray(sig),
             jnp.asarray(pk),
+            n,
             max_msg_len=self.max_msg_len,
         )
 
@@ -580,11 +913,19 @@ class VerifyStage(Stage):
                 return
             mask = self._result_mask(head)
             self._inflight.pop(0)
+            # a window slot freed: submit parked batches before reaping
+            # (keeps the device fed while the host walks the mask)
+            self._pump_submits()
             self.trace(fm.EV_BATCH_COMPLETE, head.n_elems)
             # honest traffic overwhelmingly passes whole batches: one
             # all-reduce decides the common case instead of a numpy
-            # slice + reduction per txn (~1.5us/txn of the host path)
-            all_ok = bool(mask[: head.n_elems].all())
+            # slice + reduction per txn (~1.5us/txn of the host path).
+            # The fused lane computed the count on device — the reap
+            # reads one scalar instead of scanning the mask.
+            if head.n_ok is not None:
+                all_ok = int(head.n_ok) == head.n_elems
+            else:
+                all_ok = bool(mask[: head.n_elems].all())
             emits = []
             for payload, desc, (a, b), tsorig in zip(
                 head.payloads, head.descs, head.elem_ranges, head.tsorigs
@@ -635,12 +976,31 @@ class VerifyStage(Stage):
 
     def flush(self) -> None:
         """Close and drain everything (test/shutdown path)."""
+        c = self._sweep_client
+        if c is not None:
+            # bounded: the emit side may be stuck on credits (the same
+            # posture the Python lane's emit queue keeps at shutdown)
+            for _ in range(4 * c.n_slots):
+                c.pump()
+                c.seal()
+                self._nv_opened_at = 0.0
+                self._nv_pump()
+                if self._nv_inflight:
+                    self._nv_drain(block=True)
+                    self._nv_publish()
+                if (not self._nv_inflight and not self._nv_emit
+                        and not c.stash_pending and not c.open_elems()
+                        and not (c.meta[:, 0] == 2).any()):
+                    break
+            return
         self._fill_bank()
         for acc in (self._gen, self._comb):
             if acc.elems:
                 self._close_batch(acc)
-        while self._inflight:
+        self._pump_submits()
+        while self._inflight or self._submit_queue:
             self._drain(block=True)
+            self._pump_submits()
         if self._emit_queue:
             self._emit_burst([])
 

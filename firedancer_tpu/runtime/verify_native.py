@@ -1,0 +1,237 @@
+"""ctypes binding for the native verify sweep client (native/fd_verify.cpp).
+
+The verify stage's host orchestration in one FFI crossing per sweep
+(ISSUE 13): fdr_sweep drains the stage's input rings AND runs the C
+frag callback — shard filter, fd_txn_parse (function pointer into
+fd_txn_parse.so, the fd_pack/fd_shred precedent), tcache dedup, the
+msg-length/fit guards, and fixed-shape batch assembly into a ring of
+reusable slot buffers — with zero Python per frag.  Python touches the
+pipeline at BATCH granularity only: dispatch a sealed slot's numpy
+views to the device kernel, and publish the reaped frames straight from
+the slot's preassembled frame arena (one fdr_publish_burst crossing).
+
+`FDTPU_NATIVE_VERIFY=0` disables the lane; a missing toolchain (or a
+missing fd_txn_parse.so) degrades to the Python intake path via
+NativeUnavailable.  Differential parity with the Python lane is the
+contract (tests/test_verify_native.py).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+from firedancer_tpu.utils.nativebuild import NativeUnavailable, build_so
+
+_SRC = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+    "fd_verify.cpp",
+)
+_SO = os.path.join(os.path.dirname(_SRC), "fd_verify.so")
+
+ENV_SWITCH = "FDTPU_NATIVE_VERIFY"
+
+# slot states (fd_verify.cpp enum)
+SLOT_FREE = 0
+SLOT_OPEN = 1
+SLOT_SEALED = 2
+SLOT_INFLIGHT = 3
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is None:
+        build_so(_SRC, _SO)
+        lib = ctypes.CDLL(_SO)
+        u64 = ctypes.c_uint64
+        vp = ctypes.c_void_p
+        lib.fdv_stage_new.argtypes = [u64, u64, u64, u64, u64, vp]
+        lib.fdv_stage_new.restype = vp
+        lib.fdv_stage_delete.argtypes = [vp]
+        lib.fdv_frag_cb.restype = ctypes.c_int  # resolved by ADDRESS only
+        lib.fdv_append.argtypes = [vp, ctypes.c_char_p, u64, u64]
+        lib.fdv_append.restype = ctypes.c_int
+        lib.fdv_seal.argtypes = [vp]
+        lib.fdv_pump.argtypes = [vp]
+        lib.fdv_slot_release.argtypes = [vp, u64]
+        for name in ("fdv_meta_ptr", "fdv_counters_ptr"):
+            getattr(lib, name).argtypes = [vp]
+            getattr(lib, name).restype = vp
+        for name in ("fdv_slot_msg", "fdv_slot_ln", "fdv_slot_sig",
+                     "fdv_slot_pk", "fdv_slot_frames", "fdv_slot_ranges",
+                     "fdv_slot_arena"):
+            getattr(lib, name).argtypes = [vp, u64]
+            getattr(lib, name).restype = vp
+        _lib = lib
+    return _lib
+
+
+def enabled() -> bool:
+    """The env switch: FDTPU_NATIVE_VERIFY=0 forces the Python intake."""
+    return os.environ.get(ENV_SWITCH, "1") != "0"
+
+
+def _parse_fn():
+    """Address of fd_txn_parse — the one parser implementation, entered
+    through a function pointer (no second parser to drift)."""
+    from firedancer_tpu.protocol import txn_native
+
+    lib = txn_native._load()
+    return ctypes.cast(lib.fd_txn_parse, ctypes.c_void_p)
+
+
+def available() -> bool:
+    """enabled AND both .so's load (toolchain-less hosts degrade to the
+    Python intake path gracefully)."""
+    if not enabled():
+        return False
+    try:
+        _load()
+        _parse_fn()
+        return True
+    except (NativeUnavailable, OSError, AttributeError):
+        return False
+
+
+# counter tail, in fd_verify.cpp declaration order after `flags` and
+# `open_elems`; names match the stage's schema metrics so housekeeping
+# copies them verbatim
+_COUNTERS = ("filtered", "frags_in", "parse_fail", "dedup_dup",
+             "msg_too_long", "too_many_sigs", "txn_in", "elems_in",
+             "intake_dropped", "sealed_batches")
+_TAIL_FLAGS = 0
+_TAIL_OPEN_ELEMS = 1
+_TAIL_COUNTERS = 2
+
+_META_NCOL = 4  # (state, n_elems, n_txn, arena_off) per slot
+
+
+class _SlotViews:
+    """Zero-copy numpy views over one slot's C buffers, built once."""
+
+    def __init__(self, lib, h, i: int, batch: int, mml: int):
+        def view(ptr, n, dt):
+            ct = (ctypes.c_uint8 * n) if dt == np.uint8 else \
+                 (ctypes.c_uint32 * n) if dt == np.uint32 else \
+                 (ctypes.c_int32 * n) if dt == np.int32 else \
+                 (ctypes.c_uint64 * n)
+            return np.frombuffer(ct.from_address(ptr), dtype=dt)
+
+        self.msg = view(lib.fdv_slot_msg(h, i), batch * mml,
+                        np.uint8).reshape(batch, mml)
+        self.ln = view(lib.fdv_slot_ln(h, i), batch, np.int32)
+        self.sig = view(lib.fdv_slot_sig(h, i), batch * 64,
+                        np.uint8).reshape(batch, 64)
+        self.pk = view(lib.fdv_slot_pk(h, i), batch * 32,
+                       np.uint8).reshape(batch, 32)
+        self.frames = view(lib.fdv_slot_frames(h, i), batch * 4,
+                           np.uint64).reshape(batch, 4)
+        self.ranges = view(lib.fdv_slot_ranges(h, i), batch * 2,
+                           np.uint32).reshape(batch, 2)
+        self.arena_ptr = int(lib.fdv_slot_arena(h, i))
+
+
+class StageClient:
+    """The verify stage's sweep-harness client: C-side intake + batch
+    assembly over a cyclic slot ring.  Constructed by VerifyStage when
+    the lane is armed (all-native rings, no plane, no comb bank);
+    exposes the fdr_sweep callback address, zero-FFI slot/counters
+    views, and the batch-granular control surface (seal / release /
+    next sealed slot)."""
+
+    def __init__(self, *, shard_idx: int, shard_cnt: int, batch: int,
+                 max_msg_len: int, n_slots: int):
+        lib = _load()
+        self._lib = lib
+        self.batch = batch
+        self.max_msg_len = max_msg_len
+        self.n_slots = n_slots
+        self._h = lib.fdv_stage_new(shard_idx, shard_cnt, batch,
+                                    max_msg_len, n_slots, _parse_fn())
+        if not self._h:
+            raise NativeUnavailable("fdv_stage_new failed")
+        self.cb = ctypes.cast(lib.fdv_frag_cb, ctypes.c_void_p)
+        self.cb_ctx = ctypes.c_void_p(self._h)
+        self.meta = np.frombuffer(
+            (ctypes.c_uint64 * (n_slots * _META_NCOL)).from_address(
+                int(lib.fdv_meta_ptr(self._h))),
+            dtype=np.uint64,
+        ).reshape(n_slots, _META_NCOL)
+        n_tail = _TAIL_COUNTERS + len(_COUNTERS)
+        self._tail = np.frombuffer(
+            (ctypes.c_uint64 * n_tail).from_address(
+                int(lib.fdv_counters_ptr(self._h))),
+            dtype=np.uint64,
+        )
+        self.slots = [_SlotViews(lib, self._h, i, batch, max_msg_len)
+                      for i in range(n_slots)]
+        self._next_dispatch = 0  # cyclic = the C acquire order
+
+    # -- intake surface ------------------------------------------------------
+
+    @property
+    def stash_pending(self) -> bool:
+        return bool(self._tail[_TAIL_FLAGS] & 1)
+
+    def can_accept(self) -> bool:
+        """Room for at least one more txn without stashing: the sweep
+        gate — when False the stage reaps/publishes first instead of
+        sweeping frags it would immediately stash.  ONE u64 read (the C
+        side maintains the bit); release()/pump() refresh it."""
+        return bool(self._tail[_TAIL_FLAGS] & 2)
+
+    def append(self, payload: bytes, tsorig: int) -> None:
+        """Per-frag fallback (mixed-lane / lossy splice): forward into
+        the SAME C-side state the sweep callback fills."""
+        self._lib.fdv_append(self._h, payload, len(payload), tsorig)
+
+    def counters(self) -> dict[str, int]:
+        return {name: int(self._tail[_TAIL_COUNTERS + i])
+                for i, name in enumerate(_COUNTERS)}
+
+    # -- batch surface -------------------------------------------------------
+
+    def open_elems(self) -> int:
+        """Elements accumulated in the currently-open slot (0 = none) —
+        the deadline-close probe.  ONE u64 read (the C side maintains
+        the word), cheap enough for before_credit every iteration."""
+        return int(self._tail[_TAIL_OPEN_ELEMS])
+
+    def seal(self) -> None:
+        self._lib.fdv_seal(self._h)
+
+    def pump(self) -> None:
+        self._lib.fdv_pump(self._h)
+
+    def take_sealed(self) -> tuple[int, int, int] | None:
+        """Next sealed slot in ring order as (slot idx, n_elems, n_txn),
+        marked INFLIGHT (python-owned until release); None when the next
+        slot in order is not sealed — dispatch stays in submission
+        order by construction."""
+        i = self._next_dispatch
+        if self.meta[i, 0] != SLOT_SEALED:
+            return None
+        self.meta[i, 0] = SLOT_INFLIGHT
+        self._next_dispatch = (i + 1) % self.n_slots
+        return i, int(self.meta[i, 1]), int(self.meta[i, 2])
+
+    def release(self, slot: int) -> None:
+        self._lib.fdv_slot_release(self._h, slot)
+
+    def close(self) -> None:
+        if self._h:
+            self.meta = self._tail = None
+            self.slots = []
+            self._lib.fdv_stage_delete(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

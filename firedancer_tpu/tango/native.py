@@ -262,6 +262,25 @@ class NativeProducer:
             self._lsp, self._pp, buf, tbl.ctypes.data, n,
         ))
 
+    def publish_burst_raw(self, buf_ptr: int, tbl: np.ndarray,
+                          n: int) -> int:
+        """fdr_publish_burst over frames that already live in native
+        memory (the verify sweep client's slot arenas): buf_ptr is the
+        arena base, tbl an (n, 4) u64 (off, sz, sig, tsorig) table —
+        credit-gated per frame, returns frames published, the tail stays
+        with the caller.  Contract: the caller's frame assembler bounds
+        every sz by the link mtu (fd_verify.cpp frames are TXN_MTU +
+        descriptor, and verify out links carry mtu >= that); the C side
+        trusts the rows."""
+        if not n:
+            return 0
+        if self._lsp is None:
+            raise RuntimeError("detached native producer (link closed)")
+        return int(self._lib.fdr_publish_burst(
+            self._lsp, self._pp, ctypes.cast(buf_ptr, ctypes.c_char_p),
+            tbl.ctypes.data, n,
+        ))
+
     def publish_pool(self, buf: bytes, tbl: np.ndarray, pool_n: int,
                      start_sig: int, n: int) -> int:
         """Cycle a pregenerated pool (joined buffer + (off, sz) rows,

@@ -117,6 +117,25 @@ def enable_compile_cache(path: str | None = None, min_compile_secs: float = 1.0)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", min_compile_secs)
 
 
+def serialize_executable_ok(platform: str) -> bool:
+    """Whether jax.experimental.serialize_executable round-trips on this
+    backend — the warm-boot lane choice (ISSUE 13).
+
+    On accelerator backends the serialized executable IS machine code:
+    a warm boot deserializes in seconds, which is what makes the 10 s
+    `warm_cold_start` budget reachable (a leader that compiles misses
+    its slot).  On XLA:CPU the round trip FAILS ("Symbols not found" at
+    load — the CPU executable references process-local symbols), so CPU
+    keeps the jax.export StableHLO lane: re-optimization is skipped via
+    the persistent cache and only LLVM rehydration remains.
+    FDTPU_FORCE_SERIALIZE_EXEC=1 overrides for debugging on real
+    accelerators that misreport their platform."""
+    force = os.environ.get("FDTPU_FORCE_SERIALIZE_EXEC")
+    if force is not None and force != "0":  # the repo-wide "0 = off" rule
+        return True
+    return platform not in ("cpu", "", None)
+
+
 def serve_cache_dir() -> str:
     """Repo-local persistent cache for the SERVING step's executables,
     partitioned by target fingerprint like default_cache_dir."""

@@ -444,14 +444,20 @@ def test_fdlint_script_runs_clean_over_shipped_tree():
 
 
 def test_fixed_violations_stay_fixed():
-    """The three true positives fdlint found were FIXED, not baselined:
-    their files now lint clean, and the baseline has no entry for them."""
+    """The three true positives fdlint found at introduction were FIXED,
+    not baselined: their files carry no unsuppressed error finding, and
+    the baseline holds ONLY the documented FD214 comb-install exception
+    (ISSUE 13 — see baseline.toml for the reasoning)."""
     for mod in ("runtime/stage.py", "runtime/verify.py",
                 "runtime/pack_stage.py"):
         findings = [f for f in ast_rules.lint_file(os.path.join(PKG, mod))
                     if get_rule(f.rule).severity == "error"]
-        assert findings == [], f"{mod}: {[f.format() for f in findings]}"
-    assert bl.load_baseline() == {}
+        bl.apply_baseline(findings, bl.load_baseline())
+        live = [f for f in findings if not f.suppressed]
+        assert live == [], f"{mod}: {[f.format() for f in live]}"
+    assert set(bl.load_baseline()) == {
+        ("firedancer_tpu/runtime/verify.py", "FD214"),
+    }
 
 
 def test_stage_housekeeping_phase_survives_hash_salt():
@@ -852,3 +858,88 @@ def test_fd213_registered_and_clean_on_repo():
                             "firedancer_tpu", "runtime", rel)
         findings = ast_rules.lint_path(root)
         assert [f for f in findings if f.rule == "FD213"] == []
+
+
+# -- FD214: device sync outside the designated reap point ---------------------
+
+
+_VERIFY_SYNC_SRC = '''
+import numpy as np
+
+class VerifyStage:
+    def _accumulate(self, got, payload, tsorig):
+        n = int(np.asarray(self._count))          # FD214: sync in intake
+        self._elems.append(got)
+
+    def _submit(self, acc, cached):
+        res = self._dispatch(acc, cached)
+        res.block_until_ready()                   # FD214: sync at submit
+        self._inflight.append(res)
+
+    def during_housekeeping(self):
+        v = self._probe.item()                    # FD214: sync in hk
+        self._log(v)
+
+    def _drain(self, block):
+        mask = np.asarray(self._inflight[0].result)   # ok: THE reap point
+        return mask
+
+    def _result_mask(self, head):
+        return np.asarray(head.result)            # ok: reap hook
+
+    def flush(self):
+        return np.asarray(self._tail)             # ok: shutdown drain
+
+    def after_frag(self, in_idx, meta, payload):
+        x = np.asarray(meta)                      # FD201 territory, not 214
+        return x
+
+
+class ShardedVerifyStage(VerifyStage):
+    def _close_batch(self, acc=None):
+        n_ok = int(np.asarray(self._pend.n_ok))   # FD214: subclass inherits
+        return n_ok
+
+
+class UnrelatedHelper:
+    def _submit(self):
+        return np.asarray(self._x)                # not a verify-stage class
+'''
+
+
+def test_fd214_flags_sync_outside_reap_point():
+    findings = ast_rules.lint_source(
+        _VERIFY_SYNC_SRC, "firedancer_tpu/runtime/verify.py")
+    hits = [f for f in findings if f.rule == "FD214"]
+    msgs = [f.msg for f in hits]
+    assert len(hits) == 4, msgs
+    assert any("_accumulate" in m for m in msgs)
+    assert any("_submit" in m for m in msgs)
+    assert any("during_housekeeping" in m for m in msgs)
+    assert any("_close_batch" in m for m in msgs)  # subclass inherits
+    # the frag callback is FD201's jurisdiction, not re-flagged as FD214
+    assert not any("after_frag" in m for m in msgs)
+    assert any(f.rule == "FD201" for f in findings)
+
+
+def test_fd214_scoped_to_verify_path_modules():
+    # the identical body elsewhere is not FD214's business
+    findings = ast_rules.lint_source(
+        _VERIFY_SYNC_SRC, "firedancer_tpu/runtime/bank.py")
+    assert [f for f in findings if f.rule == "FD214"] == []
+
+
+def test_fd214_registered_and_baselined_on_repo():
+    assert "FD214" in {r.id for r in all_rules()}
+    # the repo's verify path carries exactly the two baselined
+    # _fill_bank hits (deliberate comb-install sync, documented in
+    # baseline.toml) and nothing else
+    for rel, allowed in (("runtime/verify.py", 2),
+                         ("parallel/serve.py", 0),
+                         ("runtime/verify_native.py", 0)):
+        root = os.path.join(os.path.dirname(__file__), "..",
+                            "firedancer_tpu", rel)
+        findings = [f for f in ast_rules.lint_path(root)
+                    if f.rule == "FD214"]
+        assert len(findings) == allowed, (rel, findings)
+        assert all("_fill_bank" in f.msg for f in findings)

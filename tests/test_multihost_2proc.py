@@ -19,6 +19,15 @@ def _free_port() -> int:
     return port
 
 
+@pytest.mark.skipif(
+    (os.cpu_count() or 1) <= 1 and not os.environ.get("FDTPU_RUN_MULTIHOST"),
+    reason="needs >= 2 cores: two jax.distributed processes spin-wait on"
+           " each other's collectives, and on a 1-core (cgroup-limited)"
+           " box the coordinator handshake starves until the 240 s"
+           " timeout — a box limitation, not a code failure (ISSUE 13;"
+           " set FDTPU_RUN_MULTIHOST=1 to force).  CI runners have >= 2"
+           " cores and keep running it.",
+)
 @pytest.mark.timeout(300)
 def test_two_process_coordinator_and_collectives():
     coordinator = f"127.0.0.1:{_free_port()}"

@@ -7,6 +7,7 @@ verify kernel (the full single-program serving step) is slow-tier, the
 same line test_sigverify/test_parallel draw.
 """
 
+import os
 import time
 
 import numpy as np
@@ -283,3 +284,109 @@ def test_serving_step_byte_identical_to_single_device():
     assert (got[real] == expect[real]).all()
     assert not got[~real].any()
     assert int(np.asarray(pend.n_ok)) == int(expect[real].sum())
+
+
+# -- warm-boot lane selection (ISSUE 13) --------------------------------------
+#
+# The serialize_executable path is accelerator-only: on CPU the
+# executable round trip fails ("Symbols not found"), so CPU must keep
+# the jax.export lane while a real chip picks the serialized-executable
+# lane and the 10 s warm_cold_start budget.  The selection (not the TPU
+# serialization itself, which cannot run here) is what these pin.
+
+
+def test_warmboot_lane_selection_cpu_vs_accel(tmp_path, monkeypatch):
+    from firedancer_tpu.utils import platform as fp
+
+    assert not fp.serialize_executable_ok("cpu")
+    assert fp.serialize_executable_ok("tpu")
+    assert fp.serialize_executable_ok("gpu")
+    monkeypatch.setenv("FDTPU_FORCE_SERIALIZE_EXEC", "1")
+    assert fp.serialize_executable_ok("cpu")  # debug override
+
+
+def test_plane_selects_export_lane_on_cpu(tiny_plane):
+    assert tiny_plane._mesh_platform() == "cpu"
+    assert not tiny_plane._use_serialized_executable()
+
+
+@pytest.fixture
+def swap_cache_dir(tmp_path):
+    """Point jax's compilation-cache config at a temp dir for one test
+    (jax.config attrs are read-only properties: update() + restore)."""
+    import jax
+
+    prev = jax.config.jax_compilation_cache_dir
+    cache = str(tmp_path)
+    jax.config.update("jax_compilation_cache_dir", cache)
+    yield cache
+    jax.config.update("jax_compilation_cache_dir", prev)
+
+
+def test_plane_warm_boot_loads_serialized_executable(swap_cache_dir,
+                                                      monkeypatch):
+    """On a (simulated) accelerator mesh, a warm boot is pure
+    deserialization: no export, no compile.  The blob machinery and
+    the lane wiring are real; only the backend serializer is stubbed —
+    it cannot run on CPU by design."""
+    import pickle
+
+    import jax
+
+    plane = ServePlane(TINY)
+    monkeypatch.setattr(plane, "_use_serialized_executable", lambda: True)
+    monkeypatch.setattr(type(plane), "_mesh_platform",
+                        lambda self: "faketpu")
+    cache = swap_cache_dir
+    blob = plane._exec_blob_path(cache)
+    assert "faketpu" in os.path.basename(blob)
+    sentinel = object()
+    calls = {}
+
+    def fake_load(payload, in_tree, out_tree):
+        calls["args"] = (payload, in_tree, out_tree)
+        return sentinel
+
+    from jax.experimental import serialize_executable as se
+
+    monkeypatch.setattr(se, "deserialize_and_load", fake_load)
+    with open(blob, "wb") as f:
+        pickle.dump((b"exec-bytes", "in-tree", "out-tree"), f)
+
+    def boom(cache_dir):  # a warm boot must never reach the compiler
+        raise AssertionError("export/compile lane entered on warm boot")
+
+    monkeypatch.setattr(plane, "_warmup_export", boom)
+    compile_s = plane.warmup()
+    assert plane._aot is sentinel
+    assert calls["args"] == (b"exec-bytes", "in-tree", "out-tree")
+    assert compile_s < 5.0  # deserialization, not compilation
+
+
+def test_plane_cold_boot_serializes_executable(swap_cache_dir, monkeypatch):
+    """Cold boot on an accelerator: compile through the export lane
+    once, then persist the serialized executable for the next boot."""
+    import pickle
+
+    import jax
+
+    plane = ServePlane(TINY)
+    monkeypatch.setattr(plane, "_use_serialized_executable", lambda: True)
+    monkeypatch.setattr(type(plane), "_mesh_platform",
+                        lambda self: "faketpu")
+    cache = swap_cache_dir
+    compiled = object()
+
+    def fake_export(cache_dir):
+        plane._aot = compiled
+
+    from jax.experimental import serialize_executable as se
+
+    monkeypatch.setattr(plane, "_warmup_export", fake_export)
+    monkeypatch.setattr(
+        se, "serialize", lambda aot: (b"xc", "it", "ot"))
+    plane.warmup()
+    blob = plane._exec_blob_path(cache)
+    assert os.path.exists(blob)
+    with open(blob, "rb") as f:
+        assert pickle.load(f) == (b"xc", "it", "ot")
