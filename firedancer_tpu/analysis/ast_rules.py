@@ -110,6 +110,19 @@ _FD214_SYNC_CALLS = frozenset({
     ("np", "asarray"), ("np", "array"), ("jax", "device_get"),
 })
 
+# FD215: blocking waits in the stage loop's hot hooks.  The slot-clock
+# plane (runtime/slot_clock) is the only sanctioned deadline authority;
+# a time.sleep (or an unbounded zero-arg .wait()/.join()/.acquire()) in
+# a frag callback OR a loop hook (before_credit / after_credit /
+# during_housekeeping) stalls every link the stage serves and makes its
+# slots unpaceable.  The loop hooks are included because they run every
+# run_once sweep — a sleep there is a sleep in the hot loop even though
+# no frag is in hand.
+_HOT_HOOKS = frozenset({
+    "during_housekeeping", "before_credit", "after_credit",
+})
+_FD215_BLOCKING_ATTRS = frozenset({"wait", "join", "acquire"})
+
 
 def _fd208_offender(arg: ast.AST) -> str | None:
     """Why `arg` allocates/formats, or None if it looks scalar-cheap."""
@@ -247,6 +260,7 @@ class _Linter(ast.NodeVisitor):
         self.path = path
         self.findings: list[Finding] = []
         self._frag_depth = 0  # >0 while inside a frag-callback body
+        self._hook_depth = 0  # >0 inside a loop hook (FD215 scope)
         self._func_stack: list[ast.FunctionDef] = []
         self._mods = mods or {}  # import alias -> canonical module
         self._funcs = funcs or {}  # from-imported name -> (module, func)
@@ -321,6 +335,7 @@ class _Linter(ast.NodeVisitor):
 
     def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
         is_frag = node.name in FRAG_CALLBACKS and self._in_class()
+        is_hook = node.name in _HOT_HOOKS and self._in_class()
         # FD214 method attribution: a def directly inside a verify-stage
         # class opens a method scope; nested defs inherit it
         opens_method = (
@@ -332,9 +347,13 @@ class _Linter(ast.NodeVisitor):
         self._func_stack.append(node)
         if is_frag:
             self._frag_depth += 1
+        if is_hook:
+            self._hook_depth += 1
         self.generic_visit(node)
         if is_frag:
             self._frag_depth -= 1
+        if is_hook:
+            self._hook_depth -= 1
         self._func_stack.pop()
         if opens_method:
             self._fd214_method.pop()
@@ -371,6 +390,8 @@ class _Linter(ast.NodeVisitor):
         mf = self._resolve(node)
         if self._frag_depth:
             self._check_frag_call(node, mf)
+        if self._frag_depth or self._hook_depth:
+            self._check_fd215(node, mf)
         self._check_fd214(node, mf)
         if mf and mf[0] == "random" and mf[1] in _RANDOM_GLOBALS:
             self.hit("FD203", node,
@@ -386,6 +407,34 @@ class _Linter(ast.NodeVisitor):
             self._check_chaos_entropy(node)
         self._check_builder_arg(node)
         self.generic_visit(node)
+
+    def _check_fd215(self, node: ast.Call,
+                     mf: tuple[str, str] | None) -> None:
+        """FD215: blocking sleep/wait inside a frag callback or loop
+        hook.  time.sleep anywhere in them is a hard hit; a zero-arg
+        .wait()/.join()/.acquire() is the unbounded-blocking shape
+        (str.join(iterable) and bounded waits carry arguments, so they
+        never match).  The slot-clock plane is the only deadline
+        authority — waiting means returning and re-checking the clock
+        next sweep."""
+        if mf == ("time", "sleep"):
+            where = ("frag callback" if self._frag_depth
+                     else "stage-loop hook")
+            self.hit("FD215", node,
+                     f"time.sleep in a {where}: the stage loop must"
+                     " never block — pace against runtime/slot_clock and"
+                     " return until due")
+            return
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr in _FD215_BLOCKING_ATTRS
+                and not node.args and not node.keywords):
+            where = ("frag callback" if self._frag_depth
+                     else "stage-loop hook")
+            self.hit("FD215", node,
+                     f"unbounded .{node.func.attr}() in a {where}:"
+                     " zero-arg wait/join/acquire blocks the stage loop"
+                     " indefinitely — bound it and move it off the hot"
+                     " loop (the slot clock is the deadline authority)")
 
     def _check_fd214(self, node: ast.Call,
                      mf: tuple[str, str] | None) -> None:

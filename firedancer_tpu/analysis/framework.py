@@ -203,6 +203,16 @@ FD214 = _rule(
     " device results — a sync anywhere else (intake, batching, submit,"
     " housekeeping) quietly serializes the window back to depth 1",
 )
+FD215 = _rule(
+    "FD215", "blocking-wait-in-hot-hook", SEV_ERROR,
+    "blocking sleep/wait (time.sleep, zero-arg .wait()/.join()/.acquire())"
+    " inside a frag callback or a stage-loop hook (before_credit,"
+    " after_credit, during_housekeeping): the slot-clock plane"
+    " (runtime/slot_clock) is the only sanctioned deadline authority — a"
+    " stage that sleeps in its loop stalls every link it serves and"
+    " cannot be paced, sealed, or missed on the schedule; wait by"
+    " RETURNING from the hook and re-checking the clock next sweep",
+)
 FD213 = _rule(
     "FD213", "hash-alloc-in-shred-frag", SEV_ERROR,
     "per-frag hashing or bytes assembly (hashlib/merkle-helper call,"

@@ -32,6 +32,16 @@ Catalog (docs/OPERATIONS.md has the runbook):
   stage-kill        SIGKILL a pipeline stage mid-run under the process
                     supervisor: fail-fast, flight dump written, every
                     shm segment reclaimed, clean restart
+  slot-overrun      the FULL leader topology against a compressed
+                    slot-clock cadence with poh frozen across two
+                    boundaries: healthy slots seal at their deadlines
+                    (jitter bounded), the overrun becomes slot_missed
+                    VALUES + clean continuation, the handoff fires on
+                    the schedule, and no txn is lost
+  crash-mid-slot    SIGKILL a relay twice mid-slot under a restart
+                    policy: in-place respawn against the live rings,
+                    exactly-once stream diff, slots keep sealing; a
+                    crash-looping relay degrades to fail-fast + dump
   partition-heal    CLUSTER: 4 full validators over the real wire, the
                     cluster split across a leader rotation so both
                     halves fork, then healed: one heaviest fork, bank
@@ -60,6 +70,7 @@ from firedancer_tpu.chaos import faults as cf
 from firedancer_tpu.chaos import invariants as inv
 from firedancer_tpu.runtime.stage import Stage
 from firedancer_tpu.tango import shm
+from firedancer_tpu.tango.rings import MCache
 from firedancer_tpu.utils import metrics as fm
 from firedancer_tpu.utils.rng import Rng
 
@@ -848,6 +859,413 @@ def run_stage_kill(seed: int = 0, duration: float = 30.0, *,
 
 
 # =============================================================================
+# slot-overrun: the leader topology against the real wall-clock cadence
+# =============================================================================
+
+
+def run_slot_overrun(seed: int = 0, duration: float = 120.0, *,
+                     n_txns: int = 96, n_slots: int = 8,
+                     slot_ms: float = 500.0,
+                     boot_grace_s: float = 20.0) -> ScenarioResult:
+    """The FULL leader process topology under a compressed slot cadence
+    with an induced overrun: poh is SIGSTOPped across two slot
+    boundaries mid-window.  The slot-clock plane must (a) seal every
+    healthy slot at its deadline with bounded jitter, (b) turn the
+    frozen boundaries into `slot_missed` VALUES — flight events +
+    metrics, never a hang — and continue cleanly, (c) close the leader
+    window ON THE SCHEDULE (handoff fires at the last deadline, not at
+    drain), and (d) lose no txn: the deadline block close carries the
+    unscheduled tail across boundaries (shedding stays disarmed here, so
+    zero drops is exact).
+
+    (duration bounds the supervisor wait; the run's length is the
+    anchored window: boot_grace_s + n_slots * slot_ms.)"""
+    from firedancer_tpu.models.leader_topo import (
+        build_leader_topology,
+        leader_window_done,
+    )
+    from firedancer_tpu.runtime import topo as ft
+    from firedancer_tpu.runtime.slot_clock import SlotClockCfg
+
+    suite = inv.InvariantSuite()
+    t_s = slot_ms / 1e3
+    # anchor HERE so the fault schedule can fire at slot-relative
+    # offsets from the same epoch the stages pace against
+    cfg = SlotClockCfg(slot_ms=slot_ms, slot0=1, ticks_per_slot=8,
+                       n_slots=n_slots,
+                       miss_grace_frac=0.25).anchored(boot_grace_s)
+    # verify runs precomputed: the cadence/recovery machinery under test
+    # is host-side, and a child cold-compiling the sigverify kernel
+    # would eat the anchored window on a slow box (the device lane has
+    # its own differential + kernel-ladder coverage)
+    topo = build_leader_topology(
+        n_txns=n_txns, pool_size=n_txns, batch=16, slot_clock=cfg,
+        verify_precomputed=True,
+    )
+    h = ft.launch(topo)
+    names = h.shm_names()
+    info: dict = {}
+    try:
+        # freeze poh from 60% into slot 1 until 40% into slot 3: the
+        # boundaries of slots 1 and 2 (plus grace) pass while it is
+        # stopped -> exactly two missed slots, with >= 0.35*slot_ms of
+        # scheduling margin on every edge
+        faults = [cf.FreezeStage("poh", at_s=0.6 * t_s),
+                  cf.ThawStage("poh", at_s=2.4 * t_s)]
+        injector = cf.FaultInjector(faults).arm(t0=cfg.t0_ns / 1e9)
+        ok = h.supervise(
+            until=leader_window_done(n_slots),
+            timeout_s=min(duration, boot_grace_s + n_slots * t_s + 60),
+            heartbeat_timeout_s=30.0, on_poll=injector,
+        )
+        window_end_lag_s = time.monotonic() - (
+            cfg.t0_ns / 1e9 + n_slots * t_s)
+        suite.check("fault-schedule-fired", injector.all_fired())
+        suite.check("window-closed-on-supervisor", ok,
+                    f"supervise failed (failed={h.failed!r})")
+        reg = h.met_views["poh"][0]
+        sealed = reg.get("slots_sealed")
+        missed = reg.get("slot_missed")
+        suite.check("every-slot-resolved", sealed + missed == n_slots,
+                    f"sealed {sealed} + missed {missed} != {n_slots}")
+        suite.check("overrun-became-missed-slots", missed == 2,
+                    f"missed {missed} != 2 (freeze spanned 2 boundaries)")
+        suite.check("healthy-slots-sealed", sealed == n_slots - 2)
+        # handoff on the schedule: the window closed within a few polls
+        # of the last deadline — drain state cannot stretch it
+        suite.check("handoff-on-schedule",
+                    0 <= window_end_lag_s < max(2.0, t_s),
+                    f"window end lag {window_end_lag_s:.2f}s")
+        # seal jitter bounded: every seal landed inside the grace window
+        # (the histogram's upper tail is the proof)
+        lag_hist = reg.hist("slot_seal_lag_ns")
+        p99 = fm.hist_quantile(lag_hist, 0.99)
+        suite.check("seal-jitter-bounded",
+                    lag_hist["count"] == sealed
+                    and p99 <= cfg.miss_grace_frac * slot_ms * 1e6,
+                    f"seal lag p99 {p99 / 1e6:.1f}ms over grace")
+        # zero loss across the boundaries: nothing dropped or shed at
+        # pack, everything pack scheduled landed at the bank, and the
+        # missed slots cost ticks, not txns
+        preg = h.met_views["pack"][0]
+        breg = h.met_views["bank0"][0]
+        # settle: the window closes on the SCHEDULE, so a microblock can
+        # be in flight between pack and bank at that instant (and the
+        # registries flush on lazy housekeeping) — give the in-flight
+        # work a bounded moment to land before reconciling counters
+        settle_end = time.monotonic() + 10.0
+        while time.monotonic() < settle_end:
+            if (preg.get("txn_in") == n_txns
+                    and preg.get("txn_scheduled")
+                    == breg.get("txn_exec") + breg.get("txn_rejected")):
+                break
+            time.sleep(0.05)
+        suite.check("traffic-flowed-through-the-window",
+                    preg.get("txn_in") == n_txns,
+                    f"pack accepted {preg.get('txn_in')}/{n_txns}")
+        suite.check("no-txn-dropped-or-shed",
+                    preg.get("txn_dropped") == 0
+                    and preg.get("txn_shed") == 0)
+        suite.check("deadline-close-carried-tail",
+                    preg.get("blocks_closed") >= 1,
+                    "pack never observed a slot boundary")
+        suite.check("scheduled-equals-landed",
+                    preg.get("txn_scheduled")
+                    == breg.get("txn_exec") + breg.get("txn_rejected"),
+                    f"pack {preg.get('txn_scheduled')} vs bank"
+                    f" {breg.get('txn_exec')}+{breg.get('txn_rejected')}")
+        # the flight ring carries the first-class events
+        rec = h.met_views["poh"][1]
+        evs = [r[1] for r in rec.records()]
+        suite.check("slot-events-on-flight-ring",
+                    fm.EV_SLOT_SEAL in evs and fm.EV_SLOT_MISSED in evs)
+        info = {
+            "n_slots": n_slots,
+            "sealed": sealed,
+            "missed": missed,
+            "txn_in_pack": preg.get("txn_in"),
+            "txn_scheduled": preg.get("txn_scheduled"),
+            "txn_landed": breg.get("txn_exec"),
+            # blocks_closed is asserted >= 1 above but kept OUT of the
+            # deterministic summary: whether the final (post-window)
+            # close is observed before halt is a scheduling race
+            "faults": [f.describe() for f in faults],
+        }
+        h.halt()
+    finally:
+        result = ScenarioResult("slot-overrun", seed, suite, info)
+        if not suite.ok:
+            _capture_trace_from_dump(result, h.dump_flight(
+                "slot-overrun invariant violation"))
+        h.close()
+    inv.check_shm_reclaimed(suite, names)
+    return result
+
+
+# =============================================================================
+# crash-mid-slot: in-place restart under the slot clock
+# =============================================================================
+
+
+class SlotGenStage(Stage):
+    """Source stage whose progress is DURABLE in its own ring: on an
+    in-place restart it resumes from the producer's recovered seq (sig
+    == counter), the source-stage half of the resume contract."""
+
+    def __init__(self, *args, limit=100_000, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.limit = limit
+        self._sent = 0
+
+    def resume_from_rings(self) -> None:
+        super().resume_from_rings()
+        self._sent = self.outs[0].seq
+
+    def after_credit(self) -> None:
+        for _ in range(max(1, self.burst)):
+            if self._sent >= self.limit:
+                return
+            if not self.publish(0, b"slot-frag-%06d" % self._sent,
+                                sig=self._sent):
+                return
+            self._sent += 1
+
+
+class CrashLoopRelayStage(Stage):
+    """Deterministically dies on every frag past `crash_at` — the
+    crash-loop flank: restarts can never help, so the supervisor must
+    exhaust the policy and degrade to fail-fast + flight dump."""
+
+    def __init__(self, *args, crash_at=16, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.require_credit = True
+        self.crash_at = crash_at
+
+    def after_frag(self, in_idx, meta, payload) -> None:
+        if int(meta[MCache.COL_SIG]) >= self.crash_at:
+            os._exit(42)  # a hard death, like SIGKILL (no FAIL record)
+        self.publish(0, payload, sig=int(meta[MCache.COL_SIG]),
+                     tsorig=int(meta[MCache.COL_TSORIG]))
+
+
+class CreditRelayStage(Stage):
+    """ChaosRelayStage with require_credit: never consumes a frag it
+    cannot forward — the lossless relay the exactly-once diff needs."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.require_credit = True
+
+    def after_frag(self, in_idx, meta, payload) -> None:
+        self.publish(0, payload, sig=int(meta[MCache.COL_SIG]),
+                     tsorig=int(meta[MCache.COL_TSORIG]))
+
+
+def _b_slot_gen(links, cnc, *, limit):
+    return SlotGenStage("gen", outs=[shm.make_producer(links["gr"])],
+                        cnc=cnc, limit=limit)
+
+
+def _b_credit_relay(links, cnc):
+    # the observer consumer (fseq 1) is reliable too: the relay
+    # backpressures rather than laps it, so the parent-side stream diff
+    # sees every frag
+    return CreditRelayStage(
+        "relay", ins=[shm.make_consumer(links["gr"], lazy=8)],
+        outs=[shm.make_producer(links["rs"], reliable_fseq_idx=[0, 1])],
+        cnc=cnc)
+
+
+def _b_crashloop_relay(links, cnc, *, crash_at):
+    return CrashLoopRelayStage(
+        "relay", ins=[shm.make_consumer(links["gr"], lazy=8)],
+        outs=[shm.make_producer(links["rs"], reliable_fseq_idx=[0, 1])],
+        cnc=cnc, crash_at=crash_at)
+
+
+def _b_slot_poh(links, cnc, *, clock):
+    from firedancer_tpu.runtime.poh_stage import PohStage
+
+    stage = PohStage("poh", outs=[shm.make_producer(links["ps"])],
+                     cnc=cnc, clock=clock)
+    stage.require_credit = True
+    return stage
+
+
+def _b_ps_sink(links, cnc):
+    return ChaosSinkStage("psink",
+                          ins=[shm.make_consumer(links["ps"], lazy=8)],
+                          cnc=cnc)
+
+
+def _b_rs_sink(links, cnc):
+    return ChaosSinkStage("sink",
+                          ins=[shm.make_consumer(links["rs"], lazy=8)],
+                          cnc=cnc)
+
+
+def _crash_mid_slot_topology(limit: int, clock, relay_builder,
+                             **relay_kw):
+    from firedancer_tpu.runtime import topo as ft
+    from firedancer_tpu.runtime.poh_stage import PohStage
+
+    topo = ft.Topology()
+    topo.link("gr", depth=256, mtu=64)
+    topo.link("rs", depth=256, mtu=64, n_consumers=2)
+    topo.link("ps", depth=512, mtu=65536)
+    topo.stage("gen", _b_slot_gen, limit=limit, outs=["gr"],
+               restartable=True)
+    topo.stage("relay", relay_builder, ins=["gr"], outs=["rs"],
+               restartable=True, **relay_kw)
+    topo.stage("sink", _b_rs_sink, ins=["rs"])
+    topo.stage("poh", _b_slot_poh, clock=clock, outs=["ps"],
+               credit_gated=True, schema=PohStage.metrics_schema())
+    topo.stage("psink", _b_ps_sink, ins=["ps"])
+    return topo
+
+
+def run_crash_mid_slot(seed: int = 0, duration: float = 60.0, *,
+                       n_frags: int = 4000, n_slots: int = 6,
+                       slot_ms: float = 300.0,
+                       boot_grace_s: float = 5.0) -> ScenarioResult:
+    """SIGKILL a relay stage TWICE mid-slot while a slot-clocked poh
+    stage runs in the same topology: the supervisor's restart policy
+    must respawn the relay in place against its live rings — the
+    parent-side stream diff proves exactly-once (no frag lost,
+    duplicated or reordered across both kills) — while the slot clock
+    keeps sealing every slot on schedule (a stage crash costs work, not
+    time).  Flank: a crash-LOOPING relay exhausts the bounded attempts
+    and degrades to the fail-fast + flight-dump path.
+
+    (duration bounds the supervisor wait; the run is bounded by the
+    anchored slot window and the frag count.)"""
+    from firedancer_tpu.runtime import topo as ft
+    from firedancer_tpu.runtime.restart import RestartPolicy
+    from firedancer_tpu.runtime.slot_clock import SlotClockCfg
+
+    suite = inv.InvariantSuite()
+    info: dict = {}
+    t_s = slot_ms / 1e3
+    cfg = SlotClockCfg(slot_ms=slot_ms, slot0=1, ticks_per_slot=4,
+                       n_slots=n_slots,
+                       miss_grace_frac=0.25).anchored(boot_grace_s)
+    policy = RestartPolicy(max_restarts=3, backoff_base_s=0.03,
+                           seed=seed)
+    topo = _crash_mid_slot_topology(n_frags, cfg, _b_credit_relay)
+    h = ft.launch(topo)
+    names = h.shm_names()
+    got: list[int] = []
+    payloads: list[bytes] = []
+    obs = shm.Consumer(h.links["rs"], fseq_idx=1, lazy=4)
+
+    def drain_obs(hh) -> None:
+        while True:
+            r = obs.poll()
+            if not isinstance(r, tuple):
+                break
+            got.append(int(r[0][1]))
+            payloads.append(bytes(r[1]))
+
+    # kills are PROGRESS-gated, not wall-gated: a fast box drains the
+    # whole stream during the boot grace, and a wall-offset kill would
+    # then hit an idle relay — the exactly-once diff must be proven
+    # against a LIVE replay window, so each kill fires only while the
+    # stream is demonstrably mid-flight
+    kill_at = (n_frags // 4, n_frags // 2)
+    kills_fired: list[int] = []
+
+    def on_poll(hh) -> None:
+        drain_obs(hh)
+        k = len(kills_fired)
+        if k < len(kill_at) and kill_at[k] <= len(got) < n_frags:
+            kills_fired.append(len(got))
+            hh.kill_stage("relay")
+
+    try:
+        def done(hh) -> bool:
+            reg = hh.met_views["poh"][0]
+            return (len(got) >= n_frags
+                    and reg.get("slots_sealed")
+                    + reg.get("slot_missed") >= n_slots)
+
+        ok = h.supervise(
+            until=done,
+            timeout_s=min(duration, boot_grace_s + n_slots * t_s + 45),
+            heartbeat_timeout_s=20.0, on_poll=on_poll, restart=policy)
+        drain_obs(h)
+        suite.check("both-kills-fired", len(kills_fired) == 2,
+                    f"fired at {kills_fired} of {kill_at}")
+        suite.check("kills-landed-mid-stream",
+                    all(k < n_frags for k in kills_fired),
+                    f"fired at {kills_fired} with the stream drained")
+        suite.check("supervisor-survived-both-kills", ok,
+                    f"supervise failed (failed={h.failed!r})")
+        suite.check("relay-restarted-in-place",
+                    h.restarts.get("relay", 0) == 2,
+                    f"restarts: {h.restarts}")
+        suite.check("no-flight-dump-on-recovery",
+                    h.flight_dump_path is None)
+        suite.check("exactly-once-no-loss",
+                    sorted(set(got)) == list(range(n_frags)),
+                    f"{len(set(got))} unique of {n_frags}")
+        suite.check("exactly-once-no-dup", len(got) == len(set(got)),
+                    f"{len(got) - len(set(got))} duplicates")
+        suite.check("stream-order-preserved", got == sorted(got))
+        reg = h.met_views["poh"][0]
+        sealed, missed = reg.get("slots_sealed"), reg.get("slot_missed")
+        suite.check("crash-cost-no-slots",
+                    sealed == n_slots and missed == 0,
+                    f"sealed {sealed} missed {missed} of {n_slots}")
+        info = {
+            "n_frags": n_frags,
+            "restarts": h.restarts.get("relay", 0),
+            "restart_schedule_ms": [
+                round(d * 1e3, 3) for d in policy.schedule("relay")],
+            "slots_sealed": sealed,
+            "stream_digest": inv.payload_digest(payloads),
+            # the gate thresholds, not the exact fire offsets (those
+            # depend on scheduling and would break the same-seed diff)
+            "faults": [f"kill:relay@>={k}frags" for k in kill_at],
+        }
+        h.halt()
+    finally:
+        result = ScenarioResult("crash-mid-slot", seed, suite, info)
+        if not suite.ok:
+            _capture_trace_from_dump(result, h.dump_flight(
+                "crash-mid-slot invariant violation"))
+        del obs
+        h.close()
+    inv.check_shm_reclaimed(suite, names)
+
+    # crash-loop flank: a relay that ALWAYS dies exhausts the bounded
+    # attempts and degrades to the existing fail-fast + flight dump
+    cfg2 = SlotClockCfg(slot_ms=slot_ms, slot0=1, ticks_per_slot=4,
+                        n_slots=n_slots).anchored(1.0)
+    pol2 = RestartPolicy(max_restarts=2, backoff_base_s=0.02, seed=seed)
+    topo2 = _crash_mid_slot_topology(256, cfg2, _b_crashloop_relay,
+                                     crash_at=16)
+    h2 = ft.launch(topo2)
+    names2 = h2.shm_names()
+    try:
+        ok2 = h2.supervise(until=lambda hh: False, timeout_s=30,
+                           heartbeat_timeout_s=20.0, restart=pol2)
+        suite.check("crash-loop-fails-fast", ok2 is False)
+        suite.check("crash-loop-victim-identified", h2.failed == "relay")
+        suite.check("crash-loop-attempts-bounded",
+                    h2.restarts.get("relay") == pol2.max_restarts,
+                    f"restarts: {h2.restarts}")
+        dump_ok = bool(h2.flight_dump_path
+                       and os.path.exists(h2.flight_dump_path))
+        suite.check("crash-loop-flight-dump-written", dump_ok)
+        info["crash_loop_restarts"] = h2.restarts.get("relay", 0)
+    finally:
+        h2.close()
+    inv.check_shm_reclaimed(suite, names2, prefix="crash-loop-")
+    return ScenarioResult("crash-mid-slot", seed, suite, info,
+                          result.artifacts)
+
+
+# =============================================================================
 # cluster scenarios (chaos/cluster.ClusterHarness: N full validators
 # over the real loopback wire — gossip discovery, wsample leader
 # rotation, turbine fan-out, repair, choreo voting)
@@ -1145,6 +1563,8 @@ SCENARIOS = {
     "fork-storm": run_fork_storm,
     "leader-handoff": run_leader_handoff,
     "stage-kill": run_stage_kill,
+    "slot-overrun": run_slot_overrun,
+    "crash-mid-slot": run_crash_mid_slot,
     "partition-heal": run_cluster_partition_heal,
     "laggard-catchup": run_cluster_laggard_catchup,
     "leader-rotation": run_cluster_leader_rotation,

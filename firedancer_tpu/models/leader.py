@@ -172,12 +172,28 @@ def build_leader_pipeline(
     keep_entries: bool = False,
     keep_sets: bool = True,
     native_pack: bool | None = None,
+    slot_clock=None,
+    shed_keep: int | None = None,
 ) -> LeaderPipeline:
     """keep_sets=False releases the shred stage from materializing
     FecSets in Python, which lets it adopt the zero-Python sweep lane
     (bench uses this; tests that read pipe.shred.sets keep the
-    default)."""
+    default).
+
+    slot_clock (runtime/slot_clock.SlotClockCfg or a built SlotClock)
+    runs the pipeline against the real wall-clock slot cadence: poh
+    paces ticks to the deadline and seals/misses slots on schedule,
+    pack closes the block at each boundary (the unscheduled tail
+    carries over; shed_keep arms the load-shedding degraded mode), and
+    the banks observe the boundaries."""
     use_native_pack = resolve_native_pack(native_pack)
+    if slot_clock is not None:
+        from firedancer_tpu.runtime.slot_clock import SlotClockCfg
+
+        if isinstance(slot_clock, SlotClockCfg):
+            # ONE anchor for every stage: each resolve_clock below then
+            # derives identical boundaries from the same epoch
+            slot_clock = slot_clock.anchored()
     uid = shm.fresh_uid()
     links = []
 
@@ -230,6 +246,8 @@ def build_leader_pipeline(
             outs=[shm.make_producer(l) for l in pack_bank],
             bank_cnt=n_bank,
             n_txn_ins=n_verify,
+            clock=slot_clock,
+            shed_keep=shed_keep,
         )
     else:
         dedup = DedupStage(
@@ -243,6 +261,8 @@ def build_leader_pipeline(
             + [shm.make_consumer(l, lazy=8) for l in bank_done],
             outs=[shm.make_producer(l) for l in pack_bank],
             bank_cnt=n_bank,
+            clock=slot_clock,
+            shed_keep=shed_keep,
         )
     # ONE live bank shared by every bank stage (the Frankendancer shape:
     # all bank tiles commit into the same Agave bank over the FFI)
@@ -255,6 +275,7 @@ def build_leader_pipeline(
             outs=[shm.make_producer(bank_poh[b]), shm.make_producer(bank_done[b])],
             bank_idx=b,
             ctx=bank_ctx,
+            clock=slot_clock,
         )
         for b in range(n_bank)
     ]
@@ -264,6 +285,7 @@ def build_leader_pipeline(
         "poh",
         ins=[shm.make_consumer(l, lazy=8) for l in bank_poh],
         outs=[shm.make_producer(poh_shred)],
+        clock=slot_clock,
     )
     poh.require_credit = True
     if keep_entries:

@@ -40,8 +40,9 @@ def build_benchg(links, cnc, *, pool_size, n_txns):
     )
 
 
-def build_verify(links, cnc, *, batch):
-    _cpu()
+def build_verify(links, cnc, *, batch, precomputed=False):
+    if not precomputed:
+        _cpu()
     from firedancer_tpu.runtime.verify import VerifyStage
 
     return VerifyStage(
@@ -52,6 +53,7 @@ def build_verify(links, cnc, *, batch):
         batch=batch,
         max_msg_len=256,
         batch_deadline_s=0.002,
+        precomputed_ok=precomputed,
     )
 
 
@@ -106,7 +108,7 @@ def build_dedup_sharded(links, cnc, *, n_shards):
     )
 
 
-def build_pack(links, cnc, *, n_bank):
+def build_pack(links, cnc, *, n_bank, slot_clock=None, shed_keep=None):
     from firedancer_tpu.runtime.pack_stage import PackStage
 
     return PackStage(
@@ -120,10 +122,13 @@ def build_pack(links, cnc, *, n_bank):
         # soon as anything is pending
         min_pending=1,
         mb_deadline_s=0.0,
+        clock=slot_clock,
+        shed_keep=shed_keep,
     )
 
 
-def build_pack_native(links, cnc, *, n_bank, txn_links):
+def build_pack_native(links, cnc, *, n_bank, txn_links, slot_clock=None,
+                      shed_keep=None):
     """The fused native dedup+pack stage: consumes the verify output
     links directly (no dedup process) and runs native/fd_pack.cpp via
     one FFI crossing per burst.  The parent only wires this when
@@ -141,10 +146,12 @@ def build_pack_native(links, cnc, *, n_bank, txn_links):
         n_txn_ins=len(txn_links),
         min_pending=1,
         mb_deadline_s=0.0,
+        clock=slot_clock,
+        shed_keep=shed_keep,
     )
 
 
-def build_bank(links, cnc, *, bank_idx, slot=1):
+def build_bank(links, cnc, *, bank_idx, slot=1, slot_clock=None):
     # the bank process OWNS the live bank (its own funk + SlotExecution,
     # default_bank_ctx): the process topology therefore runs n_bank=1 —
     # multiple real-execution banks need the funk state shared, which the
@@ -163,12 +170,13 @@ def build_bank(links, cnc, *, bank_idx, slot=1):
         cnc=cnc,
         bank_idx=bank_idx,
         ctx=default_bank_ctx(slot=slot),
+        clock=slot_clock,
     )
     stage.require_credit = True
     return stage
 
 
-def build_poh(links, cnc, *, n_bank):
+def build_poh(links, cnc, *, n_bank, slot_clock=None):
     from firedancer_tpu.runtime.poh_stage import PohStage
 
     stage = PohStage(
@@ -176,6 +184,7 @@ def build_poh(links, cnc, *, n_bank):
         ins=[shm.make_consumer(links[f"bp{b}"], lazy=8) for b in range(n_bank)],
         outs=[shm.make_producer(links["ps"])],
         cnc=cnc,
+        clock=slot_clock,
     )
     stage.require_credit = True
     return stage
@@ -221,6 +230,10 @@ def build_leader_topology(
     slot: int = 1,
     sandbox: dict | None = None,
     native_pack: bool | None = None,
+    slot_clock=None,
+    boot_grace_s: float = 0.0,
+    shed_keep: int | None = None,
+    verify_precomputed: bool = False,
 ) -> ft.Topology:
     """sandbox: utils/sandbox.enter kwargs applied to EVERY stage child
     (the per-tile jail; fd_topo_run's seccomp step).  The default policy
@@ -230,7 +243,17 @@ def build_leader_topology(
     native_pack: None = auto — when pack/scheduler_native.available()
     (checked HERE in the parent, which also builds the .so so children
     just load it), the dedup process disappears and the pack process
-    runs the fused native dedup+pack lane over the verify link."""
+    runs the fused native dedup+pack lane over the verify link.
+
+    slot_clock (runtime/slot_clock.SlotClockCfg): run the topology
+    against the real wall-clock cadence.  The cfg is anchored HERE, in
+    the parent, `boot_grace_s` into the future (children need real time
+    to spawn — XLA imports take seconds on cold boxes), so every child
+    derives the SAME slot boundaries from one shared monotonic epoch.
+    With n_slots set on the cfg, the leader window ends ON THE SCHEDULE
+    — poh stops sealing at the last slot's deadline regardless of how
+    much load is still draining (the handoff contract); supervise with
+    `until=leader_window_done(...)` to observe it."""
     from firedancer_tpu.models.leader import resolve_native_pack
     from firedancer_tpu.ops.ref import ed25519_ref as ref
 
@@ -240,7 +263,11 @@ def build_leader_topology(
     from firedancer_tpu.runtime.bank import BankStage
     from firedancer_tpu.runtime.dedup import DedupStage
     from firedancer_tpu.runtime.pack_stage import PackStage
+    from firedancer_tpu.runtime.poh_stage import PohStage
     from firedancer_tpu.runtime.verify import VerifyStage
+
+    if slot_clock is not None:
+        slot_clock = slot_clock.anchored(boot_grace_s)
 
     if n_bank != 1:
         # each bank process owns its own funk: two real-execution banks
@@ -278,10 +305,12 @@ def build_leader_topology(
     topo.stage("benchg", build_benchg, pool_size=pool_size, n_txns=n_txns,
                sandbox=sb, outs=["gv"])
     topo.stage("verify0", build_verify, batch=batch, sandbox=sb,
+               precomputed=verify_precomputed,
                ins=["gv"], outs=["vd"], schema=VerifyStage.metrics_schema())
     if use_native_pack:
         topo.stage("pack", build_pack_native, n_bank=n_bank,
                    txn_links=["vd"], sandbox=sb,
+                   slot_clock=slot_clock, shed_keep=shed_keep,
                    ins=["vd"] + [f"bd{b}" for b in range(n_bank)],
                    outs=[f"pb{b}" for b in range(n_bank)],
                    schema=PackStage.metrics_schema())
@@ -289,21 +318,42 @@ def build_leader_topology(
         topo.stage("dedup", build_dedup, sandbox=sb, ins=["vd"], outs=["dp"],
                    schema=DedupStage.metrics_schema())
         topo.stage("pack", build_pack, n_bank=n_bank, sandbox=sb,
+                   slot_clock=slot_clock, shed_keep=shed_keep,
                    ins=["dp"] + [f"bd{b}" for b in range(n_bank)],
                    outs=[f"pb{b}" for b in range(n_bank)],
                    schema=PackStage.metrics_schema())
     for b in range(n_bank):
         topo.stage(f"bank{b}", build_bank, bank_idx=b, slot=slot, sandbox=sb,
+                   slot_clock=slot_clock,
                    ins=[f"pb{b}"], outs=[f"bp{b}", f"bd{b}"],
                    credit_gated=True, schema=BankStage.metrics_schema())
     topo.stage("poh", build_poh, n_bank=n_bank, sandbox=sb,
+               slot_clock=slot_clock,
                ins=[f"bp{b}" for b in range(n_bank)], outs=["ps"],
-               credit_gated=True)
+               credit_gated=True, schema=PohStage.metrics_schema())
     topo.stage("shred", build_shred, secret=secret, slot=slot, sandbox=sb,
                ins=["ps"], outs=["ss"])
     topo.stage("store", build_store, leader_pub=leader_pub, sandbox=sb,
                ins=["ss"])
     return topo
+
+
+def leader_window_done(n_slots: int, stage: str = "poh"):
+    """An `until` predicate for TopologyHandle.supervise: the leader
+    window is over once poh has resolved every scheduled slot — sealed
+    or MISSED, both count; the handoff fires on the schedule, not on
+    drain.  Reads the poh stage's shm metrics registry (values are at
+    most one housekeeping interval stale, which is exactly the jitter
+    budget the grace window already absorbs)."""
+
+    def _done(handle) -> bool:
+        reg = handle.met_views.get(stage, (None, None))[0]
+        if reg is None:
+            return False
+        return (reg.get("slots_sealed") + reg.get("slot_missed")
+                >= n_slots)
+
+    return _done
 
 
 def build_sharded_leader_topology(
@@ -341,6 +391,7 @@ def build_sharded_leader_topology(
     from firedancer_tpu.runtime.bank import BankStage
     from firedancer_tpu.runtime.dedup import DedupStage
     from firedancer_tpu.runtime.pack_stage import PackStage
+    from firedancer_tpu.runtime.poh_stage import PohStage
     from firedancer_tpu.runtime.verify import VerifyStage
 
     use_native_pack = resolve_native_pack(native_pack)
@@ -397,7 +448,7 @@ def build_sharded_leader_topology(
                    credit_gated=True, schema=BankStage.metrics_schema())
     topo.stage("poh", build_poh, n_bank=n_bank, sandbox=sb,
                ins=[f"bp{b}" for b in range(n_bank)], outs=["ps"],
-               credit_gated=True)
+               credit_gated=True, schema=PohStage.metrics_schema())
     topo.stage("shred", build_shred, secret=secret, slot=slot, sandbox=sb,
                ins=["ps"], outs=["ss"])
     topo.stage("store", build_store, leader_pub=leader_pub, sandbox=sb,

@@ -198,6 +198,18 @@ class Pack:
         self._remove(o)
         return True
 
+    def shed_lowest(self, n: int) -> int:
+        """Deadline load-shedding (the slot-clock degraded mode): drop
+        up to `n` of the LOWEST-priority pending regular txns — the pool
+        tail, the same end the delete-worst eviction rule trims — and
+        return how many were shed.  Votes are consensus traffic and are
+        never shed."""
+        shed = 0
+        while shed < n and self._pending:
+            self._remove(self._pending[-1])
+            shed += 1
+        return shed
+
     def pending_cnt(self) -> int:
         return len(self._pending) + len(self._pending_votes)
 

@@ -71,6 +71,8 @@ def _load():
         ]
         lib.fd_pack_microblock_done.argtypes = [vp, u64]
         lib.fd_pack_end_block.argtypes = [vp]
+        lib.fd_pack_shed.restype = u64
+        lib.fd_pack_shed.argtypes = [vp, u64, ctypes.POINTER(u64)]
         lib.fd_pack_cost_probe.restype = i64
         lib.fd_pack_cost_probe.argtypes = [
             ctypes.c_char_p, u64, ctypes.c_char_p, u64, ctypes.POINTER(u64),
@@ -216,6 +218,14 @@ class NativePack:
 
     def end_block(self) -> None:
         self._lib.fd_pack_end_block(self._h)
+
+    def shed_lowest(self, n: int) -> int:
+        """Pack.shed_lowest parity: drop up to n lowest-priority pending
+        regular txns in ONE crossing (votes never shed); the post-op
+        pool size piggybacks so the policy stays zero-FFI."""
+        shed = int(self._lib.fd_pack_shed(self._h, n, self._pending_out))
+        self.last_pending = int(self._pending_out[0])
+        return shed
 
     def pending_cnt(self) -> int:
         return int(self._lib.fd_pack_pending_cnt(self._h))

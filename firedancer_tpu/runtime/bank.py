@@ -166,16 +166,46 @@ class BankStage(Stage):
                      "txns committed by the C++ fast lane")
             .counter("native_punt",
                      "C++ fast-lane punts resumed on the Python lane")
+            .counter("slot_boundaries",
+                     "slot-clock boundaries observed (slot-clock mode:"
+                     " the in-flight microblock always finishes — commits"
+                     " are atomic per after_frag — and the boundary is"
+                     " only ever crossed BETWEEN microblocks)")
         )
 
     def __init__(self, *args, bank_idx: int = 0, ctx: BankCtx | None = None,
-                 **kwargs):
+                 clock=None, **kwargs):
         super().__init__(*args, **kwargs)
         self.bank_idx = bank_idx
         self.ctx = ctx if ctx is not None else default_bank_ctx()
         # per-microblock commit latency vs the oldest txn's origin stamp
         # (the bencho measurement point: txn acknowledged by the runtime)
         self.commit_latencies_ns: list[int] = []
+        # slot-clock awareness (runtime/slot_clock): the bank's half of
+        # the deadline-aware block close is structural — a microblock
+        # commit is atomic inside after_frag, so the boundary can only
+        # fall between microblocks and "in-flight work finishes" needs
+        # no special path.  The stage still OBSERVES boundaries (one
+        # clock read per loop sweep in before_credit, FD202) so the
+        # flight trace shows where each slot's commits ended.
+        from .slot_clock import resolve_clock
+
+        self._clock = resolve_clock(clock)
+        self._clock_slot = (self._clock.cfg.slot0
+                            if self._clock is not None else 0)
+
+    def before_credit(self) -> None:
+        if self._clock is None:
+            return
+        now = self._clock.now()
+        slot = self._clock.slot_at(now)
+        last = self._clock.last_slot()
+        if last is not None:
+            slot = min(slot, last + 1)  # window-bounded, like pack's
+        if slot > self._clock_slot:
+            self.metrics.inc("slot_boundaries", slot - self._clock_slot)
+            self.trace(fm.EV_SLOT_ROLL, slot)
+            self._clock_slot = slot
 
     def after_frag(self, in_idx: int, meta, payload: bytes) -> None:
         from firedancer_tpu.flamenco.runtime import TXN_SUCCESS

@@ -43,6 +43,7 @@ import time
 from firedancer_tpu.pack.scheduler import Pack
 from firedancer_tpu.tango.rings import MCache
 from firedancer_tpu.utils import metrics as fm
+from .slot_clock import resolve_clock
 from .stage import Stage
 from .verify import decode_verified
 
@@ -70,6 +71,13 @@ class PackStage(Stage):
                 fm.exp_buckets(1, 64, 7),
                 "txns per emitted microblock",
             )
+            .counter("blocks_closed",
+                     "slot boundaries where the block closed on the"
+                     " deadline (slot-clock mode; the unscheduled tail"
+                     " carries into the next slot's pool)")
+            .counter("txn_shed",
+                     "pending txns shed by the deadline load-shedding"
+                     " degraded mode (lowest-priority first, never votes)")
         )
 
     def __init__(
@@ -82,6 +90,9 @@ class PackStage(Stage):
         mb_deadline_s: float = 0.002,
         adaptive: bool = True,
         n_txn_ins: int = 1,
+        clock=None,
+        close_frac: float = 0.25,
+        shed_keep: int | None = None,
         **kwargs,
     ):
         super().__init__(*args, **kwargs)
@@ -107,6 +118,22 @@ class PackStage(Stage):
         # first-sig -> tsorig for end-to-end latency attribution; bounded:
         # entries for txns evicted from the pool would otherwise leak
         self._tsorig_by_sig: dict[bytes, int] = {}
+        # slot-clock mode (runtime/slot_clock): the DEADLINE-AWARE block
+        # close.  At each slot boundary the block accounting resets
+        # (pack.end_block) and the unscheduled tail simply stays in the
+        # pool — it carries into the next slot, zero loss.  Inside the
+        # final `close_frac` of a slot the policy schedules aggressively
+        # (no min_pending accumulation), and with `shed_keep` set the
+        # degraded mode sheds the lowest-priority pending REGULAR work
+        # down to shed_keep when the clock says the slot cannot close in
+        # time (votes are never shed).
+        self._clock = resolve_clock(clock)
+        self._close_ns = 0
+        self._shed_keep = shed_keep
+        self._deadline_near = False
+        if self._clock is not None:
+            self._clock_slot = self._clock.cfg.slot0
+            self._close_ns = int(self._clock.slot_ns * close_frac)
 
     def _make_pack(self, **kw):
         return Pack(**kw)
@@ -143,6 +170,8 @@ class PackStage(Stage):
         # unconditionally every iteration, so the stamp lags a txn's
         # arrival by at most one iteration even under backpressure
         self._flush_intake()
+        if self._clock is not None:
+            self._clock_roll(self._clock.now())
         if self.adaptive:
             # adaptive close probe: one mcache row read per txn input —
             # no syscalls, stamped here for the same FD202 reason
@@ -168,6 +197,39 @@ class PackStage(Stage):
 
     # -- internals ----------------------------------------------------------
 
+    def _clock_roll(self, now: int) -> None:
+        """One clock read per loop sweep (before_credit cadence, FD202):
+        close the block at each slot boundary — in-flight microblocks
+        finish via the normal done-feedback, the unscheduled tail stays
+        pooled for the next slot — and arm the deadline-close /
+        load-shed posture for the slot's final stretch."""
+        clock = self._clock
+        slot = clock.slot_at(now)
+        last = clock.last_slot()
+        if last is not None:
+            # the leader window bounds the boundaries this stage owns:
+            # one final close after the last slot, then the clock is
+            # someone else's (keeps post-window accounting, and the
+            # deterministic chaos summaries, from drifting with wall
+            # time while the topology drains)
+            slot = min(slot, last + 1)
+        if slot > self._clock_slot:
+            self.pack.end_block()
+            self.metrics.inc("blocks_closed", slot - self._clock_slot)
+            self.trace(fm.EV_SLOT_ROLL, slot)
+            self._clock_slot = slot
+        self._deadline_near = clock.remaining_ns(slot, now) <= self._close_ns
+        if self._deadline_near and self._shed_keep is not None:
+            excess = self._pending_cnt() - self._shed_keep
+            if excess > 0:
+                shed = self._shed(excess)
+                if shed:
+                    self.metrics.inc("txn_shed", shed)
+                    self.trace(fm.EV_SLOT_SHED, shed)
+
+    def _shed(self, n: int) -> int:
+        return self.pack.shed_lowest(n)
+
     def _flush_intake(self) -> None:
         """Native-lane hook: push the accumulated frag burst through the
         single FFI crossing.  The Python lane inserts per frag already."""
@@ -180,6 +242,10 @@ class PackStage(Stage):
         if n == 0:
             return False
         if self.force_flush or n >= self.min_pending:
+            return True
+        if self._deadline_near:
+            # the slot's final stretch: accumulating toward min_pending
+            # risks the block closing with schedulable work stranded
             return True
         if self.adaptive and self._input_idle:
             # inputs ran dry: nothing else is coming this instant, so

@@ -20,7 +20,9 @@ entries with txn_cnt = 0.
 from __future__ import annotations
 
 from firedancer_tpu.tango.rings import MCache
+from firedancer_tpu.utils import metrics as fm
 from .poh import PohChain
+from .slot_clock import resolve_clock
 from .stage import Stage
 
 
@@ -50,6 +52,30 @@ def parse_entry(frame: bytes) -> tuple[int, bytes, list[bytes]]:
 
 
 class PohStage(Stage):
+    @classmethod
+    def extra_schema(cls) -> fm.MetricsSchema:
+        return (
+            fm.MetricsSchema()
+            .counter("ticks", "tick entries emitted")
+            .counter("mixins", "microblock mixin entries emitted")
+            .counter("poh_spans_queued", "full-tick spans parked for the"
+                     " serving plane's on-mesh self-audit")
+            .counter("slots_sealed",
+                     "slots whose final tick landed at the deadline"
+                     " (slot-clock mode)")
+            .counter("slot_missed",
+                     "slots whose boundary passed unsealed — the first-"
+                     "class MISSED outcome, never a hang or a drop")
+            .counter("slot_skipped_ticks",
+                     "ticks never emitted because their slot was missed")
+            .histogram(
+                "slot_seal_lag_ns",
+                fm.exp_buckets(1e4, 1e10, 19),
+                "final-tick landing time past the slot deadline"
+                " (the seal jitter the cadence tests bound)",
+            )
+        )
+
     def __init__(
         self,
         *args,
@@ -58,6 +84,7 @@ class PohStage(Stage):
         ticks_per_slot: int = 8,
         hashes_per_iter: int = 16,
         plane=None,
+        clock=None,
         **kwargs,
     ):
         super().__init__(*args, **kwargs)
@@ -80,13 +107,29 @@ class PohStage(Stage):
         # mixin (poh_iters == hashes_per_tick); others are skipped.
         self.plane = plane
         self._span_start = seed
+        # slot-clock mode (runtime/slot_clock): ticks PACED to the wall-
+        # clock deadline, the slot sealed at its boundary regardless of
+        # pending load, and a boundary that passes unsealable (frozen
+        # stage, starved credits) becomes a slot_missed VALUE — the
+        # pipeline skips to the scheduled slot and keeps going
+        self._clock = resolve_clock(clock)
+        if self._clock is not None:
+            self.ticks_per_slot = self._clock.cfg.ticks_per_slot
+            self.slot = self._clock.cfg.slot0
+            self._slot_hash_base = 0
+            self.window_closed = False
 
     # -- callbacks ----------------------------------------------------------
 
     def after_credit(self) -> None:
         """The clock: advance the chain a bounded amount per loop sweep so
         the cooperative scheduler stays fair (the reference hashes in
-        after_credit exactly the same way, fd_poh.c)."""
+        after_credit exactly the same way, fd_poh.c).  In slot-clock mode
+        the wall clock, not the txn stream, decides when ticks land and
+        when the slot seals."""
+        if self._clock is not None:
+            self._clock_sweep(self._clock.now())
+            return
         room = self.hashes_per_tick - (self.chain.hashcnt % self.hashes_per_tick)
         n = min(self.hashes_per_iter, room)
         if n <= 0:  # clock stopped (drain mode)
@@ -95,6 +138,106 @@ class PohStage(Stage):
         self._hashes_since_entry += n
         if self.chain.hashcnt % self.hashes_per_tick == 0:
             self._emit_tick()
+
+    # -- slot-clock mode -----------------------------------------------------
+
+    def before_credit(self) -> None:
+        """Miss detection must outrun backpressure: run_once skips
+        after_credit while any output is starved, but a slot whose
+        grace expired during the stall must STILL become a miss (the
+        outcome is a value precisely because it needs no credit to be
+        declared).  before_credit runs unconditionally every sweep."""
+        if self._clock is None or self.window_closed:
+            return
+        now = self._clock.now()
+        if self._clock.missed(self.slot, now):
+            self._miss_slots(now)
+
+    def _tick_progress(self) -> int:
+        """Hashes into the CURRENT tick (slot-local; mixins may overshoot
+        a boundary — the overshoot simply counts toward the next tick)."""
+        return (self.chain.hashcnt - self._slot_hash_base
+                - self._tick_cnt * self.hashes_per_tick)
+
+    def _clock_sweep(self, now: int) -> None:
+        clock = self._clock
+        if self.window_closed:
+            return
+        if now >= clock.deadline_of(self.slot):
+            # the boundary: seal NOW regardless of pending load — or,
+            # past the grace, declare the slot missed and move on
+            if clock.missed(self.slot, now):
+                self._miss_slots(now)
+            else:
+                self._seal_rush()
+            return  # pace the new slot from the next sweep on
+        # paced hashing: tick k (1-based) may complete only once due;
+        # catch-up after a stall is bounded per sweep (cooperative loop)
+        for _ in range(4):
+            if self._tick_cnt >= self.ticks_per_slot:
+                return  # fully ticked; wait for the boundary roll
+            k = self._tick_cnt + 1
+            due = now >= clock.tick_deadline(self.slot, k)
+            need = self.hashes_per_tick - self._tick_progress()
+            if need > 0:
+                cap = need if due else min(self.hashes_per_iter, need - 1)
+                if cap > 0:
+                    self.chain.append(cap)
+                    self._hashes_since_entry += cap
+            if not due or self._tick_progress() < self.hashes_per_tick:
+                return
+            if self.outs and self.outs[0].cr_avail <= 0:
+                return  # starved: retry next sweep (the miss clock runs)
+            self._emit_tick()
+
+    def _seal_rush(self) -> None:
+        """Deadline reached with the slot still open: land every
+        remaining tick immediately (hashing is cheap; credits may not
+        be) and roll to the next scheduled slot.  Called only inside the
+        grace window — past it the slot is a miss, not a late seal."""
+        clock = self._clock
+        while self._tick_cnt < self.ticks_per_slot:
+            if self.outs and self.outs[0].cr_avail <= 0:
+                return  # retry next sweep; grace expiry turns this into a miss
+            need = self.hashes_per_tick - self._tick_progress()
+            if need > 0:
+                self.chain.append(need)
+                self._hashes_since_entry += need
+            self._emit_tick()
+        lag = clock.now() - clock.deadline_of(self.slot)
+        self.metrics.inc("slots_sealed")
+        self.metrics.observe("slot_seal_lag_ns", max(lag, 1))
+        self.trace(fm.EV_SLOT_SEAL, self.slot)
+        self._advance_slot(self.slot + 1)
+
+    def _miss_slots(self, now: int) -> None:
+        """The first-class MISSED outcome: the boundary (plus grace)
+        passed before the slot's final tick could land — emit the event
+        and the metric, skip the unsealed ticks, and continue cleanly at
+        the slot the clock says is current."""
+        clock = self._clock
+        target = clock.slot_at(now)
+        missed = max(target - self.slot, 1)
+        skipped = (missed * self.ticks_per_slot) - self._tick_cnt
+        for s in range(self.slot, self.slot + missed):
+            self.trace(fm.EV_SLOT_MISSED, s)
+        self.metrics.inc("slot_missed", missed)
+        self.metrics.inc("slot_skipped_ticks", max(skipped, 0))
+        self._advance_slot(self.slot + missed)
+
+    def _advance_slot(self, slot: int) -> None:
+        self.slot = slot
+        self._tick_cnt = 0
+        self._slot_hash_base = self.chain.hashcnt
+        if not self._clock.in_window(slot):
+            # the leader window ended: handoff fires on this schedule
+            # (not on drain) — the clock plane stops sealing and the
+            # supervisor observes slots_done via the metrics registry
+            self.window_closed = True
+
+    def slots_done(self) -> int:
+        return (self.metrics.get("slots_sealed")
+                + self.metrics.get("slot_missed"))
 
     def after_frag(self, in_idx: int, meta, payload: bytes) -> None:
         """A bank's executed microblock: mix its hash into the chain and

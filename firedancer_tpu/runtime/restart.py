@@ -1,0 +1,65 @@
+"""Per-stage restart policy for the self-healing supervisor.
+
+The reference's disco supervision model distinguishes a tile that died
+once (respawn it in place — its workspace rings are intact) from a tile
+that crash-loops (take the topology down and leave the evidence).  This
+module is the policy half: bounded attempts with exponential backoff and
+SEEDED jitter — the schedule for a given (seed, stage) is byte-identical
+across runs (utils/rng, the RepairClient retry discipline), so chaos
+scenarios that exercise restarts stay deterministic per seed.
+
+The mechanism half lives in runtime/topo.TopologyHandle.supervise
+(respawn + ring reattach) and runtime/stage.Stage.resume_from_rings
+(cursor recovery + the exactly-once publish guard).
+"""
+
+from __future__ import annotations
+
+import zlib
+from dataclasses import dataclass
+
+from firedancer_tpu.utils.rng import Rng
+
+
+@dataclass(frozen=True)
+class RestartPolicy:
+    """Bounded in-place restarts with deterministic backoff.
+
+    attempt k (1-based) waits `backoff_base_s * backoff_mult**(k-1)`
+    scaled by a seeded jitter in [1, 1 + jitter_frac) — jitter breaks
+    thundering-herd respawns when several stages share a policy, and
+    seeding it keeps same-seed runs byte-identical.  Past `max_restarts`
+    the supervisor falls back to today's fail-fast + flight dump."""
+
+    max_restarts: int = 3
+    backoff_base_s: float = 0.05
+    backoff_mult: float = 2.0
+    jitter_frac: float = 0.5
+    seed: int = 0
+
+    def delay_s(self, stage: str, attempt: int) -> float:
+        """Backoff before restart `attempt` (1-based) of `stage` —
+        deterministic per (seed, stage, attempt)."""
+        if attempt < 1:
+            raise ValueError("attempt is 1-based")
+        base = self.backoff_base_s * self.backoff_mult ** (attempt - 1)
+        # one Rng per (stage, attempt): the schedule must not depend on
+        # HOW MANY draws other stages made before this one
+        r = Rng(self.seed, zlib.crc32(stage.encode()) ^ (attempt << 32))
+        return base * (1.0 + self.jitter_frac * r.float01())
+
+    def schedule(self, stage: str) -> list[float]:
+        """The stage's full deterministic backoff schedule, in seconds."""
+        return [self.delay_s(stage, a)
+                for a in range(1, self.max_restarts + 1)]
+
+
+def policy_for(restart, stage: str) -> RestartPolicy | None:
+    """Resolve supervise(restart=...)'s argument: a single policy applies
+    to every stage, a dict maps stage names (missing names -> no
+    restart), None disables in-place restart entirely."""
+    if restart is None:
+        return None
+    if isinstance(restart, RestartPolicy):
+        return restart
+    return restart.get(stage)

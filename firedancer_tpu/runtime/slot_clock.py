@@ -1,0 +1,160 @@
+"""The slot-clock plane: ONE wall-clock deadline authority for the leader.
+
+The protocol's leader pipeline lives or dies by the 400 ms slot cadence
+(/root/reference/src/app/fdctl/run/tiles/fd_poh.c derives every tick and
+leader-rotation decision from the reckoning of wall-clock time against
+the epoch schedule).  Until now this build's pipeline ran free — slots
+sealed when the txn stream drained — so nothing could ever MISS a slot.
+This module is the missing clock: a picklable config (`SlotClockCfg`)
+that every stage of a topology anchors to the SAME monotonic epoch, and
+a reader (`SlotClock`) that answers the only questions deadline code may
+ask: which slot is it, when does it end, which ticks are due, and is a
+slot past saving.
+
+Design rules:
+
+  - all arithmetic is integer nanoseconds off one anchor (`t0_ns`), so
+    every process of a topology (CLOCK_MONOTONIC is system-wide on
+    Linux) derives identical boundaries — there is no peer-to-peer
+    clock agreement problem to have;
+  - the cadence is CONFIGURABLE (400 ms real, compressed to tens of ms
+    for tests) but the geometry is fixed at anchor time: slot s starts
+    at t0 + (s - slot0)*slot_ns, full stop.  Load never moves a
+    boundary — that is the whole point;
+  - `now_fn` is injectable for unit tests (virtual time), defaulting to
+    time.monotonic_ns — the same clock the frag timestamps use
+    (tango/shm.now_ns);
+  - this plane is the ONLY sanctioned deadline authority for stage
+    code: fdlint FD215 flags blocking sleeps/waits inside frag
+    callbacks and housekeeping hooks precisely so no stage invents a
+    private clock to wait on.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, replace
+
+
+@dataclass(frozen=True)
+class SlotClockCfg:
+    """Picklable slot-clock geometry (StageSpec.kwargs ride the spawn).
+
+    `t0_ns` is the shared anchor: resolve it ONCE in the parent (via
+    `anchored`) before handing the cfg to builders, or every child would
+    anchor at its own boot instant and the clocks would disagree.
+    `boot_grace_s` exists because spawned children take real time to
+    boot (XLA import): anchoring the epoch slightly in the future means
+    slot 0 of the window starts after the topology is actually up."""
+
+    slot_ms: float = 400.0
+    slot0: int = 1
+    ticks_per_slot: int = 8
+    # the leader window: seal slots [slot0, slot0 + n_slots) then stop
+    # (handoff fires on this schedule, not on drain); None = unbounded
+    n_slots: int | None = None
+    # grace past the deadline before a slot is MISSED rather than sealed
+    # late (jitter allowance, as a fraction of the slot)
+    miss_grace_frac: float = 0.25
+    t0_ns: int | None = None
+
+    def anchored(self, boot_grace_s: float = 0.0,
+                 now_ns: int | None = None) -> "SlotClockCfg":
+        """Resolve the epoch anchor NOW (+ boot grace); idempotent when
+        t0_ns is already set."""
+        if self.t0_ns is not None:
+            return self
+        base = time.monotonic_ns() if now_ns is None else now_ns
+        return replace(self, t0_ns=base + int(boot_grace_s * 1e9))
+
+    def build(self, now_fn=None) -> "SlotClock":
+        return SlotClock(self, now_fn=now_fn)
+
+
+class SlotClock:
+    """Deadline reader over an anchored cfg.  Pure integer-ns queries —
+    cheap enough for before_credit/after_credit cadence (one clock read
+    per sweep, never per frag: FD202)."""
+
+    def __init__(self, cfg: SlotClockCfg, now_fn=None):
+        if cfg.ticks_per_slot <= 0:
+            raise ValueError("ticks_per_slot must be positive")
+        if cfg.slot_ms <= 0:
+            raise ValueError("slot_ms must be positive")
+        self.cfg = cfg if cfg.t0_ns is not None else cfg.anchored()
+        self._now_fn = now_fn or time.monotonic_ns
+        self.slot_ns = max(int(cfg.slot_ms * 1e6), cfg.ticks_per_slot)
+        self.tick_ns = self.slot_ns // cfg.ticks_per_slot
+        self.grace_ns = int(self.slot_ns * cfg.miss_grace_frac)
+        self.t0 = self.cfg.t0_ns
+
+    # -- queries -------------------------------------------------------------
+
+    def now(self) -> int:
+        return self._now_fn()
+
+    def slot_at(self, now_ns: int) -> int:
+        """The slot whose window contains now (clamped to slot0 before
+        the anchor — the boot-grace period belongs to the first slot)."""
+        return self.cfg.slot0 + max(0, now_ns - self.t0) // self.slot_ns
+
+    def start_of(self, slot: int) -> int:
+        return self.t0 + (slot - self.cfg.slot0) * self.slot_ns
+
+    def deadline_of(self, slot: int) -> int:
+        return self.start_of(slot) + self.slot_ns
+
+    def remaining_ns(self, slot: int, now_ns: int) -> int:
+        return self.deadline_of(slot) - now_ns
+
+    def ticks_due(self, slot: int, now_ns: int) -> int:
+        """Ticks of `slot` that should have LANDED by now, in
+        [0, ticks_per_slot] — tick k (1-based) is due at
+        start + k*tick_ns."""
+        d = now_ns - self.start_of(slot)
+        if d <= 0:
+            return 0
+        return min(d // self.tick_ns, self.cfg.ticks_per_slot)
+
+    def tick_deadline(self, slot: int, k: int) -> int:
+        """When tick k (1-based) of `slot` is due to land."""
+        return self.start_of(slot) + k * self.tick_ns
+
+    def missed(self, slot: int, now_ns: int) -> bool:
+        """Past saving: the deadline + grace has elapsed, so the slot is
+        a MISS, not a late seal."""
+        return now_ns > self.deadline_of(slot) + self.grace_ns
+
+    # -- leader window -------------------------------------------------------
+
+    def last_slot(self) -> int | None:
+        if self.cfg.n_slots is None:
+            return None
+        return self.cfg.slot0 + self.cfg.n_slots - 1
+
+    def in_window(self, slot: int) -> bool:
+        last = self.last_slot()
+        return last is None or slot <= last
+
+    def window_end_ns(self) -> int | None:
+        """The handoff instant: the last window slot's deadline."""
+        last = self.last_slot()
+        return None if last is None else self.deadline_of(last)
+
+    def window_done(self, now_ns: int | None = None) -> bool:
+        end = self.window_end_ns()
+        if end is None:
+            return False
+        return (self.now() if now_ns is None else now_ns) >= end
+
+
+def resolve_clock(clock) -> SlotClock | None:
+    """Accept a SlotClockCfg (builders: the picklable form), a built
+    SlotClock (tests with injected time), or None — the one coercion
+    every clocked stage constructor uses."""
+    if clock is None or isinstance(clock, SlotClock):
+        return clock
+    if isinstance(clock, SlotClockCfg):
+        return clock.build()
+    raise TypeError(f"clock must be SlotClockCfg | SlotClock | None, "
+                    f"got {type(clock).__name__}")

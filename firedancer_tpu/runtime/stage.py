@@ -197,6 +197,16 @@ class Stage:
         # (after_frag on mixed/lossy lanes) must forward into the same
         # C-side state so the two paths never diverge.
         self._sweep_client = None
+        # in-place restart (runtime/topo supervisor respawn): out_idx ->
+        # the ring's published-sig set, armed by resume_from_rings; the
+        # publish guard suppresses re-published replay frags until the
+        # stream passes the crash point (exactly-once on the wire)
+        self._resume_guards: dict[int, set[int]] = {}
+        # transactional progress (StageSpec.restartable): fseq advances
+        # ONLY at safe points — end of a completed sweep and housekeeping
+        # — never mid-poll, so a SIGKILL can never mark a frag consumed
+        # whose downstream effects were not yet published
+        self.safe_progress = False
         # ring-cost instrument (bench.py): when enabled, poll/drain and
         # publish time accumulate separately from stage compute
         self.ring_clock = False
@@ -242,6 +252,66 @@ class Stage:
         self.metrics.attach(registry)
         self.recorder.replay_into(recorder)
         self.recorder = recorder
+
+    # -- in-place restart (supervisor respawn) -------------------------------
+
+    def resume_from_rings(self) -> None:
+        """Reattach this stage's cursors to its EXISTING shm rings after
+        a supervisor respawn (runtime/topo supervise restart path):
+
+          - every consumer resumes at the progress it last PUBLISHED to
+            its fseq (frags consumed past that before the crash replay);
+          - every producer resumes at the frontier recovered from its
+            own mcache (never seq 0 — that would lap live consumers and
+            clobber in-flight payloads), and its ring's published sigs
+            arm the publish guard so replayed frags are suppressed
+            rather than re-delivered.
+
+        Exactly-once holds for stages whose output is a pure function of
+        their input stream and whose frag sigs are unique within a ring
+        depth (every pipeline link's are).  A SOURCE stage (no inputs)
+        must derive its own progress from producer state — override this
+        and read `self.outs[i].seq` (see chaos/scenario's gen stage)."""
+        for c in self.ins:
+            c.resume()
+        self._resume_guards = {}
+        for i, p in enumerate(self.outs):
+            sigs = p.resume()
+            if sigs:
+                self._resume_guards[i] = sigs
+        self.trace(fm.EV_RESTART, self._iter)
+
+    def arm_safe_progress(self) -> None:
+        """Make fseq publication TRANSACTIONAL for this stage (the
+        restartable-stage contract, StageSpec.restartable): consumers
+        stop auto-publishing progress mid-poll (their lazy interval is
+        pushed out of reach) and run_once publishes it only after a
+        sweep's frag effects are fully out.  A SIGKILL therefore leaves
+        the fseq at a point where everything at or before it is on the
+        wire — resume replays at-least-once and the publish guard dedups
+        to exactly-once."""
+        self.safe_progress = True
+        for c in self.ins:
+            c.set_lazy(1 << 62)
+
+    def _commit_progress(self) -> None:
+        for c in self.ins:
+            c.publish_progress()
+
+    def _guarded(self, out_idx: int, sig: int) -> bool:
+        """True = this publish is a replay duplicate: swallow it.  The
+        guard disarms at the first sig the pre-crash ring never carried
+        (the replay has passed the crash point and everything after is
+        new work)."""
+        g = self._resume_guards.get(out_idx)
+        if g is None:
+            return False
+        if sig in g:
+            g.discard(sig)
+            self.metrics.inc("restart_dedup")
+            return True
+        del self._resume_guards[out_idx]
+        return False
 
     # -- callbacks (override in subclasses) ---------------------------------
 
@@ -326,8 +396,14 @@ class Stage:
             drainer = self._native_drainer()
             if drainer is not None:
                 if self._sweep_client is not None:
-                    return self._native_sweep(drainer)
-                return self._native_burst(drainer)
+                    progressed = self._native_sweep(drainer)
+                else:
+                    progressed = self._native_burst(drainer)
+                if progressed and self.safe_progress:
+                    # transactional commit: the drained sweep's effects
+                    # are out, so the fseq may now cover it
+                    self._commit_progress()
+                return progressed
         progressed = False
         # burst-drain: up to `burst` frags per sweep.  One-frag sweeps
         # make the COOPERATIVE scheduler pay the whole loop overhead
@@ -387,6 +463,8 @@ class Stage:
                 break
             if not got:
                 break
+        if progressed and self.safe_progress:
+            self._commit_progress()
         return progressed
 
     # -- native ring burst path ---------------------------------------------
@@ -568,6 +646,8 @@ class Stage:
     def publish(
         self, out_idx: int, payload: bytes, sig: int = 0, tsorig: int = 0
     ) -> bool:
+        if self._resume_guards and self._guarded(out_idx, sig):
+            return True  # replay duplicate: already on the wire pre-crash
         p = self.outs[out_idx]
         if self.ring_clock:
             _t = _pc()
@@ -590,6 +670,20 @@ class Stage:
         published."""
         if not items:
             return 0
+        if self._resume_guards and out_idx in self._resume_guards:
+            # replay window after an in-place restart: route through the
+            # per-frame path so the publish guard sees every sig (the
+            # guard disarms within one ring depth — not a hot path)
+            n = 0
+            for payload, sig, tsorig in items:
+                if self._guarded(out_idx, sig):
+                    n += 1
+                    continue
+                if not self.publish(out_idx, payload, sig=sig,
+                                    tsorig=tsorig):
+                    break
+                n += 1
+            return n
         p = self.outs[out_idx]
         burst = getattr(p, "publish_burst", None)
         if self.ring_clock:

@@ -108,6 +108,14 @@ class StageSpec:
     schema: fm.MetricsSchema | None = None
     shard: int | None = None
     logical: str | None = None
+    # declarative restart eligibility: the child arms TRANSACTIONAL
+    # progress (Stage.arm_safe_progress — fseq moves only after a
+    # sweep's effects are published), the precondition for supervise's
+    # in-place restart path to resume exactly-once.  Only mark stages
+    # whose frag effects complete within the sweep (relay-shaped); a
+    # stage holding cross-sweep in-memory state (verify's in-flight
+    # batches, pack's pool, bank's funk) would lose it on respawn.
+    restartable: bool = False
 
 
 @dataclass
@@ -127,6 +135,7 @@ class Topology:
               schema: fm.MetricsSchema | None = None,
               shard: int | None = None,
               logical: str | None = None,
+              restartable: bool = False,
               **kwargs) -> "StageSpec":
         spec = StageSpec(
             name, builder, kwargs, sandbox,
@@ -136,6 +145,7 @@ class Topology:
             schema=schema,
             shard=shard,
             logical=logical,
+            restartable=restartable,
         )
         self.stages.append(spec)
         return spec
@@ -184,11 +194,18 @@ def _quiet_shm_close(s: shared_memory.SharedMemory) -> None:
             pass
 
 
-def _stage_main(spec: StageSpec, link_names: dict, uid: str) -> None:
+def _stage_main(spec: StageSpec, link_names: dict, uid: str,
+                resume: bool = False) -> None:
     """Child entry: join links + cnc + metrics segment, build the stage,
     run until HALT.  On any raise the flight ring gets an EV_FAIL record
     BEFORE the cnc flips to FAIL — the ring lives in shm, so the record
-    survives this process for the supervisor's dump."""
+    survives this process for the supervisor's dump.
+
+    resume=True is the IN-PLACE RESTART path (supervise's restart
+    policy): the stage reattaches to its existing shm rings — consumers
+    at their published fseqs, producers at their recovered mcache
+    frontiers with the replay-dedup guard armed — and its counters
+    continue from the registry's last flushed values instead of zero."""
     cnc_shm = shared_memory.SharedMemory(name=_cnc_shm_name(uid, spec.name))
     cnc = Cnc(np.frombuffer(cnc_shm.buf, dtype=rings.U64, count=2 + Cnc.NDIAG))
     met_shm = shared_memory.SharedMemory(name=_met_shm_name(uid, spec.name))
@@ -199,6 +216,17 @@ def _stage_main(spec: StageSpec, link_names: dict, uid: str) -> None:
     stage = None
     try:
         stage = spec.builder(links, cnc, **spec.kwargs)
+        if resume:
+            # counters continue monotonically across the respawn (a
+            # fresh zeroed stage would go BACKWARD in the scrape the
+            # instant its first flush landed); histograms restart —
+            # their pre-crash state is already in the registry and the
+            # stage only ever overwrites what it locally observed
+            for name, (d, _off) in registry._off.items():
+                if d.kind != fm.HISTOGRAM:
+                    v = registry.get(name)
+                    if v:
+                        stage.metrics.counters[name] = v
         # schema-drift guard: a stage kind with extra_schema() whose spec
         # forgot schema=Kind.metrics_schema() would silently publish only
         # the base block — make the partial-metrics trap loud at boot
@@ -213,7 +241,11 @@ def _stage_main(spec: StageSpec, link_names: dict, uid: str) -> None:
                 f"schema={type(stage).__name__}.metrics_schema() to "
                 f"Topology.stage)"
             )
+        if spec.restartable:
+            stage.arm_safe_progress()
         stage.attach_observability(registry, recorder)
+        if resume:
+            stage.resume_from_rings()
         if spec.sandbox is not None:
             from firedancer_tpu.utils import sandbox as sb
 
@@ -246,7 +278,7 @@ def _stage_main(spec: StageSpec, link_names: dict, uid: str) -> None:
 
 class TopologyHandle:
     def __init__(self, topo, uid, links, cncs, cnc_shms, procs,
-                 met_shms=None, met_views=None):
+                 met_shms=None, met_views=None, link_names=None):
         self.topo = topo
         self.uid = uid
         self.links = links  # name -> ShmLink (parent-side joins)
@@ -256,8 +288,12 @@ class TopologyHandle:
         self._met_shms = met_shms or {}
         # stage name -> (MetricsRegistry, FlightRecorder), parent views
         self.met_views = met_views or {}
+        # segment names per link, for in-place respawns (same rings)
+        self._link_names = link_names or {}
         self.failed: str | None = None
         self.flight_dump_path: str | None = None
+        # stage name -> in-place restarts performed this run
+        self.restarts: dict[str, int] = {}
 
     # -- supervision --------------------------------------------------------
 
@@ -269,52 +305,140 @@ class TopologyHandle:
         heartbeat_timeout_s: float = 5.0,
         poll_s: float = 0.02,
         on_poll=None,
+        restart=None,
     ) -> bool:
         """Watchdog loop (run.c:252-330): returns True when `until()` says
         done; kills the whole topology and returns False if any stage dies,
-        signals FAIL, or stops heartbeating.
+        signals FAIL, or stops heartbeating — UNLESS a restart policy
+        covers the victim, in which case the stage is respawned IN PLACE
+        against its existing shm rings (runtime/restart.RestartPolicy;
+        the child reattaches via Stage.resume_from_rings: consumers at
+        their published fseqs, producers at their recovered frontiers,
+        replay deduped).  A stage that exhausts its bounded attempts
+        degrades to today's fail-fast + flight dump.
+
+        restart: RestartPolicy (every stage) | {stage: RestartPolicy}
+        (listed stages only) | None (fail-fast always, the old behavior).
 
         on_poll(handle): called once per watchdog iteration BEFORE the
         liveness checks — the fault-injection hook (chaos/faults.py
         schedules stage kills/freezes through it), also usable for live
         sampling.  It runs in the supervisor, so anything it does to the
         brood is judged by the same checks as a real failure."""
+        from firedancer_tpu.runtime.restart import policy_for
+
         deadline = time.monotonic() + timeout_s
+        pending: dict[str, float] = {}  # stage -> respawn-at (monotonic)
         while time.monotonic() < deadline:
             if on_poll is not None:
                 on_poll(self)
             if until is not None and until(self):
                 return True
+            now_s = time.monotonic()
+            for name in [n for n, t in pending.items() if now_s >= t]:
+                del pending[name]
+                self._respawn_stage(name)
             now = time.monotonic_ns()
             for name, p in self.procs.items():
+                if name in pending:
+                    continue  # reaped; its respawn is scheduled
                 cnc = self.cncs[name]
-                if not p.is_alive() or cnc.signal == CNC_SIG_FAIL:
-                    self.failed = name
-                    _log.warning(
-                        f"stage '{name}' died (alive={p.is_alive()}, "
-                        f"signal={cnc.signal}); killing topology"
-                    )
-                    self.dump_flight(
-                        f"stage '{name}' died (alive={p.is_alive()}, "
-                        f"signal={cnc.signal})"
-                    )
-                    self.kill()
-                    return False
                 hb = cnc.last_heartbeat
-                if hb and now - hb > heartbeat_timeout_s * 1e9:
-                    self.failed = name
+                if not p.is_alive() or cnc.signal == CNC_SIG_FAIL:
+                    why = (f"died (alive={p.is_alive()}, "
+                           f"signal={cnc.signal})")
+                elif hb and now - hb > heartbeat_timeout_s * 1e9:
+                    why = f"heartbeat stale ({(now - hb) / 1e9:.1f}s)"
+                else:
+                    continue
+                pol = policy_for(restart, name)
+                if pol is not None and not self._spec_of(name).restartable:
+                    # the policy names this stage but its spec never
+                    # opted in: without transactional progress (and with
+                    # whatever in-memory state the stage holds) a
+                    # respawn would silently lose work — refuse and
+                    # fail fast rather than degrade delivery semantics
                     _log.warning(
-                        f"stage '{name}' heartbeat stale "
-                        f"({(now - hb) / 1e9:.1f}s); killing topology"
+                        f"stage '{name}' is covered by a restart policy "
+                        f"but not declared restartable "
+                        f"(Topology.stage(restartable=True)); failing "
+                        f"fast instead of respawning"
                     )
-                    self.dump_flight(
-                        f"stage '{name}' heartbeat stale "
-                        f"({(now - hb) / 1e9:.1f}s)"
+                    pol = None
+                attempt = self.restarts.get(name, 0) + 1
+                if pol is not None and attempt <= pol.max_restarts:
+                    delay = pol.delay_s(name, attempt)
+                    self.restarts[name] = attempt
+                    _log.warning(
+                        f"stage '{name}' {why}; in-place restart "
+                        f"{attempt}/{pol.max_restarts} after "
+                        f"{delay * 1e3:.0f}ms backoff"
                     )
-                    self.kill()
-                    return False
+                    if self._reap_stage(name):
+                        pending[name] = time.monotonic() + delay
+                        continue
+                    _log.warning(
+                        f"stage '{name}' could not be reaped (process "
+                        f"survived SIGKILL); aborting the restart"
+                    )
+                self.failed = name
+                extra = (f" after {self.restarts[name]} in-place restarts"
+                         if self.restarts.get(name) else "")
+                _log.warning(
+                    f"stage '{name}' {why}{extra}; killing topology")
+                self.dump_flight(f"stage '{name}' {why}{extra}")
+                self.kill()
+                return False
             time.sleep(poll_s)
         return until is None  # plain timeout counts as failure iff waiting
+
+    def _spec_of(self, name: str) -> StageSpec:
+        return next(s for s in self.topo.stages if s.name == name)
+
+    def _reap_stage(self, name: str) -> bool:
+        """Take one dead/wedged stage's corpse down and scrub its cnc
+        verdict so the watchdog judges the RESPAWN, not the crash.
+        Returns False if the old process could not be killed — a
+        respawn then MUST NOT happen (two producers on one ring would
+        corrupt it); the caller falls through to fail-fast."""
+        p = self.procs[name]
+        if p.is_alive():
+            try:
+                os.kill(p.pid, _signal.SIGCONT)  # a SIGSTOPped victim
+            except (OSError, TypeError):
+                pass
+            p.terminate()
+        p.join(timeout=5)
+        if p.is_alive():  # SIGTERM blocked/stuck: escalate
+            try:
+                os.kill(p.pid, _signal.SIGKILL)
+            except (OSError, TypeError):
+                pass
+            p.join(timeout=5)
+            if p.is_alive():
+                return False
+        cnc = self.cncs[name]
+        cnc.signal = rings.CNC_SIG_BOOT
+        cnc.heartbeat(time.monotonic_ns())
+        return True
+
+    def _respawn_stage(self, name: str) -> None:
+        """Spawn a fresh process for `name` against the topology's
+        EXISTING segments (same uid, same rings, same cnc + metrics shm):
+        _stage_main(resume=True) makes the stage reattach its cursors
+        instead of starting at seq 0."""
+        spec = next(s for s in self.topo.stages if s.name == name)
+        # the respawned child gets a fresh boot-grace heartbeat window
+        self.cncs[name].heartbeat(time.monotonic_ns())
+        ctx = mp.get_context("spawn")
+        p = ctx.Process(
+            target=_stage_main, args=(spec, self._link_names, self.uid),
+            kwargs={"resume": True}, name=spec.name,
+        )
+        p.daemon = True
+        p.start()
+        self.procs[name] = p
+        _log.notice(f"respawned stage '{name}' in place, pid={p.pid}")
 
     def halt(self, timeout_s: float = 10.0) -> None:
         """Clean shutdown: HALT every cnc, join, terminate stragglers."""
@@ -546,4 +670,4 @@ def launch(topo: Topology, *, namespace: str | None = None) -> TopologyHandle:
         },
     )
     return TopologyHandle(topo, uid, links, cncs, cnc_shms, procs,
-                          met_shms, met_views)
+                          met_shms, met_views, link_names)

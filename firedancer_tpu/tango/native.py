@@ -310,6 +310,21 @@ class NativeProducer:
     def publish_n(self, payload: bytes, n: int) -> None:
         self._lib.fdr_publish_n(self._lsp, self._pp, payload, len(payload), n)
 
+    def resume(self) -> set[int]:
+        """In-place restart: shm.Producer.resume parity — recover the
+        publish cursor (seq + dcache chunk) from the live ring and
+        return the published sigs for the caller's replay-dedup guard.
+        The scan runs in Python over the link's numpy mcache view (one
+        pass at restart, not a hot path); the recovered cursors are
+        poked straight into the C producer struct."""
+        if self._lsp is None:
+            raise RuntimeError("detached native producer (link closed)")
+        frontier, next_chunk, sigs = self.link.mcache.recover()
+        self._p.seq = frontier
+        self._p.chunk = next_chunk
+        self.refresh_credits()
+        return sigs
+
     def detach(self) -> None:
         """Drop the shm-buffer pin (ShmLink.close path); the producer is
         unusable afterwards, exactly like a closed link's numpy views."""
@@ -377,6 +392,21 @@ class NativeConsumer:
         if self._lsp is None:
             raise RuntimeError("detached native consumer (link closed)")
         self._lib.fdr_publish_progress(self._lsp, self._cp)
+
+    def set_lazy(self, lazy: int) -> None:
+        """shm.Consumer.set_lazy parity — the C struct's field is the
+        one the crossing reads."""
+        self.lazy = lazy
+        self._c.lazy = lazy
+
+    def resume(self) -> int:
+        """In-place restart: shm.Consumer.resume parity — resume at the
+        progress last published to this consumer's fseq."""
+        if self._lsp is None:
+            raise RuntimeError("detached native consumer (link closed)")
+        self._c.seq = self.link.fseqs[int(self._c.fseq_idx)].query()
+        self._c.since_publish = 0
+        return int(self._c.seq)
 
     def consume_n(self, n: int, spin_limit: int = 1 << 30) -> int:
         if self._lsp is None:

@@ -130,6 +130,43 @@ class MCache:
             return 0, meta
         return (-1, None) if d < 0 else (1, None)
 
+    def recover(self) -> tuple[int, int, set[int]]:
+        """Reconstruct the producer's cursor state from the ring alone —
+        the in-place-restart path (a respawned stage reattaching to its
+        EXISTING shm ring must resume at its pre-crash frontier, not at
+        seq 0).
+
+        Returns (frontier_seq, next_chunk, published_sigs):
+          - frontier_seq: the next seq to publish.  The producer writes
+            sequentially and flips each row's seq word last, so the
+            newest row WITHOUT the BUSY bit is the last completed
+            publish; a row caught mid-overwrite (BUSY set with a real
+            seq) was never visible to any consumer and is simply
+            re-published.  All-BUSY-initial (never published) -> 0.
+          - next_chunk: the dcache cursor after the frontier frag, so a
+            resumed producer cannot overwrite payloads of in-flight
+            frags (DCache.alloc arithmetic, CHUNK_SZ granules).
+          - published_sigs: the sig of every completed row — the replay
+            window's dedup set (exactly-once resume requires sigs unique
+            within a ring depth, which every pipeline link provides).
+        """
+        best = None  # (seq, chunk, sz)
+        sigs: set[int] = set()
+        for line in range(self.depth):
+            row = self.table[line]
+            mseq = int(row[self.COL_SEQ])
+            if mseq & self.BUSY:
+                continue  # initial, or mid-overwrite (never published)
+            sigs.add(int(row[self.COL_SIG]))
+            if best is None or seq_diff(mseq, best[0]) > 0:
+                best = (mseq, int(row[self.COL_CHUNK]),
+                        int(row[self.COL_SZ]))
+        if best is None:
+            return 0, 0, sigs
+        frontier = (best[0] + 1) & _MASK64
+        next_chunk = best[1] + (-(-max(best[2], 1) // DCache.CHUNK_SZ))
+        return frontier, next_chunk, sigs
+
 
 class DCache:
     """Compact payload ring paired with an mcache (fd_dcache).

@@ -179,6 +179,18 @@ class Producer:
     def refresh_credits(self) -> None:
         self.cr_avail = self.fctl.credits(self.seq)
 
+    def resume(self) -> set[int]:
+        """In-place restart: recover the publish cursor from the LIVE
+        ring (a fresh endpoint starts at seq 0 — resuming there would
+        lap every consumer and clobber in-flight payloads).  Returns the
+        ring's published sigs: the caller's replay-dedup window
+        (Stage.resume_from_rings arms a guard with it)."""
+        frontier, next_chunk, sigs = self.link.mcache.recover()
+        self.seq = frontier
+        self.link.dcache._chunk = next_chunk
+        self.refresh_credits()
+        return sigs
+
     def try_publish(self, payload: bytes, sig: int = 0, tsorig: int = 0) -> bool:
         """Publish if credits allow; False means backpressured.
 
@@ -262,9 +274,24 @@ class Consumer:
         this per iteration to distinguish backlog from idle ingress.)"""
         return self.link.mcache.query(self.seq)[0] >= 0
 
+    def resume(self) -> int:
+        """In-place restart: resume at the progress this consumer LAST
+        PUBLISHED to its fseq.  Frags consumed past the published cursor
+        before the crash are replayed (fseq publication is lazy); the
+        restarted stage's producer-side dedup guard keeps the replay
+        exactly-once on the wire."""
+        self.seq = self.fseq.query()
+        self._since_publish = 0
+        return self.seq
+
     def publish_progress(self) -> None:
         self.fseq.publish(self.seq)
         self._since_publish = 0
+
+    def set_lazy(self, lazy: int) -> None:
+        """Retune the auto-publication interval (Stage.arm_safe_progress
+        pushes it out of reach so progress only moves at safe points)."""
+        self.lazy = lazy
 
 
 # -- ring-lane selection ------------------------------------------------------

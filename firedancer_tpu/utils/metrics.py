@@ -370,6 +370,11 @@ EV_BATCH_COMPLETE = 9  # device/work batch drained (arg = elements)
 EV_NATIVE_PUNT = 10    # native fast lane punted to the fallback (arg = count)
 EV_OVERRUN = 11        # input overrun detected (arg = input index)
 EV_MICROBLOCK = 12     # microblock committed/emitted (arg = txn count)
+EV_SLOT_SEAL = 13      # slot sealed at its deadline (arg = slot)
+EV_SLOT_MISSED = 14    # slot boundary passed unsealed — MISSED (arg = slot)
+EV_SLOT_ROLL = 15      # slot boundary observed by a non-poh stage (arg = slot)
+EV_SLOT_SHED = 16      # pack shed pending work at the deadline (arg = txns)
+EV_RESTART = 17        # stage resumed in place after a supervisor respawn
 
 EVENT_NAMES = {
     EV_BOOT: "boot",
@@ -384,6 +389,11 @@ EVENT_NAMES = {
     EV_NATIVE_PUNT: "native_punt",
     EV_OVERRUN: "overrun",
     EV_MICROBLOCK: "microblock",
+    EV_SLOT_SEAL: "slot_seal",
+    EV_SLOT_MISSED: "slot_missed",
+    EV_SLOT_ROLL: "slot_roll",
+    EV_SLOT_SHED: "slot_shed",
+    EV_RESTART: "restart",
 }
 
 FLIGHT_DEPTH = 512  # records per stage ring (fixed, small: ~12 KiB)
@@ -589,6 +599,9 @@ def stage_schema() -> MetricsSchema:
         .counter("backpressure", "publishes dropped for credits")
         .counter("backpressure_stall", "consume stalls while credit-gated")
         .counter("filtered", "frags dropped by before_frag")
+        .counter("restart_dedup",
+                 "replayed frags suppressed by the in-place-restart"
+                 " publish guard (exactly-once resume)")
         .histogram(
             "frag_latency_ns",
             exp_buckets(1e3, 1e10, 24),

@@ -751,6 +751,25 @@ u64 fd_pack_pending_cnt(void* h) {
   return P->pending.size + P->pending_votes.size;
 }
 
+// Deadline load-shedding (slot-clock degraded mode): drop up to n of the
+// lowest-priority pending REGULAR txns (the treap tail, same end the
+// delete-worst eviction trims; votes are consensus traffic and are never
+// shed).  Returns how many were shed; *out_pending reports the post-op
+// pool size so the stage's policy checks stay zero-FFI, matching the
+// insert/schedule crossings.
+u64 fd_pack_shed(void* h, u64 n, u64* out_pending) {
+  Pack* P = static_cast<Pack*>(h);
+  u64 shed = 0;
+  while (shed < n) {
+    int w = P->pending.worst(P->nodes);
+    if (w < 0) break;
+    pool_remove(*P, w);
+    shed++;
+  }
+  if (out_pending) *out_pending = P->pending.size + P->pending_votes.size;
+  return shed;
+}
+
 // Block accounting peek (tests): cost_used, vote_cost_used, data_bytes_used.
 void fd_pack_block_state(void* h, u64* out3) {
   Pack* P = static_cast<Pack*>(h);

@@ -281,3 +281,47 @@ def test_scenario_matrix_full_scale(name):
     leader handoff with its XLA compiles."""
     r = cs.run_scenario(name, seed=7)
     assert r.ok, f"{name}:\n{r.suite.describe()}"
+
+
+# -- slot-clock plane scenarios (ISSUE 14) ------------------------------------
+
+
+def test_crash_mid_slot_scenario():
+    """In-place restart under the slot clock: two SIGKILLs mid-slot are
+    absorbed by the restart policy (exactly-once stream diff across
+    both), the slot clock never misses a beat, and the crash-loop flank
+    degrades to the fail-fast + flight-dump path within the bounded
+    attempts — the ISSUE 14 acceptance pair in one scenario run."""
+    r = cs.run_crash_mid_slot(seed=11, n_frags=2000, n_slots=4,
+                              slot_ms=250.0, boot_grace_s=4.0)
+    assert r.ok, r.suite.describe()
+    checks = r.summary()["checks"]
+    for name in ("both-kills-fired", "kills-landed-mid-stream",
+                 "relay-restarted-in-place", "exactly-once-no-loss",
+                 "exactly-once-no-dup", "stream-order-preserved",
+                 "crash-cost-no-slots", "crash-loop-fails-fast",
+                 "crash-loop-attempts-bounded",
+                 "crash-loop-flight-dump-written", "shm-reclaimed",
+                 "crash-loop-shm-reclaimed"):
+        assert checks[name], name
+    assert r.info["restarts"] == 2
+    # the backoff schedule in the summary is the POLICY's deterministic
+    # one: reproducible from (seed, stage) alone
+    from firedancer_tpu.runtime.restart import RestartPolicy
+
+    pol = RestartPolicy(max_restarts=3, backoff_base_s=0.03, seed=11)
+    assert r.info["restart_schedule_ms"] == [
+        round(d * 1e3, 3) for d in pol.schedule("relay")]
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(600)
+def test_slot_overrun_scenario_deterministic():
+    """The full leader topology on the wall clock, twice with one seed:
+    identical summaries (the chaos determinism contract), the frozen
+    boundaries always exactly two missed slots."""
+    a = cs.run_slot_overrun(seed=7)
+    assert a.ok, a.suite.describe()
+    b = cs.run_slot_overrun(seed=7)
+    assert a.summary() == b.summary()
+    assert a.info["missed"] == 2

@@ -23,6 +23,18 @@ from __future__ import annotations
 import os
 import struct
 
+import pytest
+
+# gate, don't error: hypothesis is an optional dev dependency — on boxes
+# without it (this image bakes only the jax toolchain) the module must
+# SKIP at collection, not break the whole suite's collection.  Deep-fuzz
+# hosts install hypothesis and run scripts/fuzz_deep.sh.
+pytest.importorskip(
+    "hypothesis",
+    reason="hypothesis not installed (optional fuzz-tier dependency; "
+           "see scripts/fuzz_deep.sh)",
+)
+
 from hypothesis import HealthCheck, given, settings, strategies as st
 
 MAX_EXAMPLES = int(os.environ.get("FDTPU_FUZZ_EXAMPLES", "250"))

@@ -471,3 +471,100 @@ def test_lossy_consumer_wraps_native(link):
     assert delivered == 40 - lossy.dropped
     assert lossy.dropped > 0 and lossy.duplicated > 0
     assert all(s >= (1 << 63) for s, _ in got)  # u64 sigs intact
+
+
+# -- ring reattach (in-place restart, ISSUE 14) -------------------------------
+#
+# A supervisor respawn reattaches a stage's endpoints to the LIVE shm
+# segment: the consumer resumes at its published fseq, the producer at
+# the frontier recovered from its own mcache (seq + dcache chunk + the
+# published-sig dedup window).  Both lanes must recover identically —
+# these tests kill endpoints mid-burst and assert no frag is lost,
+# duplicated or reordered, and that flow-control credits conserve.
+
+
+def _reattach_roundtrip(make_prod, make_cons, link):
+    """Drive a kill/reattach cycle at BOTH ends of one link."""
+    prod = make_prod(link)
+    cons = make_cons(link)
+    got = []
+
+    def drain(c, n=10**9):
+        k = 0
+        while k < n:
+            r = c.poll()
+            if not isinstance(r, tuple):
+                break
+            got.append((int(r[0][MCache.COL_SIG]), bytes(r[1])))
+            k += 1
+
+    for i in range(40):
+        assert prod.try_publish(b"A%03d" % i, sig=i)
+    drain(cons, 17)  # mid-burst...
+    cons.publish_progress()
+    replay_from = 17
+    drain(cons, 6)  # ...consume past the published fseq, then die
+    assert len(got) == 23
+    # the consumer's replacement resumes at the PUBLISHED progress: the
+    # 6 unacknowledged frags replay (at-least-once at ring level; the
+    # stage-level publish guard is what dedups a relay's output)
+    cons2 = make_cons(link)
+    assert cons2.resume() == replay_from
+    del got[replay_from:]
+    drain(cons2)
+    assert [s for s, _ in got] == list(range(40))
+    assert [p for _, p in got] == [b"A%03d" % i for i in range(40)]
+    # now the producer dies: its replacement recovers frontier + chunk
+    # + the published-sig window from the ring alone
+    prod2 = make_prod(link)
+    sigs = prod2.resume()
+    assert prod2.seq == 40
+    assert sigs == set(range(40))
+    cons2.publish_progress()
+    prod2.refresh_credits()
+    depth = link.depth
+    # credits conserve: everything consumed+acked -> full budget again
+    assert prod2.cr_avail == depth
+    for i in range(40, 40 + depth):
+        assert prod2.try_publish(b"B%03d" % i, sig=i)
+    assert prod2.cr_avail == 0  # exactly depth spent, none leaked
+    drain(cons2)
+    assert [s for s, _ in got] == list(range(40 + depth))
+    # payload bytes intact across the chunk-cursor recovery: nothing
+    # overwrote an in-flight frag
+    assert got[-1][1] == b"B%03d" % (40 + depth - 1)
+
+
+def test_ring_reattach_native_lane(link):
+    _reattach_roundtrip(fn.NativeProducer,
+                        lambda l: fn.NativeConsumer(l, lazy=8), link)
+
+
+def test_ring_reattach_python_twin(link):
+    _reattach_roundtrip(shm.Producer,
+                        lambda l: shm.Consumer(l, lazy=8), link)
+
+
+def test_ring_reattach_mixed_lanes(link):
+    """The respawned endpoint need not be the same lane as its
+    predecessor (a restarted child without a toolchain joins with
+    Python rings): a native producer's ring recovers under a Python
+    successor and vice versa."""
+    prod = fn.NativeProducer(link)
+    for i in range(10):
+        assert prod.try_publish(b"M%02d" % i, sig=100 + i)
+    py = shm.Producer(link)
+    sigs = py.resume()
+    assert py.seq == 10 and sigs == set(range(100, 110))
+    assert py.try_publish(b"M10", sig=110)
+    nat = fn.NativeProducer(link)
+    assert nat.resume() == set(range(100, 111))
+    assert nat.seq == 11
+    cons = shm.Consumer(link, lazy=4)
+    seen = []
+    while True:
+        r = cons.poll()
+        if not isinstance(r, tuple):
+            break
+        seen.append(int(r[0][MCache.COL_SIG]))
+    assert seen == list(range(100, 111))
