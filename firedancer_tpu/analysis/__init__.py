@@ -18,19 +18,28 @@ fdlint closes that gap with two halves sharing one rule framework:
     from the CLI against an imported topology factory;
   - the **AST lint pass** (`ast_rules.lint_path`) walks the package
     source for repo-specific hot-path violations (host syncs in frag
-    callbacks, unseeded randomness, un-picklable stage builders).
+    callbacks, unseeded randomness, un-picklable stage builders);
+  - the **ABI contract checker** (`abi_check.check_repo`) extracts the
+    `extern "C"` surface of every native/*.cpp and diffs it against
+    the ctypes binding module that mirrors it — struct layouts,
+    argtypes/restype declarations, mirrored constants, meta-table
+    shapes — the FD_STATIC_ASSERT class of drift, caught statically.
 
 CLI:  python -m firedancer_tpu.analysis firedancer_tpu/
       python -m firedancer_tpu.analysis --list-rules
+      python -m firedancer_tpu.analysis --abi
 
-Findings carry stable rule IDs (FD1xx topology, FD2xx AST).  Deliberate
-violations are suppressed inline (`# fdlint: disable=FDxxx -- reason`);
-pre-existing ones are grandfathered in `analysis/baseline.toml`.  See
-docs/ANALYSIS.md for every rule's rationale.
+Findings carry stable rule IDs (FD1xx topology, FD2xx AST, FD3xx ABI).
+Deliberate violations are suppressed inline (`# fdlint: disable=FDxxx
+-- reason`); pre-existing ones are grandfathered in
+`analysis/baseline.toml` (prune stale entries with `--prune-baseline`).
+See docs/ANALYSIS.md for every rule's rationale.
 """
 
 from __future__ import annotations
 
+from . import native_rules  # noqa: F401 -- registers the FD3xx rules
+from .abi_check import check_pair, check_repo
 from .framework import Finding, Rule, all_rules, get_rule
 from .topo_check import TopologyError, check_topology
 
@@ -39,6 +48,8 @@ __all__ = [
     "Rule",
     "TopologyError",
     "all_rules",
+    "check_pair",
+    "check_repo",
     "check_topology",
     "get_rule",
 ]

@@ -1,0 +1,1199 @@
+"""Cross-language ABI contract checker: native/*.cpp vs ctypes bindings.
+
+Every native hot path is a C++ translation unit whose `extern "C"`
+surface is mirrored by a hand-written ctypes binding module.  The
+reference pins the equivalent contracts at compile time with
+FD_STATIC_ASSERT; here nothing checks them, and the failure mode of
+drift is silent wire corruption (a struct field moved, an argtype
+dropped, a mirrored depth constant stale).  This module extracts both
+declarations STATICALLY and diffs them field-by-field:
+
+  - the C side through a small dedicated parser (no libclang — the
+    exported surface is deliberately plain C): `extern "C"` function
+    signatures, struct definitions with computed field offsets/sizes/
+    alignment (the standard x86-64 LP64 rules, which are also exactly
+    ctypes' native-mode rules), and shared constants (enum members,
+    `constexpr` scalars, `#define`s) from the whole file;
+  - the Python side through an AST pass over the binding module:
+    `ctypes.Structure` `_fields_` layouts, `argtypes`/`restype`
+    declarations (including the `getattr(lib, name)`-in-a-loop idiom
+    and `[u64] * 8` repeats), lib-handle call sites with
+    discarded-result tracking, numpy meta-table constructions, and
+    module-level mirrored constants.
+
+Pairing is by the `_SRC` convention: a binding module names its
+translation unit in a `".cpp"` string literal.  Python structs bind to
+C structs positionally, through the function signatures both appear in
+(`argtypes=[POINTER(_Link), ...]` against `fdr_link*` at the same
+position) — no name convention required.  Findings are FD3xx
+(native_rules.py) and flow through the shared framework/baseline/CLI
+machinery, so inline suppressions and `scripts/fdlint.sh` just work.
+
+Known limits (docs/ANALYSIS.md has the full list): the C parser
+understands the plain-C subset the exported surfaces use — bitfields,
+unions, templates and C++ classes in the export path are out of scope;
+an unparseable struct or an unresolvable type degrades to "unknown"
+and is skipped rather than guessed at.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from .framework import Finding
+
+# repo root = parent of the firedancer_tpu package
+_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+NATIVE_DIR = os.path.join(_ROOT, "native")
+
+
+# ===========================================================================
+# type model (shared by both extractors)
+# ===========================================================================
+
+
+class T:
+    """One ABI-relevant type.  kind:
+    'int'    size, signed
+    'float'  size
+    'ptr'    pointee (T) — fn pointers are ptr-to-void
+    'struct' name (by value; C side only in practice)
+    'array'  elem (T), n
+    'void'
+    'charp'  (py only: ctypes.c_char_p — a char*/u8* pointer)
+    'voidp'  (py only: ctypes.c_void_p — any pointer)
+    'unknown'
+    """
+
+    __slots__ = ("kind", "size", "signed", "pointee", "name", "elem", "n")
+
+    def __init__(self, kind, *, size=0, signed=False, pointee=None,
+                 name="", elem=None, n=0):
+        self.kind = kind
+        self.size = size
+        self.signed = signed
+        self.pointee = pointee
+        self.name = name
+        self.elem = elem
+        self.n = n
+
+    def __repr__(self):
+        if self.kind == "int":
+            return f"{'i' if self.signed else 'u'}{self.size * 8}"
+        if self.kind == "float":
+            return f"f{self.size * 8}"
+        if self.kind == "ptr":
+            return f"{self.pointee!r}*"
+        if self.kind == "struct":
+            return f"struct {self.name}"
+        if self.kind == "array":
+            return f"{self.elem!r}[{self.n}]"
+        return self.kind
+
+
+VOID = T("void")
+UNKNOWN = T("unknown")
+
+
+def _align_of(t: T, structs) -> int:
+    if t.kind == "int" or t.kind == "float":
+        return t.size
+    if t.kind in ("ptr", "charp", "voidp"):
+        return 8
+    if t.kind == "array":
+        return _align_of(t.elem, structs)
+    if t.kind == "struct":
+        s = structs.get(t.name)
+        return s.align(structs) if s else 1
+    return 1
+
+
+def _size_of(t: T, structs) -> int:
+    if t.kind in ("int", "float"):
+        return t.size
+    if t.kind in ("ptr", "charp", "voidp"):
+        return 8
+    if t.kind == "array":
+        return t.n * _size_of(t.elem, structs)
+    if t.kind == "struct":
+        s = structs.get(t.name)
+        return s.total(structs) if s else 0
+    return 0
+
+
+class StructDef:
+    """A struct on either side: named fields + computed layout (the
+    standard alignment rules, identical for g++ x86-64 and ctypes)."""
+
+    def __init__(self, name: str, fields, line: int = 0,
+                 complete: bool = True):
+        self.name = name
+        self.fields = fields  # [(fname, T)]
+        self.line = line
+        self.complete = complete  # False: a field failed to parse
+
+    def align(self, structs) -> int:
+        return max([_align_of(t, structs) for _, t in self.fields] or [1])
+
+    def total(self, structs) -> int:
+        off = 0
+        for _, t in self.fields:
+            a = _align_of(t, structs)
+            off = (off + a - 1) // a * a + _size_of(t, structs)
+        a = self.align(structs)
+        return (off + a - 1) // a * a
+
+    def layout(self, structs):
+        """[(fname, offset, size)] under standard alignment."""
+        out, off = [], 0
+        for fname, t in self.fields:
+            a = _align_of(t, structs)
+            off = (off + a - 1) // a * a
+            sz = _size_of(t, structs)
+            out.append((fname, off, sz))
+            off += sz
+        return out
+
+
+class CFunc:
+    def __init__(self, name, ret: T, params, line: int):
+        self.name = name
+        self.ret = ret
+        self.params = params  # [T]
+        self.line = line
+
+
+class CSurface:
+    def __init__(self, path):
+        self.path = path
+        self.funcs: dict[str, CFunc] = {}
+        self.structs: dict[str, StructDef] = {}
+        self.consts: dict[str, int] = {}
+
+
+# ===========================================================================
+# C-side extraction
+# ===========================================================================
+
+_C_INTS = {
+    "char": (1, True), "signed char": (1, True), "int8_t": (1, True),
+    "unsigned char": (1, False), "uint8_t": (1, False), "bool": (1, False),
+    "short": (2, True), "short int": (2, True), "int16_t": (2, True),
+    "unsigned short": (2, False), "uint16_t": (2, False),
+    "int": (4, True), "signed": (4, True), "signed int": (4, True),
+    "int32_t": (4, True),
+    "unsigned": (4, False), "unsigned int": (4, False),
+    "uint32_t": (4, False),
+    "long": (8, True), "long int": (8, True), "long long": (8, True),
+    "int64_t": (8, True), "ssize_t": (8, True), "ptrdiff_t": (8, True),
+    "intptr_t": (8, True),
+    "unsigned long": (8, False), "unsigned long long": (8, False),
+    "uint64_t": (8, False), "size_t": (8, False), "uintptr_t": (8, False),
+    "__int128": (16, True), "unsigned __int128": (16, False),
+}
+_C_KEYWORD_TOKENS = frozenset(
+    "unsigned signed long short int char bool const volatile struct "
+    "enum union __int128 restrict __restrict".split()
+)
+
+
+def _strip_c(text: str) -> str:
+    """Remove comments and string/char-literal CONTENT, preserving
+    newlines (line numbers must survive for findings)."""
+    out = []
+    i, n = 0, len(text)
+    while i < n:
+        c = text[i]
+        if c == "/" and i + 1 < n and text[i + 1] == "/":
+            j = text.find("\n", i)
+            i = n if j < 0 else j
+        elif c == "/" and i + 1 < n and text[i + 1] == "*":
+            j = text.find("*/", i + 2)
+            seg = text[i: n if j < 0 else j + 2]
+            out.append("\n" * seg.count("\n"))
+            i = n if j < 0 else j + 2
+        elif c in "\"'":
+            q, j = c, i + 1
+            while j < n and text[j] != q:
+                j += 2 if text[j] == "\\" else 1
+            out.append(q + q)
+            i = min(j + 1, n)
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+_INT_EXPR_RE = re.compile(r"^[\w\s()+\-*/<>|&^~]+$")
+
+
+def _c_int_expr(expr: str, consts: dict[str, int]) -> int | None:
+    """Fold a plain-C integer constant expression (suffixes stripped,
+    names resolved from already-known constants)."""
+    expr = re.sub(r"\b(0[xX][0-9a-fA-F]+|\d+)[uUlL]*", r"\1", expr).strip()
+    if not expr or not _INT_EXPR_RE.match(expr):
+        return None
+    names = set(re.findall(r"[A-Za-z_]\w*", expr))
+    env = {}
+    for nm in names:
+        if nm not in consts:
+            return None
+        env[nm] = consts[nm]
+    try:
+        v = eval(compile(expr, "<abi-const>", "eval"), {"__builtins__": {}},
+                 env)
+    except Exception:
+        return None
+    return int(v) if isinstance(v, int) else None
+
+
+def _c_collect_consts(text: str, consts: dict[str, int]) -> None:
+    for m in re.finditer(r"^[ \t]*#[ \t]*define[ \t]+(\w+)[ \t]+(.+?)$",
+                        text, re.M):
+        v = _c_int_expr(m.group(2), consts)
+        if v is not None:
+            consts[m.group(1)] = v
+    for m in re.finditer(
+            r"\b(?:constexpr|static\s+const(?:expr)?)\s+[\w:]+(?:\s+[\w:]+)*"
+            r"\s+(\w+)\s*=\s*([^;{]+);", text):
+        v = _c_int_expr(m.group(2), consts)
+        if v is not None:
+            consts[m.group(1)] = v
+    for m in re.finditer(r"\benum\b[^{;(]*\{([^}]*)\}", text):
+        nxt = 0
+        for ent in m.group(1).split(","):
+            ent = ent.strip()
+            if not ent:
+                continue
+            if "=" in ent:
+                nm, _, val = ent.partition("=")
+                v = _c_int_expr(val, consts)
+                if v is None:
+                    nxt = None
+                    continue
+                consts[nm.strip()] = v
+                nxt = v + 1
+            elif nxt is not None and re.match(r"^\w+$", ent):
+                consts[ent] = nxt
+                nxt += 1
+
+
+def _c_collect_typedefs(text: str):
+    """name -> T for simple and function-pointer typedefs/usings."""
+    tds: dict[str, T] = {}
+    for m in re.finditer(r"\btypedef\s+([\w\s]+?)\s*(\**)\s*(\w+)\s*;", text):
+        base = " ".join(m.group(1).split())
+        t = _c_base_type(base, tds, {})
+        for _ in m.group(2):
+            t = T("ptr", pointee=t)
+        tds[m.group(3)] = t
+    for m in re.finditer(r"\busing\s+(\w+)\s*=\s*([\w\s]+?)\s*(\**)\s*;",
+                        text):
+        t = _c_base_type(" ".join(m.group(2).split()), tds, {})
+        for _ in m.group(3):
+            t = T("ptr", pointee=t)
+        tds[m.group(1)] = t
+    for m in re.finditer(
+            r"\btypedef\s+[\w\s*]+\(\s*\*\s*(\w+)\s*\)\s*\(", text):
+        tds[m.group(1)] = T("ptr", pointee=VOID)  # fn ptr: opaque pointer
+    return tds
+
+
+def _c_base_type(base: str, typedefs, structs) -> T:
+    base = base.replace("struct ", "").strip()
+    if base == "void":
+        return VOID
+    if base in ("float",):
+        return T("float", size=4)
+    if base in ("double",):
+        return T("float", size=8)
+    if base in _C_INTS:
+        sz, sg = _C_INTS[base]
+        return T("int", size=sz, signed=sg)
+    if base in typedefs:
+        return typedefs[base]
+    if base in structs:
+        return T("struct", name=base)
+    return UNKNOWN
+
+
+def _c_parse_decl_type(decl: str, typedefs, structs, consts):
+    """One declarator ('const fdr_link* const* links', 'uint64_t
+    rel_idx[FDR_MAX_REL]', 'int (*cb)(...)') -> (T, name|None).
+    Arrays in PARAMETER position must be decayed by the caller."""
+    decl = decl.strip()
+    if not decl:
+        return None, None
+    fn = re.match(r"^[\w\s*]+\(\s*\*\s*(\w*)\s*\)\s*\(.*\)$", decl,
+                  re.S)
+    if fn:  # function-pointer declarator
+        return T("ptr", pointee=VOID), (fn.group(1) or None)
+    arr_n = None
+    am = re.search(r"\[([^\]]*)\]\s*$", decl)
+    if am:
+        arr_n = _c_int_expr(am.group(1), consts) if am.group(1).strip() \
+            else 0
+        decl = decl[: am.start()]
+    stars = decl.count("*")
+    decl = decl.replace("*", " ")
+    toks = [t for t in decl.split()
+            if t not in ("const", "volatile", "restrict", "__restrict")]
+    if not toks:
+        return None, None
+    name = None
+    base_toks = toks
+    if len(toks) >= 2:
+        # the last token is the declarator name unless it is part of a
+        # multiword base ('unsigned long long') or the only type token
+        tail = toks[-1]
+        head = toks[:-1]
+        if tail not in _C_KEYWORD_TOKENS and (
+            all(h in _C_KEYWORD_TOKENS for h in head)
+            or " ".join(head) in _C_INTS
+            or head[-1] in typedefs or head[-1] in structs
+            or head[-1] == "void" or head[-1] in ("float", "double")
+        ):
+            name, base_toks = tail, head
+    t = _c_base_type(" ".join(base_toks), typedefs, structs)
+    for _ in range(stars):
+        t = T("ptr", pointee=t)
+    if arr_n is not None:
+        if arr_n and t.kind != "unknown":
+            t = T("array", elem=t, n=arr_n)
+        else:
+            t = UNKNOWN
+    return t, name
+
+
+def _c_collect_structs(text: str, typedefs, consts):
+    structs: dict[str, StructDef] = {}
+    for m in re.finditer(r"\bstruct\s+(\w+)\s*\{", text):
+        name = m.group(1)
+        body, _end = _balanced(text, m.end() - 1)
+        if body is None:
+            continue
+        fields, complete = [], True
+        for decl in body.split(";"):
+            decl = decl.strip()
+            if not decl:
+                continue
+            if "(" in decl or "{" in decl:  # method / nested: unsupported
+                complete = False
+                continue
+            # comma declarators: split on commas OUTSIDE brackets
+            first_t = None
+            parts = [p for p in re.split(r",", decl) if p.strip()]
+            for k, part in enumerate(parts):
+                if k == 0:
+                    t, fname = _c_parse_decl_type(part, typedefs, structs,
+                                                  consts)
+                    first_t = t
+                else:
+                    # 'uint64_t a, b' — reuse the base type
+                    fname = part.strip().strip("*")
+                    t = first_t
+                if t is None or fname is None or t.kind == "unknown":
+                    complete = False
+                    continue
+                fields.append((fname, t))
+        line = text.count("\n", 0, m.start()) + 1
+        structs[name] = StructDef(name, fields, line, complete)
+    return structs
+
+
+def _balanced(text: str, open_idx: int):
+    """text[open_idx] == '{' -> (body, index past the closing brace)."""
+    depth = 0
+    for i in range(open_idx, len(text)):
+        if text[i] == "{":
+            depth += 1
+        elif text[i] == "}":
+            depth -= 1
+            if depth == 0:
+                return text[open_idx + 1: i], i + 1
+    return None, len(text)
+
+
+def _c_extern_regions(text: str):
+    """[(offset, region_text)] for every extern "C" { ... } block."""
+    out = []
+    for m in re.finditer(r'\bextern\s*""\s*\{', text):
+        body, _ = _balanced(text, m.end() - 1)
+        if body is not None:
+            out.append((m.end(), body))
+    return out
+
+
+def _c_collect_funcs(text: str, surface: CSurface, typedefs) -> None:
+    for base_off, region in _c_extern_regions(text):
+        i, n = 0, len(region)
+        stmt_start = 0
+        while i < n:
+            c = region[i]
+            if c == ";":
+                stmt_start = i + 1
+                i += 1
+            elif c == "{":
+                header = region[stmt_start:i].strip()
+                _, past = _balanced(region, i)
+                if re.match(r"^(struct|enum|union|class)\b", header) \
+                        or "(" not in header:
+                    # struct/enum body; `};` terminates it
+                    i = past
+                    continue
+                fn = _c_parse_func_header(header, surface, typedefs,
+                                          text.count("\n", 0, base_off +
+                                                     stmt_start) + 1)
+                if fn is not None:
+                    surface.funcs[fn.name] = fn
+                i = past
+                stmt_start = i
+            else:
+                i += 1
+
+
+def _c_parse_func_header(header: str, surface: CSurface, typedefs,
+                         line: int):
+    header = " ".join(header.split())
+    if header.startswith(("static ", "inline ", "static inline ")):
+        return None  # not exported
+    p = header.find("(")
+    if p < 0:
+        return None
+    pre = header[:p].rstrip()
+    m = re.search(r"(\w+)$", pre)
+    if not m:
+        return None
+    name = m.group(1)
+    ret, _ = _c_parse_decl_type(pre[: m.start()] or "void", typedefs,
+                                surface.structs, surface.consts)
+    if ret is None:
+        ret = UNKNOWN
+    # params: balanced through the matching ')'
+    depth, j = 0, p
+    while j < len(header):
+        if header[j] == "(":
+            depth += 1
+        elif header[j] == ")":
+            depth -= 1
+            if depth == 0:
+                break
+        j += 1
+    params_text = header[p + 1: j]
+    params: list[T] = []
+    if params_text.strip() not in ("", "void"):
+        for part in _split_top(params_text):
+            t, _nm = _c_parse_decl_type(part, typedefs, surface.structs,
+                                        surface.consts)
+            if t is None:
+                t = UNKNOWN
+            if t.kind == "array":  # parameter arrays decay to pointers
+                t = T("ptr", pointee=t.elem)
+            params.append(t)
+    return CFunc(name, ret, params, line)
+
+
+def _split_top(s: str):
+    out, depth, start = [], 0, 0
+    for i, c in enumerate(s):
+        if c in "([":
+            depth += 1
+        elif c in ")]":
+            depth -= 1
+        elif c == "," and depth == 0:
+            out.append(s[start:i])
+            start = i + 1
+    out.append(s[start:])
+    return out
+
+
+def extract_c(path: str) -> CSurface:
+    """The exported ABI surface of one C++ translation unit."""
+    with open(path, encoding="utf-8") as fh:
+        text = _strip_c(fh.read())
+    surface = CSurface(path)
+    _c_collect_consts(text, surface.consts)
+    typedefs = _c_collect_typedefs(text)
+    surface.structs = _c_collect_structs(text, typedefs, surface.consts)
+    _c_collect_funcs(text, surface, typedefs)
+    return surface
+
+
+# ===========================================================================
+# Python-side extraction
+# ===========================================================================
+
+_PY_CTYPES = {
+    "c_int8": (1, True), "c_byte": (1, True),
+    "c_uint8": (1, False), "c_ubyte": (1, False), "c_bool": (1, False),
+    "c_char": (1, False),
+    "c_int16": (2, True), "c_short": (2, True),
+    "c_uint16": (2, False), "c_ushort": (2, False),
+    "c_int32": (4, True), "c_int": (4, True),
+    "c_uint32": (4, False), "c_uint": (4, False),
+    "c_int64": (8, True), "c_long": (8, True), "c_longlong": (8, True),
+    "c_ssize_t": (8, True),
+    "c_uint64": (8, False), "c_ulong": (8, False),
+    "c_ulonglong": (8, False), "c_size_t": (8, False),
+}
+
+
+class PyBinding:
+    def __init__(self, path):
+        self.path = path
+        self.cpp: str | None = None  # basename of the paired .cpp
+        self.structs: dict[str, StructDef] = {}
+        self.argtypes: dict[str, tuple[list | None, int]] = {}
+        self.restypes: dict[str, tuple[T, int]] = {}
+        self.calls: list[tuple[str, int, bool]] = []  # (fn, line, discarded)
+        self.consts: dict[str, tuple[int, int]] = {}  # name -> (value, line)
+        self.tables: list[tuple[int, str | None, int | None, str]] = []
+
+
+class _PyExtractor:
+    """In-order AST walk: aliases/assignments are resolved as they are
+    met (the binding modules declare before use)."""
+
+    def __init__(self, tree: ast.Module, path: str):
+        self.b = PyBinding(path)
+        self.types: dict[str, T] = {}  # name -> resolved ctype
+        self.ctypes_names = {"ctypes"}  # module aliases
+        self.np_names = {"np", "numpy"}
+        self.libnames: set[str] = set()
+        self.loopvars: dict[str, tuple[str, ...]] = {}
+        self._walk_body(tree.body, module_level=True)
+
+    # -- type expression resolution -----------------------------------------
+
+    def _resolve_type(self, node: ast.AST) -> T:
+        if isinstance(node, ast.Constant) and node.value is None:
+            return VOID
+        if isinstance(node, ast.Name):
+            if node.id in self.types:
+                return self.types[node.id]
+            if node.id in self.b.structs:
+                return T("struct", name=node.id)
+            if node.id in _PY_CTYPES:  # from ctypes import c_uint64
+                sz, sg = _PY_CTYPES[node.id]
+                return T("int", size=sz, signed=sg)
+            if node.id == "c_char_p":
+                return T("charp")
+            if node.id == "c_void_p":
+                return T("voidp")
+            if node.id in ("c_float",):
+                return T("float", size=4)
+            if node.id in ("c_double",):
+                return T("float", size=8)
+            return UNKNOWN
+        if isinstance(node, ast.Attribute):
+            root = node.value
+            if isinstance(root, ast.Name) and root.id in self.ctypes_names:
+                a = node.attr
+                if a in _PY_CTYPES:
+                    sz, sg = _PY_CTYPES[a]
+                    return T("int", size=sz, signed=sg)
+                if a == "c_char_p":
+                    return T("charp")
+                if a == "c_void_p":
+                    return T("voidp")
+                if a == "c_float":
+                    return T("float", size=4)
+                if a == "c_double":
+                    return T("float", size=8)
+            return UNKNOWN
+        if isinstance(node, ast.Call):
+            f = node.func
+            fname = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else "")
+            if fname == "POINTER" and len(node.args) == 1:
+                inner = self._resolve_type(node.args[0])
+                return UNKNOWN if inner.kind == "unknown" \
+                    else T("ptr", pointee=inner)
+            return UNKNOWN
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mult):
+            elem = self._resolve_type(node.left)
+            n = self._const_int(node.right)
+            if elem.kind != "unknown" and n is not None:
+                return T("array", elem=elem, n=n)
+            return UNKNOWN
+        return UNKNOWN
+
+    def _const_int(self, node: ast.AST) -> int | None:
+        if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+                and not isinstance(node.value, bool):
+            return node.value
+        if isinstance(node, ast.Name) and node.id in self.b.consts:
+            return self.b.consts[node.id][0]
+        if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+            v = self._const_int(node.operand)
+            return None if v is None else -v
+        if isinstance(node, ast.BinOp):
+            left = self._const_int(node.left)
+            right = self._const_int(node.right)
+            if left is None or right is None:
+                return None
+            op = node.op
+            if isinstance(op, ast.Add):
+                return left + right
+            if isinstance(op, ast.Sub):
+                return left - right
+            if isinstance(op, ast.Mult):
+                return left * right
+            if isinstance(op, ast.LShift):
+                return left << right
+            if isinstance(op, ast.RShift):
+                return left >> right
+            if isinstance(op, ast.BitOr):
+                return left | right
+            if isinstance(op, ast.BitAnd):
+                return left & right
+            if isinstance(op, ast.FloorDiv) and right:
+                return left // right
+        return None
+
+    def _type_list(self, node: ast.AST) -> list | None:
+        if isinstance(node, (ast.List, ast.Tuple)):
+            return [self._resolve_type(e) for e in node.elts]
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mult):
+            base = self._type_list(node.left)
+            n = self._const_int(node.right)
+            if base is None:
+                base = self._type_list(node.right)
+                n = self._const_int(node.left)
+            if base is not None and n is not None:
+                return base * n
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+            a = self._type_list(node.left)
+            c = self._type_list(node.right)
+            if a is not None and c is not None:
+                return a + c
+        return None
+
+    # -- lib handles + declaration targets ----------------------------------
+
+    def _lib_fn_of(self, node: ast.AST) -> list[str] | None:
+        """`lib.fdr_poll` / `self._lib.fdr_poll` / `getattr(lib, name)`
+        -> exported function name(s), else None."""
+        if isinstance(node, ast.Attribute):
+            v = node.value
+            if isinstance(v, ast.Name) and v.id in self.libnames:
+                return [node.attr]
+            if isinstance(v, ast.Attribute) and v.attr == "_lib" \
+                    and isinstance(v.value, ast.Name) \
+                    and v.value.id == "self":
+                return [node.attr]
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                and node.func.id == "getattr" and len(node.args) == 2:
+            recv, key = node.args
+            recv_ok = (isinstance(recv, ast.Name)
+                       and recv.id in self.libnames)
+            if recv_ok:
+                if isinstance(key, ast.Constant) \
+                        and isinstance(key.value, str):
+                    return [key.value]
+                if isinstance(key, ast.Name) and key.id in self.loopvars:
+                    return list(self.loopvars[key.id])
+        return None
+
+    def _is_lib_load(self, node: ast.AST) -> bool:
+        """RHS that yields a lib handle: ctypes.CDLL(...) or a bare
+        `_load()` / `_host_lib()`-style loader of THIS module (an
+        attribute `other._load()` is another module's lib and must not
+        be treated as ours)."""
+        if not isinstance(node, ast.Call):
+            return False
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "CDLL" \
+                and isinstance(f.value, ast.Name) \
+                and f.value.id in self.ctypes_names:
+            return True
+        if isinstance(f, ast.Name) \
+                and re.match(r"^_\w*(load|lib)\w*$", f.id):
+            return True
+        return False
+
+    # -- walk ----------------------------------------------------------------
+
+    def _walk_body(self, body, module_level=False):
+        for stmt in body:
+            self._walk_stmt(stmt, module_level)
+
+    def _walk_stmt(self, stmt, module_level=False):
+        if isinstance(stmt, ast.Import):
+            for a in stmt.names:
+                if a.name == "ctypes":
+                    self.ctypes_names.add(a.asname or "ctypes")
+                if a.name == "numpy":
+                    self.np_names.add(a.asname or "numpy")
+        elif isinstance(stmt, ast.ImportFrom):
+            pass
+        elif isinstance(stmt, ast.ClassDef):
+            self._maybe_structure(stmt)
+            self._walk_body(stmt.body)
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._walk_body(stmt.body)
+        elif isinstance(stmt, ast.For):
+            names = None
+            if isinstance(stmt.target, ast.Name) \
+                    and isinstance(stmt.iter, (ast.Tuple, ast.List)) \
+                    and all(isinstance(e, ast.Constant)
+                            and isinstance(e.value, str)
+                            for e in stmt.iter.elts):
+                names = tuple(e.value for e in stmt.iter.elts)
+                self.loopvars[stmt.target.id] = names
+            self._walk_body(stmt.body)
+            self._walk_body(stmt.orelse)
+            if names is not None:
+                self.loopvars.pop(stmt.target.id, None)
+        elif isinstance(stmt, (ast.If, ast.While)):
+            self._scan_expr(stmt.test)
+            self._walk_body(stmt.body)
+            self._walk_body(stmt.orelse)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            self._walk_body(stmt.body)
+        elif isinstance(stmt, ast.Try):
+            self._walk_body(stmt.body)
+            for h in stmt.handlers:
+                self._walk_body(h.body)
+            self._walk_body(stmt.orelse)
+            self._walk_body(stmt.finalbody)
+        elif isinstance(stmt, ast.Assign):
+            self._handle_assign(stmt, module_level)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            self._scan_expr(stmt.value)
+        elif isinstance(stmt, ast.Expr):
+            self._handle_expr_stmt(stmt)
+        elif isinstance(stmt, ast.Return) and stmt.value is not None:
+            self._scan_expr(stmt.value)
+        else:
+            for sub in ast.iter_child_nodes(stmt):
+                if isinstance(sub, ast.expr):
+                    self._scan_expr(sub)
+
+    def _maybe_structure(self, cls: ast.ClassDef) -> None:
+        is_struct = any(
+            (isinstance(b, ast.Attribute) and b.attr == "Structure")
+            or (isinstance(b, ast.Name) and b.id == "Structure")
+            for b in cls.bases
+        )
+        if not is_struct:
+            return
+        for stmt in cls.body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name) \
+                    and stmt.targets[0].id == "_fields_" \
+                    and isinstance(stmt.value, (ast.List, ast.Tuple)):
+                fields, complete = [], True
+                for e in stmt.value.elts:
+                    if isinstance(e, ast.Tuple) and len(e.elts) >= 2 \
+                            and isinstance(e.elts[0], ast.Constant):
+                        t = self._resolve_type(e.elts[1])
+                        if t.kind == "unknown":
+                            complete = False
+                        fields.append((e.elts[0].value, t))
+                    else:
+                        complete = False
+                self.b.structs[cls.name] = StructDef(
+                    cls.name, fields, cls.lineno, complete)
+
+    def _handle_assign(self, stmt: ast.Assign, module_level: bool) -> None:
+        tgt = stmt.targets[0] if len(stmt.targets) == 1 else None
+        # `.cpp` pairing literal
+        for sub in ast.walk(stmt.value):
+            if isinstance(sub, ast.Constant) and isinstance(sub.value, str) \
+                    and sub.value.endswith(".cpp") and self.b.cpp is None:
+                self.b.cpp = os.path.basename(sub.value)
+        # lib handle binding
+        if isinstance(tgt, ast.Name) and self._is_lib_load(stmt.value):
+            self.libnames.add(tgt.id)
+        # argtypes / restype
+        if isinstance(tgt, ast.Attribute) and tgt.attr in ("argtypes",
+                                                           "restype"):
+            fns = self._lib_fn_of(tgt.value)
+            if fns:
+                if tgt.attr == "argtypes":
+                    tl = self._type_list(stmt.value)
+                    for fn in fns:
+                        self.b.argtypes[fn] = (tl, stmt.lineno)
+                else:
+                    rt = self._resolve_type(stmt.value)
+                    for fn in fns:
+                        self.b.restypes[fn] = (rt, stmt.lineno)
+                return
+        # module constants
+        if module_level and isinstance(tgt, ast.Name):
+            v = self._const_int(stmt.value)
+            nm = tgt.id
+            if v is not None and nm.lstrip("_").isupper() \
+                    and nm not in self.b.consts:
+                self.b.consts[nm] = (v, stmt.lineno)
+        # ctype alias (anywhere): u64 = ctypes.c_uint64, PL = POINTER(_Link),
+        # incl. tuple unpacking (`u64, vp = ctypes.c_uint64, ctypes.c_void_p`)
+        if isinstance(tgt, ast.Name):
+            t = self._resolve_type(stmt.value)
+            if t.kind != "unknown":
+                self.types[tgt.id] = t
+        elif isinstance(tgt, ast.Tuple) \
+                and isinstance(stmt.value, ast.Tuple) \
+                and len(tgt.elts) == len(stmt.value.elts):
+            for te, ve in zip(tgt.elts, stmt.value.elts):
+                if isinstance(te, ast.Name):
+                    t = self._resolve_type(ve)
+                    if t.kind != "unknown":
+                        self.types[te.id] = t
+        self._scan_expr(stmt.value)
+
+    def _handle_expr_stmt(self, stmt: ast.Expr) -> None:
+        v = stmt.value
+        if isinstance(v, ast.Call) and isinstance(v.func, ast.Attribute):
+            fns = self._lib_fn_of(v.func)
+            if fns:
+                for fn in fns:
+                    self.b.calls.append((fn, v.lineno, True))
+                for a in list(v.args) + [kw.value for kw in v.keywords]:
+                    self._scan_expr(a)
+                return
+        self._scan_expr(v)
+
+    def _scan_expr(self, node: ast.AST) -> None:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Constant) and isinstance(sub.value, str) \
+                    and sub.value.endswith(".cpp") and self.b.cpp is None:
+                self.b.cpp = os.path.basename(sub.value)
+            if isinstance(sub, ast.Call):
+                if isinstance(sub.func, ast.Attribute):
+                    fns = self._lib_fn_of(sub.func)
+                    if fns:
+                        for fn in fns:
+                            self.b.calls.append((fn, sub.lineno, False))
+                        continue
+                self._maybe_table(sub)
+
+    def _maybe_table(self, call: ast.Call) -> None:
+        f = call.func
+        if not (isinstance(f, ast.Attribute) and f.attr in ("zeros", "empty")
+                and isinstance(f.value, ast.Name)
+                and f.value.id in self.np_names):
+            return
+        if not call.args or not isinstance(call.args[0], ast.Tuple) \
+                or len(call.args[0].elts) != 2:
+            return
+        cols = call.args[0].elts[1]
+        cols_name = cols.id if isinstance(cols, ast.Name) else None
+        cols_val = self._const_int(cols)
+        dtype = ""
+        for kw in call.keywords:
+            if kw.arg == "dtype" and isinstance(kw.value, ast.Attribute):
+                dtype = kw.value.attr
+        self.b.tables.append((call.lineno, cols_name, cols_val, dtype))
+
+
+def extract_py(path: str) -> PyBinding:
+    with open(path, encoding="utf-8") as fh:
+        tree = ast.parse(fh.read(), filename=path)
+    return _PyExtractor(tree, path).b
+
+
+# ===========================================================================
+# the differ
+# ===========================================================================
+
+
+def _compat_arg(ct: T, pt: T, bindings: dict) -> str | None:
+    """Why py argtype `pt` cannot marshal C param `ct` (None = fine).
+    `bindings` accumulates pystruct->cstruct pairings discovered at
+    pointer positions."""
+    if ct.kind == "unknown" or pt.kind == "unknown":
+        return None
+    if pt.kind == "voidp":
+        if ct.kind == "ptr":
+            return None
+        return f"c_void_p passed for non-pointer C type {ct!r}"
+    if pt.kind == "charp":
+        if ct.kind == "ptr" and (
+                ct.pointee.kind == "void"
+                or (ct.pointee.kind == "int" and ct.pointee.size == 1)):
+            return None
+        if ct.kind == "ptr":
+            return f"c_char_p passed for {ct!r} (pointee is not bytes)"
+        return f"c_char_p passed for non-pointer C type {ct!r}"
+    if pt.kind == "ptr":
+        if ct.kind != "ptr":
+            return f"POINTER argtype for non-pointer C type {ct!r}"
+        ci, pi = ct.pointee, pt.pointee
+        if pi.kind == "struct":
+            if ci.kind == "struct":
+                prev = bindings.setdefault(pi.name, ci.name)
+                if prev != ci.name:
+                    return (f"POINTER({pi.name}) bound to both"
+                            f" {prev} and {ci.name}")
+                return None
+            if ci.kind == "void":
+                return None
+            return f"POINTER({pi.name}) passed for {ct!r}"
+        if pi.kind == "ptr" and ci.kind == "ptr":
+            return _compat_arg(ci, pi, bindings)
+        if pi.kind == "int" and ci.kind == "int":
+            if pi.size != ci.size:
+                return f"POINTER({pi!r}) vs C {ct!r} (pointee size)"
+            return None
+        if ci.kind in ("void", "unknown") or pi.kind == "unknown":
+            return None
+        return f"POINTER({pi!r}) vs C {ct!r}"
+    if pt.kind == "int":
+        if ct.kind != "int":
+            return f"integer argtype {pt!r} for C type {ct!r}"
+        if pt.size != ct.size:
+            return f"{pt!r} vs C {ct!r} (size {pt.size} != {ct.size})"
+        if pt.signed != ct.signed:
+            return f"{pt!r} vs C {ct!r} (signedness)"
+        return None
+    if pt.kind == "float":
+        if ct.kind == "float" and ct.size == pt.size:
+            return None
+        return f"{pt!r} vs C {ct!r}"
+    if pt.kind == "array":
+        return f"by-value array argtype {pt!r} (pass a POINTER)"
+    return None
+
+
+def _compat_ret(ct: T, pt: T | None) -> str | None:
+    """Why the declared restype (None = never declared -> implicit
+    c_int) cannot carry C return type `ct`."""
+    if ct.kind == "unknown":
+        return None
+    if pt is None:  # ctypes default: c_int
+        if ct.kind == "void":
+            return None
+        if ct.kind == "ptr":
+            return ("no restype on a pointer-returning function: the"
+                    " implicit c_int truncates the pointer to 32 bits")
+        if ct.kind == "int" and ct.size > 4:
+            return (f"no restype on a function returning {ct!r}: the"
+                    " implicit c_int truncates to 32 bits")
+        return None
+    if pt.kind == "unknown":
+        return None
+    if ct.kind == "void":
+        return (f"restype {pt!r} declared on a void function (reads"
+                " garbage)")
+    if ct.kind == "ptr":
+        if pt.kind in ("voidp", "charp") or pt.kind == "ptr":
+            return None
+        return f"restype {pt!r} for pointer return {ct!r}"
+    if ct.kind == "int":
+        if pt.kind != "int":
+            return f"restype {pt!r} for C return {ct!r}"
+        if pt.size != ct.size:
+            return (f"restype {pt!r} vs C return {ct!r} (size"
+                    f" {pt.size} != {ct.size})")
+        if pt.signed != ct.signed:
+            return f"restype {pt!r} vs C return {ct!r} (signedness)"
+        return None
+    if ct.kind == "float":
+        if pt.kind == "float" and pt.size == ct.size:
+            return None
+        return f"restype {pt!r} for C return {ct!r}"
+    return None
+
+
+def _diff_struct(py: StructDef, cs: StructDef, c_structs,
+                 py_structs) -> list[str]:
+    """Human-readable layout differences (empty = layouts agree)."""
+    probs: list[str] = []
+    pl = py.layout(py_structs)
+    cl = cs.layout(c_structs)
+    if len(pl) != len(cl):
+        probs.append(f"field count {len(pl)} != C {len(cl)}")
+    for i, ((pn, po, ps), (cn, co, csz)) in enumerate(zip(pl, cl)):
+        if pn != cn:
+            probs.append(f"field {i} named '{pn}' vs C '{cn}'")
+        if po != co:
+            probs.append(f"field '{pn}' at offset {po} vs C {co}")
+        if ps != csz:
+            probs.append(f"field '{pn}' size {ps} vs C {csz}")
+        if probs:
+            break  # first divergence poisons everything after it
+    pt, ct_ = py.total(py_structs), cs.total(c_structs)
+    if not probs and pt != ct_:
+        probs.append(f"sizeof {pt} != C {ct_}")
+    return probs
+
+
+def check_pair(py_path: str, cpp_path: str) -> list[Finding]:
+    """Diff one binding module against its paired translation unit.
+    Inline `# fdlint: disable=FD3xx -- reason` comments on the Python
+    declaration/call line mark findings suppressed, exactly like the
+    AST rules."""
+    b = extract_py(py_path)
+    c = extract_c(cpp_path)
+    relp = os.path.relpath(py_path, _ROOT) if py_path.startswith(_ROOT) \
+        else py_path
+    cbase = os.path.basename(cpp_path)
+    findings: list[Finding] = []
+
+    def hit(rule, line, msg):
+        findings.append(Finding(rule=rule, path=relp, line=line, msg=msg))
+
+    bindings: dict[str, str] = {}  # py struct -> C struct
+
+    # -- declared argtypes vs C signatures -----------------------------------
+    for fn, (tl, line) in sorted(b.argtypes.items()):
+        cf = c.funcs.get(fn)
+        if cf is None:
+            hit("FD308", line,
+                f"argtypes declared for '{fn}', which {cbase} does not"
+                " export")
+            continue
+        if tl is None:
+            continue  # unresolvable list: out of the static subset
+        if len(tl) != len(cf.params):
+            hit("FD304", line,
+                f"'{fn}' declares {len(tl)} argtypes but {cbase}:"
+                f"{cf.line} takes {len(cf.params)} parameters")
+            continue
+        for i, (pt, ct) in enumerate(zip(tl, cf.params)):
+            why = _compat_arg(ct, pt, bindings)
+            if why:
+                hit("FD304", line, f"'{fn}' argtypes[{i}]: {why}"
+                    f" ({cbase}:{cf.line})")
+
+    # -- restypes -------------------------------------------------------------
+    for fn, (rt, line) in sorted(b.restypes.items()):
+        cf = c.funcs.get(fn)
+        if cf is None:
+            hit("FD308", line,
+                f"restype declared for '{fn}', which {cbase} does not"
+                " export")
+            continue
+        why = _compat_ret(cf.ret, rt)
+        if why:
+            hit("FD303", line, f"'{fn}': {why} ({cbase}:{cf.line})")
+
+    # -- implicit restype (declared-or-called functions) ----------------------
+    referenced: dict[str, int] = {}  # fn -> first line it is referenced
+    for fn, (_tl, line) in b.argtypes.items():
+        referenced.setdefault(fn, line)
+    for fn, line, _disc in b.calls:
+        referenced.setdefault(fn, line)
+    for fn, line in sorted(referenced.items()):
+        cf = c.funcs.get(fn)
+        if cf is None or fn in b.restypes:
+            continue
+        why = _compat_ret(cf.ret, None)
+        if why:
+            hit("FD303", line, f"'{fn}': {why} ({cbase}:{cf.line})")
+
+    # -- call sites -----------------------------------------------------------
+    seen_unknown: set[str] = set()
+    seen_noargs: set[str] = set()
+    for fn, line, discarded in b.calls:
+        cf = c.funcs.get(fn)
+        if cf is None:
+            if fn not in seen_unknown and fn not in b.argtypes \
+                    and fn not in b.restypes:
+                seen_unknown.add(fn)
+                hit("FD308", line,
+                    f"call to '{fn}', which {cbase} does not export")
+            continue
+        if fn not in b.argtypes and cf.params and fn not in seen_noargs:
+            seen_noargs.add(fn)
+            hit("FD302", line,
+                f"'{fn}' called with no argtypes declared"
+                f" ({len(cf.params)} parameters at {cbase}:{cf.line}:"
+                " ctypes guesses the marshalling)")
+        if discarded and cf.ret.kind == "int" and cf.ret.signed:
+            hit("FD306", line,
+                f"result of '{fn}' discarded but {cbase}:{cf.line}"
+                f" returns {cf.ret!r} (signed error-code convention)"
+                " — check it or document why it cannot fail")
+
+    # -- struct layouts (via the signature-position bindings) -----------------
+    for pyname, cname in sorted(bindings.items()):
+        ps = b.structs.get(pyname)
+        cs = c.structs.get(cname)
+        if ps is None or cs is None or not cs.complete \
+                or not ps.complete:
+            continue
+        probs = _diff_struct(ps, cs, c.structs, b.structs)
+        if probs:
+            hit("FD301", ps.line,
+                f"struct {pyname} vs {cbase} {cname}:{cs.line}: "
+                + "; ".join(probs))
+
+    # -- mirrored constants ---------------------------------------------------
+    for name, (val, line) in sorted(b.consts.items()):
+        cval = c.consts.get(name.lstrip("_"))
+        if cval is not None and cval != val:
+            hit("FD305", line,
+                f"constant {name} = {val} but {cbase} defines"
+                f" {name.lstrip('_')} = {cval}")
+
+    # -- numpy meta-table contracts -------------------------------------------
+    for line, cols_name, cols_val, dtype in b.tables:
+        key = cols_name.lstrip("_") if cols_name else None
+        if key and key in c.consts and dtype != "uint64":
+            hit("FD307", line,
+                f"table with {cols_name} columns (a {cbase} contract)"
+                f" declared dtype {dtype or '<default float64>'} — the"
+                " C side indexes u64 rows")
+
+    from .ast_rules import _disabled_lines
+
+    with open(py_path, encoding="utf-8") as fh:
+        disabled = _disabled_lines(fh.read())
+    for f in findings:
+        ids = disabled.get(f.line)
+        if ids and f.rule in ids:
+            f.suppressed = "inline"
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+# ===========================================================================
+# repo discovery + entry point
+# ===========================================================================
+
+
+def discover_bindings(pkg_root: str | None = None,
+                      native_dir: str | None = None):
+    """[(py_path, cpp_path)] for every binding module: imports ctypes
+    AND names a native/*.cpp translation unit in a string literal."""
+    pkg_root = pkg_root or os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    native_dir = native_dir or NATIVE_DIR
+    pairs = []
+    for dirpath, dirnames, filenames in os.walk(pkg_root):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d not in {"__pycache__", ".git"})
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path, encoding="utf-8") as fh:
+                src = fh.read()
+            if "ctypes" not in src:
+                continue
+            m = re.search(r'["\']([\w./]*?(\w+\.cpp))["\']', src)
+            if not m:
+                continue
+            cpp = os.path.join(native_dir, m.group(2))
+            if os.path.exists(cpp):
+                pairs.append((path, cpp))
+    return pairs
+
+
+def check_repo(pkg_root: str | None = None,
+               native_dir: str | None = None) -> list[Finding]:
+    """The full ABI pass: every discovered binding pair, diffed.  The
+    CLI runs this once per invocation (and the fdlint gate test runs
+    the CLI once per suite) — the whole pass is pure parsing, well
+    under the 5 s tier-1 budget."""
+    findings: list[Finding] = []
+    for py_path, cpp_path in discover_bindings(pkg_root, native_dir):
+        findings.extend(check_pair(py_path, cpp_path))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings

@@ -33,18 +33,24 @@ DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.toml")
 
 def load_baseline(path: str | None = None) -> dict[tuple[str, str], int]:
     """(path, rule) -> allowed count.  Missing file = empty baseline."""
+    out: dict[tuple[str, str], int] = {}
+    for ent in load_entries(path):
+        key = (_norm(ent["path"]), str(ent["rule"]))
+        out[key] = out.get(key, 0) + int(ent.get("count", 1))
+    return out
+
+
+def load_entries(path: str | None = None) -> list[dict]:
+    """The raw [[suppress]] entries in file order (reasons preserved) —
+    the form the prune pass rewrites.  Missing file = no entries."""
     path = path or DEFAULT_BASELINE
     if not os.path.exists(path):
-        return {}
+        return []
     from firedancer_tpu.protocol import toml
 
     with open(path, encoding="utf-8") as fh:
         data = toml.loads(fh.read())
-    out: dict[tuple[str, str], int] = {}
-    for ent in data.get("suppress", []):
-        key = (_norm(ent["path"]), str(ent["rule"]))
-        out[key] = out.get(key, 0) + int(ent.get("count", 1))
-    return out
+    return list(data.get("suppress", []))
 
 
 def _norm(p: str) -> str:
@@ -81,28 +87,80 @@ def apply_baseline(
             f.suppressed = "baseline"
 
 
+def prune_entries(
+    entries: list[dict], findings: list[Finding]
+) -> tuple[list[dict], list[str]]:
+    """Baseline hygiene: shrink/drop entries that suppress more findings
+    than the analyzers currently produce.  `findings` must come from a
+    NO-baseline run (inline suppressions excluded by the caller or
+    here).  Returns (pruned entries in original order, human report of
+    what was stale).  An entry whose (path, rule) yields zero findings
+    is dropped; one whose count exceeds the live count is shrunk; live
+    counts are consumed in entry order so duplicate keys keep the
+    earliest entry's reason."""
+    live: dict[tuple[str, str], int] = {}
+    for f in findings:
+        if f.suppressed == "inline":
+            continue  # inline disables carry their own reason in-source
+        key = (_norm(f.path), f.rule)
+        live[key] = live.get(key, 0) + 1
+    kept: list[dict] = []
+    stale: list[str] = []
+    for ent in entries:
+        key = (_norm(ent["path"]), str(ent["rule"]))
+        want = int(ent.get("count", 1))
+        have = live.get(key, 0)
+        take = min(want, have)
+        live[key] = have - take
+        if take == 0:
+            stale.append(f"{ent['path']}: {ent['rule']} x{want}"
+                         " — no current finding, dropped")
+            continue
+        if take < want:
+            stale.append(f"{ent['path']}: {ent['rule']} x{want}"
+                         f" — only {take} current finding(s), shrunk")
+        ent = dict(ent)
+        ent["count"] = take
+        kept.append(ent)
+    return kept, stale
+
+
+def format_entries(entries: list[dict]) -> str:
+    """Render [[suppress]] entries back to the baseline schema."""
+    lines = [
+        "# fdlint baseline: grandfathered findings (see docs/ANALYSIS.md).",
+        "# Regenerate with: python -m firedancer_tpu.analysis"
+        " --write-baseline",
+        "# Drop stale entries with: python -m firedancer_tpu.analysis"
+        " --prune-baseline",
+        "",
+    ]
+    for ent in entries:
+        reason = str(ent.get("reason", "grandfathered"))
+        reason = reason.replace("\\", "\\\\").replace('"', '\\"')
+        lines += [
+            "[[suppress]]",
+            f'path = "{_norm(ent["path"])}"',
+            f'rule = "{ent["rule"]}"',
+            f"count = {int(ent.get('count', 1))}",
+            f'reason = "{reason}"',
+            "",
+        ]
+    return "\n".join(lines)
+
+
 def format_baseline(findings: list[Finding]) -> str:
     """The minimal baseline TOML covering every unsuppressed finding
-    (what --write-baseline emits)."""
+    (what --write-baseline emits).  One renderer: delegates to
+    format_entries so the two writers cannot drift."""
     counts: dict[tuple[str, str], int] = {}
     for f in findings:
         if f.suppressed == "inline":
             continue  # inline disables carry their own reason in-source
         key = (_norm(f.path), f.rule)
         counts[key] = counts.get(key, 0) + 1
-    lines = [
-        "# fdlint baseline: grandfathered findings (see docs/ANALYSIS.md).",
-        "# Regenerate with: python -m firedancer_tpu.analysis"
-        " --write-baseline",
-        "",
-    ]
-    for (path, rule), count in sorted(counts.items()):
-        lines += [
-            "[[suppress]]",
-            f'path = "{path}"',
-            f'rule = "{rule}"',
-            f"count = {count}",
-            'reason = "grandfathered at baseline creation"',
-            "",
-        ]
-    return "\n".join(lines)
+    return format_entries([
+        {"path": path, "rule": rule, "count": count,
+         "reason": "grandfathered at baseline creation"}
+        for (path, rule), count in sorted(counts.items())
+    ])

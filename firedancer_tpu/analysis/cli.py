@@ -2,9 +2,12 @@
 
 Default run = AST lint over the given paths (default: the installed
 firedancer_tpu package) + topology check of the flagship process
-topology (models/leader_topo.build_leader_topology), with the shipped
-baseline applied.  Exit status 0 iff no unsuppressed findings — the
-contract scripts/fdlint.sh and tests/test_fdlint.py enforce in tier-1.
+topology (models/leader_topo.build_leader_topology) + the cross-language
+ABI contract check (abi_check: native/*.cpp vs the ctypes bindings),
+with the shipped baseline applied.  Exit status 0 iff no unsuppressed
+findings — the contract scripts/fdlint.sh and tests/test_fdlint.py
+enforce in tier-1.  `--abi` runs the ABI pass alone; `--no-abi` skips
+it.
 """
 
 from __future__ import annotations
@@ -14,7 +17,8 @@ import importlib
 import os
 import sys
 
-from . import ast_rules, baseline as bl, report, topo_check
+from . import abi_check, ast_rules, baseline as bl, report, topo_check
+from . import native_rules  # noqa: F401 -- registers the FD3xx rules
 from .framework import Finding
 
 DEFAULT_TOPO = "firedancer_tpu.models.leader_topo:build_leader_topology"
@@ -34,6 +38,7 @@ def check_paths(
     topo_specs: list[str] | None = None,
     baseline_path: str | None = None,
     use_baseline: bool = True,
+    abi: bool = False,
 ) -> list[Finding]:
     """The full analyzer pass as a library call (tests use this)."""
     findings: list[Finding] = []
@@ -42,6 +47,8 @@ def check_paths(
     for spec in topo_specs or ():
         topo = _resolve_topo(spec)
         findings.extend(topo_check.check_topology(topo, label=spec))
+    if abi:
+        findings.extend(abi_check.check_repo())
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     if use_baseline:
         bl.apply_baseline(findings, bl.load_baseline(baseline_path))
@@ -65,6 +72,11 @@ def main(argv: list[str] | None = None) -> int:
                     f" default {DEFAULT_TOPO}")
     ap.add_argument("--no-topo", action="store_true",
                     help="skip the topology check")
+    ap.add_argument("--abi", action="store_true",
+                    help="run ONLY the cross-language ABI contract"
+                    " check (native/*.cpp vs the ctypes bindings)")
+    ap.add_argument("--no-abi", action="store_true",
+                    help="skip the ABI contract check")
     ap.add_argument("--baseline", default=None,
                     help=f"baseline file (default {bl.DEFAULT_BASELINE})")
     ap.add_argument("--no-baseline", action="store_true",
@@ -73,6 +85,10 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--write-baseline", action="store_true",
                     help="write the minimal baseline covering current"
                     " findings and exit 0")
+    ap.add_argument("--prune-baseline", action="store_true",
+                    help="drop/shrink baseline entries that no longer"
+                    " match a current finding (reasons preserved) and"
+                    " exit 0")
     ap.add_argument("--json", action="store_true", help="JSON output")
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="also show suppressed findings")
@@ -86,10 +102,13 @@ def main(argv: list[str] | None = None) -> int:
     if not paths:
         paths = [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
     topo_specs = [] if args.no_topo else (args.topo or [DEFAULT_TOPO])
+    run_abi = not args.no_abi
+    if args.abi:  # ABI pass alone
+        paths, topo_specs, run_abi = [], [], True
 
     if args.write_baseline:
         findings = check_paths(paths, topo_specs=topo_specs,
-                               use_baseline=False)
+                               use_baseline=False, abi=run_abi)
         out = bl.format_baseline(findings)
         path = args.baseline or bl.DEFAULT_BASELINE
         with open(path, "w", encoding="utf-8") as fh:
@@ -98,11 +117,51 @@ def main(argv: list[str] | None = None) -> int:
               f"{len(report.active(findings))} finding(s) to {path}")
         return 0
 
+    if args.prune_baseline:
+        # prune ONLY entries the current invocation actually analyzed:
+        # a scoped run (--abi empties the lint paths; explicit paths
+        # narrow them) must never drop a live suppression it simply
+        # did not look at — out-of-scope entries pass through verbatim
+        findings = check_paths(paths, topo_specs=topo_specs,
+                               use_baseline=False, abi=run_abi)
+        path = args.baseline or bl.DEFAULT_BASELINE
+        roots = [bl._norm(os.path.abspath(p)) for p in paths]
+
+        def in_scope(ent) -> bool:
+            p = bl._norm(str(ent["path"]))
+            if p.startswith("topo:"):
+                return bool(topo_specs)
+            return any(p == r or p.startswith(r.rstrip("/") + "/")
+                       for r in roots)
+
+        entries = bl.load_entries(path)
+        for i, ent in enumerate(entries):
+            ent["_idx"] = i
+        outside = [e for e in entries if not in_scope(e)]
+        kept, stale = bl.prune_entries(
+            [e for e in entries if in_scope(e)], findings)
+        merged = sorted(outside + kept, key=lambda e: e["_idx"])
+        for e in merged:
+            e.pop("_idx", None)
+        for line in stale:
+            print(f"fdlint: stale baseline entry: {line}")
+        if outside:
+            print(f"fdlint: {len(outside)} entr"
+                  f"{'y' if len(outside) == 1 else 'ies'} outside this"
+                  " run's scope kept unchanged")
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write(bl.format_entries(merged))
+        print(f"fdlint: baseline pruned to {len(merged)} entr"
+              f"{'y' if len(merged) == 1 else 'ies'}"
+              f" ({len(stale)} stale) at {path}")
+        return 0
+
     findings = check_paths(
         paths,
         topo_specs=topo_specs,
         baseline_path=args.baseline,
         use_baseline=not args.no_baseline,
+        abi=run_abi,
     )
     if args.json:
         print(report.render_json(findings))

@@ -1,0 +1,73 @@
+"""FD3xx rule registry: the cross-language ABI contract (abi_check).
+
+Every native hot path is one C++ translation unit mirrored by a
+hand-written ctypes binding module — two declarations of the same wire
+format with nothing but discipline keeping them in sync.  The reference
+guards the equivalent surface with compile-time FD_STATIC_ASSERT layout
+checks (fd_tango_base.h pins struct offsets at build time); in a
+ctypes world the drift is silent: a reordered struct field, a dropped
+argtype, or a stale mirrored constant corrupts the shm wire or
+truncates a pointer without any exception, until a differential test
+happens to cover the exact field.  abi_check.py extracts both sides
+and diffs them field-by-field; these are the finding IDs it reports
+through the shared framework/baseline/CLI machinery.
+"""
+
+from __future__ import annotations
+
+from .framework import SEV_ERROR, _rule
+
+FD301 = _rule(
+    "FD301", "abi-struct-layout", SEV_ERROR,
+    "ctypes.Structure layout disagrees with the C struct it crosses the"
+    " FFI as (field offset/size/name/count or total sizeof): every"
+    " access on either side reads the other's memory at the wrong"
+    " offset — silent shm corruption, the FD_STATIC_ASSERT class",
+)
+FD302 = _rule(
+    "FD302", "abi-missing-argtypes", SEV_ERROR,
+    "exported C function is called through the lib handle with no"
+    " argtypes declared: ctypes guesses per-argument marshalling"
+    " (ints truncate to 32-bit, None becomes garbage) and the call"
+    " signature can drift without any check firing",
+)
+FD303 = _rule(
+    "FD303", "abi-restype-drift", SEV_ERROR,
+    "restype missing or incompatible with the C return type: the"
+    " default c_int TRUNCATES pointer and 64-bit returns to 32 bits"
+    " (a heap handle above 4GB comes back mangled and is later passed"
+    " back to C as a wild pointer)",
+)
+FD304 = _rule(
+    "FD304", "abi-argtypes-drift", SEV_ERROR,
+    "declared argtypes disagree with the C signature (count or an"
+    " incompatible type at a position): the crossing marshals the"
+    " wrong widths/pointees and the C side reads stack/register"
+    " garbage",
+)
+FD305 = _rule(
+    "FD305", "abi-constant-drift", SEV_ERROR,
+    "a Python constant mirroring a C constant of the same name has a"
+    " different value (ring depths, MTUs, meta-table widths, enum"
+    " codes): both sides index shared memory with different geometry",
+)
+FD306 = _rule(
+    "FD306", "abi-unchecked-rc", SEV_ERROR,
+    "call site discards the result of a C function returning a signed"
+    " error code: a failed crossing (capacity, punt, stash) is"
+    " silently treated as success and the divergence surfaces frames"
+    " later as corruption",
+)
+FD307 = _rule(
+    "FD307", "abi-table-dtype", SEV_ERROR,
+    "a numpy meta/frame table whose column count mirrors a C-side"
+    " constant is not dtype uint64: the C side indexes the table as"
+    " u64 rows, so any narrower dtype shears every row",
+)
+FD308 = _rule(
+    "FD308", "abi-unknown-export", SEV_ERROR,
+    "argtypes/restype declared (or a call made) for a function name"
+    " the paired C translation unit does not export: a rename on one"
+    " side only — the binding will AttributeError at runtime, or"
+    " worse, resolve against a stale .so",
+)

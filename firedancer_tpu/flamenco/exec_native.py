@@ -59,8 +59,7 @@ _lib = None
 def _load():
     global _lib
     if _lib is None:
-        build_so(_SRC, _SO)
-        lib = ctypes.CDLL(_SO)
+        lib = ctypes.CDLL(build_so(_SRC, _SO))
         lib.fd_exec_batch.argtypes = [
             ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint64,
         ]

@@ -113,8 +113,7 @@ def _host_lib():
         )
         so = os.path.join(os.path.dirname(src), "fd_reedsol.so")
         try:
-            build_so(src, so)
-            lib = ctypes.CDLL(so)
+            lib = ctypes.CDLL(build_so(src, so))
             lib.fd_reedsol_encode.argtypes = [
                 ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64,
                 ctypes.c_uint64, ctypes.c_uint64, ctypes.c_char_p,

@@ -49,8 +49,7 @@ _lib = None
 def _load():
     global _lib
     if _lib is None:
-        build_so(_SRC, _SO)
-        lib = ctypes.CDLL(_SO)
+        lib = ctypes.CDLL(build_so(_SRC, _SO))
         u64, i64, vp = ctypes.c_uint64, ctypes.c_int64, ctypes.c_void_p
         lib.fd_pack_new.restype = vp
         lib.fd_pack_new.argtypes = [u64] * 8

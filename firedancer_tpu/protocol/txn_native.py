@@ -40,8 +40,7 @@ def _load():
     global _lib
     if _lib is not None:
         return _lib
-    build_so(_SRC, _SO)
-    lib = ctypes.CDLL(_SO)
+    lib = ctypes.CDLL(build_so(_SRC, _SO))
     lib.fd_txn_parse.argtypes = [
         ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint64,
     ]

@@ -55,8 +55,7 @@ _lib = None
 def _load():
     global _lib
     if _lib is None:
-        build_so(_SRC, _SO)
-        lib = ctypes.CDLL(_SO)
+        lib = ctypes.CDLL(build_so(_SRC, _SO))
         u64 = ctypes.c_uint64
         p64 = ctypes.POINTER(u64)
         pi64 = ctypes.POINTER(ctypes.c_int64)

@@ -46,8 +46,7 @@ _lib = None
 def _load():
     global _lib
     if _lib is None:
-        build_so(_SRC, _SO)
-        lib = ctypes.CDLL(_SO)
+        lib = ctypes.CDLL(build_so(_SRC, _SO))
         u64 = ctypes.c_uint64
         vp = ctypes.c_void_p
         lib.fdv_stage_new.argtypes = [u64, u64, u64, u64, u64, vp]
@@ -185,10 +184,18 @@ class StageClient:
         side maintains the bit); release()/pump() refresh it."""
         return bool(self._tail[_TAIL_FLAGS] & 2)
 
-    def append(self, payload: bytes, tsorig: int) -> None:
+    def append(self, payload: bytes, tsorig: int) -> bool:
         """Per-frag fallback (mixed-lane / lossy splice): forward into
-        the SAME C-side state the sweep callback fills."""
-        self._lib.fdv_append(self._h, payload, len(payload), tsorig)
+        the SAME C-side state the sweep callback fills.  True = handled
+        now — ingested into the open slot, OR rejected-and-counted by a
+        C-side guard (oversize/parse/dedup drops land in the stage
+        counters, exactly like the sweep path); False = deferred to the
+        C-side stash (order-preserving, drained by pump()).  Either
+        way the C side fully accounts for the frag — the return is the
+        BACKPRESSURE signal, not an acceptance signal (fdlint FD306: a
+        signed rc must not be discarded)."""
+        return self._lib.fdv_append(self._h, payload, len(payload),
+                                    tsorig) == 0
 
     def counters(self) -> dict[str, int]:
         return {name: int(self._tail[_TAIL_COUNTERS + i])

@@ -98,8 +98,7 @@ def _load():
     global _lib
     if _lib is not None:
         return _lib
-    build_so(_SRC, _SO)
-    lib = ctypes.CDLL(_SO)
+    lib = ctypes.CDLL(build_so(_SRC, _SO))
     PL = ctypes.POINTER(_Link)
     PP = ctypes.POINTER(_Producer)
     PC = ctypes.POINTER(_Consumer)

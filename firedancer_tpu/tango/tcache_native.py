@@ -26,8 +26,7 @@ def _load():
     global _lib
     if _lib is not None:
         return _lib
-    build_so(_SRC, _SO)
-    lib = ctypes.CDLL(_SO)
+    lib = ctypes.CDLL(build_so(_SRC, _SO))
     lib.tcache_new.restype = ctypes.c_void_p
     lib.tcache_new.argtypes = [ctypes.c_uint64]
     lib.tcache_delete.argtypes = [ctypes.c_void_p]

@@ -2,8 +2,18 @@
 
 One place owns the build-to-temp + atomic-rename discipline (concurrent
 stage processes must never clobber each other's half-written .so) and the
-temp cleanup on failure; tango/native.py and protocol/txn_native.py both
-load through it.
+temp cleanup on failure; every binding module loads through it.
+
+Sanitizer lane (ISSUE 15): `FDTPU_NATIVE_SAN=asan|ubsan` redirects every
+build into `native/san/<san>/` with the matching instrumentation flags,
+so the SAME differential suites exercise the SAME bindings over
+ASan/UBSan-instrumented .so's — no second build system, no test forks.
+`build_so` RETURNS the path actually built (the san twin when the lane
+is armed); callers must CDLL that return value, never their own `so`
+argument.  ASan additionally needs its runtime loaded before python's
+first allocation: run the process under `san_env()` (LD_PRELOAD of the
+toolchain's libasan + leak detection off — CPython deliberately leaks
+arenas at exit and would drown real reports).
 """
 
 from __future__ import annotations
@@ -16,15 +26,90 @@ class NativeUnavailable(RuntimeError):
     pass
 
 
-def build_so(src: str, so: str) -> None:
-    """Compile `src` -> `so` if missing/stale; raises NativeUnavailable
-    when no toolchain exists or the compile fails."""
+SAN_ENV = "FDTPU_NATIVE_SAN"
+
+_BASE_FLAGS = ["-O2", "-shared", "-fPIC"]
+_SAN_FLAGS = {
+    # -O1 keeps frames honest for reports while staying fast enough for
+    # the differential suites; -g makes the report lines resolvable
+    "asan": ["-O1", "-shared", "-fPIC", "-g", "-fno-omit-frame-pointer",
+             "-fsanitize=address"],
+    "ubsan": ["-O1", "-shared", "-fPIC", "-g",
+              "-fsanitize=undefined", "-fno-sanitize-recover=undefined"],
+}
+
+
+def san_mode() -> str | None:
+    """The armed sanitizer lane, or None.  An unknown value is a hard
+    error — a typo'd FDTPU_NATIVE_SAN silently running uninstrumented
+    would defeat the lane's whole point."""
+    v = os.environ.get(SAN_ENV, "").strip().lower()
+    if not v:
+        return None
+    if v not in _SAN_FLAGS:
+        raise NativeUnavailable(
+            f"{SAN_ENV}={v!r}: expected 'asan' or 'ubsan'")
+    return v
+
+
+def san_so_path(so: str, san: str) -> str:
+    """native/foo.so -> native/san/<san>/foo.so (instrumented twin)."""
+    d = os.path.dirname(so)
+    return os.path.join(d, "san", san, os.path.basename(so))
+
+
+def _toolchain_lib(lib: str) -> str:
+    try:
+        path = subprocess.run(
+            ["g++", f"-print-file-name={lib}"],
+            check=True, capture_output=True, text=True,
+        ).stdout.strip()
+    except (OSError, subprocess.CalledProcessError) as e:
+        raise NativeUnavailable(f"cannot locate {lib}: {e}") from e
+    if not os.path.isabs(path) or not os.path.exists(path):
+        raise NativeUnavailable(f"toolchain has no {lib} (got {path!r})")
+    return path
+
+
+def san_env(san: str) -> dict[str, str]:
+    """Environment additions for a process that will dlopen
+    instrumented .so's: the sanitizer runtime preloaded (ASan must be
+    the FIRST loaded DSO or dlopen refuses the instrumented library)
+    and leak detection off (CPython's arena teardown is all noise).
+    libstdc++ rides the preload list too: ASan resolves the REAL
+    __cxa_throw at startup via RTLD_NEXT, and a python process has no
+    libstdc++ in its link map yet (jaxlib bundles its own statically)
+    — without it the first C++ exception anywhere dies in
+    "AsanCheckFailed real___cxa_throw != 0" instead of propagating.
+    Raises NativeUnavailable when the toolchain lacks the runtime."""
+    lib = {"asan": "libasan.so", "ubsan": "libubsan.so"}[san]
+    preload = f"{_toolchain_lib(lib)} {_toolchain_lib('libstdc++.so')}"
+    env = {SAN_ENV: san, "LD_PRELOAD": preload}
+    if san == "asan":
+        env["ASAN_OPTIONS"] = "detect_leaks=0:abort_on_error=1"
+    else:
+        env["UBSAN_OPTIONS"] = "print_stacktrace=1:halt_on_error=1"
+    return env
+
+
+def build_so(src: str, so: str) -> str:
+    """Compile `src` -> `so` if missing/stale and return the path to
+    load.  Under FDTPU_NATIVE_SAN the build lands in the san/<san>/
+    twin with instrumentation flags — the RETURN VALUE is the loadable
+    path, which differs from `so` on that lane.  Raises
+    NativeUnavailable when no toolchain exists or the compile fails."""
+    san = san_mode()
+    flags = _BASE_FLAGS
+    if san:
+        so = san_so_path(so, san)
+        flags = _SAN_FLAGS[san]
+        os.makedirs(os.path.dirname(so), exist_ok=True)
     if os.path.exists(so) and os.path.getmtime(so) >= os.path.getmtime(src):
-        return
+        return so
     tmp = f"{so}.{os.getpid()}"
     try:
         subprocess.run(
-            ["g++", "-O2", "-shared", "-fPIC", "-o", tmp, src],
+            ["g++", *flags, "-o", tmp, src],
             check=True,
             capture_output=True,
             text=True,
@@ -38,3 +123,4 @@ def build_so(src: str, so: str) -> None:
                 os.remove(tmp)
             except OSError:
                 pass
+    return so

@@ -8,7 +8,15 @@
 # fine: every loader raises NativeUnavailable and its caller falls back to
 # the Python lane, and the tests SKIP (never fail).
 #
-# Usage: scripts/build_native.sh [--force]
+# Sanitizer lane (ISSUE 15): `--san asan|ubsan` builds instrumented twins
+# into native/san/<san>/ — the same flags utils/nativebuild uses when
+# FDTPU_NATIVE_SAN is set, so a prebuilt CI lane and the on-demand lane
+# produce interchangeable artifacts.  Run the suites against them with
+#   FDTPU_NATIVE_SAN=asan LD_PRELOAD="$(g++ -print-file-name=libasan.so)" \
+#     ASAN_OPTIONS=detect_leaks=0 python -m pytest tests/test_native_san.py
+# (docs/OPERATIONS.md has the full runbook).
+#
+# Usage: scripts/build_native.sh [--force] [--san asan|ubsan]
 
 set -euo pipefail
 cd "$(dirname "$0")/../native"
@@ -16,16 +24,38 @@ cd "$(dirname "$0")/../native"
 CXX=${CXX:-g++}
 CXXFLAGS=${CXXFLAGS:--O2 -shared -fPIC}
 
+force=0
+san=""
+while [ $# -gt 0 ]; do
+    case "$1" in
+        --force) force=1 ;;
+        --san)
+            shift
+            san="${1:-}"
+            case "$san" in
+                asan)  CXXFLAGS="-O1 -shared -fPIC -g -fno-omit-frame-pointer -fsanitize=address" ;;
+                ubsan) CXXFLAGS="-O1 -shared -fPIC -g -fsanitize=undefined -fno-sanitize-recover=undefined" ;;
+                *) echo "build_native: --san expects asan|ubsan (got '$san')" >&2; exit 2 ;;
+            esac
+            ;;
+        *) echo "build_native: unknown arg '$1'" >&2; exit 2 ;;
+    esac
+    shift
+done
+
 if ! command -v "$CXX" >/dev/null 2>&1; then
     echo "build_native: no $CXX on this host; runtime falls back to python lanes" >&2
     exit 0
 fi
 
-force=0
-[ "${1:-}" = "--force" ] && force=1
+outdir="."
+if [ -n "$san" ]; then
+    outdir="san/$san"
+    mkdir -p "$outdir"
+fi
 
 for src in *.cpp; do
-    so="${src%.cpp}.so"
+    so="$outdir/${src%.cpp}.so"
     if [ "$force" = 0 ] && [ -f "$so" ] && [ "$so" -nt "$src" ]; then
         echo "build_native: $so up to date"
         continue

@@ -1,0 +1,94 @@
+// ABI drift fixture: the C half of a deliberately-drifted binding pair.
+// tests/test_abi_check.py pairs this with drift_binding.py and asserts
+// every FD3xx rule detects its seeded mismatch.  The structs/functions
+// deliberately exercise the parser's whole supported subset: typedefs,
+// enum/constexpr/#define constants, arrays, double pointers, fn-ptr
+// typedefs, and multiword base types.
+
+#include <cstdint>
+
+typedef uint8_t u8;
+typedef uint64_t u64;
+typedef int64_t i64;
+using u32 = uint32_t;
+
+constexpr u64 TBL_NCOL = 6;      // py mirrors 6 (clean) — table dtype drifts
+#define FIX_DEPTH 128            // py mirrors 64  -> FD305
+constexpr u32 FIX_MTU = 1232;    // py mirrors 1232 (clean control)
+
+extern "C" {
+
+enum { FIX_MAX_REL = 16, FIX_MODE_A = 0, FIX_MODE_B };  // py MODE_B drifts
+
+// py _Skew mirrors this with chunk/seq swapped -> FD301 (offset skew)
+struct fix_skew {
+  u64 seq;
+  u32 chunk;
+  u32 flags;
+  u64 rel[FIX_MAX_REL];
+};
+
+// py _Dropped mirrors this without `lost` -> FD301 (dropped field)
+struct fix_dropped {
+  u64 a;
+  u64 lost;
+  u64 b;
+};
+
+// py _Clean mirrors this exactly (control: no finding)
+struct fix_clean {
+  u8* base;
+  u64 depth;
+  u32 mode;
+  i64 delta;
+};
+
+void fix_init(const fix_clean* c, fix_skew* s, fix_dropped* d) {
+  (void)c; (void)s; (void)d;
+}
+
+// py declares restype c_void_p but only 2 argtypes -> FD304 (count)
+void* fix_open(u64 depth, u64 mtu, u32 mode) {
+  (void)depth; (void)mtu; (void)mode;
+  return nullptr;
+}
+
+// py declares NO restype -> FD303 (implicit c_int truncates the ptr)
+void* fix_handle(void* h) { return h; }
+
+// py argtypes declare c_uint32 where C takes u64 -> FD304 (width)
+void fix_push(const fix_clean* c, u64 tag, const u8* payload, u64 sz) {
+  (void)c; (void)tag; (void)payload; (void)sz;
+}
+
+// py CALLS this with no argtypes declared -> FD302
+int fix_poll(fix_clean* c, u8* out, u64 cap) {
+  (void)c; (void)out; (void)cap;
+  return -1;
+}
+
+// py discards the signed rc at a call site -> FD306
+i64 fix_commit(fix_clean* c) {
+  (void)c;
+  return -1;
+}
+
+// unsigned return: a discarded result is NOT an error code -> no FD306
+u64 fix_tick(fix_clean* c) {
+  (void)c;
+  return 0;
+}
+
+typedef int (*fix_cb)(void* ctx, const u64* meta);
+
+// clean control: full argtypes/restype parity (incl. fn ptr + double
+// pointer + getattr-loop declarations on the py side)
+i64 fix_sweep(fix_clean* const* links, u64 n, fix_cb cb, void* ctx) {
+  (void)links; (void)n; (void)cb; (void)ctx;
+  return 0;
+}
+
+void* fix_ptr_a(void* h) { return h; }
+void* fix_ptr_b(void* h) { return h; }
+
+}  // extern "C"

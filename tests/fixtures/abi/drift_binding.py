@@ -1,0 +1,113 @@
+"""ABI drift fixture: the Python half of the deliberately-drifted pair.
+
+NOT imported by anything — tests/test_abi_check.py feeds this file and
+drift.cpp to abi_check.check_pair and asserts each FD3xx rule detects
+its seeded mismatch (comments mark every seed).  The clean declarations
+in between are the false-positive controls: they must produce nothing.
+"""
+
+import ctypes
+
+import numpy as np
+
+_SRC = "drift.cpp"  # pairing literal (check_pair gets paths explicitly)
+
+FIX_MAX_REL = 16
+FIX_DEPTH = 64        # FD305: C #define FIX_DEPTH 128
+FIX_MTU = 1232        # clean control: matches constexpr FIX_MTU
+FIX_MODE_A = 0        # clean control: matches the enum
+FIX_MODE_B = 2        # FD305: C enum gives FIX_MODE_B = 1
+TBL_NCOL = 6          # clean control: matches constexpr TBL_NCOL
+
+
+class _Skew(ctypes.Structure):
+    # FD301: `chunk` widened to u64 (C: u32) — every later field lands
+    # at the wrong offset (the offset-skew shape)
+    _fields_ = [
+        ("seq", ctypes.c_uint64),
+        ("chunk", ctypes.c_uint64),
+        ("flags", ctypes.c_uint32),
+        ("rel", ctypes.c_uint64 * FIX_MAX_REL),
+    ]
+
+
+class _Dropped(ctypes.Structure):
+    # FD301: C has `lost` between a and b — a dropped field
+    _fields_ = [
+        ("a", ctypes.c_uint64),
+        ("b", ctypes.c_uint64),
+    ]
+
+
+class _Clean(ctypes.Structure):
+    # control: byte-for-byte the C fix_clean
+    _fields_ = [
+        ("base", ctypes.c_void_p),
+        ("depth", ctypes.c_uint64),
+        ("mode", ctypes.c_uint32),
+        ("delta", ctypes.c_int64),
+    ]
+
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    lib = ctypes.CDLL("drift.so")
+    u64 = ctypes.c_uint64
+    PC = ctypes.POINTER(_Clean)
+    # binds _Clean<->fix_clean, _Skew<->fix_skew, _Dropped<->fix_dropped
+    lib.fix_init.argtypes = [PC, ctypes.POINTER(_Skew),
+                             ctypes.POINTER(_Dropped)]
+    # FD304: 2 argtypes declared, C takes 3
+    lib.fix_open.argtypes = [u64, u64]
+    lib.fix_open.restype = ctypes.c_void_p
+    # FD303: pointer-returning, restype never declared (implicit c_int)
+    lib.fix_handle.argtypes = [ctypes.c_void_p]
+    # FD304: argtypes[1] c_uint32 where C takes uint64_t
+    lib.fix_push.argtypes = [PC, ctypes.c_uint32, ctypes.c_char_p, u64]
+    # clean control: fn-ptr + double-pointer parity
+    lib.fix_sweep.argtypes = [ctypes.POINTER(PC), u64, ctypes.c_void_p,
+                              ctypes.c_void_p]
+    lib.fix_sweep.restype = ctypes.c_int64
+    lib.fix_commit.argtypes = [PC]
+    lib.fix_commit.restype = ctypes.c_int64
+    lib.fix_tick.argtypes = [PC]
+    lib.fix_tick.restype = u64
+    # clean control: the getattr-in-a-loop declaration idiom
+    for name in ("fix_ptr_a", "fix_ptr_b"):
+        getattr(lib, name).argtypes = [ctypes.c_void_p]
+        getattr(lib, name).restype = ctypes.c_void_p
+    # FD308: drift.cpp exports no such function
+    lib.fix_renamed.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return lib
+
+
+class Client:
+    def __init__(self):
+        self._lib = _load()
+        self._c = _Clean()
+        self._cp = ctypes.byref(self._c)
+        self._out = ctypes.create_string_buffer(1232)
+        # FD307: TBL_NCOL-column table (a C-side contract) but u32 rows
+        self.tbl = np.zeros((FIX_DEPTH, TBL_NCOL), dtype=np.uint32)
+        # clean control: u64 rows
+        self.meta = np.zeros((FIX_DEPTH, TBL_NCOL), dtype=np.uint64)
+
+    def poll(self):
+        # FD302: fix_poll called, argtypes never declared
+        return self._lib.fix_poll(self._cp, self._out, 1232)
+
+    def commit(self) -> None:
+        # FD306: signed error code discarded
+        self._lib.fix_commit(self._cp)
+        # control: unsigned return discarded is NOT an error code
+        self._lib.fix_tick(self._cp)
+
+    def commit_checked(self) -> int:
+        # control: consumed rc produces nothing
+        return int(self._lib.fix_commit(self._cp))

@@ -1,0 +1,243 @@
+"""abi_check (fdlint FD3xx) tests: the C-surface parser on the exact
+shapes the native translation units use, the drift-fixture pair proving
+every FD3xx rule detects its seeded mismatch (tests/fixtures/abi/), the
+false-positive controls inside the same fixture, and — the tier-1
+contract — the shipped repo diffing CLEAN across every binding pair.
+"""
+
+import os
+import time
+from collections import Counter
+
+from firedancer_tpu.analysis import abi_check as ac
+from firedancer_tpu.analysis.framework import all_rules
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIX = os.path.join(REPO, "tests", "fixtures", "abi")
+DRIFT_PY = os.path.join(FIX, "drift_binding.py")
+DRIFT_CPP = os.path.join(FIX, "drift.cpp")
+
+
+# -- rule registry -----------------------------------------------------------
+
+
+def test_fd3xx_rules_registered():
+    ids = {r.id for r in all_rules()}
+    for n in range(301, 309):
+        assert f"FD{n}" in ids
+
+
+# -- the C-surface parser ----------------------------------------------------
+
+
+_C_SRC = r'''
+// comment with a "string" and extern "C" inside it
+#include <cstdint>
+typedef uint8_t u8;
+typedef uint64_t u64;
+using u32 = uint32_t;
+#define DEPTH 64
+constexpr u64 MTU = 2 * 616;
+constexpr int NCOL = 7;
+
+extern "C" {
+
+enum { MAX_REL = 16, MODE_A = 0, MODE_B, MODE_C = 9 };
+
+struct pair_hdr {
+  u64 seq;
+  u32 sz;
+  u8 flag;
+  u8* base;
+  u64 tbl[MAX_REL];
+};
+
+typedef int (*cb_t)(void* ctx, const u64* meta);
+
+static int internal_helper(int x) { return x; }
+
+i64_missing_type;  /* garbage statement: must not derail the scanner */
+
+void po_init(const pair_hdr* h, pair_hdr* const* many, unsigned n) {
+  (void)h; (void)many; (void)n;
+}
+
+void* po_new(u64 depth) { (void)depth; return nullptr; }
+
+int64_t po_run(void* h, const u8 key[32], cb_t cb, void* ctx) {
+  (void)h; (void)key; (void)cb; (void)ctx;
+  return 0;
+}
+
+}  // extern "C"
+'''
+
+
+def test_c_parser_extracts_consts_typedefs_enums(tmp_path):
+    p = tmp_path / "x.cpp"
+    p.write_text(_C_SRC)
+    c = ac.extract_c(str(p))
+    assert c.consts["DEPTH"] == 64
+    assert c.consts["MTU"] == 1232
+    assert c.consts["NCOL"] == 7
+    # enum with explicit, implicit-increment, and re-anchored members
+    assert c.consts["MAX_REL"] == 16
+    assert c.consts["MODE_A"] == 0
+    assert c.consts["MODE_B"] == 1
+    assert c.consts["MODE_C"] == 9
+
+
+def test_c_parser_struct_layout(tmp_path):
+    p = tmp_path / "x.cpp"
+    p.write_text(_C_SRC)
+    c = ac.extract_c(str(p))
+    s = c.structs["pair_hdr"]
+    assert s.complete
+    # u64 @0, u32 @8, u8 @12, pad, ptr @16, u64[16] @24 -> sizeof 152
+    assert s.layout(c.structs) == [
+        ("seq", 0, 8), ("sz", 8, 4), ("flag", 12, 1),
+        ("base", 16, 8), ("tbl", 24, 128),
+    ]
+    assert s.total(c.structs) == 152
+
+
+def test_c_parser_functions(tmp_path):
+    p = tmp_path / "x.cpp"
+    p.write_text(_C_SRC)
+    c = ac.extract_c(str(p))
+    assert "internal_helper" not in c.funcs  # static: not exported
+    init = c.funcs["po_init"]
+    assert [repr(t) for t in init.params] == \
+        ["struct pair_hdr*", "struct pair_hdr**", "u32"]
+    assert init.ret.kind == "void"
+    assert c.funcs["po_new"].ret.kind == "ptr"
+    run = c.funcs["po_run"]
+    assert repr(run.ret) == "i64"
+    # array param decays, fn-ptr typedef is a pointer
+    assert [t.kind for t in run.params] == ["ptr", "ptr", "ptr", "ptr"]
+
+
+def test_c_parser_layouts_match_real_ctypes():
+    """The computed layout of every bound repo struct must equal what
+    ctypes itself computes — the ground truth the checker's alignment
+    rules claim to reproduce."""
+    import ctypes
+
+    from firedancer_tpu.tango import native as tn
+
+    c = ac.extract_c(os.path.join(REPO, "native", "fd_ring.cpp"))
+    b = ac.extract_py(os.path.join(REPO, "firedancer_tpu", "tango",
+                                   "native.py"))
+    for pyname, cname, cls in (("_Link", "fdr_link", tn._Link),
+                               ("_Producer", "fdr_producer", tn._Producer),
+                               ("_Consumer", "fdr_consumer", tn._Consumer)):
+        ps, cs = b.structs[pyname], c.structs[cname]
+        assert ps.total(b.structs) == ctypes.sizeof(cls)
+        assert cs.total(c.structs) == ctypes.sizeof(cls)
+        for (fname, off, _sz) in ps.layout(b.structs):
+            assert getattr(cls, fname).offset == off
+
+
+# -- the drift fixture: every rule detects its seeded mismatch ---------------
+
+
+def _drift_findings():
+    return ac.check_pair(DRIFT_PY, DRIFT_CPP)
+
+
+def test_every_fd3xx_rule_fires_on_the_drift_fixture():
+    counts = Counter(f.rule for f in _drift_findings())
+    assert counts == {
+        "FD301": 2,  # offset skew (widened field) + dropped field
+        "FD302": 1,  # fix_poll called, no argtypes
+        "FD303": 1,  # fix_handle: pointer return, implicit c_int
+        "FD304": 2,  # fix_open arg count + fix_push arg width
+        "FD305": 2,  # FIX_DEPTH #define drift + FIX_MODE_B enum drift
+        "FD306": 1,  # fix_commit signed rc discarded
+        "FD307": 1,  # TBL_NCOL-column table declared u32
+        "FD308": 1,  # fix_renamed not exported
+    }, counts
+
+
+def test_drift_findings_name_both_sides():
+    by_rule = {}
+    for f in _drift_findings():
+        by_rule.setdefault(f.rule, f)
+        assert f.path.endswith("drift_binding.py")
+        assert f.line > 0
+    assert "chunk" in by_rule["FD301"].msg
+    assert "drift.cpp" in by_rule["FD301"].msg
+    assert "FIX_DEPTH" in by_rule["FD305"].msg or \
+        "FIX_MODE_B" in by_rule["FD305"].msg
+    assert "fix_poll" in by_rule["FD302"].msg
+    assert "truncates" in by_rule["FD303"].msg
+
+
+def test_clean_controls_produce_no_findings():
+    """The fixture's parity declarations (fix_init/fix_sweep/fix_tick/
+    fix_ptr_* incl. the getattr-loop idiom, the u64 table, the matching
+    constants) must stay silent — the false-positive guard."""
+    findings = _drift_findings()
+    for f in findings:
+        for clean in ("fix_init", "fix_sweep", "fix_tick", "fix_ptr_a",
+                      "fix_ptr_b", "FIX_MTU", "FIX_MODE_A", "_Clean"):
+            assert clean not in f.msg, f.format()
+    # the unsigned-return discard (fix_tick) is not an error code
+    assert not any(f.rule == "FD306" and "fix_tick" in f.msg
+                   for f in findings)
+
+
+def test_abi_findings_honor_inline_disable(tmp_path):
+    """`# fdlint: disable=FD3xx -- reason` on the declaration line marks
+    the finding suppressed (never dropped), same as the AST rules."""
+    cpp = tmp_path / "m.cpp"
+    cpp.write_text('extern "C" {\nvoid* mk() { return 0; }\n}\n')
+    py = tmp_path / "m_binding.py"
+    py.write_text(
+        "import ctypes\n"
+        "lib = ctypes.CDLL('m.so')\n"
+        "h = lib.mk()  # fdlint: disable=FD303 -- probe, truncation ok\n"
+    )
+    findings = ac.check_pair(str(py), str(cpp))
+    assert [f.rule for f in findings] == ["FD303"]
+    assert findings[0].suppressed == "inline"
+
+
+def test_getattr_loop_declarations_are_extracted():
+    b = ac.extract_py(DRIFT_PY)
+    assert "fix_ptr_a" in b.argtypes and "fix_ptr_b" in b.argtypes
+    assert "fix_ptr_a" in b.restypes and "fix_ptr_b" in b.restypes
+
+
+def test_argtypes_list_repeat_is_extracted():
+    """`[u64] * 8` (scheduler_native's fd_pack_new idiom) resolves to
+    eight argtypes, not an opaque expression."""
+    b = ac.extract_py(os.path.join(REPO, "firedancer_tpu", "pack",
+                                   "scheduler_native.py"))
+    tl, _line = b.argtypes["fd_pack_new"]
+    assert tl is not None and len(tl) == 8
+    assert all(repr(t) == "u64" for t in tl)
+
+
+# -- the repo contract --------------------------------------------------------
+
+
+def test_repo_bindings_all_discovered():
+    """Every native/*.cpp with a .so twin has a discovered binding pair
+    — a new native lane cannot silently dodge the ABI gate."""
+    pairs = ac.discover_bindings()
+    cpps = {os.path.basename(c) for _py, c in pairs}
+    native = os.path.join(REPO, "native")
+    expected = {fn for fn in os.listdir(native) if fn.endswith(".cpp")}
+    assert cpps == expected, (cpps, expected)
+
+
+def test_repo_is_abi_clean_and_fast():
+    """The acceptance gate: zero findings over the shipped tree, well
+    inside the 5 s tier-1 budget (the fdlint gate test runs this via
+    the CLI once per suite)."""
+    t0 = time.monotonic()
+    findings = ac.check_repo()
+    dt = time.monotonic() - t0
+    assert findings == [], [f.format() for f in findings]
+    assert dt < 5.0, f"abi_check took {dt:.2f}s (budget 5s)"

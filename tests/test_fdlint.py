@@ -428,6 +428,87 @@ def test_write_baseline_roundtrip(tmp_path):
     assert fdcli.main(["--no-topo", "--no-baseline", str(src)]) == 1
 
 
+def test_prune_baseline_drops_and_shrinks_stale_entries(tmp_path):
+    """Satellite (ISSUE 15): baseline hygiene.  Entries whose
+    file/rule no longer produces a finding are dropped; overcounted
+    entries shrink to the live count; live entries keep their reason
+    verbatim; entries OUTSIDE the run's analyzed scope pass through
+    untouched (a scoped run must not eat suppressions it never
+    looked at)."""
+    src = tmp_path / "mod.py"
+    src.write_text("a = hash(b)\n")  # exactly ONE live FD204
+    base = tmp_path / "baseline.toml"
+    base.write_text(
+        # stale: rule fixed long ago, no current finding
+        '[[suppress]]\npath = "%s"\nrule = "FD203"\ncount = 2\n'
+        'reason = "fixed since"\n'
+        # overcounted: 3 grandfathered, 1 live
+        '[[suppress]]\npath = "%s"\nrule = "FD204"\ncount = 3\n'
+        'reason = "keep me"\n'
+        # stale: the file itself was deleted (still inside the scope)
+        '[[suppress]]\npath = "%s"\nrule = "FD204"\ncount = 1\n'
+        'reason = "file deleted"\n'
+        # outside the scanned tree entirely: must survive verbatim
+        '[[suppress]]\npath = "elsewhere/keep.py"\nrule = "FD202"\n'
+        'count = 5\nreason = "not my scope"\n'
+        % (src, src, tmp_path / "gone.py")
+    )
+    rc = fdcli.main(["--prune-baseline", "--no-topo", "--no-abi",
+                     "--baseline", str(base), str(tmp_path)])
+    assert rc == 0
+    entries = bl.load_entries(str(base))
+    assert [(e["rule"], int(e["count"])) for e in entries] == \
+        [("FD204", 1), ("FD202", 5)]
+    assert entries[0]["reason"] == "keep me"  # shrunk from 3, reason kept
+    assert entries[1]["reason"] == "not my scope"  # out of scope: verbatim
+    # the pruned file still suppresses exactly the live finding
+    assert fdcli.main(["--no-topo", "--no-abi", "--baseline", str(base),
+                       str(tmp_path)]) == 0
+
+
+def test_prune_baseline_scoped_abi_run_keeps_lint_entries(tmp_path):
+    """Regression: `--abi --prune-baseline` analyzes zero lint paths —
+    it must NOT drop the shipped verify.py FD214 suppressions as
+    'stale' just because this run never linted them."""
+    import shutil
+
+    base = tmp_path / "baseline.toml"
+    shutil.copy(bl.DEFAULT_BASELINE, base)
+    rc = fdcli.main(["--abi", "--prune-baseline", "--baseline",
+                     str(base)])
+    assert rc == 0
+    assert bl.load_baseline(str(base)) == {
+        ("firedancer_tpu/runtime/verify.py", "FD214"): 2,
+    }
+
+
+def test_prune_baseline_keeps_shipped_file_intact(tmp_path):
+    """Pruning the SHIPPED baseline against the shipped tree is a
+    no-op: its only entry (verify.py FD214 x2) is live, so nothing is
+    stale — the hygiene pass never eats a justified suppression."""
+    import shutil
+
+    base = tmp_path / "baseline.toml"
+    shutil.copy(bl.DEFAULT_BASELINE, base)
+    rc = fdcli.main(["--prune-baseline", "--no-topo", "--no-abi",
+                     "--baseline", str(base),
+                     os.path.join(PKG, "runtime", "verify.py")])
+    assert rc == 0
+    assert bl.load_baseline(str(base)) == {
+        ("firedancer_tpu/runtime/verify.py", "FD214"): 2,
+    }
+
+
+def test_abi_pass_is_clean_and_wired_into_the_cli():
+    """Satellite (ISSUE 15): `--abi` alone exits 0 over the shipped
+    repo (zero cross-language drift after the binding fixes), and the
+    FD3xx family is registered alongside FD1xx/FD2xx."""
+    assert fdcli.main(["--abi"]) == 0
+    ids = {r.id for r in all_rules()}
+    assert {"FD301", "FD302", "FD303", "FD304", "FD305", "FD306",
+            "FD307", "FD308"} <= ids
+
+
 # -- the tier-1 gate + fixed-violation regressions ---------------------------
 
 
