@@ -740,35 +740,141 @@ def run_verify_ab(pairs: int = 3, out_path: str | None = None) -> dict:
     return out
 
 
+def run_bank_ab(pairs: int = 3, out_path: str | None = None) -> dict:
+    """The ISSUE 16 acceptance artifact: interleaved same-box A/B of the
+    native bank sweep lane — per pair, one all-native window and one
+    window with ONLY the bank sweep client off (per-frag Python commits
+    on the same rings and the same exec session), per-stage us/txn
+    tables for both, per-pair deltas and median-of-pairs, plus the
+    commit-p99 A/B and the per-run autotune snapshot.  Writes
+    BENCH_r12_bank_ab.json (or FDTPU_BENCH_BANK_AB_PATH)."""
+    from firedancer_tpu.pack import scheduler_native as sn_pack
+    from firedancer_tpu.runtime import bank_native as bkn
+
+    _require_ab_pairs(pairs, "bank sweep-lane A/B")
+    if not bkn.available():
+        print("# native bank client unavailable: no A/B to run",
+              file=sys.stderr)
+        return {"bank_ab_unavailable": True}
+    pack_avail = sn_pack.available()
+    ons, offs = [], []
+    # the endgame topology, applied to BOTH windows: 2 banks (the
+    # cooperative scheduler runs one thread, so extra banks only add
+    # idle sweep crossings) and warmup past the 1024-dest account set
+    # (first touches stash on the sweep lane and fault funk loads on
+    # the python lane — warmup either way, steady state is the claim)
+    env_prev = {k: os.environ.get(k)
+                for k in ("FDTPU_BENCH_PIPELINE_BANKS",
+                          "FDTPU_BENCH_PIPELINE_WARM")}
+    os.environ.setdefault("FDTPU_BENCH_PIPELINE_BANKS", "2")
+    os.environ.setdefault("FDTPU_BENCH_PIPELINE_WARM", "1536")
+    try:
+        _host_pipeline_warm_window()
+        for i in range(pairs):
+            print(f"# bank A/B pair {i + 1}/{pairs}", file=sys.stderr)
+            order = (True, False) if i % 2 == 0 else (False, True)
+            for on in order:
+                # BOTH windows run the ISSUE 16 endgame topology (fused
+                # poh+shred crash domain) so the pair isolates the bank
+                # lane alone; the fused-vs-unfused delta is the
+                # byte-equal test's concern, not this artifact's
+                (ons if on else offs).append(_host_pipeline_measure(
+                    native_pack=pack_avail, native_bank=on, fused=True))
+        n_bank_cfg = int(os.environ["FDTPU_BENCH_PIPELINE_BANKS"])
+        warm_cfg = int(os.environ["FDTPU_BENCH_PIPELINE_WARM"])
+    finally:
+        for k, v in env_prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    def _stage_key(rows, key):
+        return [{"v": o["pipeline_host_stage_us_per_txn"].get(key)}
+                for o in rows]
+
+    out = {
+        "pairs": pairs,
+        "fused_poh_shred": True,
+        "n_bank": n_bank_cfg,
+        "warm_txns": warm_cfg,
+        "txn_per_s": ab_summary(ons, offs, "pipeline_host_txn_per_s"),
+        "bank_us_per_txn": ab_summary(
+            _stage_key(ons, "bank"), _stage_key(offs, "bank"), "v"),
+        "commit_p99_ms": ab_summary(
+            ons, offs, "pipeline_host_commit_p99_ms"),
+        "pipeline_host_txn_per_s": round(_median(
+            [o["pipeline_host_txn_per_s"] for o in ons]), 1),
+        "stage_us_per_txn_on": [o["pipeline_host_stage_us_per_txn"]
+                                for o in ons],
+        "stage_us_per_txn_off": [o["pipeline_host_stage_us_per_txn"]
+                                 for o in offs],
+        "bank_mode_on": ons[-1].get("pipeline_host_native_bank"),
+        "bank_mode_off": offs[-1].get("pipeline_host_native_bank"),
+        "native_exec": ons[-1].get("pipeline_host_native_exec"),
+        "native_ring": ons[-1].get("pipeline_host_native_ring"),
+        "native_verify": ons[-1].get("pipeline_host_native_verify"),
+        "native_shred": ons[-1].get("pipeline_host_native_shred"),
+        "autotune": ons[-1].get("autotune"),
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+    }
+    # the acceptance gates, evaluated in-artifact so the CI smoke (and
+    # the next round's reader) need no out-of-band thresholds
+    bank_on = out["bank_us_per_txn"]["on_median"]
+    rate_on = out["txn_per_s"]["on_median"]
+    out["accept_bank_us_per_txn_le_8"] = (
+        bank_on is not None and bank_on <= 8.0)
+    out["accept_pipeline_txn_per_s_ge_24k"] = (
+        rate_on is not None and rate_on >= 24_000.0)
+    path = out_path or os.environ.get("FDTPU_BENCH_BANK_AB_PATH",
+                                      "BENCH_r12_bank_ab.json")
+    try:
+        with open(path, "w") as fh:
+            json.dump(out, fh, indent=1)
+        print(f"# bank A/B artifact -> {path}", file=sys.stderr)
+    except OSError as e:
+        print(f"# bank A/B artifact write failed: {e}", file=sys.stderr)
+    return out
+
+
 def _host_pipeline_measure(*, native_pack: bool,
                            native_ring: bool | None = None,
                            native_shred: bool | None = None,
-                           native_verify: bool | None = None) -> dict:
+                           native_verify: bool | None = None,
+                           native_bank: bool | None = None,
+                           fused: bool = False) -> dict:
     from firedancer_tpu.models.leader import build_leader_pipeline
     from firedancer_tpu.runtime.bank import default_bank_ctx
     from firedancer_tpu.runtime.benchg import gen_transfer_pool
 
     n_txn = int(os.environ.get("FDTPU_BENCH_PIPELINE_TXNS", "8192"))
+    # bank fan-out is a topology knob, not a fixed fact of the bench:
+    # the sweep lane amortizes one FFI dispatch per bank per iteration,
+    # so fewer/busier banks beat many mostly-idle ones on one box
+    n_bank = int(os.environ.get("FDTPU_BENCH_PIPELINE_BANKS", "4"))
     n_payers = 64  # schedulable parallelism (fd_benchg rotates a
     #                bounded funded account set the same way)
     t0 = time.time()
     ctx = default_bank_ctx(n_payers=n_payers)
-    # the ring AND shred lanes are chosen at endpoint/stage CONSTRUCTION
-    # (shm.make_*, ShredStage.__init__): the env switches only need to
-    # hold while the pipeline builds
+    # the ring, shred AND bank lanes are chosen at endpoint/stage
+    # CONSTRUCTION (shm.make_*, ShredStage.__init__,
+    # BankStage._arm_native): the env switches only need to hold while
+    # the pipeline builds
     env_prev = {k: os.environ.get(k)
                 for k in ("FDTPU_NATIVE_RING", "FDTPU_NATIVE_SHRED",
-                          "FDTPU_NATIVE_VERIFY")}
+                          "FDTPU_NATIVE_VERIFY", "FDTPU_NATIVE_BANK")}
     if native_ring is not None:
         os.environ["FDTPU_NATIVE_RING"] = "1" if native_ring else "0"
     if native_shred is not None:
         os.environ["FDTPU_NATIVE_SHRED"] = "1" if native_shred else "0"
     if native_verify is not None:
         os.environ["FDTPU_NATIVE_VERIFY"] = "1" if native_verify else "0"
+    if native_bank is not None:
+        os.environ["FDTPU_NATIVE_BANK"] = "1" if native_bank else "0"
     try:
         pipe = build_leader_pipeline(
             n_verify=1,
-            n_bank=4,
+            n_bank=n_bank,
             pool_size=64,  # placeholder; the real pool replaces it below
             gen_limit=n_txn,
             batch=512,
@@ -778,6 +884,7 @@ def _host_pipeline_measure(*, native_pack: bool,
             bank_ctx=ctx,
             native_pack=native_pack,
             keep_sets=False,  # frees the shred stage for the sweep lane
+            fuse_poh_shred=fused,
         )
     finally:
         for k, v in env_prev.items():
@@ -790,11 +897,14 @@ def _host_pipeline_measure(*, native_pack: bool,
                   else ("batch" if pipe.shred.native_shred else "python"))
     verify_mode = ("sweep" if pipe.verifies[0]._sweep_client is not None
                    else "python")
+    bank_mode = ("sweep" if pipe.banks[0]._sweep_client is not None
+                 else "python")
     pipe.benchg.pool = gen_transfer_pool(n_txn, n_payers=n_payers,
                                          n_dests=1024)
     print(f"# host pipeline: pool of {n_txn} signed in {time.time()-t0:.1f}s"
           f" (native_pack={native_pack}, native_ring={ring_on},"
-          f" shred={shred_mode}, verify={verify_mode})",
+          f" shred={shred_mode}, verify={verify_mode}, bank={bank_mode},"
+          f" fused={fused})",
           file=sys.stderr)
 
     def executed_cnt() -> int:
@@ -805,7 +915,11 @@ def _host_pipeline_measure(*, native_pack: bool,
         # steady-state throughput is the meaningful figure, so compile
         # cost stays out of the timed window (a real validator compiles
         # once per boot)
-        warm = 512
+        # default 512 covers the compiles; the bank A/B raises it past
+        # the dest-account set so the timed window is steady-state for
+        # BOTH lanes (first touches stash on the sweep lane and fault
+        # funk loads on the python lane — warmup cost either way)
+        warm = int(os.environ.get("FDTPU_BENCH_PIPELINE_WARM", "512"))
         pipe.run(until_txns=warm, max_iters=500_000, finish=False)
         warm_exec = executed_cnt()
         for b in pipe.banks:
@@ -951,8 +1065,23 @@ def _host_pipeline_measure(*, native_pack: bool,
             "pipeline_host_native_exec": exec_native.available(),
             "pipeline_host_native_shred": shred_mode,
             "pipeline_host_native_verify": verify_mode,
+            "pipeline_host_native_bank": bank_mode,
+            "pipeline_host_fused_poh_shred": fused,
         }
         out.update(_scrape_stage_latencies(pipe))
+        try:
+            # the occupancy-driven link tuner's snapshot for this run:
+            # pure function of the stages' own out_occupancy samples, so
+            # the NEXT topology build can consume it straight from the
+            # artifact (runtime/autotune.py — nothing resizes live rings)
+            from firedancer_tpu.runtime.autotune import recommend_topology
+
+            tuned = recommend_topology(pipe.stages)
+            out["autotune"] = {k: {str(i): t for i, t in v.items()}
+                               for k, v in tuned.items() if v}
+        except Exception as e:
+            print(f"# autotune snapshot failed: {type(e).__name__}",
+                  file=sys.stderr)
         if executed < target:
             out["pipeline_host_incomplete"] = True
         return out
@@ -1564,6 +1693,12 @@ def main() -> None:
         n = int(sys.argv[i + 1]) if len(sys.argv) > i + 1 \
             and sys.argv[i + 1].isdigit() else 3
         print(json.dumps(run_verify_ab(pairs=n), indent=1))
+        return
+    if "--bank-ab" in sys.argv:
+        i = sys.argv.index("--bank-ab")
+        n = int(sys.argv[i + 1]) if len(sys.argv) > i + 1 \
+            and sys.argv[i + 1].isdigit() else 3
+        print(json.dumps(run_bank_ab(pairs=n), indent=1))
         return
     if "--shred-ab" in sys.argv:
         i = sys.argv.index("--shred-ab")

@@ -94,6 +94,17 @@ _SHRED_PATH_FILES = frozenset({
     "fec_resolver.py",
 })
 
+# FD216: txn re-parse entry points whose per-frag use in a bank-path
+# module re-pays verify's parse — the verified frag already carries
+# `payload || packed descriptor || u16 trailer`, so the commit path
+# reads descriptor offsets, never reconstructs the Txn.  Bare names
+# cover from-imports; `ft.txn_parse`-style is matched by last component
+# (struct.unpack stays FD-clean: "unpack" alone is not in the set).
+_FD216_PARSE_NAMES = frozenset({
+    "txn_parse", "txn_unpack", "parse_txn", "message_parse",
+})
+_BANK_PATH_FILES = frozenset({"bank.py", "bank_native.py"})
+
 # FD214: the async-window discipline (ISSUE 13).  A verify stage keeps
 # >= 8 device batches in flight; ONE designated reap point consumes
 # device results, and a device->host sync anywhere else in the stage
@@ -285,6 +296,9 @@ class _Linter(ast.NodeVisitor):
         # once per entry/shred and must stay append-only; hashing and
         # shred framing happen at FEC-set granularity
         self._shred_scope = bool(parts) and parts[-1] in _SHRED_PATH_FILES
+        # FD216 scope: the bank-path modules — their frag callbacks are
+        # the commit hot path and consume pre-parsed verified frags
+        self._bank_scope = bool(parts) and parts[-1] in _BANK_PATH_FILES
         # FD214 scope: verify-path modules; the class/method context is
         # tracked below (verify-stage classes only, reap methods exempt)
         self._verify_scope = bool(parts) and parts[-1] in _FD214_FILES
@@ -628,6 +642,20 @@ class _Linter(ast.NodeVisitor):
                          "per-frag join-concat in a shred-path frag"
                          " callback: shred framing belongs at FEC-set"
                          " granularity, not per entry")
+        # FD216: txn re-parse in a bank-path frag callback — the frag is
+        # `payload || packed descriptor || u16 trailer` by the verify
+        # contract; the commit path reads sig/blockhash/account slices
+        # straight out of the descriptor's u16 offsets
+        if self._bank_scope:
+            pq = _dotted(node.func)
+            if pq is not None and pq[-1] in _FD216_PARSE_NAMES:
+                self.hit("FD216", node,
+                         f"txn re-parse '{'.'.join(pq)}' in a bank-path"
+                         " frag callback: the verified frag already"
+                         " carries the packed descriptor trailer — read"
+                         " offsets from it (bank.py's zero-copy items"
+                         " shape) instead of re-paying verify's parse"
+                         " per txn")
         # FD207: a native (ctypes) crossing per frag — the crossing
         # itself costs ~1-3us, so it belongs at burst granularity (one
         # call per drained burst / microblock, the fd_exec_batch shape)

@@ -223,3 +223,14 @@ FD213 = _rule(
     " entry_batch_to_fec_sets shape; the native lane does it all in one"
     " crossing)",
 )
+FD216 = _rule(
+    "FD216", "txn-reparse-in-bank-frag", SEV_ERROR,
+    "txn re-parse (txn_parse/txn_unpack/message-level parse) inside a frag"
+    " callback of a bank-path module: every frag a bank consumes already"
+    " carries `payload || packed descriptor || u16 trailer` — verify parsed"
+    " it once and pack preserved the trailer precisely so the commit path"
+    " reads offsets out of the descriptor (sig/blockhash/account slices by"
+    " u16 index) instead of re-paying the parse per txn; a parse here is"
+    " pure duplicate work on the hottest path (the native sweep reads the"
+    " same descriptor bytes in C)",
+)

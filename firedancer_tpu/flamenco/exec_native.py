@@ -10,10 +10,11 @@ loop overhead (fdlint FD207 enforces that discipline).
 Parity and fallback contract:
 
   - `eligible_packed` is the Executor's routing classifier: a txn whose
-    every instruction is in the native subset (system transfers/creates/
-    assign/allocate, vote vote/vote_state_update/tower_sync) routes
-    native; CPI, BPF, nonces, lookup tables and unsupported variants go
-    through the Python lane byte-for-byte.
+    every instruction is in the native subset (the full system surface
+    including the durable-nonce family, stake ops, vote vote/
+    vote_state_update/tower_sync) routes native; CPI, BPF, lookup
+    tables and unsupported variants go through the Python lane
+    byte-for-byte.
   - the C++ side may still PUNT any txn it is not sure about (old vote
     state versions, arithmetic Python's big ints would survive, bounds
     surprises); the batch stops before that txn mutates anything and the
@@ -127,8 +128,8 @@ _INSTR_SZ = _DESC_INSTR.size  # 9
 # VoteInstruction tags the native lane executes (Vote/VoteSwitch,
 # UpdateVoteState(Switch), TowerSync(Switch))
 NATIVE_VOTE_TAGS = frozenset((2, 6, 8, 9, 14, 15))
-# SystemInstruction tags routed to the Python lane (durable nonces)
-_NONCE_TAGS = frozenset((4, 5, 6, 7))
+# the stake program address (flamenco/stake.py STAKE_PROGRAM)
+_STAKE_PROGRAM = b"Stake11111" + bytes(22)
 
 
 def eligible_packed(payload: bytes, desc_bytes: bytes) -> bool:
@@ -147,11 +148,11 @@ def eligible_packed(payload: bytes, desc_bytes: bytes) -> bool:
             return False
         pa = acct_off + 32 * prog
         pk = payload[pa : pa + 32]
-        if pk == SYSTEM_PROGRAM:
-            if dsz >= 4:
-                tag = int.from_bytes(payload[doff : doff + 4], "little")
-                if tag in _NONCE_TAGS:
-                    return False
+        if pk == SYSTEM_PROGRAM or pk == _STAKE_PROGRAM:
+            # the whole native surface, durable-nonce family included
+            # (the session's in-line durable gate owns the stale-
+            # blockhash decision); stake tags 0..4 execute, others no-op
+            pass
         elif pk == VOTE_PROGRAM:
             if dsz >= 4:
                 tag = int.from_bytes(payload[doff : doff + 4], "little")
@@ -178,10 +179,18 @@ class BatchContext:
         clock_epoch: int | None = None,
         slot_hashes: bytes | None = None,
         session: Session | None = None,
+        recent_blockhash: bytes | None = None,
+        rent: tuple[int, int, float] | None = None,
     ):
         self._lib = _load()
         self._session = session
         sh = bytes(slot_hashes or b"")
+        rbh = bytes(recent_blockhash or b"")
+        # (flag, lamports_per_byte_year, exemption_threshold); flag 2 =
+        # the rent sysvar blob exists but does not decode — the C++ side
+        # punts nonce partial withdraws instead of guessing a floor
+        rent_flag, rent_lpby, rent_et = rent if rent is not None \
+            else (1, 3480, 2.0)
         self._fixed = (
             struct.pack(
                 "<QBQQB",
@@ -193,6 +202,8 @@ class BatchContext:
             )
             + _U32.pack(len(sh))
             + sh
+            + struct.pack("<B32sBQd", 1 if rbh else 0, rbh,
+                          rent_flag, rent_lpby, rent_et)
         )
         # request arena + response buffer, REUSED across microblocks
         # (ISSUE 11 bank-lane residual): the session path marshals with
@@ -216,7 +227,7 @@ class BatchContext:
         if self._resp is None:
             self._resp = ctypes.create_string_buffer(self._resp_cap)
 
-    def run(self, entries, *, gate=None) -> tuple[int, bool, list]:
+    def run(self, entries, *, gate=None, refresh=None) -> tuple[int, bool, list]:
         """One fd_exec_batch(2) call.  entries: [payload, desc_bytes,
         addrs, vals, ...] lists — only the first four fields are read
         here.  Returns (n_done, punted, [(status, fee, [(idx, value)])]).
@@ -229,9 +240,12 @@ class BatchContext:
         status-cache gate: (valid_blockhashes | None = unchanged,
         seen_delta) where seen_delta is an iterable of 96-byte
         blockhash||signature entries landed OUTSIDE the session since
-        the last call."""
+        the last call.  `refresh` (session mode) is an iterable of
+        (key, value) records merged into the session overlay before any
+        txn runs — the bank sweep's dirty-account resync, which has no
+        per-txn have=1 slot to ride."""
         if self._session is not None:
-            return self._run_session_arena(entries, gate)
+            return self._run_session_arena(entries, gate, refresh)
         parts = [struct.pack("<II", _REQ_MAGIC, len(entries)), self._fixed]
         req_sz = 0
         for e in entries:
@@ -263,7 +277,8 @@ class BatchContext:
                 raise NativeUnavailable(f"fd_exec_batch rc={rc}")
             return self._parse(buf.raw[:rc])
 
-    def _run_session_arena(self, entries, gate) -> tuple[int, bool, list]:
+    def _run_session_arena(self, entries, gate,
+                           refresh=None) -> tuple[int, bool, list]:
         """Session-mode crossing through the preallocated request arena:
         one capacity pass (plain int sums), then pack_into/slice-assign
         into the reused bytearray — no per-txn bytes construction, no
@@ -276,6 +291,9 @@ class BatchContext:
             if valid_bh is not None:
                 need += 32 * len(valid_bh)
             need += 96 * len(seen_delta)
+        if refresh:
+            for _k, v in refresh:
+                need += 36 + len(v)
         for e in entries:
             need += _TXN_HEAD.size + len(e[0]) + len(e[1])
             for v in e[3]:
@@ -311,10 +329,18 @@ class BatchContext:
             a[o] = 0
             struct.pack_into("<II", a, o + 1, 0, 0)
             o += 9
-        # reserved refresh section (count always 0: per-txn have=1
-        # values carry all account resyncs)
-        struct.pack_into("<I", a, o, 0)
+        # refresh records: session-overlay merges with no txn to ride
+        # (the bank sweep's dirty-account resync); empty on the
+        # execute_batch path, whose per-txn have=1 values carry resyncs
+        struct.pack_into("<I", a, o, len(refresh) if refresh else 0)
         o += 4
+        if refresh:
+            for k, v in refresh:
+                a[o : o + 32] = k
+                struct.pack_into("<I", a, o + 32, len(v))
+                o += 36
+                a[o : o + len(v)] = v
+                o += len(v)
         for e in entries:
             payload, desc_bytes, vals = e[0], e[1], e[3]
             _TXN_HEAD.pack_into(a, o, len(payload), len(desc_bytes),

@@ -543,6 +543,23 @@ class SlotExecution:
                         clock_slot, clock_epoch = c.slot, c.epoch
                     except T.CodecError:
                         pass  # no clock: vote txns fail typed, both lanes
+                # rent env for the nonce partial-withdraw floor: flag 2
+                # = blob present but undecodable (the C++ side punts at
+                # the point of use; the Python lane owns that path)
+                from firedancer_tpu.flamenco import types as T
+
+                _rd = T.Rent()  # absent blob -> defaults (nonce.py)
+                rent_flag = 1
+                rent_lpby = _rd.lamports_per_byte_year
+                rent_et = _rd.exemption_threshold
+                rent_blob = self.sysvars.get("rent")
+                if rent_blob:
+                    try:
+                        r = T.RENT.decode(rent_blob, 0)[0]
+                        rent_lpby = r.lamports_per_byte_year
+                        rent_et = r.exemption_threshold
+                    except T.CodecError:
+                        rent_flag = 2
                 try:
                     if self._native_session is None:
                         # one session per SlotExecution: the overlay and
@@ -555,6 +572,9 @@ class SlotExecution:
                         clock_epoch=clock_epoch,
                         slot_hashes=sh,
                         session=self._native_session,
+                        recent_blockhash=self.sysvars.get(
+                            "recent_blockhash"),
+                        rent=(rent_flag, rent_lpby, rent_et),
                     )
                 except exec_native.NativeUnavailable:
                     pass
@@ -611,6 +631,128 @@ class SlotExecution:
         if self._native_session is not None:
             self._native_session.close()
             self._native_session = None
+
+    # -- bank sweep client (native/fd_bank.cpp via runtime/bank_native) -------
+
+    def native_sync(self) -> bool:
+        """Re-arm the C session before a bank sweep with ONE zero-txn
+        crossing: the status-cache gate delta (Python-lane landings +
+        valid-set changes) and refresh records for every dirty account
+        (Python-lane writes since the last sync).  The sweep client
+        builds its own requests with no per-account values (the session
+        overlay is its only source), so this is the lane's whole
+        coherence protocol.  No-op when already coherent; returns False
+        when the native lane is unavailable/poisoned (the caller must
+        not let the sweep run)."""
+        nat = self._native_for_batch()
+        if nat is None or self._native_session is None:
+            return False
+        sc = self.status_cache
+        dirty = self._native_dirty
+        need_gate = sc is not None and (
+            not self._gate_seeded
+            or sc.version != self._gate_shipped_version
+            or bool(self._gate_seen_delta)
+        )
+        if not need_gate and not dirty:
+            return True
+        from firedancer_tpu.flamenco import exec_native
+
+        gate = self._gate_args()
+        n_delta = len(gate[1]) if gate is not None else 0
+        refresh = []
+        if dirty:
+            q = self.funk.rec_query
+            for a in dirty:
+                refresh.append((a, q(self.xid, a) or b""))
+        try:
+            nat.run([], gate=gate, refresh=refresh)
+        except exec_native.NativeUnavailable:
+            self._poison_native()
+            return False
+        if n_delta:
+            del self._gate_seen_delta[:n_delta]
+        if refresh:
+            self._native_known.update(a for a, _v in refresh)
+            dirty.clear()
+        return True
+
+    def native_apply_rec(self, payload: bytes, desc_bytes: bytes,
+                         status: int, fee: int, writes) -> TxnResult:
+        """Apply one sweep-committed txn record (the C side already ran
+        it against the session): funk writes, start-of-slot snapshots,
+        and the shared landed bookkeeping.  writes: [(acct_idx, value)]
+        with indices into the packed descriptor's account table."""
+        db = desc_bytes
+        bh = sig = None
+        if fee > 0 and self.status_cache is not None:
+            sig_off = db[2] | (db[3] << 8)
+            bh_off = db[11] | (db[12] << 8)
+            bh = payload[bh_off : bh_off + 32]
+            sig = payload[sig_off : sig_off + 64]
+        if writes:
+            acct_off = db[9] | (db[10] << 8)
+            before = self._before
+            q = self.funk.rec_query
+            known = self._native_known
+            dirty = self._native_dirty
+            for idx, val in writes:
+                a = payload[acct_off + 32 * idx : acct_off + 32 * (idx + 1)]
+                if a not in before:
+                    before[a] = q(self.parent_xid, a)
+                self.funk.rec_insert(self.xid, a, val)
+                known.add(a)
+                dirty.discard(a)
+        self.native_done_cnt += 1
+        return self._finish(TxnResult(status, fee), db[1], bh, sig,
+                            native=True)
+
+    def native_apply_batch(self, txns) -> list[TxnResult]:
+        """One sweep group's committed records in a single pass —
+        semantically native_apply_rec over each (payload, desc_bytes,
+        status, fee, writes) tuple, but the funk txn resolves/validates
+        once for the whole batch and every per-txn attribute chase is
+        hoisted to a local.  This is the drain's per-txn floor: the C
+        side already ran the txns, so everything left here is
+        authoritative-state application."""
+        before = self._before
+        q = self.funk.rec_query
+        recs_d = self.funk.txn_recs_for_write(self.xid)
+        known = self._native_known
+        dirty = self._native_dirty
+        pxid = self.parent_xid
+        xid = self.xid
+        sc = self.status_cache
+        block_seen = self._block_seen
+        stage_insert = sc.stage_insert if sc is not None else None
+        results = self.results
+        out = []
+        sig_cnt = 0
+        for payload, db, status, fee, writes in txns:
+            if writes:
+                acct_off = db[9] | (db[10] << 8)
+                for idx, val in writes:
+                    a = payload[acct_off + 32 * idx:acct_off + 32 * (idx + 1)]
+                    if a not in before:
+                        before[a] = q(pxid, a)
+                    recs_d[a] = val if type(val) is bytes else bytes(val)
+                    known.add(a)
+                    dirty.discard(a)
+            self.native_done_cnt += 1
+            r = TxnResult(status, fee)
+            if fee > 0:
+                sig_cnt += db[1]
+                if stage_insert is not None:
+                    sig_off = db[2] | (db[3] << 8)
+                    bh_off = db[11] | (db[12] << 8)
+                    bh = payload[bh_off : bh_off + 32]
+                    sig = payload[sig_off : sig_off + 64]
+                    block_seen.add((bh, sig))
+                    stage_insert(xid, bh, sig)
+            results.append(r)
+            out.append(r)
+        self.signature_cnt += sig_cnt
+        return out
 
     @staticmethod
     def _unpack_trailer(payload: bytes, desc_bytes: bytes) -> ft.Txn:

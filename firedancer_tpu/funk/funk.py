@@ -136,6 +136,17 @@ class Funk:
             raise FunkError(ERR_FROZEN, "txn has children; records frozen")
         t.recs[key] = bytes(val)
 
+    def txn_recs_for_write(self, xid: bytes) -> dict:
+        """The txn's live record dict for a BATCH of insert-or-modify
+        writes (the bank drain's per-sweep apply): the ancestry lookup
+        and frozen check run once up front instead of once per record.
+        Callers must store plain bytes values and must not hold the
+        dict across a txn_publish/cancel."""
+        t = self._get(xid)
+        if t.children:
+            raise FunkError(ERR_FROZEN, "txn has children; records frozen")
+        return t.recs
+
     def rec_remove(self, xid: bytes | None, key: bytes) -> None:
         """Remove `key` as seen from `xid` (tombstones hide ancestors)."""
         if xid is None:

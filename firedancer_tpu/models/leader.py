@@ -126,6 +126,10 @@ class LeaderPipeline:
         # (the BENCH_r03-05 pollution).  Ordering is the fix: views die,
         # THEN the mappings close, THEN the names unlink.
         for s in self.stages:
+            half = getattr(s, "shred_half", None)
+            if half is not None:  # fused poh+shred: the inner stage's
+                half.ins = []     # link views must die too
+                half.outs = []
             s.ins = []
             s.outs = []
         import gc
@@ -174,6 +178,7 @@ def build_leader_pipeline(
     native_pack: bool | None = None,
     slot_clock=None,
     shed_keep: int | None = None,
+    fuse_poh_shred: bool = False,
 ) -> LeaderPipeline:
     """keep_sets=False releases the shred stage from materializing
     FecSets in Python, which lets it adopt the zero-Python sweep lane
@@ -212,7 +217,8 @@ def build_leader_pipeline(
     pack_bank = [mklink(f"pb{b}", mtu=65536) for b in range(n_bank)]
     bank_poh = [mklink(f"bp{b}", mtu=65536) for b in range(n_bank)]
     bank_done = [mklink(f"bd{b}", mtu=64) for b in range(n_bank)]
-    poh_shred = mklink("ps", mtu=65536)
+    # the fused poh+shred crash domain has no poh->shred ring hop
+    poh_shred = None if fuse_poh_shred else mklink("ps", mtu=65536)
     shred_store = mklink("ss", mtu=1232, d=4096)
 
     secret = hashlib.sha256(leader_seed).digest()
@@ -281,24 +287,39 @@ def build_leader_pipeline(
     ]
     for bstage in banks:
         bstage.require_credit = True
-    poh = PohStage(
-        "poh",
-        ins=[shm.make_consumer(l, lazy=8) for l in bank_poh],
-        outs=[shm.make_producer(poh_shred)],
-        clock=slot_clock,
-    )
+    if fuse_poh_shred:
+        from firedancer_tpu.runtime.shred_stage import FusedPohShredStage
+
+        poh = FusedPohShredStage(
+            "poh_shred",
+            ins=[shm.make_consumer(l, lazy=8) for l in bank_poh],
+            outs=[shm.make_producer(shred_store)],
+            clock=slot_clock,
+            signer=lambda root: ref.sign(secret, root),
+            secret=secret,
+            shred_slot=slot,
+            keep_sets=keep_sets,
+        )
+        shred = poh.shred_half
+    else:
+        poh = PohStage(
+            "poh",
+            ins=[shm.make_consumer(l, lazy=8) for l in bank_poh],
+            outs=[shm.make_producer(poh_shred)],
+            clock=slot_clock,
+        )
+        shred = ShredStage(
+            "shred",
+            ins=[shm.make_consumer(poh_shred, lazy=8)],
+            outs=[shm.make_producer(shred_store)],
+            signer=lambda root: ref.sign(secret, root),
+            secret=secret,  # arms the native shredder lane when available
+            slot=slot,
+            keep_sets=keep_sets,
+        )
     poh.require_credit = True
     if keep_entries:
         poh.entries = []
-    shred = ShredStage(
-        "shred",
-        ins=[shm.make_consumer(poh_shred, lazy=8)],
-        outs=[shm.make_producer(shred_store)],
-        signer=lambda root: ref.sign(secret, root),
-        secret=secret,  # arms the native shredder lane when available
-        slot=slot,
-        keep_sets=keep_sets,
-    )
     # the leader's own store trusts its own signing path (the reference's
     # shred tile only signature-verifies shreds arriving from OTHER
     # leaders on the retransmit path, fd_fec_resolver_new's NULL-signer
@@ -311,7 +332,8 @@ def build_leader_pipeline(
         trust_membership=True,
     )
     stages = [benchg, *verifies] + ([dedup] if dedup else []) \
-        + [pack, *banks, poh, shred, store]
+        + [pack, *banks, poh] \
+        + ([] if fuse_poh_shred else [shred]) + [store]
     return LeaderPipeline(
         stages=stages,
         links=links,

@@ -1,0 +1,130 @@
+"""Per-link credit/depth autotuner (ISSUE 16).
+
+Every stage samples each out ring's occupancy fraction
+(1 - credits/depth) at housekeeping cadence (stage.py _housekeeping)
+into per-out bucket counts over `OCC_EDGES`.  This module turns those
+histograms into (depth, lazy) recommendations per link:
+
+  - a link whose p99 occupancy crowds the top (>= HIGH_OCC) is a
+    backpressure choke: double its depth up the ladder and HALVE the
+    producing stage's housekeeping laziness so credits refresh before
+    the ring fills again;
+  - a link that never rises above LOW_OCC at p99 is oversized memory
+    and cache traffic: step the depth down the ladder (floor 64) and
+    relax the laziness;
+  - anything in between keeps its current geometry (hysteresis — ring
+    resizes are not free, so the tuner only moves on clear evidence).
+
+Pure and deterministic by contract, exactly like verify_tune: the same
+bucket counts always yield the same recommendation, so a tuned topology
+is as reproducible as an untuned one and an offline recommendation from
+a scraped snapshot matches what the live stage would pick.  Nothing
+here resizes a live ring — shm rings are fixed at create — the output
+feeds the NEXT topology build (bench records it per run).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+# occupancy-fraction bucket edges, shared with stage.py's sampler and
+# the out_occupancy schema histogram (utils/metrics.stage_schema)
+OCC_EDGES = (0.0625, 0.125, 0.25, 0.5, 0.75, 0.875, 0.9375, 1.0)
+
+DEPTH_LADDER = (64, 128, 256, 512, 1024, 2048, 4096, 8192)
+LAZY_LADDER = (8, 16, 32, 64, 128, 256)
+
+OCC_Q = 0.99        # the tail that decides: sustained pressure, not spikes
+HIGH_OCC = 0.75     # p99 at or above this -> grow
+LOW_OCC = 0.125     # p99 at or below this -> shrink
+MIN_EVIDENCE = 32   # samples before any move (cold stages keep defaults)
+
+
+@dataclass(frozen=True)
+class LinkTuning:
+    """One out link's recommended geometry."""
+
+    depth: int
+    lazy: int
+
+    def as_dict(self) -> dict:
+        return {"depth": self.depth, "lazy": self.lazy}
+
+
+def _quantile_edge(counts: list[int], q: float) -> float | None:
+    """The OCC_EDGES edge at the q-quantile of the bucket counts
+    (counts[i] <= edge i; the overflow bucket maps to 1.0).  None when
+    there is no evidence."""
+    total = sum(counts)
+    if total <= 0:
+        return None
+    target = q * total
+    acc = 0
+    for i, c in enumerate(counts):
+        acc += c
+        if acc >= target:
+            return OCC_EDGES[i] if i < len(OCC_EDGES) else 1.0
+    return 1.0
+
+
+def _ladder_step(ladder: tuple, v: int, direction: int) -> int:
+    """The next rung up (+1) or down (-1) from the rung covering v;
+    clamped at the ends.  v between rungs snaps to the smallest rung
+    >= v first."""
+    idx = 0
+    for i, rung in enumerate(ladder):
+        idx = i
+        if rung >= v:
+            break
+    return ladder[max(0, min(len(ladder) - 1, idx + direction))]
+
+
+def recommend_link(
+    occ_counts: list[int], *, depth: int, lazy: int = 128
+) -> LinkTuning:
+    """The deterministic per-link recommendation from one sample set.
+
+    occ_counts: bucket counts over OCC_EDGES (+1 overflow slot), as
+    Stage.out_occupancy keeps per out.  depth/lazy: the link's current
+    ring depth and the producing stage's housekeeping laziness."""
+    q = _quantile_edge(occ_counts, OCC_Q)
+    if q is None or sum(occ_counts) < MIN_EVIDENCE:
+        return LinkTuning(depth=depth, lazy=lazy)
+    if q >= HIGH_OCC:
+        return LinkTuning(
+            depth=_ladder_step(DEPTH_LADDER, depth, +1),
+            lazy=_ladder_step(LAZY_LADDER, lazy, -1),
+        )
+    if q <= LOW_OCC:
+        return LinkTuning(
+            depth=_ladder_step(DEPTH_LADDER, depth, -1),
+            lazy=_ladder_step(LAZY_LADDER, lazy, +1),
+        )
+    return LinkTuning(depth=depth, lazy=lazy)
+
+
+def recommend_for_stage(stage) -> dict[int, LinkTuning]:
+    """Per-out recommendations from a live stage's own samples.  Only
+    outs with a sized link (depth known) appear.  Never touches ring
+    state."""
+    out: dict[int, LinkTuning] = {}
+    for i, p in enumerate(stage.outs):
+        if i >= len(stage.out_occupancy):
+            break
+        d = getattr(getattr(p, "link", None), "depth", 0)
+        if not d:
+            continue
+        out[i] = recommend_link(
+            stage.out_occupancy[i], depth=d, lazy=stage.lazy
+        )
+    return out
+
+
+def recommend_topology(stages) -> dict[str, dict[int, dict]]:
+    """The whole-pipeline snapshot (bench artifact form): stage name ->
+    out idx -> {depth, lazy}."""
+    return {
+        s.name: {i: t.as_dict() for i, t in recommend_for_stage(s).items()}
+        for s in stages
+        if s.outs
+    }

@@ -31,6 +31,16 @@ Outputs: outs[0] = bank->poh executed microblocks; outs[1] = done->pack.
 
 Entry frame out: 32B mixin | u16 txn_cnt | (u16 len || raw txn payload)*.
 Done frame out: empty payload, sig = bank index.
+
+Native sweep lane (ISSUE 16): when the exec session and both out
+producers are native, the whole after_frag hot path — microblock parse,
+session exec, entry build, both publishes — runs inside ONE `fdr_sweep`
+crossing per credit window (native/fd_bank.cpp via runtime/bank_native).
+Python's before_credit drains the C result log each iteration: applies
+the committed records to funk (still the authoritative store), resumes
+punted/stalled microblocks on the Python lane IN ORDER, and re-syncs
+the session (status-cache gate delta + dirty account values) before the
+next sweep.  `FDTPU_NATIVE_BANK=0` forces the Python path.
 """
 
 from __future__ import annotations
@@ -41,6 +51,13 @@ from firedancer_tpu.protocol import txn as ft
 from firedancer_tpu.tango.rings import MCache
 from firedancer_tpu.utils import metrics as fm
 from .stage import Stage
+
+# lazy singletons for _drain_native's per-iteration hot path (set on
+# first drain; bank_native imports ctypes machinery, so module import
+# time stays free of it for python-lane-only users)
+_bd = None
+_TXN_SUCCESS = None
+_now_ns = None
 
 
 def parse_microblock(frame: bytes) -> tuple[int, list[bytes]]:
@@ -171,6 +188,20 @@ class BankStage(Stage):
                      " the in-flight microblock always finishes — commits"
                      " are atomic per after_frag — and the boundary is"
                      " only ever crossed BETWEEN microblocks)")
+            # bank sweep lane (native/fd_bank.cpp), absolute values
+            # copied from the C counter tail in during_housekeeping
+            .counter("bank_mb_seen", "microblocks entering the C sweep")
+            .counter("bank_mb_native",
+                     "microblocks fully committed+published in C")
+            .counter("bank_mb_stashed",
+                     "microblocks stashed for the Python-lane drain"
+                     " (punt, credit stall, or publish fallback)")
+            .counter("bank_txn_native",
+                     "txns the C sweep committed session-side")
+            .counter("bank_credit_waits",
+                     "sweep stalls: an out ring had no credit pre-exec")
+            .counter("bank_mb_dropped",
+                     "log-arena OOM before commit (never-path diag)")
         )
 
     def __init__(self, *args, bank_idx: int = 0, ctx: BankCtx | None = None,
@@ -193,8 +224,49 @@ class BankStage(Stage):
         self._clock = resolve_clock(clock)
         self._clock_slot = (self._clock.cfg.slot0
                             if self._clock is not None else 0)
+        # bank sweep lane: armed when the exec session is live and both
+        # out producers are native — the sweep harness (stage.py) then
+        # routes whole credit windows through fdb_frag_cb
+        self._armed_ctx = None
+        self._arm_native()
+
+    def _arm_native(self) -> None:
+        self._sweep_client = None
+        from . import bank_native as bd
+
+        if not bd.available():
+            return
+        if len(self.outs) < 2 or any(
+            type(p).__name__ != "NativeProducer" for p in self.outs[:2]
+        ):
+            return
+        sx = self.ctx.sx
+        nat = sx._native_for_batch()
+        if nat is None or sx._native_session is None:
+            return
+        try:
+            hdr = bd.make_hdr(nat, gated=sx.status_cache is not None)
+            self._sweep_client = bd.StageClient(
+                sx._native_session, hdr, self.outs[0], self.outs[1],
+                bank_idx=self.bank_idx,
+            )
+            self._armed_ctx = nat
+        except bd.NativeUnavailable:
+            self._sweep_client = None
+
+    def _disarm_native(self) -> None:
+        """The exec session died (poisoned mid-resume): the C client's
+        session pointer is stale, so the sweep must never run again —
+        close it BEFORE returning to the harness (which rebuilds its
+        cached drainer on client change and falls back per-frag)."""
+        c = self._sweep_client
+        self._sweep_client = None
+        self._armed_ctx = None
+        if c is not None:
+            c.close()
 
     def before_credit(self) -> None:
+        self._drain_native()
         if self._clock is None:
             return
         now = self._clock.now()
@@ -207,9 +279,163 @@ class BankStage(Stage):
             self.trace(fm.EV_SLOT_ROLL, slot)
             self._clock_slot = slot
 
+    def during_housekeeping(self) -> None:
+        c = self._sweep_client
+        if c is not None:
+            self.metrics.counters.update(c.counters())
+
+    def flush(self) -> None:
+        """Settle any pending stash (end-of-run: the harness stops
+        sweeping, so the result log must not hold unresumed work)."""
+        self._drain_native()
+
+    def _drain_native(self) -> None:
+        """Drain the C sweep's result log: apply committed records to
+        funk, resume stashed microblocks on the Python lane in arrival
+        order, publish their frames, then re-sync the session so the
+        next sweep sees every Python-side landing and write."""
+        c = self._sweep_client
+        if c is None:
+            return
+        # hot path: these run once per bank per iteration, so the import
+        # machinery (1 dict probe per `from x import y` even when cached)
+        # is hoisted into module-level lazy singletons
+        global _bd, _TXN_SUCCESS, _now_ns
+        if _bd is None:
+            from . import bank_native as _bd_mod
+            from firedancer_tpu.flamenco.runtime import TXN_SUCCESS as _ts
+            from firedancer_tpu.tango.shm import now_ns as _nn
+            _bd, _TXN_SUCCESS, _now_ns = _bd_mod, _ts, _nn
+        bd, TXN_SUCCESS, now_ns = _bd, _TXN_SUCCESS, _now_ns
+
+        sx = self.ctx.sx
+        log = c.take_log()
+        if log:
+            groups = bd.parse_log(log)
+            # All-or-nothing credit gate: the C lane stashed these
+            # microblocks BECAUSE an out ring had no credit, and
+            # Stage.publish drops on failure.  State application is not
+            # replayable (funk writes would double-apply), so the whole
+            # drain defers until the consumers freed enough credits for
+            # every pending publish.  Meanwhile stash_pending keeps the
+            # C lane appending raw frags, bounded by the input ring.
+            need_ent = sum(1 for g in groups if g[4] == 0)
+            need_done = sum(1 for g in groups if g[4] != 1)
+            if need_ent or need_done:
+                for p in self.outs[:2]:
+                    p.refresh_credits()
+                if (self.outs[0].cr_avail < need_ent
+                        or self.outs[1].cr_avail < need_done):
+                    return
+            from_bytes = int.from_bytes
+            for (mb_seq, tsorig, lat_ns, n_done, published, recs,
+                 mb) in groups:
+                _seq, frags = parse_microblock(mb)
+                sigs: list[bytes] = []
+                txns: list[bytes] = []
+                batch = []
+                n_ok = n_fail = n_rej = 0
+                for frag, (status, fee, writes) in zip(frags, recs):
+                    psz = from_bytes(frag[-2:], "little")
+                    p, db = frag[:psz], frag[psz:-2]
+                    batch.append((p, db, status, fee, writes))
+                    if fee > 0:
+                        sig_off = db[2] | (db[3] << 8)
+                        sigs.append(p[sig_off : sig_off + 64])
+                        txns.append(p)
+                        n_ok += 1
+                        if status != TXN_SUCCESS:
+                            n_fail += 1
+                    else:
+                        n_rej += 1
+                if batch:
+                    sx.native_apply_batch(batch)
+                if n_ok:
+                    self.metrics.inc("txn_exec", n_ok)
+                if n_fail:
+                    self.metrics.inc("txn_exec_failed", n_fail)
+                if n_rej:
+                    self.metrics.inc("txn_rejected", n_rej)
+                self.metrics.inc("native_exec", n_done)
+                if published == 1:
+                    # entry + done already on the rings: state only
+                    self.metrics.inc("microblocks")
+                    self.trace(fm.EV_MICROBLOCK, len(txns))
+                    if tsorig and len(self.commit_latencies_ns) < 100_000:
+                        self.commit_latencies_ns.append(int(lat_ns))
+                    continue
+                if published == 2:
+                    # entry is out; only the done frame was deferred
+                    self.metrics.inc("microblocks")
+                    self.trace(fm.EV_MICROBLOCK, len(txns))
+                    if tsorig and len(self.commit_latencies_ns) < 100_000:
+                        self.commit_latencies_ns.append(int(lat_ns))
+                    self.publish(1, b"", sig=self.bank_idx)
+                    continue
+                # published == 0: resume the tail in order, then publish
+                # both frames from Python (byte-identical entry format)
+                items = []
+                for frag in frags[n_done:]:
+                    psz = int.from_bytes(frag[-2:], "little")
+                    items.append((frag[:psz], None, frag[psz:-2]))
+                nd0, np0 = sx.native_done_cnt, sx.native_punt_cnt
+                results = self.ctx.execute_batch(items) if items else []
+                d_native = sx.native_done_cnt - nd0
+                d_punt = sx.native_punt_cnt - np0
+                if d_native:
+                    self.metrics.inc("native_exec", d_native)
+                if d_punt:
+                    self.metrics.inc("native_punt", d_punt)
+                    self.trace(fm.EV_NATIVE_PUNT, d_punt)
+                for (p, _desc, db), r in zip(items, results):
+                    if r.fee > 0:
+                        sig_off = db[2] | (db[3] << 8)
+                        sigs.append(p[sig_off : sig_off + 64])
+                        txns.append(p)
+                        self.metrics.inc("txn_exec")
+                        if r.status != TXN_SUCCESS:
+                            self.metrics.inc("txn_exec_failed")
+                    else:
+                        self.metrics.inc("txn_rejected")
+                self.metrics.inc("microblocks")
+                self.trace(fm.EV_MICROBLOCK, len(txns))
+                if tsorig and len(self.commit_latencies_ns) < 100_000:
+                    self.commit_latencies_ns.append(now_ns() - tsorig)
+                if txns:
+                    mixin = hashlib.sha256(b"".join(sigs)).digest()
+                    out = bytearray()
+                    out += mixin
+                    out += len(txns).to_bytes(2, "little")
+                    for p in txns:
+                        out += len(p).to_bytes(2, "little")
+                        out += p
+                    self.publish(0, bytes(out), sig=mb_seq, tsorig=tsorig)
+                self.publish(1, b"", sig=self.bank_idx)
+            c.clear_log()
+        # session coherence before the next sweep; a poisoned session
+        # (mid-resume failure) permanently disarms the lane
+        if not sx.native_sync():
+            self._disarm_native()
+            return
+        # the env header follows BatchContext rebuilds (sysvar swap)
+        nat = sx._native_ctx or None
+        if nat is not self._armed_ctx and nat is not None:
+            try:
+                self._sweep_client.set_hdr(
+                    bd.make_hdr(nat, gated=sx.status_cache is not None))
+                self._armed_ctx = nat
+            except bd.NativeUnavailable:
+                self._disarm_native()
+
     def after_frag(self, in_idx: int, meta, payload: bytes) -> None:
         from firedancer_tpu.flamenco.runtime import TXN_SUCCESS
 
+        if self._sweep_client is not None:
+            # mixed-lane splice: a frag arrived on the per-frag path
+            # while the sweep lane is armed — settle the C log first so
+            # microblock order stays ring order, then commit in Python
+            # (the next drain's sync re-ships whatever this dirties)
+            self._drain_native()
         mb_seq, frags = parse_microblock(payload)
         # zero-copy commit path: the verified frag already carries
         # payload || packed descriptor || u16 payload_sz, which is exactly

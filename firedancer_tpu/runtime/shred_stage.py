@@ -35,6 +35,7 @@ Python shredder end to end.
 from __future__ import annotations
 
 from firedancer_tpu.tango.rings import MCache
+from .poh_stage import PohStage
 from .shredder import EntryBatchMeta, FecSet, Shredder
 from .stage import Stage
 
@@ -178,6 +179,61 @@ class ShredStage(Stage):
                 self.publish_burst_out(0, items)
                 self.metrics.inc("data_shreds_out", len(st.data_shreds))
                 self.metrics.inc("parity_shreds_out", len(st.parity_shreds))
+
+
+class FusedPohShredStage(PohStage):
+    """Fused poh+shred crash domain (ISSUE 16): ONE stage owns both the
+    hash clock and the shredder, collapsing the poh->shred ring hop —
+    each bank microblock's entry goes mixin -> entry batch -> FEC set
+    inside a single run_once sweep, and ticks append to the same batch
+    buffer with no intermediate ring crossing.
+
+    Composition, not reimplementation: the PoH half IS PohStage (every
+    slot-clock seal/miss semantic from PR 14 inherited verbatim); the
+    shred half IS a ShredStage whose intake is called in-process where
+    the unfused topology would publish to the poh_shred link.  The
+    shred half's native sweep buffer (fd_shred.cpp stage_append closes
+    batches at target size in C) still takes the entries, so the fused
+    lane keeps the zero-Python shred path.  Crash-domain consequence:
+    the supervisor restarts poh and shred together — entries can never
+    be stranded on a ring between the two.
+
+    outs[0] is the WIRE SHRED link (the unfused shred stage's out); the
+    PoH half's credit checks therefore gate tick emission on the same
+    downstream the shreds land on, which is exactly the backpressure
+    the collapsed hop implies."""
+
+    def __init__(self, *args, signer, secret: bytes | None = None,
+                 shred_slot: int = 1, shred_version: int = 1,
+                 batch_target_sz: int = 16384, keep_sets: bool = False,
+                 shred_plane=None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.shred_half = ShredStage(
+            f"{self.name}/shred", ins=[], outs=list(self.outs),
+            signer=signer, secret=secret, slot=shred_slot,
+            shred_version=shred_version, batch_target_sz=batch_target_sz,
+            keep_sets=keep_sets, plane=shred_plane,
+        )
+
+    def publish(self, out_idx: int, payload: bytes, sig: int = 0,
+                tsorig: int = 0) -> bool:
+        """The collapsed hop: every entry the PoH half emits feeds the
+        shredder in-process instead of crossing a ring."""
+        meta = [0] * 8
+        meta[MCache.COL_TSORIG] = tsorig
+        self.shred_half.after_frag(0, meta, payload)
+        self.metrics.inc("frags_out")  # unfused-poh metric parity
+        return True
+
+    def after_credit(self) -> None:
+        super().after_credit()  # the clock: ticks / slot-clock sweep
+        self.shred_half.after_credit()  # credit-deferred batch retry
+
+    def during_housekeeping(self) -> None:
+        self.shred_half.during_housekeeping()
+
+    def flush(self, *, block_complete: bool = True) -> None:
+        self.shred_half.flush(block_complete=block_complete)
 
 
 def deshred_entry_batch(batch: bytes) -> list[bytes]:

@@ -32,6 +32,7 @@ import numpy as np
 from firedancer_tpu.tango import shm
 from firedancer_tpu.tango.rings import CNC_SIG_HALT, CNC_SIG_RUN, Cnc, MCache
 from firedancer_tpu.utils import metrics as fm
+from .autotune import OCC_EDGES
 
 _pc = time.perf_counter
 
@@ -219,6 +220,9 @@ class Stage:
         from firedancer_tpu.utils.rng import Rng
 
         self._rng = Rng(seed, zlib.crc32(name.encode()))
+        # per-out occupancy bucket counts (OCC_EDGES geometry), sampled
+        # in _housekeeping — runtime/autotune's per-link evidence
+        self.out_occupancy: list[list[int]] = []
         self._next_housekeeping = 0
         self._iter = 0
         self._in_rr = 0  # round-robin input cursor
@@ -342,6 +346,21 @@ class Stage:
             c.publish_progress()
         for p in self.outs:
             p.refresh_credits()
+        # per-link occupancy sample (1 - credits/depth) at housekeeping
+        # cadence — the evidence the credit/depth autotuner
+        # (runtime/autotune) sizes rings and laziness from.  Kept both
+        # as the schema histogram (monitor/scrape) and as per-out bucket
+        # counts (per-LINK resolution the aggregate hist can't give).
+        if len(self.out_occupancy) != len(self.outs):
+            self.out_occupancy = [
+                [0] * (len(OCC_EDGES) + 1) for _ in self.outs
+            ]
+        for i, p in enumerate(self.outs):
+            d = getattr(getattr(p, "link", None), "depth", 0)
+            if d:
+                occ = 1.0 - p.cr_avail / d
+                self.metrics.observe("out_occupancy", occ)
+                self.out_occupancy[i][bisect_left(OCC_EDGES, occ)] += 1
         self.cnc.heartbeat(time.monotonic_ns())
         m = self.metrics
         self.cnc.diag_set(self.DIAG_FRAGS_IN, m.get("frags_in"))

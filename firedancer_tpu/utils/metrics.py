@@ -607,4 +607,10 @@ def stage_schema() -> MetricsSchema:
             exp_buckets(1e3, 1e10, 24),
             "tsorig->processing latency per frag",
         )
+        .histogram(
+            "out_occupancy",
+            (0.0625, 0.125, 0.25, 0.5, 0.75, 0.875, 0.9375, 1.0),
+            "out-ring occupancy fraction (1 - credits/depth) sampled at"
+            " housekeeping cadence — the autotuner's sizing evidence",
+        )
     )

@@ -12,18 +12,21 @@
 // zero Account-object traffic on the hot path.
 //
 // Parity contract (differentially tested against flamenco/runtime.py
-// _execute_txn + programs.py/vote_program.py): identical status codes,
-// fees, and final account bytes.  Anything this lane is not SURE about —
-// other programs, nonce instructions, vote state versions != current,
-// lookup tables, arithmetic overflow that Python's big ints would survive
-// — raises Punt: the batch stops BEFORE the txn mutates anything, the
-// caller executes that txn through the Python lane, and resubmits the
-// remainder.  Sequential semantics hold across the batch via an account
-// overlay (a txn reads every earlier txn's committed writes).
+// _execute_txn + programs.py/vote_program.py/nonce.py/stake.py):
+// identical status codes, fees, and final account bytes.  Anything this
+// lane is not SURE about — other programs, vote state versions !=
+// current, lookup tables, arithmetic overflow that Python's big ints
+// would survive — raises Punt: the batch stops BEFORE the txn mutates
+// anything, the caller executes that txn through the Python lane, and
+// resubmits the remainder.  Sequential semantics hold across the batch
+// via an account overlay (a txn reads every earlier txn's committed
+// writes).
 //
 // Status codes mirror flamenco/runtime.py:
 //   0 success | -1 fee payer short (no fee) | -2 insufficient funds
 //   -3 account error | -4 program error     (-2/-3/-4 still pay the fee)
+//   -5 blockhash unknown/expired (no fee; the session gate's verdict
+//      when the durable-nonce check fails)
 //
 // Build: scripts/build_native.sh (g++ -O2 -shared -fPIC).
 
@@ -50,6 +53,7 @@ constexpr i64 ST_FEE = -1;
 constexpr i64 ST_FUNDS = -2;
 constexpr i64 ST_ACCT = -3;
 constexpr i64 ST_PROG = -4;
+constexpr i64 ST_BLOCKHASH = -5;  // TXN_ERR_BLOCKHASH (no fee)
 constexpr i64 ST_ALREADY = -6;  // TXN_ERR_ALREADY_PROCESSED (no fee)
 
 constexpr u64 MAX_PERMITTED_DATA_LENGTH = 10ull * 1024 * 1024;
@@ -68,6 +72,10 @@ static const Key VOTE_KEY = {
     0x7c, 0x4d, 0x76, 0x24, 0xeb, 0xd3, 0xbd, 0xb3,
     0xd8, 0x35, 0x5e, 0x73, 0xd1, 0x10, 0x43, 0xfc,
     0x0d, 0xa3, 0x53, 0x80, 0x00, 0x00, 0x00, 0x00,
+};
+// b"Stake11111" + 22 zero bytes (flamenco/stake.py STAKE_PROGRAM)
+static const Key STAKE_KEY = {
+    'S', 't', 'a', 'k', 'e', '1', '1', '1', '1', '1',
 };
 
 // typed failures: InstrError family mapped to the runtime's txn status
@@ -199,6 +207,91 @@ struct Rd {
     u8 b = get8();
     if (b > 1) throw Err{ST_PROG};
     return b == 1;
+  }
+};
+
+// -- sha-256 (durable-nonce hash rotation; portable, nonce ops are rare) -----
+
+static const u32 SHA_H0[8] = {
+    0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+    0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19,
+};
+static const u32 SHA_K[64] = {
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2,
+};
+
+static inline u32 sha_rotr(u32 x, unsigned r) {
+  return (x >> r) | (x << (32 - r));
+}
+
+struct Sha256 {
+  u32 h[8];
+  u8 buf[64];
+  u64 len;
+  Sha256() { std::memcpy(h, SHA_H0, sizeof(h)); len = 0; }
+  void block(const u8* p) {
+    u32 w[64];
+    for (int i = 0; i < 16; i++)
+      w[i] = (u32)p[4 * i] << 24 | (u32)p[4 * i + 1] << 16 |
+             (u32)p[4 * i + 2] << 8 | (u32)p[4 * i + 3];
+    for (int i = 16; i < 64; i++) {
+      u32 s0 = sha_rotr(w[i - 15], 7) ^ sha_rotr(w[i - 15], 18) ^
+               (w[i - 15] >> 3);
+      u32 s1 = sha_rotr(w[i - 2], 17) ^ sha_rotr(w[i - 2], 19) ^
+               (w[i - 2] >> 10);
+      w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+    }
+    u32 a = h[0], b = h[1], c = h[2], d = h[3], e = h[4], f = h[5], g = h[6],
+        hh = h[7];
+    for (int i = 0; i < 64; i++) {
+      u32 S1 = sha_rotr(e, 6) ^ sha_rotr(e, 11) ^ sha_rotr(e, 25);
+      u32 ch = (e & f) ^ (~e & g);
+      u32 t1 = hh + S1 + ch + SHA_K[i] + w[i];
+      u32 S0 = sha_rotr(a, 2) ^ sha_rotr(a, 13) ^ sha_rotr(a, 22);
+      u32 maj = (a & b) ^ (a & c) ^ (b & c);
+      u32 t2 = S0 + maj;
+      hh = g; g = f; f = e; e = d + t1;
+      d = c; c = b; b = a; a = t1 + t2;
+    }
+    h[0] += a; h[1] += b; h[2] += c; h[3] += d;
+    h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
+  }
+  void update(const u8* p, u64 n) {
+    u64 have = len & 63;
+    len += n;
+    if (have) {
+      u64 need = 64 - have;
+      if (n < need) { std::memcpy(buf + have, p, n); return; }
+      std::memcpy(buf + have, p, need);
+      block(buf);
+      p += need; n -= need;
+    }
+    while (n >= 64) { block(p); p += 64; n -= 64; }
+    if (n) std::memcpy(buf, p, n);
+  }
+  void final(u8 out[32]) {
+    u64 bits = len * 8;
+    u8 pad = 0x80;
+    update(&pad, 1);
+    u8 z = 0;
+    while ((len & 63) != 56) update(&z, 1);
+    u8 lb[8];
+    for (int i = 0; i < 8; i++) lb[i] = (u8)(bits >> (56 - 8 * i));
+    update(lb, 8);
+    for (int i = 0; i < 8; i++) {
+      out[4 * i] = (u8)(h[i] >> 24); out[4 * i + 1] = (u8)(h[i] >> 16);
+      out[4 * i + 2] = (u8)(h[i] >> 8); out[4 * i + 3] = (u8)h[i];
+    }
   }
 };
 
@@ -542,7 +635,52 @@ struct VoteEnv {
   u64 clock_slot, clock_epoch;
   bool sh_present;
   const SlotHashes* sh;
+  // durable-nonce family (flamenco/nonce.py): the slot's blockhash view
+  bool have_rbh = false;
+  Key rbh = {};
+  // rent sysvar (nonce partial withdraw's rent floor): 2 = the sysvar
+  // blob was present but undecodable -> Punt at the point of use (the
+  // Python lane owns whatever that decode raises)
+  u8 rent_flag = 0;
+  u64 rent_lpby = 3480;
+  double rent_et = 2.0;
 };
+
+// next_nonce (flamenco/nonce.py): domain-separated over the blockhash
+// and the account key
+static void nonce_next(const Key& rbh, const Key& key, u8 out[32]) {
+  static const char dom[] = "fdtpu:durable-nonce";
+  Sha256 s;
+  s.update((const u8*)dom, sizeof(dom) - 1);
+  s.update(rbh.data(), 32);
+  s.update(key.data(), 32);
+  s.final(out);
+}
+
+constexpr u64 NONCE_DATA_LEN = 4 + 32 + 32;
+constexpr u32 NONCE_UNINIT = 0;
+constexpr u32 NONCE_INIT = 1;
+
+// decode_state: short data reads as uninitialized (zeros)
+static void nonce_decode(const std::vector<u8>& data, u32& state, Key& auth,
+                         Key& nonce) {
+  if (data.size() < NONCE_DATA_LEN) {
+    state = NONCE_UNINIT;
+    auth.fill(0);
+    nonce.fill(0);
+    return;
+  }
+  state = rd32(data.data());
+  std::memcpy(auth.data(), data.data() + 4, 32);
+  std::memcpy(nonce.data(), data.data() + 36, 32);
+}
+
+static void nonce_store(std::vector<u8>& data, u32 state, const Key& auth,
+                        const Key& nonce) {
+  wr32(data.data(), state);
+  std::memcpy(data.data() + 4, auth.data(), 32);
+  std::memcpy(data.data() + 36, nonce.data(), 32);
+}
 
 // -- system program (flamenco/programs.py system_program) --------------------
 
@@ -559,8 +697,95 @@ static void sys_need_signer(const std::vector<IA>& ia, u32 i) {
   if (!ia[i].signer) throw Err{ST_ACCT};  // top level: no pda signers
 }
 
+// signed_by (nonce.py/stake.py): any instruction account that is this
+// key and a txn-level signer (no pda signers at top level)
+static bool instr_signed_by(const TxnX& T, const std::vector<IA>& ia,
+                            const Key& key) {
+  for (auto& a : ia)
+    if (a.signer && T.accts[a.idx].key == key) return true;
+  return false;
+}
+
+// -- durable-nonce family (flamenco/nonce.py handle, tags 4..7) --------------
+
+static void nonce_instr(TxnX& T, const std::vector<IA>& ia, const u8* data,
+                        u32 dlen, u32 tag, const VoteEnv& env) {
+  // _recent_blockhash: fail CLOSED when the sysvar is absent
+  auto rbh = [&]() -> const Key& {
+    if (!env.have_rbh) throw Err{ST_ACCT};
+    return env.rbh;
+  };
+  Acct& a = sys_acct(T, ia, 0);
+  sys_need_writable(ia, 0);
+  if (a.owner != SYS_KEY) throw Err{ST_ACCT};  // not system-owned
+  u32 state;
+  Key authority, nonce;
+  nonce_decode(a.data, state, authority, nonce);
+
+  if (tag == 6) {  // InitializeNonceAccount { authority 32 }
+    if (dlen < 4 + 32) throw Err{ST_ACCT};
+    if (state != NONCE_UNINIT) throw Err{ST_ACCT};
+    if (a.data.size() < NONCE_DATA_LEN) throw Err{ST_ACCT};
+    Key auth_new, nn;
+    std::memcpy(auth_new.data(), data + 4, 32);
+    nonce_next(rbh(), a.key, nn.data());
+    nonce_store(a.data, NONCE_INIT, auth_new, nn);
+  } else if (tag == 4) {  // AdvanceNonceAccount
+    if (state != NONCE_INIT) throw Err{ST_ACCT};
+    if (!instr_signed_by(T, ia, authority)) throw Err{ST_ACCT};
+    Key nn;
+    nonce_next(rbh(), a.key, nn.data());
+    if (nn == nonce) throw Err{ST_ACCT};  // same-slot double advance
+    nonce_store(a.data, NONCE_INIT, authority, nn);
+  } else if (tag == 5) {  // WithdrawNonceAccount { lamports u64 }
+    if (dlen < 12) throw Err{ST_ACCT};
+    u64 lamports = rd64(data + 4);
+    Acct& dest = sys_acct(T, ia, 1);
+    sys_need_writable(ia, 1);
+    const Key& who = state == NONCE_INIT ? authority : a.key;
+    if (!instr_signed_by(T, ia, who)) throw Err{ST_ACCT};
+    if (a.lamports < lamports) throw Err{ST_FUNDS};
+    if (state == NONCE_INIT) {
+      if (lamports == a.lamports) {
+        // full drain: refuse while the stored nonce is still current,
+        // and clear the state so the drained account stops satisfying
+        // durable_nonce_ok
+        Key nn;
+        nonce_next(rbh(), a.key, nn.data());
+        if (nn == nonce) throw Err{ST_ACCT};  // blockhash not expired
+        Key z = {};
+        nonce_store(a.data, NONCE_UNINIT, z, z);
+      } else {
+        // partial: the remainder must stay rent-exempt
+        if (env.rent_flag == 2) throw Punt{};  // undecodable rent sysvar
+        // int((data_len + 128) * lamports_per_byte_year
+        //     * exemption_threshold), python float semantics
+        u64 dl = (u64)a.data.size() + 128;
+        if (env.rent_lpby != 0 && dl > U64_MAX / env.rent_lpby)
+          throw Punt{};  // python bigint territory
+        double f = (double)(dl * env.rent_lpby) * env.rent_et;
+        if (!(f >= 0.0) || f >= 18446744073709551616.0)
+          throw Punt{};  // NaN / negative / > u64: python lane decides
+        u64 floor_ = (u64)f;
+        if (a.lamports - lamports < floor_) throw Err{ST_FUNDS};
+      }
+    }
+    if (a.key == dest.key) return;
+    if (dest.lamports > U64_MAX - lamports) throw Punt{};  // py bigint
+    a.lamports -= lamports;
+    dest.lamports += lamports;
+  } else if (tag == 7) {  // AuthorizeNonceAccount { authority 32 }
+    if (dlen < 4 + 32) throw Err{ST_ACCT};
+    if (state != NONCE_INIT) throw Err{ST_ACCT};
+    if (!instr_signed_by(T, ia, authority)) throw Err{ST_ACCT};
+    Key auth_new;
+    std::memcpy(auth_new.data(), data + 4, 32);
+    nonce_store(a.data, NONCE_INIT, auth_new, nonce);
+  }
+}
+
 static void system_instr(TxnX& T, const std::vector<IA>& ia, const u8* data,
-                         u32 dlen) {
+                         u32 dlen, const VoteEnv& env) {
   if (dlen < 4) return;  // garbage instruction: no-op (legacy parity)
   u32 tag = rd32(data);
   if (tag == 2) {  // Transfer { lamports }
@@ -607,7 +832,7 @@ static void system_instr(TxnX& T, const std::vector<IA>& ia, const u8* data,
     if (a.owner != SYS_KEY) throw Err{ST_ACCT};
     std::memcpy(a.owner.data(), data + 4, 32);
   } else if (tag >= 4 && tag <= 7) {
-    throw Punt{};  // durable-nonce family: Python lane (flamenco/nonce.py)
+    nonce_instr(T, ia, data, dlen, tag, env);  // durable-nonce family
   } else if (tag == 8) {  // Allocate { space }
     if (dlen < 12 || ia.empty()) throw Err{ST_ACCT};
     u64 space = rd64(data + 4);
@@ -619,6 +844,169 @@ static void system_instr(TxnX& T, const std::vector<IA>& ia, const u8* data,
     a.data.assign(space, 0);
   }
   // other tags: no-op (unimplemented surface is inert, never fatal)
+}
+
+// -- stake program (flamenco/stake.py stake_program, tags 0..4) --------------
+
+constexpr u64 STAKE_DATA_LEN = 4 + 32 * 3 + 8 * 3;  // 124
+constexpr u32 STAKE_UNINIT = 0;
+constexpr u32 STAKE_INIT = 1;
+constexpr u32 STAKE_DELEGATED = 2;
+constexpr u64 STAKE_WARMUP_DIV = 4;
+
+struct StakeSt {
+  u32 state = STAKE_UNINIT;
+  Key staker = {}, withdrawer = {}, voter = {};
+  u64 stake = 0;
+  u64 activation_epoch = U64_MAX;
+  u64 deactivation_epoch = U64_MAX;
+};
+
+// StakeState.decode: short data reads as the uninitialized default
+static void stake_decode(const std::vector<u8>& data, StakeSt& st) {
+  if (data.size() < STAKE_DATA_LEN) { st = StakeSt(); return; }
+  const u8* p = data.data();
+  st.state = rd32(p);
+  std::memcpy(st.staker.data(), p + 4, 32);
+  std::memcpy(st.withdrawer.data(), p + 36, 32);
+  std::memcpy(st.voter.data(), p + 68, 32);
+  st.stake = rd64(p + 100);
+  st.activation_epoch = rd64(p + 108);
+  st.deactivation_epoch = rd64(p + 116);
+}
+
+static void stake_store(std::vector<u8>& data, const StakeSt& st) {
+  u8* p = data.data();
+  wr32(p, st.state);
+  std::memcpy(p + 4, st.staker.data(), 32);
+  std::memcpy(p + 36, st.withdrawer.data(), 32);
+  std::memcpy(p + 68, st.voter.data(), 32);
+  wr64(p + 100, st.stake);
+  wr64(p + 108, st.activation_epoch);
+  wr64(p + 116, st.deactivation_epoch);
+}
+
+// locked_stake: the whole delegation while active/warming, ramping to
+// zero through cooldown (a quarter releases per epoch boundary)
+static u64 stake_locked(const StakeSt& st, u64 epoch) {
+  if (st.state != STAKE_DELEGATED) return 0;
+  if (st.deactivation_epoch == U64_MAX || epoch < st.deactivation_epoch)
+    return st.stake;
+  u64 d = epoch - st.deactivation_epoch;
+  if (d >= STAKE_WARMUP_DIV) return 0;  // released >= stake
+  u64 released = (u64)(((u128)st.stake * d) / STAKE_WARMUP_DIV);
+  return st.stake - released;
+}
+
+static void stake_instr(TxnX& T, const std::vector<IA>& ia, const u8* data,
+                        u32 dlen, const VoteEnv& env) {
+  if (dlen < 4) return;  // garbage instruction: no-op
+  u32 tag = rd32(data);
+  // acct(i, owned=...): the owner-may-modify/debit rule
+  auto acct = [&](u32 i, bool owned) -> Acct& {
+    if (i >= ia.size()) throw Err{ST_ACCT};
+    Acct& a = T.accts[ia[i].idx];
+    if (owned && a.owner != STAKE_KEY) throw Err{ST_ACCT};
+    return a;
+  };
+  // _clock_epoch fails CLOSED in python (AcctError when the sysvar is
+  // missing); env.have_clock false also covers a MALFORMED clock blob
+  // (the caller could not decode it) whose python-lane outcome differs,
+  // so the safe translation is a punt, not a typed failure
+  auto clock_epoch = [&]() -> u64 {
+    if (!env.have_clock) throw Punt{};
+    return env.clock_epoch;
+  };
+
+  if (tag == 0) {  // Initialize { staker 32 | withdrawer 32 }
+    if (dlen < 4 + 64) throw Err{ST_ACCT};
+    Acct& a = acct(0, true);
+    sys_need_writable(ia, 0);
+    StakeSt st;
+    stake_decode(a.data, st);
+    if (st.state != STAKE_UNINIT) throw Err{ST_ACCT};
+    if (a.data.size() < STAKE_DATA_LEN) throw Err{ST_ACCT};
+    st = StakeSt();
+    st.state = STAKE_INIT;
+    std::memcpy(st.staker.data(), data + 4, 32);
+    std::memcpy(st.withdrawer.data(), data + 36, 32);
+    stake_store(a.data, st);
+  } else if (tag == 1) {  // Delegate; accounts [stake, vote]
+    Acct& a = acct(0, true);
+    Acct& vote = acct(1, false);
+    sys_need_writable(ia, 0);
+    StakeSt st;
+    stake_decode(a.data, st);
+    if (st.state == STAKE_UNINIT) throw Err{ST_ACCT};
+    if (!instr_signed_by(T, ia, st.staker)) throw Err{ST_ACCT};
+    u64 epoch = clock_epoch();
+    st.state = STAKE_DELEGATED;
+    st.voter = vote.key;
+    st.stake = a.lamports;  // whole balance delegates
+    st.activation_epoch = epoch;
+    st.deactivation_epoch = U64_MAX;
+    stake_store(a.data, st);
+  } else if (tag == 2) {  // Deactivate
+    Acct& a = acct(0, true);
+    sys_need_writable(ia, 0);
+    StakeSt st;
+    stake_decode(a.data, st);
+    if (st.state != STAKE_DELEGATED) throw Err{ST_ACCT};
+    if (!instr_signed_by(T, ia, st.staker)) throw Err{ST_ACCT};
+    st.deactivation_epoch = clock_epoch();
+    stake_store(a.data, st);
+  } else if (tag == 3) {  // Withdraw { lamports u64 }; [stake, dest]
+    if (dlen < 12) throw Err{ST_ACCT};
+    u64 lamports = rd64(data + 4);
+    Acct& a = acct(0, true);
+    Acct& dest = acct(1, false);
+    sys_need_writable(ia, 0);
+    sys_need_writable(ia, 1);
+    StakeSt st;
+    stake_decode(a.data, st);
+    if (st.state == STAKE_UNINIT) {
+      // an uninitialized stake account withdraws under its OWN key
+      if (!instr_signed_by(T, ia, a.key)) throw Err{ST_ACCT};
+    } else if (!instr_signed_by(T, ia, st.withdrawer)) {
+      throw Err{ST_ACCT};
+    }
+    u64 locked =
+        st.state == STAKE_DELEGATED ? stake_locked(st, clock_epoch()) : 0;
+    // python signed arithmetic: lamports > balance - locked fails even
+    // when locked exceeds the balance
+    if ((__int128)a.lamports - (__int128)locked < (__int128)lamports)
+      throw Err{ST_FUNDS};
+    if (a.key == dest.key) return;
+    if (dest.lamports > U64_MAX - lamports) throw Punt{};  // py bigint
+    a.lamports -= lamports;
+    dest.lamports += lamports;
+  } else if (tag == 4) {  // Split { lamports u64 }; [stake, new_stake]
+    if (dlen < 12) throw Err{ST_ACCT};
+    u64 lamports = rd64(data + 4);
+    Acct& a = acct(0, true);
+    Acct& nw = acct(1, true);
+    sys_need_writable(ia, 0);
+    sys_need_writable(ia, 1);
+    StakeSt st;
+    stake_decode(a.data, st);
+    if (st.state != STAKE_DELEGATED) throw Err{ST_ACCT};
+    if (!instr_signed_by(T, ia, st.staker)) throw Err{ST_ACCT};
+    if (lamports > st.stake || lamports > a.lamports) throw Err{ST_FUNDS};
+    if (nw.data.size() < STAKE_DATA_LEN) throw Err{ST_ACCT};
+    StakeSt nst;
+    stake_decode(nw.data, nst);
+    if (nst.state != STAKE_UNINIT) throw Err{ST_ACCT};
+    if (nw.lamports > U64_MAX - lamports) throw Punt{};  // py bigint
+    st.stake -= lamports;
+    a.lamports -= lamports;
+    stake_store(a.data, st);
+    nw.lamports += lamports;
+    nst = st;
+    nst.state = STAKE_DELEGATED;
+    nst.stake = lamports;
+    stake_store(nw.data, nst);
+  }
+  // other tags: no-op
 }
 
 // -- vote program (flamenco/vote_program.py vote_program) --------------------
@@ -775,7 +1163,7 @@ static void load_acct(const Overlay& ov, const TxnIn& in, u32 i,
 }
 
 static TxnResult execute_txn(const TxnIn& in, Overlay& ov, u64 lps,
-                             const VoteEnv& env) {
+                             const VoteEnv& env, bool durable = false) {
   TxnX T;
   T.payload = in.payload;
   T.payload_sz = in.payload_sz;
@@ -822,6 +1210,39 @@ static TxnResult execute_txn(const TxnIn& in, Overlay& ov, u64 lps,
     w.idx = 0;
     acct_encode(baseline[0], w.val);  // fee-debited payer, no effects
     r.writes.push_back(std::move(w));
+    // a FAILED durable-nonce txn still advances its nonce account
+    // (runtime.py _advance_nonce_account): the rotated hash is part of
+    // the txn's on-chain footprint, else the signed txn re-lands after
+    // the status cache prunes its signature
+    if (durable && d.instr_cnt > 0) {
+      const Instr& ins0 = d.instrs[0];
+      if ((u64)ins0.acct_off + ins0.acct_cnt <= in.payload_sz &&
+          ins0.acct_cnt >= 1) {
+        u8 nidx = in.payload[ins0.acct_off];
+        if (nidx < d.acct_cnt && env.have_rbh) {
+          // funk's post-fee-debit view IS the baseline (instruction
+          // effects never landed); baseline[0] carries the debit, so a
+          // payer-is-nonce txn rotates the already-debited account
+          Acct na = baseline[nidx];
+          u32 nstate;
+          Key nauth, ncur;
+          nonce_decode(na.data, nstate, nauth, ncur);
+          if (nstate == NONCE_INIT) {
+            Key nn;
+            nonce_next(env.rbh, na.key, nn.data());
+            nonce_store(na.data, NONCE_INIT, nauth, nn);
+            Write nw;
+            nw.idx = nidx;
+            acct_encode(na, nw.val);
+            if (nidx == 0) {
+              r.writes[0] = std::move(nw);  // payer IS the nonce account
+            } else {
+              r.writes.push_back(std::move(nw));
+            }
+          }
+        }
+      }
+    }
     return r;
   };
 
@@ -843,9 +1264,11 @@ static TxnResult execute_txn(const TxnIn& in, Overlay& ov, u64 lps,
     const u8* progkey = T.addr(ins.prog);
     try {
       if (std::memcmp(progkey, SYS_KEY.data(), 32) == 0) {
-        system_instr(T, ia, data, ins.data_sz);
+        system_instr(T, ia, data, ins.data_sz, env);
       } else if (std::memcmp(progkey, VOTE_KEY.data(), 32) == 0) {
         vote_instr(T, ia, data, ins.data_sz, env);
+      } else if (std::memcmp(progkey, STAKE_KEY.data(), 32) == 0) {
+        stake_instr(T, ia, data, ins.data_sz, env);
       } else {
         throw Punt{};  // BPF / other builtins: Python lane
       }
@@ -904,6 +1327,18 @@ int64_t fd_exec_batch(const uint8_t* req, uint64_t req_sz, uint8_t* resp,
   }
   p += sh_sz;
   env.sh = &sh;
+  // u8 rbh_flag | 32B rbh | u8 rent_flag | u64 lamports_per_byte_year
+  // | f64 exemption_threshold  (durable-nonce + rent-floor env)
+  if (!have(1 + 32 + 1 + 8 + 8)) return -1;
+  env.have_rbh = *p++ != 0;
+  std::memcpy(env.rbh.data(), p, 32);
+  p += 32;
+  env.rent_flag = *p++;
+  env.rent_lpby = rd64(p);
+  p += 8;
+  u64 et_bits = rd64(p);
+  p += 8;
+  std::memcpy(&env.rent_et, &et_bits, 8);
 
   std::vector<TxnIn> txns;
   txns.reserve(n_txn);
@@ -992,6 +1427,55 @@ struct Session {
   std::set<Key> valid_bh;
 };
 
+// durable_nonce_ok (flamenco/nonce.py): may this stale-blockhash txn run
+// as a durable-nonce txn?  First instruction system AdvanceNonceAccount,
+// nonce account writable + initialized + stored hash == the txn's
+// blockhash, authority among the signers.  Evaluated against the batch's
+// working overlay first (earlier txns' writes), then the session's.
+// Throws Punt when it cannot decide: malformed descriptor/offsets, or an
+// account value that never reached the session (only funk can answer).
+static bool durable_ok(const Session* S, const Overlay& work,
+                       const TxnIn& in, const Key& bh) {
+  Desc d;
+  parse_desc(in.desc_bytes, in.desc_sz, d);  // malformed -> Punt
+  if (d.instr_cnt == 0) return false;
+  const Instr& ins = d.instrs[0];
+  if (ins.prog >= d.acct_cnt) return false;
+  if ((u64)d.acct_off + 32ull * d.acct_cnt > in.payload_sz) throw Punt{};
+  const u8* addrs = in.payload + d.acct_off;
+  if (std::memcmp(addrs + 32ull * ins.prog, SYS_KEY.data(), 32) != 0)
+    return false;
+  if ((u64)ins.data_off + ins.data_sz > in.payload_sz) throw Punt{};
+  if (ins.data_sz < 4 || rd32(in.payload + ins.data_off) != 4 ||
+      ins.acct_cnt < 1)
+    return false;
+  if ((u64)ins.acct_off + ins.acct_cnt > in.payload_sz) throw Punt{};
+  u8 idx = in.payload[ins.acct_off];
+  if (idx >= d.acct_cnt || !is_writable(d, idx)) return false;
+  Key nkey;
+  std::memcpy(nkey.data(), addrs + 32ull * idx, 32);
+  const std::vector<u8>* val;
+  auto itw = work.find(nkey);
+  if (itw != work.end()) {
+    val = &itw->second;
+  } else {
+    auto its = S->ov.find(nkey);
+    if (its == S->ov.end()) throw Punt{};  // value never shipped
+    val = &its->second;
+  }
+  Acct na;
+  acct_decode(val->data(), val->size(), na);
+  if (na.owner != SYS_KEY) return false;
+  u32 state;
+  Key auth, nonce;
+  nonce_decode(na.data, state, auth, nonce);
+  if (state != NONCE_INIT || nonce != bh) return false;
+  u32 ns = d.sig_cnt < d.acct_cnt ? d.sig_cnt : d.acct_cnt;
+  for (u32 i = 0; i < ns; i++)
+    if (std::memcmp(addrs + 32ull * i, auth.data(), 32) == 0) return true;
+  return false;
+}
+
 void* fd_exec_session_new() { return new (std::nothrow) Session(); }
 
 void fd_exec_session_delete(void* h) { delete static_cast<Session*>(h); }
@@ -1028,6 +1512,16 @@ int64_t fd_exec_batch2(void* sh, const uint8_t* req, uint64_t req_sz,
   else slh.ok = true;
   p += sh_sz;
   env.sh = &slh;
+  if (!have_b(1 + 32 + 1 + 8 + 8)) return -1;
+  env.have_rbh = *p++ != 0;
+  std::memcpy(env.rbh.data(), p, 32);
+  p += 32;
+  env.rent_flag = *p++;
+  env.rent_lpby = rd64(p);
+  p += 8;
+  u64 et_bits = rd64(p);
+  p += 8;
+  std::memcpy(&env.rent_et, &et_bits, 8);
 
   if (!have_b(1 + 4)) return -1;
   // gate flag: 0 = off, 1 = on + REPLACE the valid-blockhash set from
@@ -1113,6 +1607,7 @@ int64_t fd_exec_batch2(void* sh, const uint8_t* req, uint64_t req_sz,
     const TxnIn& in = txns[t];
     std::array<u8, 96> bhsig;
     bool have_key = false;
+    bool durable = false;
     if (gate_on) {
       // slice blockhash + first signature straight from the payload
       // via the descriptor offsets; anything out of range punts to
@@ -1131,10 +1626,23 @@ int64_t fd_exec_batch2(void* sh, const uint8_t* req, uint64_t req_sz,
       Key bh;
       std::memcpy(bh.data(), bhsig.data(), 32);
       if (!S->valid_bh.count(bh)) {
-        // stale/unknown blockhash: durable-nonce candidate — only the
-        // Python gate can decide, so the batch stops BEFORE this txn
-        punted = true;
-        break;
+        // stale/unknown blockhash: run the durable-nonce gate in-line
+        // (the check the Python gate used to own).  Not durable ->
+        // TXN_ERR_BLOCKHASH, no fee, no footprint, batch continues;
+        // undecidable here -> punt, the Python lane resolves it
+        bool ok;
+        try {
+          ok = durable_ok(S, work, in, bh);
+        } catch (const Punt&) {
+          punted = true;
+          break;
+        }
+        if (!ok) {
+          recs.push_back(TxnResult{ST_BLOCKHASH, 0, {}});
+          rec_in.push_back(&in);
+          continue;
+        }
+        durable = true;
       }
       if (S->seen.count(bhsig) || landed.count(bhsig)) {
         recs.push_back(TxnResult{ST_ALREADY, 0, {}});
@@ -1159,7 +1667,7 @@ int64_t fd_exec_batch2(void* sh, const uint8_t* req, uint64_t req_sz,
     }
     TxnResult r;
     try {
-      r = execute_txn(in, work, lps, env);
+      r = execute_txn(in, work, lps, env, durable);
     } catch (const Punt&) {
       punted = true;
       break;

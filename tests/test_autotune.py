@@ -1,0 +1,80 @@
+"""Per-link credit/depth autotuner (ISSUE 16, runtime/autotune)."""
+
+from __future__ import annotations
+
+from firedancer_tpu.runtime import autotune as at
+from firedancer_tpu.runtime.stage import Stage
+from firedancer_tpu.tango import shm
+
+
+def _counts(**at_edges) -> list[int]:
+    """Bucket counts keyed by edge index: _counts(i7=40) puts 40
+    samples in the bucket at OCC_EDGES[7]."""
+    c = [0] * (len(at.OCC_EDGES) + 1)
+    for k, v in at_edges.items():
+        c[int(k[1:])] = v
+    return c
+
+
+def test_high_occupancy_grows_depth_and_tightens_lazy():
+    rec = at.recommend_link(_counts(i7=64), depth=256, lazy=128)
+    assert rec.depth == 512
+    assert rec.lazy == 64
+
+
+def test_low_occupancy_shrinks_depth_and_relaxes_lazy():
+    rec = at.recommend_link(_counts(i0=64), depth=1024, lazy=64)
+    assert rec.depth == 512
+    assert rec.lazy == 128
+
+
+def test_mid_occupancy_and_thin_evidence_keep_geometry():
+    # p99 in the comfortable middle: no move
+    rec = at.recommend_link(_counts(i3=64), depth=256, lazy=128)
+    assert rec == at.LinkTuning(256, 128)
+    # a clear signal but too few samples: no move
+    rec = at.recommend_link(_counts(i7=8), depth=256, lazy=128)
+    assert rec == at.LinkTuning(256, 128)
+    # no evidence at all: no move
+    rec = at.recommend_link(_counts(), depth=256, lazy=128)
+    assert rec == at.LinkTuning(256, 128)
+
+
+def test_ladder_clamps_at_ends():
+    assert at.recommend_link(_counts(i7=64), depth=8192, lazy=8).depth == 8192
+    assert at.recommend_link(_counts(i7=64), depth=8192, lazy=8).lazy == 8
+    assert at.recommend_link(_counts(i0=64), depth=64, lazy=256).depth == 64
+    assert at.recommend_link(_counts(i0=64), depth=64, lazy=256).lazy == 256
+
+
+def test_deterministic():
+    c = _counts(i2=10, i5=30, i7=24)
+    assert at.recommend_link(c, depth=512) == at.recommend_link(c, depth=512)
+
+
+def test_live_stage_samples_and_recommends():
+    """A producing stage with a stalled consumer fills its ring; the
+    housekeeping sampler sees the pressure and the tuner says grow."""
+    uid = shm.fresh_uid()
+    link = shm.ShmLink.create(f"tat_{uid}", depth=64, mtu=64, n_fseq=1)
+    try:
+
+        class Pub(Stage):
+            def after_credit(self):
+                self.publish(0, b"x" * 8, sig=self._iter)
+
+        st = Pub("pub", outs=[shm.make_producer(link)], lazy=8)
+        _sink = shm.make_consumer(link)  # registered, never drains
+        for _ in range(2000):
+            st.run_once()
+        assert st.out_occupancy and sum(st.out_occupancy[0]) >= at.MIN_EVIDENCE
+        rec = at.recommend_for_stage(st)
+        assert rec[0].depth == 128        # 64 -> one rung up
+        assert rec[0].lazy < st.lazy + 1  # never relaxed under pressure
+        topo = at.recommend_topology([st])
+        assert topo["pub"][0]["depth"] == 128
+        # the aggregate schema histogram carries the same evidence
+        h = st.metrics.hist("out_occupancy")
+        assert h["count"] >= at.MIN_EVIDENCE
+    finally:
+        link.close()

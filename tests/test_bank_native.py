@@ -1,0 +1,235 @@
+"""Differential suite for the native bank sweep client (ISSUE 16,
+native/fd_bank.cpp + runtime/bank_native.py).
+
+Lane parity is the contract: the same microblock stream through the
+native sweep lane (fdr_sweep: C-side frame parse, fd_exec_batch2
+session exec, PoH-mixin entry build, credit-gated entry/done publish in
+ONE crossing) and through the Python after_frag path must publish
+byte-identical entry frames in the same order, commit the same funk
+state (identical sealed bank hash), and count the same landings.
+
+The cold-account protocol is exercised implicitly: C-built requests
+ship all accounts have=0, so the first touch of every payer/dest punts
+to the Python resume lane, which ships the values into the session —
+steady state is all-native (asserted via the bank_mb_native counter).
+
+The module SKIPS (never fails) without the toolchain or with
+FDTPU_NATIVE_BANK=0.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from firedancer_tpu.protocol import txn as ft
+from firedancer_tpu.runtime import bank_native as bn
+from firedancer_tpu.runtime.bank import BankStage, default_bank_ctx
+from firedancer_tpu.runtime.benchg import gen_transfer_pool
+from firedancer_tpu.runtime.verify import encode_verified
+from firedancer_tpu.tango import shm
+
+if not bn.available():
+    pytest.skip(
+        "native bank client unavailable (no toolchain or"
+        " FDTPU_NATIVE_BANK=0)",
+        allow_module_level=True,
+    )
+
+
+def _frag(payload: bytes) -> bytes:
+    desc = ft.txn_parse(payload)
+    assert desc is not None
+    return encode_verified(payload, desc)
+
+
+def _mb_frame(mb_seq: int, payloads: list[bytes]) -> bytes:
+    out = bytearray()
+    out += mb_seq.to_bytes(4, "little")
+    out += len(payloads).to_bytes(2, "little")
+    for p in payloads:
+        f = _frag(p)
+        out += len(f).to_bytes(2, "little")
+        out += f
+    return bytes(out)
+
+
+@pytest.fixture(scope="module")
+def frames():
+    # dests rotate over 8 keys so the session warms quickly: the first
+    # microblocks punt on cold accounts, the tail goes fully native
+    pool = gen_transfer_pool(96, n_dests=8)
+    return [_mb_frame(i, pool[i * 8 : (i + 1) * 8]) for i in range(12)]
+
+
+def _drive(frames, *, native: bool, out_depth=256, done_depth=256,
+           in_depth=64, lossy=False, iters=20000, bank_idx=3):
+    """One BankStage over real rings; returns (armed?, entry frames
+    [(payload, sig, tsorig)...], done frames, metrics, bank hash)."""
+    prev = os.environ.get(bn.ENV_SWITCH)
+    os.environ[bn.ENV_SWITCH] = "1" if native else "0"
+    uid = shm.fresh_uid()
+    lin = shm.ShmLink.create(f"tbn_i_{uid}", depth=in_depth, mtu=65536,
+                             n_fseq=1)
+    lpoh = shm.ShmLink.create(f"tbn_p_{uid}", depth=out_depth, mtu=65536,
+                              n_fseq=1)
+    ldone = shm.ShmLink.create(f"tbn_d_{uid}", depth=done_depth, mtu=64,
+                               n_fseq=1)
+    try:
+        prod = shm.make_producer(lin)
+        ctx = default_bank_ctx()
+        st = BankStage(
+            "b0", ins=[shm.make_consumer(lin, lazy=8)],
+            outs=[shm.make_producer(lpoh), shm.make_producer(ldone)],
+            bank_idx=bank_idx, ctx=ctx,
+        )
+        st.require_credit = True
+        if lossy:
+            from firedancer_tpu.tango.lossy import LossyConsumer
+            from firedancer_tpu.utils.rng import Rng
+
+            # a fault-free splice: forces the per-frag fallback path
+            st.ins[0] = LossyConsumer(st.ins[0], Rng(7))
+        armed = st._sweep_client is not None
+        cpoh = shm.make_consumer(lpoh, lazy=4)
+        cdone = shm.make_consumer(ldone, lazy=4)
+        ents, dones, fed = [], [], 0
+        for _ in range(iters):
+            while fed < len(frames) and prod.try_publish(
+                    frames[fed], sig=fed, tsorig=1000 + fed):
+                fed += 1
+            st.run_once()
+            for cons, acc in ((cpoh, ents), (cdone, dones)):
+                while True:
+                    r = cons.poll()
+                    if r in (shm.POLL_EMPTY, shm.POLL_OVERRUN):
+                        break
+                    meta, payload = r
+                    acc.append((bytes(payload), int(meta[1]),
+                                int(meta[5])))
+            if fed == len(frames) and len(dones) == len(frames):
+                break
+        st.flush()
+        for cons, acc in ((cpoh, ents), (cdone, dones)):
+            while True:
+                r = cons.poll()
+                if r in (shm.POLL_EMPTY, shm.POLL_OVERRUN):
+                    break
+                meta, payload = r
+                acc.append((bytes(payload), int(meta[1]), int(meta[5])))
+        st.during_housekeeping()  # copy the C counters
+        rep = {k: st.metrics.get(k) for k in (
+            "txn_exec", "txn_exec_failed", "txn_rejected", "microblocks",
+            "bank_mb_seen", "bank_mb_native", "bank_mb_stashed",
+            "bank_txn_native", "bank_credit_waits", "bank_mb_dropped")}
+        bank_hash = ctx.seal(b"\x11" * 32).bank_hash
+        return armed, ents, dones, rep, bank_hash
+    finally:
+        if prev is None:
+            os.environ.pop(bn.ENV_SWITCH, None)
+        else:
+            os.environ[bn.ENV_SWITCH] = prev
+        lin.close()
+        lpoh.close()
+        ldone.close()
+
+
+def test_stream_diff_native_vs_python(frames):
+    a_n, ent_n, done_n, rep_n, h_n = _drive(frames, native=True)
+    a_p, ent_p, done_p, rep_p, h_p = _drive(frames, native=False)
+    assert a_n and not a_p
+    # entry frames byte-identical: payloads (mixin + txns), sigs
+    # (mb_seq), tsorigs, order
+    assert [(e[0], e[1]) for e in ent_n] == [(e[0], e[1]) for e in ent_p]
+    assert len(done_n) == len(done_p) == len(frames)
+    assert all(d[0] == b"" and d[1] == 3 for d in done_n)
+    for k in ("txn_exec", "txn_exec_failed", "txn_rejected",
+              "microblocks"):
+        assert rep_n[k] == rep_p[k], k
+    assert rep_n["microblocks"] == len(frames)
+    assert h_n == h_p  # identical committed state
+
+
+def test_cold_punts_then_steady_state_native(frames):
+    """Cold accounts punt exactly once (all-have=0 requests), then the
+    session knows them: the stream's tail must run fully native."""
+    armed, _, _, rep, _ = _drive(frames, native=True)
+    assert armed
+    assert rep["bank_mb_seen"] == len(frames)
+    assert rep["bank_mb_stashed"] >= 1   # cold prefix punted
+    assert rep["bank_mb_native"] >= len(frames) // 2  # warm tail native
+    assert rep["bank_mb_native"] + rep["bank_mb_stashed"] == len(frames)
+    assert rep["bank_txn_native"] >= 1
+    assert rep["bank_mb_dropped"] == 0
+
+
+def test_mixed_lane_splice_matches_sweep(frames):
+    """A LossyConsumer splice (chaos shape) drops the stage to the
+    per-frag path; entries and state must still match the pure sweep."""
+    a_s, ent_s, done_s, rep_s, h_s = _drive(frames, native=True)
+    a_m, ent_m, done_m, rep_m, h_m = _drive(frames, native=True,
+                                            lossy=True)
+    assert a_s and a_m
+    assert [(e[0], e[1]) for e in ent_s] == [(e[0], e[1]) for e in ent_m]
+    assert len(done_s) == len(done_m)
+    assert rep_s["txn_exec"] == rep_m["txn_exec"]
+    assert h_s == h_m
+
+
+def test_credit_stall_no_loss_no_reorder(frames):
+    """Out rings far smaller than the stream: the C side stalls on
+    credits pre-exec (stash, not drop), the Python drain defers until
+    the consumers free credits, and every entry still lands in order."""
+    a_n, ent_n, done_n, rep_n, h_n = _drive(
+        frames, native=True, out_depth=4, done_depth=4, in_depth=16)
+    assert a_n
+    assert len(done_n) == len(frames)
+    assert rep_n["microblocks"] == len(frames)
+    assert rep_n["bank_mb_dropped"] == 0
+    # entry sigs are mb_seqs, strictly increasing (ring order held)
+    sigs = [e[1] for e in ent_n]
+    assert sigs == sorted(sigs)
+    # byte-identical to the python lane under the same pressure
+    _, ent_p, done_p, rep_p, h_p = _drive(
+        frames, native=False, out_depth=4, done_depth=4, in_depth=16)
+    assert [(e[0], e[1]) for e in ent_n] == [(e[0], e[1]) for e in ent_p]
+    assert h_n == h_p
+
+
+def test_ineligible_txn_splices_in_order(frames):
+    """A native-ineligible txn (unknown program) mid-stream punts its
+    microblock to the Python resume lane; order and state parity hold."""
+    from firedancer_tpu.ops.ref import ed25519_ref as ref
+    from firedancer_tpu.runtime.benchg import pool_blockhash, pool_payers
+
+    pool = gen_transfer_pool(96, n_dests=8)
+    sec, pub = pool_payers()[0]
+    msg = ft.message_build(
+        version=ft.VLEGACY, signature_cnt=1, readonly_signed_cnt=0,
+        readonly_unsigned_cnt=1, acct_addrs=[pub, b"\x07" * 32],
+        recent_blockhash=pool_blockhash(),
+        instrs=[ft.InstrSpec(program_id=1, accounts=bytes([0]),
+                             data=b"\x01")],
+    )
+    alien = ft.txn_assemble([ref.sign(sec, msg)], msg)
+    mbs = list(frames[:6])
+    mbs.append(_mb_frame(6, pool[48:52] + [alien]))
+    mbs.append(_mb_frame(7, pool[56:64]))
+    a_n, ent_n, done_n, rep_n, h_n = _drive(mbs, native=True)
+    a_p, ent_p, done_p, rep_p, h_p = _drive(mbs, native=False)
+    assert a_n and not a_p
+    assert [(e[0], e[1]) for e in ent_n] == [(e[0], e[1]) for e in ent_p]
+    assert len(done_n) == len(done_p) == len(mbs)
+    assert rep_n["txn_exec"] == rep_p["txn_exec"]
+    assert rep_n["txn_rejected"] == rep_p["txn_rejected"]
+    assert h_n == h_p
+
+
+def test_env_switch_disarms():
+    os.environ[bn.ENV_SWITCH] = "0"
+    try:
+        assert not bn.available()
+    finally:
+        os.environ[bn.ENV_SWITCH] = "1"
+    assert bn.available()

@@ -4,8 +4,11 @@ The contract (ISSUE 4): a randomized stream of system/vote txns — valid,
 malformed, boundary lamports, missing signers, duplicate accounts,
 duplicate signatures, stale blockhashes, punt-inducing shapes — executed
 through both lanes must produce identical per-txn status codes and fees,
-an identical bank hash, and byte-identical final account state.  CPI/BPF/
-nonce/lookup-table txns must route to the Python lane (classifier test).
+an identical bank hash, and byte-identical final account state.  Since
+ISSUE 16 the native surface also covers stake-program ops and the
+durable-nonce family (the session's in-line durable gate owns the
+stale-blockhash decision); CPI/BPF/compute-budget/lookup-table txns
+still route to the Python lane (classifier test).
 
 The whole module SKIPS (never fails) when the native lane is unavailable
 (no toolchain, .so deleted, or FDTPU_NATIVE_EXEC=0).
@@ -24,7 +27,9 @@ from firedancer_tpu.flamenco import exec_native
 if not exec_native.available():  # pragma: no cover - toolchain-less host
     pytest.skip("native executor lane unavailable", allow_module_level=True)
 
+from firedancer_tpu.flamenco import nonce as fnonce
 from firedancer_tpu.flamenco import vote_program as vp
+from firedancer_tpu.flamenco.stake import STAKE_PROGRAM
 from firedancer_tpu.flamenco.agave_state import (
     Lockout,
     PriorVoters,
@@ -41,6 +46,9 @@ from firedancer_tpu.protocol.txn import SYSTEM_PROGRAM, VOTE_PROGRAM
 SLOT = 41
 BH = hashlib.sha256(b"exec-native-bh").digest()
 STALE_BH = hashlib.sha256(b"stale").digest()
+# a durable-nonce era hash: unknown to the status cache, stored as the
+# nonce value of the pre-seeded "noncedur*" accounts in _world()
+NONCE_BH = hashlib.sha256(b"nonce-era").digest()
 SLOT_HASHES = [
     (s, hashlib.sha256(b"sh%d" % s).digest()) for s in range(1, 40)
 ]
@@ -139,6 +147,17 @@ def _world() -> tuple[Funk, StatusCache]:
                    data=bytes(vp.VOTE_STATE_SIZE)))
     funk.rec_insert(None, _pk("notvote"),
                     acct_build(10**9, data=bytes(vp.VOTE_STATE_SIZE)))
+    # durable-nonce era accounts: stored nonce == NONCE_BH (which the
+    # status cache does NOT know), authority payerB; "noncepay" is its
+    # own authority so it can serve as the fee payer of a durable txn
+    for name in ("noncedur0", "noncedur1", "noncedur2"):
+        funk.rec_insert(None, _pk(name),
+                        acct_build(10**8, data=fnonce.encode_state(
+                            fnonce.STATE_INIT, _pk("payerB"), NONCE_BH)))
+    funk.rec_insert(None, _pk("noncepay"),
+                    acct_build(10**8, data=fnonce.encode_state(
+                        fnonce.STATE_INIT, _pk("noncepay"), NONCE_BH)))
+    funk.rec_insert(None, _pk("nonceU"), acct_build(10**8, data=bytes(68)))
     return funk, sc
 
 
@@ -272,7 +291,7 @@ def _stream(rng: random.Random) -> list[bytes]:
             txns.append(_txn(rng, [_pk("voterA")], [va, VOTE_PROGRAM],
                              [sys_instr(2, bytes([1, 0]), data)],
                              ro_unsigned=1))
-        elif kind == 15:  # python-lane programs interleaved: BPF, nonce
+        elif kind == 15:  # BPF stays Python-lane; nonce init is native now
             if rng.randrange(2):
                 txns.append(_txn(rng, [p], [_pk("dst%d" % i), BPF_PROG],
                                  [sys_instr(2, bytes([0, 1]), b"\x01\x02")],
@@ -327,7 +346,8 @@ def _run(txns: list[bytes], *, native: bool, batch: int = 16):
             k: funk.rec_query(sx.xid, k) for k in funk.rec_keys(sx.xid)
         }
         return ([(r.status, r.fee) for r in results], sealed.bank_hash,
-                sealed.fees, sealed.signature_cnt, state)
+                sealed.fees, sealed.signature_cnt, state,
+                (sx.native_done_cnt, sx.native_punt_cnt))
     finally:
         os.environ.pop(exec_native.ENV_SWITCH, None)
 
@@ -378,8 +398,9 @@ def test_vote_state_bytes_identical():
 
 
 def test_fallback_routing_classifier():
-    """CPI/BPF, nonces, compute-budget and lookup-table txns never route
-    native; system transfers and votes do."""
+    """CPI/BPF, compute-budget and lookup-table txns never route native;
+    system transfers, votes, stake ops and the nonce family do
+    (ISSUE 16 widened the surface to stake + durable nonce)."""
     from firedancer_tpu.protocol.base58 import b58_decode32
 
     rng = random.Random(3)
@@ -404,7 +425,11 @@ def test_fallback_routing_classifier():
     nonce = _txn(rng, [p], [_pk("n"), SYSTEM_PROGRAM],
                  [ft.InstrSpec(2, bytes([1, 0]),
                                (4).to_bytes(4, "little"))], ro_unsigned=1)
-    assert not eligible(nonce)
+    assert eligible(nonce)  # durable-nonce family runs native now
+    stake = _txn(rng, [p], [_pk("stk"), STAKE_PROGRAM],
+                 [ft.InstrSpec(2, bytes([1, 0]),
+                               (2).to_bytes(4, "little"))], ro_unsigned=1)
+    assert eligible(stake)  # stake-program ops run native now
     cb = _txn(rng, [p], [_pk("d"), b58_decode32(CB_PROG_B58)],
               [ft.InstrSpec(2, bytes([0]), b"\x02\x40\x42\x0f\x00")],
               ro_unsigned=1)
@@ -474,10 +499,10 @@ def test_session_values_survive_python_lane_interleave():
                     [ft.InstrSpec(2, bytes([0, 1]), _transfer_data(lam))],
                     ro_unsigned=1)
 
-    # a nonce-family txn is Python-lane by classifier, touches the payer
-    py_lane = _txn(rng, [p], [_pk("svin"), SYSTEM_PROGRAM],
-                   [ft.InstrSpec(2, bytes([1, 0]),
-                                 (6).to_bytes(4, "little") + _pk("auth"))],
+    # a BPF txn is Python-lane by classifier and touches the payer (fee
+    # debit), so it dirties the session overlay between native crossings
+    py_lane = _txn(rng, [p], [_pk("svin"), BPF_PROG],
+                   [ft.InstrSpec(2, bytes([0, 1]), b"\x01\x02")],
                    ro_unsigned=1)
     txns = [t_native(0, 100), py_lane, t_native(1, 200), py_lane,
             t_native(2, 400)]
@@ -536,3 +561,243 @@ def test_punt_mid_batch_resumes_in_order():
     nat = _run(txns, native=True, batch=len(txns))
     assert py[0] == nat[0] == [(0, 5000)] * 5
     assert py[4] == nat[4]
+
+
+# -- ISSUE 16: widened eligibility (stake program + durable nonce) -------------
+
+
+def _stake_stream(rng: random.Random) -> list[bytes]:
+    """Randomized stake-program ops — create/init/delegate/deactivate/
+    withdraw/split plus malformed, wrong-signer and foreign-owner shapes.
+    All of it is native-eligible now, so the native lane must match the
+    Python lane tag for tag (incl. warmup-locked withdraw arithmetic)."""
+    payers = [_pk("payerA"), _pk("payerB")]
+    ii = ft.InstrSpec
+    txns: list[bytes] = []
+    n_stake = 5
+    for j in range(n_stake):
+        p = payers[j % 2]
+        sk = _pk("stk%d" % j)
+        txns.append(_txn(rng, [p, sk], [SYSTEM_PROGRAM],
+                         [ii(2, bytes([0, 1]),
+                             _create_data(10**7, 124, STAKE_PROGRAM))]))
+        txns.append(_txn(rng, [p], [sk, STAKE_PROGRAM],
+                         [ii(2, bytes([1]),
+                             (0).to_bytes(4, "little") + p + p)],
+                         ro_unsigned=1))
+    for i in range(90):
+        p = payers[rng.randrange(2)]
+        sk = _pk("stk%d" % rng.randrange(n_stake))
+        kind = rng.randrange(8)
+        if kind == 0:  # delegate to the live vote account
+            txns.append(_txn(rng, [p],
+                             [sk, _pk("voteacct"), STAKE_PROGRAM],
+                             [ii(3, bytes([1, 2, 0]),
+                                 (1).to_bytes(4, "little"))],
+                             ro_unsigned=2))
+        elif kind == 1:  # deactivate
+            txns.append(_txn(rng, [p], [sk, STAKE_PROGRAM],
+                             [ii(2, bytes([1, 0]),
+                                 (2).to_bytes(4, "little"))],
+                             ro_unsigned=1))
+        elif kind == 2:  # withdraw: in-range, overdrawn, or warmup-locked
+            lam = rng.choice([1, 5_000, 10**7, 10**12])
+            txns.append(_txn(rng, [p],
+                             [sk, _pk("sdst%d" % i), STAKE_PROGRAM],
+                             [ii(3, bytes([1, 2, 0]),
+                                 (3).to_bytes(4, "little")
+                                 + lam.to_bytes(8, "little"))],
+                             ro_unsigned=1))
+        elif kind == 3:  # split into a prepared (or missing) sibling
+            dst = _pk("stk%dsib" % rng.randrange(n_stake))
+            if rng.randrange(2):
+                txns.append(_txn(rng, [p, dst], [SYSTEM_PROGRAM],
+                                 [ii(2, bytes([0, 1]),
+                                     _create_data(10**6, 124,
+                                                  STAKE_PROGRAM))]))
+            txns.append(_txn(rng, [p],
+                             [sk, dst, STAKE_PROGRAM],
+                             [ii(3, bytes([1, 2, 0]),
+                                 (4).to_bytes(4, "little")
+                                 + rng.choice([1_000, 10**9])
+                                 .to_bytes(8, "little"))],
+                             ro_unsigned=1))
+        elif kind == 4:  # wrong signer for delegate (staker absent)
+            q = payers[1 - payers.index(p)]
+            txns.append(_txn(rng, [q],
+                             [sk, _pk("voteacct"), STAKE_PROGRAM],
+                             [ii(3, bytes([1, 2, 0]),
+                                 (1).to_bytes(4, "little"))],
+                             ro_unsigned=2))
+        elif kind == 5:  # malformed: short data / unknown tag / not owned
+            data = rng.choice([b"\x01", (9).to_bytes(4, "little"),
+                               (0).to_bytes(4, "little") + b"short"])
+            tgt = rng.choice([sk, _pk("datasrc")])
+            txns.append(_txn(rng, [p], [tgt, STAKE_PROGRAM],
+                             [ii(2, bytes([1, 0]), data)],
+                             ro_unsigned=1))
+        elif kind == 6:  # re-init / init of a foreign-owner account
+            tgt = rng.choice([sk, _pk("foreign")])
+            txns.append(_txn(rng, [p], [tgt, STAKE_PROGRAM],
+                             [ii(2, bytes([1]),
+                                 (0).to_bytes(4, "little") + p + p)],
+                             ro_unsigned=1))
+        else:  # plain transfers keep intra-batch payer conflicts hot
+            txns.append(_txn(rng, [p], [_pk("sd%d" % i), SYSTEM_PROGRAM],
+                             [ii(2, bytes([0, 1]),
+                                 _transfer_data(rng.randrange(1, 999)))],
+                             ro_unsigned=1))
+    return txns
+
+
+def _nonce_stream(rng: random.Random) -> list[bytes]:
+    """Randomized durable-nonce traffic: the full instruction family via
+    the normal (valid-blockhash) path, plus genuine durable txns whose
+    recent_blockhash is the STORED nonce — those must clear the
+    session's in-line durable gate, rotate the nonce on typed failure,
+    and handle the nonce-is-payer shape (writes[0] replacement)."""
+    pA, pB = _pk("payerA"), _pk("payerB")
+    ii = ft.InstrSpec
+    adv = (4).to_bytes(4, "little")
+    txns: list[bytes] = []
+    for j in range(3):  # fresh nonce accounts through the normal path
+        nk = _pk("nnk%d" % j)
+        txns.append(_txn(rng, [pA, nk], [SYSTEM_PROGRAM],
+                         [ii(2, bytes([0, 1]),
+                             _create_data(10**7, 68, SYSTEM_PROGRAM))]))
+        txns.append(_txn(rng, [pA], [nk, SYSTEM_PROGRAM],
+                         [ii(2, bytes([1]),
+                             (6).to_bytes(4, "little") + pB)],
+                         ro_unsigned=1))
+    for i in range(70):
+        kind = rng.randrange(10)
+        nk = _pk("nnk%d" % rng.randrange(3))
+        if kind == 0:
+            # durable advance on a pre-seeded era account: the first use
+            # lands (fee + rotation); any reuse of the SAME account then
+            # fails the gate (nonce moved) with TXN_ERR_BLOCKHASH
+            dk = _pk("noncedur%d" % rng.randrange(3))
+            txns.append(_txn(rng, [pB], [dk, SYSTEM_PROGRAM],
+                             [ii(2, bytes([1, 0]), adv)],
+                             ro_unsigned=1, blockhash=NONCE_BH))
+        elif kind == 1:
+            # durable txn whose SECOND instruction fails typed: the fee
+            # sticks and the nonce still rotates (failure-rotation path)
+            dk = _pk("noncedur%d" % rng.randrange(3))
+            txns.append(_txn(rng, [pB], [dk, SYSTEM_PROGRAM],
+                             [ii(2, bytes([1, 0]), adv),
+                              ii(2, bytes([0, 1]),
+                                 _transfer_data(10**13))],
+                             ro_unsigned=1, blockhash=NONCE_BH))
+        elif kind == 2:
+            # the nonce account IS the fee payer (writes[0] replacement)
+            txns.append(_txn(rng, [_pk("noncepay")], [SYSTEM_PROGRAM],
+                             [ii(1, bytes([0]), adv)],
+                             ro_unsigned=1, blockhash=NONCE_BH))
+        elif kind == 3:
+            # gate rejections: wrong authority / uninit / unknown hash
+            shape = rng.randrange(3)
+            if shape == 0:  # pA signs but the authority is pB
+                txns.append(_txn(rng, [pA],
+                                 [_pk("noncedur0"), SYSTEM_PROGRAM],
+                                 [ii(2, bytes([1, 0]), adv)],
+                                 ro_unsigned=1, blockhash=NONCE_BH))
+            elif shape == 1:
+                txns.append(_txn(rng, [pB],
+                                 [_pk("nonceU"), SYSTEM_PROGRAM],
+                                 [ii(2, bytes([1, 0]), adv)],
+                                 ro_unsigned=1, blockhash=STALE_BH))
+            else:
+                txns.append(_txn(rng, [pB],
+                                 [_pk("noncedur1"), SYSTEM_PROGRAM],
+                                 [ii(2, bytes([1, 0]), adv)],
+                                 ro_unsigned=1,
+                                 blockhash=_pk("junkbh%d" % i)))
+        elif kind == 4:  # same-slot advance via valid BH: hash unmoved
+            txns.append(_txn(rng, [pB], [nk, SYSTEM_PROGRAM],
+                             [ii(2, bytes([1, 0]), adv)],
+                             ro_unsigned=1))
+        elif kind == 5:  # withdraw: partial above/below the rent floor,
+            # exact-balance drain (blockhash-not-expired), overdrawn
+            lam = rng.choice([100, 10**7 - 100, 10**7, 10**12])
+            txns.append(_txn(rng, [pB],
+                             [nk, _pk("ndst%d" % i), SYSTEM_PROGRAM],
+                             [ii(3, bytes([1, 2, 0]),
+                                 (5).to_bytes(4, "little")
+                                 + lam.to_bytes(8, "little"))],
+                             ro_unsigned=1))
+        elif kind == 6:  # authorize: may flip authority away from pB
+            txns.append(_txn(rng, [pB], [nk, SYSTEM_PROGRAM],
+                             [ii(2, bytes([1, 0]),
+                                 (7).to_bytes(4, "little")
+                                 + rng.choice([pB, pA]))],
+                             ro_unsigned=1))
+        elif kind == 7:  # malformed: short init/authorize, re-init
+            data = rng.choice([(6).to_bytes(4, "little") + b"short",
+                               (7).to_bytes(4, "little"),
+                               (6).to_bytes(4, "little") + pB])
+            txns.append(_txn(rng, [pA], [nk, SYSTEM_PROGRAM],
+                             [ii(2, bytes([1, 0]), data)],
+                             ro_unsigned=1))
+        elif kind == 8:  # withdraw from an uninitialized system account
+            txns.append(_txn(rng, [pA],
+                             [_pk("nonceU"), _pk("ndst%d" % i),
+                              SYSTEM_PROGRAM],
+                             [ii(3, bytes([1, 2, 0]),
+                                 (5).to_bytes(4, "little")
+                                 + (500).to_bytes(8, "little"))],
+                             ro_unsigned=1))
+        else:  # interleaved plain transfers
+            txns.append(_txn(rng, [pA], [_pk("nd%d" % i), SYSTEM_PROGRAM],
+                             [ii(2, bytes([0, 1]),
+                                 _transfer_data(rng.randrange(1, 999)))],
+                             ro_unsigned=1))
+    return txns
+
+
+def test_differential_stake_stream():
+    rng = random.Random(0x57A4E)
+    txns = _stake_stream(rng)
+    py = _run(txns, native=False)
+    nat = _run(txns, native=True)
+    assert py[0] == nat[0], [
+        (i, a, b) for i, (a, b) in enumerate(zip(py[0], nat[0])) if a != b
+    ][:10]
+    assert py[1] == nat[1], "bank hash diverged"
+    assert py[2] == nat[2] and py[3] == nat[3]
+    assert py[4] == nat[4]
+    # the stake surface must actually have run native, not punted away
+    assert nat[5][0] > len(txns) // 2
+
+
+def test_differential_nonce_stream():
+    rng = random.Random(0xD0CE)
+    txns = _nonce_stream(rng)
+    py = _run(txns, native=False, batch=13)
+    nat = _run(txns, native=True, batch=13)
+    assert py[0] == nat[0], [
+        (i, a, b) for i, (a, b) in enumerate(zip(py[0], nat[0])) if a != b
+    ][:10]
+    assert py[1] == nat[1], "bank hash diverged"
+    assert py[4] == nat[4]
+    assert nat[5][0] > len(txns) // 2
+    # the durable path itself must have been exercised: at least one
+    # fee-charged SUCCESS against a blockhash the status cache rejects
+    durable_ok = [
+        s for t, (s, fee) in zip(txns, py[0])
+        if ft.txn_parse(t).recent_blockhash(t) == NONCE_BH
+        and s == 0 and fee > 0
+    ]
+    assert durable_ok, "no durable-nonce txn landed — stream too weak"
+
+
+@pytest.mark.slow
+def test_differential_widened_more_seeds():
+    for seed in (3, 1137, 20260):
+        rng = random.Random(seed)
+        txns = _stake_stream(rng) + _nonce_stream(rng)
+        py = _run(txns, native=False, batch=17)
+        nat = _run(txns, native=True, batch=17)
+        assert py[0] == nat[0], seed
+        assert py[1] == nat[1], seed
+        assert py[4] == nat[4], seed

@@ -1099,3 +1099,66 @@ def test_fd215_registered_and_repo_clean():
     findings = [f for f in ast_rules.lint_path(root)
                 if f.rule == "FD215"]
     assert findings == [], findings
+
+
+# -- FD216: txn re-parse in bank-path frag callbacks (zero-copy commit) -------
+
+
+_REPARSE_SRC = '''
+from firedancer_tpu.protocol import txn as ft
+from firedancer_tpu.protocol.txn import txn_parse
+import struct
+
+class BankishStage:
+    def after_frag(self, in_idx, meta, payload):
+        t = ft.txn_parse(payload)                 # FD216: qualified re-parse
+        desc, end = ft.txn_unpack(payload, 0)     # FD216: descriptor re-parse
+        t2 = txn_parse(payload)                   # FD216: from-import alias
+        psz = struct.unpack("<H", payload[-2:])   # struct.unpack: clean
+        n = int.from_bytes(payload[-2:], "little")  # offset read: clean
+        return t or t2 or desc or psz or n
+
+    def _arm_native(self):
+        return ft.txn_parse(b"")                  # not a frag callback: clean
+
+
+def txn_parse_free(payload):
+    return txn_parse(payload)                     # free function: clean
+'''
+
+
+def test_fd216_flags_reparse_in_bank_frag():
+    findings = ast_rules.lint_source(
+        _REPARSE_SRC, "firedancer_tpu/runtime/bank.py")
+    hits = [f for f in findings if f.rule == "FD216"]
+    msgs = [f.msg for f in hits]
+    assert len(hits) == 3, msgs
+    assert sum("txn_parse" in m for m in msgs) == 2
+    assert sum("txn_unpack" in m for m in msgs) == 1
+    # the same source OUTSIDE the bank path is not FD216's business
+    clean = [f for f in ast_rules.lint_source(
+        _REPARSE_SRC, "firedancer_tpu/runtime/poh_stage.py")
+        if f.rule == "FD216"]
+    assert clean == [], clean
+
+
+def test_fd216_suppressible_inline():
+    src = ("from firedancer_tpu.protocol.txn import txn_parse\n"
+           "class B:\n"
+           "    def after_frag(self, in_idx, meta, payload):\n"
+           "        return txn_parse(payload)  "
+           "# fdlint: disable=FD216 -- replay-side decode\n")
+    findings = [f for f in ast_rules.lint_source(
+        src, "firedancer_tpu/runtime/bank_native.py")
+        if f.rule == "FD216"]
+    assert len(findings) == 1 and findings[0].suppressed == "inline"
+
+
+def test_fd216_registered_and_repo_clean():
+    assert "FD216" in {r.id for r in all_rules()}
+    # the commit path honors the verify contract: the repo's own bank
+    # modules read the packed descriptor, they never re-parse the txn
+    root = os.path.join(os.path.dirname(__file__), "..", "firedancer_tpu")
+    findings = [f for f in ast_rules.lint_path(root)
+                if f.rule == "FD216"]
+    assert findings == [], findings

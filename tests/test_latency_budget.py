@@ -16,7 +16,11 @@ from multiprocessing import shared_memory
 
 import pytest
 
-from firedancer_tpu.runtime.slo import HOP_P50_BUDGET_NS, check_hop_budgets
+from firedancer_tpu.runtime.slo import (
+    HOP_P50_BUDGET_NS,
+    HOP_P99_BUDGET_NS,
+    check_hop_budgets,
+)
 from firedancer_tpu.utils import metrics as fm
 
 N_TXNS = 384
@@ -112,3 +116,27 @@ def test_e2e_budget_declared_and_enforced():
     bad = {"store": {"buckets": [1e12], "counts": [0, 5], "sum": 5e12,
                      "count": 5}}
     assert check_hop_budgets(bad)
+
+
+def test_tail_budget_declared_and_enforced():
+    """Round 12: the commit and e2e p99s are budgeted, and the checker
+    catches a histogram whose MEDIAN is fine but whose tail blows the
+    p99 row (the regression shape a p50-only ratchet is blind to)."""
+    assert "bank0" in HOP_P99_BUDGET_NS and "store" in HOP_P99_BUDGET_NS
+    # 98 observations at 1ms, 2 in the 10s bucket: p50 passes its
+    # budget, p99 lands in the tail bucket and must trip
+    bad = {"store": {"buckets": [1e6, 1e10], "counts": [98, 2, 0],
+                     "sum": 98e6 + 2e10, "count": 100}}
+    msgs = check_hop_budgets(bad)
+    assert any("p99" in m for m in msgs), msgs
+    assert not any("p50" in m for m in msgs), msgs
+
+
+def test_tail_hops_observed(scraped_hists):
+    """A tail budget on a hop that consumed nothing is dead code — the
+    p99-budgeted hops must see the stream in the fixture run (the
+    enforcement itself rides test_hop_p50s_within_budget, whose checker
+    walks both tables)."""
+    hists = scraped_hists["hists"]
+    for name in HOP_P99_BUDGET_NS:
+        assert name in hists and hists[name]["count"] > 0, name

@@ -1,7 +1,7 @@
 """Sanitizer lane (ISSUE 15): the native differential suites under
 ASan/UBSan-instrumented .so's.
 
-The point: the C++ hot paths (~4.7k LoC across 8 translation units) had
+The point: the C++ hot paths (~5k LoC across 9 translation units) had
 zero sanitizer coverage — PR 10's review history (NULL-deref guards,
 SIGFPE guard, range checks found only by hand) is exactly the class an
 instrumented run catches mechanically.  `FDTPU_NATIVE_SAN=asan|ubsan`
@@ -40,6 +40,7 @@ SAN_SUITES = (
     "test_shred_native.py",   # shredder + reedsol (fd_shred, fd_reedsol)
     "test_verify_native.py",  # verify sweep client (fd_verify)
     "test_exec_native.py",    # executor fast lane (fd_exec_native)
+    "test_bank_native.py",    # bank sweep client + result log (fd_bank)
 )
 
 

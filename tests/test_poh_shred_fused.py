@@ -1,0 +1,217 @@
+"""Differential suite for the fused poh+shred crash domain (ISSUE 16,
+runtime/shred_stage.FusedPohShredStage).
+
+The fusion collapses the poh->shred ring hop: entries feed the shredder
+in-process, inside the same run_once sweep that mixed them into the
+chain.  The contract is byte-identity — the wire-shred stream of the
+fused stage must equal the unfused PohStage -> ring -> ShredStage
+topology frame for frame, under free-running PoH and under the slot
+clock (sealed slots, missed-slot accounting and window close included),
+because fusion is a crash-domain/latency change, NOT a protocol change.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from firedancer_tpu.ops.ref import ed25519_ref as ref
+from firedancer_tpu.runtime.poh_stage import PohStage
+from firedancer_tpu.runtime.shred_stage import FusedPohShredStage, ShredStage
+from firedancer_tpu.runtime.slot_clock import SlotClockCfg
+from firedancer_tpu.tango import shm
+
+MS = 1_000_000
+_SECRET = hashlib.sha256(b"fused-leader").digest()
+
+
+def _mb(i: int, n_txn: int = 5) -> bytes:
+    """An executed-microblock frame (bank->poh wire format)."""
+    out = bytearray()
+    out += hashlib.sha256(b"mixin%d" % i).digest()
+    out += n_txn.to_bytes(2, "little")
+    for k in range(n_txn):
+        p = hashlib.sha256(b"txn%d.%d" % (i, k)).digest() * 6  # 192B
+        out += len(p).to_bytes(2, "little")
+        out += p
+    return bytes(out)
+
+
+class _Topo:
+    """Either topology behind one drive interface."""
+
+    def __init__(self, *, fused: bool, clock=None, uid=None):
+        uid = uid or shm.fresh_uid()
+        tag = "f" if fused else "u"
+        self.links = [shm.ShmLink.create(f"tpf_{tag}i_{uid}", depth=256,
+                                         mtu=65536, n_fseq=1)]
+        lss = shm.ShmLink.create(f"tpf_{tag}s_{uid}", depth=4096, mtu=1232,
+                                 n_fseq=1)
+        self.links.append(lss)
+        self.prod = shm.make_producer(self.links[0])
+        signer = lambda root: ref.sign(_SECRET, root)  # noqa: E731
+        if fused:
+            self.poh = FusedPohShredStage(
+                "poh_shred", ins=[shm.make_consumer(self.links[0], lazy=8)],
+                outs=[shm.make_producer(lss)], clock=clock,
+                signer=signer, secret=_SECRET, shred_slot=1)
+            self.shred = self.poh.shred_half
+            self.stages = [self.poh]
+        else:
+            lps = shm.ShmLink.create(f"tpf_up_{uid}", depth=1024, mtu=65536,
+                                     n_fseq=1)
+            self.links.append(lps)
+            self.poh = PohStage(
+                "poh", ins=[shm.make_consumer(self.links[0], lazy=8)],
+                outs=[shm.make_producer(lps)], clock=clock)
+            self.shred = ShredStage(
+                "shred", ins=[shm.make_consumer(lps, lazy=8)],
+                outs=[shm.make_producer(lss)], signer=signer,
+                secret=_SECRET, slot=1)
+            self.stages = [self.poh, self.shred]
+        self.poh.require_credit = True
+        self.poh.entries = []
+        self.sink = shm.make_consumer(lss, lazy=4)
+        self.shreds: list[tuple[bytes, int]] = []
+
+    def step(self) -> None:
+        for s in self.stages:
+            s.run_once()
+
+    def drain(self) -> None:
+        while True:
+            r = self.sink.poll()
+            if r in (shm.POLL_EMPTY, shm.POLL_OVERRUN):
+                break
+            meta, payload = r
+            self.shreds.append((bytes(payload), int(meta[1])))
+
+    def finish(self) -> None:
+        self.poh.hashes_per_iter = 0  # stop the free-running clock
+        for _ in range(50):
+            self.step()
+        self.shred.flush(block_complete=True)
+        for _ in range(10):
+            self.step()
+        self.drain()
+
+    def close(self) -> None:
+        for s in self.stages + [self.shred]:
+            s.ins = []
+            s.outs = []
+        self.prod = None
+        self.sink = None
+        import gc
+
+        gc.collect()
+        for link in self.links:
+            link.close()
+            link.unlink()
+
+
+def _run_free(fused: bool):
+    topo = _Topo(fused=fused)
+    try:
+        mbs = [_mb(i) for i in range(40)]
+        fed = 0
+        for it in range(400):
+            # two microblocks per sweep: mixins interleave with ticks
+            for _ in range(2):
+                if fed < len(mbs) and topo.prod.try_publish(
+                        mbs[fed], sig=fed, tsorig=1000 + fed):
+                    fed += 1
+            topo.step()
+            topo.drain()
+        assert fed == len(mbs)
+        topo.finish()
+        rep = {k: topo.poh.metrics.get(k) for k in ("ticks", "mixins")}
+        rep.update({k: topo.shred.metrics.get(k) for k in
+                    ("entry_batches", "fec_sets", "data_shreds_out",
+                     "parity_shreds_out")})
+        return topo.shreds, list(topo.poh.entries), rep
+    finally:
+        topo.close()
+
+
+def test_free_running_stream_byte_identical():
+    s_u, e_u, rep_u = _run_free(fused=False)
+    s_f, e_f, rep_f = _run_free(fused=True)
+    assert rep_u == rep_f
+    assert rep_u["mixins"] == 40
+    assert rep_u["data_shreds_out"] > 0
+    assert e_u == e_f          # entry triples incl. chain hashes
+    assert s_u == s_f          # wire shreds byte-for-byte, same order
+
+
+def _run_clocked(fused: bool):
+    """Scripted virtual time: paced ticks, one forced miss (an abrupt
+    2.6-slot jump past the grace), window close at n_slots."""
+    t = [0]
+    clock = SlotClockCfg(
+        slot_ms=100.0, slot0=1, ticks_per_slot=4, n_slots=6, t0_ns=0,
+    ).build(now_fn=lambda: t[0])
+    topo = _Topo(fused=fused, clock=clock)
+    try:
+        mbs = [_mb(i, n_txn=3) for i in range(30)]
+        fed = 0
+        step_ns = 2 * MS
+        for it in range(200):
+            if it == 80:
+                t[0] += 260 * MS  # freeze across 2 boundaries + grace
+            else:
+                t[0] += step_ns
+            if it % 3 == 0 and fed < len(mbs):
+                if topo.prod.try_publish(mbs[fed], sig=fed,
+                                         tsorig=1000 + fed):
+                    fed += 1
+            topo.step()
+            topo.drain()
+        assert fed == len(mbs)
+        assert topo.poh.window_closed
+        topo.shred.flush(block_complete=True)
+        for _ in range(10):
+            topo.step()
+        topo.drain()
+        rep = {k: topo.poh.metrics.get(k) for k in (
+            "ticks", "mixins", "slots_sealed", "slot_missed",
+            "slot_skipped_ticks")}
+        rep["slots_done"] = topo.poh.slots_done()
+        return topo.shreds, list(topo.poh.entries), rep
+    finally:
+        topo.close()
+
+
+def test_slot_clock_stream_byte_identical_with_miss_accounting():
+    s_u, e_u, rep_u = _run_clocked(fused=False)
+    s_f, e_f, rep_f = _run_clocked(fused=True)
+    assert rep_u == rep_f      # seals, misses, skipped ticks — identical
+    assert rep_u["slot_missed"] >= 1       # the forced jump missed slots
+    assert rep_u["slots_sealed"] >= 1
+    assert rep_u["slots_done"] == 6        # window fully accounted
+    assert e_u == e_f
+    assert s_u == s_f
+
+
+def test_fused_leader_pipeline_end_to_end():
+    """The fused topology as a whole pipeline: txns land, shreds arrive
+    at the store, the block seals — and the fused stage is ONE crash
+    domain in the stage list (no poh->shred link exists)."""
+    from firedancer_tpu.models.leader import build_leader_pipeline
+
+    pipe = build_leader_pipeline(
+        n_verify=1, n_bank=1, pool_size=128, gen_limit=96,
+        verify_precomputed=True, fuse_poh_shred=True, keep_sets=True,
+    )
+    try:
+        pipe.run(until_txns=96, max_iters=40_000)
+        assert pipe.poh is pipe.stages[-2]  # fused stage, then store
+        assert pipe.shred is pipe.poh.shred_half
+        assert not any(s.name == "shred" for s in pipe.stages)
+        assert pipe.pack.metrics.get("txn_in") >= 96
+        assert pipe.banks[0].metrics.get("txn_exec") > 0
+        assert pipe.poh.metrics.get("mixins") > 0
+        assert pipe.shred.metrics.get("data_shreds_out") > 0
+        assert pipe.store.metrics.get("shreds_in") > 0
+        res = pipe.seal()
+        assert len(res.bank_hash) == 32
+    finally:
+        pipe.close()
