@@ -2,12 +2,14 @@
 
 Default run = AST lint over the given paths (default: the installed
 firedancer_tpu package) + topology check of the flagship process
-topology (models/leader_topo.build_leader_topology) + the cross-language
-ABI contract check (abi_check: native/*.cpp vs the ctypes bindings),
-with the shipped baseline applied.  Exit status 0 iff no unsuppressed
-findings — the contract scripts/fdlint.sh and tests/test_fdlint.py
-enforce in tier-1.  `--abi` runs the ABI pass alone; `--no-abi` skips
-it.
+topologies (models/leader_topo.build_leader_topology and its fused
+poh+shred variant) + the cross-language ABI contract check (abi_check:
+native/*.cpp vs the ctypes bindings) + the crash-domain/ring-discipline
+pass (race_check: FD4xx over the package, the flagship topologies and
+native/), with the shipped baseline applied.  Exit status 0 iff no
+unsuppressed findings — the contract scripts/fdlint.sh and
+tests/test_fdlint.py enforce in tier-1.  `--abi` / `--race` run the
+named pass alone; `--no-abi` / `--no-race` skip it.
 """
 
 from __future__ import annotations
@@ -17,11 +19,15 @@ import importlib
 import os
 import sys
 
-from . import abi_check, ast_rules, baseline as bl, report, topo_check
+from . import abi_check, ast_rules, baseline as bl, race_check, report, \
+    topo_check
 from . import native_rules  # noqa: F401 -- registers the FD3xx rules
 from .framework import Finding
 
 DEFAULT_TOPO = "firedancer_tpu.models.leader_topo:build_leader_topology"
+DEFAULT_TOPO_FUSED = \
+    "firedancer_tpu.models.leader_topo:build_leader_topology_fused"
+DEFAULT_TOPOS = [DEFAULT_TOPO, DEFAULT_TOPO_FUSED]
 
 
 def _resolve_topo(spec: str):
@@ -39,6 +45,7 @@ def check_paths(
     baseline_path: str | None = None,
     use_baseline: bool = True,
     abi: bool = False,
+    race: bool = False,
 ) -> list[Finding]:
     """The full analyzer pass as a library call (tests use this)."""
     findings: list[Finding] = []
@@ -49,6 +56,10 @@ def check_paths(
         findings.extend(topo_check.check_topology(topo, label=spec))
     if abi:
         findings.extend(abi_check.check_repo())
+    if race:
+        # the FD4xx pass owns its own scope (package tree + flagship
+        # topologies + native/), exactly like the ABI pass does
+        findings.extend(race_check.check_repo())
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     if use_baseline:
         bl.apply_baseline(findings, bl.load_baseline(baseline_path))
@@ -69,7 +80,7 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--topo", action="append", default=None,
                     metavar="MOD:FACTORY",
                     help="also check this topology (module:factory);"
-                    f" default {DEFAULT_TOPO}")
+                    f" default {DEFAULT_TOPO} + its fused variant")
     ap.add_argument("--no-topo", action="store_true",
                     help="skip the topology check")
     ap.add_argument("--abi", action="store_true",
@@ -77,6 +88,12 @@ def main(argv: list[str] | None = None) -> int:
                     " check (native/*.cpp vs the ctypes bindings)")
     ap.add_argument("--no-abi", action="store_true",
                     help="skip the ABI contract check")
+    ap.add_argument("--race", action="store_true",
+                    help="run ONLY the crash-domain/ring-discipline"
+                    " pass (FD4xx: race_check over the package,"
+                    " flagship topologies and native/)")
+    ap.add_argument("--no-race", action="store_true",
+                    help="skip the crash-domain/ring-discipline pass")
     ap.add_argument("--baseline", default=None,
                     help=f"baseline file (default {bl.DEFAULT_BASELINE})")
     ap.add_argument("--no-baseline", action="store_true",
@@ -101,14 +118,17 @@ def main(argv: list[str] | None = None) -> int:
     paths = args.paths
     if not paths:
         paths = [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
-    topo_specs = [] if args.no_topo else (args.topo or [DEFAULT_TOPO])
+    topo_specs = [] if args.no_topo else (args.topo or list(DEFAULT_TOPOS))
     run_abi = not args.no_abi
-    if args.abi:  # ABI pass alone
-        paths, topo_specs, run_abi = [], [], True
+    run_race = not args.no_race
+    if args.abi or args.race:  # the named pass(es) alone
+        paths, topo_specs = [], []
+        run_abi, run_race = args.abi, args.race
 
     if args.write_baseline:
         findings = check_paths(paths, topo_specs=topo_specs,
-                               use_baseline=False, abi=run_abi)
+                               use_baseline=False, abi=run_abi,
+                               race=run_race)
         out = bl.format_baseline(findings)
         path = args.baseline or bl.DEFAULT_BASELINE
         with open(path, "w", encoding="utf-8") as fh:
@@ -123,16 +143,23 @@ def main(argv: list[str] | None = None) -> int:
         # narrow them) must never drop a live suppression it simply
         # did not look at — out-of-scope entries pass through verbatim
         findings = check_paths(paths, topo_specs=topo_specs,
-                               use_baseline=False, abi=run_abi)
+                               use_baseline=False, abi=run_abi,
+                               race=run_race)
         path = args.baseline or bl.DEFAULT_BASELINE
         roots = [bl._norm(os.path.abspath(p)) for p in paths]
 
         def in_scope(ent) -> bool:
             p = bl._norm(str(ent["path"]))
+            r = str(ent["rule"])
             if p.startswith("topo:"):
                 return bool(topo_specs)
-            return any(p == r or p.startswith(r.rstrip("/") + "/")
-                       for r in roots)
+            if r.startswith("FD4"):
+                # the race pass always scans the whole package tree,
+                # the flagship topologies and native/ — its entries are
+                # in scope exactly when it ran, regardless of `paths`
+                return run_race
+            return any(p == r0 or p.startswith(r0.rstrip("/") + "/")
+                       for r0 in roots)
 
         entries = bl.load_entries(path)
         for i, ent in enumerate(entries):
@@ -162,6 +189,7 @@ def main(argv: list[str] | None = None) -> int:
         baseline_path=args.baseline,
         use_baseline=not args.no_baseline,
         abi=run_abi,
+        race=run_race,
     )
     if args.json:
         print(report.render_json(findings))

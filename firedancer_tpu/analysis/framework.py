@@ -234,3 +234,63 @@ FD216 = _rule(
     " pure duplicate work on the hottest path (the native sweep reads the"
     " same descriptor bytes in C)",
 )
+
+# -- race/crash-domain rules (FD4xx): ring discipline + restart safety ------
+#
+# Registered here, implemented in race_check.py (the fdrace half of the
+# gate).  The crash-domain map is reconstructed statically from the same
+# topology factories the FD1xx pass checks: one StageSpec = one OS
+# process = one crash domain (a fused stage like FusedPohShredStage is
+# ONE spec and therefore ONE domain).
+
+FD401 = _rule(
+    "FD401", "crossdomain-mutable-state", SEV_ERROR,
+    "module-level mutable state mutated at runtime in a module reachable"
+    " from two or more crash domains: under the spawn start method every"
+    " domain holds a divergent private copy, so any shared-state"
+    " assumption silently breaks — coordinate through a ring or shm"
+    " segment instead",
+)
+FD402 = _rule(
+    "FD402", "restart-unsafe-frag-state", SEV_ERROR,
+    "stage used by a restartable crash domain accumulates cross-sweep"
+    " in-memory state in a frag callback (or is a source stage without a"
+    " resume_from_rings override): a SIGKILL + in-place respawn loses"
+    " that state and the replay-dedup ledger only covers the ring wire,"
+    " breaking the exactly-once contract — restartable stages must be"
+    " relay-shaped (frag effects = publishes + metrics only)",
+)
+FD403 = _rule(
+    "FD403", "uncredited-publish", SEV_ERROR,
+    "frag callback publishes with the result discarded in a stage class"
+    " that neither arms require_credit nor checks credits (cr_avail):"
+    " under backpressure try_publish returns False and the consumed frag"
+    " silently vanishes from the pipeline — arm self.require_credit ="
+    " True (the bank/poh/sign contract) or handle the False return",
+)
+FD404 = _rule(
+    "FD404", "seq-read-after-publish", SEV_ERROR,
+    "mcache read-back (query()/table[] load) after publishing to the same"
+    " mcache in one function: the published line may already be BUSY or"
+    " overwritten by the next lap, so the read races the ring's own"
+    " overrun window — producers must trust their seq cursor, never"
+    " re-read the ring (the BUSY-bit protocol exists to make consumer"
+    " reads detect exactly this)",
+)
+FD405 = _rule(
+    "FD405", "speculative-read-no-recheck", SEV_ERROR,
+    "dcache payload read after an mcache query without the second query"
+    " re-check: a producer lapping the ring mid-copy hands the consumer"
+    " torn payload bytes undetected — the speculative-read protocol is"
+    " query, copy, query again and retry on seq change"
+    " (tango/shm.py Consumer.poll is the compliant shape)",
+)
+FD406 = _rule(
+    "FD406", "native-fence-discipline", SEV_ERROR,
+    "native ring code (native/*.cpp) breaks fence discipline: a shared"
+    " seq/fseq cell reached through a non-atomic pointer, a seq or credit"
+    " store weaker than memory_order_release, or a speculative dcache"
+    " copy with no acquire-ordered seq re-check after the memcpy —"
+    " exactly the orderings the Python/NumPy lane gets for free from the"
+    " GIL and the C++ lane must spell out",
+)

@@ -1,0 +1,865 @@
+"""fdrace (FD4xx): crash-domain + ring-discipline static analyzer.
+
+The validator's safety story is lock-free ring protocols between
+isolated crash domains (one OS process per StageSpec).  fdabi (FD3xx)
+checks the FFI *signatures* across the native boundary; nothing checked
+that the *state* shared across process and restart boundaries is
+actually safe.  This module closes that gap with five static passes:
+
+  FD401  module-global mutable state mutated at runtime in a module
+         reachable from >= 2 crash domains (spawn divergence / false
+         sharing assumptions);
+  FD402  restartable crash domains whose stage classes accumulate
+         cross-sweep state in frag callbacks, or source stages without
+         a resume_from_rings override (exactly-once violations);
+  FD403  frag-callback publishes with the result discarded in classes
+         that never arm require_credit nor check credits (silent frag
+         loss under backpressure);
+  FD404  mcache read-back after publishing to the same mcache in one
+         function (producer-side self-race);
+  FD405  speculative dcache reads missing the second mcache query
+         re-check (torn payload reads);
+  FD406  fence discipline in native/*.cpp ring code (non-atomic shared
+         cells, sub-release seq/credit stores, speculative memcpy with
+         no acquire re-check) — a lightweight plain-C parse in
+         abi_check's style, never a compile.
+
+The crash-domain map is reconstructed statically from the same topology
+factories the FD1xx pass checks: one StageSpec = one spawned process =
+one crash domain.  A fused stage (runtime/shred_stage.FusedPohShredStage)
+is constructed by ONE builder inside ONE spec, so it lands — correctly —
+as ONE domain.
+
+Suppression matches the rest of fdlint: `# fdlint: disable=FD40x --
+reason` on the finding line for Python, `// fdlint: disable=FD406 --
+reason` for C++, plus the count-ratchet baseline.
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib
+import inspect
+import os
+import re
+import sys
+import textwrap
+
+from .abi_check import _strip_c
+from .ast_rules import _disabled_lines
+from .framework import Finding
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+PKG_DIR = os.path.join(_ROOT, "firedancer_tpu")
+NATIVE_DIR = os.path.join(_ROOT, "native")
+
+# the topologies whose crash-domain maps anchor FD401/FD402 — the same
+# flagship factories the FD1xx pass validates, fused variant included
+DEFAULT_TOPOS = (
+    "firedancer_tpu.models.leader_topo:build_leader_topology",
+    "firedancer_tpu.models.leader_topo:build_leader_topology_fused",
+)
+
+# frag callbacks: the per-frag dispatch surface of runtime/stage.Stage
+FRAG_CBS = frozenset({"before_frag", "during_frag", "after_frag",
+                      "sweep_frags"})
+
+# raw-text prefilter twin of FRAG_CBS (check_ring_discipline)
+_FRAG_DEF_RE = re.compile(
+    r"def\s+(?:before_frag|during_frag|after_frag|sweep_frags)\b")
+
+# method names that mutate their receiver in place
+_MUTATORS = frozenset({
+    "append", "appendleft", "add", "update", "extend", "insert",
+    "setdefault", "pop", "popitem", "popleft", "remove", "discard",
+    "clear", "sort", "push",
+})
+
+_C_DISABLE_RE = re.compile(r"//\s*fdlint:\s*disable=([A-Z0-9, ]+)")
+
+
+def _resolve_topo(spec: str):
+    """'pkg.mod:factory' -> Topology (cli._resolve_topo's shape,
+    duplicated to keep the import graph acyclic: cli imports us)."""
+    modname, _, attr = spec.partition(":")
+    obj = getattr(importlib.import_module(modname), attr)
+    return obj() if callable(obj) else obj
+
+
+def _dotted_str(node: ast.AST) -> str | None:
+    """`a.b[0].c` -> "a.b[].c" (subscripts collapsed); None otherwise."""
+    parts: list[str] = []
+    while True:
+        if isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        elif isinstance(node, ast.Subscript):
+            parts.append("[]")
+            node = node.value
+        elif isinstance(node, ast.Name):
+            parts.append(node.id)
+            return ".".join(reversed(parts))
+        else:
+            return None
+
+
+# ---------------------------------------------------------------------------
+# crash-domain reconstruction
+# ---------------------------------------------------------------------------
+
+
+def _is_stage_class(obj) -> bool:
+    return isinstance(obj, type) and any(
+        c.__name__ == "Stage" for c in obj.__mro__)
+
+
+def _resolve_in_env(node: ast.AST, env: dict):
+    """Resolve `Name` / `mod.attr.Name` call targets against a builder's
+    module namespace (plus its local imports)."""
+    chain: list[str] = []
+    while isinstance(node, ast.Attribute):
+        chain.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    obj = env.get(node.id)
+    for attr in reversed(chain):
+        if obj is None:
+            return None
+        obj = getattr(obj, attr, None)
+    return obj
+
+
+def builder_stage_classes(builder) -> set[type]:
+    """The Stage subclasses a spec's builder constructs, by reading its
+    source: every `SomeStage(...)` call resolved against the builder's
+    module globals and its function-local imports.  A stage composed
+    INSIDE another stage's __init__ (FusedPohShredStage's shred half)
+    deliberately does not surface here — it runs in the same process, so
+    it is the same crash domain."""
+    try:
+        mod = sys.modules.get(builder.__module__) or importlib.import_module(
+            builder.__module__)
+        src = textwrap.dedent(inspect.getsource(builder))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError, ImportError):
+        return set()
+    env = dict(vars(mod))
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module:
+            try:
+                m = importlib.import_module(node.module)
+            except ImportError:
+                continue
+            for al in node.names:
+                if hasattr(m, al.name):
+                    env[al.asname or al.name] = getattr(m, al.name)
+        elif isinstance(node, ast.Import):
+            for al in node.names:
+                top = al.name.split(".")[0]
+                try:
+                    env[al.asname or top] = importlib.import_module(
+                        al.name if al.asname else top)
+                except ImportError:
+                    pass
+    out: set[type] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            obj = _resolve_in_env(node.func, env)
+            if _is_stage_class(obj):
+                out.add(obj)
+    return out
+
+
+def domain_map(topo) -> list[tuple[str, set[type], bool]]:
+    """[(domain name, stage classes, restartable)] — one entry per
+    StageSpec: the process-per-spec contract of runtime/topo.launch."""
+    return [(spec.name, builder_stage_classes(spec.builder),
+             bool(getattr(spec, "restartable", False)))
+            for spec in topo.stages]
+
+
+_IMPORT_CACHE: dict[tuple[str, tuple[str, ...]], set[str]] = {}
+
+
+_FILE_CACHE: dict[str, str | None] = {}
+
+
+def _module_file(modname: str) -> str | None:
+    """Module name -> .py path via find_spec, cached: find_spec walks
+    the import machinery (and imports parent packages), which made the
+    `from pkg import maybe_submodule` probe in _module_imports the
+    hottest call in the whole pass."""
+    if modname in _FILE_CACHE:
+        return _FILE_CACHE[modname]
+    try:
+        spec = importlib.util.find_spec(modname)
+    except (ImportError, ValueError, ModuleNotFoundError):
+        spec = None
+    out = None
+    if spec is not None and spec.origin and spec.origin.endswith(".py"):
+        out = spec.origin
+    _FILE_CACHE[modname] = out
+    return out
+
+
+def _module_imports(modname: str,
+                    prefixes: tuple[str, ...] = ("firedancer_tpu",)
+                    ) -> set[str]:
+    """Direct imports of a module within the given top-level packages,
+    by parsing its source (never by executing it).  The prefixes come
+    from the closure's seed modules, so fixture topologies living in
+    their own package resolve exactly like the flagship ones."""
+    key = (modname, prefixes)
+    if key in _IMPORT_CACHE:
+        return _IMPORT_CACHE[key]
+    _IMPORT_CACHE[key] = out = set()
+    path = _module_file(modname)
+    if path is None:
+        return out
+    tree = _parse_file(path)
+    if tree is None:
+        return out
+    pkg = modname.rsplit(".", 1)[0] if "." in modname else modname
+    # imports are statements: descend statement bodies only, never
+    # expression subtrees (a full ast.walk here was ~40% of the pass)
+    work: list[ast.AST] = list(tree.body)
+    while work:
+        node = work.pop()
+        if isinstance(node, ast.Import):
+            for al in node.names:
+                if al.name.startswith(prefixes):
+                    out.add(al.name)
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:  # relative: resolve against the package
+                base = modname.split(".")
+                base = base[: len(base) - node.level]
+                mod = ".".join(base + ([node.module] if node.module else []))
+            else:
+                mod = node.module or ""
+            if not mod.startswith(prefixes):
+                continue
+            out.add(mod)
+            # `from pkg import name` where name is a submodule
+            for al in node.names:
+                sub = f"{mod}.{al.name}"
+                if _module_file(sub) is not None:
+                    out.add(sub)
+        else:
+            for fld in ("body", "orelse", "finalbody", "handlers"):
+                work.extend(getattr(node, fld, None) or ())
+    return out
+
+
+def _closure(seeds: set[str]) -> set[str]:
+    """Import closure restricted to the seeds' own top-level packages —
+    firedancer_tpu for the flagship topologies, the fixture package for
+    test topologies; third-party trees are never entered."""
+    prefixes = tuple(sorted({s.split(".")[0] for s in seeds}))
+    if not prefixes:
+        return set()
+    seen: set[str] = set()
+    work = list(seeds)
+    while work:
+        m = work.pop()
+        if m in seen or not m.startswith(prefixes):
+            continue
+        seen.add(m)
+        work.extend(_module_imports(m, prefixes))
+    return seen
+
+
+# ---------------------------------------------------------------------------
+# FD401: cross-domain module-global mutable state
+# ---------------------------------------------------------------------------
+
+_MUTABLE_CTORS = frozenset({
+    "dict", "list", "set", "bytearray", "deque", "defaultdict",
+    "Counter", "OrderedDict", "iter",
+})
+
+_AST_CACHE: dict[str, ast.Module | None] = {}
+_TEXT_CACHE: dict[str, str | None] = {}
+
+
+def _read_file(path: str) -> str | None:
+    if path not in _TEXT_CACHE:
+        try:
+            with open(path, encoding="utf-8") as fh:
+                _TEXT_CACHE[path] = fh.read()
+        except OSError:
+            _TEXT_CACHE[path] = None
+    return _TEXT_CACHE[path]
+
+
+def _parse_file(path: str) -> ast.Module | None:
+    if path not in _AST_CACHE:
+        text = _read_file(path)
+        try:
+            _AST_CACHE[path] = None if text is None else ast.parse(text)
+        except SyntaxError:
+            _AST_CACHE[path] = None
+    return _AST_CACHE[path]
+
+
+def _mutable_globals(tree: ast.Module) -> set[str]:
+    """Module-level names bound to a mutable container/iterator."""
+    out: set[str] = set()
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        v = node.value
+        mutable = isinstance(v, (ast.Dict, ast.List, ast.Set, ast.ListComp,
+                                 ast.DictComp, ast.SetComp)) or (
+            isinstance(v, ast.Call) and isinstance(v.func, ast.Name)
+            and v.func.id in _MUTABLE_CTORS)
+        if not mutable:
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                out.add(t.id)
+    return out
+
+
+def _global_mutations(tree: ast.Module, names: set[str]):
+    """(name, line, how) for every runtime mutation of a module global:
+    inside any function body — rebinding via `global`, subscript store,
+    in-place mutator call, or next() on an iterator global.  Single
+    pass per function: `global` declarations and mutations collected in
+    one subtree walk (the 2 s fdlint budget)."""
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        declared: set[str] = set()
+        rebinds: list[tuple[str, int]] = []
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Global):
+                declared.update(n for n in node.names if n in names)
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    if isinstance(t, ast.Name) and t.id in names:
+                        rebinds.append((t.id, node.lineno))
+                    elif (isinstance(t, ast.Subscript)
+                          and isinstance(t.value, ast.Name)
+                          and t.value.id in names):
+                        yield t.value.id, node.lineno, "subscript store"
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    if (isinstance(t, ast.Subscript)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id in names):
+                        yield t.value.id, node.lineno, "subscript delete"
+            elif isinstance(node, ast.Call):
+                f = node.func
+                if (isinstance(f, ast.Attribute) and f.attr in _MUTATORS
+                        and isinstance(f.value, ast.Name)
+                        and f.value.id in names):
+                    yield f.value.id, node.lineno, f".{f.attr}() call"
+                elif (isinstance(f, ast.Name) and f.id == "next"
+                      and node.args
+                      and isinstance(node.args[0], ast.Name)
+                      and node.args[0].id in names):
+                    yield node.args[0].id, node.lineno, "next() advance"
+        for name, line in rebinds:
+            if name in declared:  # a local of the same name is not ours
+                yield name, line, "rebound via `global`"
+
+
+def check_cross_domain_state(topo_specs) -> list[Finding]:
+    """FD401 over every module reachable from >= 2 crash domains of the
+    given topologies (union across topologies: a module shared by two
+    domains in ANY checked deployment is shared state)."""
+    reach: dict[str, set[str]] = {}  # module -> domain labels
+    restartable_domains: list[tuple[str, str, set[type]]] = []
+    for spec in topo_specs:
+        topo = _resolve_topo(spec)
+        for name, classes, restartable in domain_map(topo):
+            if restartable:
+                restartable_domains.append((spec, name, classes))
+            mods = _closure({cls.__module__ for cls in classes})
+            for m in mods:
+                reach.setdefault(m, set()).add(name)
+    findings: list[Finding] = []
+    seen: set[tuple[str, str]] = set()
+    for modname in sorted(reach):
+        domains = reach[modname]
+        if len(domains) < 2:
+            continue
+        path = _module_file(modname)
+        if path is None:
+            continue
+        tree = _parse_file(path)
+        if tree is None:
+            continue
+        globs = _mutable_globals(tree)
+        if not globs:
+            continue
+        for gname, line, how in _global_mutations(tree, globs):
+            if (path, gname) in seen:
+                continue
+            seen.add((path, gname))
+            doms = ", ".join(sorted(domains)[:4])
+            more = len(domains) - min(len(domains), 4)
+            if more:
+                doms += f", +{more} more"
+            findings.append(Finding(
+                "FD401", path, line,
+                f"module-global '{gname}' mutated at runtime ({how}) in a"
+                f" module reachable from crash domains [{doms}]: each"
+                f" spawned process holds its own divergent copy",
+            ))
+    findings.extend(_check_restart_domains(restartable_domains))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# FD402: restart-unsafe frag state in restartable domains
+# ---------------------------------------------------------------------------
+
+# attrs a frag callback may legitimately touch in a restartable stage:
+# metrics are observability (rebuilt at respawn), the resume guards and
+# round-robin cursor are the restart machinery itself
+_RESTART_SAFE_ATTRS = frozenset({"metrics", "_resume_guards", "_in_rr"})
+
+
+def _classdef_of(cls) -> tuple[str, ast.ClassDef] | None:
+    path = _module_file(cls.__module__)
+    if path is None:
+        return None
+    tree = _parse_file(path)
+    if tree is None:
+        return None
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef) and node.name == cls.__name__:
+            return path, node
+    return None
+
+
+def _self_mutations(fn: ast.AST):
+    """(attr, line, how) for cross-sweep self-state accumulation."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.AugAssign):
+            t = node.target
+            if (isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"):
+                yield t.attr, node.lineno, f"self.{t.attr} augmented"
+            elif (isinstance(t, ast.Subscript)
+                  and isinstance(t.value, ast.Attribute)
+                  and isinstance(t.value.value, ast.Name)
+                  and t.value.value.id == "self"):
+                yield (t.value.attr, node.lineno,
+                       f"self.{t.value.attr}[] augmented")
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                if (isinstance(t, ast.Subscript)
+                        and isinstance(t.value, ast.Attribute)
+                        and isinstance(t.value.value, ast.Name)
+                        and t.value.value.id == "self"):
+                    yield (t.value.attr, node.lineno,
+                           f"self.{t.value.attr}[] assigned")
+        elif isinstance(node, ast.Call):
+            f = node.func
+            if (isinstance(f, ast.Attribute) and f.attr in _MUTATORS
+                    and isinstance(f.value, ast.Attribute)
+                    and isinstance(f.value.value, ast.Name)
+                    and f.value.value.id == "self"):
+                yield (f.value.attr, node.lineno,
+                       f"self.{f.value.attr}.{f.attr}()")
+
+
+def _check_restart_domains(restartable) -> list[Finding]:
+    findings: list[Finding] = []
+    seen: set[tuple[str, int]] = set()
+    for spec_label, name, classes in restartable:
+        if not classes:
+            continue
+        overrides_resume = any(
+            "resume_from_rings" in c.__dict__
+            for cls in classes for c in cls.__mro__
+            if c.__name__ != "Stage")
+        for cls in sorted(classes, key=lambda c: c.__name__):
+            located = _classdef_of(cls)
+            if located is None:
+                continue
+            path, cdef = located
+            for node in cdef.body:
+                if not isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                if node.name not in FRAG_CBS:
+                    continue
+                for attr, line, how in _self_mutations(node):
+                    if attr in _RESTART_SAFE_ATTRS:
+                        continue
+                    if (path, line) in seen:
+                        continue
+                    seen.add((path, line))
+                    findings.append(Finding(
+                        "FD402", path, line,
+                        f"{cls.__name__}.{node.name} mutates cross-sweep"
+                        f" state ({how}) but domain '{name}' is"
+                        f" restartable: an in-place respawn loses this"
+                        f" state and the replay ledger only dedups the"
+                        f" ring wire",
+                    ))
+        # source-domain half of the resume contract
+        for spec in _resolve_topo(spec_label).stages:
+            if spec.name != name:
+                continue
+            if spec.ins is not None and len(spec.ins) == 0 \
+                    and not overrides_resume:
+                cls = sorted(classes, key=lambda c: c.__name__)[0]
+                located = _classdef_of(cls)
+                if located is None:
+                    continue
+                path, cdef = located
+                if (path, cdef.lineno) in seen:
+                    continue
+                seen.add((path, cdef.lineno))
+                findings.append(Finding(
+                    "FD402", path, cdef.lineno,
+                    f"source stage {cls.__name__} backs restartable domain"
+                    f" '{name}' without overriding resume_from_rings: a"
+                    f" respawned source restarts its stream from scratch"
+                    f" — derive progress from the producer's recovered"
+                    f" seq (chaos/scenario.SlotGenStage's shape)",
+                ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# FD403/FD404/FD405: ring protocol discipline in Python
+# ---------------------------------------------------------------------------
+
+
+def _check_publish_discipline(tree: ast.Module, path: str) -> list[Finding]:
+    """FD403/FD404/FD405 in ONE traversal (the 2 s fdlint budget: the
+    naive shape — walk for classes, re-walk per class for credit
+    arming, re-walk per method, re-walk the whole tree again for
+    functions — visited hot files' nodes 4x and dominated the gate).
+
+    Per-class state (does it arm require_credit / touch cr_avail
+    anywhere in its body?) and FD403 candidates accumulate during the
+    class subtree visit; candidates are emitted only at class exit if
+    the class never armed.  Per-function publish/query/read protocol
+    state lives on a frame created at function entry and is judged at
+    function exit — a nested def gets its own frame, so its ring
+    traffic is attributed to the innermost function."""
+    findings: list[Finding] = []
+
+    def flush_fn(fname: str, published: dict[str, int],
+                 queries: list[tuple[str, int]], reads: list[int]) -> None:
+        for chain, qline in queries:
+            pub = published.get(chain)
+            if pub is not None and qline > pub:
+                findings.append(Finding(
+                    "FD404", path, qline,
+                    f"{fname} reads back '{chain}' at line {qline} after"
+                    f" publishing to it at line {pub}: the line may"
+                    f" already be BUSY/overwritten by the next lap —"
+                    f" trust the seq cursor instead",
+                ))
+        if reads and queries:
+            last_read = max(reads)
+            before = [ln for _, ln in queries if ln < last_read]
+            after = [ln for _, ln in queries if ln > last_read]
+            if before and not after:
+                findings.append(Finding(
+                    "FD405", path, last_read,
+                    f"{fname} copies payload bytes out of the dcache"
+                    f" after an mcache query but never re-checks the seq"
+                    f" afterwards: a producer lap mid-copy hands back torn"
+                    f" bytes undetected (query, copy, query again)",
+                ))
+
+    def visit(node, cls, fn) -> None:
+        # cls: {"name", "arms", "cands"} | None; fn: per-function frame
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                c = {"name": child.name, "arms": False, "cands": []}
+                visit(child, c, None)
+                if not c["arms"]:
+                    findings.extend(c["cands"])
+                continue
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                f = {"name": child.name, "pub": {}, "q": [], "r": [],
+                     "frag": fn is None and cls is not None
+                     and child.name in FRAG_CBS}
+                visit(child, cls, f)
+                flush_fn(child.name, f["pub"], f["q"], f["r"])
+                continue
+            if cls is not None:
+                if isinstance(child, ast.Assign):
+                    for t in child.targets:
+                        if (isinstance(t, ast.Attribute)
+                                and t.attr == "require_credit"
+                                and isinstance(child.value, ast.Constant)
+                                and child.value.value is True):
+                            cls["arms"] = True
+                elif (isinstance(child, ast.Attribute)
+                      and child.attr == "cr_avail"):
+                    cls["arms"] = True
+            if fn is not None:
+                if isinstance(child, ast.Call) and isinstance(
+                        child.func, ast.Attribute):
+                    chain = _dotted_str(child.func.value)
+                    if chain is not None:
+                        is_mc = "mcache" in chain.split(".")
+                        if child.func.attr in ("publish", "try_publish") \
+                                and is_mc:
+                            fn["pub"].setdefault(chain, child.lineno)
+                        elif child.func.attr == "query" and is_mc:
+                            fn["q"].append((chain, child.lineno))
+                        elif (child.func.attr == "read"
+                              and "dcache" in chain.split(".")):
+                            fn["r"].append(child.lineno)
+                elif isinstance(child, ast.Subscript) and isinstance(
+                        child.ctx, ast.Load):
+                    chain = _dotted_str(child.value)
+                    if chain and chain.endswith("mcache.table"):
+                        fn["q"].append(
+                            (chain.rsplit(".", 1)[0], child.lineno))
+                if (fn["frag"] and cls is not None
+                        and isinstance(child, ast.Expr)
+                        and isinstance(child.value, ast.Call)):
+                    g = child.value.func
+                    if (isinstance(g, ast.Attribute)
+                            and g.attr in ("publish", "publish_burst_out",
+                                           "try_publish")
+                            and isinstance(g.value, ast.Name)
+                            and g.value.id == "self"):
+                        cls["cands"].append(Finding(
+                            "FD403", path, child.lineno,
+                            f"{cls['name']}.{fn['name']} discards the"
+                            f" result of self.{g.attr}() and the class"
+                            f" neither arms require_credit nor checks"
+                            f" cr_avail: under backpressure the consumed"
+                            f" frag is silently dropped",
+                        ))
+            visit(child, cls, fn)
+
+    visit(tree, None, None)
+    return findings
+
+
+def _iter_py_files(paths) -> list[str]:
+    out: list[str] = []
+    for root in paths:
+        if os.path.isfile(root):
+            out.append(root)
+            continue
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = sorted(
+                d for d in dirnames if d not in {"__pycache__", ".git"})
+            out.extend(os.path.join(dirpath, fn)
+                       for fn in sorted(filenames) if fn.endswith(".py"))
+    return out
+
+
+def check_ring_discipline(paths) -> list[Finding]:
+    findings: list[Finding] = []
+    for path in _iter_py_files(paths):
+        text = _read_file(path)
+        if text is None:
+            continue
+        # token prefilter: FD404/405 need a raw mcache/dcache touch and
+        # FD403 needs a publish inside a frag-callback def — skip the
+        # parse+visit for files with neither (the 2 s fdlint budget)
+        if "mcache" not in text and "dcache" not in text and not (
+                "publish" in text and _FRAG_DEF_RE.search(text)):
+            continue
+        tree = _parse_file(path)
+        if tree is None:
+            continue
+        findings.extend(_check_publish_discipline(tree, path))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# FD406: native fence discipline (lightweight C++ parse)
+# ---------------------------------------------------------------------------
+
+_CAST_RE = re.compile(
+    r"reinterpret_cast\s*<\s*(?:const\s+)?(?:u?int(?:32|64)_t|unsigned"
+    r"(?:\s+long)*)\s*(?:const\s+)?\*\s*>|"
+    r"\(\s*(?:const\s+)?u?int(?:32|64)_t\s*\*\s*\)")
+_STORE_RE = re.compile(r"(?:\.|->)\s*store\s*\(")
+_MEMCPY_RE = re.compile(r"\bmemcpy\s*\(")
+_RELEASE_RE = re.compile(r"memory_order_(?:release|seq_cst|acq_rel)")
+
+
+def _line_of(text: str, pos: int) -> int:
+    return text.count("\n", 0, pos) + 1
+
+
+def _paren(text: str, open_idx: int) -> str | None:
+    """text[open_idx] == '(' -> the balanced argument text inside."""
+    depth = 0
+    for i in range(open_idx, len(text)):
+        if text[i] == "(":
+            depth += 1
+        elif text[i] == ")":
+            depth -= 1
+            if depth == 0:
+                return text[open_idx + 1: i]
+    return None
+
+
+def _store_receiver(stripped: str, pos: int) -> str:
+    """The expression text immediately left of a `.store(` / `->store(`
+    match: walks back over identifiers, member ops and balanced
+    brackets — enough to see `r[0]`, `fseq_cell(l, i)`, `cell->`."""
+    i = pos
+    depth = 0
+    while i > 0:
+        ch = stripped[i - 1]
+        if ch in ")]":
+            depth += 1
+        elif ch in "([":
+            if depth == 0:
+                break
+            depth -= 1
+        elif depth == 0 and not (ch.isalnum() or ch in "_.->"):
+            break
+        i -= 1
+    return stripped[i:pos].strip()
+
+
+def _enclosing_body_end(stripped: str, pos: int) -> int:
+    """End of the enclosing function: the next close brace at column 0
+    (the style every native/*.cpp translation unit follows)."""
+    end = stripped.find("\n}", pos)
+    return len(stripped) if end < 0 else end
+
+
+def _split_args(argtext: str) -> list[str]:
+    out, depth, cur = [], 0, []
+    for ch in argtext:
+        if ch in "([{":
+            depth += 1
+        elif ch in ")]}":
+            depth -= 1
+        if ch == "," and depth == 0:
+            out.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    if cur:
+        out.append("".join(cur))
+    return out
+
+
+def check_native(native_dir: str | None = None) -> list[Finding]:
+    native_dir = native_dir or NATIVE_DIR
+    findings: list[Finding] = []
+    if not os.path.isdir(native_dir):
+        return findings
+    for fn in sorted(os.listdir(native_dir)):
+        if not fn.endswith(".cpp"):
+            continue
+        path = os.path.join(native_dir, fn)
+        with open(path, encoding="utf-8") as fh:
+            text = fh.read()
+        if "mcache_off" not in text and "fseq_off" not in text:
+            continue  # not ring code: no shared cells to discipline
+        stripped = _strip_c(text)
+        # (a) shared seq/credit cells reached through non-atomic pointers
+        for m in _CAST_RE.finditer(stripped):
+            op = stripped.find("(", m.end() - 1)
+            if op < 0:
+                continue
+            inner = _paren(stripped, op)
+            if inner and ("mcache_off" in inner or "fseq_off" in inner):
+                findings.append(Finding(
+                    "FD406", path, _line_of(stripped, m.start()),
+                    "shared mcache/fseq cell reached through a non-atomic"
+                    " integer pointer: cross-process seq/credit words must"
+                    " be std::atomic<uint64_t> (plain loads/stores are"
+                    " torn and unordered)",
+                ))
+        # (b) seq / credit stores must be release-ordered
+        for m in _STORE_RE.finditer(stripped):
+            recv = _store_receiver(stripped, m.start())
+            is_seq_cell = recv.endswith("[0]") or "fseq" in recv
+            if not is_seq_cell:
+                continue
+            op = stripped.find("(", m.end() - 1)
+            args = _paren(stripped, op) if op > 0 else None
+            if args is None or not _RELEASE_RE.search(args):
+                findings.append(Finding(
+                    "FD406", path, _line_of(stripped, m.start()),
+                    f"store to seq/credit cell '{recv}' is weaker than"
+                    " memory_order_release: consumers ordering on this"
+                    " word may observe it before the payload/meta writes"
+                    " it publishes",
+                ))
+        # (c) speculative dcache copies need an acquire re-check after
+        for m in _MEMCPY_RE.finditer(stripped):
+            op = stripped.find("(", m.end() - 1)
+            argtext = _paren(stripped, op) if op > 0 else None
+            if argtext is None:
+                continue
+            args = _split_args(argtext)
+            if len(args) < 3 or "dcache" not in args[1]:
+                continue  # not a copy OUT of the dcache
+            tail = stripped[m.end():_enclosing_body_end(stripped, m.end())]
+            if not re.search(r"load\s*\(\s*std::memory_order_acquire", tail):
+                findings.append(Finding(
+                    "FD406", path, _line_of(stripped, m.start()),
+                    "speculative memcpy out of the dcache with no"
+                    " acquire-ordered seq re-load afterwards: a producer"
+                    " lapping the ring mid-copy hands back torn payload"
+                    " bytes undetected",
+                ))
+        # inline suppression, C++ comment form
+        disabled: dict[int, set[str]] = {}
+        for i, line in enumerate(text.splitlines(), start=1):
+            cm = _C_DISABLE_RE.search(line)
+            if cm:
+                disabled[i] = {t.strip() for t in cm.group(1).split(",")
+                               if t.strip()}
+        for f in findings:
+            if f.path != path or f.suppressed:
+                continue
+            ids = disabled.get(f.line)
+            if ids and f.rule in ids:
+                f.suppressed = "inline"
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# entry point
+# ---------------------------------------------------------------------------
+
+
+def check_repo(paths=None, topo_specs=None,
+               native_dir: str | None = None) -> list[Finding]:
+    """The full FD4xx pass: crash-domain rules anchored on the default
+    topologies, ring-discipline rules over the package tree, fence
+    discipline over native/.  Inline suppressions applied; the baseline
+    is the caller's job (cli.check_paths), like every other pass."""
+    paths = list(paths) if paths is not None else [PKG_DIR]
+    topo_specs = (list(topo_specs) if topo_specs is not None
+                  else list(DEFAULT_TOPOS))
+    findings = check_cross_domain_state(topo_specs)
+    findings.extend(check_ring_discipline(paths))
+    findings.extend(check_native(native_dir))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    by_path: dict[str, list[Finding]] = {}
+    for f in findings:
+        if f.path.endswith(".py") and not f.suppressed:
+            by_path.setdefault(f.path, []).append(f)
+    for path, fs in by_path.items():
+        try:
+            with open(path, encoding="utf-8") as fh:
+                disabled = _disabled_lines(fh.read())
+        except OSError:
+            continue
+        for f in fs:
+            ids = disabled.get(f.line)
+            if ids and f.rule in ids:
+                f.suppressed = "inline"
+    return findings

@@ -195,3 +195,16 @@ def validate_or_raise(topo, label: str = "topology") -> list[Finding]:
     if fatal:
         raise TopologyError(fatal)
     return findings
+
+
+def restart_domains(topo) -> list[tuple[str, bool]]:
+    """The crash/restart-domain map of a topology: [(domain, restartable)]
+    in stage order.  One StageSpec = one spawned process = one domain —
+    which makes the fusion semantics explicit: a fused stage
+    (FusedPohShredStage behind models/leader_topo's fuse_poh_shred knob)
+    is ONE spec, so its halves restart together and an entry can never
+    be stranded on a ring between them.  race_check (FD401/FD402)
+    anchors its cross-domain reachability on the same map; tests assert
+    the fused topology yields exactly one poh+shred domain."""
+    return [(spec.name, bool(getattr(spec, "restartable", False)))
+            for spec in topo.stages]

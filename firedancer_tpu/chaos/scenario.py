@@ -735,10 +735,14 @@ class ChaosGenStage(Stage):
 
 
 class ChaosRelayStage(Stage):
+    """Deliberately LOSSY relay: chaos scenarios use it to create the
+    backpressure-drop flank the lossless CreditRelayStage exists to
+    contrast against — the FD403 discard below is the point."""
+
     def after_frag(self, in_idx, meta, payload) -> None:
         from firedancer_tpu.tango.rings import MCache
 
-        self.publish(0, payload, sig=int(meta[MCache.COL_SIG]),
+        self.publish(0, payload, sig=int(meta[MCache.COL_SIG]),  # fdlint: disable=FD403 -- lossy by design
                      tsorig=int(meta[MCache.COL_TSORIG]))
 
 

@@ -207,6 +207,33 @@ def build_shred(links, cnc, *, secret, slot):
     )
 
 
+def build_poh_shred_fused(links, cnc, *, n_bank, secret, slot,
+                          slot_clock=None):
+    """The fused poh+shred crash domain (runtime/shred_stage.
+    FusedPohShredStage) as ONE process: the poh->shred ring hop ("ps")
+    disappears, entries feed the shredder in-process, and the
+    supervisor restarts clock and shredder together — entries can never
+    be stranded on a ring between them."""
+    _cpu()  # the shred half's reedsol dispatches on device
+    from firedancer_tpu.ops.ref import ed25519_ref as ref
+    from firedancer_tpu.runtime.shred_stage import FusedPohShredStage
+
+    stage = FusedPohShredStage(
+        "poh_shred",
+        ins=[shm.make_consumer(links[f"bp{b}"], lazy=8)
+             for b in range(n_bank)],
+        outs=[shm.make_producer(links["ss"])],
+        cnc=cnc,
+        clock=slot_clock,
+        signer=lambda root: ref.sign(secret, root),
+        secret=secret,  # arms the native shredder lane when available
+        shred_slot=slot,
+        batch_target_sz=4096,
+    )
+    stage.require_credit = True
+    return stage
+
+
 def build_store(links, cnc, *, leader_pub):
     _cpu()  # the resolver's RS recover dispatches on device
     from firedancer_tpu.ops.ref import ed25519_ref as ref
@@ -234,6 +261,7 @@ def build_leader_topology(
     boot_grace_s: float = 0.0,
     shed_keep: int | None = None,
     verify_precomputed: bool = False,
+    fuse_poh_shred: bool = False,
 ) -> ft.Topology:
     """sandbox: utils/sandbox.enter kwargs applied to EVERY stage child
     (the per-tile jail; fd_topo_run's seccomp step).  The default policy
@@ -253,7 +281,13 @@ def build_leader_topology(
     With n_slots set on the cfg, the leader window ends ON THE SCHEDULE
     — poh stops sealing at the last slot's deadline regardless of how
     much load is still draining (the handoff contract); supervise with
-    `until=leader_window_done(...)` to observe it."""
+    `until=leader_window_done(...)` to observe it.
+
+    fuse_poh_shred: collapse poh and shred into ONE crash domain
+    (FusedPohShredStage): the "ps" link and the separate shred process
+    disappear, and the fused stage consumes the bank entry links and
+    produces wire shreds directly.  Supervise with
+    `leader_window_done(n, stage="poh_shred")` in this mode."""
     from firedancer_tpu.models.leader import resolve_native_pack
     from firedancer_tpu.ops.ref import ed25519_ref as ref
 
@@ -289,7 +323,8 @@ def build_leader_topology(
         topo.link(f"pb{b}", depth=256, mtu=65536)
         topo.link(f"bp{b}", depth=256, mtu=65536)
         topo.link(f"bd{b}", depth=256, mtu=64)
-    topo.link("ps", depth=1024, mtu=65536)
+    if not fuse_poh_shred:
+        topo.link("ps", depth=1024, mtu=65536)
     topo.link("ss", depth=4096, mtu=1232)
 
     secret = hashlib.sha256(leader_seed).digest()
@@ -327,15 +362,35 @@ def build_leader_topology(
                    slot_clock=slot_clock,
                    ins=[f"pb{b}"], outs=[f"bp{b}", f"bd{b}"],
                    credit_gated=True, schema=BankStage.metrics_schema())
-    topo.stage("poh", build_poh, n_bank=n_bank, sandbox=sb,
-               slot_clock=slot_clock,
-               ins=[f"bp{b}" for b in range(n_bank)], outs=["ps"],
-               credit_gated=True, schema=PohStage.metrics_schema())
-    topo.stage("shred", build_shred, secret=secret, slot=slot, sandbox=sb,
-               ins=["ps"], outs=["ss"])
+    if fuse_poh_shred:
+        from firedancer_tpu.runtime.shred_stage import FusedPohShredStage
+
+        topo.stage("poh_shred", build_poh_shred_fused, n_bank=n_bank,
+                   secret=secret, slot=slot, sandbox=sb,
+                   slot_clock=slot_clock,
+                   ins=[f"bp{b}" for b in range(n_bank)], outs=["ss"],
+                   credit_gated=True,
+                   schema=FusedPohShredStage.metrics_schema())
+    else:
+        topo.stage("poh", build_poh, n_bank=n_bank, sandbox=sb,
+                   slot_clock=slot_clock,
+                   ins=[f"bp{b}" for b in range(n_bank)], outs=["ps"],
+                   credit_gated=True, schema=PohStage.metrics_schema())
+        topo.stage("shred", build_shred, secret=secret, slot=slot,
+                   sandbox=sb, ins=["ps"], outs=["ss"])
     topo.stage("store", build_store, leader_pub=leader_pub, sandbox=sb,
                ins=["ss"])
     return topo
+
+
+def build_leader_topology_fused(**kw) -> ft.Topology:
+    """build_leader_topology with the fusion knob on: the fused
+    poh+shred crash domain as a checkable flagship variant — the
+    default `--topo` spec fdlint's FD1xx (link/credit invariants) and
+    FD4xx (crash-domain map) passes validate alongside the unfused
+    topology."""
+    kw.setdefault("fuse_poh_shred", True)
+    return build_leader_topology(**kw)
 
 
 def leader_window_done(n_slots: int, stage: str = "poh"):

@@ -25,6 +25,13 @@ class DedupStage(Stage):
 
     def __init__(self, *args, tcache_depth: int = DEDUP_TCACHE_DEPTH, **kwargs):
         super().__init__(*args, **kwargs)
+        # fdrace FD403 true positive: after_frag inserts into the tcache
+        # BEFORE publishing, so a backpressured publish dropped the txn
+        # while the tcache already marked it seen — an upstream
+        # retransmit then dies here as a "duplicate" forever.  Never
+        # consume a frag that can't be forwarded (bank/poh/sign's
+        # contract).
+        self.require_credit = True
         # the native C++ tcache is the hot path (fd_dedup.c's position is
         # all per-frag overhead); the Python ring is the portable fallback
         try:

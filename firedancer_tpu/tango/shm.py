@@ -40,7 +40,9 @@ def fresh_uid(namespace: str | None = None) -> str:
     on the same uid and silently share rings.  The process-wide counter
     cannot repeat within a pid; `namespace` scopes a validator's (or
     test's) segments so a supervisor FAIL reclaims only its own."""
-    tag = f"{os.getpid()}_{next(_uid_seq)}"
+    # Parent-only: names are derived before spawn; children receive
+    # them ready-made, so per-process counter divergence is harmless.
+    tag = f"{os.getpid()}_{next(_uid_seq)}"  # fdlint: disable=FD401 -- parent-only naming
     return f"{namespace}_{tag}" if namespace else tag
 
 

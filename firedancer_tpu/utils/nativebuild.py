@@ -4,8 +4,9 @@ One place owns the build-to-temp + atomic-rename discipline (concurrent
 stage processes must never clobber each other's half-written .so) and the
 temp cleanup on failure; every binding module loads through it.
 
-Sanitizer lane (ISSUE 15): `FDTPU_NATIVE_SAN=asan|ubsan` redirects every
-build into `native/san/<san>/` with the matching instrumentation flags,
+Sanitizer lane (ISSUE 15): `FDTPU_NATIVE_SAN=asan|ubsan|tsan` redirects
+every build into `native/san/<san>/` with the matching instrumentation
+flags,
 so the SAME differential suites exercise the SAME bindings over
 ASan/UBSan-instrumented .so's — no second build system, no test forks.
 `build_so` RETURNS the path actually built (the san twin when the lane
@@ -36,6 +37,12 @@ _SAN_FLAGS = {
              "-fsanitize=address"],
     "ubsan": ["-O1", "-shared", "-fPIC", "-g",
               "-fsanitize=undefined", "-fno-sanitize-recover=undefined"],
+    # TSan sees in-PROCESS threads only: the cross-process shm rings are
+    # invisible to it (docs/OPERATIONS.md "TSan vs the shm rings"), so
+    # this lane guards the threaded native paths + validates the fence
+    # annotations race_check's FD406 checks statically
+    "tsan": ["-O1", "-shared", "-fPIC", "-g", "-fno-omit-frame-pointer",
+             "-fsanitize=thread"],
 }
 
 
@@ -48,7 +55,7 @@ def san_mode() -> str | None:
         return None
     if v not in _SAN_FLAGS:
         raise NativeUnavailable(
-            f"{SAN_ENV}={v!r}: expected 'asan' or 'ubsan'")
+            f"{SAN_ENV}={v!r}: expected 'asan', 'ubsan' or 'tsan'")
     return v
 
 
@@ -82,11 +89,28 @@ def san_env(san: str) -> dict[str, str]:
     — without it the first C++ exception anywhere dies in
     "AsanCheckFailed real___cxa_throw != 0" instead of propagating.
     Raises NativeUnavailable when the toolchain lacks the runtime."""
-    lib = {"asan": "libasan.so", "ubsan": "libubsan.so"}[san]
+    lib = {"asan": "libasan.so", "ubsan": "libubsan.so",
+           "tsan": "libtsan.so"}[san]
     preload = f"{_toolchain_lib(lib)} {_toolchain_lib('libstdc++.so')}"
     env = {SAN_ENV: san, "LD_PRELOAD": preload}
     if san == "asan":
         env["ASAN_OPTIONS"] = "detect_leaks=0:abort_on_error=1"
+    elif san == "tsan":
+        # The suppressions file mutes jaxlib's UNinstrumented
+        # xla_extension.so (TSan cannot see its internal sync, so XLA
+        # threadpool alloc/free handoffs report as races — third-party
+        # noise, while our instrumented twins stay fully checked).
+        # detect_deadlocks=0: native/*.cpp holds ZERO mutexes (pure
+        # std::atomic; FD406 + grep enforce it), so the experimental
+        # lock-order detector can only ever report libgcc/libstdc++/XLA
+        # internals — race detection, the lane's point, stays fully on.
+        # The shm rings are cross-process and thus OUTSIDE TSan's
+        # model — a report against an mmap'd ring cell is an artifact,
+        # see docs/OPERATIONS.md before trusting one.
+        supp = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "tsan.supp")
+        env["TSAN_OPTIONS"] = (
+            f"halt_on_error=1:detect_deadlocks=0:suppressions={supp}")
     else:
         env["UBSAN_OPTIONS"] = "print_stacktrace=1:halt_on_error=1"
     return env

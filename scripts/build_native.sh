@@ -8,7 +8,7 @@
 # fine: every loader raises NativeUnavailable and its caller falls back to
 # the Python lane, and the tests SKIP (never fail).
 #
-# Sanitizer lane (ISSUE 15): `--san asan|ubsan` builds instrumented twins
+# Sanitizer lane (ISSUE 15): `--san asan|ubsan|tsan` builds instrumented twins
 # into native/san/<san>/ — the same flags utils/nativebuild uses when
 # FDTPU_NATIVE_SAN is set, so a prebuilt CI lane and the on-demand lane
 # produce interchangeable artifacts.  Run the suites against them with
@@ -16,7 +16,7 @@
 #     ASAN_OPTIONS=detect_leaks=0 python -m pytest tests/test_native_san.py
 # (docs/OPERATIONS.md has the full runbook).
 #
-# Usage: scripts/build_native.sh [--force] [--san asan|ubsan]
+# Usage: scripts/build_native.sh [--force] [--san asan|ubsan|tsan]
 
 set -euo pipefail
 cd "$(dirname "$0")/../native"
@@ -35,7 +35,8 @@ while [ $# -gt 0 ]; do
             case "$san" in
                 asan)  CXXFLAGS="-O1 -shared -fPIC -g -fno-omit-frame-pointer -fsanitize=address" ;;
                 ubsan) CXXFLAGS="-O1 -shared -fPIC -g -fsanitize=undefined -fno-sanitize-recover=undefined" ;;
-                *) echo "build_native: --san expects asan|ubsan (got '$san')" >&2; exit 2 ;;
+                tsan)  CXXFLAGS="-O1 -shared -fPIC -g -fno-omit-frame-pointer -fsanitize=thread" ;;
+                *) echo "build_native: --san expects asan|ubsan|tsan (got '$san')" >&2; exit 2 ;;
             esac
             ;;
         *) echo "build_native: unknown arg '$1'" >&2; exit 2 ;;

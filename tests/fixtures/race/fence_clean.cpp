@@ -1,0 +1,41 @@
+// FD406 clean controls: the same shapes written with the fence
+// discipline native/fd_ring.cpp actually follows — zero findings.
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+
+struct fdr_link {
+  uint64_t mcache_off;
+  uint64_t fseq_off;
+  uint64_t dcache_off;
+};
+
+static uint8_t *lbase(fdr_link *l) { return (uint8_t *)l; }
+
+extern "C" {
+
+// (a) shared cells only ever reached through std::atomic pointers
+uint64_t good_seq_read(fdr_link *l) {
+  auto *seq =
+      reinterpret_cast<std::atomic<uint64_t> *>(lbase(l) + l->mcache_off);
+  return seq[0].load(std::memory_order_acquire);
+}
+
+// (b) seq/credit stores are release-ordered
+void good_seq_store(fdr_link *l, uint64_t v) {
+  auto *r = reinterpret_cast<std::atomic<uint64_t> *>(lbase(l) + l->fseq_off);
+  r[0].store(v, std::memory_order_release);
+}
+
+// (c) the speculative copy is followed by an acquire re-load of the seq
+int good_copy(fdr_link *l, uint8_t *dst, uint64_t off, uint64_t sz,
+              uint64_t seq_expect) {
+  auto *seq =
+      reinterpret_cast<std::atomic<uint64_t> *>(lbase(l) + l->mcache_off);
+  uint8_t *dcache = lbase(l) + l->dcache_off;
+  memcpy(dst, dcache + off, sz);
+  if (seq[0].load(std::memory_order_acquire) != seq_expect) return -1;
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,42 @@
+// FD406 firing seeds: every fence-discipline shape the native pass
+// flags, in the style of native/fd_ring.cpp.  Analyzer input only —
+// never compiled.
+#include <cstdint>
+#include <cstring>
+
+struct fdr_link {
+  uint64_t mcache_off;
+  uint64_t fseq_off;
+  uint64_t dcache_off;
+};
+
+static uint8_t *lbase(fdr_link *l) { return (uint8_t *)l; }
+
+extern "C" {
+
+// (a) shared cell reached through a non-atomic integer pointer
+uint64_t bad_seq_read(fdr_link *l) {
+  uint64_t *seq = reinterpret_cast<uint64_t *>(lbase(l) + l->mcache_off);
+  return seq[0];
+}
+
+// (b) seq cell stored with plain (relaxed-at-best) ordering
+void bad_seq_store(fdr_link *l, uint64_t v) {
+  auto *r = reinterpret_cast<std::atomic<uint64_t> *>(lbase(l) + l->fseq_off);
+  r[0].store(v);
+}
+
+// (b) suppression control: the violation is seeded AND inline-disabled
+void bad_seq_store_waived(fdr_link *l, uint64_t v) {
+  auto *r = reinterpret_cast<std::atomic<uint64_t> *>(lbase(l) + l->fseq_off);
+  r[0].store(v);  // fdlint: disable=FD406 -- seeded suppression control
+}
+
+// (c) speculative dcache copy with no acquire re-load afterwards
+int bad_copy(fdr_link *l, uint8_t *dst, uint64_t off, uint64_t sz) {
+  uint8_t *dcache = lbase(l) + l->dcache_off;
+  memcpy(dst, dcache + off, sz);
+  return 0;
+}
+
+}  // extern "C"

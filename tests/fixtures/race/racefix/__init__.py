@@ -1,0 +1,6 @@
+"""Mini topology package seeding the FD401/FD402 fixtures.
+
+Its own top-level package (not firedancer_tpu), so the tests also prove
+race_check's import closure derives its package prefix from the seed
+modules instead of hard-coding the flagship tree.
+"""

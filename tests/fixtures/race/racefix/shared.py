@@ -1,0 +1,22 @@
+"""Shared by both stage modules -> reachable from two crash domains.
+
+PENDING is the FD401 seed: module-global mutable state mutated at
+runtime.  Each spawned stage process holds its own divergent copy, so
+code written as if stage_a's insert were visible to stage_b is wrong.
+
+TABLE is the clean control: a mutable container that is only ever READ
+after import — reachable from two domains but never mutated, so FD401
+must stay silent on it.
+"""
+
+PENDING = {}
+
+TABLE = {"mtu": 1232, "depth": 64}
+
+
+def note(sig):
+    PENDING[sig] = True  # FD401: subscript store into a shared global
+
+
+def lookup(key):
+    return TABLE.get(key)

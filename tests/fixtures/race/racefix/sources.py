@@ -1,0 +1,22 @@
+"""Source stages (ins=[]) for the FD402 resume-contract pair."""
+
+from firedancer_tpu.runtime.stage import Stage
+
+
+class GenStage(Stage):
+    """FD402 firing seed: backs a restartable source domain without a
+    resume_from_rings override — a respawn restarts its stream from
+    scratch instead of deriving progress from the recovered seq."""
+
+    def tick(self):
+        return None
+
+
+class GenCleanStage(Stage):
+    """Clean control: the resume override IS the restart contract."""
+
+    def tick(self):
+        return None
+
+    def resume_from_rings(self, *args, **kwargs):
+        super().resume_from_rings(*args, **kwargs)

@@ -1,0 +1,19 @@
+"""FD402 firing seed: a restartable relay accumulating frag state."""
+
+from firedancer_tpu.runtime.stage import Stage
+
+from racefix import shared
+
+
+class RelayAStage(Stage):
+    """Runs in the restartable 'relay_a' domain of topo.build_fire.
+
+    after_frag both mutates the cross-domain shared global (the FD401
+    seed lives in shared.note) and accumulates per-process state on
+    self — an in-place respawn silently loses `seen`, so the dedup it
+    implements evaporates exactly when the supervisor restarts it.
+    """
+
+    def after_frag(self, out_idx, sig, sz):
+        shared.note(sig)
+        self.seen.add(sig)  # FD402 seed: cross-sweep state, not replay-safe

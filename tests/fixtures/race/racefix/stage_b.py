@@ -1,0 +1,13 @@
+"""Clean control for FD402: a restartable relay that only touches
+restart-safe attrs (metrics are rebuilt at respawn) and only READS the
+shared module's lookup table."""
+
+from firedancer_tpu.runtime.stage import Stage
+
+from racefix import shared
+
+
+class RelayBStage(Stage):
+    def after_frag(self, out_idx, sig, sz):
+        if shared.lookup("mtu"):
+            self.metrics["frags"] += 1  # restart-safe: FD402 stays silent

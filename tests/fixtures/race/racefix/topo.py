@@ -1,0 +1,43 @@
+"""Fixture topologies anchoring the FD401/FD402 tests.
+
+build_fire seeds every crash-domain shape; build_clean wires the
+controls.  The builders are parsed (never called) by
+race_check.builder_stage_classes, exactly like the flagship factories.
+"""
+
+from firedancer_tpu.runtime.topo import Topology
+
+from racefix.sources import GenCleanStage, GenStage
+from racefix.stage_a import RelayAStage
+from racefix.stage_b import RelayBStage
+
+
+def build_gen(links, cnc):
+    return GenStage()
+
+
+def build_gen_clean(links, cnc):
+    return GenCleanStage()
+
+
+def build_relay_a(links, cnc):
+    return RelayAStage()
+
+
+def build_relay_b(links, cnc):
+    return RelayBStage()
+
+
+def build_fire() -> Topology:
+    t = Topology()
+    t.stage("gen", build_gen, ins=[], outs=["ab"], restartable=True)
+    t.stage("relay_a", build_relay_a, ins=["ab"], restartable=True)
+    t.stage("relay_b", build_relay_b, ins=["ab"])
+    return t
+
+
+def build_clean() -> Topology:
+    t = Topology()
+    t.stage("gen", build_gen_clean, ins=[], outs=["ab"], restartable=True)
+    t.stage("relay_b", build_relay_b, ins=["ab"], restartable=True)
+    return t

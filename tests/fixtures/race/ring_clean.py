@@ -1,0 +1,46 @@
+"""Clean controls for FD403/FD404/FD405: the same shapes written with
+the ring protocol respected — every rule must stay silent here."""
+
+
+class CreditRelayStage:
+    """FD403 control: the class arms require_credit, so a discarded
+    publish cannot silently drop a consumed frag."""
+
+    def __init__(self):
+        self.require_credit = True
+
+    def during_frag(self, meta, payload):
+        self.publish(0, payload, sig=int(meta[0]))
+
+
+class CheckedRelayStage:
+    """FD403 control: the publish result is checked, not discarded."""
+
+    def during_frag(self, meta, payload):
+        ok = self.publish(0, payload, sig=int(meta[0]))
+        if not ok:
+            self.metrics["backpressure"] += 1
+
+
+def peek_then_publish(prod, meta, seq):
+    """FD404 control: the read-back happens BEFORE the publish."""
+    row = prod.out.mcache.query(seq)
+    prod.out.mcache.publish(meta)
+    return row
+
+
+def copy_with_recheck(link, seq):
+    """FD405 control: query, copy, query again — the re-check makes a
+    mid-copy producer lap detectable."""
+    meta = link.mcache.query(seq)
+    payload = link.dcache.read(meta)
+    again = link.mcache.query(seq)
+    if again is None or again[0] != meta[0]:
+        return None
+    return payload
+
+
+def copy_without_query(link, chunk):
+    """FD405 control: a dcache read with no speculative mcache query in
+    the same function is not the speculative-read shape."""
+    return link.dcache.read(chunk)

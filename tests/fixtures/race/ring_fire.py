@@ -1,0 +1,36 @@
+"""FD403/FD404/FD405 firing seeds (ring-protocol discipline).
+
+Each function/class below violates exactly one rule; the matching
+controls live in ring_clean.py.  Analyzer input only — never imported.
+"""
+
+
+class LossyRelayStage:
+    """FD403 seed: frag callback discards the publish result and the
+    class neither arms require_credit nor looks at cr_avail — under
+    backpressure the consumed frag is silently dropped."""
+
+    def during_frag(self, meta, payload):
+        self.publish(0, payload, sig=int(meta[0]))  # FD403 fires here
+
+
+def republish_then_peek(prod, meta):
+    """FD404 seed: reads the mcache line back after publishing it —
+    the line may already be BUSY/overwritten by the next lap."""
+    seq = prod.out.mcache.publish(meta)
+    row = prod.out.mcache.query(seq)  # FD404 fires here
+    return row
+
+
+def peek_table_after_publish(prod, meta, seq):
+    """FD404 seed, raw-table form: mcache.table[] load after publish."""
+    prod.ring.mcache.publish(meta)
+    return prod.ring.mcache.table[seq & 63]  # FD404 fires here
+
+
+def copy_speculative(link, seq):
+    """FD405 seed: query -> dcache copy, never re-checks the seq —
+    a producer lap mid-copy hands back torn bytes undetected."""
+    meta = link.mcache.query(seq)
+    payload = link.dcache.read(meta)  # FD405 fires here
+    return payload

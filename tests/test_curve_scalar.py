@@ -63,6 +63,8 @@ j_validate = jax.jit(fs.sc_validate)
 j_reduce = jax.jit(fs.sc_reduce512)
 
 
+@pytest.mark.slow  # ~25 s of XLA compiles; decompress stays covered in
+# tier-1 by test_decompress_rejects_non_points + the sigverify suites
 def test_decompress_compress_roundtrip(rng):
     pts = rand_points(rng, 12)
     enc = [ref.point_compress(p) for p in pts]
@@ -89,6 +91,8 @@ def test_decompress_rejects_non_points(rng):
     assert not np.asarray(ok).any()
 
 
+@pytest.mark.slow  # ~30 s of XLA compiles; dbl/add correctness rides
+# the tier-1 sigverify differential suites transitively
 def test_dbl_add_vs_ref(rng):
     pts = rand_points(rng, 8)
     enc = bytes_cols([ref.point_compress(p) for p in pts])
@@ -134,6 +138,7 @@ def small_order_encodings() -> list[bytes]:
     return out
 
 
+@pytest.mark.slow  # heaviest compile in the file (~40 s on 1 core)
 def test_small_order_detection(rng):
     # All 8-torsion encodings must flag; random honest points must not.
     found = small_order_encodings()

@@ -7,6 +7,7 @@ environment and the production path is always jitted anyway.
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from firedancer_tpu.ops import limbs as fl
 
@@ -79,6 +80,8 @@ def test_mul_stays_loose_after_chains(rng):
     assert arr.min() >= 0 and arr.max() < 1 << 15
 
 
+@pytest.mark.slow  # ~16 s compile; invert/pow2523 are exercised inside
+# every tier-1 decompress + sigverify kernel anyway
 def test_invert_pow2523(rng):
     vals = [v for v in rand_ints(rng, 10) if v % P != 0]
     fa = to_fe(vals)

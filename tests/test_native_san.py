@@ -1,5 +1,5 @@
-"""Sanitizer lane (ISSUE 15): the native differential suites under
-ASan/UBSan-instrumented .so's.
+"""Sanitizer lane (ISSUE 15, TSan twin PR 17): the native differential
+suites under ASan/UBSan/TSan-instrumented .so's.
 
 The point: the C++ hot paths (~5k LoC across 9 translation units) had
 zero sanitizer coverage — PR 10's review history (NULL-deref guards,
@@ -113,6 +113,7 @@ def _run_suites(san: str) -> None:
     blob = r.stdout + r.stderr
     assert "ERROR: AddressSanitizer" not in blob, blob[-4000:]
     assert "runtime error:" not in blob, blob[-4000:]  # UBSan report line
+    assert "WARNING: ThreadSanitizer" not in blob, blob[-4000:]
 
 
 @pytest.mark.slow
@@ -123,3 +124,15 @@ def test_asan_differential_suites():
 @pytest.mark.slow
 def test_ubsan_differential_suites():
     _run_suites("ubsan")
+
+
+@pytest.mark.slow
+def test_tsan_differential_suites():
+    """TSan twin (PR 17): the same differential matrix over
+    -fsanitize=thread builds.  TSan models in-process threads only —
+    the cross-process shm rings are outside it (the static FD406 pass
+    in analysis/race_check covers those fences; docs/OPERATIONS.md
+    explains why a TSan report against an mmap'd ring cell is an
+    artifact) — so this leg guards the threaded native paths and
+    proves the instrumented .so's stay report-clean under load."""
+    _run_suites("tsan")

@@ -1,0 +1,205 @@
+"""race_check (fdlint FD4xx) tests: every rule fires on its seeded
+fixture (tests/fixtures/race/) with an exact count, every clean control
+stays silent, inline suppression works in both languages, the fused
+poh+shred topology resolves to ONE crash domain, and — the tier-1
+contract — the shipped repo diffs CLEAN inside the runtime budget.
+"""
+
+import os
+import sys
+import time
+from collections import Counter
+
+import pytest
+
+from firedancer_tpu.analysis import race_check as rc
+from firedancer_tpu.analysis import topo_check
+from firedancer_tpu.analysis.framework import all_rules
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIX = os.path.join(REPO, "tests", "fixtures", "race")
+RING_FIRE = os.path.join(FIX, "ring_fire.py")
+RING_CLEAN = os.path.join(FIX, "ring_clean.py")
+FENCE_FIRE = os.path.join(FIX, "fence_fire.cpp")
+FENCE_CLEAN = os.path.join(FIX, "fence_clean.cpp")
+
+
+@pytest.fixture()
+def racefix_path():
+    """Make the fixture topology package (racefix) importable."""
+    sys.path.insert(0, FIX)
+    try:
+        yield
+    finally:
+        sys.path.remove(FIX)
+        for mod in [m for m in sys.modules if m.split(".")[0] == "racefix"]:
+            del sys.modules[mod]
+
+
+# -- rule registry -----------------------------------------------------------
+
+
+def test_fd4xx_rules_registered():
+    ids = {r.id for r in all_rules()}
+    for n in range(401, 407):
+        assert f"FD{n}" in ids
+
+
+# -- FD403/FD404/FD405: ring discipline fixtures -----------------------------
+
+
+def test_ring_rules_fire_on_fixture():
+    counts = Counter(f.rule for f in rc.check_ring_discipline([RING_FIRE]))
+    assert counts == {
+        "FD403": 1,  # LossyRelayStage discarded publish
+        "FD404": 2,  # query read-back + raw mcache.table[] read-back
+        "FD405": 1,  # speculative dcache copy, no re-check
+    }, counts
+
+
+def test_ring_findings_name_the_shape():
+    by_rule = {}
+    for f in rc.check_ring_discipline([RING_FIRE]):
+        by_rule.setdefault(f.rule, f)
+        assert f.path == RING_FIRE and f.line > 0
+    assert "LossyRelayStage.during_frag" in by_rule["FD403"].msg
+    assert "require_credit" in by_rule["FD403"].msg
+    assert "prod.out.mcache" in by_rule["FD404"].msg
+    assert "re-checks the seq" in by_rule["FD405"].msg
+
+
+def test_ring_clean_controls_silent():
+    findings = rc.check_ring_discipline([RING_CLEAN])
+    assert findings == [], [f.format() for f in findings]
+
+
+# -- FD406: native fence-discipline fixtures ---------------------------------
+
+
+def _fence_findings():
+    return rc.check_native(FIX)
+
+
+def test_fd406_fires_on_fixture():
+    fire = [f for f in _fence_findings() if f.path == FENCE_FIRE]
+    assert len(fire) == 4, [f.format() for f in fire]
+    assert all(f.rule == "FD406" for f in fire)
+    msgs = " | ".join(f.msg for f in fire)
+    assert "non-atomic" in msgs          # (a) bad_seq_read
+    assert "memory_order_release" in msgs  # (b) bad_seq_store
+    assert "torn payload" in msgs        # (c) bad_copy
+
+
+def test_fd406_inline_disable_marks_suppressed():
+    fire = [f for f in _fence_findings() if f.path == FENCE_FIRE]
+    supp = [f for f in fire if f.suppressed]
+    assert len(supp) == 1 and supp[0].suppressed == "inline"
+
+
+def test_fd406_clean_control_silent():
+    clean = [f for f in _fence_findings() if f.path == FENCE_CLEAN]
+    assert clean == [], [f.format() for f in clean]
+
+
+# -- FD401/FD402: crash-domain fixtures (the racefix mini topology) ----------
+
+
+def test_fd401_fd402_fire_on_fixture_topology(racefix_path):
+    findings = rc.check_cross_domain_state(["racefix.topo:build_fire"])
+    counts = Counter(f.rule for f in findings)
+    assert counts == {"FD401": 1, "FD402": 2}, \
+        [f.format() for f in findings]
+    fd401 = next(f for f in findings if f.rule == "FD401")
+    assert fd401.path.endswith("shared.py")
+    assert "'PENDING'" in fd401.msg and "relay_a" in fd401.msg \
+        and "relay_b" in fd401.msg
+    by_path = {os.path.basename(f.path) for f in findings
+               if f.rule == "FD402"}
+    assert by_path == {"stage_a.py", "sources.py"}
+    src = next(f for f in findings if f.path.endswith("sources.py"))
+    assert "resume_from_rings" in src.msg
+
+
+def test_fd401_fd402_clean_topology_silent(racefix_path):
+    findings = rc.check_cross_domain_state(["racefix.topo:build_clean"])
+    assert findings == [], [f.format() for f in findings]
+
+
+def test_domain_map_resolves_fixture_builders(racefix_path):
+    topo = rc._resolve_topo("racefix.topo:build_fire")
+    doms = {name: {c.__name__ for c in classes}
+            for name, classes, _restartable in rc.domain_map(topo)}
+    assert doms == {"gen": {"GenStage"},
+                    "relay_a": {"RelayAStage"},
+                    "relay_b": {"RelayBStage"}}
+
+
+# -- inline suppression, Python side -----------------------------------------
+
+
+def test_python_inline_disable_marks_suppressed(tmp_path):
+    p = tmp_path / "lossy.py"
+    p.write_text(
+        "class S:\n"
+        "    def after_frag(self, out_idx, sig, sz):\n"
+        "        self.publish(0, b'x', sig=sig)"
+        "  # fdlint: disable=FD403 -- lossy by design\n"
+    )
+    findings = rc.check_repo(paths=[str(p)], topo_specs=[],
+                             native_dir=str(tmp_path))
+    assert [f.rule for f in findings] == ["FD403"]
+    assert findings[0].suppressed == "inline"
+
+
+# -- the fused poh+shred crash domain (topo_check satellite) -----------------
+
+
+def test_fused_topology_validates_and_drops_ps_link():
+    from firedancer_tpu.models.leader_topo import build_leader_topology_fused
+
+    topo = build_leader_topology_fused()
+    topo_check.validate_or_raise(topo, label="fused")  # FD1xx green
+    assert "ps" not in {ls.name for ls in topo.links}
+    names = [s.name for s in topo.stages]
+    assert "poh_shred" in names
+    assert "poh" not in names and "shred" not in names
+
+
+def test_fused_stage_is_one_restart_domain():
+    from firedancer_tpu.models.leader_topo import (
+        build_leader_topology, build_leader_topology_fused,
+    )
+
+    fused = dict(topo_check.restart_domains(build_leader_topology_fused()))
+    assert "poh_shred" in fused  # ONE domain for both halves
+    unfused = dict(topo_check.restart_domains(build_leader_topology()))
+    assert "poh" in unfused and "shred" in unfused
+    assert "poh_shred" not in unfused
+
+
+def test_domain_map_resolves_fused_stage():
+    topo = rc._resolve_topo(
+        "firedancer_tpu.models.leader_topo:build_leader_topology_fused")
+    doms = {name: {c.__name__ for c in classes}
+            for name, classes, _restartable in rc.domain_map(topo)}
+    assert doms["poh_shred"] == {"FusedPohShredStage"}
+
+
+# -- the acceptance gate -----------------------------------------------------
+
+
+def test_repo_diffs_clean_and_fast():
+    """Zero unsuppressed FD4xx findings over the shipped tree, well
+    inside the fdlint wall budget (the CLI gate test runs this once per
+    suite via scripts/fdlint.sh; ISSUE 17 pins FD2xx+FD3xx+FD4xx under
+    2 s — the 5 s ceiling here is slack for loaded CI hosts, matching
+    test_abi_check's)."""
+    t0 = time.monotonic()
+    findings = rc.check_repo()
+    dt = time.monotonic() - t0
+    active = [f for f in findings if not f.suppressed]
+    assert active == [], [f.format() for f in active]
+    # the two waived repo findings stay VISIBLE as suppressed entries
+    assert {(f.rule, f.suppressed) for f in findings} <= \
+        {("FD401", "inline"), ("FD403", "inline")}
+    assert dt < 5.0, f"race_check took {dt:.2f}s (budget 5s)"

@@ -128,6 +128,9 @@ def test_sharded_pipeline_precomputed_end_to_end():
 # -- per-shard metrics labels through the PROCESS topology --------------------
 
 
+@pytest.mark.slow  # ~17 s (spawns the full sharded process topology);
+# tier-1 keeps the sharded e2e via test_sharded_pipeline_precomputed_
+# end_to_end and the metrics plane via test_monitor
 def test_sharded_topology_shm_metrics_and_labels():
     """(a) of the serving-plane test triad: router frag conservation per
     shard read from the shm registries of a REAL process topology, plus

@@ -386,6 +386,10 @@ def test_native_pack_shed_parity():
 # -- the compressed-cadence pipeline run (acceptance) -------------------------
 
 
+@pytest.mark.slow  # ~24 s wall (real compressed clock + pipeline build);
+# the cadence invariants each have focused tier-1 tests above, and the
+# fused-stage clock run (test_poh_shred_fused) keeps an e2e clock test
+# in tier-1
 def test_leader_pipeline_under_compressed_cadence_zero_loss():
     """The cooperative leader pipeline against a real (compressed) wall
     clock: every slot seals at its deadline with bounded jitter, txns
