@@ -683,6 +683,210 @@ def run_shred_ab(pairs: int = 3, out_path: str | None = None) -> dict:
     return out
 
 
+class _NetSink:
+    """Unlimited-credit null producer: counts published frames so the
+    ingress windows measure intake, not downstream compute."""
+
+    def __init__(self):
+        self.n = 0
+
+    def try_publish(self, payload, sig=0, tsorig=0):
+        self.n += 1
+        return True
+
+
+def _net_env(native: bool):
+    prev = os.environ.get("FDTPU_NATIVE_NET")
+    os.environ["FDTPU_NATIVE_NET"] = "1" if native else "0"
+    return prev
+
+
+def _net_env_restore(prev):
+    if prev is None:
+        os.environ.pop("FDTPU_NATIVE_NET", None)
+    else:
+        os.environ["FDTPU_NATIVE_NET"] = prev
+
+
+def _net_quic_window(native: bool, clients: int = 4,
+                     dgrams: int = 240) -> dict:
+    """One QUIC-flavor ingress window: establish in-process client
+    connections against a ChaosSock'd stage, pre-seal the steady-state
+    short-header datagrams OUTSIDE the timed region, then time ONLY the
+    ingress path (stage._on_datagram + after_credit) — µs/datagram with
+    client-side seal and downstream compute split out.  The OFF window
+    pins the net lane off at stage build (FDTPU_NATIVE_NET=0) and
+    ops/aes.py to pure Python for the timed region only, so setup stays
+    fast and the measured lane is honest."""
+    import hashlib
+
+    from firedancer_tpu.chaos.population import ChaosSock
+    from firedancer_tpu.ops import aes
+    from firedancer_tpu.ops.ref import ed25519_ref as ref
+    from firedancer_tpu.runtime.net import QuicIngressStage
+    from firedancer_tpu.waltz import quic
+
+    identity = hashlib.sha256(b"net-ab").digest()
+    prev = _net_env(native)
+    try:
+        sink = _NetSink()
+        st = QuicIngressStage("quic", outs=[sink], sock=ChaosSock(),
+                              rx_burst=64, identity_secret=identity)
+        assert (st._net_client is not None) == native
+        conns = []
+        for ci in range(clients):
+            c = quic.Connection.client_new(
+                expected_peer=ref.public_key(identity))
+            addr = ("ab", ci)
+            for _ in range(40):
+                moved = False
+                for dg in c.flush():
+                    moved = True
+                    st._on_datagram(dg, addr)
+                q = st.sock.tx.get(addr)
+                while q:
+                    moved = True
+                    c.receive(q.popleft())
+                if not moved:
+                    break
+            assert c.established
+            conns.append((c, addr))
+        # mixed steady-state txn sizes, one short-header datagram each
+        sizes = (96, 512, 1200)
+        h = hashlib.sha256(b"net-ab-payload")
+        batch = []
+        sids = [2] * clients
+        for i in range(dgrams):
+            ci = i % clients
+            c, addr = conns[ci]
+            n = sizes[i % len(sizes)]
+            buf = b""
+            while len(buf) < n:
+                h = hashlib.sha256(h.digest() + bytes([ci]))
+                buf += h.digest()
+            c.send_stream(sids[ci], buf[:n], fin=True)
+            sids[ci] += 4
+            for dg in c.flush():
+                batch.append((dg, addr))
+        sent_txns = dgrams
+        base_txns = sink.n
+        if not native:
+            aes._NATIVE = False  # pure-Python lane for the timed region
+        try:
+            t0 = time.perf_counter()
+            for dg, addr in batch:
+                st._on_datagram(dg, addr)
+            st.after_credit()
+            elapsed = time.perf_counter() - t0
+        finally:
+            aes._NATIVE = None  # back to env-resolved on next call
+        delivered = sink.n - base_txns
+        st.close()
+        if delivered != sent_txns:
+            print(f"# net A/B quic window delivered {delivered}/"
+                  f"{sent_txns} txns", file=sys.stderr)
+        return {"v": round(elapsed * 1e6 / max(len(batch), 1), 3),
+                "datagrams": len(batch), "txns": delivered,
+                "native": native}
+    finally:
+        _net_env_restore(prev)
+
+
+def _net_udp_window(native: bool, pkts: int = 512,
+                    payload: int = 900) -> dict:
+    """One UDP-flavor ingress window over a real localhost socket: send
+    rx_burst-sized chunks, time only the after_credit drains (native
+    recvmmsg-style sweep vs one recvfrom per datagram)."""
+    import socket as _socket
+
+    from firedancer_tpu.runtime.net import UdpIngressStage
+
+    prev = _net_env(native)
+    tx = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
+    try:
+        sink = _NetSink()
+        st = UdpIngressStage("udp", outs=[sink], rx_burst=64)
+        assert (st._net_client is not None) == native
+        addr = st.addr
+        data = b"\xA5" * payload
+        elapsed = 0.0
+        got0 = st.metrics.get("pkt_rx") or 0
+        sent = 0
+        while sent < pkts:
+            chunk = min(st.rx_burst, pkts - sent)
+            for _ in range(chunk):
+                tx.sendto(data, addr)
+            sent += chunk
+            deadline = time.monotonic() + 1.0
+            while ((st.metrics.get("pkt_rx") or 0) - got0 < sent
+                   and time.monotonic() < deadline):
+                t0 = time.perf_counter()
+                st.after_credit()
+                elapsed += time.perf_counter() - t0
+        got = (st.metrics.get("pkt_rx") or 0) - got0
+        st.close()
+        if got != pkts:
+            print(f"# net A/B udp window drained {got}/{pkts} pkts",
+                  file=sys.stderr)
+        return {"v": round(elapsed * 1e6 / max(got, 1), 3),
+                "datagrams": got, "native": native}
+    finally:
+        tx.close()
+        _net_env_restore(prev)
+
+
+def run_net_ab(pairs: int = 3, out_path: str | None = None) -> dict:
+    """The ISSUE 18 acceptance artifact: interleaved same-box A/B of the
+    native net sweep client, both ingress flavors — QUIC short-header
+    steady state (DCID lookup + HP unmask + GCM open + frame walk +
+    reasm in one FFI crossing, vs the per-datagram pure-Python lane) and
+    plain UDP (batched sweep vs recvfrom loop).  Per-pair deltas +
+    median-of-pairs in ingress µs/datagram, split from client seal and
+    downstream compute.  Writes BENCH_r13_net_ab.json (or
+    FDTPU_BENCH_NET_AB_PATH)."""
+    from firedancer_tpu.runtime import net_native
+
+    _require_ab_pairs(pairs, "net ingress-lane A/B")
+    if not net_native.available():
+        print("# native net client unavailable: no A/B to run",
+              file=sys.stderr)
+        return {"net_ab_unavailable": True}
+    q_ons, q_offs, u_ons, u_offs = [], [], [], []
+    _net_quic_window(True, clients=1, dgrams=24)  # warm both .so paths
+    for i in range(pairs):
+        print(f"# net A/B pair {i + 1}/{pairs}", file=sys.stderr)
+        order = (True, False) if i % 2 == 0 else (False, True)
+        for on in order:
+            (q_ons if on else q_offs).append(_net_quic_window(on))
+            (u_ons if on else u_offs).append(_net_udp_window(on))
+    quic_ab = ab_summary(q_ons, q_offs, "v")
+    udp_ab = ab_summary(u_ons, u_offs, "v")
+    out = {
+        "pairs": pairs,
+        "quic_ingress_us_per_datagram": quic_ab,
+        "udp_ingress_us_per_datagram": udp_ab,
+        "quic_speedup_median": round(
+            quic_ab["off_median"] / max(quic_ab["on_median"], 1e-9), 2),
+        "udp_speedup_median": round(
+            udp_ab["off_median"] / max(udp_ab["on_median"], 1e-9), 2),
+        "quic_windows_on": q_ons,
+        "quic_windows_off": q_offs,
+        "udp_windows_on": u_ons,
+        "udp_windows_off": u_offs,
+        "native_simd": net_native.simd_features(),
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+    }
+    path = out_path or os.environ.get("FDTPU_BENCH_NET_AB_PATH",
+                                      "BENCH_r13_net_ab.json")
+    try:
+        with open(path, "w") as fh:
+            json.dump(out, fh, indent=1)
+        print(f"# net A/B artifact -> {path}", file=sys.stderr)
+    except OSError as e:
+        print(f"# net A/B artifact write failed: {e}", file=sys.stderr)
+    return out
+
+
 def run_verify_ab(pairs: int = 3, out_path: str | None = None) -> dict:
     """The ISSUE 13 host acceptance artifact: interleaved same-box A/B
     of the native verify sweep lane — per pair, one all-native window
@@ -1687,6 +1891,12 @@ def main() -> None:
         if "--real" not in sys.argv:
             force_cpu_backend()
         print(json.dumps(run_kernel_ladder(), indent=1))
+        return
+    if "--net-ab" in sys.argv:
+        i = sys.argv.index("--net-ab")
+        n = int(sys.argv[i + 1]) if len(sys.argv) > i + 1 \
+            and sys.argv[i + 1].isdigit() else 3
+        print(json.dumps(run_net_ab(pairs=n), indent=1))
         return
     if "--verify-ab" in sys.argv:
         i = sys.argv.index("--verify-ab")

@@ -134,6 +134,21 @@ _HOT_HOOKS = frozenset({
 })
 _FD215_BLOCKING_ATTRS = frozenset({"wait", "join", "acquire"})
 
+# FD217: per-datagram Python crypto / recvfrom in the ingress hot path
+# of a net module that REGISTERS a native sweep client — the
+# `self._net_client` / `self._sweep_client` assignment is the gate, so
+# a module that never arms the lane keeps its Python receive loop
+# un-flagged.  Scope is LEXICAL: the flagged calls may live only in the
+# _py_* punt helpers the hot path falls back to, never in a frag
+# callback, a loop hook, or _on_datagram itself.
+_NET_PATH_FILES = frozenset({"net.py", "net_native.py"})
+_FD217_INGRESS_CBS = frozenset({"_on_datagram"})
+_FD217_CRYPTO_NAMES = frozenset({
+    "_ghash", "ghash", "_ghash_mul", "ghash_mul", "encrypt_block",
+    "seal_packet", "open_packet", "_hp_mask", "hp_mask",
+})
+_FD217_SWEEP_ATTRS = frozenset({"_net_client", "_sweep_client"})
+
 
 def _fd208_offender(arg: ast.AST) -> str | None:
     """Why `arg` allocates/formats, or None if it looks scalar-cheap."""
@@ -248,6 +263,24 @@ def _import_aliases(tree: ast.Module):
     return mods, funcs
 
 
+def _registers_sweep_client(tree: ast.Module) -> bool:
+    """FD217's gate: does this module assign a native sweep client
+    (`self._net_client = ...` / `self._sweep_client = ...`) anywhere in
+    a class body's subtree?"""
+    for node in ast.walk(tree):
+        targets: tuple | list = ()
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            targets = (node.target,)
+        for t in targets:
+            d = _dotted(t)
+            if d is not None and len(d) == 2 and d[0] == "self" \
+                    and d[1] in _FD217_SWEEP_ATTRS:
+                return True
+    return False
+
+
 def _local_defs(fn: ast.AST) -> set[str]:
     """Function names bound in fn's OWN scope: descend into compound
     statements (if/for/try/with) but not into nested class or function
@@ -267,11 +300,12 @@ def _local_defs(fn: ast.AST) -> set[str]:
 
 class _Linter(ast.NodeVisitor):
     def __init__(self, path: str, mods=None, funcs=None, nmods=None,
-                 nfuncs=None, cmods=None, cfuncs=None):
+                 nfuncs=None, cmods=None, cfuncs=None, net_gate=False):
         self.path = path
         self.findings: list[Finding] = []
         self._frag_depth = 0  # >0 while inside a frag-callback body
         self._hook_depth = 0  # >0 inside a loop hook (FD215 scope)
+        self._ncb_depth = 0  # >0 inside _on_datagram (FD217 scope)
         self._func_stack: list[ast.FunctionDef] = []
         self._mods = mods or {}  # import alias -> canonical module
         self._funcs = funcs or {}  # from-imported name -> (module, func)
@@ -299,6 +333,10 @@ class _Linter(ast.NodeVisitor):
         # FD216 scope: the bank-path modules — their frag callbacks are
         # the commit hot path and consume pre-parsed verified frags
         self._bank_scope = bool(parts) and parts[-1] in _BANK_PATH_FILES
+        # FD217 scope: net ingress modules, gated on the module actually
+        # registering a native sweep client (net_gate from the prescan)
+        self._net_scope = net_gate and bool(parts) \
+            and parts[-1] in _NET_PATH_FILES
         # FD214 scope: verify-path modules; the class/method context is
         # tracked below (verify-stage classes only, reap methods exempt)
         self._verify_scope = bool(parts) and parts[-1] in _FD214_FILES
@@ -350,6 +388,7 @@ class _Linter(ast.NodeVisitor):
     def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
         is_frag = node.name in FRAG_CALLBACKS and self._in_class()
         is_hook = node.name in _HOT_HOOKS and self._in_class()
+        is_ncb = node.name in _FD217_INGRESS_CBS and self._in_class()
         # FD214 method attribution: a def directly inside a verify-stage
         # class opens a method scope; nested defs inherit it
         opens_method = (
@@ -363,11 +402,15 @@ class _Linter(ast.NodeVisitor):
             self._frag_depth += 1
         if is_hook:
             self._hook_depth += 1
+        if is_ncb:
+            self._ncb_depth += 1
         self.generic_visit(node)
         if is_frag:
             self._frag_depth -= 1
         if is_hook:
             self._hook_depth -= 1
+        if is_ncb:
+            self._ncb_depth -= 1
         self._func_stack.pop()
         if opens_method:
             self._fd214_method.pop()
@@ -406,6 +449,9 @@ class _Linter(ast.NodeVisitor):
             self._check_frag_call(node, mf)
         if self._frag_depth or self._hook_depth:
             self._check_fd215(node, mf)
+        if self._net_scope and (self._frag_depth or self._hook_depth
+                                or self._ncb_depth):
+            self._check_fd217(node)
         self._check_fd214(node, mf)
         if mf and mf[0] == "random" and mf[1] in _RANDOM_GLOBALS:
             self.hit("FD203", node,
@@ -449,6 +495,46 @@ class _Linter(ast.NodeVisitor):
                      " zero-arg wait/join/acquire blocks the stage loop"
                      " indefinitely — bound it and move it off the hot"
                      " loop (the slot clock is the deadline authority)")
+
+    def _check_fd217(self, node: ast.Call) -> None:
+        """FD217: per-datagram Python crypto / recvfrom in the ingress
+        hot path (frag callback, loop hook, or _on_datagram) of a net
+        module that registers a native sweep client.  The native lane
+        owns the short-header steady state in one FFI crossing; these
+        calls belong only in the _py_* punt helpers it falls back to.
+
+        Shapes: `.recvfrom(...)` (the per-datagram syscall the batched
+        sweep replaces), `.seal(iv, ...)` / `.open(iv, ct, tag, ...)`
+        (the AesGcm surface — the arg-count floors keep builtin
+        file-open and zero-arg seals out), and the bare/dotted crypto
+        primitives (GHASH, AES block, HP mask, packet seal/open)."""
+        if isinstance(node.func, ast.Attribute):
+            a = node.func.attr
+            if a == "recvfrom":
+                self.hit("FD217", node,
+                         "per-datagram recvfrom in an ingress hot path"
+                         " with a native sweep client registered: the"
+                         " batched native sweep owns the socket drain —"
+                         " keep the recvfrom loop in the _py_* fallback"
+                         " lane")
+                return
+            if (a == "seal" and len(node.args) >= 1) \
+                    or (a == "open" and len(node.args) >= 3):
+                self.hit("FD217", node,
+                         f"per-datagram Python AES-GCM .{a}() in an"
+                         " ingress hot path with a native sweep client"
+                         " registered: short-header crypto belongs to"
+                         " the one-crossing native lane (fd_net); keep"
+                         " Python crypto in the _py_* punt lane")
+                return
+        fq = _dotted(node.func)
+        if fq is not None and fq[-1] in _FD217_CRYPTO_NAMES:
+            self.hit("FD217", node,
+                     f"per-datagram Python crypto '{'.'.join(fq)}' in an"
+                     " ingress hot path with a native sweep client"
+                     " registered: GHASH/AES-block/HP-mask per datagram"
+                     " re-serializes ingress to the pure-Python rate —"
+                     " the native lane does this in one crossing")
 
     def _check_fd214(self, node: ast.Call,
                      mf: tuple[str, str] | None) -> None:
@@ -784,7 +870,8 @@ def lint_source(source: str, path: str) -> list[Finding]:
                         msg=f"file does not parse: {e.msg}")]
     mods, funcs = _import_aliases(tree)
     nmods, nfuncs, cmods, cfuncs = _native_imports(tree)
-    linter = _Linter(path, mods, funcs, nmods, nfuncs, cmods, cfuncs)
+    linter = _Linter(path, mods, funcs, nmods, nfuncs, cmods, cfuncs,
+                     net_gate=_registers_sweep_client(tree))
     linter.visit(tree)
     disabled = _disabled_lines(source)
     for f in linter.findings:

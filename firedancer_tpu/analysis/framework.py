@@ -234,6 +234,19 @@ FD216 = _rule(
     " pure duplicate work on the hottest path (the native sweep reads the"
     " same descriptor bytes in C)",
 )
+FD217 = _rule(
+    "FD217", "python-crypto-in-ingress-frag", SEV_ERROR,
+    "per-datagram Python crypto (AES-GCM seal/open, GHASH, AES block"
+    " encrypt, header-protection mask, packet seal/open) or a per-datagram"
+    " recvfrom inside an ingress frag callback / loop hook / _on_datagram"
+    " of a net module that registers a native sweep client: the short-"
+    " header steady state belongs to the one-crossing native lane"
+    " (fd_net's DCID lookup + HP unmask + GCM open + frame walk), and the"
+    " socket drains through the batched sweep — per-datagram Python"
+    " crypto or recvfrom there silently re-serializes ingress to the"
+    " pure-Python rate; keep it in the _py_* punt lane the native client"
+    " falls back to",
+)
 
 # -- race/crash-domain rules (FD4xx): ring discipline + restart safety ------
 #

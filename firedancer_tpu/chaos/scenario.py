@@ -247,10 +247,26 @@ def run_connection_storm(seed: int = 0, duration: float = 20.0, *,
             "txns_expected": len(pop.honest_payloads),
             "delivered_digest": inv.payload_digest(received),
             "retry_tx": retries if not loss_p else None,
+            # the native lane's deterministic facts only: armed-or-not
+            # and how many established conns moved onto the fast path
+            # (raw rx counters ride timers, so they live in the failure
+            # ledger, not the replay-diffed summary)
+            "net_native": stage._net_client is not None,
+            "net_conn_exported": stage.metrics.get("net_conn_exported"),
         }
         if amplification_probe:
             info["amplification_capped"] = _amplification_probe(
                 suite, seed, identity, min(duration / 4, 3.0))
+        # captured BEFORE close (net counters die with the client): the
+        # per-address byte ledger + native counters the failure artifact
+        # pairs with the flight dump
+        ledger = {
+            "rx_bytes": {f"{a[0]}:{a[1]}": v
+                         for a, v in sorted(pop.rx_bytes.items())},
+            "tx_bytes": {f"{a[0]}:{a[1]}": v
+                         for a, v in sorted(stage.sock.tx_bytes.items())},
+            "net_counters": stage.net_counters(),
+        }
     finally:
         stage.close()
         link.close()
@@ -258,6 +274,10 @@ def run_connection_storm(seed: int = 0, duration: float = 20.0, *,
     result = ScenarioResult("connection-storm", seed, suite, info)
     if not suite.ok:
         _capture_coop_failure(result, [stage])
+        lpath = _artifact_base(result.scenario, seed) + "_ledger.json"
+        with open(lpath, "w") as f:
+            json.dump(ledger, f, indent=1)
+        result.artifacts.append(lpath)
     return result
 
 

@@ -12,6 +12,32 @@ the honest shape).
 
 from __future__ import annotations
 
+import os as _os
+
+# Native delegation (ISSUE 18): native/fd_net.cpp carries a byte-identical
+# AES/GCM (AES-NI + PCLMUL, scalar fallback) proven against this module by
+# the seeded fuzz in tests/test_net_native.py; when the .so is buildable
+# every seal/open/encrypt_block routes through it.  FDTPU_NATIVE_AES=0
+# pins the pure-Python path (the bench OFF lane, and the ground truth the
+# differential suites diff against).
+_NATIVE = None  # None = unresolved, False = unavailable, module = ready
+
+
+def _native():
+    global _NATIVE
+    if _NATIVE is None:
+        _NATIVE = False
+        if _os.environ.get("FDTPU_NATIVE_AES", "1") != "0":
+            try:
+                from firedancer_tpu.runtime import net_native as _nn
+
+                _nn.simd_features()  # forces the .so build + load
+                _NATIVE = _nn
+            except (ImportError, OSError, AttributeError, RuntimeError):
+                _NATIVE = False
+    return _NATIVE
+
+
 # FIPS-197 S-box (public standard constant)
 _SBOX = bytes.fromhex(
     "637c777bf26b6fc53001672bfed7ab76ca82c97dfa5947f0add4a2af9ca472c0"
@@ -77,11 +103,15 @@ def _encrypt_block(rks: list[bytes], block: bytes) -> bytes:
 
 class Aes:
     def __init__(self, key: bytes):
-        self._rks = _expand_key(key)
+        self._rks = _expand_key(key)  # also validates the key length
+        self._key = bytes(key)
 
     def encrypt_block(self, block: bytes) -> bytes:
         if len(block) != 16:
             raise ValueError("AES block is 16 bytes")
+        nn = _native()
+        if nn:
+            return nn.aes_ecb_blocks(self._key, block)
         return _encrypt_block(self._rks, block)
 
 
@@ -133,6 +163,9 @@ class AesGcm:
         """-> (ciphertext, 16-byte tag)."""
         if len(iv) != 12:
             raise ValueError("GCM IV must be 96 bits (the QUIC form)")
+        nn = _native()
+        if nn:
+            return nn.gcm_seal(self._aes._key, iv, plaintext, aad)
         j0 = iv + b"\x00\x00\x00\x01"
         ct = self._ctr(j0, plaintext)
         s = self._ghash(aad, ct)
@@ -143,6 +176,9 @@ class AesGcm:
         """-> plaintext, or None on authentication failure."""
         if len(iv) != 12 or len(tag) != 16:
             return None
+        nn = _native()
+        if nn:
+            return nn.gcm_open(self._aes._key, iv, ciphertext, tag, aad)
         j0 = iv + b"\x00\x00\x00\x01"
         s = self._ghash(aad, ciphertext)
         expect = (int.from_bytes(self._aes.encrypt_block(j0), "big") ^ s).to_bytes(

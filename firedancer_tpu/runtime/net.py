@@ -12,6 +12,14 @@ Nonblocking: each loop iteration drains up to `rx_burst` datagrams into
 the out link (credits permitting), so the cooperative scheduler never
 stalls on an idle socket.  Oversized datagrams (> TXN_MTU) are dropped
 and counted, mirroring fd_quic's MTU policy.
+
+Native net lane (ISSUE 18): with `FDTPU_NATIVE_NET` on and the toolchain
+present, plain-UDP intake runs as a recvmmsg-style batched sweep in
+native/fd_net.cpp (one FFI crossing per burst) and QuicIngressStage
+routes every datagram through the native QUIC short-header fast path
+first — whatever the C side cannot fully own PUNTs back to the Python
+lane below in arrival order, so waltz/quic.py stays the single source of
+truth for the control plane.
 """
 
 from __future__ import annotations
@@ -21,10 +29,19 @@ import os
 import socket
 
 from firedancer_tpu.protocol.txn import TXN_MTU
+from firedancer_tpu.utils.nativebuild import NativeUnavailable
+from . import net_native
 from .stage import Stage
 
 
 class UdpIngressStage(Stage):
+    # the native recvmmsg sweep bypasses _on_datagram entirely, so only
+    # the class whose per-datagram handling IS "publish the raw bytes"
+    # may take it; framed subclasses keep the Python receive loop and
+    # hook the native lane at their own seam (QuicIngressStage) or not
+    # at all (StreamIngressStage)
+    _NATIVE_UDP = True
+
     def __init__(
         self,
         *args,
@@ -41,6 +58,13 @@ class UdpIngressStage(Stage):
         sock.setblocking(False)
         self.sock = sock
         self.rx_burst = rx_burst
+        self._net_client = None
+        if self._NATIVE_UDP and net_native.available():
+            try:
+                self._net_client = net_native.NetClient(
+                    max_conns=1, reasm_depth=1)
+            except NativeUnavailable:
+                self._net_client = None
 
     @property
     def addr(self) -> tuple[str, int]:
@@ -49,6 +73,14 @@ class UdpIngressStage(Stage):
     def after_credit(self) -> None:
         """One receive loop for every ingress flavor; subclasses override
         only the per-datagram handling (_on_datagram)."""
+        if (self._NATIVE_UDP and self._net_client is not None
+                and isinstance(self.sock, socket.socket)):
+            self._native_udp_sweep()
+            return
+        self._py_recv_loop()
+
+    def _py_recv_loop(self) -> None:
+        """The Python fallback lane: one recvfrom per datagram."""
         for _ in range(self.rx_burst):
             try:
                 data, src = self.sock.recvfrom(2048)
@@ -60,6 +92,31 @@ class UdpIngressStage(Stage):
                 raise
             if not self._on_datagram(data, src):
                 return  # backpressured: stop draining the socket
+
+    def _native_udp_sweep(self) -> None:
+        """Batched intake: one crossing drains the socket into the C out
+        arena, one burst publishes it.  The credit-gated tail stays
+        queued on the native side — never dropped."""
+        nc = self._net_client
+        oi = net_native.COUNTER_IDX["oversz"]
+        before = int(nc.counters_view[oi])
+        nc.udp_sweep(self.sock.fileno(), self.rx_burst)
+        oversz = int(nc.counters_view[oi]) - before
+        if oversz:
+            self.metrics.inc("oversize_drop", oversz)
+        n = nc.out_count()
+        if not n:
+            return
+        # sig mirrors the Python lane's running pkt_rx sequence; the
+        # arithmetic keeps a retried tail's sigs stable across sweeps
+        base = self.metrics.get("pkt_rx")
+        items = [(nc.out_txn(i), base + 1 + i, 0) for i in range(n)]
+        done = self.publish_burst_out(0, items)
+        nc.out_pop(done)
+        if done:
+            self.metrics.inc("pkt_rx", done)
+        if done < n:
+            self.metrics.inc("pkt_drop_backpressure", n - done)
 
     def _on_datagram(self, data: bytes, src) -> bool:
         """Handle one datagram; False = stop the burst (backpressure)."""
@@ -73,6 +130,9 @@ class UdpIngressStage(Stage):
         return True
 
     def close(self) -> None:
+        if self._net_client is not None:
+            self._net_client.close()
+            self._net_client = None
         self.sock.close()
 
 
@@ -114,6 +174,8 @@ class StreamIngressStage(UdpIngressStage):
     txns publish downstream.  One-frame streams take the fast path
     through the same slot logic.
     """
+
+    _NATIVE_UDP = False  # frames need the per-datagram parse below
 
     def __init__(self, *args, reasm_depth: int = 64, **kwargs):
         super().__init__(*args, **kwargs)
@@ -182,6 +244,8 @@ class QuicIngressStage(UdpIngressStage):
     sign stage holds it; QUIC cert self-signing is the one role fd_tls
     keeps near the socket)."""
 
+    _NATIVE_UDP = False  # the native seam is the QUIC datagram path
+
     def __init__(self, *args, identity_secret: bytes, reasm_depth: int = 64,
                  max_conns: int = 64, tx_filter=None, retry: bool = False,
                  **kwargs):
@@ -212,6 +276,21 @@ class QuicIngressStage(UdpIngressStage):
         # sent us (tracked only pre-handshake; validated addrs drop out)
         # src -> [rx_bytes, tx_bytes, created_monotonic_s]
         self._addr_budget: dict = {}
+        # native net lane (ISSUE 18): established conns export their rx
+        # application keys into the C table; short-header steady-state
+        # datagrams then never touch Python crypto.  The event drain
+        # keeps the Python Connection authoritative (tracker, acks, rx
+        # windows) so the control plane and every PUNT stay correct.
+        self._addr_ids: dict = {}     # src -> interned u32 addr id
+        self._native_idx: dict = {}   # local cid bytes -> native idx
+        self._by_idx: dict = {}       # native idx -> Connection
+        self._native_src: dict = {}   # native idx -> current home addr
+        if net_native.available():
+            try:
+                self._net_client = net_native.NetClient(
+                    max_conns=max_conns, reasm_depth=reasm_depth)
+            except NativeUnavailable:
+                self._net_client = None
 
     def _send(self, dg: bytes, dst) -> None:
         if self.tx_filter is not None and not self.tx_filter(dg):
@@ -230,6 +309,11 @@ class QuicIngressStage(UdpIngressStage):
         self.sock.sendto(dg, dst)
 
     def after_credit(self) -> None:
+        if self._net_client is not None:
+            # retry the credit-gated native txn tail before taking more
+            # off the socket — queued-never-dropped needs a drain point
+            # that does not depend on further ingress
+            self._flush_native_txns()
         super().after_credit()
         # loss-recovery housekeeping: fire PTO retransmissions even when
         # the socket is quiet (a lost server flight must not deadlock the
@@ -240,6 +324,197 @@ class QuicIngressStage(UdpIngressStage):
                 self._send(dg, src)
 
     def _on_datagram(self, data: bytes, src) -> bool:
+        """Native-first dispatch: the C fast path either fully consumes
+        the datagram (short header, known conn, consumable frame mix),
+        drops it (auth/flow/frame violations — byte-for-byte the Python
+        lane's verdict), or PUNTs it to the Python lane below in arrival
+        order."""
+        nc = self._net_client
+        if nc is None:
+            return self._py_datagram(data, src)
+        rc = nc.datagram(data, self._intern_addr(src))
+        if rc == net_native.RC_CONSUMED:
+            self.metrics.inc("pkt_rx")
+            return self._drain_native(src)
+        if rc == net_native.RC_DROP:
+            self._drain_native(src)
+            self.metrics.inc("bad_packet")
+            return True
+        return self._punt(data, src)
+
+    def _intern_addr(self, src) -> int:
+        aid = self._addr_ids.get(src)
+        if aid is None:
+            aid = len(self._addr_ids) + 1
+            self._addr_ids[src] = aid
+        return aid
+
+    def _punt(self, data: bytes, src) -> bool:
+        """Python-lane handling for a datagram the native side declined,
+        then state re-sync: pns/windows/address the Python conn just
+        advanced push back down so the C table never goes stale."""
+        from firedancer_tpu.waltz import quic
+
+        conn = self.conns.get(src)
+        prev = None
+        if conn is not None:
+            idx = self._native_idx.get(bytes(conn.local_cid))
+            if idx is not None:
+                prev = (conn, idx,
+                        [(int(r[0]), int(r[1]))
+                         for r in conn.recv[quic.APPLICATION].ranges])
+        ok = self._py_datagram(data, src)
+        if prev is not None:
+            self._sync_after_punt(*prev, src)
+        else:
+            self._maybe_export(src)
+        return ok
+
+    def _maybe_export(self, src) -> None:
+        """Install a newly-established conn's rx side into the native
+        table (or re-home an already-exported conn after migration)."""
+        from firedancer_tpu.waltz import quic
+
+        nc = self._net_client
+        conn = self.conns.get(src)
+        if nc is None or conn is None or not conn.established:
+            return
+        cid = bytes(conn.local_cid)
+        idx = self._native_idx.get(cid)
+        if idx is not None:
+            if self._native_src.get(idx) != src:
+                nc.conn_set_addr(idx, self._intern_addr(src))
+                self._native_src[idx] = src
+            return
+        keys = quic.export_rx_app_keys(conn)
+        if keys is None:
+            return
+        key, iv, hp = keys
+        ranges = [(int(lo), int(hi))
+                  for lo, hi in conn.recv[quic.APPLICATION].ranges]
+        idx = nc.conn_add(cid, self._intern_addr(src), key, iv, hp,
+                          ranges, conn.rx_max_data, conn.rx_data_total)
+        if idx >= 0:
+            self._native_idx[cid] = idx
+            self._by_idx[idx] = conn
+            self._native_src[idx] = src
+            self.metrics.inc("net_conn_exported")
+
+    def _sync_after_punt(self, conn, idx: int, old_ranges, src) -> None:
+        from firedancer_tpu.waltz import quic
+
+        nc = self._net_client
+        if conn.closed:
+            self._native_remove(conn)
+            return
+        # pns the Python lane just admitted (at most the packets of one
+        # datagram) feed the native dedup window
+        for lo, hi in ((int(r[0]), int(r[1]))
+                       for r in conn.recv[quic.APPLICATION].ranges):
+            cur = lo
+            for olo, ohi in old_ranges:
+                if ohi < cur or olo > hi:
+                    continue
+                for pn in range(cur, min(olo - 1, hi) + 1):
+                    nc.conn_pn_add(idx, pn)
+                cur = max(cur, ohi + 1)
+                if cur > hi:
+                    break
+            for pn in range(cur, hi + 1):
+                nc.conn_pn_add(idx, pn)
+        nc.conn_window(idx, conn.rx_max_data, conn.rx_data_total)
+        if self.conns.get(src) is conn and self._native_src.get(idx) != src:
+            nc.conn_set_addr(idx, self._intern_addr(src))  # migrated
+            self._native_src[idx] = src
+
+    def _native_remove(self, conn) -> None:
+        idx = self._native_idx.pop(bytes(conn.local_cid), None)
+        if idx is not None:
+            self._net_client.conn_remove(idx)
+            self._by_idx.pop(idx, None)
+            self._native_src.pop(idx, None)
+
+    def _drain_native(self, src) -> bool:
+        """Replay the C side's events into the authoritative Python
+        conns (tracker/ack/rtt/window state), publish completed txns
+        (credit-gated; the tail stays queued native-side), and flush the
+        per-conn ACK responses exactly as the Python lane would."""
+        import time as _t
+
+        from firedancer_tpu.waltz import quic
+
+        nc = self._net_client
+        now = _t.monotonic()
+        nev = nc.event_count()
+        ev = nc.events
+        touched = set()
+        for i in range(nev):
+            idx = int(ev[i, 1])
+            conn = self._by_idx.get(idx)
+            if conn is None:
+                continue
+            typ = int(ev[i, 0])
+            a = int(ev[i, 2])
+            b = int(ev[i, 3])
+            if typ == net_native.EV_PKT:
+                conn._processed_any = True
+                if b != 1:  # dup re-acks only, never re-adds
+                    conn.recv[quic.APPLICATION].add(a)
+                if b in (0, 1):  # ack-eliciting or dup
+                    conn.ack_pending.add(quic.APPLICATION)
+                touched.add(idx)
+            elif typ == net_native.EV_ACK:
+                conn._on_ack(quic.APPLICATION, [(a - b, a)], now)
+                touched.add(idx)
+            elif typ == net_native.EV_WIN:
+                conn.rx_consumed += a
+                conn.rx_data_total += b
+                if conn.rx_consumed * 2 > conn.rx_max_data:
+                    # _rx_window_updates' MAX_DATA advertisement, pushed
+                    # back down so the native flow check tracks it
+                    conn.rx_max_data = (conn.rx_consumed
+                                        + quic.DEFAULT_MAX_DATA)
+                    conn.ctrl_out.append(
+                        bytes([quic.FT_MAX_DATA])
+                        + quic.varint_encode(conn.rx_max_data))
+                    nc.conn_window(idx, conn.rx_max_data,
+                                   conn.rx_data_total)
+                touched.add(idx)
+        if nev:
+            nc.events_clear()
+        ok = self._flush_native_txns()
+        for idx in touched:
+            conn = self._by_idx.get(idx)
+            if conn is None:
+                continue
+            home = self._native_src.get(idx, src)
+            for dg in conn.flush():
+                self._send(dg, home)
+        return ok
+
+    def _flush_native_txns(self) -> bool:
+        nc = self._net_client
+        n = nc.out_count()
+        if not n:
+            return True
+        base = self.metrics.get("txn_rx")
+        items = [(nc.out_txn(i), base + 1 + i, 0) for i in range(n)]
+        done = self.publish_burst_out(0, items)
+        nc.out_pop(done)
+        if done:
+            self.metrics.inc("txn_rx", done)
+        if done < n:
+            self.metrics.inc("txn_drop_backpressure", n - done)
+            return False
+        return True
+
+    def net_counters(self) -> dict:
+        """The native lane's counter block ({} on the Python lane) —
+        storm summaries and bench read it without touching the FFI."""
+        nc = self._net_client
+        return nc.counters() if nc is not None else {}
+
+    def _py_datagram(self, data: bytes, src) -> bool:
         from firedancer_tpu.waltz import quic, tls13
 
         conn = self.conns.get(src)
@@ -410,6 +685,8 @@ class QuicIngressStage(UdpIngressStage):
         for src, conn in list(self.conns.items()):
             if conn.closed or not conn.established:
                 del self.conns[src]
+                if self._net_client is not None:
+                    self._native_remove(conn)
                 self.metrics.inc("conn_evict")
                 return True
         return False

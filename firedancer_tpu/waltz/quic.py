@@ -167,6 +167,23 @@ def _hp_mask(hp: Aes, sample: bytes) -> bytes:
     return hp.encrypt_block(sample)
 
 
+def export_rx_app_keys(conn: "Connection") -> tuple[bytes, bytes, bytes] | None:
+    """Raw (key, iv, hp) bytes of the connection's APPLICATION-level rx
+    side, re-derived from the TLS secret (Keys keeps only the schedule
+    objects, never the raw bytes).  The native net lane installs these
+    into its interned connection table; None until the handshake has
+    produced the application secrets."""
+    sec = conn.tls.secrets.get(APPLICATION)
+    if sec is None:
+        return None
+    s = sec[1] if conn.is_client else sec[0]
+    return (
+        hkdf_expand_label(s, "quic key", b"", 16),
+        hkdf_expand_label(s, "quic iv", b"", 12),
+        hkdf_expand_label(s, "quic hp", b"", 16),
+    )
+
+
 # -- packet sealing / opening -------------------------------------------------
 
 PN_LEN = 2  # fixed 2-byte encoded packet numbers (valid per §17.1)

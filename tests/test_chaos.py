@@ -152,11 +152,45 @@ def test_connection_storm_small_scale():
     """Tier-1 slice of the acceptance storm: the full >=1k population
     rides the slow matrix; the machinery (retry gate statelessness,
     budget audit, honest delivery through the gate) is identical."""
+    from firedancer_tpu.runtime import net_native
+
     r = cs.run_connection_storm(seed=11, duration=60, n_clients=48,
                                 n_honest=3)
     assert r.ok, r.suite.describe()
     assert r.info["retry_tx"] == r.info["storm"] + r.info["honest"]
     assert r.info["amplification_capped"] is True
+    # the native net lane (ISSUE 18): armed whenever the .so builds, and
+    # every established honest conn moved onto the fast path
+    assert r.info["net_native"] == net_native.available()
+    if r.info["net_native"]:
+        assert r.info["net_conn_exported"] == r.info["honest"]
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(1200)
+def test_connection_storm_10k_native():
+    """The ISSUE 18 acceptance storm: 10k concurrent clients against the
+    ingress with the native sweep client armed — RetryGate stays
+    stateless, the 3x anti-amplification ledger holds from the outside,
+    honest txns land exactly once over the native lane, and the
+    per-seed summary diffs clean across two full runs."""
+    r1 = cs.run_connection_storm(seed=7, duration=600, n_clients=10000,
+                                 n_honest=32)
+    assert r1.ok, r1.suite.describe()
+    checks = r1.summary()["checks"]
+    for name in ("retry-per-untokened-initial",
+                 "storm-allocates-no-connections",
+                 "amplification-budget-held",
+                 "honest-txns-delivered-exactly-once"):
+        assert checks[name], name
+    from firedancer_tpu.runtime import net_native
+
+    assert r1.info["net_native"] == net_native.available()
+    if r1.info["net_native"]:
+        assert r1.info["net_conn_exported"] == r1.info["honest"]
+    r2 = cs.run_connection_storm(seed=7, duration=600, n_clients=10000,
+                                 n_honest=32)
+    assert r1.summary() == r2.summary()
 
 
 def test_stage_kill_scenario_and_restart():

@@ -1162,3 +1162,89 @@ def test_fd216_registered_and_repo_clean():
     findings = [f for f in ast_rules.lint_path(root)
                 if f.rule == "FD216"]
     assert findings == [], findings
+
+
+# -- FD217: per-datagram Python crypto in ingress with a sweep client ---------
+
+
+_NET_CRYPTO_SRC = '''
+from firedancer_tpu.ops.aes import AesGcm
+from firedancer_tpu.waltz.quic import _hp_mask
+from . import net_native
+
+
+class IngressStage:
+    def __init__(self):
+        self._net_client = net_native.NetClient(max_conns=1, reasm_depth=1)
+        self._gcm = AesGcm(b"k" * 16)
+
+    def _on_datagram(self, data, src):
+        pt = self._gcm.open(data[:12], data[12:-16], data[-16:])  # FD217
+        mask = _hp_mask(b"h" * 16, data[:16])                     # FD217
+        return pt or mask
+
+    def after_credit(self):
+        data, src = self.sock.recvfrom(2048)                      # FD217
+        ct, tag = self._gcm.seal(b"\\x00" * 12, data)              # FD217
+        return ct, tag
+
+    def _py_datagram(self, data, src):
+        # the punt lane: the same calls are FD217-clean here
+        pt = self._gcm.open(data[:12], data[12:-16], data[-16:])
+        mask = _hp_mask(b"h" * 16, data[:16])
+        for _ in range(2):
+            data, src = self.sock.recvfrom(2048)
+        return pt or mask
+
+    def report(self, path):
+        with open(path) as fh:                    # builtin open: clean
+            return fh.read()
+'''
+
+
+def test_fd217_flags_ingress_crypto_with_sweep_client():
+    findings = ast_rules.lint_source(
+        _NET_CRYPTO_SRC, "firedancer_tpu/runtime/net.py")
+    hits = [f for f in findings if f.rule == "FD217"]
+    msgs = [f.msg for f in hits]
+    assert len(hits) == 4, msgs
+    assert sum(".open()" in m for m in msgs) == 1
+    assert sum(".seal()" in m for m in msgs) == 1
+    assert sum("recvfrom" in m for m in msgs) == 1
+    assert sum("_hp_mask" in m for m in msgs) == 1
+    # without the sweep-client registration the SAME hot-path calls are
+    # the module's legitimate Python lane — the gate must not fire
+    ungated = _NET_CRYPTO_SRC.replace(
+        "self._net_client = net_native.NetClient"
+        "(max_conns=1, reasm_depth=1)",
+        "self._net_client_off = None")
+    clean = [f for f in ast_rules.lint_source(
+        ungated, "firedancer_tpu/runtime/net.py") if f.rule == "FD217"]
+    assert clean == [], clean
+    # and outside the net modules the rule has no opinion at all
+    other = [f for f in ast_rules.lint_source(
+        _NET_CRYPTO_SRC, "firedancer_tpu/runtime/verify.py")
+        if f.rule == "FD217"]
+    assert other == [], other
+
+
+def test_fd217_suppressible_inline():
+    src = ("class S:\n"
+           "    def __init__(self):\n"
+           "        self._sweep_client = object()\n"
+           "    def _on_datagram(self, data, src):\n"
+           "        return self.gcm.open(data[:12], data[12:], b'')  "
+           "# fdlint: disable=FD217 -- bring-up shim\n")
+    findings = [f for f in ast_rules.lint_source(
+        src, "firedancer_tpu/runtime/net.py") if f.rule == "FD217"]
+    assert len(findings) == 1 and findings[0].suppressed == "inline"
+
+
+def test_fd217_registered_and_repo_clean():
+    assert "FD217" in {r.id for r in all_rules()}
+    # the ingress hot path honors the lane split: the repo's own net
+    # modules keep per-datagram Python crypto in the _py_* punt lane
+    root = os.path.join(os.path.dirname(__file__), "..", "firedancer_tpu")
+    findings = [f for f in ast_rules.lint_path(root)
+                if f.rule == "FD217"]
+    assert findings == [], findings

@@ -41,6 +41,7 @@ SAN_SUITES = (
     "test_verify_native.py",  # verify sweep client (fd_verify)
     "test_exec_native.py",    # executor fast lane (fd_exec_native)
     "test_bank_native.py",    # bank sweep client + result log (fd_bank)
+    "test_net_native.py",     # net sweep client + QUIC fast path (fd_net)
 )
 
 
