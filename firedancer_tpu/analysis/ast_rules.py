@@ -149,6 +149,20 @@ _FD217_CRYPTO_NAMES = frozenset({
 })
 _FD217_SWEEP_ATTRS = frozenset({"_net_client", "_sweep_client"})
 
+# FD218: per-record Python funk mutation in the bank commit hot path of
+# a module that ARMS the native funk lane — the `.set_funk(...)` call is
+# the gate, so a pure-Python bank keeps its funk writes un-flagged.
+# Once the lane is armed, the session commit writes records straight
+# into the shm map inside the fdr_sweep crossing and the sanctioned
+# host-side write is rec_insert_batch at burst granularity; a
+# per-record rec_insert/rec_remove (or a _root_merge / a
+# txn_recs_for_write dict materialization) in a frag callback or loop
+# hook re-pays a map probe + allocation per record on the hottest path.
+# rec_insert_batch itself is exempt by exact-name match.
+_FD218_FUNK_MUTATORS = frozenset({
+    "rec_insert", "rec_remove", "_root_merge", "txn_recs_for_write",
+})
+
 
 def _fd208_offender(arg: ast.AST) -> str | None:
     """Why `arg` allocates/formats, or None if it looks scalar-cheap."""
@@ -281,6 +295,20 @@ def _registers_sweep_client(tree: ast.Module) -> bool:
     return False
 
 
+def _registers_funk_client(tree: ast.Module) -> bool:
+    """FD218's gate: does this module arm the native funk lane — a
+    `<anything>.set_funk(...)` call anywhere in its subtree?  (The bank
+    stage's _arm_native does `self._sweep_client.set_funk(funk, xid)`;
+    a module that never arms the lane keeps its Python funk writes
+    un-flagged.)"""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "set_funk":
+            return True
+    return False
+
+
 def _local_defs(fn: ast.AST) -> set[str]:
     """Function names bound in fn's OWN scope: descend into compound
     statements (if/for/try/with) but not into nested class or function
@@ -300,7 +328,8 @@ def _local_defs(fn: ast.AST) -> set[str]:
 
 class _Linter(ast.NodeVisitor):
     def __init__(self, path: str, mods=None, funcs=None, nmods=None,
-                 nfuncs=None, cmods=None, cfuncs=None, net_gate=False):
+                 nfuncs=None, cmods=None, cfuncs=None, net_gate=False,
+                 funk_gate=False):
         self.path = path
         self.findings: list[Finding] = []
         self._frag_depth = 0  # >0 while inside a frag-callback body
@@ -337,6 +366,10 @@ class _Linter(ast.NodeVisitor):
         # registering a native sweep client (net_gate from the prescan)
         self._net_scope = net_gate and bool(parts) \
             and parts[-1] in _NET_PATH_FILES
+        # FD218 scope: bank-path modules, gated on the module actually
+        # arming the native funk lane (funk_gate from the prescan)
+        self._funk_scope = funk_gate and bool(parts) \
+            and parts[-1] in _BANK_PATH_FILES
         # FD214 scope: verify-path modules; the class/method context is
         # tracked below (verify-stage classes only, reap methods exempt)
         self._verify_scope = bool(parts) and parts[-1] in _FD214_FILES
@@ -452,6 +485,8 @@ class _Linter(ast.NodeVisitor):
         if self._net_scope and (self._frag_depth or self._hook_depth
                                 or self._ncb_depth):
             self._check_fd217(node)
+        if self._funk_scope and (self._frag_depth or self._hook_depth):
+            self._check_fd218(node)
         self._check_fd214(node, mf)
         if mf and mf[0] == "random" and mf[1] in _RANDOM_GLOBALS:
             self.hit("FD203", node,
@@ -535,6 +570,28 @@ class _Linter(ast.NodeVisitor):
                      " registered: GHASH/AES-block/HP-mask per datagram"
                      " re-serializes ingress to the pure-Python rate —"
                      " the native lane does this in one crossing")
+
+    def _check_fd218(self, node: ast.Call) -> None:
+        """FD218: per-record Python funk mutation in the bank commit hot
+        path (frag callback or loop hook) of a module that arms the
+        native funk lane.  With the lane armed, session commits write
+        records straight into the shm map inside the fdr_sweep crossing
+        and the only sanctioned host-side write is rec_insert_batch at
+        burst granularity — a per-record rec_insert/rec_remove, a
+        _root_merge, or a txn_recs_for_write dict materialization in a
+        frag re-pays a map probe + allocation per record right where the
+        native lane just removed it.  Matched by exact last component,
+        so rec_insert_batch never trips the rule."""
+        fq = _dotted(node.func)
+        if fq is not None and len(fq) >= 2 \
+                and fq[-1] in _FD218_FUNK_MUTATORS:
+            self.hit("FD218", node,
+                     f"per-record funk mutation '{'.'.join(fq)}' in a"
+                     " bank-path frag callback / loop hook with the"
+                     " native funk lane armed: committed records land in"
+                     " the shm map inside the fdr_sweep crossing — batch"
+                     " any host-side write through rec_insert_batch at"
+                     " burst granularity, never per record in a frag")
 
     def _check_fd214(self, node: ast.Call,
                      mf: tuple[str, str] | None) -> None:
@@ -871,7 +928,8 @@ def lint_source(source: str, path: str) -> list[Finding]:
     mods, funcs = _import_aliases(tree)
     nmods, nfuncs, cmods, cfuncs = _native_imports(tree)
     linter = _Linter(path, mods, funcs, nmods, nfuncs, cmods, cfuncs,
-                     net_gate=_registers_sweep_client(tree))
+                     net_gate=_registers_sweep_client(tree),
+                     funk_gate=_registers_funk_client(tree))
     linter.visit(tree)
     disabled = _disabled_lines(source)
     for f in linter.findings:

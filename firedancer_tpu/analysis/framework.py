@@ -247,6 +247,17 @@ FD217 = _rule(
     " pure-Python rate; keep it in the _py_* punt lane the native client"
     " falls back to",
 )
+FD218 = _rule(
+    "FD218", "python-funk-mutation-in-bank-frag", SEV_ERROR,
+    "per-record Python funk mutation (rec_insert/rec_remove, _root_merge,"
+    " txn_recs_for_write) inside a frag callback / loop hook of a"
+    " bank-path module that arms the native funk lane (set_funk): with"
+    " the lane armed, session commits write records straight into the"
+    " shm map inside the fdr_sweep crossing — a per-record Python write"
+    " there re-pays a map probe + allocation per record on the commit"
+    " hot path; batch host-side writes through rec_insert_batch at burst"
+    " granularity",
+)
 
 # -- race/crash-domain rules (FD4xx): ring discipline + restart safety ------
 #

@@ -17,7 +17,7 @@ from dataclasses import dataclass, field
 
 from firedancer_tpu.flamenco import types as T
 from firedancer_tpu.flamenco.executor import acct_decode, acct_encode
-from firedancer_tpu.funk import Funk
+from firedancer_tpu.funk import Funk, make_funk
 
 
 @dataclass
@@ -97,7 +97,7 @@ def genesis_boot(blob: bytes, funk: Funk | None = None) -> tuple[Funk, Genesis, 
     """Seed a funk root from genesis; -> (funk, genesis, genesis_hash).
     The boot path fddev takes before the first leader slot."""
     g = genesis_parse(blob)
-    funk = funk or Funk()
+    funk = funk or make_funk()
     for a in g.accounts:
         funk.rec_insert(
             None, a.pubkey,

@@ -371,9 +371,15 @@ class SlotExecution:
         self.status_cache = status_cache
         self.ancestors = ancestors
         # xid carries a nonce: competing blocks for the SAME slot off the
-        # same parent are distinct forks (consensus decides which publishes)
-        self.xid = b"slot:%d:%d:%s" % (slot, next(_xid_seq),
-                                       (parent_xid or b"root"))
+        # same parent are distinct forks (consensus decides which
+        # publishes).  The parent rides along as a digest, not verbatim —
+        # embedding the full parent xid grows the key by ~15 bytes per
+        # unpublished ancestor, and a partitioned fork chain blows past
+        # the native funk's FFK_XID_MAX (128) within a handful of slots.
+        self.xid = b"slot:%d:%d:%s" % (
+            slot, next(_xid_seq),
+            hashlib.sha256(parent_xid).hexdigest()[:24].encode()
+            if parent_xid else b"root")
         funk.txn_prepare(parent_xid, self.xid)
         self.sysvars = default_sysvars(slot)
         # durable nonces advance against the PARENT's bank hash: fresh,
@@ -418,7 +424,14 @@ class SlotExecution:
         self._native_dirty: set[bytes] = set()  # py-written since sync
         self._table_cache: dict = {}  # ALT decode, once per block
         self._before: dict[bytes, bytes | None] = {}  # start-of-slot view
+        # native shm funk: seal() reads before/after pairs from the fork
+        # overlay in one txn_diff crossing, so the per-write _before
+        # snapshot maintenance on the drain path is dead weight
+        self._funk_diff = hasattr(funk, "txn_diff")
         self.results: list[TxnResult] = []
+        # interned TxnResults for the sweep drain: a burst of landed
+        # transfers repeats a handful of (status, fee) pairs
+        self._txnres_cache: dict[tuple, TxnResult] = {}
         # native-lane accounting, read by the bank stage's metrics: txns
         # committed by the C++ lane vs. punted back to the Python lane
         self.native_done_cnt = 0
@@ -698,7 +711,7 @@ class SlotExecution:
             dirty = self._native_dirty
             for idx, val in writes:
                 a = payload[acct_off + 32 * idx : acct_off + 32 * (idx + 1)]
-                if a not in before:
+                if not self._funk_diff and a not in before:
                     before[a] = q(self.parent_xid, a)
                 self.funk.rec_insert(self.xid, a, val)
                 known.add(a)
@@ -726,6 +739,7 @@ class SlotExecution:
         block_seen = self._block_seen
         stage_insert = sc.stage_insert if sc is not None else None
         results = self.results
+        track_before = not self._funk_diff
         out = []
         sig_cnt = 0
         for payload, db, status, fee, writes in txns:
@@ -733,7 +747,7 @@ class SlotExecution:
                 acct_off = db[9] | (db[10] << 8)
                 for idx, val in writes:
                     a = payload[acct_off + 32 * idx:acct_off + 32 * (idx + 1)]
-                    if a not in before:
+                    if track_before and a not in before:
                         before[a] = q(pxid, a)
                     recs_d[a] = val if type(val) is bytes else bytes(val)
                     known.add(a)
@@ -753,6 +767,75 @@ class SlotExecution:
             out.append(r)
         self.signature_cnt += sig_cnt
         return out
+
+    def native_apply_group(self, frags, recs) -> tuple:
+        """One FULLY-published sweep group straight off the frag bytes —
+        semantically native_apply_batch over (frag[:psz], frag[psz:-2],
+        status, fee, writes) tuples, but the drain's published!=0 path
+        needs only the accounting, so the payload/descriptor slices are
+        never materialized.  With the native funk plane armed the record
+        stream arrives stripped (the values already live in the shm map)
+        and the only per-txn slices left are the bh/sig pair the status
+        cache keys on.  Returns (n_ok, n_fail, n_rej)."""
+        before = self._before
+        q = self.funk.rec_query
+        recs_d = self.funk.txn_recs_for_write(self.xid)
+        known = self._native_known
+        dirty = self._native_dirty
+        pxid = self.parent_xid
+        xid = self.xid
+        sc = self.status_cache
+        if sc is not None:
+            # stage_insert unrolled: the two per-xid structure probes
+            # hoist out of the loop (one staged batch per group)
+            staged_append = sc._staged[xid][1].append
+            staged_add = sc._staged_seen[xid].add
+        else:
+            staged_append = None
+        seen_add = self._block_seen.add
+        res_append = self.results.append
+        # landed transfers repeat the same (status, fee) almost every
+        # txn: intern the TxnResults (readers never mutate them — the
+        # dataclass exists to carry the pair out of the slot)
+        res_cache = self._txnres_cache
+        track_before = not self._funk_diff
+        n_ok = n_fail = n_rej = 0
+        sig_cnt = 0
+        for frag, (status, fee, writes) in zip(frags, recs):
+            psz = frag[-2] | (frag[-1] << 8)
+            if writes:
+                acct_off = frag[psz + 9] | (frag[psz + 10] << 8)
+                for idx, val in writes:
+                    a = frag[acct_off + 32 * idx : acct_off + 32 * (idx + 1)]
+                    if track_before and a not in before:
+                        before[a] = q(pxid, a)
+                    recs_d[a] = val if type(val) is bytes else bytes(val)
+                    known.add(a)
+                    dirty.discard(a)
+            if fee > 0:
+                n_ok += 1
+                if status != TXN_SUCCESS:
+                    n_fail += 1
+                sig_cnt += frag[psz + 1]
+                if staged_append is not None:
+                    sig_off = frag[psz + 2] | (frag[psz + 3] << 8)
+                    bh_off = frag[psz + 11] | (frag[psz + 12] << 8)
+                    t = (frag[bh_off : bh_off + 32],
+                         frag[sig_off : sig_off + 64])
+                    seen_add(t)
+                    staged_append(t)
+                    staged_add(t)
+            else:
+                n_rej += 1
+            r = res_cache.get((status, fee))
+            if r is None:
+                r = TxnResult(status, fee)
+                if len(res_cache) < 64:
+                    res_cache[(status, fee)] = r
+            res_append(r)
+        self.native_done_cnt += n_ok + n_rej
+        self.signature_cnt += sig_cnt
+        return n_ok, n_fail, n_rej
 
     @staticmethod
     def _unpack_trailer(payload: bytes, desc_bytes: bytes) -> ft.Txn:
@@ -984,12 +1067,24 @@ class SlotExecution:
         over +new / -old) chained into the bank hash."""
         vals = []
         signs = []
-        for a in sorted(self._before):
-            after = self.funk.rec_query(self.xid, a)
-            if after == self._before[a]:
+        diff_fn = getattr(self.funk, "txn_diff", None)
+        if diff_fn is not None:
+            # native shm store: the slot's whole before/after read-out is
+            # ONE FFI crossing over the fork's own overlay.  Equivalent
+            # to the _before walk — an account touched but never written
+            # has before == after and cancels out of the lattice sum, and
+            # the overlay's parent view IS the start-of-slot value
+            # (parent overlays freeze while this fork is live).
+            pairs = ((a, bef, aft) for a, bef, aft in diff_fn(self.xid))
+        else:
+            q = self.funk.rec_query
+            pairs = ((a, self._before[a], q(self.xid, a))
+                     for a in self._before)
+        for a, before, after in sorted(pairs):
+            if after == before:
                 continue
-            if self._before[a] is not None:
-                vals.append(lt.lthash_of(a + self._before[a]))
+            if before is not None:
+                vals.append(lt.lthash_of(a + before))
                 signs.append(-1)
             if after is not None:
                 vals.append(lt.lthash_of(a + after))

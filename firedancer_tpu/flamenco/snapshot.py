@@ -51,7 +51,7 @@ except ImportError:  # pragma: no cover - environment-dependent
 
 from firedancer_tpu.flamenco import types as T
 from firedancer_tpu.flamenco.executor import acct_decode, acct_encode
-from firedancer_tpu.funk import Funk
+from firedancer_tpu.funk import Funk, make_funk
 
 SNAPSHOT_VERSION = b"1.2.0"
 _ZSTD_MAGIC = b"\x28\xb5\x2f\xfd"
@@ -277,7 +277,7 @@ def snapshot_load(
         for k in inc_man.deleted:  # removals since the base must not
             accounts.pop(k, None)  # resurrect on restore
         manifest = inc_man
-    funk = funk or Funk()
+    funk = funk or make_funk()
     for k, v in accounts.items():
         funk.rec_insert(None, k, v)
     return funk, manifest
@@ -386,7 +386,7 @@ def agave_snapshot_load(
                     f"manifest names missing vec {slot}.{vid}"
                 )
 
-        funk = funk or Funk()
+        funk = funk or make_funk()
         summary = restore_manifest(funk, manifest, open_vec)
         return funk, manifest, summary
     finally:

@@ -5,3 +5,15 @@ from .funk import (  # noqa: F401
     Funk,
     FunkError,
 )
+
+
+def make_funk(**kwargs):
+    """Construction funnel for the authoritative record store: the
+    native shm-backed map when the lane is enabled and the toolchain
+    builds it, the dict-backed `Funk` otherwise.  Topology builders go
+    through here so FDTPU_NATIVE_FUNK toggles the whole tree."""
+    from . import funk_native
+
+    if funk_native.available():
+        return funk_native.NativeFunk(**kwargs)
+    return Funk()

@@ -194,4 +194,5 @@ def funk_from_config(cfg) -> Funk:
     """The boot-time funk factory: [ledger] funk_dir enables durability."""
     if getattr(cfg.ledger, "funk_dir", ""):
         return PersistentFunk(cfg.ledger.funk_dir)
-    return Funk()
+    from firedancer_tpu.funk import make_funk
+    return make_funk()

@@ -23,6 +23,7 @@ from dataclasses import dataclass
 
 from firedancer_tpu.flamenco.blockstore import Blockstore
 from firedancer_tpu.funk.funk import Funk
+from firedancer_tpu.funk import make_funk
 
 
 @dataclass
@@ -78,7 +79,7 @@ def replay_ledger(
     from firedancer_tpu.runtime.poh_stage import parse_entry
     from firedancer_tpu.runtime.shred_stage import deshred_entry_batch
 
-    funk = funk if funk is not None else Funk()
+    funk = funk if funk is not None else make_funk()
     bs = Blockstore(store_dir)
     results: list[SlotReplay] = []
     parent_hash = b"\x00" * 32

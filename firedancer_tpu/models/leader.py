@@ -87,7 +87,9 @@ class LeaderPipeline:
     def finish(self, *, max_sweeps: int = 50_000) -> None:
         """Drain: verify flush -> pack force-flush -> stop the poh clock ->
         shred flush -> sweep until quiescent."""
-        self.benchg.limit = 0  # stop generating
+        if hasattr(self.benchg, "limit"):
+            self.benchg.limit = 0  # stop generating (socket ingress
+            #                        has no generator to stop)
         for v in self.verifies:
             v.flush()
         self._sweep(max_sweeps)
@@ -125,6 +127,8 @@ class LeaderPipeline:
         # pointers exist' into whatever artifact tail captured stderr
         # (the BENCH_r03-05 pollution).  Ordering is the fix: views die,
         # THEN the mappings close, THEN the names unlink.
+        if hasattr(self.benchg, "sock"):
+            self.benchg.close()  # socket ingress: fd + native client
         for s in self.stages:
             half = getattr(s, "shred_half", None)
             if half is not None:  # fused poh+shred: the inner stage's
@@ -179,6 +183,7 @@ def build_leader_pipeline(
     slot_clock=None,
     shed_keep: int | None = None,
     fuse_poh_shred: bool = False,
+    udp_ingress: bool = False,
 ) -> LeaderPipeline:
     """keep_sets=False releases the shred stage from materializing
     FecSets in Python, which lets it adopt the zero-Python sweep lane
@@ -190,7 +195,13 @@ def build_leader_pipeline(
     paces ticks to the deadline and seals/misses slots on schedule,
     pack closes the block at each boundary (the unscheduled tail
     carries over; shed_keep arms the load-shedding degraded mode), and
-    the banks observe the boundaries."""
+    the banks observe the boundaries.
+
+    udp_ingress=True puts a real localhost socket at the front instead
+    of the in-process generator: UdpIngressStage (native recvmmsg sweep
+    when the net lane is up) publishes datagrams into gen_verify, so an
+    e2e window covers ingress -> verify -> ... -> store over actual
+    network bytes.  The caller feeds txns at pipe.benchg.addr."""
     use_native_pack = resolve_native_pack(native_pack)
     if slot_clock is not None:
         from firedancer_tpu.runtime.slot_clock import SlotClockCfg
@@ -224,10 +235,18 @@ def build_leader_pipeline(
     secret = hashlib.sha256(leader_seed).digest()
     leader_pub = ref.public_key(secret)
 
-    pool = gen_transfer_pool(pool_size)
-    benchg = BenchGStage(
-        pool, "benchg", outs=[shm.make_producer(gen_verify)], limit=gen_limit
-    )
+    if udp_ingress:
+        from firedancer_tpu.runtime.net import UdpIngressStage
+
+        benchg = UdpIngressStage(
+            "net", outs=[shm.make_producer(gen_verify)], rx_burst=64
+        )
+    else:
+        pool = gen_transfer_pool(pool_size)
+        benchg = BenchGStage(
+            pool, "benchg", outs=[shm.make_producer(gen_verify)],
+            limit=gen_limit
+        )
     verifies = [
         VerifyStage(
             f"verify{i}",

@@ -51,7 +51,7 @@ from firedancer_tpu.choreo.ghost import Ghost
 from firedancer_tpu.choreo.voter import Voter
 from firedancer_tpu.flamenco.blockstore import Blockstore, StatusCache
 from firedancer_tpu.flamenco.runtime import SlotExecution, replay_block
-from firedancer_tpu.funk import Funk
+from firedancer_tpu.funk import Funk, make_funk
 from firedancer_tpu.ops import bmtree
 from firedancer_tpu.ops.ref import ed25519_ref as ref
 from firedancer_tpu.protocol import shred as fs
@@ -188,7 +188,7 @@ class Validator:
         self.gossip.set_stakes(dict(genesis.stakes))
 
         # -- bank state ------------------------------------------------------
-        self.funk = Funk()
+        self.funk = make_funk()
         self.status_cache = StatusCache()
         self._apply_genesis()
         self.forks = Forks(genesis.root_slot)

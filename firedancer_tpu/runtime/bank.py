@@ -89,9 +89,9 @@ class BankCtx:
         blockhashes: tuple[bytes, ...] = (),
         executor=None,
     ):
-        from firedancer_tpu.funk import Funk
+        from firedancer_tpu.funk import make_funk
 
-        self.funk = funk if funk is not None else Funk()
+        self.funk = funk if funk is not None else make_funk()
         self.slot = slot
         self.status_cache = status_cache
         if status_cache is not None:
@@ -114,6 +114,18 @@ class BankCtx:
         from firedancer_tpu.flamenco.runtime import acct_build
 
         self.funk.rec_insert(None, pubkey, acct_build(lamports))
+
+    def preload(self, pubkeys) -> None:
+        """Push existing funk records into the native session overlay
+        (one refresh crossing on the next sync).  A validator enters a
+        slot with its accounts DB resident; the session overlay starts
+        empty, so without this every first touch of an account punts a
+        microblock to the resume lane.  Harnesses that know their
+        account set call this after the pipeline arms to start the
+        native sweeps steady-state.  No-op on the Python lane."""
+        sx = self.sx
+        if sx._native_for_batch() is not None:
+            sx._native_dirty.update(bytes(k) for k in pubkeys)
 
     @property
     def sx(self):
@@ -251,6 +263,14 @@ class BankStage(Stage):
                 bank_idx=self.bank_idx,
             )
             self._armed_ctx = nat
+            # native funk plane: when the authoritative store is the shm
+            # map, the C side writes committed records into it inside
+            # the sweep crossing and the drain shrinks to result-log
+            # accounting (the xid is the slot's fork — BankCtx.sx is
+            # one slot, so its lifetime is the client's)
+            fk = sx.funk
+            if hasattr(fk, "txn_diff") and getattr(fk, "_h", None):
+                self._sweep_client.set_funk(fk, sx.xid)
         except bd.NativeUnavailable:
             self._sweep_client = None
 
@@ -331,6 +351,28 @@ class BankStage(Stage):
             for (mb_seq, tsorig, lat_ns, n_done, published, recs,
                  mb) in groups:
                 _seq, frags = parse_microblock(mb)
+                if published:
+                    # entry (and for ==1 the done frame) already on the
+                    # rings: result accounting only, straight off the
+                    # frag bytes — no payload/descriptor slices, no
+                    # per-frag tuple list
+                    n_ok, n_fail, n_rej = sx.native_apply_group(
+                        frags, recs)
+                    if n_ok:
+                        self.metrics.inc("txn_exec", n_ok)
+                    if n_fail:
+                        self.metrics.inc("txn_exec_failed", n_fail)
+                    if n_rej:
+                        self.metrics.inc("txn_rejected", n_rej)
+                    self.metrics.inc("native_exec", n_done)
+                    self.metrics.inc("microblocks")
+                    self.trace(fm.EV_MICROBLOCK, n_ok)
+                    if tsorig and len(self.commit_latencies_ns) < 100_000:
+                        self.commit_latencies_ns.append(int(lat_ns))
+                    if published == 2:
+                        # entry is out; only the done frame was deferred
+                        self.publish(1, b"", sig=self.bank_idx)
+                    continue
                 sigs: list[bytes] = []
                 txns: list[bytes] = []
                 batch = []
@@ -357,21 +399,6 @@ class BankStage(Stage):
                 if n_rej:
                     self.metrics.inc("txn_rejected", n_rej)
                 self.metrics.inc("native_exec", n_done)
-                if published == 1:
-                    # entry + done already on the rings: state only
-                    self.metrics.inc("microblocks")
-                    self.trace(fm.EV_MICROBLOCK, len(txns))
-                    if tsorig and len(self.commit_latencies_ns) < 100_000:
-                        self.commit_latencies_ns.append(int(lat_ns))
-                    continue
-                if published == 2:
-                    # entry is out; only the done frame was deferred
-                    self.metrics.inc("microblocks")
-                    self.trace(fm.EV_MICROBLOCK, len(txns))
-                    if tsorig and len(self.commit_latencies_ns) < 100_000:
-                        self.commit_latencies_ns.append(int(lat_ns))
-                    self.publish(1, b"", sig=self.bank_idx)
-                    continue
                 # published == 0: resume the tail in order, then publish
                 # both frames from Python (byte-identical entry format)
                 items = []

@@ -58,6 +58,8 @@ def _load():
         lib.fdb_stage_flags_off.restype = u64
         lib.fdb_stage_set_hdr.argtypes = [vp, cp, u64]
         lib.fdb_stage_set_hdr.restype = ctypes.c_int
+        lib.fdb_stage_set_funk.argtypes = [vp, vp, vp, vp, cp, u64]
+        lib.fdb_stage_set_funk.restype = ctypes.c_int
         lib.fdb_log_ptr.argtypes = [vp]
         lib.fdb_log_ptr.restype = vp
         lib.fdb_log_clear.argtypes = [vp]
@@ -99,17 +101,20 @@ def make_hdr(batch_ctx, *, gated: bool) -> bytes:
 # offset comes from the C side (fdb_stage_flags_off) so the zero-FFI
 # view can never drift from the struct layout
 _COUNTERS = ("bank_mb_seen", "bank_mb_native", "bank_mb_stashed",
-             "bank_txn_native", "bank_credit_waits", "bank_mb_dropped")
+             "bank_txn_native", "bank_credit_waits", "bank_mb_dropped",
+             "bank_funk_writes", "bank_funk_falls")
 
 _GROUP_HEAD = struct.Struct("<QQQIBI")
+_REC_HEAD = struct.Struct("<bQB")  # status | fee | n_writes
 
 
 def parse_log(log: bytes) -> list:
     """Decode a drained result log into groups of
     (mb_seq, tsorig, lat_ns, n_done, published, recs, mb_raw) where
     recs = [(status, fee, [(acct_idx, value)])] — the fd_exec_batch2
-    response records verbatim, and mb_raw is the original microblock
-    frame (runtime/bank.parse_microblock format)."""
+    response records verbatim (writes is an empty tuple for stripped
+    records), and mb_raw is the original microblock frame
+    (runtime/bank.parse_microblock format)."""
     groups = []
     off = 0
     n = len(log)
@@ -118,18 +123,22 @@ def parse_log(log: bytes) -> list:
             _GROUP_HEAD.unpack_from(log, off)
         off += _GROUP_HEAD.size
         recs = []
+        rec_unpack = _REC_HEAD.unpack_from
         for _ in range(n_done):
-            status = int.from_bytes(log[off:off + 1], "little", signed=True)
-            fee = int.from_bytes(log[off + 1:off + 9], "little")
-            n_w = log[off + 9]
+            status, fee, n_w = rec_unpack(log, off)
             off += 10
-            writes = []
-            for _ in range(n_w):
-                idx = log[off]
-                vlen = int.from_bytes(log[off + 1:off + 5], "little")
-                off += 5
-                writes.append((idx, log[off:off + vlen]))
-                off += vlen
+            if n_w:
+                writes = []
+                for _ in range(n_w):
+                    idx = log[off]
+                    vlen = int.from_bytes(log[off + 1:off + 5], "little")
+                    off += 5
+                    writes.append((idx, log[off:off + vlen]))
+                    off += vlen
+            else:
+                # the native funk lane strips every record: share one
+                # empty tuple instead of allocating a list per txn
+                writes = ()
             recs.append((status, fee, writes))
         groups.append((mb_seq, tsorig, lat_ns, n_done, published,
                        recs, log[off:off + mb_sz]))
@@ -196,6 +205,27 @@ class StageClient:
         blockhash arm a fresh request header)."""
         if not self._lib.fdb_stage_set_hdr(self._h, hdr, len(hdr)):
             raise NativeUnavailable("fdb_stage_set_hdr failed")
+
+    def set_funk(self, funk, xid: bytes | None) -> None:
+        """Arm (or disarm: funk/xid None) the native funk plane: the C
+        side writes committed records slot-direct into `funk`'s shm map
+        and strips write payloads from the result log.  Called alongside
+        set_hdr at every slot roll — the xid is the slot's funk fork."""
+        if funk is None or xid is None:
+            rc = self._lib.fdb_stage_set_funk(self._h, None, None, None,
+                                              None, 0)
+        else:
+            from firedancer_tpu.funk import funk_native as fk
+
+            flib = fk._load()
+            rc = self._lib.fdb_stage_set_funk(
+                self._h, ctypes.c_void_p(funk._h),
+                ctypes.cast(flib.ffk_txn_slot, ctypes.c_void_p),
+                ctypes.cast(flib.ffk_rec_insert_slot, ctypes.c_void_p),
+                xid, len(xid),
+            )
+        if rc == 0:
+            raise NativeUnavailable("fdb_stage_set_funk failed")
 
     def take_log(self) -> bytes:
         """Copy out the pending result log (empty bytes when idle).

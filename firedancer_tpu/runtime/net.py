@@ -96,11 +96,18 @@ class UdpIngressStage(Stage):
     def _native_udp_sweep(self) -> None:
         """Batched intake: one crossing drains the socket into the C out
         arena, one burst publishes it.  The credit-gated tail stays
-        queued on the native side — never dropped."""
+        queued on the native side — never dropped.
+
+        The crossing is one real recvmmsg(2) kernel-scattered straight
+        into the arena; FDTPU_NET_SCALAR_RECV=1 pins the byte-identical
+        per-datagram recv fallback (differential baseline, non-Linux)."""
         nc = self._net_client
         oi = net_native.COUNTER_IDX["oversz"]
         before = int(nc.counters_view[oi])
-        nc.udp_sweep(self.sock.fileno(), self.rx_burst)
+        if os.environ.get("FDTPU_NET_SCALAR_RECV", "0") == "1":
+            nc.udp_sweep_scalar(self.sock.fileno(), self.rx_burst)
+        else:
+            nc.udp_sweep(self.sock.fileno(), self.rx_burst)
         oversz = int(nc.counters_view[oi]) - before
         if oversz:
             self.metrics.inc("oversize_drop", oversz)

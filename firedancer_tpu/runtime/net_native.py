@@ -83,6 +83,8 @@ def _load():
         lib.fdn_datagram.restype = i32
         lib.fdn_udp_sweep.argtypes = [vp, i32, i32]
         lib.fdn_udp_sweep.restype = i32
+        lib.fdn_udp_sweep_scalar.argtypes = [vp, i32, i32]
+        lib.fdn_udp_sweep_scalar.restype = i32
         for name in ("fdn_counters_ptr", "fdn_events_ptr",
                      "fdn_out_tbl_ptr", "fdn_out_arena_ptr"):
             getattr(lib, name).argtypes = [vp]
@@ -194,9 +196,16 @@ class NetClient:
                                           addr_id))
 
     def udp_sweep(self, fd: int, max_pkts: int) -> int:
-        """recvmmsg-style batched plain-UDP intake straight into the out
-        arena (one crossing for the whole burst); datagrams taken."""
+        """One real recvmmsg syscall per burst, kernel-scattered
+        straight into the out arena (per-packet iovec slots — no bounce
+        buffer, no second copy); datagrams taken."""
         return int(self._lib.fdn_udp_sweep(self._h, fd, max_pkts))
+
+    def udp_sweep_scalar(self, fd: int, max_pkts: int) -> int:
+        """The byte-identical scalar fallback: one recv per datagram
+        through a bounce buffer (the pre-recvmmsg shape).  Differential
+        suites drive both paths over the same socket load."""
+        return int(self._lib.fdn_udp_sweep_scalar(self._h, fd, max_pkts))
 
     # -- drain surface -------------------------------------------------------
 

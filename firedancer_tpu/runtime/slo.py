@@ -24,6 +24,12 @@ profile did NOT justify store flush-batching: the store hop is ~13% of
 wall with the per-shred membership recompute already skipped on the
 leader's own stream (`trust_membership`), so its budget tightens and
 its code stays put.
+
+Round 14 ratchet (ISSUE 19): the native shm storage plane moves the
+committed-record write INTO the bank sweep crossing (the drain is
+result-log accounting only), stepping the pipeline past 30K txn/s —
+the bank p50 budget steps down to the new floor and the two tail rows
+(commit, end-to-end) tighten with it.
 """
 
 from __future__ import annotations
@@ -35,8 +41,8 @@ HOP_P50_BUDGET_NS: dict[str, int] = {
     "verify0": 100_000_000,   # ingress -> verify (batch close included)
     "dedup": 150_000_000,     # python lane only (fused lane has no hop)
     "pack": 200_000_000,      # ingress -> pack intake (dedup hop included)
-    "bank0": 300_000_000,     # ingress -> commit (microblock close incl.)
-    "store": 500_000_000,     # end to end
+    "bank0": 250_000_000,     # ingress -> commit (microblock close incl.)
+    "store": 450_000_000,     # end to end
 }
 
 # hop -> p99 budget, ns: the tail ratchet.  bank0's row is the commit
@@ -45,8 +51,8 @@ HOP_P50_BUDGET_NS: dict[str, int] = {
 # two hops whose tails the bench rounds actually track — a p99 on a
 # mid-pipe hop would only re-measure its consumers' scheduling noise.
 HOP_P99_BUDGET_NS: dict[str, int] = {
-    "bank0": 600_000_000,
-    "store": 800_000_000,
+    "bank0": 500_000_000,
+    "store": 700_000_000,
 }
 
 

@@ -158,6 +158,14 @@ typedef int (*fdr_try_publish_t)(const void* link, void* prod,
 typedef u64 (*fdr_refresh_credits_t)(const void* link, void* prod);
 typedef i64 (*fd_exec_batch2_t)(void* sh, const u8* req, u64 req_sz,
                                 u8* resp, u64 resp_cap);
+// fd_funk.so (ISSUE 19): committed records go DIRECTLY into the shm
+// record map inside this crossing — the txn index resolves once per
+// group (the xid is the slot's funk fork), then each write is one
+// slot-direct upsert.
+typedef int32_t (*ffk_txn_slot_t)(void* h, const u8* xid, int32_t xlen);
+typedef int32_t (*ffk_rec_insert_slot_t)(void* h, int32_t ti, const u8* key,
+                                         int32_t klen, const u8* val,
+                                         int32_t vlen);
 
 static inline u16 rd16(const u8* p) { return (u16)(p[0] | (p[1] << 8)); }
 static inline u32 rd32(const u8* p) {
@@ -208,12 +216,23 @@ struct BankStageCtx {
   u8* ent;  u64 ent_cap;      // entry-frame build buffer
   FragRef* refs; u64 refs_cap;
   u8* log;  u64 log_cap;
+  // native funk plane (fdb_stage_set_funk; null = disarmed): committed
+  // records write straight into the shm map and the log carries
+  // payload-stripped records (n_w=0) for result accounting only
+  void* funk;
+  ffk_txn_slot_t funk_slot;
+  ffk_rec_insert_slot_t funk_insert;
+  u64 funk_xid_len;
+  u8 funk_xid[128];           // FFK_XID_MAX
+  u8* fkrecs; u64 fkrecs_cap; // stripped-record scratch
   // flags + counters Python reads off the struct (no FFI);
   // fdb_stage_flags_off pins this offset
   u64 log_sz;
   u64 stash_pending;  // a published<1 group awaits the Python drain
   u64 mb_seen, mb_native, mb_stashed, txn_native, credit_waits;
   u64 mb_dropped;  // log arena OOM before anything committed (never-path)
+  u64 funk_writes;  // records inserted into the native map in-crossing
+  u64 funk_falls;   // groups that fell back to full-value logging
 };
 
 static int ensure_cap(u8** buf, u64* cap, u64 need) {
@@ -303,7 +322,32 @@ void fdb_stage_delete(void* p) {
   std::free(st->ent);
   std::free(st->refs);
   std::free(st->log);
+  std::free(st->fkrecs);
   std::free(st);
+}
+
+// Arm/re-arm (or disarm: funk == NULL) the native funk plane.  Called
+// at arm time and at every slot roll alongside fdb_stage_set_hdr — the
+// xid is the slot's funk fork, so its lifetime is the hdr's.  The fn
+// pointers come from fd_funk.so (cross-.so linking by address, the
+// fd_exec_batch2 precedent).  Returns 0 on hard error (xid too long),
+// 1 armed, 2 armed but the xid does not resolve yet (the per-frag
+// resolve falls back to full-value logging until it does).
+int fdb_stage_set_funk(void* p, void* funk, void* slot_fn, void* insert_fn,
+                       const u8* xid, u64 xid_len) {
+  BankStageCtx* st = (BankStageCtx*)p;
+  if (!funk || !xid_len) {
+    st->funk = nullptr;
+    st->funk_xid_len = 0;
+    return 1;
+  }
+  if (xid_len > sizeof(st->funk_xid)) return 0;
+  st->funk = funk;
+  st->funk_slot = (ffk_txn_slot_t)slot_fn;
+  st->funk_insert = (ffk_rec_insert_slot_t)insert_fn;
+  std::memcpy(st->funk_xid, xid, xid_len);
+  st->funk_xid_len = xid_len;
+  return st->funk_slot(st->funk, st->funk_xid, (int32_t)xid_len) >= 0 ? 1 : 2;
 }
 
 // The env/gate prefix changes when Python re-arms the session (slot
@@ -491,12 +535,56 @@ int fdb_frag_cb(void* vctx, const u64* meta8, const u8* payload) {
   u64 lat_ns = now_ns() - tsorig;
   st->txn_native += n_done;
 
+  // native funk plane: the session has committed these records, so put
+  // them straight into the shm map NOW (slot-direct upserts) and log a
+  // payload-stripped record stream (n_w=0) — the Python drain shrinks
+  // to result accounting.  Any insert failure falls back to the full
+  // log for the whole group: upserts are idempotent, so a partial C
+  // write is safely overwritten by the Python re-apply.
+  const u8* lrecs = recs;
+  u64 lrecs_sz = recs_sz;
+  if (st->funk && n_done) {
+    int32_t ti = st->funk_slot(st->funk, st->funk_xid,
+                               (int32_t)st->funk_xid_len);
+    int ok = ti >= 0 &&
+             ensure_cap(&st->fkrecs, &st->fkrecs_cap, (u64)n_done * 10);
+    if (ok) {
+      u8* o = st->fkrecs;
+      const u8* w = recs;
+      for (u32 t = 0; t < n_done; t++) {
+        u8 n_w = w[9];
+        std::memcpy(o, w, 10);
+        o[9] = 0;  // values live in the shm map, not the log
+        o += 10;
+        w += 10;
+        const FragRef& r = st->refs[t];
+        const u8* desc = r.frag + r.psz;
+        u64 acct_off = rd16(desc + 9);  // in-bounds: batch2 gated the desc
+        for (u8 j = 0; j < n_w; j++) {
+          u32 vlen = rd32(w + 1);
+          if (ok && st->funk_insert(st->funk, ti,
+                                    r.frag + acct_off + 32u * (u64)w[0], 32,
+                                    w + 5, (int32_t)vlen) != 0)
+            ok = 0;  // keep walking: the stripped stream must stay aligned
+          w += 5 + vlen;
+        }
+      }
+    }
+    if (ok) {
+      lrecs = st->fkrecs;
+      lrecs_sz = (u64)n_done * 10;
+      st->funk_writes += n_done;
+    } else {
+      st->funk_falls++;
+    }
+  }
+
   if (punted || n_done < cnt) {
     // PUNT: the committed prefix rides in the log; Python applies it
     // and resumes the tail in order through SlotExecution.execute_batch
     st->mb_stashed++;
-    log_group(st, mb_seq, tsorig, lat_ns, n_done, 0, recs, recs_sz, payload,
-              sz);
+    log_group(st, mb_seq, tsorig, lat_ns, n_done, 0, lrecs, lrecs_sz,
+              payload, sz);
     return -1;
   }
 
@@ -507,8 +595,8 @@ int fdb_frag_cb(void* vctx, const u64* meta8, const u8* payload) {
   if (n_landed) {
     if (!ensure_cap(&st->ent, &st->ent_cap, ent_sz)) {
       st->mb_stashed++;
-      log_group(st, mb_seq, tsorig, lat_ns, n_done, 0, recs, recs_sz, payload,
-                sz);
+      log_group(st, mb_seq, tsorig, lat_ns, n_done, 0, lrecs, lrecs_sz,
+                payload, sz);
       return -1;
     }
     Sha256 hx;
@@ -537,8 +625,8 @@ int fdb_frag_cb(void* vctx, const u64* meta8, const u8* payload) {
       // back to Python for the publish half (state is already committed
       // session-side; the n_done records carry it across)
       st->mb_stashed++;
-      log_group(st, mb_seq, tsorig, lat_ns, n_done, 0, recs, recs_sz, payload,
-                sz);
+      log_group(st, mb_seq, tsorig, lat_ns, n_done, 0, lrecs, lrecs_sz,
+                payload, sz);
       return -1;
     }
   }
@@ -548,7 +636,7 @@ int fdb_frag_cb(void* vctx, const u64* meta8, const u8* payload) {
     published = 2;  // entry is out; Python publishes only the done frame
   }
   st->mb_native++;
-  log_group(st, mb_seq, tsorig, lat_ns, n_done, published, recs, recs_sz,
+  log_group(st, mb_seq, tsorig, lat_ns, n_done, published, lrecs, lrecs_sz,
             payload, sz);
   return published == 1 ? 0 : -1;
 }

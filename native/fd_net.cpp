@@ -1038,15 +1038,18 @@ i32 fdn_datagram(void *ctx, const u8 *data, i32 sz, u32 addr_id) {
   return RC_CONSUMED;
 }
 
-// recvmmsg-style batched UDP intake (the plain-UDP ingress flavor): up
-// to max_pkts datagrams in ONE crossing land directly in the out arena
-// as whole txns (UdpIngressStage semantics: one datagram = one txn,
-// oversize dropped+counted).  Returns datagrams taken (0 = socket dry).
+// Real recvmmsg under the sweep (ISSUE 19 satellite): ONE syscall
+// drains the UDP burst and the kernel scatters each datagram DIRECTLY
+// into its out-arena slot — per-packet iovecs at NET_TXN_MTU stride, no
+// intermediate buffer, no second copy.  Oversize datagrams truncate
+// into their slot (MSG_TRUNC) and are dropped+counted without a row,
+// matching the scalar fallback's drop; the slot gap is bounded by the
+// same want*MTU reservation the credit gate already takes.  Returns
+// datagrams taken (0 = socket dry).
 i32 fdn_udp_sweep(void *ctx, i32 fd, i32 max_pkts) {
 #if defined(__linux__)
   NetCtx *c = (NetCtx *)ctx;
   enum { BATCH = 64 };
-  static u8 bufs[BATCH][2048];
   struct mmsghdr msgs[BATCH];
   struct iovec iovs[BATCH];
   i32 total = 0;
@@ -1060,25 +1063,26 @@ i32 fdn_udp_sweep(void *ctx, i32 fd, i32 max_pkts) {
     if (want > room) want = room;
     memset(msgs, 0, sizeof(msgs[0]) * (size_t)want);
     for (i32 i = 0; i < want; i++) {
-      iovs[i].iov_base = bufs[i];
-      iovs[i].iov_len = sizeof(bufs[i]);
+      iovs[i].iov_base = c->arena + c->arena_used + (u64)i * NET_TXN_MTU;
+      iovs[i].iov_len = NET_TXN_MTU;
       msgs[i].msg_hdr.msg_iov = &iovs[i];
       msgs[i].msg_hdr.msg_iovlen = 1;
     }
     i32 got = (i32)recvmmsg(fd, msgs, (unsigned)want, MSG_DONTWAIT, NULL);
     if (got <= 0) break;
     for (i32 i = 0; i < got; i++) {
-      u32 len = msgs[i].msg_len;
       c->counters[C_UDP_PKTS]++;
-      if (len > NET_TXN_MTU) { c->counters[C_OVERSZ]++; continue; }
+      if (msgs[i].msg_hdr.msg_flags & MSG_TRUNC) {
+        c->counters[C_OVERSZ]++;  // > MTU: dropped, slot left as a gap
+        continue;
+      }
       u64 *row = c->out_tbl[c->out_n++];
-      row[0] = c->arena_used;
-      row[1] = len;
+      row[0] = c->arena_used + (u64)i * NET_TXN_MTU;
+      row[1] = msgs[i].msg_len;
       row[2] = 0;
       row[3] = 0;
-      memcpy(c->arena + c->arena_used, bufs[i], len);
-      c->arena_used += len;
     }
+    c->arena_used += (u64)got * NET_TXN_MTU;
     total += got;
     if (got < want) break;  // socket drained mid-batch
   }
@@ -1086,6 +1090,40 @@ i32 fdn_udp_sweep(void *ctx, i32 fd, i32 max_pkts) {
 #else
   (void)ctx; (void)fd; (void)max_pkts;
   return -1;
+#endif
+}
+
+// Scalar fallback: one recvfrom per datagram into a bounce buffer, then
+// a copy into the arena — the pre-recvmmsg shape, kept byte-identical
+// (same rows, counters, and credit gate; only arena offsets may differ
+// because good packets pack contiguously).  Portable: POSIX recv only.
+// Differential suites drive both paths over the same socket load.
+i32 fdn_udp_sweep_scalar(void *ctx, i32 fd, i32 max_pkts) {
+#if !defined(__linux__)
+  (void)ctx; (void)fd; (void)max_pkts;
+  return -1;  // <sys/socket.h> is only pulled in under the Linux gate
+#else
+  NetCtx *c = (NetCtx *)ctx;
+  u8 buf[2048];
+  i32 total = 0;
+  while (total < max_pkts) {
+    if (c->out_n >= OUT_CAP ||
+        c->arena_used + NET_TXN_MTU > OUT_ARENA_SZ)
+      break;  // credit-gated: leave the rest on the socket
+    i64 got = (i64)recv(fd, buf, sizeof(buf), MSG_DONTWAIT);
+    if (got < 0) break;
+    total++;
+    c->counters[C_UDP_PKTS]++;
+    if ((u64)got > NET_TXN_MTU) { c->counters[C_OVERSZ]++; continue; }
+    u64 *row = c->out_tbl[c->out_n++];
+    row[0] = c->arena_used;
+    row[1] = (u64)got;
+    row[2] = 0;
+    row[3] = 0;
+    memcpy(c->arena + c->arena_used, buf, (size_t)got);
+    c->arena_used += (u64)got;
+  }
+  return total;
 #endif
 }
 

@@ -1248,3 +1248,82 @@ def test_fd217_registered_and_repo_clean():
     findings = [f for f in ast_rules.lint_path(root)
                 if f.rule == "FD217"]
     assert findings == [], findings
+
+
+# -- FD218: per-record Python funk mutation with the native funk lane armed ---
+
+
+_BANK_FUNK_SRC = '''
+from firedancer_tpu.runtime import bank_native
+
+
+class BankStage:
+    def __init__(self, funk, xid):
+        self._sweep_client = bank_native.StageClient(n_lanes=1)
+        self._sweep_client.set_funk(funk, xid)
+        self.funk = funk
+        self.xid = xid
+
+    def after_frag(self, sig, frag):
+        recs = self.funk.txn_recs_for_write(self.xid)        # FD218
+        for key, val in frag.items():
+            self.funk.rec_insert(self.xid, key, val)         # FD218
+        self.funk.rec_insert_batch(self.xid, frag.items())   # clean
+        return recs
+
+    def after_credit(self):
+        self.funk._root_merge([(b"k", b"v")])                # FD218
+        self.funk.rec_remove(self.xid, b"dead")              # FD218
+
+    def _drain_native(self, rows):
+        # cold path, not a frag callback: per-record writes are fine
+        for key, val in rows:
+            self.funk.rec_insert(self.xid, key, val)
+        self.funk._root_merge(rows)
+'''
+
+
+def test_fd218_flags_per_record_funk_mutation_with_lane_armed():
+    findings = ast_rules.lint_source(
+        _BANK_FUNK_SRC, "firedancer_tpu/runtime/bank.py")
+    hits = [f for f in findings if f.rule == "FD218"]
+    msgs = [f.msg for f in hits]
+    assert len(hits) == 4, msgs
+    assert sum("txn_recs_for_write" in m for m in msgs) == 1
+    assert sum("rec_insert'" in m for m in msgs) == 1  # not rec_insert_batch
+    assert sum("_root_merge" in m for m in msgs) == 1
+    assert sum("rec_remove" in m for m in msgs) == 1
+    # without the set_funk arming the SAME writes are the module's
+    # legitimate Python funk lane — the gate must not fire
+    ungated = _BANK_FUNK_SRC.replace(
+        "self._sweep_client.set_funk(funk, xid)", "self._armed = False")
+    clean = [f for f in ast_rules.lint_source(
+        ungated, "firedancer_tpu/runtime/bank.py") if f.rule == "FD218"]
+    assert clean == [], clean
+    # and outside the bank-path modules the rule has no opinion at all
+    other = [f for f in ast_rules.lint_source(
+        _BANK_FUNK_SRC, "firedancer_tpu/runtime/net.py")
+        if f.rule == "FD218"]
+    assert other == [], other
+
+
+def test_fd218_suppressible_inline():
+    src = ("class S:\n"
+           "    def __init__(self, c):\n"
+           "        c.set_funk(None, b'')\n"
+           "    def after_frag(self, sig, frag):\n"
+           "        return self.funk.rec_insert(None, b'k', b'v')  "
+           "# fdlint: disable=FD218 -- bring-up shim\n")
+    findings = [f for f in ast_rules.lint_source(
+        src, "firedancer_tpu/runtime/bank.py") if f.rule == "FD218"]
+    assert len(findings) == 1 and findings[0].suppressed == "inline"
+
+
+def test_fd218_registered_and_repo_clean():
+    assert "FD218" in {r.id for r in all_rules()}
+    # the commit hot path honors the one-crossing contract: the repo's
+    # own bank modules never mutate funk per record inside a frag
+    root = os.path.join(os.path.dirname(__file__), "..", "firedancer_tpu")
+    findings = [f for f in ast_rules.lint_path(root)
+                if f.rule == "FD218"]
+    assert findings == [], findings

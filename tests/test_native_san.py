@@ -42,6 +42,7 @@ SAN_SUITES = (
     "test_exec_native.py",    # executor fast lane (fd_exec_native)
     "test_bank_native.py",    # bank sweep client + result log (fd_bank)
     "test_net_native.py",     # net sweep client + QUIC fast path (fd_net)
+    "test_funk_native.py",    # shm storage plane (fd_funk)
 )
 
 
