@@ -345,6 +345,31 @@ def _scrape_stage_latencies(pipe) -> dict:
         stages[s.name] = {"p50_ns": p50, "p99_ns": p99, "count": h["count"]}
         if o50 or o99:
             stages[s.name]["overflow"] = True  # true value above top edge
+        # sweep-phase decomposition (ISSUE 20 tentpole b): the nsweep_*
+        # words are C-owned, written from inside the fdr_sweep crossing —
+        # read them off the registry, never the Python facade
+        from firedancer_tpu.utils import metrics as fm
+
+        reg = s.metrics.registry
+        if reg is not None:
+            phases = {}
+            for ph in fm.NSWEEP_PHASES:
+                try:
+                    ph_h = reg.hist(f"nsweep_{ph}_ns")
+                except KeyError:
+                    continue
+                if not ph_h["count"]:
+                    continue
+                p50v = fm.hist_quantile(ph_h, 0.5)
+                p99v = fm.hist_quantile(ph_h, 0.99)
+                top = ph_h["buckets"][-1]
+                phases[ph] = {
+                    "count": ph_h["count"],
+                    "p50_ns": round(min(p50v, top), 1),
+                    "p99_ns": round(min(p99v, top), 1),
+                }
+            if phases:
+                stages[s.name]["sweep_phases"] = phases
     out = {"stage_latency_ns": stages}
     e2e = stages.get(pipe.store.name)
     if e2e:
@@ -1312,6 +1337,104 @@ def run_funk_ab(pairs: int = 3, out_path: str | None = None) -> dict:
     return out
 
 
+def run_metrics_ab(pairs: int = 3, out_path: str | None = None) -> dict:
+    """The ISSUE 20 acceptance artifact: interleaved same-box A/B of the
+    in-crossing metrics plane — per pair, one window with the native
+    observability plane armed (every sweep client stamping phase
+    histograms, latency observes and decimated flight events from
+    INSIDE the crossing) and one with FDTPU_NATIVE_METRICS=0 (the exact
+    same native pipeline, zero instrumentation).  The claim under test:
+    in-crossing instrumentation costs <2% pipeline txn/s.  Writes
+    BENCH_r15_metrics_ab.json (or FDTPU_BENCH_METRICS_AB_PATH)."""
+    from firedancer_tpu.pack import scheduler_native as sn_pack
+    from firedancer_tpu.runtime import bank_native as bkn
+
+    _require_ab_pairs(pairs, "metrics-plane A/B")
+    if not bkn.available():
+        print("# native bank client unavailable: no A/B to run",
+              file=sys.stderr)
+        return {"metrics_ab_unavailable": True}
+    pack_avail = sn_pack.available()
+    ons, offs = [], []
+    # the round-14 endgame topology in BOTH windows; the metrics switch
+    # must be held across the WHOLE measure window (not just the build):
+    # plane arming is lazy, at each stage's first sweep
+    env_prev = {k: os.environ.get(k)
+                for k in ("FDTPU_BENCH_PIPELINE_BANKS",
+                          "FDTPU_BENCH_PIPELINE_WARM",
+                          "FDTPU_NATIVE_METRICS")}
+    os.environ.setdefault("FDTPU_BENCH_PIPELINE_BANKS", "2")
+    os.environ.setdefault("FDTPU_BENCH_PIPELINE_WARM", "1536")
+    try:
+        _host_pipeline_warm_window()
+        for i in range(pairs):
+            print(f"# metrics A/B pair {i + 1}/{pairs}", file=sys.stderr)
+            order = (True, False) if i % 2 == 0 else (False, True)
+            for on in order:
+                os.environ["FDTPU_NATIVE_METRICS"] = "1" if on else "0"
+                (ons if on else offs).append(_host_pipeline_measure(
+                    native_pack=pack_avail, native_bank=True, fused=True))
+        n_bank_cfg = int(os.environ["FDTPU_BENCH_PIPELINE_BANKS"])
+        warm_cfg = int(os.environ["FDTPU_BENCH_PIPELINE_WARM"])
+    finally:
+        for k, v in env_prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    def _stage_key(rows, key):
+        return [{"v": o["pipeline_host_stage_us_per_txn"].get(key)}
+                for o in rows]
+
+    out = {
+        "pairs": pairs,
+        "fused_poh_shred": True,
+        "n_bank": n_bank_cfg,
+        "warm_txns": warm_cfg,
+        "txn_per_s": ab_summary(ons, offs, "pipeline_host_txn_per_s"),
+        "bank_us_per_txn": ab_summary(
+            _stage_key(ons, "bank"), _stage_key(offs, "bank"), "v"),
+        "commit_p99_ms": ab_summary(
+            ons, offs, "pipeline_host_commit_p99_ms"),
+        "pipeline_host_txn_per_s": round(_median(
+            [o["pipeline_host_txn_per_s"] for o in ons]), 1),
+        "stage_us_per_txn_on": [o["pipeline_host_stage_us_per_txn"]
+                                for o in ons],
+        "stage_us_per_txn_off": [o["pipeline_host_stage_us_per_txn"]
+                                 for o in offs],
+        # the sweep-phase decomposition from the instrumented windows —
+        # the bank 13.8 us/txn breakdown ROADMAP item 1 asks for
+        "sweep_phases_on": [o.get("stage_latency_ns", {}) for o in ons],
+        "bank_mode": ons[-1].get("pipeline_host_native_bank"),
+        "native_exec": ons[-1].get("pipeline_host_native_exec"),
+        "native_ring": ons[-1].get("pipeline_host_native_ring"),
+        "native_verify": ons[-1].get("pipeline_host_native_verify"),
+        "native_shred": ons[-1].get("pipeline_host_native_shred"),
+        "autotune": ons[-1].get("autotune"),
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+    }
+    # the ISSUE 20 gate, evaluated in-artifact: the instrumented window
+    # keeps >=98% of the uninstrumented window's txn/s (median of pairs)
+    rate_on = out["txn_per_s"]["on_median"]
+    rate_off = out["txn_per_s"]["off_median"]
+    overhead_pct = None
+    if rate_on is not None and rate_off:
+        overhead_pct = round(100.0 * (rate_off - rate_on) / rate_off, 2)
+    out["overhead_pct"] = overhead_pct
+    out["accept_overhead_lt_2pct"] = (
+        overhead_pct is not None and overhead_pct < 2.0)
+    path = out_path or os.environ.get("FDTPU_BENCH_METRICS_AB_PATH",
+                                      "BENCH_r15_metrics_ab.json")
+    try:
+        with open(path, "w") as fh:
+            json.dump(out, fh, indent=1)
+        print(f"# metrics A/B artifact -> {path}", file=sys.stderr)
+    except OSError as e:
+        print(f"# metrics A/B artifact write failed: {e}", file=sys.stderr)
+    return out
+
+
 def _host_pipeline_measure(*, native_pack: bool,
                            native_ring: bool | None = None,
                            native_shred: bool | None = None,
@@ -2211,6 +2334,12 @@ def main() -> None:
         n = int(sys.argv[i + 1]) if len(sys.argv) > i + 1 \
             and sys.argv[i + 1].isdigit() else 3
         print(json.dumps(run_funk_ab(pairs=n), indent=1))
+        return
+    if "--metrics-ab" in sys.argv:
+        i = sys.argv.index("--metrics-ab")
+        n = int(sys.argv[i + 1]) if len(sys.argv) > i + 1 \
+            and sys.argv[i + 1].isdigit() else 3
+        print(json.dumps(run_metrics_ab(pairs=n), indent=1))
         return
     if "--shred-ab" in sys.argv:
         i = sys.argv.index("--shred-ab")

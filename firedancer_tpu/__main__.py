@@ -341,8 +341,16 @@ def cmd_metrics(args) -> int:
             return 0
         from firedancer_tpu.utils.metrics import MetricsServer
 
+        def resolve():
+            # re-resolve the registry set on every scrape: if the run
+            # behind the descriptor was replaced (or a metrics segment
+            # joined late) the server must not keep exposing a stale
+            # boot-time snapshot of counters
+            ses.refresh()
+            return ses.registries(), ses.shard_labels()
+
         srv = MetricsServer(ses.registries(), port=args.serve,
-                            labels=ses.shard_labels())
+                            labels=ses.shard_labels(), resolver=resolve)
         try:
             host, port = srv.addr
             print(f"# serving /metrics on http://{host}:{port}/ (^C exits)",
@@ -395,6 +403,56 @@ def cmd_trace(args) -> int:
         json.dump(trace, f)
     n = len(trace["traceEvents"])
     print(f"# wrote {n} trace events to {args.out}", file=sys.stderr)
+    return 0
+
+
+def cmd_slotreport(args) -> int:
+    """Per-slot structured report over the native observability plane
+    (runtime/slot_report.py): live session, post-mortem flight dump(s),
+    or an in-process cluster run."""
+    from firedancer_tpu.runtime import monitor as mon
+    from firedancer_tpu.runtime import slot_report as sr
+
+    try:
+        if args.cluster:
+            rep = sr.run_cluster_report(args.cluster, slots=args.slots,
+                                        seed=args.seed)
+        elif args.dump:
+            reports = []
+            for path in args.dump:
+                with open(path) as f:
+                    reports.append(sr.build_report(json.load(f)))
+            rep = reports[0] if len(reports) == 1 \
+                else sr.aggregate_reports(reports)
+        elif args.descriptor is not None or mon.list_runs():
+            from firedancer_tpu.runtime.monitor import MonitorSession
+
+            ses = MonitorSession.attach(args.descriptor)
+            try:
+                rep = sr.report_from_session(ses)
+            finally:
+                ses.close()
+        else:
+            dumps = mon.list_flight_dumps()
+            if not dumps:
+                print("slotreport: no live run and no flight dumps found",
+                      file=sys.stderr)
+                return 1
+            print(f"# using newest flight dump {dumps[0]}", file=sys.stderr)
+            with open(dumps[0]) as f:
+                rep = sr.build_report(json.load(f))
+    except (RuntimeError, OSError, json.JSONDecodeError) as e:
+        print(f"slotreport: {e}", file=sys.stderr)
+        return 1
+    if args.normalize:
+        rep = sr.normalize(rep)
+    text = sr.dumps(rep)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+        print(f"# wrote slot report to {args.out}", file=sys.stderr)
+    else:
+        sys.stdout.write(text)
     return 0
 
 
@@ -522,6 +580,30 @@ def main(argv=None) -> int:
     trcp.add_argument("--descriptor", default=None,
                       help="run descriptor to snapshot live (optional)")
 
+    srp = sub.add_parser(
+        "slotreport",
+        help="per-slot JSON report: seal/miss, sweep-phase p50/p99,"
+             " native-vs-punt, funk writes, restarts",
+    )
+    srp.add_argument("--descriptor", default=None,
+                     help="run descriptor to snapshot live (optional)")
+    srp.add_argument("--dump", nargs="+", default=None, metavar="DUMP",
+                     help="flight dump file(s); several -> aggregated"
+                          " multi-node report")
+    srp.add_argument("--cluster", type=int, default=0, metavar="N",
+                     help="boot an N-validator in-process cluster and"
+                          " report it (chaos/cluster.py)")
+    srp.add_argument("--slots", type=int, default=6,
+                     help="cluster mode: slots to run")
+    srp.add_argument("--seed", type=int, default=7,
+                     help="cluster mode: harness seed (same seed ->"
+                          " byte-identical report)")
+    srp.add_argument("--out", default=None,
+                     help="write JSON here (default: stdout)")
+    srp.add_argument("--normalize", action="store_true",
+                     help="strip timing-dependent fields (CI determinism"
+                          " diffs)")
+
     chp = sub.add_parser(
         "chaos",
         help="scenario harness: adversarial load + faults + invariants",
@@ -588,6 +670,11 @@ def main(argv=None) -> int:
         return cmd_metrics(args)
     if args.cmd == "trace":
         return cmd_trace(args)
+    if args.cmd == "slotreport":
+        from firedancer_tpu.utils.platform import force_cpu_backend
+
+        force_cpu_backend()  # cluster mode must never cold-init a device
+        return cmd_slotreport(args)
     if args.cmd == "chaos":
         from firedancer_tpu.utils.platform import (
             enable_compile_cache,

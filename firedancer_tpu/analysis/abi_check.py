@@ -368,8 +368,11 @@ def _c_parse_decl_type(decl: str, typedefs, structs, consts):
     return t, name
 
 
-def _c_collect_structs(text: str, typedefs, consts):
-    structs: dict[str, StructDef] = {}
+def _c_collect_structs(text: str, typedefs, consts, seed=None):
+    """Struct defs in `text`; `seed` pre-populates the resolution dict
+    (structs merged from local includes), so a field typed by a header
+    struct resolves instead of degrading the def to incomplete."""
+    structs: dict[str, StructDef] = dict(seed) if seed else {}
     for m in re.finditer(r"\bstruct\s+(\w+)\s*\{", text):
         name = m.group(1)
         body, _end = _balanced(text, m.end() - 1)
@@ -511,13 +514,38 @@ def _split_top(s: str):
 
 
 def extract_c(path: str) -> CSurface:
-    """The exported ABI surface of one C++ translation unit."""
+    """The exported ABI surface of one C++ translation unit.
+
+    Quoted local includes (`#include "fd_metrics.h"` next to the TU)
+    are part of the surface: their constants/typedefs/structs merge in
+    FIRST — in include order — so a cpp struct holding an `fdm_plane*`
+    field or an array dimensioned by a header constant resolves, and a
+    binding module's mirrored FDM_* constants diff against the header's
+    definitions.  Header line numbers are not tracked (findings cite
+    the cpp); header functions are inline/static and never export."""
     with open(path, encoding="utf-8") as fh:
         text = _strip_c(fh.read())
     surface = CSurface(path)
+    typedefs: dict[str, T] = {}
+    # _strip_c emptied the quoted literals — read the raw source for
+    # the include targets
+    with open(path, encoding="utf-8") as fh:
+        raw = fh.read()
+    for inc in re.findall(r'^[ \t]*#[ \t]*include[ \t]+"([^"]+)"', raw,
+                          re.M):
+        ipath = os.path.join(os.path.dirname(path), inc)
+        if not os.path.exists(ipath):
+            continue
+        with open(ipath, encoding="utf-8") as fh:
+            itext = _strip_c(fh.read())
+        _c_collect_consts(itext, surface.consts)
+        typedefs.update(_c_collect_typedefs(itext))
+        surface.structs.update(_c_collect_structs(
+            itext, typedefs, surface.consts, seed=surface.structs))
     _c_collect_consts(text, surface.consts)
-    typedefs = _c_collect_typedefs(text)
-    surface.structs = _c_collect_structs(text, typedefs, surface.consts)
+    typedefs.update(_c_collect_typedefs(text))
+    surface.structs = _c_collect_structs(
+        text, typedefs, surface.consts, seed=surface.structs)
     _c_collect_funcs(text, surface, typedefs)
     return surface
 
@@ -937,6 +965,10 @@ def _compat_arg(ct: T, pt: T, bindings: dict) -> str | None:
         if pi.kind == "ptr" and ci.kind == "ptr":
             return _compat_arg(ci, pi, bindings)
         if pi.kind == "int" and ci.kind == "int":
+            if pi.size != ci.size:
+                return f"POINTER({pi!r}) vs C {ct!r} (pointee size)"
+            return None
+        if pi.kind == "float" and ci.kind == "float":
             if pi.size != ci.size:
                 return f"POINTER({pi!r}) vs C {ct!r} (pointee size)"
             return None

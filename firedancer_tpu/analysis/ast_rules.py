@@ -163,6 +163,23 @@ _FD218_FUNK_MUTATORS = frozenset({
     "rec_insert", "rec_remove", "_root_merge", "txn_recs_for_write",
 })
 
+# FD219: Python-side write on a NATIVE-OWNED metric name in a module
+# that registers a native sweep client (same `self._net_client` /
+# `self._sweep_client` gate as FD217).  These shm words are written
+# in-line by C from inside the fdr_sweep crossing and the Metrics
+# facade deliberately never tracks them — a Python observe()/inc()
+# either double-counts or zero-clobbers the C increments at the next
+# housekeeping flush.  The name set mirrors
+# utils/metrics.native_owned_names() (a test asserts they stay equal).
+_FD219_NATIVE_OWNED = frozenset({
+    "nsweep_frags", "nsweep_crossings",
+    "nsweep_drain_ns", "nsweep_callback_ns", "nsweep_apply_ns",
+    "nsweep_publish_ns", "nsweep_lat_ns", "nbank_txn_lat_ns",
+})
+_FD219_WRITERS = frozenset({
+    "observe", "observe_batch", "inc", "record", "store", "store_hist",
+})
+
 
 def _fd208_offender(arg: ast.AST) -> str | None:
     """Why `arg` allocates/formats, or None if it looks scalar-cheap."""
@@ -370,6 +387,10 @@ class _Linter(ast.NodeVisitor):
         # arming the native funk lane (funk_gate from the prescan)
         self._funk_scope = funk_gate and bool(parts) \
             and parts[-1] in _BANK_PATH_FILES
+        # FD219 scope: ANY module that registers a native sweep client —
+        # once armed, the nsweep_* words are C-owned everywhere in the
+        # file (cold paths double-count just as surely as hot ones)
+        self._fd219_scope = net_gate
         # FD214 scope: verify-path modules; the class/method context is
         # tracked below (verify-stage classes only, reap methods exempt)
         self._verify_scope = bool(parts) and parts[-1] in _FD214_FILES
@@ -487,6 +508,8 @@ class _Linter(ast.NodeVisitor):
             self._check_fd217(node)
         if self._funk_scope and (self._frag_depth or self._hook_depth):
             self._check_fd218(node)
+        if self._fd219_scope:
+            self._check_fd219(node)
         self._check_fd214(node, mf)
         if mf and mf[0] == "random" and mf[1] in _RANDOM_GLOBALS:
             self.hit("FD203", node,
@@ -592,6 +615,28 @@ class _Linter(ast.NodeVisitor):
                      " the shm map inside the fdr_sweep crossing — batch"
                      " any host-side write through rec_insert_batch at"
                      " burst granularity, never per record in a frag")
+
+    def _check_fd219(self, node: ast.Call) -> None:
+        """FD219: Python-side write on a native-owned metric name in a
+        module that registers a native sweep client.  Matched on an
+        attribute call named observe/observe_batch/inc/record/store/
+        store_hist whose FIRST argument is a string literal in the
+        native-owned set — recorder.record(EV_..., arg) and dynamic
+        names never trip it."""
+        if not isinstance(node.func, ast.Attribute) \
+                or node.func.attr not in _FD219_WRITERS or not node.args:
+            return
+        a0 = node.args[0]
+        if isinstance(a0, ast.Constant) and isinstance(a0.value, str) \
+                and a0.value in _FD219_NATIVE_OWNED:
+            self.hit("FD219", node,
+                     f"Python {node.func.attr}() on native-owned metric"
+                     f" '{a0.value}' with a native sweep client"
+                     " registered: C writes this shm word from inside"
+                     " the fdr_sweep crossing and the facade never"
+                     " tracks it — this write double-counts (or"
+                     " zero-clobbers the C increments at flush);"
+                     " declare a separate non-native metric instead")
 
     def _check_fd214(self, node: ast.Call,
                      mf: tuple[str, str] | None) -> None:

@@ -258,6 +258,17 @@ FD218 = _rule(
     " hot path; batch host-side writes through rec_insert_batch at burst"
     " granularity",
 )
+FD219 = _rule(
+    "FD219", "python-write-on-native-owned-metric", SEV_ERROR,
+    "a Python-side metrics write (observe/observe_batch/inc/record/"
+    "store/store_hist) on a NATIVE-OWNED metric name (the nsweep_*"
+    " block + nbank_txn_lat_ns) in a module that registers a native"
+    " sweep client: those shm words are written in-line by C from inside"
+    " the fdr_sweep crossing, and the Python facade deliberately never"
+    " tracks them — a facade write either double-counts the metric or"
+    " zero-clobbers the C increments at the next housekeeping flush;"
+    " declare a separate (non-native) metric for host-side observations",
+)
 
 # -- race/crash-domain rules (FD4xx): ring discipline + restart safety ------
 #

@@ -770,14 +770,88 @@ class ChaosSinkStage(Stage):
     pass
 
 
+def _nm_enabled() -> bool:
+    """Is the in-crossing metrics plane armed? (runtime/native_metrics
+    switch — the crash scenarios only assert C-side flight evidence
+    when the plane could have written it.)"""
+    from firedancer_tpu.runtime import native_metrics as nm
+
+    return nm.enabled()
+
+
+def _native_relay_possible(stage: Stage) -> bool:
+    """Can this relay run as a native sweep client?  Requires the native
+    ring lane (every in a NativeConsumer, the out a NativeProducer) —
+    the same precondition stage._native_drainer checks."""
+    if not shm.native_ring_enabled() or not stage.ins or not stage.outs:
+        return False
+    from firedancer_tpu.tango import native as tn
+
+    return (all(type(c) is tn.NativeConsumer for c in stage.ins)
+            and type(stage.outs[0]) is tn.NativeProducer)
+
+
+class NativeChaosRelayStage(ChaosRelayStage):
+    """ChaosRelayStage with the forward moved INTO the fdr_sweep
+    crossing (tango/native.NativeRelayClient): lossy relay in C, with
+    the in-crossing metrics plane stamping sweep-phase histograms and
+    flight events a SIGKILL cannot lose (ISSUE 20 satellite 4 — the
+    crash scenarios assert the killed relay's dump carries C-side
+    events).  `crash_at` non-zero makes the C side _exit(42) on the
+    first frag with sig >= crash_at, flushing its in-flight drain/
+    publish flight records first — the crash-loop flank.  Falls back to
+    the inherited Python after_frag when the native lane is off."""
+
+    def __init__(self, *args, crash_at=0, **kwargs):
+        super().__init__(*args, **kwargs)
+        if _native_relay_possible(self):
+            from firedancer_tpu.tango import native as tn
+
+            self._sweep_client = tn.NativeRelayClient(
+                self.outs[0].link, fseq_idx=0, crash_at=crash_at)
+
+    def during_housekeeping(self) -> None:
+        # the C relay counts its own forwards/drops; reconcile them into
+        # the facade counters so _wait_registry("relay", "frags_out", ..)
+        # and the registry conservation check read the native truth
+        client = self._sweep_client
+        if client is not None:
+            fwd, drop = client.counts()
+            self.metrics.counters["frags_out"] = fwd
+            self.metrics.counters["backpressure"] = drop
+        super().during_housekeeping()
+
+    def resume_from_rings(self) -> None:
+        super().resume_from_rings()
+        client = self._sweep_client
+        if client is not None:
+            # the relay's internal producer boots at seq 0; align it
+            # with the frontier the stage producer just recovered or a
+            # respawn would lap live consumers from the ring's origin
+            client.seq_sync(self.outs[0].seq)
+
+
+def _relay_nsweep_events(dump: dict, stage: str = "relay") -> dict:
+    """Count the C-side in-crossing flight events in a dump's relay
+    ring — the evidence the crash scenarios assert survived the kill."""
+    records = dump.get("stages", {}).get(stage, {}).get("records", ())
+    return {
+        "drain": sum(1 for _, ev, _a in records
+                     if ev == fm.EV_NSWEEP_DRAIN),
+        "publish": sum(1 for _, ev, _a in records
+                       if ev == fm.EV_NSWEEP_PUBLISH),
+    }
+
+
 def _b_gen(links, cnc, *, limit):
     return ChaosGenStage("gen", outs=[shm.make_producer(links["gr"])], cnc=cnc,
                          limit=limit)
 
 
 def _b_relay(links, cnc):
-    return ChaosRelayStage("relay", ins=[shm.make_consumer(links["gr"], lazy=8)],
-                           outs=[shm.make_producer(links["rs"])], cnc=cnc)
+    return NativeChaosRelayStage(
+        "relay", ins=[shm.make_consumer(links["gr"], lazy=8)],
+        outs=[shm.make_producer(links["rs"])], cnc=cnc)
 
 
 def _b_sink(links, cnc):
@@ -859,6 +933,20 @@ def run_stage_kill(seed: int = 0, duration: float = 30.0, *,
             suite.check("dump-carries-all-stage-rings",
                         set(dump.get("stages", {}))
                         == {"gen", "relay", "sink"})
+            if shm.native_ring_enabled() and _nm_enabled():
+                # the killed relay ran a NATIVE sweep client: its shm
+                # flight ring must carry the C-side in-crossing events
+                # (fdm_flight release-stores survive SIGKILL), and the
+                # Chrome trace must render them
+                evs = _relay_nsweep_events(dump)
+                suite.check("dump-has-native-crossing-events",
+                            evs["drain"] > 0 and evs["publish"] > 0,
+                            f"relay nsweep events: {evs}")
+                names = {e.get("name") for e in
+                         fm.flight_to_chrome_trace(dump)["traceEvents"]}
+                suite.check("trace-renders-native-crossing-events",
+                            {"nsweep_drain", "nsweep_publish"} <= names,
+                            f"trace event names: {sorted(names)[:20]}")
             _capture_trace_from_dump(
                 ScenarioResult("stage-kill", seed, suite, info, artifacts),
                 h.flight_dump_path)
@@ -1107,6 +1195,23 @@ def _b_crashloop_relay(links, cnc, *, crash_at):
         cnc=cnc, crash_at=crash_at)
 
 
+def _b_native_crashloop_relay(links, cnc, *, crash_at):
+    # the crash-loop flank on the NATIVE lane: C hits sig >= crash_at
+    # inside the fdr_sweep crossing, flushes its in-flight drain/publish
+    # flight records, then _exit(42) — the dump assertion proves the
+    # C-side events outlive the hard death.  Lossy (the relay client
+    # tracks one fseq), which the flank tolerates: it asserts fail-fast/
+    # victim/attempts/dump, never stream conservation.  Falls back to
+    # the Python crash-loop relay when the native lane is off so the
+    # flank still crashes deterministically.
+    cls = NativeChaosRelayStage if shm.native_ring_enabled() \
+        else CrashLoopRelayStage
+    return cls(
+        "relay", ins=[shm.make_consumer(links["gr"], lazy=8)],
+        outs=[shm.make_producer(links["rs"], reliable_fseq_idx=[0, 1])],
+        cnc=cnc, crash_at=crash_at)
+
+
 def _b_slot_poh(links, cnc, *, clock):
     from firedancer_tpu.runtime.poh_stage import PohStage
 
@@ -1266,7 +1371,7 @@ def run_crash_mid_slot(seed: int = 0, duration: float = 60.0, *,
     cfg2 = SlotClockCfg(slot_ms=slot_ms, slot0=1, ticks_per_slot=4,
                         n_slots=n_slots).anchored(1.0)
     pol2 = RestartPolicy(max_restarts=2, backoff_base_s=0.02, seed=seed)
-    topo2 = _crash_mid_slot_topology(256, cfg2, _b_crashloop_relay,
+    topo2 = _crash_mid_slot_topology(256, cfg2, _b_native_crashloop_relay,
                                      crash_at=16)
     h2 = ft.launch(topo2)
     names2 = h2.shm_names()
@@ -1281,6 +1386,22 @@ def run_crash_mid_slot(seed: int = 0, duration: float = 60.0, *,
         dump_ok = bool(h2.flight_dump_path
                        and os.path.exists(h2.flight_dump_path))
         suite.check("crash-loop-flight-dump-written", dump_ok)
+        if dump_ok and shm.native_ring_enabled() and _nm_enabled():
+            # the relay died by C-side _exit(42) INSIDE the crossing:
+            # its flight ring must still carry the in-crossing drain/
+            # publish events (the crash path flushes them first), and
+            # the Chrome trace must render them
+            with open(h2.flight_dump_path) as f:
+                dump2 = json.load(f)
+            evs2 = _relay_nsweep_events(dump2)
+            suite.check("crash-loop-dump-has-native-crossing-events",
+                        evs2["drain"] > 0,
+                        f"relay nsweep events: {evs2}")
+            names2_ev = {e.get("name") for e in
+                         fm.flight_to_chrome_trace(dump2)["traceEvents"]}
+            suite.check("crash-loop-trace-renders-crossing-events",
+                        "nsweep_drain" in names2_ev,
+                        f"trace event names: {sorted(names2_ev)[:20]}")
         info["crash_loop_restarts"] = h2.restarts.get("relay", 0)
     finally:
         h2.close()

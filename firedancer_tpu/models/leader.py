@@ -134,8 +134,13 @@ class LeaderPipeline:
             if half is not None:  # fused poh+shred: the inner stage's
                 half.ins = []     # link views must die too
                 half.outs = []
+                half.drop_native_views()
             s.ins = []
             s.outs = []
+            # the in-crossing metrics plane + drainer plan hold views
+            # over the metric segments a caller may own (the latency-
+            # budget fixture attaches its own) — same ordering rule
+            s.drop_native_views()
         import gc
 
         gc.collect()

@@ -214,6 +214,20 @@ class BankStage(Stage):
                      "sweep stalls: an out ring had no credit pre-exec")
             .counter("bank_mb_dropped",
                      "log-arena OOM before commit (never-path diag)")
+            .counter("bank_funk_writes",
+                     "records the C sweep inserted into the native funk"
+                     " map in-crossing")
+            .counter("bank_funk_falls",
+                     "groups that fell back to full-value logging")
+            # native-owned (ISSUE 20): fdb_frag_cb observes each
+            # committed txn's commit latency in-crossing — the Python
+            # facade never touches this histogram
+            .histogram(
+                "nbank_txn_lat_ns", fm.exp_buckets(1e3, 1e10, 24),
+                "per-txn commit latency (tsorig -> session commit),"
+                " stamped by the C sweep lane",
+                native=True,
+            )
         )
 
     def __init__(self, *args, bank_idx: int = 0, ctx: BankCtx | None = None,
@@ -221,6 +235,9 @@ class BankStage(Stage):
         super().__init__(*args, **kwargs)
         self.bank_idx = bank_idx
         self.ctx = ctx if ctx is not None else default_bank_ctx()
+        # the stage-extra plane histogram (ISSUE 20): the sweep harness
+        # binds this name as the plane's xlat slot for fdb_frag_cb
+        self.native_xlat_metric = "nbank_txn_lat_ns"
         # per-microblock commit latency vs the oldest txn's origin stamp
         # (the bencho measurement point: txn acknowledged by the runtime)
         self.commit_latencies_ns: list[int] = []

@@ -60,6 +60,7 @@ def _load():
         lib.fdb_stage_set_hdr.restype = ctypes.c_int
         lib.fdb_stage_set_funk.argtypes = [vp, vp, vp, vp, cp, u64]
         lib.fdb_stage_set_funk.restype = ctypes.c_int
+        lib.fdb_stage_set_metrics.argtypes = [vp, vp]
         lib.fdb_log_ptr.argtypes = [vp]
         lib.fdb_log_ptr.restype = vp
         lib.fdb_log_clear.argtypes = [vp]
@@ -226,6 +227,15 @@ class StageClient:
             )
         if rc == 0:
             raise NativeUnavailable("fdb_stage_set_funk failed")
+
+    def set_metrics(self, plane) -> None:
+        """Arm the shm metrics plane (ISSUE 20): apply/publish brackets
+        inside fdb_frag_cb accumulate into the SAME fdm_plane the sweep
+        harness hands fdr_sweep, and per-txn commit latency observes
+        into the stage's nbank_txn_lat_ns histogram in-crossing."""
+        self._plane = plane  # keepalive: C holds the raw pointer
+        self._lib.fdb_stage_set_metrics(
+            self._h, plane.ptr if plane is not None else None)
 
     def take_log(self) -> bytes:
         """Copy out the pending result log (empty bytes when idle).

@@ -54,6 +54,22 @@ def descriptor_path(uid: str) -> str:
     return os.path.join(RUN_DIR, f"fdtpu_run_{uid}.json")
 
 
+# Mappings whose close() hit BufferError because the caller still held
+# registry views (e.g. a MetricsServer scraping across a refresh()).
+# Parked here so SharedMemory.__del__ never re-raises into the void;
+# reaped on the next session close() once the views have died.
+_ORPHANS: list = []
+
+
+def _reap_orphans() -> None:
+    for s in list(_ORPHANS):
+        try:
+            s.close()
+        except BufferError:
+            continue
+        _ORPHANS.remove(s)
+
+
 def flight_dump_path(uid: str) -> str:
     return os.path.join(RUN_DIR, f"fdtpu_flight_{uid}.json")
 
@@ -131,9 +147,13 @@ class _Joined:
 class MonitorSession:
     """Read-only join of a running topology's cnc + metrics regions."""
 
-    def __init__(self, joined: list[_Joined], uid: str | None = None):
+    def __init__(self, joined: list[_Joined], uid: str | None = None,
+                 descriptor: str | None = None):
         self._joined = joined
         self.uid = uid
+        # the path we attached through — refresh() re-reads it to detect
+        # a replaced run or a metrics segment that failed to join
+        self.descriptor = descriptor
 
     @classmethod
     def attach(cls, descriptor: str | None = None) -> "MonitorSession":
@@ -176,7 +196,7 @@ class MonitorSession:
                         except (OSError, BufferError):
                             pass
             joined.append(j)
-        return cls(joined, uid=d.get("uid"))
+        return cls(joined, uid=d.get("uid"), descriptor=descriptor)
 
     def close(self) -> None:
         for j in self._joined:
@@ -193,8 +213,43 @@ class MonitorSession:
                 try:
                     j.met_shm.close()
                 except BufferError:
-                    pass
+                    # a caller still holds registry views — park the
+                    # mapping instead of orphaning it to a __del__ that
+                    # would re-raise; reaped once the views die
+                    _ORPHANS.append(j.met_shm)
                 j.met_shm = None
+        _reap_orphans()
+
+    def refresh(self) -> bool:
+        """Re-attach if the run behind our descriptor changed: a new uid
+        (the run was replaced), a different stage set, or a metrics
+        segment that failed to map at attach time and may exist now.
+
+        An IN-PLACE restart (RestartPolicy respawn) reuses the same shm
+        regions, so our mappings stay valid and this is a no-op — the
+        stale case this guards is a scraper outliving the run it first
+        joined (ISSUE 20 satellite 2).  Returns True when re-attached."""
+        if self.descriptor is None:
+            return False
+        try:
+            with open(self.descriptor) as f:
+                d = json.load(f)
+        except (OSError, ValueError):
+            return False  # descriptor gone/torn — keep the old mappings
+        joined_regs = {j.name for j in self._joined
+                       if j.registry is not None}
+        stale = (
+            d.get("uid") != self.uid
+            or set(d.get("stages", {})) != {j.name for j in self._joined}
+            or bool(set(d.get("metrics", {})) - joined_regs)
+        )
+        if not stale:
+            return False
+        fresh = MonitorSession.attach(self.descriptor)
+        self.close()
+        self._joined = fresh._joined
+        self.uid = fresh.uid
+        return True
 
     # -- metrics plane ------------------------------------------------------
 
@@ -264,6 +319,7 @@ class MonitorSession:
                 "shard": j.shard,
             }
             row.update(fm.latency_row(j.registry))
+            row["sweep_phases"] = fm.nsweep_phase_row([j.registry])
             out.append(row)
         for logical, js in groups.items():
             sigs = [j.cnc.signal for j in js]
@@ -286,6 +342,8 @@ class MonitorSession:
                 "shards": len(js),
             }
             row.update(fm.latency_row_merged([j.registry for j in js]))
+            row["sweep_phases"] = fm.nsweep_phase_row(
+                [j.registry for j in js])
             out.append(row)
         return out
 
@@ -321,7 +379,7 @@ class MonitorSession:
                dt_s: float) -> str:
         hdr = (f"{'stage':<14}{'state':<6}{'hb_ms':>8}{'in/s':>11}"
                f"{'out/s':>11}{'busy%':>7}{'ovrn':>7}{'bkp':>7}"
-               f"{'p50 lat':>9}{'p99 lat':>9}")
+               f"{'p50 lat':>9}{'p99 lat':>9}{'sweep p50us':>16}")
         lines = [hdr, "-" * len(hdr)]
         prev_by = {r["stage"]: r for r in prev or []}
         for r in rows:
@@ -344,6 +402,7 @@ class MonitorSession:
                 f"{fmt(busy):>7}{r['overrun']:>7}{r['backpressure']:>7}"
                 f"{fm.format_latency_ms(r.get('lat_p50_ms')):>9}"
                 f"{fm.format_latency_ms(r.get('lat_p99_ms')):>9}"
+                f"{fm.format_phase_cell(r.get('sweep_phases') or {}):>16}"
             )
         return "\n".join(lines)
 

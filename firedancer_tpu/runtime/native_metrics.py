@@ -1,0 +1,230 @@
+"""The native metrics plane binding (ISSUE 20 tentpole a).
+
+Builds the `fdm_plane` handle native sweep clients write the shm
+metrics plane through: Python computes every layout fact — histogram
+word offsets, bucket-edge tables, counter words, the flight ring base —
+from the stage's MetricsRegistry/FlightRecorder (utils/metrics.py is
+the single source of truth for the segment format) and hands them to C
+in one struct.  The C side (native/fd_metrics.h, carried by every
+client .so) only ever writes THROUGH the offsets it was given:
+relaxed-atomic counter bumps, byte-identical histogram observes, and
+in-line flight records that survive the writer being SIGKILLed.
+
+This module is an abi_check binding surface for native/fd_ring.cpp
+(the TU that exports the plane validators + differential-test
+drivers): the _Hist/_Plane layouts and the mirrored FDM_* constants
+below are proven against the header by analysis/abi_check.py.
+
+The plane is ON by default wherever a native sweep client runs;
+FDTPU_NATIVE_METRICS=0 disables it (the bench A/B's OFF arm).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+from firedancer_tpu.utils import metrics as fm
+from firedancer_tpu.utils.nativebuild import NativeUnavailable
+
+# constants mirrored from native/fd_metrics.h (FD305 checks them)
+FDM_ABI_VERSION = 1
+FDM_SEG_MAGIC = 0xFD7B0F17
+FDM_SEG_HDR_WORDS = 4
+FDM_REC_WORDS = 3
+FDM_SUM_SCALE = 1024
+FDM_FLIGHT_DECIMATE = 64
+FDM_NPH = 4
+FDM_F_CTR = 1
+FDM_F_PH = 2
+FDM_F_FLIGHT = 4
+FDM_F_LAT = 8
+FDM_F_XLAT = 16
+
+u64 = ctypes.c_uint64
+_PU64 = ctypes.POINTER(ctypes.c_uint64)
+
+# the paired translation unit (abi_check discovers this module by it)
+_SRC = "native/fd_ring.cpp"
+
+
+class _Hist(ctypes.Structure):
+    _fields_ = [
+        ("off", ctypes.c_uint64),
+        ("n", ctypes.c_uint64),
+        ("edges", ctypes.POINTER(ctypes.c_double)),
+    ]
+
+
+class _Plane(ctypes.Structure):
+    _fields_ = [
+        ("version", ctypes.c_uint64),
+        ("met", ctypes.POINTER(ctypes.c_uint64)),
+        ("rec", ctypes.POINTER(ctypes.c_uint64)),
+        ("rec_cap", ctypes.c_uint64),
+        ("flags", ctypes.c_uint64),
+        ("c_frags_off", ctypes.c_uint64),
+        ("c_crossings_off", ctypes.c_uint64),
+        ("ph", _Hist * FDM_NPH),
+        ("lat", _Hist),
+        ("xlat", _Hist),
+        ("ph_accum", ctypes.c_uint64 * FDM_NPH),
+        ("crossings", ctypes.c_uint64),
+    ]
+
+
+class PlaneUnavailable(RuntimeError):
+    """No native toolchain / ABI mismatch — callers run without the
+    plane (the observability layer must never take a stage down)."""
+
+
+_lib = None
+
+
+def _load_lib():
+    """The fd_ring.so handle ("native/fd_ring.cpp") with the fdm_*
+    surface declared; raises PlaneUnavailable where the ring .so
+    cannot build."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    try:
+        from firedancer_tpu.tango.native import _load
+
+        lib = _load()
+    except NativeUnavailable as e:
+        raise PlaneUnavailable(str(e)) from e
+    lib.fdm_abi_version.restype = u64
+    lib.fdm_abi_version.argtypes = []
+    lib.fdm_plane_attach.argtypes = [
+        ctypes.POINTER(_Plane), _PU64, u64,
+    ]
+    lib.fdm_plane_attach.restype = ctypes.c_int
+    lib.fdm_test_ctr.argtypes = [ctypes.POINTER(_Plane), u64, u64]
+    lib.fdm_test_hist.argtypes = [
+        ctypes.POINTER(_Plane), ctypes.POINTER(_Hist),
+        ctypes.POINTER(ctypes.c_double), u64,
+    ]
+    lib.fdm_test_flight.argtypes = [ctypes.POINTER(_Plane), u64, u64]
+    lib.fdm_test_sweep_end.argtypes = [
+        ctypes.POINTER(_Plane), u64, u64, u64, u64, u64,
+    ]
+    if int(lib.fdm_abi_version()) != FDM_ABI_VERSION:
+        raise PlaneUnavailable(
+            f"fd_metrics ABI {int(lib.fdm_abi_version())} != "
+            f"{FDM_ABI_VERSION}"
+        )
+    _lib = lib
+    return lib
+
+
+def enabled() -> bool:
+    """The plane rides every native sweep client unless explicitly
+    disabled (the bench A/B's OFF arm sets FDTPU_NATIVE_METRICS=0)."""
+    return os.environ.get("FDTPU_NATIVE_METRICS", "1") != "0"
+
+
+class NativePlane:
+    """One stage's fdm_plane: built from its registry (+ flight
+    recorder), handed to SweepDrainer/sweep clients as `.ptr`.
+
+    Keepalives matter: C holds raw pointers into the registry words,
+    the recorder words and the bucket-edge arrays — this object pins
+    them all for the plane's lifetime, and the drainer/client pins the
+    plane."""
+
+    def __init__(self, registry: fm.MetricsRegistry,
+                 recorder: fm.FlightRecorder | None = None, *,
+                 xlat: str | None = None):
+        lib = _load_lib()
+        self.registry = registry
+        self.recorder = recorder
+        self._edges: list[np.ndarray] = []
+        p = _Plane()
+        p.version = FDM_ABI_VERSION
+        p.met = ctypes.cast(int(registry.words.ctypes.data), _PU64)
+        flags = 0
+        if "nsweep_frags" in registry._off \
+                and "nsweep_crossings" in registry._off:
+            p.c_frags_off = registry._off["nsweep_frags"][1]
+            p.c_crossings_off = registry._off["nsweep_crossings"][1]
+            flags |= FDM_F_CTR
+        ph_ok = True
+        for i, ph in enumerate(fm.NSWEEP_PHASES):
+            if not self._bind_hist(p.ph[i], registry, f"nsweep_{ph}_ns"):
+                ph_ok = False
+        if ph_ok:
+            flags |= FDM_F_PH
+        if self._bind_hist(p.lat, registry, "nsweep_lat_ns"):
+            flags |= FDM_F_LAT
+        if xlat and self._bind_hist(p.xlat, registry, xlat):
+            flags |= FDM_F_XLAT
+        if recorder is not None:
+            p.rec = ctypes.cast(int(recorder.words.ctypes.data), _PU64)
+            p.rec_cap = recorder.capacity
+            flags |= FDM_F_FLIGHT
+        p.flags = flags
+        self._p = p
+        self.flags = flags
+        # cached once: the sweep call must not rebuild argument
+        # temporaries per crossing (FD212)
+        self.ptr = ctypes.cast(ctypes.pointer(p), ctypes.c_void_p)
+        self._lib = lib
+        # segment-backed registries carry the whole-segment view: let C
+        # re-validate the header magic + derived bases against what we
+        # just computed (drift here would be silent shm corruption)
+        seg = getattr(registry, "_seg", None)
+        if seg is not None:
+            rc = int(lib.fdm_plane_attach(
+                ctypes.byref(p),
+                ctypes.cast(int(seg.ctypes.data), _PU64), len(seg),
+            ))
+            if rc != 0:
+                raise PlaneUnavailable(
+                    f"fdm_plane_attach failed ({rc}): segment layout"
+                    " drift between Python and C"
+                )
+
+    def _bind_hist(self, slot, registry: fm.MetricsRegistry,
+                   name: str) -> bool:
+        got = registry._off.get(name)
+        if got is None:
+            return False
+        d, off = got
+        if d.kind != fm.HISTOGRAM:
+            return False
+        edges = registry._edges[name]  # float64, precomputed at layout
+        self._edges.append(edges)
+        slot.off = off
+        slot.n = len(d.buckets)
+        slot.edges = ctypes.cast(int(edges.ctypes.data),
+                                 ctypes.POINTER(ctypes.c_double))
+        return True
+
+    # -- differential-test drivers (C writers, Python-checked) ----------
+
+    def test_ctr(self, name: str, v: int) -> None:
+        self._lib.fdm_test_ctr(ctypes.byref(self._p),
+                               self.registry._off[name][1], v)
+
+    def test_hist(self, name: str, values) -> None:
+        vals = np.ascontiguousarray(values, dtype=np.float64)
+        slot = _Hist()
+        if not self._bind_hist(slot, self.registry, name):
+            raise KeyError(name)
+        self._lib.fdm_test_hist(
+            ctypes.byref(self._p), ctypes.byref(slot),
+            ctypes.cast(int(vals.ctypes.data),
+                        ctypes.POINTER(ctypes.c_double)),
+            len(vals),
+        )
+
+    def test_flight(self, event: int, arg: int) -> None:
+        self._lib.fdm_test_flight(ctypes.byref(self._p), event, arg)
+
+    def test_sweep_end(self, got: int, drain_ns: int, cb_ns: int,
+                       apply_ns: int = 0, pub_ns: int = 0) -> None:
+        self._lib.fdm_test_sweep_end(ctypes.byref(self._p), got,
+                                     drain_ns, cb_ns, apply_ns, pub_ns)

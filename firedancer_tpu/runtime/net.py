@@ -102,6 +102,11 @@ class UdpIngressStage(Stage):
         into the arena; FDTPU_NET_SCALAR_RECV=1 pins the byte-identical
         per-datagram recv fallback (differential baseline, non-Linux)."""
         nc = self._net_client
+        # lazy plane arm (ISSUE 20): the shm registry attaches after the
+        # client exists, so re-arm whenever the stage's plane rebuilds
+        plane = self._native_plane()
+        if plane is not getattr(nc, "_plane", None):
+            nc.set_metrics(plane)
         oi = net_native.COUNTER_IDX["oversz"]
         before = int(nc.counters_view[oi])
         if os.environ.get("FDTPU_NET_SCALAR_RECV", "0") == "1":
@@ -339,6 +344,11 @@ class QuicIngressStage(UdpIngressStage):
         nc = self._net_client
         if nc is None:
             return self._py_datagram(data, src)
+        # lazy plane arm (ISSUE 20): the shm registry attaches after the
+        # client exists, so re-arm whenever the stage's plane rebuilds
+        plane = self._native_plane()
+        if plane is not getattr(nc, "_plane", None):
+            nc.set_metrics(plane)
         rc = nc.datagram(data, self._intern_addr(src))
         if rc == net_native.RC_CONSUMED:
             self.metrics.inc("pkt_rx")

@@ -85,6 +85,7 @@ def _load():
         lib.fdn_udp_sweep.restype = i32
         lib.fdn_udp_sweep_scalar.argtypes = [vp, i32, i32]
         lib.fdn_udp_sweep_scalar.restype = i32
+        lib.fdn_set_metrics.argtypes = [vp, vp]
         for name in ("fdn_counters_ptr", "fdn_events_ptr",
                      "fdn_out_tbl_ptr", "fdn_out_arena_ptr"):
             getattr(lib, name).argtypes = [vp]
@@ -194,6 +195,14 @@ class NetClient:
         RC_PUNT (run the Python lane on these bytes) / RC_DROP."""
         return int(self._lib.fdn_datagram(self._h, data, len(data),
                                           addr_id))
+
+    def set_metrics(self, plane) -> None:
+        """Arm the shm metrics plane (ISSUE 20): socket sweeps observe
+        the drain phase and per-datagram decrypt+apply the callback
+        phase, straight from C.  `plane` None disarms."""
+        self._plane = plane  # keepalive: C holds the raw pointer
+        self._lib.fdn_set_metrics(
+            self._h, plane.ptr if plane is not None else None)
 
     def udp_sweep(self, fd: int, max_pkts: int) -> int:
         """One real recvmmsg syscall per burst, kernel-scattered

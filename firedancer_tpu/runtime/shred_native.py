@@ -76,6 +76,7 @@ def _load():
         lib.fds_stage_delete.argtypes = [vp]
         lib.fds_stage_flags_off.restype = u64
         lib.fds_stage_set_slot.argtypes = [vp, u64]
+        lib.fds_stage_set_metrics.argtypes = [vp, vp]
         lib.fds_stage_append.argtypes = [vp, cp, u64, u64]
         lib.fds_stage_flush.argtypes = [vp, ctypes.c_int]
         lib.fds_stage_flush.restype = ctypes.c_int
@@ -298,6 +299,13 @@ class StageClient:
 
     def set_slot(self, slot: int) -> None:
         self._lib.fds_stage_set_slot(self._h, slot)
+
+    def set_metrics(self, plane) -> None:
+        """Arm the shm metrics plane (ISSUE 20): shred/encode bursts
+        and the wire loop attribute apply/publish phases in-crossing."""
+        self._plane = plane  # keepalive: C holds the raw pointer
+        self._lib.fds_stage_set_metrics(
+            self._h, plane.ptr if plane is not None else None)
 
     def close(self) -> None:
         if self._h:

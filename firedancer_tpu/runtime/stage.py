@@ -84,6 +84,12 @@ class Metrics:
         self._hcounts: dict[str, list[int]] = {}
         self._hsums: dict[str, float] = {}
         for d in self.schema.defs:
+            if d.native:
+                # native-owned words (written in-line by a C sweep
+                # client): building local state for them would make
+                # flush() overwrite the C increments with zeros — the
+                # facade never tracks them (fdlint FD219's contract)
+                continue
             if d.kind == fm.HISTOGRAM:
                 self._hedges[d.name] = d.buckets
                 self._hcounts[d.name] = [0] * (len(d.buckets) + 1)
@@ -146,6 +152,8 @@ class Metrics:
         if reg is None:
             return
         for name, (d, _off) in reg._off.items():
+            if d.native:
+                continue  # C-owned words: never overwrite from Python
             if d.kind == fm.HISTOGRAM:
                 if name in self._hcounts:
                     reg.store_hist(name, self._hcounts[name],
@@ -198,6 +206,15 @@ class Stage:
         # (after_frag on mixed/lossy lanes) must forward into the same
         # C-side state so the two paths never diverge.
         self._sweep_client = None
+        # in-crossing metrics plane (ISSUE 20): built lazily alongside
+        # the drainer and handed into fdr_sweep so C records phase
+        # histograms / counters / flight events from INSIDE the
+        # crossing.  (registry-or-local, plane-or-None) — rebuilt when
+        # attach_observability rebinds the registry.
+        self._nplane: tuple | None = None
+        # stage-extra native histogram the plane should bind as its
+        # xlat slot (bank sets "nbank_txn_lat_ns")
+        self.native_xlat_metric: str | None = None
         # in-place restart (runtime/topo supervisor respawn): out_idx ->
         # the ring's published-sig set, armed by resume_from_rings; the
         # publish guard suppresses re-published replay frags until the
@@ -256,6 +273,53 @@ class Stage:
         self.metrics.attach(registry)
         self.recorder.replay_into(recorder)
         self.recorder = recorder
+        # the native plane (if one was already built) pointed at the old
+        # words — drop it so the next sweep rebinds against the shm
+        # segment (and the drainer plan with it)
+        self._nplane = None
+        self._drainer = None
+
+    def _native_plane(self):
+        """The stage's in-crossing metrics plane (NativePlane), built
+        lazily against the attached shm registry — or a private local
+        registry when the stage runs cooperatively without one, so the
+        profiler works in-process too (bench's A/B windows).  None when
+        the plane is disabled (FDTPU_NATIVE_METRICS=0) or the schema
+        lacks the native block."""
+        cached = self._nplane
+        if cached is not None and cached[0] is self.metrics.registry:
+            return cached[1]
+        from . import native_metrics as nm
+
+        plane = None
+        reg = self.metrics.registry
+        if nm.enabled():
+            if reg is None:
+                reg = fm.MetricsRegistry(self.metrics.schema)
+                self.metrics.attach(reg)
+            try:
+                plane = nm.NativePlane(
+                    reg, self.recorder,
+                    xlat=self.native_xlat_metric,
+                )
+            except (nm.PlaneUnavailable, KeyError):
+                plane = None
+        self._nplane = (self.metrics.registry, plane)
+        return plane
+
+    def drop_native_views(self) -> None:
+        """Terminal: release every native-plane reference holding views
+        over an shm metrics segment (the plane itself, the drainer plan
+        that embeds it, and the sweep client's keepalive), so a caller
+        that owns the segment can close it without BufferError.  The
+        stage must not sweep again after this."""
+        self._nplane = None
+        self._drainer = None
+        client = self._sweep_client
+        if client is not None and getattr(client, "_plane", None) is not None:
+            set_metrics = getattr(client, "set_metrics", None)
+            if set_metrics is not None:
+                set_metrics(None)  # C drops its raw pointer too
 
     # -- in-place restart (supervisor respawn) -------------------------------
 
@@ -509,8 +573,16 @@ class Stage:
             type(c) is fn.NativeConsumer for c in self.ins
         ):
             if client is not None:
+                plane = self._native_plane()
                 drainer = fn.SweepDrainer(self.ins, max(1, self.burst),
-                                          client)
+                                          client, plane)
+                if plane is not None:
+                    set_metrics = getattr(client, "set_metrics", None)
+                    if set_metrics is not None:
+                        # hand the plane into the stage's own C context
+                        # too: apply/publish phase attribution + stage
+                        # extras (bank's per-txn latency) write through it
+                        set_metrics(plane)
             else:
                 drainer = fn.BurstDrainer(self.ins, max(1, self.burst))
         self._drainer = (list(self.ins), drainer, client)
@@ -705,12 +777,16 @@ class Stage:
             return n
         p = self.outs[out_idx]
         burst = getattr(p, "publish_burst", None)
+        # the native burst publishes through the metrics plane (ISSUE
+        # 20): the crossing's duration observes into the stage's
+        # publish-phase histogram from INSIDE C
+        plane = self._native_plane() if burst is not None else None
         if self.ring_clock:
             _t = _pc()
-            n = self._publish_items(p, burst, items)
+            n = self._publish_items(p, burst, items, plane)
             self.ring_publish_s += _pc() - _t
         else:
-            n = self._publish_items(p, burst, items)
+            n = self._publish_items(p, burst, items, plane)
         if n:
             self.metrics.inc("frags_out", n)
         if n < len(items):
@@ -718,9 +794,9 @@ class Stage:
         return n
 
     @staticmethod
-    def _publish_items(p, burst, items) -> int:
+    def _publish_items(p, burst, items, plane=None) -> int:
         if burst is not None:
-            return burst(items)
+            return burst(items, plane)
         n = 0
         for payload, sig, tsorig in items:
             if not p.try_publish(payload, sig=sig, tsorig=tsorig):

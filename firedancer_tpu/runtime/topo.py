@@ -222,8 +222,11 @@ def _stage_main(spec: StageSpec, link_names: dict, uid: str,
             # instant its first flush landed); histograms restart —
             # their pre-crash state is already in the registry and the
             # stage only ever overwrites what it locally observed
+            # native-owned words are never resume-copied: C bumps them
+            # in the segment directly, and seeding the Python facade
+            # would re-add them at the next flush (double count)
             for name, (d, _off) in registry._off.items():
-                if d.kind != fm.HISTOGRAM:
+                if d.kind != fm.HISTOGRAM and not d.native:
                     v = registry.get(name)
                     if v:
                         stage.metrics.counters[name] = v

@@ -672,6 +672,10 @@ class VerifyStage(Stage):
         c = self._sweep_client
         p = self.outs[0]
         pc = time.perf_counter
+        # the reap publishes OUTSIDE the sweep crossing: route the burst
+        # through the metrics plane so its duration still lands in the
+        # stage's publish-phase histogram (ISSUE 20)
+        plane = self._native_plane()
         while self._nv_emit:
             ent = self._nv_emit[0]
             slot, tbl, pos = ent
@@ -679,11 +683,11 @@ class VerifyStage(Stage):
             if self.ring_clock:
                 _t = pc()
                 done = p.publish_burst_raw(c.slots[slot].arena_ptr, sub,
-                                           len(sub))
+                                           len(sub), plane)
                 self.ring_publish_s += pc() - _t
             else:
                 done = p.publish_burst_raw(c.slots[slot].arena_ptr, sub,
-                                           len(sub))
+                                           len(sub), plane)
             if done:
                 self.metrics.inc("frags_out", done)
             ent[2] = pos + done

@@ -130,12 +130,28 @@ def _load():
     lib.fdr_sweep.argtypes = [
         ctypes.POINTER(PL), ctypes.POINTER(PC), u64, ctypes.POINTER(u64),
         u64, ctypes.c_void_p, u64, ctypes.c_void_p, ctypes.POINTER(u64),
-        ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
     ]
     lib.fdr_sweep.restype = ctypes.c_int64
     lib.fdr_publish_n.argtypes = [PL, PP, ctypes.c_char_p, u64, u64]
     lib.fdr_consume_n.argtypes = [PL, PC, ctypes.c_char_p, u64, u64]
     lib.fdr_consume_n.restype = u64
+    # the metrics-plane surface (runtime/native_metrics.py declares the
+    # fdm_plane struct and proves its layout; here the plane travels as
+    # an opaque pointer)
+    lib.fdr_publish_burst_prof.argtypes = [
+        PL, PP, ctypes.c_char_p, ctypes.c_void_p, u64, ctypes.c_void_p,
+    ]
+    lib.fdr_publish_burst_prof.restype = u64
+    # the native relay sweep client (chaos coverage)
+    lib.fdr_relay_new.argtypes = [PL, u64, u64]
+    lib.fdr_relay_new.restype = ctypes.c_void_p
+    lib.fdr_relay_set_metrics.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    lib.fdr_relay_seq_sync.argtypes = [ctypes.c_void_p, u64]
+    lib.fdr_relay_counts.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(u64), ctypes.POINTER(u64),
+    ]
+    lib.fdr_relay_free.argtypes = [ctypes.c_void_p]
     _lib = lib
     return lib
 
@@ -226,13 +242,14 @@ class NativeProducer:
             tsorig,
         ))
 
-    def publish_burst(self, items) -> int:
+    def publish_burst(self, items, plane=None) -> int:
         """Publish a frame list [(payload, sig, tsorig), ...] with ONE
         crossing; credit-gated per frame.  Returns frames published (the
         tail past credit exhaustion stays with the caller).  The frame
         table is built only for the creditable PREFIX — a retry queue
         deep in backpressure must not pay an O(queue) join per sweep to
-        publish a handful of frames."""
+        publish a handful of frames.  `plane` (NativePlane) times the
+        burst into the stage's publish-phase histogram in C."""
         n = len(items)
         if not n:
             return 0
@@ -257,12 +274,16 @@ class NativeProducer:
             tbl[k, 3] = tsorig
             off += sz
         buf = b"".join(items[k][0] for k in range(n))
+        if plane is not None:
+            return int(self._lib.fdr_publish_burst_prof(
+                self._lsp, self._pp, buf, tbl.ctypes.data, n, plane.ptr,
+            ))
         return int(self._lib.fdr_publish_burst(
             self._lsp, self._pp, buf, tbl.ctypes.data, n,
         ))
 
     def publish_burst_raw(self, buf_ptr: int, tbl: np.ndarray,
-                          n: int) -> int:
+                          n: int, plane=None) -> int:
         """fdr_publish_burst over frames that already live in native
         memory (the verify sweep client's slot arenas): buf_ptr is the
         arena base, tbl an (n, 4) u64 (off, sz, sig, tsorig) table —
@@ -270,11 +291,17 @@ class NativeProducer:
         with the caller.  Contract: the caller's frame assembler bounds
         every sz by the link mtu (fd_verify.cpp frames are TXN_MTU +
         descriptor, and verify out links carry mtu >= that); the C side
-        trusts the rows."""
+        trusts the rows.  `plane` (a runtime/native_metrics.NativePlane)
+        times the burst into the publish-phase histogram in C."""
         if not n:
             return 0
         if self._lsp is None:
             raise RuntimeError("detached native producer (link closed)")
+        if plane is not None:
+            return int(self._lib.fdr_publish_burst_prof(
+                self._lsp, self._pp, ctypes.cast(buf_ptr, ctypes.c_char_p),
+                tbl.ctypes.data, n, plane.ptr,
+            ))
         return int(self._lib.fdr_publish_burst(
             self._lsp, self._pp, ctypes.cast(buf_ptr, ctypes.c_char_p),
             tbl.ctypes.data, n,
@@ -483,11 +510,16 @@ class SweepDrainer(BurstDrainer):
     stage loop batch-observes frag latencies from the tsorig column."""
 
     def __init__(self, consumers: list[NativeConsumer], max_frags: int,
-                 client):
+                 client, plane=None):
         super().__init__(consumers, max_frags)
         self.client = client
         self._cb = client.cb
         self._cb_ctx = client.cb_ctx
+        # in-crossing observability (runtime/native_metrics.NativePlane):
+        # cached as a raw pointer once — the sweep call must not rebuild
+        # argument temporaries (FD212)
+        self.plane = plane
+        self._plane_p = plane.ptr if plane is not None else None
 
     def sweep(self, rr: int, max_frags: int) -> tuple[int, int, int]:
         """(frags processed, next rr cursor, overruns this sweep)."""
@@ -499,5 +531,56 @@ class SweepDrainer(BurstDrainer):
             self._links, self._cons, self._n, self._rrp,
             min(max_frags, self.max_frags), self._arena_p, self._arena_sz,
             self._meta_p, self._ovrnp, self._cb, self._cb_ctx,
+            self._plane_p,
         )
         return int(n), int(self._rr.value), int(self._ovrn.value)
+
+
+class NativeRelayClient:
+    """The native relay sweep client (fd_ring.cpp fdr_relay_*): forward
+    every drained frag onto one output link, lossy under backpressure —
+    the zero-Python twin of chaos' relay stages, so crash scenarios
+    exercise a REAL native crossing whose flight events must survive
+    SIGKILL.  `crash_at` non-zero makes the C side _exit(42) on the
+    first frag with sig >= crash_at (CrashLoopRelayStage's flank)."""
+
+    def __init__(self, out_link: shm.ShmLink, fseq_idx: int = 0,
+                 crash_at: int = 0):
+        self._lib = _load()
+        self._ls, self._keep = _link_struct(out_link)
+        self._h = self._lib.fdr_relay_new(ctypes.byref(self._ls),
+                                          fseq_idx, crash_at)
+        self.cb = ctypes.cast(self._lib.fdr_relay_cb, ctypes.c_void_p)
+        self.cb_ctx = ctypes.c_void_p(self._h)
+        self.link = out_link
+        _register(out_link, self)
+
+    def set_metrics(self, plane) -> None:
+        """Arm the in-crossing metrics plane (NativePlane) — publish
+        phase attribution + the crash-path flight flush.  `plane` None
+        disarms."""
+        self._plane = plane  # keepalive: C holds a raw pointer
+        self._lib.fdr_relay_set_metrics(
+            self._h, plane.ptr if plane is not None else None)
+
+    def seq_sync(self, seq: int) -> None:
+        """Align the relay's producer cursor with the out ring (the
+        in-place-restart resume path)."""
+        self._lib.fdr_relay_seq_sync(self._h, seq)
+
+    def counts(self) -> tuple[int, int]:
+        """(forwarded, dropped) so far."""
+        fwd = ctypes.c_uint64(0)
+        drop = ctypes.c_uint64(0)
+        self._lib.fdr_relay_counts(self._h, ctypes.byref(fwd),
+                                   ctypes.byref(drop))
+        return int(fwd.value), int(drop.value)
+
+    def detach(self) -> None:
+        if self._h is not None:
+            self._lib.fdr_relay_free(self._h)
+        self._h = None
+        self.cb = self.cb_ctx = None
+        self._ls = None
+        self._keep = None
+        self.link = None

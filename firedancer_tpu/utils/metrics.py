@@ -53,6 +53,12 @@ class MetricDef:
     kind: str
     help: str = ""
     buckets: tuple = ()  # histogram edges, ascending
+    # native=True marks a metric OWNED by a C sweep client: it is written
+    # in-line from inside the fdr_sweep crossing, so the Python Metrics
+    # facade must neither flush nor resume-copy these words (either would
+    # clobber the relaxed-atomic C increments).  fdlint FD219 enforces
+    # the ownership split statically.
+    native: bool = False
 
     def words(self) -> int:
         if self.kind == HISTOGRAM:
@@ -64,19 +70,23 @@ class MetricDef:
 class MetricsSchema:
     defs: list[MetricDef] = field(default_factory=list)
 
-    def counter(self, name: str, help: str = "") -> "MetricsSchema":
-        self.defs.append(MetricDef(name, COUNTER, help))
+    def counter(self, name: str, help: str = "", *,
+                native: bool = False) -> "MetricsSchema":
+        self.defs.append(MetricDef(name, COUNTER, help, native=native))
         return self
 
-    def gauge(self, name: str, help: str = "") -> "MetricsSchema":
-        self.defs.append(MetricDef(name, GAUGE, help))
+    def gauge(self, name: str, help: str = "", *,
+              native: bool = False) -> "MetricsSchema":
+        self.defs.append(MetricDef(name, GAUGE, help, native=native))
         return self
 
-    def histogram(self, name: str, buckets, help: str = "") -> "MetricsSchema":
+    def histogram(self, name: str, buckets, help: str = "", *,
+                  native: bool = False) -> "MetricsSchema":
         edges = tuple(buckets)
         if list(edges) != sorted(edges) or not edges:
             raise ValueError("histogram buckets must be ascending, non-empty")
-        self.defs.append(MetricDef(name, HISTOGRAM, help, edges))
+        self.defs.append(MetricDef(name, HISTOGRAM, help, edges,
+                                   native=native))
         return self
 
     def footprint(self) -> int:
@@ -89,18 +99,22 @@ class MetricsSchema:
 def schema_to_obj(schema: MetricsSchema) -> list[dict]:
     """JSON-serializable schema (run-descriptor form): a monitor process
     reconstructs the registry layout without importing stage classes."""
-    return [
-        {"name": d.name, "kind": d.kind, "help": d.help,
-         "buckets": list(d.buckets)}
-        for d in schema.defs
-    ]
+    out = []
+    for d in schema.defs:
+        o = {"name": d.name, "kind": d.kind, "help": d.help,
+             "buckets": list(d.buckets)}
+        if d.native:  # omit-when-false keeps old descriptors byte-stable
+            o["native"] = True
+        out.append(o)
+    return out
 
 
 def schema_from_obj(obj: list[dict]) -> MetricsSchema:
     s = MetricsSchema()
     for d in obj:
         s.defs.append(MetricDef(d["name"], d["kind"], d.get("help", ""),
-                                tuple(d.get("buckets", ()))))
+                                tuple(d.get("buckets", ())),
+                                native=bool(d.get("native", False))))
     return s
 
 
@@ -233,6 +247,41 @@ def latency_row_merged(regs: list) -> dict:
     return out
 
 
+def nsweep_phase_row(regs: list) -> dict:
+    """Per-phase p50 sweep durations in us, merged across the shard
+    registries of one logical stage — the monitor's sweep-phase column
+    (ISSUE 20 tentpole b).  Phases with no crossings map to None."""
+    out = {}
+    for ph in NSWEEP_PHASES:
+        name = f"nsweep_{ph}_ns"
+        merged = None
+        for reg in regs:
+            if reg is None or name not in reg._off:
+                continue
+            h = reg.hist(name)
+            if merged is None:
+                merged = h
+            else:
+                merged["counts"] = [a + b for a, b in
+                                    zip(merged["counts"], h["counts"])]
+                merged["count"] += h["count"]
+        v = None
+        if merged and merged["count"]:
+            q = hist_quantile(merged, 0.5)
+            v = None if q == float("inf") else q / 1e3
+        out[ph] = v
+    return out
+
+
+def format_phase_cell(row: dict) -> str:
+    """Compact sweep-phase cell: 'd12/c48/a3/p7' (p50 us per phase,
+    phases without crossings omitted), '-' when the stage has no native
+    sweep client."""
+    parts = [f"{ph[0]}{row[ph]:.0f}" for ph in NSWEEP_PHASES
+             if row.get(ph) is not None]
+    return "/".join(parts) if parts else "-"
+
+
 def format_latency_ms(v: float | None) -> str:
     """One cell of the monitor's latency columns: '-' when the metrics
     plane is not joined, '>max' when the quantile overflowed the last
@@ -325,17 +374,28 @@ class MetricsServer:
     mutated live; every scrape renders the current registries."""
 
     def __init__(self, stages: dict[str, MetricsRegistry], *,
-                 host="127.0.0.1", port=0, labels: dict | None = None):
+                 host="127.0.0.1", port=0, labels: dict | None = None,
+                 resolver=None):
         from firedancer_tpu.protocol import http as H
 
         self.stages = stages
         self.labels = labels
+        # resolver: optional () -> (stages, labels), consulted per scrape
+        # so a scraper over an externally-attached session re-resolves
+        # the registry set instead of serving a boot-time snapshot that
+        # goes stale across an in-place restart (ISSUE 20 satellite 2)
+        self.resolver = resolver
 
         def handler(req, _body):
             if req.method != "GET":
                 return H.build_response(405, b"GET only\n")
             if req.path not in ("/metrics", "/"):
                 return H.build_response(404, b"not found\n")
+            if self.resolver is not None:
+                try:
+                    self.stages, self.labels = self.resolver()
+                except (RuntimeError, OSError):
+                    pass  # keep serving the last good registry set
             # snapshot the dict: a registrar may add stages while a
             # scrape renders (this runs on a per-connection thread)
             body = render_prometheus(dict(self.stages),
@@ -375,6 +435,9 @@ EV_SLOT_MISSED = 14    # slot boundary passed unsealed — MISSED (arg = slot)
 EV_SLOT_ROLL = 15      # slot boundary observed by a non-poh stage (arg = slot)
 EV_SLOT_SHED = 16      # pack shed pending work at the deadline (arg = txns)
 EV_RESTART = 17        # stage resumed in place after a supervisor respawn
+EV_NSWEEP_DRAIN = 18   # native sweep crossing drained (arg = frags; C-side,
+                       # decimated — every FDM_FLIGHT_DECIMATE crossings)
+EV_NSWEEP_PUBLISH = 19  # native sweep crossing published (arg = frags; C-side)
 
 EVENT_NAMES = {
     EV_BOOT: "boot",
@@ -394,6 +457,8 @@ EVENT_NAMES = {
     EV_SLOT_ROLL: "slot_roll",
     EV_SLOT_SHED: "slot_shed",
     EV_RESTART: "restart",
+    EV_NSWEEP_DRAIN: "nsweep_drain",
+    EV_NSWEEP_PUBLISH: "nsweep_publish",
 }
 
 FLIGHT_DEPTH = 512  # records per stage ring (fixed, small: ~12 KiB)
@@ -510,10 +575,25 @@ def _segment_views(arr: np.ndarray, schema: MetricsSchema):
     b = a + n_met
     reg = MetricsRegistry(schema, buf=arr[a:b])
     rec = FlightRecorder(words=arr[b:])
+    # retain the whole-segment view: the native metrics plane
+    # (runtime/native_metrics.py) derives the segment base address from
+    # it so fdm_plane_attach can re-validate the header magic in C
+    reg._seg = arr
     return reg, rec
 
 
 # -- flight dumps + Chrome trace export ---------------------------------------
+
+
+def registry_obj(reg: MetricsRegistry) -> dict:
+    """Structured (JSON-ready) snapshot of one registry: counters/gauges
+    as ints, histograms as hist() dicts.  The slotreport --dump path
+    reads THIS (not the Prometheus text) out of flight dumps."""
+    out: dict = {}
+    for d in reg.schema.defs:
+        out[d.name] = reg.hist(d.name) if d.kind == HISTOGRAM \
+            else reg.get(d.name)
+    return out
 
 
 def flight_dump_obj(uid: str, stages: dict, *, failed: str | None = None,
@@ -533,6 +613,9 @@ def flight_dump_obj(uid: str, stages: dict, *, failed: str | None = None,
         }
         if reg is not None:
             regs[name] = reg
+            # structured snapshot per stage so post-mortem tooling
+            # (slotreport --dump) never has to re-parse Prometheus text
+            obj["stages"][name]["metrics"] = registry_obj(reg)
     if regs:
         obj["metrics"] = render_prometheus(regs)
     return obj
@@ -589,9 +672,11 @@ def flight_to_chrome_trace(dump: dict) -> dict:
 
 
 # The stage-loop schema every pipeline stage shares (the "all tiles" block
-# of metrics.xml): frag counters + latency histograms.
+# of metrics.xml): frag counters + latency histograms, plus the
+# native-sweep block below so any stage a C sweep client drives can be
+# instrumented from INSIDE the fdr_sweep crossing without a relaunch.
 def stage_schema() -> MetricsSchema:
-    return (
+    s = (
         MetricsSchema()
         .counter("frags_in", "fragments consumed")
         .counter("frags_out", "fragments published")
@@ -614,3 +699,46 @@ def stage_schema() -> MetricsSchema:
             " housekeeping cadence — the autotuner's sizing evidence",
         )
     )
+    return add_native_sweep_schema(s)
+
+
+# Sweep-phase profiler buckets: one crossing drains <= burst frags, so
+# phase durations span ~100 ns (idle publish) to ~100 ms (a stalled
+# funk apply under chaos).
+NSWEEP_PHASE_BUCKETS = exp_buckets(1e2, 1e9, 22)
+
+# The sweep-phase histogram per phase, in crossing order.  The names
+# double as the slotreport "sweep_phases" keys.
+NSWEEP_PHASES = ("drain", "callback", "apply", "publish")
+
+
+def add_native_sweep_schema(s: MetricsSchema) -> MetricsSchema:
+    """The native-sweep observability block (ISSUE 20 tentpole a+b):
+    counters + per-phase histograms written ONLY by C code inside the
+    fdr_sweep crossing (native=True: the Python facade neither flushes
+    nor resume-copies these words)."""
+    s.counter("nsweep_frags",
+              "frags consumed inside native sweep crossings", native=True)
+    s.counter("nsweep_crossings",
+              "non-empty native sweep crossings", native=True)
+    for ph in NSWEEP_PHASES:
+        s.histogram(
+            f"nsweep_{ph}_ns", NSWEEP_PHASE_BUCKETS,
+            f"native sweep {ph}-phase duration per crossing (ns)",
+            native=True,
+        )
+    s.histogram(
+        "nsweep_lat_ns", exp_buckets(1e3, 1e10, 24),
+        "tsorig->consume latency per frag, stamped in-crossing by C"
+        " (the native twin of frag_latency_ns)",
+        native=True,
+    )
+    return s
+
+
+def native_owned_names() -> frozenset:
+    """Every metric name a registered native sweep client may write —
+    the FD219 double-count set (analysis/ast_rules.py mirrors it)."""
+    names = {d.name for d in stage_schema().defs if d.native}
+    names.add("nbank_txn_lat_ns")  # bank's per-txn extra (runtime/bank.py)
+    return frozenset(names)

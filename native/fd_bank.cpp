@@ -48,6 +48,8 @@
 #include <cstring>
 #include <ctime>
 
+#include "fd_metrics.h"
+
 namespace {
 
 typedef uint8_t u8;
@@ -225,6 +227,10 @@ struct BankStageCtx {
   u64 funk_xid_len;
   u8 funk_xid[128];           // FFK_XID_MAX
   u8* fkrecs; u64 fkrecs_cap; // stripped-record scratch
+  // shm metrics plane (fdb_stage_set_metrics; null = dark): the SAME
+  // plane fdr_sweep carries, so apply/publish brackets here land in
+  // that crossing's fdm_sweep_end phase decomposition
+  fdm_plane* mplane;
   // flags + counters Python reads off the struct (no FFI);
   // fdb_stage_flags_off pins this offset
   u64 log_sz;
@@ -348,6 +354,14 @@ int fdb_stage_set_funk(void* p, void* funk, void* slot_fn, void* insert_fn,
   std::memcpy(st->funk_xid, xid, xid_len);
   st->funk_xid_len = xid_len;
   return st->funk_slot(st->funk, st->funk_xid, (int32_t)xid_len) >= 0 ? 1 : 2;
+}
+
+// Arm/disarm the shm metrics plane (ISSUE 20).  The pointer is the
+// stage's own fdm_plane — the one its SweepDrainer already passes to
+// fdr_sweep — so the apply/publish accumulators bracketed below fold
+// into the same crossing's phase histograms.
+void fdb_stage_set_metrics(void* p, fdm_plane* plane) {
+  ((BankStageCtx*)p)->mplane = plane;
 }
 
 // The env/gate prefix changes when Python re-arms the session (slot
@@ -534,6 +548,12 @@ int fdb_frag_cb(void* vctx, const u64* meta8, const u8* payload) {
   }
   u64 lat_ns = now_ns() - tsorig;
   st->txn_native += n_done;
+  // per-txn commit latency, stamped in-crossing: every txn in the
+  // microblock commits atomically with it, so each gets the group's
+  // latency — a per-txn-weighted distribution (nbank_txn_lat_ns)
+  if (st->mplane && (st->mplane->flags & FDM_F_XLAT) && tsorig)
+    for (u32 t = 0; t < n_done; t++)
+      fdm_hist_obs(st->mplane->met, &st->mplane->xlat, (double)lat_ns);
 
   // native funk plane: the session has committed these records, so put
   // them straight into the shm map NOW (slot-direct upserts) and log a
@@ -544,6 +564,7 @@ int fdb_frag_cb(void* vctx, const u64* meta8, const u8* payload) {
   const u8* lrecs = recs;
   u64 lrecs_sz = recs_sz;
   if (st->funk && n_done) {
+    u64 t_apply = st->mplane ? fdm_now_ns() : 0;
     int32_t ti = st->funk_slot(st->funk, st->funk_xid,
                                (int32_t)st->funk_xid_len);
     int ok = ti >= 0 &&
@@ -577,6 +598,8 @@ int fdb_frag_cb(void* vctx, const u64* meta8, const u8* payload) {
     } else {
       st->funk_falls++;
     }
+    if (st->mplane)
+      fdm_accum(st->mplane, FDM_PH_APPLY, fdm_now_ns() - t_apply);
   }
 
   if (punted || n_done < cnt) {
@@ -619,8 +642,12 @@ int fdb_frag_cb(void* vctx, const u64* meta8, const u8* payload) {
     }
     hx.final(st->ent);
     wr16(st->ent + 32, (u16)n_landed);
-    if (!st->publish(st->ent_link, st->ent_prod, st->ent, ent_sz, mb_seq,
-                     tsorig)) {
+    u64 t_pub = st->mplane ? fdm_now_ns() : 0;
+    int ent_ok = st->publish(st->ent_link, st->ent_prod, st->ent, ent_sz,
+                             mb_seq, tsorig);
+    if (st->mplane)
+      fdm_accum(st->mplane, FDM_PH_PUBLISH, fdm_now_ns() - t_pub);
+    if (!ent_ok) {
       // credits were pre-gated, so this is an out-mtu mismatch: fall
       // back to Python for the publish half (state is already committed
       // session-side; the n_done records carry it across)
@@ -631,8 +658,12 @@ int fdb_frag_cb(void* vctx, const u64* meta8, const u8* payload) {
     }
   }
   static const u8 kEmpty = 0;  // 0-byte done frame: non-null for memcpy
-  if (!st->publish(st->done_link, st->done_prod, &kEmpty, 0, st->bank_idx,
-                   0)) {
+  u64 t_done = st->mplane ? fdm_now_ns() : 0;
+  int done_ok = st->publish(st->done_link, st->done_prod, &kEmpty, 0,
+                            st->bank_idx, 0);
+  if (st->mplane)
+    fdm_accum(st->mplane, FDM_PH_PUBLISH, fdm_now_ns() - t_done);
+  if (!done_ok) {
     published = 2;  // entry is out; Python publishes only the done frame
   }
   st->mb_native++;

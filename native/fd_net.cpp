@@ -49,6 +49,8 @@
 #include <errno.h>
 #endif
 
+#include "fd_metrics.h"
+
 typedef uint8_t u8;
 typedef uint16_t u16;
 typedef uint32_t u32;
@@ -519,8 +521,28 @@ struct NetCtx {
   u64 arena_used;
   u8 *arena;
   u64 counters[C_COUNT];
+  // shm metrics plane (fdn_set_metrics; null = dark): socket sweeps
+  // observe the drain phase, per-datagram decrypt+apply the callback
+  // phase — the publish phase rides the Python-side burst crossing
+  fdm_plane *mplane;
   u8 scratch[2048];
 };
+
+// Source-stage drain observe: net has no fdr_sweep epilogue, so the
+// socket sweep records its own crossing (drain hist + counters + the
+// decimated flight trail).
+static inline void net_obs_drain(NetCtx *c, u64 t0, i32 total) {
+  fdm_plane *pl = c->mplane;
+  if (!pl || total <= 0) return;
+  if (pl->flags & FDM_F_PH)
+    fdm_hist_obs(pl->met, &pl->ph[FDM_PH_DRAIN],
+                 (double)(fdm_now_ns() - t0));
+  fdm_ctr_add(pl, pl->c_frags_off, (u64)total);
+  fdm_ctr_add(pl, pl->c_crossings_off, 1);
+  if ((pl->crossings % FDM_FLIGHT_DECIMATE) == 0)
+    fdm_flight(pl, FDM_EV_NSWEEP_DRAIN, (u64)total);
+  pl->crossings++;
+}
 
 static inline u64 hash64(u64 x) {
   x ^= x >> 33; x *= 0xff51afd7ed558ccdULL;
@@ -943,8 +965,8 @@ extern "C" {
 // 1 = PUNT (run the Python lane on these exact bytes, in order),
 // 2 = dropped+counted here (dedup/bad packet — the Python lane would
 //     have dropped it the same way).
-i32 fdn_datagram(void *ctx, const u8 *data, i32 sz, u32 addr_id) {
-  NetCtx *c = (NetCtx *)ctx;
+static i32 fdn_datagram_inner(NetCtx *c, const u8 *data, i32 sz,
+                              u32 addr_id) {
   c->counters[C_RX_DGRAM]++;
   if (sz <= 0) { c->counters[C_PUNT]++; return RC_PUNT; }
   if (data[0] & 0x80) {  // long header: handshake/control plane
@@ -1038,6 +1060,31 @@ i32 fdn_datagram(void *ctx, const u8 *data, i32 sz, u32 addr_id) {
   return RC_CONSUMED;
 }
 
+// One datagram, synchronously — the metrics-armed wrapper: the
+// decrypt+frame-apply span observes into the callback-phase histogram
+// (one crossing per datagram; this path already pays a syscall per
+// packet, so two clock reads are noise).
+i32 fdn_datagram(void *ctx, const u8 *data, i32 sz, u32 addr_id) {
+  NetCtx *c = (NetCtx *)ctx;
+  fdm_plane *pl = c->mplane;
+  if (!pl) return fdn_datagram_inner(c, data, sz, addr_id);
+  u64 t0 = fdm_now_ns();
+  i32 rc = fdn_datagram_inner(c, data, sz, addr_id);
+  if (pl->flags & FDM_F_PH)
+    fdm_hist_obs(pl->met, &pl->ph[FDM_PH_CB], (double)(fdm_now_ns() - t0));
+  fdm_ctr_add(pl, pl->c_frags_off, 1);
+  fdm_ctr_add(pl, pl->c_crossings_off, 1);
+  if ((pl->crossings % FDM_FLIGHT_DECIMATE) == 0)
+    fdm_flight(pl, FDM_EV_NSWEEP_DRAIN, 1);
+  pl->crossings++;
+  return rc;
+}
+
+// Arm/disarm the shm metrics plane (ISSUE 20).
+void fdn_set_metrics(void *ctx, fdm_plane *plane) {
+  ((NetCtx *)ctx)->mplane = plane;
+}
+
 // Real recvmmsg under the sweep (ISSUE 19 satellite): ONE syscall
 // drains the UDP burst and the kernel scatters each datagram DIRECTLY
 // into its out-arena slot — per-packet iovecs at NET_TXN_MTU stride, no
@@ -1052,6 +1099,7 @@ i32 fdn_udp_sweep(void *ctx, i32 fd, i32 max_pkts) {
   enum { BATCH = 64 };
   struct mmsghdr msgs[BATCH];
   struct iovec iovs[BATCH];
+  u64 t0 = c->mplane ? fdm_now_ns() : 0;
   i32 total = 0;
   while (total < max_pkts) {
     i32 want = max_pkts - total;
@@ -1086,6 +1134,7 @@ i32 fdn_udp_sweep(void *ctx, i32 fd, i32 max_pkts) {
     total += got;
     if (got < want) break;  // socket drained mid-batch
   }
+  net_obs_drain(c, t0, total);
   return total;
 #else
   (void)ctx; (void)fd; (void)max_pkts;
@@ -1105,6 +1154,7 @@ i32 fdn_udp_sweep_scalar(void *ctx, i32 fd, i32 max_pkts) {
 #else
   NetCtx *c = (NetCtx *)ctx;
   u8 buf[2048];
+  u64 t0 = c->mplane ? fdm_now_ns() : 0;
   i32 total = 0;
   while (total < max_pkts) {
     if (c->out_n >= OUT_CAP ||
@@ -1123,6 +1173,7 @@ i32 fdn_udp_sweep_scalar(void *ctx, i32 fd, i32 max_pkts) {
     memcpy(c->arena + c->arena_used, buf, (size_t)got);
     c->arena_used += (u64)got;
   }
+  net_obs_drain(c, t0, total);
   return total;
 #endif
 }

@@ -31,8 +31,12 @@
 
 #include <atomic>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <ctime>
+#include <unistd.h>
+
+#include "fd_metrics.h"
 
 namespace {
 
@@ -327,11 +331,24 @@ int64_t fdr_drain(fdr_link* const* links, fdr_consumer* const* cons,
 typedef int (*fdr_sweep_cb)(void* ctx, const uint64_t* meta8,
                             const uint8_t* payload);
 
+// The trailing `plane` is the in-crossing observability hook (ISSUE
+// 20): when non-null, the sweep stamps CLOCK_MONOTONIC at every
+// consumed-frag boundary (two reads per frag, none per idle poll pass
+// beyond the crossing edges) and decomposes the crossing into
+// drain / callback / apply / publish phase histograms — apply and
+// publish arrive from the stage callback via the plane's accumulators
+// (fdm_accum), callback time is reported net of them.  Per-frag
+// tsorig latency observes into nsweep_lat_ns in the same breath, and
+// fdm_sweep_end leaves decimated flight records straight in shm, so a
+// SIGKILL mid-sweep still shows the crossing in the dump.
 int64_t fdr_sweep(fdr_link* const* links, fdr_consumer* const* cons,
                   uint64_t n_links, uint64_t* rr_io, uint64_t max_frags,
                   uint8_t* arena, uint64_t arena_sz, uint64_t* meta_out,
-                  uint64_t* ovrn_out, fdr_sweep_cb cb, void* cb_ctx) {
+                  uint64_t* ovrn_out, fdr_sweep_cb cb, void* cb_ctx,
+                  fdm_plane* plane) {
   uint64_t got = 0, off = 0, rr = *rr_io, idle = 0, ovrn = 0;
+  uint64_t drain_ns = 0, cb_ns = 0;
+  uint64_t t_mark = plane ? fdm_now_ns() : 0;
   int stop = 0;
   while (!stop && got < max_frags && idle < n_links) {
     uint64_t i = rr % n_links;
@@ -344,7 +361,17 @@ int64_t fdr_sweep(fdr_link* const* links, fdr_consumer* const* cons,
     if (rc == 0) {
       m[2] = off;
       m[7] = i;
-      if (cb(cb_ctx, m, arena + off) < 0) stop = 1;
+      if (plane) {
+        uint64_t t1 = fdm_now_ns();
+        drain_ns += t1 - t_mark;
+        fdm_lat_obs(plane, t1, m[5]);
+        if (cb(cb_ctx, m, arena + off) < 0) stop = 1;
+        uint64_t t2 = fdm_now_ns();
+        cb_ns += t2 - t1;
+        t_mark = t2;
+      } else {
+        if (cb(cb_ctx, m, arena + off) < 0) stop = 1;
+      }
       off += m[3];
       got++;
       idle = 0;
@@ -355,9 +382,148 @@ int64_t fdr_sweep(fdr_link* const* links, fdr_consumer* const* cons,
       idle++;
     }
   }
+  if (plane) {
+    drain_ns += fdm_now_ns() - t_mark;  // trailing idle passes drain out
+    fdm_sweep_end(plane, got, drain_ns, cb_ns);
+  }
   *rr_io = rr % n_links;
   *ovrn_out = ovrn;
   return (int64_t)got;
+}
+
+// -- the metrics plane's exported surface ------------------------------------
+//
+// The fdm_* inline writers live in fd_metrics.h (each client .so
+// carries its own copy); this TU additionally exports the attach
+// validator + differential-test drivers so the Python side can prove
+// the C writers byte-identical to utils/metrics.py without a topology.
+
+uint64_t fdm_abi_version(void) { return FDM_ABI_VERSION; }
+
+// Validate a plane against its raw shm segment: header magic, metric
+// word count and recorder capacity must agree with what the Python
+// binding derived (utils/metrics.py metrics_segment_* layout).
+// Returns 0 ok, negative = which check failed.
+int fdm_plane_attach(fdm_plane* pl, const uint64_t* seg,
+                     uint64_t seg_words) {
+  if (pl->version != FDM_ABI_VERSION) return -1;
+  if (seg_words < FDM_SEG_HDR_WORDS) return -2;
+  if (seg[0] != FDM_SEG_MAGIC) return -3;
+  uint64_t n_met = seg[1];
+  uint64_t rec_cap = seg[2];
+  if (seg_words < FDM_SEG_HDR_WORDS + n_met + 1 + rec_cap * FDM_REC_WORDS)
+    return -4;
+  if (pl->met != seg + FDM_SEG_HDR_WORDS) return -5;
+  if (pl->rec && pl->rec != seg + FDM_SEG_HDR_WORDS + n_met) return -6;
+  if (pl->rec && pl->rec_cap != rec_cap) return -7;
+  return 0;
+}
+
+// Differential-test drivers: apply n observations/bumps through the C
+// writers so tests diff the resulting words against Python's
+// MetricsRegistry/FlightRecorder doing the same operations.
+void fdm_test_ctr(fdm_plane* pl, uint64_t off, uint64_t v) {
+  fdm_ctr_add(pl, off, v);
+}
+
+void fdm_test_hist(fdm_plane* pl, const fdm_hist* h, const double* vals,
+                   uint64_t n) {
+  for (uint64_t i = 0; i < n; i++) fdm_hist_obs(pl->met, h, vals[i]);
+}
+
+void fdm_test_flight(fdm_plane* pl, uint64_t ev, uint64_t arg) {
+  fdm_flight(pl, ev, arg);
+}
+
+void fdm_test_sweep_end(fdm_plane* pl, uint64_t got, uint64_t drain_ns,
+                        uint64_t cb_ns, uint64_t apply_ns,
+                        uint64_t pub_ns) {
+  fdm_accum(pl, FDM_PH_APPLY, apply_ns);
+  fdm_accum(pl, FDM_PH_PUBLISH, pub_ns);
+  fdm_sweep_end(pl, got, drain_ns, cb_ns);
+}
+
+// Plane-timed burst publish: fdr_publish_burst with the burst duration
+// observed into the publish-phase histogram (for clients whose publish
+// crossing happens outside the sweep callback — verify's reap path).
+uint64_t fdr_publish_burst_prof(const fdr_link* l, fdr_producer* p,
+                                const uint8_t* buf, const uint64_t* tbl,
+                                uint64_t n, fdm_plane* plane) {
+  if (!plane) return fdr_publish_burst(l, p, buf, tbl, n);
+  uint64_t t0 = fdm_now_ns();
+  uint64_t done = fdr_publish_burst(l, p, buf, tbl, n);
+  fdm_publish_obs(plane, fdm_now_ns() - t0, done);
+  return done;
+}
+
+// -- native relay sweep client (chaos coverage) ------------------------------
+//
+// A zero-Python relay: forward every drained frag onto one output link
+// (lossy — a frag that finds no credits is dropped and counted, the
+// same contract chaos' ChaosRelayStage has in Python).  Exists so the
+// chaos stage-kill / crash-mid-slot scenarios exercise a REAL native
+// sweep client whose in-crossing flight events must survive SIGKILL.
+// `crash_at` non-zero arms the crash-loop flank: the relay _exit(42)s
+// the process the moment it consumes a frag with sig >= crash_at —
+// after the publish, mirroring CrashLoopRelayStage's os._exit(42).
+struct fdr_relay {
+  const fdr_link* out;
+  fdr_producer prod;
+  fdm_plane* plane;
+  uint64_t forwarded;
+  uint64_t dropped;
+  uint64_t crash_at;
+};
+
+void* fdr_relay_new(const fdr_link* out, uint64_t fseq_idx,
+                    uint64_t crash_at) {
+  fdr_relay* r = new fdr_relay();
+  r->out = out;
+  fdr_producer_init(out, &r->prod);
+  r->prod.n_rel = 1;
+  r->prod.rel_idx[0] = fseq_idx;
+  r->plane = nullptr;
+  r->forwarded = 0;
+  r->dropped = 0;
+  r->crash_at = crash_at;
+  return r;
+}
+
+void fdr_relay_set_metrics(void* ctx, fdm_plane* pl) {
+  static_cast<fdr_relay*>(ctx)->plane = pl;
+}
+
+void fdr_relay_seq_sync(void* ctx, uint64_t seq) {
+  static_cast<fdr_relay*>(ctx)->prod.seq = seq;
+}
+
+void fdr_relay_counts(void* ctx, uint64_t* fwd_out, uint64_t* drop_out) {
+  fdr_relay* r = static_cast<fdr_relay*>(ctx);
+  *fwd_out = r->forwarded;
+  *drop_out = r->dropped;
+}
+
+void fdr_relay_free(void* ctx) { delete static_cast<fdr_relay*>(ctx); }
+
+int fdr_relay_cb(void* ctx, const uint64_t* meta8, const uint8_t* payload) {
+  fdr_relay* r = static_cast<fdr_relay*>(ctx);
+  uint64_t t0 = r->plane ? fdm_now_ns() : 0;
+  if (fdr_try_publish(r->out, &r->prod, payload, meta8[3], meta8[1],
+                      meta8[5]))
+    r->forwarded++;
+  else
+    r->dropped++;
+  if (r->plane) fdm_accum(r->plane, FDM_PH_PUBLISH, fdm_now_ns() - t0);
+  if (r->crash_at && meta8[1] >= r->crash_at) {
+    // crash-loop flank: flush the crossing-so-far to shm first so the
+    // dump carries this crossing's phase records, then die abruptly
+    if (r->plane) {
+      fdm_flight(r->plane, FDM_EV_NSWEEP_DRAIN, 1);
+      fdm_flight(r->plane, FDM_EV_NSWEEP_PUBLISH, 1);
+    }
+    _exit(42);
+  }
+  return 0;
 }
 
 // Bulk benchmark helpers: move n frags entirely in native code (the

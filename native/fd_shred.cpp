@@ -34,6 +34,8 @@
 #include <cstdlib>
 #include <cstring>
 
+#include "fd_metrics.h"
+
 #if defined(__x86_64__)
 #include <cpuid.h>
 #include <immintrin.h>
@@ -1102,6 +1104,10 @@ struct ShredStageCtx {
   u8* arena;
   u64 arena_cap;
   u64 pending_bc;     // block_complete of a deferred flush (retry keeps it)
+  // shm metrics plane (fds_stage_set_metrics; null = dark): the shred
+  // burst and its publish loop attribute apply/publish phases into the
+  // sweep crossing's decomposition
+  fdm_plane* mplane;
   // flags + counters Python reads off the struct (no FFI)
   u64 pending_flush;  // batch closed for size but deferred for credits
   u64 entries_in, entry_batches, fec_sets;
@@ -1153,6 +1159,13 @@ void fds_stage_delete(void* p) {
   std::free(st->buf);
   std::free(st->arena);
   std::free(st);
+}
+
+// Arm/disarm the shm metrics plane (ISSUE 20): the SAME fdm_plane the
+// stage's SweepDrainer passes fdr_sweep, so the apply/publish accums
+// bracketed in stage_flush fold into that crossing's decomposition.
+void fds_stage_set_metrics(void* p, fdm_plane* plane) {
+  ((ShredStageCtx*)p)->mplane = plane;
 }
 
 void fds_stage_set_slot(void* p, u64 slot) {
@@ -1212,10 +1225,15 @@ static int stage_flush(ShredStageCtx* st, int block_complete, int force) {
       max_sets = 256;  // OOM fallback: may drop, counted below
     }
   }
+  u64 t_apply = st->mplane ? fdm_now_ns() : 0;
   i64 nsets = fds_shred_batch(st->sh, st->buf, st->buf_sz, st->slot,
                               st->parent_off, st->ref_tick, block_complete,
                               st->idx, st->arena, st->arena_cap, set_meta,
                               max_sets, sroots);
+  // the shred/encode burst is the stage's apply phase; the wire loop
+  // below is its publish phase (fdm_sweep_end nets both out of cb)
+  if (st->mplane)
+    fdm_accum(st->mplane, FDM_PH_APPLY, fdm_now_ns() - t_apply);
   u64 tsorig = st->tsorig_min;
   st->buf_sz = 0;
   st->tsorig_min = 0;
@@ -1226,6 +1244,7 @@ static int stage_flush(ShredStageCtx* st, int block_complete, int force) {
     return 1;
   }
   st->entry_batches++;
+  u64 t_pub = st->mplane ? fdm_now_ns() : 0;
   for (i64 s = 0; s < nsets; s++) {
     u64 d = set_meta[4 * s + 0];
     u64 pcnt = set_meta[4 * s + 1];
@@ -1247,6 +1266,8 @@ static int stage_flush(ShredStageCtx* st, int block_complete, int force) {
     st->frags_out += done;
     st->backpressure += (d + pcnt) - done;
   }
+  if (st->mplane)
+    fdm_accum(st->mplane, FDM_PH_PUBLISH, fdm_now_ns() - t_pub);
   if (heap_blk) std::free(heap_blk);
   return 1;
 }

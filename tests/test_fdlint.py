@@ -1327,3 +1327,78 @@ def test_fd218_registered_and_repo_clean():
     findings = [f for f in ast_rules.lint_path(root)
                 if f.rule == "FD218"]
     assert findings == [], findings
+
+
+# -- FD219: Python write on a native-owned metric with a sweep client armed ---
+
+
+_NATIVE_METRIC_SRC = '''
+class BankStage:
+    def __init__(self, client):
+        self._sweep_client = client
+
+    def after_frag(self, sig, frag):
+        self.metrics.observe("nsweep_apply_ns", 120.0)       # FD219
+        self.metrics.inc("nsweep_frags", 4)                  # FD219
+        self.metrics.observe("nbank_txn_lat_ns", 9.0)        # FD219
+        self.metrics.observe("frag_latency_ns", 9.0)         # non-native: ok
+        self.metrics.inc("frags_in")                         # non-native: ok
+
+    def during_housekeeping(self):
+        # cold paths double-count just as surely as hot ones
+        self.metrics.registry.store("nsweep_crossings", 1)   # FD219
+        self.recorder.record(17, 0)          # event id, not a name: ok
+
+    def report(self, name):
+        self.metrics.observe(name, 1.0)      # dynamic name: ok
+'''
+
+
+def test_fd219_flags_python_writes_on_native_owned_metrics():
+    findings = ast_rules.lint_source(
+        _NATIVE_METRIC_SRC, "firedancer_tpu/runtime/bank.py")
+    hits = [f for f in findings if f.rule == "FD219"]
+    msgs = [f.msg for f in hits]
+    assert len(hits) == 4, msgs
+    assert sum("nsweep_apply_ns" in m for m in msgs) == 1
+    assert sum("nsweep_frags" in m for m in msgs) == 1
+    assert sum("nbank_txn_lat_ns" in m for m in msgs) == 1
+    assert sum("nsweep_crossings" in m for m in msgs) == 1
+    # without the sweep-client registration the module owns its facade:
+    # the SAME writes are the legitimate Python metrics lane
+    ungated = _NATIVE_METRIC_SRC.replace(
+        "self._sweep_client = client", "self._client_off = client")
+    clean = [f for f in ast_rules.lint_source(
+        ungated, "firedancer_tpu/runtime/bank.py") if f.rule == "FD219"]
+    assert clean == [], clean
+
+
+def test_fd219_name_set_mirrors_metrics_schema():
+    # the lint mirror must track utils/metrics.native_owned_names():
+    # a native metric added to the schema without extending the mirror
+    # silently escapes the double-count gate (and vice versa)
+    from firedancer_tpu.utils import metrics as fm
+
+    assert ast_rules._FD219_NATIVE_OWNED == fm.native_owned_names()
+
+
+def test_fd219_suppressible_inline():
+    src = ("class S:\n"
+           "    def __init__(self, c):\n"
+           "        self._sweep_client = c\n"
+           "    def after_frag(self, sig, frag):\n"
+           "        self.metrics.inc('nsweep_frags')  "
+           "# fdlint: disable=FD219 -- bring-up shim\n")
+    findings = [f for f in ast_rules.lint_source(
+        src, "firedancer_tpu/runtime/bank.py") if f.rule == "FD219"]
+    assert len(findings) == 1 and findings[0].suppressed == "inline"
+
+
+def test_fd219_registered_and_repo_clean():
+    assert "FD219" in {r.id for r in all_rules()}
+    # the repo's own sweep-client modules never write native-owned words
+    # from Python (the facade skip + this rule are the same contract)
+    root = os.path.join(os.path.dirname(__file__), "..", "firedancer_tpu")
+    findings = [f for f in ast_rules.lint_path(root)
+                if f.rule == "FD219"]
+    assert findings == [], findings

@@ -356,3 +356,184 @@ def test_metrics_server_concurrent_scrape_and_registration():
         srv.close()
     assert errors == []
     assert len(srv.stages) > 1  # the registrar really was mutating
+
+
+# -- scraper re-resolution (ISSUE 20 satellite 2) -----------------------------
+#
+# Two failure modes a long-lived scraper must survive:
+#   (a) in-place restart: the supervisor SIGKILLs + respawns a stage
+#       against the SAME shm, so served counters continue monotonically;
+#   (b) run replacement: a new run takes over the advertised descriptor
+#       path, so the scraper must re-resolve the registry set instead of
+#       serving the dead run's (stale) counters forever.
+
+
+def _pong_builder(links, cnc, *, limit=64):
+    return _PingStage("pong", outs=[shm.Producer(links["pc"])], cnc=cnc,
+                      limit=limit)
+
+
+def _drain_builder(links, cnc):
+    return _SinkStage("drain", ins=[shm.Consumer(links["pc"], lazy=8)],
+                      cnc=cnc)
+
+
+def _pong_topology(limit=64):
+    topo = ft.Topology()
+    topo.link("pc", depth=256, mtu=64)
+    topo.stage("pong", _pong_builder, limit=limit, outs=["pc"])
+    topo.stage("drain", _drain_builder, ins=["pc"])
+    return topo
+
+
+def test_monitor_refresh_follows_replaced_run():
+    """MonitorSession.refresh(): no-op while the run is unchanged, full
+    re-attach when a NEW run takes over the descriptor path."""
+    h1 = ft.launch(_ping_topology(limit=16))
+    h2 = None
+    try:
+        path1 = mon.descriptor_path(h1.uid)
+        ses = mon.MonitorSession.attach(path1)
+        try:
+            assert ses.wait_ready(timeout_s=30)
+            uid1 = ses.uid
+            assert ses.refresh() is False  # same run -> keep mappings
+            assert ses.uid == uid1
+            h2 = ft.launch(_pong_topology(limit=16))
+            # the operator restarted the validator behind the same
+            # advertised path: new uid, new stage set, new segments
+            with open(mon.descriptor_path(h2.uid)) as f:
+                blob = f.read()
+            with open(path1, "w") as f:
+                f.write(blob)
+            assert ses.refresh() is True
+            assert ses.uid == h2.uid and ses.uid != uid1
+            assert set(ses.registries()) == {"pong", "drain"}
+            assert ses.wait_ready(timeout_s=30)
+        finally:
+            ses.close()
+    finally:
+        if h2 is not None:
+            h2.close()
+        h1.close()
+
+
+def test_metrics_server_resolver_re_resolves_across_run_replacement():
+    """The `fdtpu metrics --serve` wiring: a resolver-equipped server
+    must serve the NEW run's registries after replacement — never the
+    dead run's frozen counters (the stale-scrape regression)."""
+    import urllib.request
+
+    h1 = ft.launch(_ping_topology(limit=16))
+    h2 = None
+    try:
+        path1 = mon.descriptor_path(h1.uid)
+        ses = mon.MonitorSession.attach(path1)
+        try:
+            assert ses.wait_ready(timeout_s=30)
+
+            def resolve():
+                ses.refresh()
+                return ses.registries(), ses.shard_labels()
+
+            srv = fm.MetricsServer(ses.registries(),
+                                   labels=ses.shard_labels(),
+                                   resolver=resolve)
+            try:
+                host, port = srv.addr
+
+                def scrape():
+                    return urllib.request.urlopen(
+                        f"http://{host}:{port}/metrics", timeout=10
+                    ).read().decode()
+
+                assert 'stage="ping"' in scrape()
+                h2 = ft.launch(_pong_topology(limit=16))
+                with open(mon.descriptor_path(h2.uid)) as f:
+                    blob = f.read()
+                with open(path1, "w") as f:
+                    f.write(blob)
+                body = scrape()
+                assert 'stage="pong"' in body
+                assert 'stage="ping"' not in body  # stale set dropped
+            finally:
+                srv.close()
+                srv.stages = {}  # drop shm views before mappings close
+        finally:
+            ses.close()
+    finally:
+        if h2 is not None:
+            h2.close()
+        h1.close()
+
+
+def test_scrape_continuity_across_in_place_restart():
+    """SIGKILL a restartable publisher mid-scrape: the supervisor
+    respawns it against the SAME shm metrics segment, so an attached
+    HTTP scraper sees counters continue monotonically — no reset, no
+    stale plateau, no failed scrapes."""
+    import urllib.request
+
+    from firedancer_tpu.runtime.restart import RestartPolicy
+
+    topo = ft.Topology()
+    topo.link("pc", depth=256, mtu=64)
+    topo.stage("ping", _ping_builder, limit=100_000, outs=["pc"],
+               restartable=True)
+    topo.stage("sink", _sink_builder, ins=["pc"])
+    h = ft.launch(topo)
+    ses = None
+    srv = None
+    try:
+        ses = mon.MonitorSession.attach(mon.descriptor_path(h.uid))
+        assert ses.wait_ready(timeout_s=30)
+
+        def resolve():
+            ses.refresh()
+            return ses.registries(), ses.shard_labels()
+
+        srv = fm.MetricsServer(ses.registries(), labels=ses.shard_labels(),
+                               resolver=resolve)
+        host, port = srv.addr
+        seen = []
+        killed = [0]
+        kill_val = [0]
+
+        def scrape_sink():
+            body = urllib.request.urlopen(
+                f"http://{host}:{port}/metrics", timeout=10
+            ).read().decode()
+            for ln in body.splitlines():
+                if ln.startswith('frags_in{stage="sink"}'):
+                    return int(ln.split()[-1])
+            return None
+
+        def on_poll(hh):
+            v = scrape_sink()
+            if v is None:
+                return
+            seen.append(v)
+            if v > 200 and killed[0] == 0:
+                killed[0] = 1
+                kill_val[0] = v
+                hh.kill_stage("ping")
+
+        ok = h.supervise(
+            until=lambda hh: killed[0] and seen
+            and seen[-1] >= kill_val[0] + 300,
+            timeout_s=90, on_poll=on_poll,
+            restart=RestartPolicy(max_restarts=2, backoff_base_s=0.03,
+                                  seed=5))
+        assert ok, f"supervise failed (failed={h.failed!r})"
+        assert killed[0] == 1 and h.restarts == {"ping": 1}
+        # monotonic across the respawn: same segment, counters continue
+        assert seen == sorted(seen)
+        assert seen[-1] >= kill_val[0] + 300
+        h.halt()
+    finally:
+        if srv is not None:
+            srv.close()
+            srv.stages = {}
+        if ses is not None:
+            ses.close()
+        h.close()
